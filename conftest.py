@@ -1,0 +1,2 @@
+# Root conftest: puts the repo root on sys.path so `determined_tpu` and
+# `tests.*` import without installation (no-network environment).

@@ -1,0 +1,41 @@
+"""determined_tpu: a TPU-native deep-learning platform.
+
+A ground-up rebuild of the capabilities of Determined AI (reference:
+Stickybandit86/determined) designed TPU-first:
+
+- the *data plane* is JAX/XLA: GSPMD shardings over a `jax.sharding.Mesh`
+  (data / fsdp / tensor / pipeline / context / expert axes) with XLA
+  collectives over ICI/DCN — not NCCL/Horovod/DeepSpeed;
+- the *control plane* keeps the reference's shapes: a master with
+  experiment/trial state machines, an op-stream hyperparameter searcher,
+  resource pools with gang scheduling of whole TPU slices, rendezvous that
+  seeds `jax.distributed.initialize`, snapshot-based fault tolerance,
+  checkpoint storage + GC, metrics/log pipelines, and a CLI/SDK over a
+  REST API.
+
+Package map (mirrors reference layers, see SURVEY.md):
+
+- ``determined_tpu.core``     — Core API contexts (train/checkpoint/preempt/
+  searcher/distributed), the stable integration surface
+  (ref: harness/determined/core).
+- ``determined_tpu.parallel`` — mesh construction, partition rules, ring
+  attention / Ulysses sequence parallelism, pipeline schedules (net-new vs.
+  the reference, which delegated to Horovod/DeepSpeed).
+- ``determined_tpu.ops``      — Pallas TPU kernels (flash attention, etc.).
+- ``determined_tpu.models``   — model zoo (GPT-2 flagship, MNIST, CIFAR).
+- ``determined_tpu.trainer``  — JAXTrial + Trainer fit loop
+  (ref: harness/determined/pytorch/_pytorch_trial.py, _trainer.py).
+- ``determined_tpu.searcher`` — HP search as an op stream
+  (ref: master/pkg/searcher).
+- ``determined_tpu.master``   — platform control plane: experiment/trial
+  FSMs, resource manager/schedulers, rendezvous, REST API, persistence
+  (ref: master/internal).
+- ``determined_tpu.agent``    — per-host agent daemon (ref: agent/internal).
+- ``determined_tpu.storage``  — checkpoint storage managers
+  (ref: harness/determined/common/storage).
+- ``determined_tpu.cli``      — `dtpu` command-line interface.
+"""
+
+from determined_tpu._version import __version__
+
+__all__ = ["__version__"]

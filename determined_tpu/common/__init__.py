@@ -1,0 +1,1 @@
+"""Shared plumbing: IPC, API session, small utilities."""

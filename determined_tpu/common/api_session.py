@@ -1,0 +1,82 @@
+"""HTTP session for talking to the master's REST API.
+
+Mirrors the reference's `harness/determined/common/api/_session.py:10`
+(requests.Session wrapper with auth + retries). The API contract is
+JSON-over-REST; routes live in determined_tpu/master/api_server.py.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import requests
+
+RETRY_STATUSES = (502, 503, 504)
+
+
+class Session:
+    def __init__(
+        self,
+        master_url: str,
+        token: str = "",
+        max_retries: int = 5,
+        timeout: float = 60.0,
+    ) -> None:
+        self.master_url = master_url.rstrip("/")
+        self._token = token
+        self._max_retries = max_retries
+        self._timeout = timeout
+        self._http = requests.Session()
+        if token:
+            self._http.headers["Authorization"] = f"Bearer {token}"
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        json_body: Optional[Dict[str, Any]] = None,
+        params: Optional[Dict[str, Any]] = None,
+        timeout: Optional[float] = None,
+        stream: bool = False,
+    ) -> requests.Response:
+        url = f"{self.master_url}{path}"
+        last_exc: Optional[Exception] = None
+        for attempt in range(self._max_retries + 1):
+            try:
+                resp = self._http.request(
+                    method,
+                    url,
+                    json=json_body,
+                    params=params,
+                    timeout=timeout or self._timeout,
+                    stream=stream,
+                )
+                if resp.status_code in RETRY_STATUSES:
+                    raise requests.HTTPError(f"retryable status {resp.status_code}")
+                resp.raise_for_status()
+                return resp
+            except (requests.ConnectionError, requests.Timeout, requests.HTTPError) as e:
+                last_exc = e
+                if attempt == self._max_retries:
+                    break
+                if isinstance(e, requests.HTTPError) and e.response is not None:
+                    if e.response.status_code not in RETRY_STATUSES:
+                        raise
+                time.sleep(min(2.0 ** attempt * 0.1, 5.0))
+        assert last_exc is not None
+        raise last_exc
+
+    def get(self, path: str, **kw: Any) -> Any:
+        return self._request("GET", path, **kw).json()
+
+    def post(self, path: str, json_body: Optional[Dict[str, Any]] = None, **kw: Any) -> Any:
+        resp = self._request("POST", path, json_body=json_body, **kw)
+        return resp.json() if resp.content else None
+
+    def patch(self, path: str, json_body: Optional[Dict[str, Any]] = None, **kw: Any) -> Any:
+        resp = self._request("PATCH", path, json_body=json_body, **kw)
+        return resp.json() if resp.content else None
+
+    def delete(self, path: str, **kw: Any) -> Any:
+        resp = self._request("DELETE", path, **kw)
+        return resp.json() if resp.content else None

@@ -1,0 +1,121 @@
+"""Chief/worker control-plane IPC over ZeroMQ.
+
+TPU-native analog of the reference's ZMQ star (ref:
+harness/determined/ipc.py:32,169 — ZMQBroadcastServer/ZMQBroadcastClient).
+This carries *control-plane* python objects only (metrics dicts, checkpoint
+selectors, preemption flags) — never tensors. The data plane is XLA
+collectives over ICI/DCN, compiled into the jitted program.
+
+Design difference from the reference: instead of PUB/SUB + PUSH/PULL (which
+needs a slow-joiner sync dance), we use a single ROUTER socket on the chief
+and DEALER sockets on workers. ROUTER gives reliable, addressable delivery,
+so gather/broadcast need no sync protocol.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import threading
+from typing import Any, List, Optional
+
+import zmq
+
+_HELLO = b"__hello__"
+
+
+def free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class ChiefServer:
+    """Runs on rank 0. Accepts `size - 1` worker connections."""
+
+    def __init__(self, num_workers: int, port: int = 0) -> None:
+        self._num_workers = num_workers
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.ROUTER)
+        self._sock.setsockopt(zmq.ROUTER_MANDATORY, 1)
+        if port == 0:
+            self.port = self._sock.bind_to_random_port("tcp://*")
+        else:
+            self._sock.bind(f"tcp://*:{port}")
+            self.port = port
+        self._identities: List[bytes] = []
+        # Per-rank FIFO of data frames that arrived early: a fast worker may
+        # send its next payload (or its first one, during accept) before
+        # slower workers catch up. ZMQ preserves per-connection ordering, so
+        # per-rank deques keep rounds aligned without sequence numbers.
+        self._inbox: dict = {}
+
+    def _stash(self, payload: bytes) -> None:
+        rank, obj = pickle.loads(payload)
+        self._inbox.setdefault(rank, []).append(obj)
+
+    def accept(self, timeout_s: float = 120.0) -> None:
+        """Wait for all workers to say hello."""
+        self._sock.setsockopt(zmq.RCVTIMEO, int(timeout_s * 1000))
+        while len(self._identities) < self._num_workers:
+            ident, payload = self._sock.recv_multipart()
+            if payload == _HELLO:
+                if ident not in self._identities:
+                    self._identities.append(ident)
+            else:
+                self._stash(payload)
+        self._sock.setsockopt(zmq.RCVTIMEO, -1)
+
+    def gather(self, timeout_s: Optional[float] = None) -> List[Any]:
+        """Receive one object from every worker (ranks 1..n), rank-ordered."""
+        self._sock.setsockopt(
+            zmq.RCVTIMEO, -1 if timeout_s is None else int(timeout_s * 1000)
+        )
+        out: dict = {}
+        for rank in range(1, self._num_workers + 1):
+            queued = self._inbox.get(rank)
+            if queued:
+                out[rank] = queued.pop(0)
+        while len(out) < self._num_workers:
+            ident, payload = self._sock.recv_multipart()
+            if payload == _HELLO:
+                continue
+            rank, obj = pickle.loads(payload)
+            if rank in out:
+                self._inbox.setdefault(rank, []).append(obj)
+            else:
+                out[rank] = obj
+        return [out[r] for r in sorted(out)]
+
+    def broadcast(self, obj: Any) -> None:
+        payload = pickle.dumps(obj)
+        for ident in self._identities:
+            self._sock.send_multipart([ident, payload])
+
+    def close(self) -> None:
+        # Bounded linger: lets in-flight frames flush from the IO thread
+        # without pinning dead sockets forever. linger=0 here would race
+        # with delivery of the last send.
+        self._sock.close(linger=10_000)
+
+
+class WorkerClient:
+    """Runs on ranks > 0; connects to the chief."""
+
+    def __init__(self, chief_addr: str, rank: int, timeout_s: float = 120.0) -> None:
+        self._rank = rank
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.DEALER)
+        self._sock.setsockopt(zmq.RCVTIMEO, int(timeout_s * 1000))
+        self._sock.connect(f"tcp://{chief_addr}")
+        self._sock.send(_HELLO)
+
+    def send(self, obj: Any) -> None:
+        self._sock.send(pickle.dumps((self._rank, obj)))
+
+    def recv(self) -> Any:
+        return pickle.loads(self._sock.recv())
+
+    def close(self) -> None:
+        self._sock.close(linger=10_000)

@@ -1,0 +1,36 @@
+"""Core API: the stable trial↔platform integration surface.
+
+Ref: harness/determined/core (SURVEY.md §2.3 'Core API').
+"""
+from determined_tpu.core._checkpoint import (
+    CheckpointContext,
+    DummyCheckpointContext,
+    merge_metadata,
+)
+from determined_tpu.core._context import Context, init, _dummy_init
+from determined_tpu.core._distributed import DistributedContext, DummyDistributedContext
+from determined_tpu.core._preempt import DummyPreemptContext, PreemptContext, PreemptMode
+from determined_tpu.core._searcher import (
+    DummySearcherContext,
+    SearcherContext,
+    SearcherOperation,
+)
+from determined_tpu.core._train import DummyTrainContext, TrainContext
+
+__all__ = [
+    "Context",
+    "init",
+    "CheckpointContext",
+    "DistributedContext",
+    "PreemptContext",
+    "PreemptMode",
+    "SearcherContext",
+    "SearcherOperation",
+    "TrainContext",
+    "DummyCheckpointContext",
+    "DummyDistributedContext",
+    "DummyPreemptContext",
+    "DummySearcherContext",
+    "DummyTrainContext",
+    "merge_metadata",
+]

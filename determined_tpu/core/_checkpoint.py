@@ -1,0 +1,166 @@
+"""CheckpointContext: collective checkpoint upload/download + metadata.
+
+Mirrors the reference's `harness/determined/core/_checkpoint.py:171`:
+- `storage_id` is a uuid directory name chosen by the chief and broadcast
+  (ref: _checkpoint.py:246-255, `_upload_sharded`);
+- sharded upload is a *collective*: each process uploads its own files, the
+  chief gathers per-rank resource lists, merges `metadata.json`, and reports
+  the checkpoint to the master;
+- `restore_path` streams the checkpoint down (with a per-rank selector for
+  sharded restore) and cleans up after itself.
+
+On TPU the sharded path is the common case: orbax/ocdbt writes per-host
+shards of the GSPMD-sharded train state, and each host uploads only what it
+wrote.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import uuid
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from determined_tpu.common.api_session import Session
+from determined_tpu.core._distributed import DistributedContext
+from determined_tpu.storage.base import StorageManager
+
+logger = logging.getLogger("determined_tpu.core")
+
+METADATA_FILE = "metadata.json"
+
+
+def merge_metadata(all_metadata: List[Optional[Dict[str, Any]]]) -> Dict[str, Any]:
+    """Merge per-rank metadata dicts; later ranks must not conflict.
+
+    Ref semantics: core/_checkpoint.py:38-127 (merge with conflict check).
+    """
+    merged: Dict[str, Any] = {}
+    for rank, md in enumerate(all_metadata):
+        if not md:
+            continue
+        for k, v in md.items():
+            if k in merged and merged[k] != v:
+                raise ValueError(
+                    f"conflicting checkpoint metadata key {k!r} from rank {rank}"
+                )
+            merged[k] = v
+    return merged
+
+
+class CheckpointContext:
+    def __init__(
+        self,
+        distributed: DistributedContext,
+        storage_manager: StorageManager,
+        session: Optional[Session] = None,
+        task_id: str = "",
+        allocation_id: str = "",
+        trial_id: Optional[int] = None,
+    ) -> None:
+        self._dist = distributed
+        self._storage = storage_manager
+        self._session = session
+        self._task_id = task_id
+        self._allocation_id = allocation_id
+        self._trial_id = trial_id
+
+    # -- save --------------------------------------------------------------
+    def upload(
+        self,
+        ckpt_dir: str,
+        metadata: Optional[Dict[str, Any]] = None,
+        *,
+        shard: bool = False,
+        paths: Optional[List[str]] = None,
+    ) -> str:
+        """Upload `ckpt_dir` as a new checkpoint; returns storage_id.
+
+        With shard=True this is a collective across the allocation: every
+        process calls it, each uploads its own `paths` (default: all files
+        it has), and rank 0 merges metadata + reports to the master.
+        """
+        if shard and self._dist.size > 1:
+            storage_id = self._dist.broadcast(
+                str(uuid.uuid4()) if self._dist.is_chief else None
+            )
+        else:
+            storage_id = str(uuid.uuid4())
+
+        my_files = paths if paths is not None else StorageManager._list_dir(ckpt_dir)
+        my_files = [f for f in my_files if f != METADATA_FILE]
+        self._storage.upload(ckpt_dir, storage_id, paths=my_files)
+
+        if shard and self._dist.size > 1:
+            gathered_files = self._dist.gather(my_files)
+            gathered_md = self._dist.gather(metadata)
+        else:
+            gathered_files, gathered_md = [my_files], [metadata]
+
+        if self._dist.is_chief:
+            assert gathered_files is not None and gathered_md is not None
+            merged_md = merge_metadata(gathered_md)
+            resources = sorted({f for fs in gathered_files for f in fs})
+            # write merged metadata.json alongside the shards
+            with contextlib.suppress(Exception):
+                import tempfile
+
+                with tempfile.TemporaryDirectory() as tmp:
+                    md_path = os.path.join(tmp, METADATA_FILE)
+                    with open(md_path, "w") as f:
+                        json.dump(merged_md, f)
+                    self._storage.upload(tmp, storage_id, paths=[METADATA_FILE])
+            self._report(storage_id, resources + [METADATA_FILE], merged_md)
+        if shard and self._dist.size > 1:
+            self._dist.barrier()
+        return storage_id
+
+    def _report(self, storage_id: str, resources: List[str], metadata: Dict[str, Any]) -> None:
+        if self._session is None:
+            return
+        self._session.post(
+            "/api/v1/checkpoints",
+            json_body={
+                "uuid": storage_id,
+                "task_id": self._task_id,
+                "allocation_id": self._allocation_id,
+                "trial_id": self._trial_id,
+                "resources": resources,
+                "metadata": metadata,
+                "state": "COMPLETED",
+            },
+        )
+
+    # -- load --------------------------------------------------------------
+    @contextlib.contextmanager
+    def restore_path(
+        self, storage_id: str, selector: Optional[Callable[[str], bool]] = None
+    ) -> Iterator[str]:
+        with self._storage.restore_path(storage_id, selector=selector) as path:
+            yield path
+
+    def download(
+        self, storage_id: str, dst: str, selector: Optional[Callable[[str], bool]] = None
+    ) -> None:
+        self._storage.download(storage_id, dst, selector=selector)
+
+    def get_metadata(self, storage_id: str) -> Dict[str, Any]:
+        with self._storage.restore_path(
+            storage_id, selector=lambda p: p == METADATA_FILE
+        ) as path:
+            md_path = os.path.join(path, METADATA_FILE)
+            if not os.path.exists(md_path):
+                return {}
+            with open(md_path) as f:
+                return json.load(f)
+
+    def delete(self, storage_id: str) -> None:
+        self._storage.delete(storage_id)
+
+
+class DummyCheckpointContext(CheckpointContext):
+    """Off-cluster mode (ref: core/_checkpoint.py:715): local storage, no master."""
+
+    def __init__(self, distributed: DistributedContext, storage_manager: StorageManager) -> None:
+        super().__init__(distributed, storage_manager, session=None)

@@ -1,0 +1,154 @@
+"""core.Context: composition of the five sub-contexts + init().
+
+Mirrors the reference's `harness/determined/core/_context.py:20-58` (Context)
+and `init()` (`:181`) with the `_dummy_init` off-cluster path (`:140`).
+
+`init()` decides the mode from the environment:
+- on-cluster (DTPU_MASTER set by the launcher): wires a real Session,
+  initializes `jax.distributed` from the rendezvous payload if the
+  allocation spans multiple hosts, and builds live contexts;
+- off-cluster: dummy contexts — metrics are logged, checkpoints go to a
+  local directory, preemption never fires, the searcher hands out a single
+  op. This is the official way to run trial code unmodified outside the
+  cluster (notebooks, tests).
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Optional
+
+from determined_tpu import _info
+from determined_tpu.common.api_session import Session
+from determined_tpu.core._checkpoint import CheckpointContext, DummyCheckpointContext
+from determined_tpu.core._distributed import DistributedContext, DummyDistributedContext
+from determined_tpu.core._preempt import DummyPreemptContext, PreemptContext, PreemptMode
+from determined_tpu.core._searcher import DummySearcherContext, SearcherContext
+from determined_tpu.core._train import DummyTrainContext, TrainContext
+from determined_tpu.storage import from_config as storage_from_config
+
+logger = logging.getLogger("determined_tpu.core")
+
+
+class Context:
+    def __init__(
+        self,
+        *,
+        distributed: DistributedContext,
+        train: TrainContext,
+        checkpoint: CheckpointContext,
+        preempt: PreemptContext,
+        searcher: SearcherContext,
+        info: Optional[_info.ClusterInfo] = None,
+        session: Optional[Session] = None,
+    ) -> None:
+        self.distributed = distributed
+        self.train = train
+        self.checkpoint = checkpoint
+        self.preempt = preempt
+        self.searcher = searcher
+        self.info = info
+        self._session = session
+
+    def close(self) -> None:
+        self.preempt.close()
+        self.distributed.close()
+
+    def __enter__(self) -> "Context":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+def _dummy_init(
+    *,
+    distributed: Optional[DistributedContext] = None,
+    checkpoint_storage: Optional[str] = None,
+    searcher_length: int = 1,
+) -> Context:
+    dist = distributed or DummyDistributedContext()
+    storage = storage_from_config(
+        {"type": "shared_fs", "host_path": checkpoint_storage}
+        if checkpoint_storage
+        else None
+    )
+    return Context(
+        distributed=dist,
+        train=DummyTrainContext(),
+        checkpoint=DummyCheckpointContext(dist, storage),
+        preempt=DummyPreemptContext(dist),
+        searcher=DummySearcherContext(dist, length=searcher_length),
+    )
+
+
+def _maybe_init_jax_distributed(info: _info.ClusterInfo) -> None:
+    """Bring up the JAX coordination service from the rendezvous payload.
+
+    Replaces the reference's launch-layer rendezvous plumbing (horovodrun
+    host lists, torchrun --rdzv_endpoint): the master hands each host a
+    coordinator address + process index and JAX/ICI does the rest
+    (SURVEY.md §2.5 'Rendezvous').
+    """
+    rdzv = info.rendezvous
+    if rdzv is None or rdzv.num_processes <= 1:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=rdzv.coordinator_address,
+        num_processes=rdzv.num_processes,
+        process_id=rdzv.process_index,
+    )
+
+
+def init(
+    *,
+    distributed: Optional[DistributedContext] = None,
+    checkpoint_storage: Optional[str] = None,
+    preempt_mode: PreemptMode = PreemptMode.ChiefOnly,
+) -> Context:
+    info = _info.get_cluster_info()
+    if info is None:
+        logger.info("no cluster detected; core.init() in dummy (off-cluster) mode")
+        return _dummy_init(
+            distributed=distributed, checkpoint_storage=checkpoint_storage
+        )
+
+    session = Session(info.master_url, token=info.session_token)
+
+    if distributed is None:
+        rdzv = info.rendezvous
+        if rdzv is not None and rdzv.num_processes > 1:
+            _maybe_init_jax_distributed(info)
+            chief_ip = rdzv.container_addrs[0]
+            chief_port = int(os.environ.get("DTPU_CHIEF_PORT", "42071"))
+            distributed = DistributedContext(
+                rank=rdzv.process_index,
+                size=rdzv.num_processes,
+                chief_ip=chief_ip,
+                chief_port=chief_port,
+            )
+        else:
+            distributed = DummyDistributedContext()
+
+    storage = storage_from_config(info.checkpoint_storage, checkpoint_storage)
+    trial_id = info.trial.trial_id if info.trial else 0
+    run_id = info.trial.trial_run_id if info.trial else 0
+
+    return Context(
+        distributed=distributed,
+        train=TrainContext(session, trial_id, run_id),
+        checkpoint=CheckpointContext(
+            distributed,
+            storage,
+            session=session,
+            task_id=info.task_id,
+            allocation_id=info.allocation_id,
+            trial_id=trial_id,
+        ),
+        preempt=PreemptContext(session, info.allocation_id, distributed, preempt_mode),
+        searcher=SearcherContext(session, distributed, trial_id),
+        info=info,
+        session=session,
+    )

@@ -1,0 +1,112 @@
+"""DistributedContext: cross-process control-plane primitives.
+
+Mirrors the reference's `harness/determined/core/_distributed.py:10` but for
+the JAX process model: one process per TPU host, so ``rank`` is the JAX
+process index and ``size`` the number of processes in the allocation. The
+gather/allgather/broadcast here move *python objects* over a ZMQ star (ref:
+core/_distributed.py:85-130); tensor collectives belong in the compiled
+program (psum/all_gather over the Mesh), never here.
+
+The `from_jax` constructor replaces the reference's
+`from_horovod/from_torch_distributed` adapters (core/_distributed.py:165+).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional
+
+from determined_tpu.common import ipc
+
+logger = logging.getLogger("determined_tpu.core")
+
+
+class DistributedContext:
+    def __init__(
+        self,
+        *,
+        rank: int,
+        size: int,
+        chief_ip: Optional[str] = None,
+        chief_port: int = 0,
+        port_offset: int = 0,
+    ) -> None:
+        self.rank = rank
+        self.size = size
+        self._closed = False
+        self._server: Optional[ipc.ChiefServer] = None
+        self._client: Optional[ipc.WorkerClient] = None
+        if size > 1:
+            if rank == 0:
+                self._server = ipc.ChiefServer(size - 1, port=chief_port)
+                self._server.accept()
+            else:
+                assert chief_ip is not None, "workers need chief_ip"
+                assert chief_port != 0, "workers need chief_port"
+                self._client = ipc.WorkerClient(f"{chief_ip}:{chief_port}", rank)
+
+    # -- identity ----------------------------------------------------------
+    @classmethod
+    def from_jax(cls, chief_ip: Optional[str] = None, chief_port: int = 0) -> "DistributedContext":
+        """Build from an initialized jax.distributed runtime."""
+        import jax
+
+        return cls(
+            rank=jax.process_index(),
+            size=jax.process_count(),
+            chief_ip=chief_ip,
+            chief_port=chief_port,
+        )
+
+    def get_rank(self) -> int:
+        return self.rank
+
+    def get_size(self) -> int:
+        return self.size
+
+    @property
+    def is_chief(self) -> bool:
+        return self.rank == 0
+
+    # -- collectives (control-plane objects only) --------------------------
+    def gather(self, obj: Any) -> Optional[List[Any]]:
+        """Every process sends; chief receives the ordered list, others None."""
+        if self.size == 1:
+            return [obj]
+        if self._server is not None:
+            return [obj] + self._server.gather()
+        assert self._client is not None
+        self._client.send(obj)
+        return None
+
+    def broadcast(self, obj: Any) -> Any:
+        """Chief's object is returned on every process."""
+        if self.size == 1:
+            return obj
+        if self._server is not None:
+            self._server.broadcast(obj)
+            return obj
+        assert self._client is not None
+        return self._client.recv()
+
+    def allgather(self, obj: Any) -> List[Any]:
+        gathered = self.gather(obj)
+        return self.broadcast(gathered)
+
+    def barrier(self) -> None:
+        self.allgather(None)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._server is not None:
+            self._server.close()
+        if self._client is not None:
+            self._client.close()
+
+
+class DummyDistributedContext(DistributedContext):
+    """Single-process fallback (ref: core/_distributed.py:408)."""
+
+    def __init__(self) -> None:
+        super().__init__(rank=0, size=1)

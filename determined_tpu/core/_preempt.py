@@ -1,0 +1,117 @@
+"""PreemptContext: cooperative preemption signal.
+
+Mirrors the reference's `harness/determined/core/_preempt.py:148` with its
+`_PreemptionWatcher` long-poll thread (`:15`) and preempt modes (`:124`).
+On TPU pods the **ChiefOnly + broadcast** pattern is mandatory (SURVEY.md §7
+hard part b): all hosts run one SPMD program and must reach the checkpoint
+boundary collectively, so only the chief long-polls the master and the
+decision is broadcast over the control plane at step boundaries.
+"""
+from __future__ import annotations
+
+import enum
+import logging
+import threading
+from typing import Optional
+
+from determined_tpu.common.api_session import Session
+from determined_tpu.core._distributed import DistributedContext
+
+logger = logging.getLogger("determined_tpu.core")
+
+
+class PreemptMode(enum.Enum):
+    WorkersAskChief = "workers_ask_chief"
+    ChiefOnly = "chief_only"
+
+
+class _PreemptionWatcher(threading.Thread):
+    """Long-polls the master for the allocation's preemption signal."""
+
+    def __init__(self, session: Session, allocation_id: str) -> None:
+        super().__init__(daemon=True, name="preemption-watcher")
+        self._session = session
+        self._allocation_id = allocation_id
+        self._should_preempt = False
+        self._should_quit = False
+
+    def run(self) -> None:
+        while not self._should_quit and not self._should_preempt:
+            try:
+                resp = self._session.get(
+                    f"/api/v1/allocations/{self._allocation_id}/signals/preemption",
+                    params={"timeout_seconds": 60},
+                    timeout=70,
+                )
+                if resp.get("preempt"):
+                    self._should_preempt = True
+            except Exception as e:
+                logger.warning("preemption poll failed: %s", e)
+                if self._should_quit:
+                    return
+                threading.Event().wait(5)
+
+    @property
+    def should_preempt(self) -> bool:
+        return self._should_preempt
+
+    def close(self) -> None:
+        self._should_quit = True
+
+
+class PreemptContext:
+    def __init__(
+        self,
+        session: Session,
+        allocation_id: str,
+        distributed: DistributedContext,
+        preempt_mode: PreemptMode = PreemptMode.ChiefOnly,
+    ) -> None:
+        self._session = session
+        self._allocation_id = allocation_id
+        self._dist = distributed
+        self._mode = preempt_mode
+        self._watcher: Optional[_PreemptionWatcher] = None
+        self._ack_sent = False
+        if distributed.is_chief:
+            self._watcher = _PreemptionWatcher(session, allocation_id)
+            self._watcher.start()
+
+    def should_preempt(self, auto_ack: bool = True) -> bool:
+        """Collective at step boundaries: chief polls, result broadcast."""
+        if self._dist.is_chief:
+            assert self._watcher is not None
+            flag = self._watcher.should_preempt
+        else:
+            flag = False
+        if self._mode == PreemptMode.WorkersAskChief or self._dist.size > 1:
+            flag = bool(self._dist.broadcast(flag))
+        if flag and auto_ack and self._dist.is_chief and not self._ack_sent:
+            self.acknowledge_preemption_signal()
+        return flag
+
+    def acknowledge_preemption_signal(self) -> None:
+        self._ack_sent = True
+        self._session.post(
+            f"/api/v1/allocations/{self._allocation_id}/signals/ack_preemption"
+        )
+
+    def close(self) -> None:
+        if self._watcher is not None:
+            self._watcher.close()
+
+
+class DummyPreemptContext(PreemptContext):
+    """Off-cluster: never preempted."""
+
+    def __init__(self, distributed: DistributedContext) -> None:  # noqa
+        self._dist = distributed
+
+    def should_preempt(self, auto_ack: bool = True) -> bool:
+        return False
+
+    def acknowledge_preemption_signal(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass

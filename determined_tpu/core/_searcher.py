@@ -1,0 +1,116 @@
+"""SearcherContext: the trial side of the hyperparameter-search op stream.
+
+Mirrors the reference's `harness/determined/core/_searcher.py:131`
+(SearcherContext) and `:35` (SearcherOperation). The master's searcher emits
+`ValidateAfter(length)` operations; the trial long-polls for its current
+operation, trains to that length, reports progress along the way, and
+completes the op with its searcher metric. The chief drives this; workers
+follow via broadcast — on a TPU pod every host must agree on the training
+length before the compiled loop runs.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Iterator, Optional
+
+from determined_tpu.common.api_session import Session
+from determined_tpu.core._distributed import DistributedContext
+
+logger = logging.getLogger("determined_tpu.core")
+
+
+class SearcherOperation:
+    def __init__(
+        self,
+        session: Optional[Session],
+        trial_id: int,
+        length: int,
+        is_chief: bool,
+    ) -> None:
+        self._session = session
+        self._trial_id = trial_id
+        self.length = length
+        self._is_chief = is_chief
+        self._completed = False
+
+    @property
+    def completed(self) -> bool:
+        return self._completed
+
+    def report_progress(self, length_completed: float) -> None:
+        if not self._is_chief:
+            raise RuntimeError("only the chief reports searcher progress")
+        if self._session is not None:
+            self._session.post(
+                f"/api/v1/trials/{self._trial_id}/searcher/progress",
+                json_body={"progress": length_completed},
+            )
+
+    def report_completed(self, searcher_metric: float) -> None:
+        if not self._is_chief:
+            raise RuntimeError("only the chief completes searcher ops")
+        self._completed = True
+        if self._session is not None:
+            self._session.post(
+                f"/api/v1/trials/{self._trial_id}/searcher/completed",
+                json_body={"length": self.length, "metric": searcher_metric},
+            )
+
+
+class SearcherContext:
+    def __init__(
+        self,
+        session: Session,
+        distributed: DistributedContext,
+        trial_id: int,
+    ) -> None:
+        self._session = session
+        self._dist = distributed
+        self._trial_id = trial_id
+
+    def _get_current_op(self) -> Optional[SearcherOperation]:
+        resp = self._session.get(
+            f"/api/v1/trials/{self._trial_id}/searcher/operation",
+            params={"timeout_seconds": 60},
+            timeout=70,
+        )
+        if resp.get("completed") or resp.get("op") is None:
+            return None
+        return SearcherOperation(
+            self._session, self._trial_id, int(resp["op"]["length"]), self._dist.is_chief
+        )
+
+    def operations(self) -> Iterator[SearcherOperation]:
+        """Yield ValidateAfter ops until the searcher closes the trial.
+
+        Chief polls the master; the op length (or shutdown) is broadcast so
+        every host iterates identically (ref: _pytorch_trial.py:618 loop).
+        """
+        while True:
+            if self._dist.is_chief:
+                op = self._get_current_op()
+                self._dist.broadcast(None if op is None else op.length)
+                if op is None:
+                    return
+                yield op
+                if not op.completed:
+                    raise RuntimeError(
+                        "searcher op yielded but never completed; call "
+                        "op.report_completed(metric) after training to op.length"
+                    )
+            else:
+                length = self._dist.broadcast(None)
+                if length is None:
+                    return
+                yield SearcherOperation(None, self._trial_id, int(length), False)
+
+
+class DummySearcherContext(SearcherContext):
+    """Off-cluster mode (ref: core/_searcher.py:321): one op of `length`."""
+
+    def __init__(self, distributed: DistributedContext, length: int = 1) -> None:  # noqa
+        self._dist = distributed
+        self._length = length
+
+    def operations(self) -> Iterator[SearcherOperation]:
+        yield SearcherOperation(None, 0, self._length, self._dist.is_chief)

@@ -1,0 +1,79 @@
+"""TrainContext: reporting metrics and progress to the master.
+
+Mirrors the reference's `harness/determined/core/_train.py:20` (report path
+:71-99 → REST ReportTrialMetrics → master DB). Only the chief process
+reports; callers typically guard with `distributed.is_chief` the way the
+reference's Trainer does.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, Optional
+
+from determined_tpu.common.api_session import Session
+
+logger = logging.getLogger("determined_tpu.core")
+
+
+class TrainContext:
+    def __init__(self, session: Session, trial_id: int, run_id: int = 0) -> None:
+        self._session = session
+        self._trial_id = trial_id
+        self._run_id = run_id
+
+    def _report(self, group: str, steps_completed: int, metrics: Dict[str, Any]) -> None:
+        self._session.post(
+            f"/api/v1/trials/{self._trial_id}/metrics",
+            json_body={
+                "group": group,
+                "steps_completed": steps_completed,
+                "trial_run_id": self._run_id,
+                "metrics": metrics,
+                "report_time": time.time(),
+            },
+        )
+
+    def report_training_metrics(self, steps_completed: int, metrics: Dict[str, Any]) -> None:
+        self._report("training", steps_completed, metrics)
+
+    def report_validation_metrics(self, steps_completed: int, metrics: Dict[str, Any]) -> None:
+        self._report("validation", steps_completed, metrics)
+
+    def report_metrics(self, group: str, steps_completed: int, metrics: Dict[str, Any]) -> None:
+        self._report(group, steps_completed, metrics)
+
+    def report_progress(self, progress: float) -> None:
+        self._session.post(
+            f"/api/v1/trials/{self._trial_id}/progress",
+            json_body={"progress": float(progress)},
+        )
+
+    def set_status(self, status: str) -> None:
+        self._session.post(
+            f"/api/v1/trials/{self._trial_id}/status", json_body={"status": status}
+        )
+
+    def get_experiment_best_validation(self) -> Optional[float]:
+        resp = self._session.get(f"/api/v1/trials/{self._trial_id}/best_validation")
+        return resp.get("best")
+
+
+class DummyTrainContext(TrainContext):
+    """Off-cluster mode: log metrics instead of reporting them."""
+
+    def __init__(self) -> None:  # noqa: super not called on purpose
+        self._reported: list = []
+
+    def _report(self, group: str, steps_completed: int, metrics: Dict[str, Any]) -> None:
+        self._reported.append((group, steps_completed, metrics))
+        logger.info("[dummy] %s metrics @%d: %s", group, steps_completed, metrics)
+
+    def report_progress(self, progress: float) -> None:
+        logger.info("[dummy] progress: %.3f", progress)
+
+    def set_status(self, status: str) -> None:
+        logger.info("[dummy] status: %s", status)
+
+    def get_experiment_best_validation(self) -> Optional[float]:
+        return None

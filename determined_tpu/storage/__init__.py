@@ -1,0 +1,11 @@
+"""Checkpoint storage managers (ref: harness/determined/common/storage)."""
+from determined_tpu.storage.base import StorageManager, from_config
+from determined_tpu.storage.shared import SharedFSStorageManager
+from determined_tpu.storage.gcs import GCSStorageManager
+
+__all__ = [
+    "StorageManager",
+    "SharedFSStorageManager",
+    "GCSStorageManager",
+    "from_config",
+]

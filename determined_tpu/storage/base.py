@@ -1,0 +1,85 @@
+"""StorageManager interface + factory.
+
+Mirrors the reference's `harness/determined/common/storage/base.py:26`.
+A checkpoint is a directory addressed by a uuid `storage_id`; managers
+upload/download/delete whole directories and support partial (selector'd)
+downloads for sharded restore. GCS first-class (TPU world lives on GCS,
+SURVEY.md §7.2); S3/Azure ports can follow the same interface.
+"""
+from __future__ import annotations
+
+import abc
+import contextlib
+import os
+from typing import Callable, Iterator, List, Optional
+
+
+class StorageManager(abc.ABC):
+    def __init__(self, base_path: str) -> None:
+        self.base_path = base_path
+
+    # -- directory-level API ----------------------------------------------
+    @abc.abstractmethod
+    def upload(self, src: str, storage_id: str, paths: Optional[List[str]] = None) -> None:
+        """Upload directory `src` as checkpoint `storage_id` (optionally only `paths`)."""
+
+    @abc.abstractmethod
+    def download(
+        self,
+        storage_id: str,
+        dst: str,
+        selector: Optional[Callable[[str], bool]] = None,
+    ) -> None:
+        """Download checkpoint into `dst`; `selector` filters relative paths."""
+
+    @abc.abstractmethod
+    def delete(self, storage_id: str, paths: Optional[List[str]] = None) -> List[str]:
+        """Delete a checkpoint (or some paths within it); return deleted rel-paths."""
+
+    @abc.abstractmethod
+    def list_files(self, storage_id: str) -> List[str]:
+        """Relative paths of all files in the checkpoint."""
+
+    @contextlib.contextmanager
+    def restore_path(
+        self, storage_id: str, selector: Optional[Callable[[str], bool]] = None
+    ) -> Iterator[str]:
+        """Context manager that yields a local directory with the checkpoint.
+
+        Cloud managers download into a temp dir and clean it up afterwards;
+        shared-fs yields the directory in place (ref: storage/shared.py).
+        """
+        import shutil
+        import tempfile
+
+        tmp = tempfile.mkdtemp(prefix="dtpu-ckpt-")
+        try:
+            self.download(storage_id, tmp, selector=selector)
+            yield tmp
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    @staticmethod
+    def _list_dir(root: str) -> List[str]:
+        out = []
+        for dirpath, _, filenames in os.walk(root):
+            for f in filenames:
+                out.append(os.path.relpath(os.path.join(dirpath, f), root))
+        return sorted(out)
+
+
+def from_config(config: Optional[dict], base_dir: Optional[str] = None) -> StorageManager:
+    """Build a manager from an expconf `checkpoint_storage` block."""
+    from determined_tpu.storage.gcs import GCSStorageManager
+    from determined_tpu.storage.shared import SharedFSStorageManager
+
+    if not config:
+        return SharedFSStorageManager(base_dir or os.path.expanduser("~/.dtpu/checkpoints"))
+    typ = config.get("type", "shared_fs")
+    if typ == "shared_fs":
+        return SharedFSStorageManager(
+            os.path.expanduser(config.get("host_path", base_dir or "~/.dtpu/checkpoints"))
+        )
+    if typ == "gcs":
+        return GCSStorageManager(config["bucket"], config.get("prefix", ""))
+    raise ValueError(f"unknown checkpoint storage type: {typ}")

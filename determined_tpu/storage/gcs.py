@@ -1,0 +1,75 @@
+"""GCS storage manager (ref: harness/determined/common/storage/gcs.py:14).
+
+GCS is the first-class cloud backend for TPU fleets. The google-cloud-storage
+client is imported lazily and gated: in environments without it (like CI
+images), constructing the manager raises a clear error, and everything else
+in the platform still works with shared_fs.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional
+
+from determined_tpu.storage.base import StorageManager
+
+
+class GCSStorageManager(StorageManager):
+    def __init__(self, bucket: str, prefix: str = "") -> None:
+        super().__init__(base_path=f"gs://{bucket}/{prefix}")
+        try:
+            from google.cloud import storage as gcs  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "google-cloud-storage is not installed; use checkpoint_storage.type="
+                "shared_fs or install the GCS client"
+            ) from e
+        self._client = gcs.Client()
+        self._bucket = self._client.bucket(bucket)
+        self._prefix = prefix.strip("/")
+
+    def _key(self, storage_id: str, rel: str = "") -> str:
+        parts = [p for p in (self._prefix, storage_id, rel) if p]
+        return "/".join(parts)
+
+    def upload(self, src: str, storage_id: str, paths: Optional[List[str]] = None) -> None:
+        rels = paths if paths is not None else self._list_dir(src)
+        for rel in rels:
+            blob = self._bucket.blob(self._key(storage_id, rel))
+            blob.upload_from_filename(os.path.join(src, rel))
+
+    def download(
+        self,
+        storage_id: str,
+        dst: str,
+        selector: Optional[Callable[[str], bool]] = None,
+    ) -> None:
+        prefix = self._key(storage_id) + "/"
+        found = False
+        for blob in self._client.list_blobs(self._bucket, prefix=prefix):
+            rel = blob.name[len(prefix):]
+            if not rel or (selector is not None and not selector(rel)):
+                continue
+            found = True
+            target = os.path.join(dst, rel)
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            blob.download_to_filename(target)
+        if not found:
+            raise FileNotFoundError(f"checkpoint {storage_id} not found at gs://{prefix}")
+
+    def delete(self, storage_id: str, paths: Optional[List[str]] = None) -> List[str]:
+        prefix = self._key(storage_id) + "/"
+        deleted = []
+        for blob in list(self._client.list_blobs(self._bucket, prefix=prefix)):
+            rel = blob.name[len(prefix):]
+            if paths is not None and rel not in paths:
+                continue
+            blob.delete()
+            deleted.append(rel)
+        return deleted
+
+    def list_files(self, storage_id: str) -> List[str]:
+        prefix = self._key(storage_id) + "/"
+        return sorted(
+            blob.name[len(prefix):]
+            for blob in self._client.list_blobs(self._bucket, prefix=prefix)
+        )

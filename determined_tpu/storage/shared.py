@@ -1,0 +1,72 @@
+"""Shared-filesystem storage (ref: harness/determined/common/storage/shared.py:120).
+
+On TPU pods this backs NFS/Filestore mounts; it is also the default local
+backend for off-cluster runs and tests.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+from typing import Callable, Iterator, List, Optional
+
+from determined_tpu.storage.base import StorageManager
+
+
+class SharedFSStorageManager(StorageManager):
+    def _dir(self, storage_id: str) -> str:
+        return os.path.join(self.base_path, storage_id)
+
+    def upload(self, src: str, storage_id: str, paths: Optional[List[str]] = None) -> None:
+        dst = self._dir(storage_id)
+        os.makedirs(dst, exist_ok=True)
+        rels = paths if paths is not None else self._list_dir(src)
+        for rel in rels:
+            target = os.path.join(dst, rel)
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            shutil.copy2(os.path.join(src, rel), target)
+
+    def download(
+        self,
+        storage_id: str,
+        dst: str,
+        selector: Optional[Callable[[str], bool]] = None,
+    ) -> None:
+        src = self._dir(storage_id)
+        if not os.path.isdir(src):
+            raise FileNotFoundError(f"checkpoint {storage_id} not found under {self.base_path}")
+        for rel in self._list_dir(src):
+            if selector is not None and not selector(rel):
+                continue
+            target = os.path.join(dst, rel)
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            shutil.copy2(os.path.join(src, rel), target)
+
+    def delete(self, storage_id: str, paths: Optional[List[str]] = None) -> List[str]:
+        root = self._dir(storage_id)
+        if not os.path.isdir(root):
+            return []
+        if paths is None:
+            deleted = self._list_dir(root)
+            shutil.rmtree(root)
+            return deleted
+        for rel in paths:
+            with contextlib.suppress(FileNotFoundError):
+                os.remove(os.path.join(root, rel))
+        return list(paths)
+
+    def list_files(self, storage_id: str) -> List[str]:
+        root = self._dir(storage_id)
+        if not os.path.isdir(root):
+            return []
+        return self._list_dir(root)
+
+    @contextlib.contextmanager
+    def restore_path(
+        self, storage_id: str, selector: Optional[Callable[[str], bool]] = None
+    ) -> Iterator[str]:
+        # Shared fs: serve in place, no copy (ref: shared.py restore_path).
+        root = self._dir(storage_id)
+        if not os.path.isdir(root):
+            raise FileNotFoundError(f"checkpoint {storage_id} not found under {self.base_path}")
+        yield root

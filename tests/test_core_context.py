@@ -1,0 +1,110 @@
+"""Core API tests: dummy mode, sharded checkpoint collective, metadata merge.
+
+Ref strategy: SURVEY.md §4 — dummy contexts are the official off-cluster
+mode; sharded-checkpoint logic is tested with the threaded parallel fixture.
+"""
+import json
+import os
+
+import pytest
+
+from determined_tpu import core
+from determined_tpu.core import merge_metadata
+from determined_tpu.storage import SharedFSStorageManager
+from tests.parallel import run_parallel
+
+
+def test_dummy_init_roundtrip(tmp_path):
+    with core._dummy_init(checkpoint_storage=str(tmp_path / "ckpts")) as ctx:
+        assert ctx.distributed.size == 1
+        assert ctx.preempt.should_preempt() is False
+        ctx.train.report_training_metrics(1, {"loss": 0.5})
+        ops = list(ctx.searcher.operations())
+        assert len(ops) == 1
+
+
+def test_dummy_checkpoint_upload_download(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "weights.bin").write_bytes(b"abc123")
+    (src / "nested").mkdir()
+    (src / "nested" / "opt.bin").write_bytes(b"xyz")
+
+    with core._dummy_init(checkpoint_storage=str(tmp_path / "ckpts")) as ctx:
+        sid = ctx.checkpoint.upload(str(src), metadata={"steps_completed": 7})
+        with ctx.checkpoint.restore_path(sid) as path:
+            assert (
+                open(os.path.join(path, "weights.bin"), "rb").read() == b"abc123"
+            )
+            assert (
+                open(os.path.join(path, "nested", "opt.bin"), "rb").read() == b"xyz"
+            )
+            md = json.load(open(os.path.join(path, "metadata.json")))
+            assert md == {"steps_completed": 7}
+
+
+def test_sharded_checkpoint_collective(tmp_path):
+    """Each rank uploads its own shard; chief merges metadata + resources."""
+    storage_root = str(tmp_path / "ckpts")
+
+    def fn(ctx):
+        storage = SharedFSStorageManager(storage_root)
+        ckpt_ctx = core.DummyCheckpointContext(ctx, storage)
+        shard_dir = tmp_path / f"shard-{ctx.rank}"
+        shard_dir.mkdir(exist_ok=True)
+        fname = f"shard-{ctx.rank}.bin"
+        (shard_dir / fname).write_bytes(f"data-{ctx.rank}".encode())
+        sid = ckpt_ctx.upload(
+            str(shard_dir),
+            metadata={f"rank_{ctx.rank}": ctx.rank, "shared": "same"},
+            shard=True,
+        )
+        return sid
+
+    sids = run_parallel(4, fn)
+    # all ranks agreed on one storage_id
+    assert len(set(sids)) == 1
+    storage = SharedFSStorageManager(storage_root)
+    files = storage.list_files(sids[0])
+    assert sorted(files) == ["metadata.json"] + [f"shard-{r}.bin" for r in range(4)]
+
+
+def test_merge_metadata_conflict():
+    with pytest.raises(ValueError):
+        merge_metadata([{"k": 1}, {"k": 2}])
+    assert merge_metadata([{"a": 1}, None, {"b": 2, "a": 1}]) == {"a": 1, "b": 2}
+
+
+def test_cluster_info_env_roundtrip(monkeypatch):
+    from determined_tpu import _info
+
+    info = _info.ClusterInfo(
+        master_url="http://localhost:8080",
+        cluster_id="c1",
+        agent_id="a1",
+        session_token="tok",
+        task_id="t1",
+        allocation_id="al1",
+        task_type="TRIAL",
+        rendezvous=_info.RendezvousInfo(
+            container_addrs=["10.0.0.1", "10.0.0.2"],
+            container_rank=1,
+            coordinator_address="10.0.0.1:8476",
+            num_processes=2,
+        ),
+        trial=_info.TrialInfo(
+            trial_id=3,
+            experiment_id=2,
+            trial_seed=777,
+            hparams={"lr": 0.1},
+            config={"name": "exp"},
+            latest_checkpoint="abc",
+        ),
+        checkpoint_storage={"type": "shared_fs", "host_path": "/tmp/x"},
+    )
+    for k, v in info.to_env().items():
+        monkeypatch.setenv(k, v)
+    _info.reset_cluster_info_cache()
+    got = _info.ClusterInfo.from_env()
+    assert got == info
+    _info.reset_cluster_info_cache()

@@ -1,0 +1,43 @@
+"""Control-plane IPC tests (ref strategy: harness/tests/test_ipc.py)."""
+import pytest
+
+from tests.parallel import run_parallel
+
+
+@pytest.mark.parametrize("size", [1, 2, 4])
+def test_allgather(size):
+    out = run_parallel(size, lambda ctx: ctx.allgather(ctx.rank * 10))
+    for res in out:
+        assert res == [r * 10 for r in range(size)]
+
+
+def test_gather_ordering():
+    def fn(ctx):
+        return ctx.gather(f"rank-{ctx.rank}")
+
+    out = run_parallel(4, fn)
+    assert out[0] == [f"rank-{r}" for r in range(4)]
+    for r in range(1, 4):
+        assert out[r] is None
+
+
+def test_broadcast():
+    def fn(ctx):
+        return ctx.broadcast({"payload": 42} if ctx.is_chief else None)
+
+    out = run_parallel(3, fn)
+    assert all(res == {"payload": 42} for res in out)
+
+
+def test_barrier_and_repeated_collectives():
+    def fn(ctx):
+        acc = []
+        for i in range(5):
+            acc.append(ctx.allgather((ctx.rank, i)))
+            ctx.barrier()
+        return acc
+
+    out = run_parallel(3, fn)
+    for res in out:
+        for i, round_result in enumerate(res):
+            assert round_result == [(r, i) for r in range(3)]
