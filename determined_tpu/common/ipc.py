@@ -107,14 +107,19 @@ class WorkerClient:
         self._rank = rank
         self._ctx = zmq.Context.instance()
         self._sock = self._ctx.socket(zmq.DEALER)
-        self._sock.setsockopt(zmq.RCVTIMEO, int(timeout_s * 1000))
         self._sock.connect(f"tcp://{chief_addr}")
         self._sock.send(_HELLO)
 
     def send(self, obj: Any) -> None:
         self._sock.send(pickle.dumps((self._rank, obj)))
 
-    def recv(self) -> Any:
+    def recv(self, timeout_s: Optional[float] = None) -> Any:
+        # No default timeout: the chief may legitimately spend many minutes
+        # between collectives (e.g. uploading a multi-GB shard before the
+        # checkpoint barrier); a ticking RCVTIMEO here would kill the job.
+        self._sock.setsockopt(
+            zmq.RCVTIMEO, -1 if timeout_s is None else int(timeout_s * 1000)
+        )
         return pickle.loads(self._sock.recv())
 
     def close(self) -> None:

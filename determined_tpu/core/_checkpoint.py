@@ -86,6 +86,14 @@ class CheckpointContext:
                 str(uuid.uuid4()) if self._dist.is_chief else None
             )
         else:
+            if not self._dist.is_chief:
+                # Matches the reference (core/_checkpoint.py:237): an
+                # unsharded upload from a worker would create a duplicate,
+                # unreported checkpoint under a divergent uuid.
+                raise RuntimeError(
+                    "upload(shard=False) is chief-only; use shard=True for "
+                    "collective sharded uploads"
+                )
             storage_id = str(uuid.uuid4())
 
         my_files = paths if paths is not None else StorageManager._list_dir(ckpt_dir)
@@ -102,15 +110,16 @@ class CheckpointContext:
             assert gathered_files is not None and gathered_md is not None
             merged_md = merge_metadata(gathered_md)
             resources = sorted({f for fs in gathered_files for f in fs})
-            # write merged metadata.json alongside the shards
-            with contextlib.suppress(Exception):
-                import tempfile
+            # Write merged metadata.json alongside the shards. A failure here
+            # must propagate: reporting COMPLETED without it would lose
+            # resume-critical state silently.
+            import tempfile
 
-                with tempfile.TemporaryDirectory() as tmp:
-                    md_path = os.path.join(tmp, METADATA_FILE)
-                    with open(md_path, "w") as f:
-                        json.dump(merged_md, f)
-                    self._storage.upload(tmp, storage_id, paths=[METADATA_FILE])
+            with tempfile.TemporaryDirectory() as tmp:
+                md_path = os.path.join(tmp, METADATA_FILE)
+                with open(md_path, "w") as f:
+                    json.dump(merged_md, f)
+                self._storage.upload(tmp, storage_id, paths=[METADATA_FILE])
             self._report(storage_id, resources + [METADATA_FILE], merged_md)
         if shard and self._dist.size > 1:
             self._dist.barrier()

@@ -1,0 +1,4 @@
+"""Pallas TPU kernels + blockwise reference paths for the hot ops."""
+from determined_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

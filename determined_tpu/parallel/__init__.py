@@ -1,0 +1,39 @@
+"""Parallelism layer: meshes, shardings, sequence/pipeline parallelism.
+
+This replaces the reference's entire delegation to Horovod / torch.distributed
+/ DeepSpeed (SURVEY.md §2.5): here the data plane is GSPMD — shardings over a
+`jax.sharding.Mesh` with XLA-inserted collectives over ICI/DCN. Sequence
+(context) parallelism via ring attention and Ulysses is net-new capability
+with no reference analog (SURVEY.md §5 'Long-context').
+"""
+from determined_tpu.parallel.mesh import (
+    AXIS_NAMES,
+    MeshConfig,
+    make_mesh,
+    batch_axes,
+)
+from determined_tpu.parallel.sharding import (
+    ShardingRules,
+    DEFAULT_RULES,
+    logical_to_spec,
+    logical_to_sharding,
+    shard_pytree_like,
+)
+from determined_tpu.parallel.ring import ring_attention
+from determined_tpu.parallel.ulysses import ulysses_attention
+from determined_tpu.parallel.pipeline import pipeline_apply
+
+__all__ = [
+    "AXIS_NAMES",
+    "MeshConfig",
+    "make_mesh",
+    "batch_axes",
+    "ShardingRules",
+    "DEFAULT_RULES",
+    "logical_to_spec",
+    "logical_to_sharding",
+    "shard_pytree_like",
+    "ring_attention",
+    "ulysses_attention",
+    "pipeline_apply",
+]

@@ -1,0 +1,81 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the `pipeline` axis.
+
+TPU-native replacement for the reference's DeepSpeed PipelineModule path
+(SURVEY.md §2.5: `use_pipeline_parallel`, pytorch/deepspeed/_deepspeed_context.py:241):
+stage parameters live stacked along a leading `stage` axis sharded over the
+mesh's `pipeline` axis; activations advance between neighbor devices with
+`ppermute` inside a `lax.scan` over schedule ticks — fully compiled, no
+host-side scheduling.
+
+Schedule: plain GPipe fill-drain. M microbatches over S stages take
+M + S - 1 ticks; bubble fraction (S-1)/(M+S-1). Each device computes its
+stage every tick (idle ticks compute-then-discard — branchless, which XLA
+prefers over data-dependent control flow).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    *,
+    axis_name: str = "pipeline",
+) -> jax.Array:
+    """Run microbatches through the pipeline; call inside shard_map.
+
+    Args:
+      stage_fn: params, activation [mb, ...] -> activation [mb, ...]. All
+        stages must share one activation shape (standard transformer-block
+        pipelining).
+      stage_params: this device's stage parameters (leading `stage` axis of
+        size 1 already squeezed by shard_map, or a plain per-stage pytree).
+      microbatches: [M, mb, ...] — replicated across the pipeline axis; only
+        stage 0 actually consumes it.
+
+    Returns [M, mb, ...]: final-stage outputs, replicated across the axis.
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage_idx = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    ticks = n_micro + n_stages - 1
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        incoming, outputs = carry
+        # Stage 0 picks up microbatch t (clamped); others use the activation
+        # handed over by their neighbor last tick.
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        x = jnp.where(
+            stage_idx == 0,
+            lax.dynamic_index_in_dim(microbatches, mb_idx, keepdims=False),
+            incoming,
+        )
+        y = stage_fn(stage_params, x)
+        # Last stage finished microbatch t - (n_stages - 1) this tick.
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        valid = (t >= n_stages - 1) & (stage_idx == n_stages - 1)
+        prev = lax.dynamic_index_in_dim(outputs, out_idx, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(valid, y, prev), out_idx, 0
+        )
+        # Hand activations to the next stage (ring; stage S-1 → 0 carries
+        # garbage that stage 0 overwrites).
+        incoming = lax.ppermute(y, axis_name, fwd_perm)
+        return (incoming, outputs), None
+
+    zero_act = jnp.zeros_like(microbatches[0])
+    outputs0 = jnp.zeros_like(microbatches)
+    (_, outputs), _ = lax.scan(
+        tick, (zero_act, outputs0), jnp.arange(ticks)
+    )
+    # Replicate final-stage outputs to every pipeline rank: everyone else
+    # contributed zeros, so a psum is a broadcast.
+    outputs = jnp.where(stage_idx == n_stages - 1, outputs, jnp.zeros_like(outputs))
+    return lax.psum(outputs, axis_name)

@@ -1,0 +1,102 @@
+"""Logical-axis sharding rules: how tensors map onto the mesh.
+
+The GSPMD replacement for everything the reference delegated to DeepSpeed
+topology (ZeRO stages, "slice" TP ranks — pytorch/deepspeed/_mpu.py): models
+annotate arrays with *logical* axis names ("batch", "embed", "mlp", "heads",
+"sequence", ...) and a rule table maps logical names → mesh axes. Changing
+the parallelism strategy = changing the rule table, not the model.
+
+Same design as flax's logical partitioning; implemented standalone so the
+trainer can shard raw pytrees (optimizer state, batches) with the same rules.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """Ordered (logical_name → mesh axes) rules; first match wins."""
+
+    rules: Tuple[Tuple[str, MeshAxes], ...]
+
+    def lookup(self, logical: Optional[str]) -> MeshAxes:
+        if logical is None:
+            return None
+        for name, axes in self.rules:
+            if name == logical:
+                return axes
+        return None
+
+    def replace(self, **updates: MeshAxes) -> "ShardingRules":
+        new = [(k, updates.pop(k)) if k in updates else (k, v) for k, v in self.rules]
+        new += [(k, v) for k, v in updates.items()]
+        return ShardingRules(tuple(new))
+
+
+# Canonical rules for transformer training (MaxText-style):
+# - batch is split over data×fsdp;
+# - params are sharded over fsdp on their "long" axis (ZeRO-3) and over
+#   tensor on their TP axis (Megatron column/row split);
+# - sequence activations split over context for ring attention;
+# - experts over the expert axis.
+DEFAULT_RULES = ShardingRules(
+    rules=(
+        ("batch", ("data", "fsdp")),
+        ("sequence", "context"),
+        ("embed", "fsdp"),
+        ("mlp", "tensor"),
+        ("heads", "tensor"),
+        ("kv", None),
+        ("head_dim", None),
+        ("vocab", "tensor"),
+        ("expert", "expert"),
+        ("stage", "pipeline"),
+        ("norm", None),
+    )
+)
+
+
+def logical_to_spec(
+    logical_axes: Sequence[Optional[str]], rules: ShardingRules = DEFAULT_RULES
+) -> P:
+    return P(*(rules.lookup(ax) for ax in logical_axes))
+
+
+def logical_to_sharding(
+    logical_axes: Sequence[Optional[str]],
+    mesh: Mesh,
+    rules: ShardingRules = DEFAULT_RULES,
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(logical_axes, rules))
+
+
+def spec_for_pytree(
+    logical_tree: Any, rules: ShardingRules = DEFAULT_RULES
+) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda axes: logical_to_spec(axes, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(a is None or isinstance(a, str) for a in x),
+    )
+
+
+def shard_pytree_like(
+    tree: Any,
+    logical_tree: Any,
+    mesh: Mesh,
+    rules: ShardingRules = DEFAULT_RULES,
+) -> Any:
+    """Device-put a pytree according to its logical axis annotations."""
+    specs = spec_for_pytree(logical_tree, rules)
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), tree, specs
+    )

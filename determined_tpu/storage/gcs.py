@@ -44,16 +44,20 @@ class GCSStorageManager(StorageManager):
         selector: Optional[Callable[[str], bool]] = None,
     ) -> None:
         prefix = self._key(storage_id) + "/"
-        found = False
+        exists = False
         for blob in self._client.list_blobs(self._bucket, prefix=prefix):
             rel = blob.name[len(prefix):]
-            if not rel or (selector is not None and not selector(rel)):
+            if not rel:
                 continue
-            found = True
+            exists = True
+            if selector is not None and not selector(rel):
+                continue
             target = os.path.join(dst, rel)
             os.makedirs(os.path.dirname(target), exist_ok=True)
             blob.download_to_filename(target)
-        if not found:
+        # Missing checkpoint is an error; a selector matching nothing in an
+        # existing checkpoint is not (mirrors SharedFSStorageManager).
+        if not exists:
             raise FileNotFoundError(f"checkpoint {storage_id} not found at gs://{prefix}")
 
     def delete(self, storage_id: str, paths: Optional[List[str]] = None) -> List[str]:

@@ -7,8 +7,11 @@ CPU devices so Mesh/pjit/shard_map paths compile and run everywhere.
 """
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The ambient environment registers the real TPU (axon) backend from
+# sitecustomize, which imports jax at interpreter start — so env vars set
+# here are too late; override via jax.config instead. XLA_FLAGS is still
+# read lazily at first backend init, so setting it here works.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,6 +19,8 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
