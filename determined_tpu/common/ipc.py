@@ -103,7 +103,7 @@ class ChiefServer:
 class WorkerClient:
     """Runs on ranks > 0; connects to the chief."""
 
-    def __init__(self, chief_addr: str, rank: int, timeout_s: float = 120.0) -> None:
+    def __init__(self, chief_addr: str, rank: int) -> None:
         self._rank = rank
         self._ctx = zmq.Context.instance()
         self._sock = self._ctx.socket(zmq.DEALER)

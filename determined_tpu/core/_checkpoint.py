@@ -106,23 +106,32 @@ class CheckpointContext:
         else:
             gathered_files, gathered_md = [my_files], [metadata]
 
+        chief_err: Optional[BaseException] = None
         if self._dist.is_chief:
-            assert gathered_files is not None and gathered_md is not None
-            merged_md = merge_metadata(gathered_md)
-            resources = sorted({f for fs in gathered_files for f in fs})
-            # Write merged metadata.json alongside the shards. A failure here
-            # must propagate: reporting COMPLETED without it would lose
-            # resume-critical state silently.
-            import tempfile
+            # Any chief-side failure must still reach the barrier below —
+            # workers block in an unbounded recv, so raising before the
+            # barrier would hang the whole allocation.
+            try:
+                assert gathered_files is not None and gathered_md is not None
+                merged_md = merge_metadata(gathered_md)
+                resources = sorted({f for fs in gathered_files for f in fs})
+                # Write merged metadata.json alongside the shards. A failure
+                # here must propagate: reporting COMPLETED without it would
+                # lose resume-critical state silently.
+                import tempfile
 
-            with tempfile.TemporaryDirectory() as tmp:
-                md_path = os.path.join(tmp, METADATA_FILE)
-                with open(md_path, "w") as f:
-                    json.dump(merged_md, f)
-                self._storage.upload(tmp, storage_id, paths=[METADATA_FILE])
-            self._report(storage_id, resources + [METADATA_FILE], merged_md)
+                with tempfile.TemporaryDirectory() as tmp:
+                    md_path = os.path.join(tmp, METADATA_FILE)
+                    with open(md_path, "w") as f:
+                        json.dump(merged_md, f)
+                    self._storage.upload(tmp, storage_id, paths=[METADATA_FILE])
+                self._report(storage_id, resources + [METADATA_FILE], merged_md)
+            except BaseException as e:  # noqa: BLE001 - re-raised after barrier
+                chief_err = e
         if shard and self._dist.size > 1:
             self._dist.barrier()
+        if chief_err is not None:
+            raise chief_err
         return storage_id
 
     def _report(self, storage_id: str, resources: List[str], metadata: Dict[str, Any]) -> None:

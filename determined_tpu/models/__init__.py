@@ -1,0 +1,48 @@
+"""Model zoo: flagship GPT plus the example-ladder models.
+
+Registry mirrors the role of the reference's `examples/` + `model_hub/`
+catalog: named recipes the platform's configs can reference by string
+(experiment config `model.name`).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from determined_tpu.models import gpt as gpt_mod
+from determined_tpu.models.attention import attention
+from determined_tpu.models.base import Model
+from determined_tpu.models.gpt import GPT, GPTConfig
+from determined_tpu.models.vision import CifarCNN, CNNConfig, MLPConfig, MnistMLP
+
+_REGISTRY: Dict[str, Callable[..., Model]] = {
+    "gpt2-small": lambda mesh=None, **kw: GPT(
+        gpt_mod.small() if not kw else GPTConfig(**kw), mesh=mesh
+    ),
+    "gpt2-medium": lambda mesh=None, **kw: GPT(
+        gpt_mod.medium() if not kw else GPTConfig(**kw), mesh=mesh
+    ),
+    "gpt-tiny": lambda mesh=None, **kw: GPT(gpt_mod.tiny(**kw), mesh=mesh),
+    "mnist-mlp": lambda mesh=None, **kw: MnistMLP(
+        MLPConfig(**kw) if kw else MLPConfig(), mesh=mesh
+    ),
+    "cifar-cnn": lambda mesh=None, **kw: CifarCNN(
+        CNNConfig(**kw) if kw else CNNConfig(), mesh=mesh
+    ),
+}
+
+
+def get_model(name: str, mesh: Optional[Any] = None, **hparams: Any) -> Model:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](mesh=mesh, **hparams)
+
+
+__all__ = [
+    "Model",
+    "GPT",
+    "GPTConfig",
+    "MnistMLP",
+    "CifarCNN",
+    "attention",
+    "get_model",
+]

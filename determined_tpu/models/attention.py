@@ -1,0 +1,82 @@
+"""Attention dispatch: pick the right kernel for the current mesh layout.
+
+The reference had no attention code of its own (it lived in torch/DeepSpeed
+kernels); here the model calls one entry point and the layout decides:
+
+- ``context`` axis sharded (> 1): ring attention — K/V rotate over ICI via
+  ppermute while each device attends for its local sequence chunk
+  (determined_tpu.parallel.ring).
+- otherwise on TPU: the Pallas flash kernel (determined_tpu.ops), wrapped in
+  shard_map because pallas_call is opaque to the GSPMD partitioner — batch
+  splits over data/fsdp, heads over tensor.
+- otherwise (CPU tests, tiny shapes): plain einsum softmax attention, which
+  XLA partitions on its own.
+
+All paths take/return [B, S, H, D] and are numerically exact (no windowing).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from determined_tpu.ops.flash_attention import flash_attention
+from determined_tpu.parallel.ring import reference_attention, ring_attention
+
+BATCH_AXES = ("data", "fsdp")
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Optional[Mesh] = None,
+    causal: bool = True,
+    impl: str = "auto",
+) -> jax.Array:
+    """Multi-head attention over [B, S, H, D] tensors.
+
+    impl: "auto" | "dense" | "flash" | "ring". "auto" selects ring when the
+    mesh's context axis is sharded, flash on TPU, dense elsewhere.
+    """
+    if impl == "auto":
+        if mesh is not None and mesh.shape.get("context", 1) > 1:
+            impl = "ring"
+        elif jax.default_backend() == "tpu" and q.shape[1] % 128 == 0:
+            impl = "flash"
+        else:
+            impl = "dense"
+
+    if impl == "dense":
+        return reference_attention(q, k, v, causal=causal)
+
+    if impl == "flash":
+        if mesh is None:
+            return flash_attention(q, k, v, causal=causal)
+        spec = P(BATCH_AXES, None, "tensor", None)
+
+        def local(q_, k_, v_):
+            return flash_attention(q_, k_, v_, causal=causal)
+
+        return shard_map(
+            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+
+    if impl == "ring":
+        if mesh is None:
+            raise ValueError("ring attention needs a mesh")
+        spec = P(BATCH_AXES, "context", "tensor", None)
+
+        def local(q_, k_, v_):
+            return ring_attention(q_, k_, v_, axis_name="context", causal=causal)
+
+        return shard_map(
+            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+
+    raise ValueError(f"unknown attention impl {impl!r}")

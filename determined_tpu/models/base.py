@@ -1,0 +1,46 @@
+"""Model interface for the trainer layer.
+
+The reference's trial APIs make the user subclass a framework-specific Trial
+(PyTorchTrial `harness/determined/pytorch/_pytorch_trial.py:1385`) whose
+methods hand the controller a model, optimizer, and per-batch train/eval
+functions. The TPU-native equivalent is purely functional: a `Model` bundles
+
+- ``init(rng) -> params``                    (pure pytree construction)
+- ``logical_axes() -> pytree``               (same structure as params; each
+  leaf a tuple of logical axis names consumed by
+  determined_tpu.parallel.sharding rules — this replaces DeepSpeed topology
+  config as the way parallelism attaches to a model)
+- ``loss(params, batch, rng) -> (loss, metrics)``  (differentiable)
+- ``eval_metrics(params, batch) -> metrics``       (jit-able, no rng)
+
+Models never talk to devices, meshes, or optimizers; the Trainer owns those.
+"""
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, Tuple
+
+import jax
+
+Params = Any
+Batch = Any
+Metrics = Dict[str, jax.Array]
+
+
+class Model(abc.ABC):
+    @abc.abstractmethod
+    def init(self, rng: jax.Array) -> Params:
+        """Build the initial parameter pytree."""
+
+    @abc.abstractmethod
+    def logical_axes(self) -> Any:
+        """Pytree matching init()'s structure: tuples of logical axis names."""
+
+    @abc.abstractmethod
+    def loss(self, params: Params, batch: Batch, rng: jax.Array) -> Tuple[jax.Array, Metrics]:
+        """Scalar training loss + auxiliary metrics for one batch."""
+
+    def eval_metrics(self, params: Params, batch: Batch) -> Metrics:
+        """Validation metrics for one batch; default reuses loss()."""
+        loss, metrics = self.loss(params, batch, jax.random.PRNGKey(0))
+        return dict(metrics, loss=loss)

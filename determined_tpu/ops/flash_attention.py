@@ -150,7 +150,12 @@ def _blockwise_fwd_ref(q, k, v, *, scale, causal, block_k):
     def step(carry, blk):
         m, l, acc = carry
         k_j, v_j, j = blk
-        s = jnp.einsum("bqd,bkd->bqk", q, k_j).astype(jnp.float32) * scale
+        # fp32 accumulation in the score matmul (matches the Pallas forward,
+        # which casts to fp32 before the MXU dot): bf16-rounded scores here
+        # would bias the backward's recomputed softmax.
+        s = jnp.einsum(
+            "bqd,bkd->bqk", q, k_j, preferred_element_type=jnp.float32
+        ) * scale
         if causal:
             cols = j * block_k + jnp.arange(block_k)
             mask = rows[:, None] >= cols[None, :]
@@ -187,7 +192,9 @@ def _blockwise_bwd_ref(q, k, v, o, lse, do, *, scale, causal, block_k):
 
     def step(dq_acc, blk):
         k_j, v_j, j = blk
-        s = jnp.einsum("bqd,bkd->bqk", q, k_j).astype(jnp.float32) * scale
+        s = jnp.einsum(
+            "bqd,bkd->bqk", q, k_j, preferred_element_type=jnp.float32
+        ) * scale
         if causal:
             cols = j * block_k + jnp.arange(block_k)
             mask = rows[:, None] >= cols[None, :]
@@ -261,6 +268,13 @@ def flash_attention(
     """
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
+    if causal and s_q != s_k:
+        # The causal mask top-left aligns sequences (row i sees keys <= i at
+        # absolute offset 0), which silently drops the K/V tail in decode /
+        # kv-cache layouts; those need an explicit offset, not this kernel.
+        raise ValueError(
+            f"causal flash attention requires s_q == s_k, got ({s_q}, {s_k})"
+        )
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_k)

@@ -33,7 +33,10 @@ def _block_attn_update(q, k, v, m, l, acc, *, scale, mask):
     q: [B, Sq, H, D], k/v: [B, Sk, H, D], m/l: [B, H, Sq], acc like q.
     mask: [Sq, Sk] boolean (True = attend) or None.
     """
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B, H, Sq, Sk]
+    # fp32 accumulation: bf16 inputs must not round the scores pre-softmax.
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale  # [B, H, Sq, Sk]
     if mask is not None:
         scores = jnp.where(mask[None, None], scores, -jnp.inf)
     block_max = jnp.max(scores, axis=-1)  # [B, H, Sq]
@@ -46,7 +49,7 @@ def _block_attn_update(q, k, v, m, l, acc, *, scale, mask):
     corr = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - safe_m))  # [B, H, Sq]
     new_l = l * corr + jnp.sum(p, axis=-1)
     new_acc = acc * corr[..., None].swapaxes(1, 2) + jnp.einsum(
-        "bhqk,bkhd->bqhd", p, v
+        "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32
     )
     return new_m, new_l, new_acc
 

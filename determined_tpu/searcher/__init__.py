@@ -1,0 +1,83 @@
+"""Hyperparameter search: op-stream search methods.
+
+Rebuild of `master/pkg/searcher` (see base.py). `make_method` maps an
+experiment config's `searcher:` section to a method instance, mirroring
+expconf searcher_config.go.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from determined_tpu.searcher.adaptive_asha import AdaptiveASHASearch
+from determined_tpu.searcher.asha import ASHASearch
+from determined_tpu.searcher.base import Searcher, SearchMethod, SearchRuntime
+from determined_tpu.searcher.methods import GridSearch, RandomSearch, SingleSearch
+from determined_tpu.searcher.ops import (
+    Close,
+    Create,
+    Operation,
+    Shutdown,
+    ValidateAfter,
+    from_json,
+    to_json,
+)
+from determined_tpu.searcher.simulate import simulate
+
+
+def make_method(config: Dict[str, Any]) -> SearchMethod:
+    """Build a SearchMethod from a `searcher:` config section."""
+    name = config.get("name", "single")
+    max_length = int(config.get("max_length", 1))
+    if name == "single":
+        return SingleSearch(max_length)
+    if name == "random":
+        return RandomSearch(max_length, int(config["max_trials"]))
+    if name == "grid":
+        return GridSearch(max_length)
+    if name == "asha":
+        return ASHASearch(
+            max_length,
+            int(config["max_trials"]),
+            num_rungs=int(config.get("num_rungs", 4)),
+            divisor=float(config.get("divisor", 4)),
+        )
+    if name == "adaptive_asha":
+        return AdaptiveASHASearch(
+            max_length,
+            int(config["max_trials"]),
+            mode=config.get("mode", "standard"),
+            max_rungs=int(config.get("max_rungs", 4)),
+            divisor=float(config.get("divisor", 4)),
+        )
+    raise ValueError(f"unknown searcher {name!r}")
+
+
+def make_searcher(config: Dict[str, Any], hparam_space: Dict[str, Any], seed: int = 0) -> Searcher:
+    return Searcher(
+        make_method(config),
+        hparam_space,
+        seed=seed,
+        smaller_is_better=bool(config.get("smaller_is_better", True)),
+    )
+
+
+__all__ = [
+    "Searcher",
+    "SearchMethod",
+    "SearchRuntime",
+    "SingleSearch",
+    "RandomSearch",
+    "GridSearch",
+    "ASHASearch",
+    "AdaptiveASHASearch",
+    "Create",
+    "ValidateAfter",
+    "Close",
+    "Shutdown",
+    "Operation",
+    "simulate",
+    "make_method",
+    "make_searcher",
+    "to_json",
+    "from_json",
+]

@@ -1,0 +1,119 @@
+"""Adaptive ASHA: a tournament of ASHA brackets with different aggressiveness.
+
+Rebuild of `master/pkg/searcher/adaptive_asha.go:71` + `tournament.go`:
+multiple ASHA sub-searches run concurrently, each with a different number of
+rungs (more rungs = more aggressive early stopping); trials are partitioned
+among brackets; the composite shuts down when every bracket does. Modes
+(ref: adaptive_asha.go mode semantics):
+
+- aggressive:   1 bracket  (full halving depth)
+- standard:     up to 3 brackets (depths R, R-1, R-2)
+- conservative: brackets at every depth R..1
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from determined_tpu.searcher.asha import ASHASearch
+from determined_tpu.searcher.base import SearchMethod, SearchRuntime
+from determined_tpu.searcher.ops import Create, Operation, Shutdown
+
+
+def bracket_rungs(max_rungs: int, mode: str) -> List[int]:
+    r = max(1, int(max_rungs))
+    if mode == "aggressive":
+        return [r]
+    if mode == "standard":
+        return [max(1, r - i) for i in range(min(3, r))]
+    if mode == "conservative":
+        return list(range(r, 0, -1))
+    raise ValueError(f"unknown adaptive mode {mode!r}")
+
+
+class AdaptiveASHASearch(SearchMethod):
+    def __init__(
+        self,
+        max_length: int,
+        max_trials: int,
+        mode: str = "standard",
+        max_rungs: int = 4,
+        divisor: float = 4.0,
+    ) -> None:
+        rungs = bracket_rungs(max_rungs, mode)
+        per = max(1, max_trials // len(rungs))
+        self.brackets: List[ASHASearch] = []
+        remaining = max_trials
+        for i, nr in enumerate(rungs):
+            n = per if i < len(rungs) - 1 else max(1, remaining)
+            remaining -= n
+            self.brackets.append(
+                ASHASearch(max_length, n, num_rungs=nr, divisor=divisor)
+            )
+        self.owner: Dict[str, int] = {}  # request_id -> bracket index
+        self.brackets_done: List[bool] = [False] * len(self.brackets)
+
+    def _route_out(self, bracket_idx: int, ops: List[Operation]) -> List[Operation]:
+        out: List[Operation] = []
+        for op in ops:
+            if isinstance(op, Create):
+                self.owner[str(op.request_id)] = bracket_idx
+                out.append(op)
+            elif isinstance(op, Shutdown):
+                self.brackets_done[bracket_idx] = True
+                if all(self.brackets_done):
+                    out.append(op)
+            else:
+                out.append(op)
+        return out
+
+    def initial_operations(self, rt: SearchRuntime) -> List[Operation]:
+        ops: List[Operation] = []
+        for i, b in enumerate(self.brackets):
+            ops.extend(self._route_out(i, b.initial_operations(rt)))
+        return ops
+
+    def _bracket_of(self, request_id: int) -> int:
+        return self.owner[str(request_id)]
+
+    def on_trial_created(self, rt: SearchRuntime, request_id: int) -> List[Operation]:
+        i = self._bracket_of(request_id)
+        return self._route_out(i, self.brackets[i].on_trial_created(rt, request_id))
+
+    def on_validation_completed(
+        self, rt: SearchRuntime, request_id: int, metric: float, length: int
+    ) -> List[Operation]:
+        i = self._bracket_of(request_id)
+        return self._route_out(
+            i, self.brackets[i].on_validation_completed(rt, request_id, metric, length)
+        )
+
+    def on_trial_closed(self, rt: SearchRuntime, request_id: int) -> List[Operation]:
+        i = self._bracket_of(request_id)
+        return self._route_out(i, self.brackets[i].on_trial_closed(rt, request_id))
+
+    def on_trial_exited_early(
+        self, rt: SearchRuntime, request_id: int, reason: str = "errored"
+    ) -> List[Operation]:
+        i = self._bracket_of(request_id)
+        return self._route_out(
+            i, self.brackets[i].on_trial_exited_early(rt, request_id, reason)
+        )
+
+    def progress(self) -> float:
+        total = sum(b.n_created for b in self.brackets)
+        closed = sum(b.n_closed for b in self.brackets)
+        return closed / total if total else 0.0
+
+    # -- fault tolerance (nested state) --------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "brackets": [b.snapshot() for b in self.brackets],
+            "owner": self.owner,
+            "brackets_done": self.brackets_done,
+        }
+
+    def restore(self, state: Dict[str, Any]) -> None:
+        for b, s in zip(self.brackets, state["brackets"]):
+            b.restore(s)
+        self.owner = state["owner"]
+        self.brackets_done = state["brackets_done"]

@@ -1,0 +1,109 @@
+"""ASHA: asynchronous successive halving.
+
+Rebuild of `master/pkg/searcher/asha.go:30` (asyncHalvingSearch, promote
+logic `:191`), stopping-based variant: trials all start at the lowest rung;
+on completing rung r a trial continues to rung r+1 iff its metric is in the
+top 1/divisor of everything seen at rung r so far (async decision — no
+synchronization barrier between rungs, so early trials may continue on
+less information; that is the A in ASHA).
+
+Methods minimize (the Searcher wrapper flips larger-is-better metrics).
+State is JSON-round-trip-safe: dict keys are stringified request ids.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from determined_tpu.searcher.base import SearchMethod, SearchRuntime
+from determined_tpu.searcher.ops import Close, Operation, Shutdown, ValidateAfter
+
+
+def rung_lengths(max_length: int, num_rungs: int, divisor: float) -> List[int]:
+    """Cumulative train length at each rung, top rung == max_length."""
+    out = []
+    for i in range(num_rungs):
+        length = int(max_length / (divisor ** (num_rungs - 1 - i)))
+        out.append(max(1, length))
+    # Monotonicity can break for tiny max_length; enforce it.
+    for i in range(1, num_rungs):
+        out[i] = max(out[i], out[i - 1] + 1) if out[i] <= out[i - 1] else out[i]
+    return out
+
+
+class ASHASearch(SearchMethod):
+    def __init__(
+        self,
+        max_length: int,
+        max_trials: int,
+        num_rungs: int = 4,
+        divisor: float = 4.0,
+    ) -> None:
+        self.max_length = int(max_length)
+        self.max_trials = int(max_trials)
+        self.num_rungs = int(num_rungs)
+        self.divisor = float(divisor)
+        self.lengths = rung_lengths(max_length, num_rungs, divisor)
+        # rung index -> sorted-insertion list of [metric, request_id]
+        self.rungs: List[List[List[Any]]] = [[] for _ in range(self.num_rungs)]
+        self.trial_rungs: Dict[str, int] = {}
+        self.n_created = 0
+        self.n_closed = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def initial_operations(self, rt: SearchRuntime) -> List[Operation]:
+        ops: List[Operation] = []
+        for _ in range(self.max_trials):
+            op = rt.create()
+            self.trial_rungs[str(op.request_id)] = 0
+            self.n_created += 1
+            ops.append(op)
+        return ops
+
+    def on_trial_created(self, rt: SearchRuntime, request_id: int) -> List[Operation]:
+        return [ValidateAfter(request_id, self.lengths[0])]
+
+    def _in_top_fraction(self, rung_idx: int, metric: float) -> bool:
+        rung = self.rungs[rung_idx]
+        k = int(len(rung) / self.divisor)
+        if k < 1:
+            # Too few finishers to fill even one promotion slot: only the
+            # current best continues (matches asha.go's promotionsAsync
+            # behavior of promoting once len/divisor >= 1; the first
+            # finisher is optimistically continued).
+            return metric <= min(m for m, _ in rung)
+        top_k = sorted(m for m, _ in rung)[:k]
+        return metric <= top_k[-1]
+
+    def on_validation_completed(
+        self, rt: SearchRuntime, request_id: int, metric: float, length: int
+    ) -> List[Operation]:
+        key = str(request_id)
+        r = self.trial_rungs.get(key, 0)
+        self.rungs[r].append([float(metric), request_id])
+        if r >= self.num_rungs - 1:
+            return [Close(request_id)]
+        if self._in_top_fraction(r, float(metric)):
+            self.trial_rungs[key] = r + 1
+            return [ValidateAfter(request_id, self.lengths[r + 1])]
+        return [Close(request_id)]
+
+    def on_trial_closed(self, rt: SearchRuntime, request_id: int) -> List[Operation]:
+        self.n_closed += 1
+        if self.n_closed >= self.n_created:
+            return [Shutdown()]
+        return []
+
+    def on_trial_exited_early(
+        self, rt: SearchRuntime, request_id: int, reason: str = "errored"
+    ) -> List[Operation]:
+        # Record a worst-case metric so the failure doesn't distort promotion
+        # quantiles, then account the close.
+        key = str(request_id)
+        r = self.trial_rungs.get(key, 0)
+        self.rungs[r].append([float("1e30"), request_id])
+        return self.on_trial_closed(rt, request_id)
+
+    def progress(self) -> float:
+        if not self.n_created:
+            return 0.0
+        return self.n_closed / self.n_created

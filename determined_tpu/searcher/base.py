@@ -1,0 +1,157 @@
+"""SearchMethod interface + Searcher driver.
+
+Rebuild of `master/pkg/searcher/search_method.go:17` (SearchMethod iface)
+and `searcher.go:45-192` (Searcher wrapper): HP search is an event-driven
+state machine. The experiment FSM feeds events in (trial created, validation
+completed, trial closed/failed) and routes the returned operations out to
+trials.
+
+Determinism/fault-tolerance design: hyperparameters are sampled with an rng
+keyed by (experiment seed, request_id), so a search method's state is plain
+JSON data — no rng stream to snapshot. `Searcher.snapshot()/restore()` give
+the experiment FSM crash recovery (ref: experiment.go:821 Snapshot).
+"""
+from __future__ import annotations
+
+import abc
+import json
+import random
+from typing import Any, Dict, List, Optional
+
+from determined_tpu.searcher import sample as sample_mod
+from determined_tpu.searcher.ops import (
+    Close,
+    Create,
+    Operation,
+    Shutdown,
+    ValidateAfter,
+)
+
+
+class SearchRuntime:
+    """Allocates request ids and samples hyperparameters for Create ops."""
+
+    def __init__(self, hparam_space: Dict[str, Any], seed: int = 0) -> None:
+        self.space = hparam_space
+        self.seed = seed
+        self._next_id = 1
+
+    def create(self, hparams: Optional[Dict[str, Any]] = None) -> Create:
+        rid = self._next_id
+        self._next_id += 1
+        if hparams is None:
+            rng = random.Random((self.seed << 32) + rid)
+            hparams = sample_mod.sample(self.space, rng)
+        return Create(request_id=rid, hparams=hparams, seed=(self.seed << 32) + rid)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"next_id": self._next_id, "seed": self.seed}
+
+    def restore(self, state: Dict[str, Any]) -> None:
+        self._next_id = state["next_id"]
+        self.seed = state["seed"]
+
+
+class SearchMethod(abc.ABC):
+    """Event handlers return operation lists. All state must be JSON-able."""
+
+    def initial_operations(self, rt: SearchRuntime) -> List[Operation]:
+        return []
+
+    def on_trial_created(self, rt: SearchRuntime, request_id: int) -> List[Operation]:
+        return []
+
+    @abc.abstractmethod
+    def on_validation_completed(
+        self, rt: SearchRuntime, request_id: int, metric: float, length: int
+    ) -> List[Operation]:
+        ...
+
+    def on_trial_closed(self, rt: SearchRuntime, request_id: int) -> List[Operation]:
+        return []
+
+    def on_trial_exited_early(
+        self, rt: SearchRuntime, request_id: int, reason: str = "errored"
+    ) -> List[Operation]:
+        return []
+
+    def progress(self) -> float:
+        return 0.0
+
+    # -- fault tolerance -----------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Default: every attribute (must be JSON-serializable)."""
+        state = dict(vars(self))
+        json.dumps(state)  # fail fast if a subclass holds non-JSON state
+        return state
+
+    def restore(self, state: Dict[str, Any]) -> None:
+        vars(self).update(state)
+
+
+class Searcher:
+    """Owns a SearchMethod + runtime; the experiment FSM's single entry point.
+
+    Ref: master/pkg/searcher/searcher.go:45 — tracks created trials and
+    turns method events into routed operations.
+    """
+
+    def __init__(
+        self,
+        method: SearchMethod,
+        hparam_space: Dict[str, Any],
+        seed: int = 0,
+        smaller_is_better: bool = True,
+    ) -> None:
+        self.method = method
+        self.rt = SearchRuntime(hparam_space, seed)
+        self.smaller_is_better = smaller_is_better
+        self.shutdown = False
+
+    def _sign(self, metric: float) -> float:
+        # Methods always minimize; flip for larger-is-better metrics.
+        return metric if self.smaller_is_better else -metric
+
+    def _route(self, ops: List[Operation]) -> List[Operation]:
+        for op in ops:
+            if isinstance(op, Shutdown):
+                self.shutdown = True
+        return ops
+
+    def initial_operations(self) -> List[Operation]:
+        return self._route(self.method.initial_operations(self.rt))
+
+    def trial_created(self, request_id: int) -> List[Operation]:
+        return self._route(self.method.on_trial_created(self.rt, request_id))
+
+    def validation_completed(
+        self, request_id: int, metric: float, length: int
+    ) -> List[Operation]:
+        return self._route(
+            self.method.on_validation_completed(
+                self.rt, request_id, self._sign(metric), length
+            )
+        )
+
+    def trial_closed(self, request_id: int) -> List[Operation]:
+        return self._route(self.method.on_trial_closed(self.rt, request_id))
+
+    def trial_exited_early(self, request_id: int, reason: str = "errored") -> List[Operation]:
+        return self._route(
+            self.method.on_trial_exited_early(self.rt, request_id, reason)
+        )
+
+    def progress(self) -> float:
+        return self.method.progress()
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "method": self.method.snapshot(),
+            "runtime": self.rt.snapshot(),
+            "shutdown": self.shutdown,
+        }
+
+    def restore(self, state: Dict[str, Any]) -> None:
+        self.method.restore(state["method"])
+        self.rt.restore(state["runtime"])
+        self.shutdown = state["shutdown"]

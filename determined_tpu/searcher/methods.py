@@ -1,0 +1,78 @@
+"""Basic search methods: single, random, grid.
+
+Ref: master/pkg/searcher/{single.go,random.go,grid.go} — each trial trains
+to max_length; the search shuts down when every trial closes.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from determined_tpu.searcher import sample as sample_mod
+from determined_tpu.searcher.base import SearchMethod, SearchRuntime
+from determined_tpu.searcher.ops import Close, Operation, Shutdown, ValidateAfter
+
+
+class _FixedLengthMethod(SearchMethod):
+    """Shared engine: N trials, each trains max_length then closes."""
+
+    def __init__(self, max_length: int) -> None:
+        self.max_length = int(max_length)
+        self.pending_hparams: Optional[List[Dict[str, Any]]] = None  # grid only
+        self.n_trials = 0
+        self.n_closed = 0
+
+    def _creates(self, rt: SearchRuntime, hparams_list) -> List[Operation]:
+        ops: List[Operation] = []
+        for hp in hparams_list:
+            ops.append(rt.create(hp))
+            self.n_trials += 1
+        return ops
+
+    def on_trial_created(self, rt: SearchRuntime, request_id: int) -> List[Operation]:
+        return [ValidateAfter(request_id, self.max_length)]
+
+    def on_validation_completed(
+        self, rt: SearchRuntime, request_id: int, metric: float, length: int
+    ) -> List[Operation]:
+        if length >= self.max_length:
+            return [Close(request_id)]
+        return []
+
+    def on_trial_closed(self, rt: SearchRuntime, request_id: int) -> List[Operation]:
+        self.n_closed += 1
+        if self.n_closed >= self.n_trials:
+            return [Shutdown()]
+        return []
+
+    def on_trial_exited_early(
+        self, rt: SearchRuntime, request_id: int, reason: str = "errored"
+    ) -> List[Operation]:
+        return self.on_trial_closed(rt, request_id)
+
+    def progress(self) -> float:
+        return self.n_closed / self.n_trials if self.n_trials else 0.0
+
+
+class SingleSearch(_FixedLengthMethod):
+    """One trial with directly-sampled hyperparameters (single.go)."""
+
+    def initial_operations(self, rt: SearchRuntime) -> List[Operation]:
+        return self._creates(rt, [None])
+
+
+class RandomSearch(_FixedLengthMethod):
+    """max_trials independent random samples (random.go)."""
+
+    def __init__(self, max_length: int, max_trials: int) -> None:
+        super().__init__(max_length)
+        self.max_trials = int(max_trials)
+
+    def initial_operations(self, rt: SearchRuntime) -> List[Operation]:
+        return self._creates(rt, [None] * self.max_trials)
+
+
+class GridSearch(_FixedLengthMethod):
+    """Every point of the hyperparameter grid (grid.go)."""
+
+    def initial_operations(self, rt: SearchRuntime) -> List[Operation]:
+        return self._creates(rt, list(sample_mod.grid(rt.space)))

@@ -1,0 +1,64 @@
+"""Searcher operations: the op-stream vocabulary.
+
+Rebuild of the reference's `master/pkg/searcher/operations.go:111,192,241,273`:
+search methods are event-driven state machines that emit operations; the
+experiment state machine routes them to trials. Operations are plain data —
+JSON-serializable so experiment snapshots (fault tolerance) can persist the
+searcher mid-search.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Create:
+    """Create a new trial with these sampled hyperparameters."""
+
+    request_id: int
+    hparams: Dict[str, Any]
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ValidateAfter:
+    """Train the trial to `length` total batches, then validate + report.
+
+    Lengths are cumulative (total units since trial start), matching the
+    reference's searcher semantics (operations.go:192).
+    """
+
+    request_id: int
+    length: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Close:
+    """Gracefully stop the trial (it has finished its work)."""
+
+    request_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Shutdown:
+    """End the experiment."""
+
+    cancel: bool = False
+    failure: Optional[str] = None
+
+
+Operation = Any  # Create | ValidateAfter | Close | Shutdown
+
+
+def to_json(op: Operation) -> Dict[str, Any]:
+    d = dataclasses.asdict(op)
+    d["_type"] = type(op).__name__
+    return d
+
+
+def from_json(d: Dict[str, Any]) -> Operation:
+    d = dict(d)
+    kind = d.pop("_type")
+    return {"Create": Create, "ValidateAfter": ValidateAfter, "Close": Close,
+            "Shutdown": Shutdown}[kind](**d)

@@ -1,0 +1,110 @@
+"""Hyperparameter space definition + sampling.
+
+Rebuild of the reference's expconf hyperparameter schema
+(`schemas/expconf/v0/hyperparameter*.json`) and sampling
+(`master/pkg/searcher` + `master/pkg/nprand`): each hyperparameter is a
+dict with a `type` — const / categorical / int / double / log — plus range
+fields; grid search additionally uses `count` to discretize continuous
+ranges.
+"""
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, Iterator, List
+
+HParamSpace = Dict[str, Any]
+
+
+def _is_spec(v: Any) -> bool:
+    return isinstance(v, dict) and "type" in v
+
+
+def sample_one(spec: Any, rng: random.Random) -> Any:
+    """Sample a single hyperparameter value."""
+    if not _is_spec(spec):
+        return spec  # bare values are implicit consts
+    t = spec["type"]
+    if t == "const":
+        return spec["val"]
+    if t == "categorical":
+        return rng.choice(spec["vals"])
+    if t == "int":
+        return rng.randint(int(spec["minval"]), int(spec["maxval"]))
+    if t == "double":
+        return rng.uniform(float(spec["minval"]), float(spec["maxval"]))
+    if t == "log":
+        base = float(spec.get("base", 10.0))
+        lo, hi = float(spec["minval"]), float(spec["maxval"])  # exponents
+        return base ** rng.uniform(lo, hi)
+    raise ValueError(f"unknown hyperparameter type {t!r}")
+
+
+def sample(space: HParamSpace, rng: random.Random) -> Dict[str, Any]:
+    """Sample a full hyperparameter dict (nested dicts supported)."""
+    out: Dict[str, Any] = {}
+    for k, v in space.items():
+        if isinstance(v, dict) and not _is_spec(v):
+            out[k] = sample(v, rng)
+        else:
+            out[k] = sample_one(v, rng)
+    return out
+
+
+def _grid_axis(spec: Any) -> List[Any]:
+    if not _is_spec(spec):
+        return [spec]
+    t = spec["type"]
+    if t == "const":
+        return [spec["val"]]
+    if t == "categorical":
+        return list(spec["vals"])
+    if t == "int":
+        lo, hi = int(spec["minval"]), int(spec["maxval"])
+        count = spec.get("count")
+        if count is None or count >= hi - lo + 1:
+            return list(range(lo, hi + 1))
+        step = (hi - lo) / (count - 1) if count > 1 else 0
+        return [round(lo + i * step) for i in range(count)]
+    if t == "double":
+        lo, hi = float(spec["minval"]), float(spec["maxval"])
+        count = spec["count"]
+        if count == 1:
+            return [lo]
+        step = (hi - lo) / (count - 1)
+        return [lo + i * step for i in range(count)]
+    if t == "log":
+        base = float(spec.get("base", 10.0))
+        lo, hi = float(spec["minval"]), float(spec["maxval"])
+        count = spec["count"]
+        if count == 1:
+            return [base ** lo]
+        step = (hi - lo) / (count - 1)
+        return [base ** (lo + i * step) for i in range(count)]
+    raise ValueError(f"unknown hyperparameter type {t!r}")
+
+
+def grid(space: HParamSpace) -> Iterator[Dict[str, Any]]:
+    """Cartesian product over every hyperparameter's grid axis.
+
+    Ref: master/pkg/searcher/grid.go (`count` fields discretize ranges).
+    """
+    flat: List[tuple] = []
+
+    def flatten(prefix: tuple, sub: HParamSpace) -> None:
+        for k, v in sub.items():
+            if isinstance(v, dict) and not _is_spec(v):
+                flatten(prefix + (k,), v)
+            else:
+                flat.append((prefix + (k,), _grid_axis(v)))
+
+    flatten((), space)
+    keys = [k for k, _ in flat]
+    for combo in itertools.product(*(axis for _, axis in flat)):
+        out: Dict[str, Any] = {}
+        for path, val in zip(keys, combo):
+            d = out
+            for p in path[:-1]:
+                d = d.setdefault(p, {})
+            d[path[-1]] = val
+        yield out

@@ -1,0 +1,56 @@
+"""JAXTrial: the user-facing trial definition.
+
+The TPU-native counterpart of the reference's `PyTorchTrial`
+(`harness/determined/pytorch/_pytorch_trial.py:1385`): users subclass it,
+the Trainer drives it. Differences are deliberate and JAX-shaped:
+
+- no wrap_model/wrap_optimizer mutation — the trial *builds* a functional
+  Model (pytree params) and an optax GradientTransformation;
+- data loaders yield global numpy batches (dict of arrays with a leading
+  batch axis); the Trainer shards them onto the mesh (`data`/`fsdp` axes),
+  replacing the reference's per-GPU DataLoader + sampler offsetting
+  (pytorch/samplers.py);
+- parallelism comes from the mesh + the model's logical axes, not from the
+  trial code.
+"""
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, Iterable, Iterator, Optional
+
+import optax
+from jax.sharding import Mesh
+
+from determined_tpu.models.base import Model
+
+
+class JAXTrial(abc.ABC):
+    #: hyperparameters injected by the platform (experiment config
+    #: `hyperparameters`, with searcher-sampled values filled in).
+    hparams: Dict[str, Any]
+
+    #: needed only when lengths/periods use Epoch units.
+    batches_per_epoch: int = 0
+
+    def __init__(self, hparams: Optional[Dict[str, Any]] = None) -> None:
+        self.hparams = hparams or {}
+
+    @abc.abstractmethod
+    def build_model(self, mesh: Optional[Mesh]) -> Model:
+        """Construct the Model (ref: PyTorchTrial.build_model)."""
+
+    @abc.abstractmethod
+    def build_optimizer(self) -> optax.GradientTransformation:
+        """Construct the optimizer (ref: PyTorchTrial.build_optimizer)."""
+
+    @abc.abstractmethod
+    def build_training_data(self) -> Iterator[Dict[str, Any]]:
+        """Yield global training batches (numpy dicts, leading batch axis).
+
+        Must be an infinite (or sufficiently long) stream; the searcher
+        decides how far to train (ref: build_training_data_loader).
+        """
+
+    def build_validation_data(self) -> Iterable[Dict[str, Any]]:
+        """Finite iterable of validation batches."""
+        return []

@@ -1,0 +1,173 @@
+"""Searcher tests: whole-search simulations against synthetic metrics.
+
+Mirrors the reference's simulation-based searcher tests
+(master/pkg/searcher/{asha_test.go,adaptive_asha_test.go} via simulate.go).
+"""
+import json
+
+from determined_tpu.searcher import (
+    ASHASearch,
+    AdaptiveASHASearch,
+    GridSearch,
+    RandomSearch,
+    Searcher,
+    SingleSearch,
+    make_searcher,
+    simulate,
+)
+from determined_tpu.searcher.asha import rung_lengths
+from determined_tpu.searcher.sample import grid, sample
+
+SPACE = {
+    "lr": {"type": "log", "minval": -4, "maxval": -1, "count": 4},
+    "width": {"type": "categorical", "vals": [64, 128]},
+    "depth": 2,
+}
+
+
+def good_when_small_lr(hparams, length):
+    # Deterministic synthetic loss: smaller lr + more training = better.
+    return hparams["lr"] * 10 + 1.0 / (1 + length)
+
+
+class TestSampling:
+    def test_sample_types(self):
+        import random
+
+        hp = sample(SPACE, random.Random(0))
+        assert 1e-4 <= hp["lr"] <= 1e-1
+        assert hp["width"] in (64, 128)
+        assert hp["depth"] == 2
+
+    def test_grid_cartesian(self):
+        points = list(grid(SPACE))
+        assert len(points) == 4 * 2  # lr count × width vals (const = 1 axis)
+        assert len({json.dumps(p, sort_keys=True) for p in points}) == 8
+
+    def test_deterministic_per_request_id(self):
+        from determined_tpu.searcher.base import SearchRuntime
+
+        a = SearchRuntime(SPACE, seed=7).create()
+        b = SearchRuntime(SPACE, seed=7).create()
+        assert a.hparams == b.hparams
+
+
+class TestBasicMethods:
+    def test_single(self):
+        s = Searcher(SingleSearch(max_length=100), SPACE, seed=1)
+        res = simulate(s, good_when_small_lr)
+        assert res.shutdown and res.n_trials == 1
+        assert res.lengths() == [100]
+
+    def test_random(self):
+        s = Searcher(RandomSearch(max_length=50, max_trials=8), SPACE, seed=1)
+        res = simulate(s, good_when_small_lr)
+        assert res.shutdown and res.n_trials == 8
+        assert res.lengths() == [50] * 8
+
+    def test_grid(self):
+        s = Searcher(GridSearch(max_length=10), SPACE, seed=1)
+        res = simulate(s, good_when_small_lr)
+        assert res.shutdown and res.n_trials == 8
+
+
+class TestASHA:
+    def test_rung_lengths(self):
+        assert rung_lengths(1000, 3, 4.0) == [62, 250, 1000]
+
+    def test_asha_budget_and_promotion(self):
+        s = Searcher(ASHASearch(max_length=1000, max_trials=16, num_rungs=3), SPACE, seed=3)
+        res = simulate(s, good_when_small_lr)
+        assert res.shutdown and res.n_trials == 16
+        lengths = res.lengths()
+        # Early stopping must spend far less than training everyone fully...
+        assert res.total_units < 16 * 1000 * 0.5
+        # ...but someone must reach the top rung.
+        assert lengths[-1] == 1000
+        # and most trials stop at the first rung.
+        assert sum(1 for x in lengths if x == 62) >= 8
+
+    def test_asha_picks_small_lr(self):
+        s = Searcher(ASHASearch(max_length=1000, max_trials=16, num_rungs=3), SPACE, seed=3)
+        res = simulate(s, good_when_small_lr)
+        finished = [t for t in res.trials.values() if t.length == 1000]
+        assert finished
+        # The fully-trained survivors should be among the smaller lrs sampled.
+        all_lrs = sorted(t.hparams["lr"] for t in res.trials.values())
+        for t in finished:
+            assert t.hparams["lr"] <= all_lrs[len(all_lrs) // 2]
+
+    def test_asha_survives_failures(self):
+        s = Searcher(ASHASearch(max_length=100, max_trials=4, num_rungs=2), SPACE, seed=5)
+        ops = s.initial_operations()
+        created = [op.request_id for op in ops if hasattr(op, "hparams")]
+        for rid in created:
+            s.trial_created(rid)
+        # Two trials die immediately; the rest complete normally.
+        out = []
+        out += s.trial_exited_early(created[0])
+        out += s.trial_exited_early(created[1])
+        out += s.validation_completed(created[2], 0.5, 25)
+        out += s.validation_completed(created[3], 0.9, 25)
+        out += s.validation_completed(created[2], 0.4, 100)
+        out += s.trial_closed(created[2])
+        out += s.trial_closed(created[3])
+        assert s.shutdown
+
+    def test_snapshot_restore_roundtrip(self):
+        s = Searcher(ASHASearch(max_length=100, max_trials=4, num_rungs=2), SPACE, seed=5)
+        ops = s.initial_operations()
+        rid = ops[0].request_id
+        s.trial_created(rid)
+        s.validation_completed(rid, 0.5, 50)
+        snap = json.loads(json.dumps(s.snapshot()))  # force a JSON round trip
+
+        s2 = Searcher(ASHASearch(max_length=100, max_trials=4, num_rungs=2), SPACE, seed=5)
+        s2.restore(snap)
+        assert s2.method.rungs == s.method.rungs
+        assert s2.method.trial_rungs == s.method.trial_rungs
+        assert s2.rt._next_id == s.rt._next_id
+
+
+class TestAdaptiveASHA:
+    def test_brackets_and_shutdown(self):
+        s = Searcher(
+            AdaptiveASHASearch(max_length=1000, max_trials=12, mode="standard", max_rungs=3),
+            SPACE,
+            seed=2,
+        )
+        res = simulate(s, good_when_small_lr)
+        assert res.shutdown
+        assert res.n_trials == 12
+        assert res.total_units < 12 * 1000
+
+    def test_conservative_more_brackets_than_aggressive(self):
+        cons = AdaptiveASHASearch(1000, 12, mode="conservative", max_rungs=3)
+        aggr = AdaptiveASHASearch(1000, 12, mode="aggressive", max_rungs=3)
+        assert len(cons.brackets) == 3 and len(aggr.brackets) == 1
+
+    def test_nested_snapshot(self):
+        s = Searcher(
+            AdaptiveASHASearch(1000, 6, mode="standard", max_rungs=3), SPACE, seed=2
+        )
+        ops = s.initial_operations()
+        rid = ops[0].request_id
+        s.trial_created(rid)
+        s.validation_completed(rid, 1.0, 62)
+        snap = json.loads(json.dumps(s.snapshot()))
+        s2 = Searcher(
+            AdaptiveASHASearch(1000, 6, mode="standard", max_rungs=3), SPACE, seed=2
+        )
+        s2.restore(snap)
+        assert s2.method.owner == s.method.owner
+
+
+class TestFactory:
+    def test_make_searcher_larger_is_better(self):
+        s = make_searcher(
+            {"name": "random", "max_trials": 3, "max_length": 10,
+             "smaller_is_better": False},
+            SPACE,
+        )
+        res = simulate(s, lambda hp, ln: -good_when_small_lr(hp, ln))
+        assert res.shutdown and res.n_trials == 3

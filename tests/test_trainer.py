@@ -1,0 +1,146 @@
+"""Trainer tests: loss decreases, checkpoint/resume restores exactly,
+sharded training runs on the virtual 8-device mesh, metrics are reported.
+
+JAX analog of the reference's harness/tests/experiment/pytorch/
+test_pytorch_trial.py (whole-controller loop run locally)."""
+import itertools
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from determined_tpu import core
+from determined_tpu.models import MnistMLP, get_model
+from determined_tpu.parallel.mesh import MeshConfig, make_mesh
+from determined_tpu.trainer import Batch, JAXTrial, Trainer
+
+
+class _XorTrial(JAXTrial):
+    """Tiny deterministic learnable task: 4-way parity-ish classification."""
+
+    def build_model(self, mesh):
+        from determined_tpu.models.vision import MLPConfig
+
+        return MnistMLP(MLPConfig(in_dim=8, hidden=32, n_classes=4), mesh=mesh)
+
+    def build_optimizer(self):
+        return optax.adam(self.hparams.get("lr", 1e-2))
+
+    def _stream(self, seed):
+        w = np.random.default_rng(42).normal(size=(8, 4)).astype(np.float32)
+        rng = np.random.default_rng(seed)
+        while True:
+            x = rng.normal(size=(16, 8)).astype(np.float32)
+            y = np.argmax(x @ w, axis=-1).astype(np.int32)
+            yield {"image": x, "label": y}
+
+    def build_training_data(self):
+        return self._stream(0)
+
+    def build_validation_data(self):
+        return list(itertools.islice(self._stream(1), 4))
+
+
+def _dummy_core(tmp_path):
+    return core._context._dummy_init(checkpoint_storage=str(tmp_path))
+
+
+class TestTrainerLoop:
+    def test_loss_decreases(self, tmp_path):
+        trainer = Trainer(_XorTrial(), _dummy_core(tmp_path), seed=0)
+        first = trainer._validate()
+        metrics = trainer.fit(max_length=Batch(60), report_period=Batch(20))
+        assert metrics["loss"] < first["loss"] * 0.7
+        assert trainer.steps_completed == 60
+
+    def test_metrics_reported(self, tmp_path):
+        ctx = _dummy_core(tmp_path)
+        trainer = Trainer(_XorTrial(), ctx)
+        trainer.fit(max_length=Batch(10), report_period=Batch(5))
+        groups = [g for g, _, _ in ctx.train._reported]
+        assert "training" in groups and "validation" in groups
+        train_reports = [m for g, _, m in ctx.train._reported if g == "training"]
+        assert all("loss" in m and "grad_norm" in m for m in train_reports)
+
+    def test_checkpoint_resume_exact(self, tmp_path):
+        # Train 20 steps straight through.
+        t1 = Trainer(_XorTrial(), _dummy_core(tmp_path / "a"), seed=7)
+        t1.fit(max_length=Batch(20))
+        straight = jax.device_get(t1.state["params"])
+
+        # Train 10, checkpoint, resume into a fresh trainer, train 10 more.
+        ctx = _dummy_core(tmp_path / "b")
+        t2 = Trainer(_XorTrial(), ctx, seed=7)
+        t2.fit(max_length=Batch(10))
+        storage_id = t2._save_checkpoint()
+
+        t3 = Trainer(_XorTrial(), ctx, seed=7)
+        t3.fit(max_length=Batch(20), latest_checkpoint=storage_id)
+        resumed = jax.device_get(t3.state["params"])
+        assert t3.steps_completed == 20
+
+        for a, b in zip(
+            jax.tree_util.tree_leaves(straight), jax.tree_util.tree_leaves(resumed)
+        ):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    def test_checkpoint_metadata(self, tmp_path):
+        ctx = _dummy_core(tmp_path)
+        trainer = Trainer(_XorTrial(), ctx)
+        trainer.fit(max_length=Batch(5))
+        sid = trainer._save_checkpoint()
+        md = ctx.checkpoint.get_metadata(sid)
+        assert md["steps_completed"] == 5
+
+
+class _GPTTrial(JAXTrial):
+    def build_model(self, mesh):
+        return get_model("gpt-tiny", mesh=mesh)
+
+    def build_optimizer(self):
+        return optax.chain(
+            optax.clip_by_global_norm(1.0), optax.adamw(1e-3)
+        )
+
+    def build_training_data(self):
+        rng = np.random.default_rng(0)
+        while True:
+            yield {"tokens": rng.integers(0, 256, (8, 128)).astype(np.int32)}
+
+    def build_validation_data(self):
+        rng = np.random.default_rng(1)
+        return [
+            {"tokens": rng.integers(0, 256, (8, 128)).astype(np.int32)}
+            for _ in range(2)
+        ]
+
+
+class TestShardedTraining:
+    @pytest.mark.parametrize(
+        "mesh_cfg",
+        [
+            MeshConfig(data=8),
+            MeshConfig(data=2, fsdp=2, tensor=2),
+            MeshConfig(data=2, fsdp=1, context=2, tensor=2),
+        ],
+        ids=["dp8", "dp2-fsdp2-tp2", "dp2-cp2-tp2"],
+    )
+    def test_gpt_trains_on_mesh(self, devices8, tmp_path, mesh_cfg):
+        mesh = make_mesh(mesh_cfg, devices=devices8)
+        trainer = Trainer(_GPTTrial(), _dummy_core(tmp_path), mesh=mesh)
+        trainer.fit(max_length=Batch(3))
+        assert trainer.steps_completed == 3
+        # params stay sharded on the mesh
+        leaf = jax.tree_util.tree_leaves(trainer.state["params"])[0]
+        assert leaf.sharding.mesh.shape == mesh.shape
+
+    def test_fsdp_actually_shards_opt_state(self, devices8, tmp_path):
+        mesh = make_mesh(MeshConfig(data=1, fsdp=8), devices=devices8)
+        trainer = Trainer(_GPTTrial(), _dummy_core(tmp_path), mesh=mesh)
+        state = trainer.state
+        # Adam mu for the embedding must be sharded over fsdp (ZeRO-3 analog):
+        # its per-device footprint is 1/8 of the global array.
+        wi = state["params"]["blocks"]["wi"]
+        shard = wi.addressable_shards[0]
+        assert shard.data.size * 8 == wi.size
