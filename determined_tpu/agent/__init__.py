@@ -1,0 +1,4 @@
+"""Agent daemon (ref: agent/internal) — see agent.py."""
+from determined_tpu.agent.agent import AgentDaemon, detect_slots
+
+__all__ = ["AgentDaemon", "detect_slots"]

@@ -1,0 +1,228 @@
+"""Agent daemon: runs on each TPU host, executes tasks for the master.
+
+Rebuild of `agent/internal/agent.go:41,86` + `containers/manager.go:35` with
+the container runtime swapped for process supervision: on a TPU VM the unit
+of execution is a process group owning the host's chips (there is no
+nvidia-docker equivalent in the TPU runtime; the harness process grabs the
+chips via libtpu). START actions spawn `determined_tpu.exec.prep_and_run`
+with the DTPU_* env; exits are reported back as events; stdout/stderr is
+shipped to the master's task-log store (replacing the ws ContainerLog path,
+aproto/master_message.go:41).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from determined_tpu.common.api_session import Session
+
+logger = logging.getLogger("determined_tpu.agent")
+
+
+def detect_slots(spec: Any = "auto") -> int:
+    """Slot (chip) count for this host (ref: agent/internal/detect/detect.go:19).
+
+    "auto" asks the TPU runtime via jax — only safe when the agent host's
+    chips are not yet claimed by a trial; an int (or --artificial-slots dev
+    mode) skips detection.
+    """
+    if isinstance(spec, int):
+        return spec
+    if spec == "auto":
+        try:
+            import jax
+
+            return len(jax.local_devices())
+        except Exception:  # noqa: BLE001 - no accelerator: CPU-only agent
+            return 1
+    return int(spec)
+
+
+class _Task:
+    def __init__(self, alloc_id: str, task_id: str, proc: subprocess.Popen) -> None:
+        self.alloc_id = alloc_id
+        self.task_id = task_id
+        self.proc = proc
+
+
+class AgentDaemon:
+    def __init__(
+        self,
+        master_url: str,
+        agent_id: Optional[str] = None,
+        slots: Any = "auto",
+        pool: str = "default",
+        python_exe: Optional[str] = None,
+    ) -> None:
+        self.master_url = master_url
+        self.agent_id = agent_id or socket.gethostname()
+        self.slots = detect_slots(slots)
+        self.pool = pool
+        self.session = Session(master_url)
+        self.python_exe = python_exe or sys.executable
+        self._tasks: Dict[str, _Task] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+    def register(self) -> None:
+        self.session.post(
+            "/api/v1/agents",
+            json_body={
+                "agent_id": self.agent_id, "slots": self.slots, "pool": self.pool,
+            },
+        )
+        logger.info(
+            "agent %s registered: %d slots in pool %s",
+            self.agent_id, self.slots, self.pool,
+        )
+
+    def run_forever(self) -> None:
+        self.register()
+        while not self._stop.is_set():
+            try:
+                resp = self.session.get(
+                    f"/api/v1/agents/{self.agent_id}/actions",
+                    params={"timeout_seconds": 30}, timeout=40,
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.warning("poll failed (%s); retrying", e)
+                time.sleep(2)
+                # Master may have restarted: re-register so slots reappear.
+                try:
+                    self.register()
+                except Exception:  # noqa: BLE001
+                    pass
+                continue
+            for action in resp.get("actions", []):
+                try:
+                    self.handle(action)
+                except Exception:  # noqa: BLE001
+                    logger.exception("action failed: %s", action.get("type"))
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            tasks = list(self._tasks.values())
+        for t in tasks:
+            self._kill(t)
+
+    # -- actions ---------------------------------------------------------------
+    def handle(self, action: Dict[str, Any]) -> None:
+        kind = action.get("type")
+        if kind == "START":
+            self._start(action)
+        elif kind == "KILL":
+            with self._lock:
+                task = self._tasks.get(action["alloc_id"])
+            if task is not None:
+                self._kill(task)
+        else:
+            logger.warning("unknown action %r", kind)
+
+    def _start(self, action: Dict[str, Any]) -> None:
+        env = dict(os.environ)
+        env.update(action["env"])
+        env["DTPU_ENTRYPOINT"] = action.get("entrypoint", "")
+        proc = subprocess.Popen(
+            [self.python_exe, "-m", "determined_tpu.exec.prep_and_run"],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            start_new_session=True,  # own process group: clean KILL semantics
+        )
+        task = _Task(action["alloc_id"], action.get("task_id", ""), proc)
+        with self._lock:
+            self._tasks[task.alloc_id] = task
+        threading.Thread(
+            target=self._ship_logs, args=(task,), daemon=True,
+            name=f"logs-{task.alloc_id}",
+        ).start()
+        threading.Thread(
+            target=self._wait_exit, args=(task,), daemon=True,
+            name=f"wait-{task.alloc_id}",
+        ).start()
+        logger.info("started %s (pid %d)", task.alloc_id, proc.pid)
+
+    def _ship_logs(self, task: _Task) -> None:
+        """Batch stdout lines to the master (ref: tasklogger batching)."""
+        assert task.proc.stdout is not None
+        batch = []
+        last_flush = time.time()
+
+        def flush() -> None:
+            nonlocal batch, last_flush
+            if batch:
+                try:
+                    self.session.post(
+                        "/api/v1/task_logs",
+                        json_body={"task_id": task.task_id, "logs": batch},
+                    )
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("log ship failed: %s", e)
+                batch = []
+            last_flush = time.time()
+
+        for line in task.proc.stdout:
+            batch.append({"ts": time.time(), "log": line.rstrip("\n")})
+            if len(batch) >= 64 or time.time() - last_flush > 2.0:
+                flush()
+        flush()
+
+    def _wait_exit(self, task: _Task) -> None:
+        code = task.proc.wait()
+        with self._lock:
+            self._tasks.pop(task.alloc_id, None)
+        try:
+            self.session.post(
+                f"/api/v1/agents/{self.agent_id}/events",
+                json_body={
+                    "type": "EXITED", "alloc_id": task.alloc_id,
+                    "exit_code": code,
+                    "reason": "" if code == 0 else f"exit code {code}",
+                },
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.error("failed to report exit of %s: %s", task.alloc_id, e)
+        logger.info("%s exited with %d", task.alloc_id, code)
+
+    def _kill(self, task: _Task, grace_s: float = 10.0) -> None:
+        """SIGTERM the group, escalate to SIGKILL (ref: container stop flow)."""
+        try:
+            os.killpg(os.getpgid(task.proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        try:
+            task.proc.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(task.proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+
+def main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="determined_tpu agent")
+    parser.add_argument("--master-url", required=True)
+    parser.add_argument("--agent-id", default=None)
+    parser.add_argument("--slots", default="auto",
+                        help='"auto", or an int (artificial slots)')
+    parser.add_argument("--pool", default="default")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    slots: Any = args.slots if args.slots == "auto" else int(args.slots)
+    AgentDaemon(args.master_url, args.agent_id, slots, args.pool).run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+"""`dtpu` CLI (ref: harness/determined/cli) — see cli.py."""
+from determined_tpu.cli.cli import main
+
+__all__ = ["main"]

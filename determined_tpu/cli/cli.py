@@ -1,0 +1,306 @@
+"""`dtpu` command-line interface.
+
+Rebuild of the reference's `det` CLI (`harness/determined/cli/cli.py:200`):
+noun/verb command trees over the REST API — experiment, trial, checkpoint,
+agent, master — plus the daemons (`dtpu master up`, `dtpu agent run`) and a
+single-box dev cluster (`dtpu dev cluster`, the devcluster.yaml analog).
+
+Master address: --master flag or DTPU_MASTER env (same precedence shape as
+the reference's DET_MASTER).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from determined_tpu.common.api_session import Session
+
+
+def _die(msg: str) -> "sys.NoReturn":
+    print(f"error: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _session(args: argparse.Namespace) -> Session:
+    master = args.master or os.environ.get("DTPU_MASTER")
+    if not master:
+        _die("no master address (use --master or set DTPU_MASTER)")
+    return Session(master)
+
+
+def _load_config(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        text = f.read()
+    if path.endswith(".json"):
+        return json.loads(text)
+    try:
+        import yaml
+
+        return yaml.safe_load(text)
+    except ImportError:
+        return json.loads(text)  # yaml unavailable: JSON-only configs
+
+
+def _table(rows: List[Dict[str, Any]], cols: List[str]) -> None:
+    if not rows:
+        print("(none)")
+        return
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows)) for c in cols}
+    print("  ".join(c.ljust(widths[c]) for c in cols))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(widths[c]) for c in cols))
+
+
+# -- experiment --------------------------------------------------------------
+def exp_create(args: argparse.Namespace) -> None:
+    config = _load_config(args.config)
+    if args.config_override:
+        for kv in args.config_override:
+            path, _, raw = kv.partition("=")
+            try:
+                val = json.loads(raw)
+            except json.JSONDecodeError:
+                val = raw
+            d = config
+            keys = path.split(".")
+            for k in keys[:-1]:
+                d = d.setdefault(k, {})
+            d[keys[-1]] = val
+    resp = _session(args).post("/api/v1/experiments", json_body={"config": config})
+    exp_id = resp["id"]
+    print(f"Created experiment {exp_id}")
+    if args.follow:
+        exp_wait(args, exp_id)
+
+
+def exp_wait(args: argparse.Namespace, exp_id: Optional[int] = None) -> None:
+    exp_id = exp_id if exp_id is not None else args.experiment_id
+    session = _session(args)
+    last_state = None
+    while True:
+        exp = session.get(f"/api/v1/experiments/{exp_id}")
+        state = exp["state"]
+        if state != last_state:
+            print(f"experiment {exp_id}: {state} (progress {exp.get('progress', 0):.0%})")
+            last_state = state
+        if state in ("COMPLETED", "CANCELED", "ERRORED"):
+            sys.exit(0 if state == "COMPLETED" else 1)
+        time.sleep(2)
+
+
+def exp_list(args: argparse.Namespace) -> None:
+    exps = _session(args).get("/api/v1/experiments")["experiments"]
+    _table(
+        [
+            {
+                "id": e["id"], "state": e["state"],
+                "progress": f"{e.get('progress') or 0:.0%}",
+                "searcher": e["config"].get("searcher", {}).get("name", ""),
+            }
+            for e in exps
+        ],
+        ["id", "state", "progress", "searcher"],
+    )
+
+
+def exp_describe(args: argparse.Namespace) -> None:
+    print(json.dumps(_session(args).get(
+        f"/api/v1/experiments/{args.experiment_id}"), indent=2))
+
+
+def _exp_action(action: str):
+    def run(args: argparse.Namespace) -> None:
+        resp = _session(args).post(
+            f"/api/v1/experiments/{args.experiment_id}/{action}"
+        )
+        print(f"experiment {args.experiment_id}: {resp['state']}")
+
+    return run
+
+
+# -- trial -------------------------------------------------------------------
+def trial_list(args: argparse.Namespace) -> None:
+    trials = _session(args).get(
+        f"/api/v1/experiments/{args.experiment_id}/trials")["trials"]
+    _table(
+        [
+            {
+                "id": t["id"], "state": t["state"],
+                "steps": t["steps_completed"], "restarts": t["restarts"],
+                "metric": t.get("searcher_metric"),
+                "hparams": json.dumps(t["hparams"]),
+            }
+            for t in trials
+        ],
+        ["id", "state", "steps", "restarts", "metric", "hparams"],
+    )
+
+
+def trial_logs(args: argparse.Namespace) -> None:
+    session = _session(args)
+    after = 0
+    while True:
+        logs = session.get(
+            "/api/v1/task_logs",
+            params={"task_id": f"trial-{args.trial_id}", "after": after},
+        )["logs"]
+        for line in logs:
+            print(line["log"])
+            after = line["id"]
+        if not args.follow:
+            if not logs:
+                break
+            continue
+        trial = session.get(f"/api/v1/trials/{args.trial_id}")
+        if trial["state"] in ("COMPLETED", "CANCELED", "ERRORED") and not logs:
+            break
+        time.sleep(1)
+
+
+def trial_metrics(args: argparse.Namespace) -> None:
+    metrics = _session(args).get(
+        f"/api/v1/trials/{args.trial_id}/metrics",
+        params={"group": args.group} if args.group else None,
+    )["metrics"]
+    for m in metrics:
+        print(f"[{m['grp']}] step {m['steps_completed']}: {json.dumps(m['body'])}")
+
+
+# -- checkpoint ---------------------------------------------------------------
+def ckpt_list(args: argparse.Namespace) -> None:
+    ckpts = _session(args).get(
+        f"/api/v1/trials/{args.trial_id}/checkpoints")["checkpoints"]
+    _table(
+        [
+            {"uuid": c["uuid"], "steps": c["steps_completed"],
+             "files": len(c["resources"])}
+            for c in ckpts
+        ],
+        ["uuid", "steps", "files"],
+    )
+
+
+# -- cluster ------------------------------------------------------------------
+def agent_list(args: argparse.Namespace) -> None:
+    agents = _session(args).get("/api/v1/agents")["agents"]
+    _table(
+        [
+            {"id": aid, "slots": a["slots"], "pool": a["pool"]}
+            for aid, a in agents.items()
+        ],
+        ["id", "slots", "pool"],
+    )
+
+
+def master_info(args: argparse.Namespace) -> None:
+    print(json.dumps(_session(args).get("/api/v1/master"), indent=2))
+
+
+# -- daemons ------------------------------------------------------------------
+def master_up(args: argparse.Namespace) -> None:
+    sys.argv = ["dtpu-master"] + (args.rest or [])
+    from determined_tpu.master.main import main as master_main
+
+    master_main()
+
+
+def agent_run(args: argparse.Namespace) -> None:
+    sys.argv = ["dtpu-agent"] + (args.rest or [])
+    from determined_tpu.agent.agent import main as agent_main
+
+    agent_main()
+
+
+def dev_cluster(args: argparse.Namespace) -> None:
+    from determined_tpu.devcluster import DevCluster
+
+    with DevCluster(
+        n_agents=args.agents, slots_per_agent=args.slots_per_agent,
+        db_path=args.db,
+    ) as dc:
+        print(f"dev cluster up: master at {dc.api.url}")
+        print(f"  export DTPU_MASTER={dc.api.url}")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dtpu", description="determined_tpu CLI")
+    p.add_argument("--master", "-m", default=None, help="master URL")
+    sub = p.add_subparsers(dest="noun", required=True)
+
+    exp = sub.add_parser("experiment", aliases=["e"]).add_subparsers(
+        dest="verb", required=True)
+    c = exp.add_parser("create")
+    c.add_argument("config")
+    c.add_argument("--config-override", "-O", action="append",
+                   help="dot.path=json overrides")
+    c.add_argument("--follow", "-f", action="store_true")
+    c.set_defaults(fn=exp_create)
+    exp.add_parser("list").set_defaults(fn=exp_list)
+    for verb, fn in [
+        ("describe", exp_describe), ("wait", lambda a: exp_wait(a)),
+        ("pause", _exp_action("pause")), ("activate", _exp_action("activate")),
+        ("cancel", _exp_action("cancel")), ("kill", _exp_action("kill")),
+    ]:
+        v = exp.add_parser(verb)
+        v.add_argument("experiment_id", type=int)
+        v.set_defaults(fn=fn)
+
+    trial = sub.add_parser("trial", aliases=["t"]).add_subparsers(
+        dest="verb", required=True)
+    v = trial.add_parser("list")
+    v.add_argument("experiment_id", type=int)
+    v.set_defaults(fn=trial_list)
+    v = trial.add_parser("logs")
+    v.add_argument("trial_id", type=int)
+    v.add_argument("--follow", "-f", action="store_true")
+    v.set_defaults(fn=trial_logs)
+    v = trial.add_parser("metrics")
+    v.add_argument("trial_id", type=int)
+    v.add_argument("--group", default=None)
+    v.set_defaults(fn=trial_metrics)
+
+    ckpt = sub.add_parser("checkpoint", aliases=["c"]).add_subparsers(
+        dest="verb", required=True)
+    v = ckpt.add_parser("list")
+    v.add_argument("trial_id", type=int)
+    v.set_defaults(fn=ckpt_list)
+
+    agent = sub.add_parser("agent", aliases=["a"]).add_subparsers(
+        dest="verb", required=True)
+    agent.add_parser("list").set_defaults(fn=agent_list)
+    v = agent.add_parser("run")
+    v.add_argument("rest", nargs=argparse.REMAINDER)
+    v.set_defaults(fn=agent_run)
+
+    master = sub.add_parser("master").add_subparsers(dest="verb", required=True)
+    master.add_parser("info").set_defaults(fn=master_info)
+    v = master.add_parser("up")
+    v.add_argument("rest", nargs=argparse.REMAINDER)
+    v.set_defaults(fn=master_up)
+
+    dev = sub.add_parser("dev").add_subparsers(dest="verb", required=True)
+    v = dev.add_parser("cluster")
+    v.add_argument("--agents", type=int, default=1)
+    v.add_argument("--slots-per-agent", type=int, default=1)
+    v.add_argument("--db", default=":memory:")
+    v.set_defaults(fn=dev_cluster)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -69,16 +69,23 @@ class SearcherContext:
         self._trial_id = trial_id
 
     def _get_current_op(self) -> Optional[SearcherOperation]:
-        resp = self._session.get(
-            f"/api/v1/trials/{self._trial_id}/searcher/operation",
-            params={"timeout_seconds": 60},
-            timeout=70,
-        )
-        if resp.get("completed") or resp.get("op") is None:
-            return None
-        return SearcherOperation(
-            self._session, self._trial_id, int(resp["op"]["length"]), self._dist.is_chief
-        )
+        while True:
+            resp = self._session.get(
+                f"/api/v1/trials/{self._trial_id}/searcher/operation",
+                params={"timeout_seconds": 60},
+                timeout=70,
+            )
+            if resp.get("completed"):
+                return None
+            if resp.get("op") is not None:
+                return SearcherOperation(
+                    self._session,
+                    self._trial_id,
+                    int(resp["op"]["length"]),
+                    self._dist.is_chief,
+                )
+            # op None + not completed == long-poll timeout: the searcher just
+            # hasn't issued new work yet (e.g. ASHA waiting on other trials).
 
     def operations(self) -> Iterator[SearcherOperation]:
         """Yield ValidateAfter ops until the searcher closes the trial.

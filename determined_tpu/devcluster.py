@@ -1,0 +1,94 @@
+"""DevCluster: a whole cluster (master + agents) in one process tree.
+
+Rebuild of the reference's devcluster tooling (`tools/devcluster.yaml`, e2e
+`ManagedCluster` at `e2e_tests/tests/cluster/managed_cluster.py:28`): start
+an in-process Master + ApiServer and N agent daemons on this box; agents
+spawn REAL trial subprocesses through the full exec chain, so everything
+from `POST /experiments` to rendezvous to checkpoint upload runs exactly as
+on a TPU pod — the workhorse for cluster e2e tests and local development.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+from determined_tpu.agent.agent import AgentDaemon
+from determined_tpu.common.api_session import Session
+from determined_tpu.master.api_server import ApiServer
+from determined_tpu.master.core import Master
+
+
+class DevCluster:
+    def __init__(
+        self,
+        n_agents: int = 1,
+        slots_per_agent: int = 1,
+        db_path: str = ":memory:",
+        scheduler: Optional[Dict[str, Any]] = None,
+        preempt_timeout_s: float = 120.0,
+    ) -> None:
+        # Trial subprocesses must import determined_tpu without installation.
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        pypath = os.environ.get("PYTHONPATH", "")
+        if repo_root not in pypath.split(os.pathsep):
+            os.environ["PYTHONPATH"] = (
+                f"{repo_root}{os.pathsep}{pypath}" if pypath else repo_root
+            )
+
+        self.master = Master(
+            db_path=db_path,
+            pools_config={"default": {"scheduler": scheduler or {"type": "priority"}}},
+            preempt_timeout_s=preempt_timeout_s,
+        )
+        self.api = ApiServer(self.master)
+        self.api.start()
+        self.master.external_url = self.api.url
+        self.agents: List[AgentDaemon] = []
+        self._agent_threads: List[threading.Thread] = []
+        for i in range(n_agents):
+            self.start_agent(f"agent-{i}", slots_per_agent)
+
+    # -- agents (start/kill for chaos tests, ref test_agent_restart.py) -------
+    def start_agent(self, agent_id: str, slots: int) -> AgentDaemon:
+        agent = AgentDaemon(
+            self.api.url, agent_id=agent_id, slots=slots, python_exe=sys.executable
+        )
+        thread = threading.Thread(
+            target=agent.run_forever, daemon=True, name=f"agent-{agent_id}"
+        )
+        thread.start()
+        self.agents.append(agent)
+        self._agent_threads.append(thread)
+        return agent
+
+    def kill_agent(self, agent: AgentDaemon) -> None:
+        agent.stop()
+        self.master.rm.pool().remove_agent(agent.agent_id)
+
+    # -- client-side --------------------------------------------------------
+    def session(self) -> Session:
+        return Session(self.api.url)
+
+    def create_experiment(self, config: Dict[str, Any]) -> int:
+        return int(self.session().post(
+            "/api/v1/experiments", json_body={"config": config}
+        )["id"])
+
+    def wait_experiment(self, exp_id: int, timeout: float = 300.0) -> str:
+        exp = self.master.get_experiment(exp_id)
+        assert exp is not None
+        return exp.wait_done(timeout=timeout)
+
+    def stop(self) -> None:
+        for agent in self.agents:
+            agent.stop()
+        self.master.shutdown()
+        self.api.stop()
+
+    def __enter__(self) -> "DevCluster":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()

@@ -1,0 +1,3 @@
+"""Task-side exec chain (ref: harness/determined/exec): prep_and_run
+(rendezvous + entrypoint), harness (trial runner), builtin_trials
+(fixture/example trials)."""

@@ -1,0 +1,95 @@
+"""Trial harness: entrypoint class → core.init() → Trainer.fit.
+
+Rebuild of `harness/determined/exec/harness.py:24,134` (_run_pytorch_trial):
+imports the trial class named by the experiment config's `entrypoint`
+("pkg.module:TrialClass"), builds the Trainer from the config's searcher /
+period / mesh sections, and runs to searcher completion. Exit code 0 on
+clean finish or graceful preemption; nonzero on error (the master's restart
+budget applies, trial.go:78).
+"""
+from __future__ import annotations
+
+import importlib
+import logging
+import sys
+from typing import Any, Dict, Optional
+
+from determined_tpu import core
+from determined_tpu.parallel.mesh import MeshConfig, make_mesh
+from determined_tpu.trainer import Batch, Epoch, Trainer
+from determined_tpu.trainer._units import TrainUnit
+
+logger = logging.getLogger("determined_tpu.exec")
+
+
+def import_entrypoint(entrypoint: str) -> Any:
+    module_name, _, attr = entrypoint.partition(":")
+    module = importlib.import_module(module_name)
+    return getattr(module, attr)
+
+
+def parse_unit(spec: Any) -> Optional[TrainUnit]:
+    """expconf-style length: {"batches": N} | {"epochs": N} | int (batches)."""
+    if spec is None:
+        return None
+    if isinstance(spec, int):
+        return Batch(spec)
+    if "batches" in spec:
+        return Batch(int(spec["batches"]))
+    if "epochs" in spec:
+        return Epoch(int(spec["epochs"]))
+    raise ValueError(f"bad train-unit spec {spec!r}")
+
+
+def run(entrypoint: str) -> int:
+    import os
+
+    plat = os.environ.get("DTPU_JAX_PLATFORM")
+    if plat:
+        # Test/dev clusters force trials onto CPU (the ambient sitecustomize
+        # may register a TPU backend regardless of JAX_PLATFORMS).
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    info = core._context._info.get_cluster_info()
+    assert info is not None and info.trial is not None, "harness needs a trial env"
+    cfg: Dict[str, Any] = info.trial.config
+    trial_cls = import_entrypoint(entrypoint)
+    trial = trial_cls(info.trial.hparams)
+
+    mesh = None
+    if cfg.get("mesh"):
+        mesh = make_mesh(MeshConfig(**cfg["mesh"]))
+
+    scfg = cfg.get("searcher", {})
+    try:
+        with core.init() as ctx:
+            trainer = Trainer(
+                trial,
+                ctx,
+                mesh=mesh,
+                seed=info.trial.trial_seed,
+                searcher_metric=scfg.get("metric", "loss"),
+                smaller_is_better=bool(scfg.get("smaller_is_better", True)),
+            )
+            trainer.fit(
+                validation_period=parse_unit(cfg.get("min_validation_period")),
+                checkpoint_period=parse_unit(cfg.get("min_checkpoint_period")),
+                report_period=parse_unit(cfg.get("scheduling_unit")) or Batch(10),
+                latest_checkpoint=info.trial.latest_checkpoint,
+            )
+        return 0
+    except Exception:  # noqa: BLE001
+        logger.exception("trial failed")
+        return 1
+
+
+def main() -> None:
+    import os
+
+    logging.basicConfig(level=logging.INFO)
+    sys.exit(run(os.environ["DTPU_ENTRYPOINT"]))
+
+
+if __name__ == "__main__":
+    main()

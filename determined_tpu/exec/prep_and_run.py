@@ -1,0 +1,117 @@
+"""Task startup: rendezvous with the master, then run the entrypoint.
+
+Rebuild of the reference's container exec chain
+(`harness/determined/exec/prep_container.py:23,69` + `launch.py:27`):
+
+1. every host process posts its address to the master's rendezvous service
+   and long-polls for the published table (ref: rendezvous.go:127);
+2. the rank-0 address carries the ports for `jax.distributed.initialize`
+   (coordinator) and the ZMQ control-plane star (chief) — replacing
+   horovodrun host lists / torchrun --rdzv_endpoint;
+3. the rendezvous payload is written into DTPU_RENDEZVOUS_INFO /
+   DTPU_CHIEF_PORT and the entrypoint runs:
+   - "pkg.module:TrialClass" → the trial harness (exec.harness),
+   - anything else → a shell command (core-API scripts).
+
+SIGTERM (cloud TPU preemption notice, SLURM-style) is translated into a
+preemption signal exactly like the reference's `launch.py:16` handler.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+
+from determined_tpu.common import ipc
+from determined_tpu.common.api_session import Session
+
+logger = logging.getLogger("determined_tpu.exec")
+
+
+def _my_ip(master_url: str) -> str:
+    """The address other hosts in the allocation can reach us at."""
+    host = master_url.split("//")[-1].split(":")[0]
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect((host, 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+def rendezvous(master_url: str, alloc_id: str, rank: int, num_procs: int) -> None:
+    """Run the rendezvous protocol; mutates os.environ for the entrypoint."""
+    if num_procs <= 1:
+        return
+    session = Session(master_url)
+    ip = _my_ip(master_url)
+    if rank == 0:
+        coord_port, chief_port = ipc.free_port(), ipc.free_port()
+        addr = f"{ip}:{coord_port}:{chief_port}"
+    else:
+        addr = ip
+    session.post(
+        f"/api/v1/allocations/{alloc_id}/rendezvous",
+        json_body={"rank": rank, "addr": addr},
+    )
+    info = session.get(
+        f"/api/v1/allocations/{alloc_id}/rendezvous",
+        params={"timeout_seconds": 600}, timeout=610,
+    )
+    chief = info["container_addrs"][0]
+    chief_ip, coord_port, chief_port = chief.split(":")
+    container_addrs = [a.split(":")[0] for a in info["container_addrs"]]
+    os.environ["DTPU_RENDEZVOUS_INFO"] = json.dumps(
+        {
+            "container_addrs": container_addrs,
+            "container_rank": rank,
+            "coordinator_address": f"{chief_ip}:{coord_port}",
+            "num_processes": num_procs,
+        }
+    )
+    os.environ["DTPU_CHIEF_PORT"] = chief_port
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    master_url = os.environ["DTPU_MASTER"]
+    alloc_id = os.environ.get("DTPU_ALLOCATION_ID", "")
+    rank = int(os.environ.get("DTPU_ALLOC_RANK", "0"))
+    num_procs = int(os.environ.get("DTPU_ALLOC_NUM_PROCS", "1"))
+    entrypoint = os.environ.get("DTPU_ENTRYPOINT", "")
+
+    rendezvous(master_url, alloc_id, rank, num_procs)
+
+    if ":" in entrypoint and " " not in entrypoint:
+        # Trial-class entrypoint: run in-process via the harness.
+        # SIGTERM → preemption signal so the trainer checkpoints and exits 0.
+        def on_sigterm(signum, frame):  # noqa: ANN001
+            logger.info("SIGTERM: requesting preemption")
+            try:
+                Session(master_url).post(
+                    f"/api/v1/allocations/{alloc_id}/signals/preemption_from_task"
+                )
+            except Exception:  # noqa: BLE001
+                os._exit(143)
+
+        signal.signal(signal.SIGTERM, on_sigterm)
+        from determined_tpu.exec import harness
+
+        return harness.run(entrypoint)
+
+    # Shell entrypoint (core-API script): exec as a child, forward signals.
+    cmd = shlex.split(entrypoint)
+    proc = subprocess.Popen(cmd, env=os.environ)
+    signal.signal(signal.SIGTERM, lambda s, f: proc.terminate())
+    return proc.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

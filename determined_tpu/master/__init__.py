@@ -1,0 +1,10 @@
+"""Master: the platform control plane.
+
+Rebuild of `master/internal` (see core.py): persistence (db), schedulers +
+resource pools (scheduler, rm), allocation lifecycle (allocation),
+experiment/trial FSMs (experiment), REST API (api_server).
+"""
+from determined_tpu.master.api_server import ApiServer
+from determined_tpu.master.core import Master
+
+__all__ = ["Master", "ApiServer"]

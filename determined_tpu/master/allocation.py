@@ -1,0 +1,205 @@
+"""Allocation service: rendezvous, preemption, allgather, exit tracking.
+
+Rebuild of `master/internal/task/` — `allocation.go:99` (lifecycle),
+`rendezvous.go:56` (address collection + publish), `preemptible/` (long-poll
+watcher + ack), `allgather/` (cross-process barrier/data exchange). One
+service object owns all live allocations; long-polls are blocking waits on a
+Condition (the HTTP layer calls these from request threads).
+
+TPU mapping: rendezvous collects one address per *host process* and elects
+rank 0's address as the `coordinator_address` for
+`jax.distributed.initialize` — replacing the reference's per-container IP
+lists for horovodrun/torchrun (SURVEY.md §2.5 'Rendezvous').
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+PENDING, ASSIGNED, RUNNING, TERMINATED = "PENDING", "ASSIGNED", "RUNNING", "TERMINATED"
+
+
+@dataclasses.dataclass
+class Allocation:
+    id: str
+    task_id: str
+    trial_id: Optional[int]
+    num_processes: int
+    slots: int
+    state: str = PENDING
+    # rendezvous
+    addrs: Dict[int, str] = dataclasses.field(default_factory=dict)  # rank -> addr
+    # preemption
+    preempt_requested: bool = False
+    preempt_acked: bool = False
+    preempt_deadline: Optional[float] = None
+    # allgather (keyed by round counter so reuse is safe)
+    ag_data: Dict[int, Dict[int, Any]] = dataclasses.field(default_factory=dict)
+    ag_round: int = 0
+    # exit
+    exit_code: Optional[int] = None
+    exit_reason: Optional[str] = None
+
+
+class AllocationService:
+    def __init__(self, preempt_timeout_s: float = 600.0) -> None:
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._allocs: Dict[str, Allocation] = {}
+        self._preempt_timeout_s = preempt_timeout_s
+        self._on_exit: Optional[Callable[[Allocation], None]] = None
+
+    def set_exit_hook(self, fn: Callable[[Allocation], None]) -> None:
+        self._on_exit = fn
+
+    # -- lifecycle -----------------------------------------------------------
+    def create(
+        self, alloc_id: str, *, task_id: str, trial_id: Optional[int],
+        num_processes: int, slots: int,
+    ) -> Allocation:
+        with self._cond:
+            alloc = Allocation(
+                id=alloc_id, task_id=task_id, trial_id=trial_id,
+                num_processes=num_processes, slots=slots, state=ASSIGNED,
+            )
+            self._allocs[alloc_id] = alloc
+            self._cond.notify_all()
+            return alloc
+
+    def get(self, alloc_id: str) -> Optional[Allocation]:
+        with self._lock:
+            return self._allocs.get(alloc_id)
+
+    def complete(self, alloc_id: str, exit_code: int = 0, reason: str = "") -> None:
+        """A task process group finished (or was killed)."""
+        with self._cond:
+            alloc = self._allocs.get(alloc_id)
+            if alloc is None or alloc.state == TERMINATED:
+                return
+            alloc.state = TERMINATED
+            alloc.exit_code = exit_code
+            alloc.exit_reason = reason
+            self._cond.notify_all()
+        if self._on_exit is not None:
+            self._on_exit(alloc)
+
+    def wait_exit(self, alloc_id: str, timeout: Optional[float] = None) -> Optional[Allocation]:
+        deadline = None if timeout is None else time.time() + timeout
+        with self._cond:
+            while True:
+                alloc = self._allocs.get(alloc_id)
+                if alloc is None:
+                    return None
+                if alloc.state == TERMINATED:
+                    return alloc
+                remaining = None if deadline is None else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(timeout=remaining)
+
+    # -- rendezvous (ref: rendezvous.go try/ready/push) ------------------------
+    def rendezvous_arrive(self, alloc_id: str, rank: int, addr: str) -> None:
+        with self._cond:
+            alloc = self._allocs[alloc_id]
+            alloc.addrs[rank] = addr
+            if len(alloc.addrs) == alloc.num_processes:
+                alloc.state = RUNNING
+            self._cond.notify_all()
+
+    def rendezvous_info(
+        self, alloc_id: str, timeout: float = 600.0
+    ) -> Optional[Dict[str, Any]]:
+        """Block until every process arrived; returns the published table."""
+        deadline = time.time() + timeout
+        with self._cond:
+            while True:
+                alloc = self._allocs.get(alloc_id)
+                if alloc is None:
+                    return None
+                if len(alloc.addrs) == alloc.num_processes:
+                    addrs = [alloc.addrs[r] for r in sorted(alloc.addrs)]
+                    return {
+                        "container_addrs": addrs,
+                        "coordinator_address": addrs[0],
+                        "num_processes": alloc.num_processes,
+                    }
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return None
+                self._cond.wait(timeout=min(remaining, 5.0))
+
+    # -- preemption (ref: preemptible/preemptible.go) --------------------------
+    def signal_preempt(self, alloc_id: str) -> None:
+        with self._cond:
+            alloc = self._allocs.get(alloc_id)
+            if alloc is None:
+                return
+            if not alloc.preempt_requested:
+                alloc.preempt_requested = True
+                alloc.preempt_deadline = time.time() + self._preempt_timeout_s
+            self._cond.notify_all()
+
+    def should_preempt(
+        self, alloc_id: str, timeout: float = 60.0
+    ) -> bool:
+        """Long-poll: returns current preemption flag (True as soon as set)."""
+        deadline = time.time() + timeout
+        with self._cond:
+            while True:
+                alloc = self._allocs.get(alloc_id)
+                if alloc is None:
+                    return False
+                if alloc.preempt_requested or alloc.state == TERMINATED:
+                    return alloc.preempt_requested
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=min(remaining, 5.0))
+
+    def ack_preempt(self, alloc_id: str) -> None:
+        with self._cond:
+            alloc = self._allocs.get(alloc_id)
+            if alloc is not None:
+                alloc.preempt_acked = True
+                self._cond.notify_all()
+
+    def overdue_preemptions(self) -> List[str]:
+        """Allocations past the preempt deadline without exiting (→ kill)."""
+        now = time.time()
+        with self._lock:
+            return [
+                a.id
+                for a in self._allocs.values()
+                if a.preempt_requested
+                and a.state != TERMINATED
+                and a.preempt_deadline is not None
+                and now > a.preempt_deadline
+            ]
+
+    # -- allgather (ref: task/allgather) ---------------------------------------
+    def allgather(
+        self, alloc_id: str, rank: int, data: Any, timeout: float = 600.0
+    ) -> Optional[List[Any]]:
+        """Barrier + data exchange: blocks until all ranks contribute."""
+        deadline = time.time() + timeout
+        with self._cond:
+            alloc = self._allocs[alloc_id]
+            rnd = alloc.ag_round
+            bucket = alloc.ag_data.setdefault(rnd, {})
+            if rank in bucket:
+                # Same rank re-entering: previous round is done; start anew.
+                rnd = alloc.ag_round = alloc.ag_round + 1
+                bucket = alloc.ag_data.setdefault(rnd, {})
+            bucket[rank] = data
+            if len(bucket) == alloc.num_processes:
+                alloc.ag_round = rnd + 1
+                self._cond.notify_all()
+                return [bucket[r] for r in sorted(bucket)]
+            while len(bucket) < alloc.num_processes:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return None
+                self._cond.wait(timeout=min(remaining, 5.0))
+            return [bucket[r] for r in sorted(bucket)]

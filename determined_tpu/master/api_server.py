@@ -1,0 +1,382 @@
+"""REST API server over the Master.
+
+Rebuild of the reference's gRPC/REST surface (`internal/api_*.go`, 206 RPCs
+behind grpc-gateway) scaled to the routes the harness/CLI/agents actually
+call; same resource nouns and long-poll semantics (searcher operation,
+preemption signal, rendezvous — ref api.proto:861,917,942,971-1007).
+
+stdlib ThreadingHTTPServer: each long-poll occupies one request thread,
+which is the same model as the reference's long-poll handlers; no external
+web framework is needed for a control plane at this rate.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from determined_tpu.master.core import Master
+
+logger = logging.getLogger("determined_tpu.master")
+
+Handler = Callable[["ApiRequest"], Any]
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(message)
+        self.status = status
+
+
+class ApiRequest:
+    def __init__(self, groups: Tuple[str, ...], body: Dict[str, Any], query: Dict[str, List[str]]):
+        self.groups = groups
+        self.body = body
+        self.query = query
+
+    def q(self, name: str, default: Optional[str] = None) -> Optional[str]:
+        vals = self.query.get(name)
+        return vals[0] if vals else default
+
+    def qfloat(self, name: str, default: float) -> float:
+        v = self.q(name)
+        return float(v) if v is not None else default
+
+
+def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
+    def exp_of_trial(trial_id: int):
+        row = m.db.get_trial(trial_id)
+        if row is None:
+            raise ApiError(404, f"no trial {trial_id}")
+        exp = m.get_experiment(row["experiment_id"])
+        if exp is None:
+            raise ApiError(404, f"experiment {row['experiment_id']} not loaded")
+        return exp
+
+    # -- harness: metrics/progress/status -----------------------------------
+    def post_metrics(r: ApiRequest):
+        trial_id = int(r.groups[0])
+        m.db.add_metrics(
+            trial_id,
+            r.body.get("group", "training"),
+            int(r.body.get("steps_completed", 0)),
+            r.body.get("metrics", {}),
+            trial_run_id=int(r.body.get("trial_run_id", 0)),
+            report_time=r.body.get("report_time"),
+        )
+        return {}
+
+    def get_metrics(r: ApiRequest):
+        return {"metrics": m.db.get_metrics(int(r.groups[0]), r.q("group"))}
+
+    def post_progress(r: ApiRequest):
+        trial_id = int(r.groups[0])
+        exp_of_trial(trial_id).report_progress(
+            trial_id, float(r.body.get("progress", 0.0))
+        )
+        return {}
+
+    def post_status(r: ApiRequest):
+        return {}  # informational; the FSM owns real state
+
+    def best_validation(r: ApiRequest):
+        trial_id = int(r.groups[0])
+        exp = exp_of_trial(trial_id)
+        scfg = exp.config.get("searcher", {})
+        return {
+            "best": m.db.best_validation(
+                trial_id,
+                scfg.get("metric", "loss"),
+                bool(scfg.get("smaller_is_better", True)),
+            )
+        }
+
+    # -- harness: searcher ops ----------------------------------------------
+    def searcher_operation(r: ApiRequest):
+        trial_id = int(r.groups[0])
+        return exp_of_trial(trial_id).current_searcher_op(
+            trial_id, timeout=r.qfloat("timeout_seconds", 60.0)
+        )
+
+    def searcher_completed(r: ApiRequest):
+        trial_id = int(r.groups[0])
+        exp_of_trial(trial_id).op_completed(
+            trial_id, int(r.body["length"]), float(r.body["metric"])
+        )
+        return {}
+
+    def searcher_progress(r: ApiRequest):
+        return {}
+
+    # -- harness: checkpoints -------------------------------------------------
+    def post_checkpoint(r: ApiRequest):
+        b = r.body
+        m.db.add_checkpoint(
+            b["uuid"],
+            trial_id=b.get("trial_id"),
+            task_id=b.get("task_id", ""),
+            allocation_id=b.get("allocation_id", ""),
+            resources=b.get("resources", []),
+            metadata=b.get("metadata", {}),
+            state=b.get("state", "COMPLETED"),
+        )
+        if b.get("trial_id") is not None:
+            m.db.update_trial(int(b["trial_id"]), latest_checkpoint=b["uuid"])
+        return {}
+
+    def get_checkpoint(r: ApiRequest):
+        ckpt = m.db.get_checkpoint(r.groups[0])
+        if ckpt is None:
+            raise ApiError(404, "no such checkpoint")
+        return ckpt
+
+    # -- harness: allocation signals -----------------------------------------
+    def preemption_signal(r: ApiRequest):
+        return {
+            "preempt": m.alloc_service.should_preempt(
+                r.groups[0], timeout=r.qfloat("timeout_seconds", 60.0)
+            )
+        }
+
+    def ack_preemption(r: ApiRequest):
+        m.alloc_service.ack_preempt(r.groups[0])
+        return {}
+
+    def preempt_from_task(r: ApiRequest):
+        # A task saw SIGTERM (cloud TPU preemption notice) and asks to be
+        # preempted gracefully (ref: exec/launch.py:16 SLURM handler).
+        m.alloc_service.signal_preempt(r.groups[0])
+        return {}
+
+    def rendezvous_arrive(r: ApiRequest):
+        m.alloc_service.rendezvous_arrive(
+            r.groups[0], int(r.body["rank"]), r.body["addr"]
+        )
+        return {}
+
+    def rendezvous_info(r: ApiRequest):
+        info = m.alloc_service.rendezvous_info(
+            r.groups[0], timeout=r.qfloat("timeout_seconds", 600.0)
+        )
+        if info is None:
+            raise ApiError(408, "rendezvous timeout")
+        return info
+
+    def allgather(r: ApiRequest):
+        data = m.alloc_service.allgather(
+            r.groups[0], int(r.body["rank"]), r.body.get("data"),
+            timeout=r.qfloat("timeout_seconds", 600.0),
+        )
+        if data is None:
+            raise ApiError(408, "allgather timeout")
+        return {"data": data}
+
+    # -- task logs -------------------------------------------------------------
+    def post_task_logs(r: ApiRequest):
+        m.db.add_task_logs(r.body["task_id"], r.body.get("logs", []))
+        return {}
+
+    def get_task_logs(r: ApiRequest):
+        return {
+            "logs": m.db.get_task_logs(
+                r.q("task_id", ""), int(r.q("after", "0") or 0)
+            )
+        }
+
+    # -- agents ---------------------------------------------------------------
+    def register_agent(r: ApiRequest):
+        agent_id = r.body["agent_id"]
+        pool = r.body.get("pool", "default")
+        slots = int(r.body.get("slots", 0))
+        m.agent_hub.register(agent_id, slots, pool)
+        m.rm.pool(pool).add_agent(agent_id, slots)
+        return {"cluster_id": m.cluster_id}
+
+    def agent_actions(r: ApiRequest):
+        return {
+            "actions": m.agent_hub.poll(
+                r.groups[0], timeout=r.qfloat("timeout_seconds", 30.0)
+            )
+        }
+
+    def agent_events(r: ApiRequest):
+        m.agent_event(r.groups[0], r.body)
+        return {}
+
+    def list_agents(r: ApiRequest):
+        return {"agents": m.agent_hub.list()}
+
+    # -- experiments (user/CLI) -------------------------------------------------
+    def create_experiment(r: ApiRequest):
+        exp_id = m.create_experiment(r.body["config"])
+        return {"id": exp_id}
+
+    def list_experiments(r: ApiRequest):
+        return {"experiments": m.db.list_experiments()}
+
+    def get_experiment(r: ApiRequest):
+        row = m.db.get_experiment(int(r.groups[0]))
+        if row is None:
+            raise ApiError(404, "no such experiment")
+        live = m.get_experiment(int(r.groups[0]))
+        if live is not None:
+            row["state"] = live.state
+        return row
+
+    def exp_action(r: ApiRequest):
+        exp = m.get_experiment(int(r.groups[0]))
+        if exp is None:
+            raise ApiError(404, "no such experiment")
+        action = r.groups[1]
+        {"pause": exp.pause, "activate": exp.activate,
+         "cancel": exp.cancel, "kill": exp.kill}[action]()
+        return {"state": exp.state}
+
+    def list_trials(r: ApiRequest):
+        return {"trials": m.db.list_trials(int(r.groups[0]))}
+
+    def get_trial(r: ApiRequest):
+        row = m.db.get_trial(int(r.groups[0]))
+        if row is None:
+            raise ApiError(404, "no such trial")
+        return row
+
+    def trial_checkpoints(r: ApiRequest):
+        return {"checkpoints": m.db.list_checkpoints(int(r.groups[0]))}
+
+    def master_info(r: ApiRequest):
+        return {
+            "cluster_id": m.cluster_id,
+            "version": __import__("determined_tpu").__version__,
+            "agents": m.agent_hub.list(),
+        }
+
+    R = lambda method, pat, h: (method, re.compile(f"^{pat}$"), h)  # noqa: E731
+    return [
+        R("POST", r"/api/v1/trials/(\d+)/metrics", post_metrics),
+        R("GET", r"/api/v1/trials/(\d+)/metrics", get_metrics),
+        R("POST", r"/api/v1/trials/(\d+)/progress", post_progress),
+        R("POST", r"/api/v1/trials/(\d+)/status", post_status),
+        R("GET", r"/api/v1/trials/(\d+)/best_validation", best_validation),
+        R("GET", r"/api/v1/trials/(\d+)/searcher/operation", searcher_operation),
+        R("POST", r"/api/v1/trials/(\d+)/searcher/completed", searcher_completed),
+        R("POST", r"/api/v1/trials/(\d+)/searcher/progress", searcher_progress),
+        R("GET", r"/api/v1/trials/(\d+)/checkpoints", trial_checkpoints),
+        R("GET", r"/api/v1/trials/(\d+)", get_trial),
+        R("POST", r"/api/v1/checkpoints", post_checkpoint),
+        R("GET", r"/api/v1/checkpoints/([0-9a-f-]+)", get_checkpoint),
+        R("GET", r"/api/v1/allocations/([\w.\-]+)/signals/preemption", preemption_signal),
+        R("POST", r"/api/v1/allocations/([\w.\-]+)/signals/ack_preemption", ack_preemption),
+        R("POST", r"/api/v1/allocations/([\w.\-]+)/signals/preemption_from_task", preempt_from_task),
+        R("POST", r"/api/v1/allocations/([\w.\-]+)/rendezvous", rendezvous_arrive),
+        R("GET", r"/api/v1/allocations/([\w.\-]+)/rendezvous", rendezvous_info),
+        R("POST", r"/api/v1/allocations/([\w.\-]+)/allgather", allgather),
+        R("POST", r"/api/v1/task_logs", post_task_logs),
+        R("GET", r"/api/v1/task_logs", get_task_logs),
+        R("POST", r"/api/v1/agents", register_agent),
+        R("GET", r"/api/v1/agents/([\w.\-]+)/actions", agent_actions),
+        R("POST", r"/api/v1/agents/([\w.\-]+)/events", agent_events),
+        R("GET", r"/api/v1/agents", list_agents),
+        R("POST", r"/api/v1/experiments", create_experiment),
+        R("GET", r"/api/v1/experiments", list_experiments),
+        R("GET", r"/api/v1/experiments/(\d+)", get_experiment),
+        R("POST", r"/api/v1/experiments/(\d+)/(pause|activate|cancel|kill)", exp_action),
+        R("GET", r"/api/v1/experiments/(\d+)/trials", list_trials),
+        R("GET", r"/api/v1/master", master_info),
+    ]
+
+
+class ApiServer:
+    """HTTP front end; `serve_forever` in a daemon thread via start()."""
+
+    def __init__(self, master: Master, host: str = "127.0.0.1", port: int = 0) -> None:
+        routes = build_routes(master)
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt: str, *args: Any) -> None:
+                logger.debug("http: " + fmt, *args)
+
+            def _dispatch(self, method: str) -> None:
+                parsed = urlparse(self.path)
+                body: Dict[str, Any] = {}
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    try:
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                    except json.JSONDecodeError:
+                        self._send(400, {"error": "bad json"})
+                        return
+                for m_, pat, handler in routes:
+                    if m_ != method:
+                        continue
+                    match = pat.match(parsed.path)
+                    if match:
+                        try:
+                            result = handler(
+                                ApiRequest(match.groups(), body, parse_qs(parsed.query))
+                            )
+                            self._send(200, result if result is not None else {})
+                        except (BrokenPipeError, ConnectionResetError):
+                            # Long-poll client went away (e.g. task exited
+                            # mid-response); nothing to answer.
+                            pass
+                        except ApiError as e:
+                            self._send(e.status, {"error": str(e)})
+                        except KeyError as e:
+                            self._send(404, {"error": f"not found: {e}"})
+                        except Exception as e:  # noqa: BLE001
+                            logger.exception("handler error %s %s", method, parsed.path)
+                            self._send(500, {"error": str(e)})
+                        return
+                self._send(404, {"error": f"no route {method} {parsed.path}"})
+
+            def _send(self, status: int, payload: Dict[str, Any]) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self) -> None:  # noqa: N802
+                self._dispatch("GET")
+
+            def do_POST(self) -> None:  # noqa: N802
+                self._dispatch("POST")
+
+            def do_PATCH(self) -> None:  # noqa: N802
+                self._dispatch("PATCH")
+
+            def do_DELETE(self) -> None:  # noqa: N802
+                self._dispatch("DELETE")
+
+        class _Server(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):  # noqa: ANN001
+                import sys
+
+                exc = sys.exception()
+                if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+                    return  # client hung up mid-request (task exit); routine
+                super().handle_error(request, client_address)
+
+        self._httpd = _Server((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="api-server", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,289 @@
+"""Master: assembly of DB, RM, allocation service, agent hub, experiments.
+
+Rebuild of `master/internal/core.go:107` (Master.New/Run): one process owns
+persistence, scheduling, allocation lifecycle, and the experiment registry;
+the HTTP layer (api_server.py) is a thin router over this object.
+
+Agent protocol (replaces the reference's websocket `aproto`): agents
+register over REST, long-poll `/agents/{id}/actions` for START/KILL
+commands, and POST lifecycle events back — same message shapes as
+`aproto/{agent_message,master_message}.go`, REST-framed.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from determined_tpu import _info
+from determined_tpu.master import db as db_mod
+from determined_tpu.master.allocation import AllocationService
+from determined_tpu.master.experiment import Experiment, TrialRecord
+from determined_tpu.master.rm import ResourceManager
+from determined_tpu.master.scheduler import Request
+
+logger = logging.getLogger("determined_tpu.master")
+
+
+class AgentHub:
+    """Master-side agent registry + per-agent action queues (long-polled)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._agents: Dict[str, Dict[str, Any]] = {}
+        self._queues: Dict[str, List[Dict[str, Any]]] = {}
+
+    def register(self, agent_id: str, slots: int, pool: str) -> None:
+        with self._cond:
+            self._agents[agent_id] = {
+                "slots": slots, "pool": pool, "last_seen": time.time(),
+            }
+            self._queues.setdefault(agent_id, [])
+            self._cond.notify_all()
+
+    def enqueue(self, agent_id: str, action: Dict[str, Any]) -> None:
+        with self._cond:
+            self._queues.setdefault(agent_id, []).append(action)
+            self._cond.notify_all()
+
+    def poll(self, agent_id: str, timeout: float = 30.0) -> List[Dict[str, Any]]:
+        deadline = time.time() + timeout
+        with self._cond:
+            if agent_id in self._agents:
+                self._agents[agent_id]["last_seen"] = time.time()
+            while True:
+                q = self._queues.get(agent_id, [])
+                if q:
+                    self._queues[agent_id] = []
+                    return q
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return []
+                self._cond.wait(timeout=min(remaining, 5.0))
+
+    def list(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._agents.items()}
+
+
+class RMTrialLauncher:
+    """experiment.TrialLauncher backed by the RM + agent hub.
+
+    Ref: trial.go:283 maybeAllocateTask + task_trial.go TaskSpec building —
+    turns a trial record into an allocation request, and on placement into
+    per-host START actions carrying the DTPU_* env contract.
+    """
+
+    def __init__(self, master: "Master") -> None:
+        self.m = master
+
+    def launch(self, experiment: Experiment, rec: TrialRecord) -> None:
+        cfg = experiment.config
+        resources = cfg.get("resources", {})
+        slots = int(resources.get("slots_per_trial", 1))
+        alloc_id = f"{experiment.id}.{rec.trial_id}.{rec.run_id}"
+        task_id = f"trial-{rec.trial_id}"
+        request = Request(
+            alloc_id=alloc_id,
+            slots=slots,
+            priority=int(resources.get("priority", 50)),
+            weight=float(resources.get("weight", 1.0)),
+            group_id=str(experiment.id),
+            preemptible=True,
+        )
+        with self.m._lock:
+            self.m._alloc_index[alloc_id] = (experiment, rec.trial_id)
+            self.m._trial_allocs[rec.trial_id] = alloc_id
+
+        def on_start(req: Request, assignment: Dict[str, int]) -> None:
+            hosts = sorted(assignment)
+            self.m.alloc_service.create(
+                alloc_id, task_id=task_id, trial_id=rec.trial_id,
+                num_processes=len(hosts), slots=slots,
+            )
+            self.m.db.upsert_allocation(
+                alloc_id, task_id=task_id, trial_id=rec.trial_id,
+                state="ASSIGNED", slots=slots,
+            )
+            trial_row = self.m.db.get_trial(rec.trial_id) or {}
+            for rank, agent_id in enumerate(hosts):
+                info = _info.ClusterInfo(
+                    master_url=self.m.external_url,
+                    cluster_id=self.m.cluster_id,
+                    agent_id=agent_id,
+                    session_token="",
+                    task_id=task_id,
+                    allocation_id=alloc_id,
+                    task_type="TRIAL",
+                    trial=_info.TrialInfo(
+                        trial_id=rec.trial_id,
+                        experiment_id=experiment.id,
+                        trial_seed=rec.seed,
+                        hparams=rec.hparams,
+                        config=cfg,
+                        latest_checkpoint=trial_row.get("latest_checkpoint"),
+                        trial_run_id=rec.run_id,
+                    ),
+                    checkpoint_storage=cfg.get("checkpoint_storage"),
+                )
+                env = info.to_env()
+                env["DTPU_ALLOC_RANK"] = str(rank)
+                env["DTPU_ALLOC_NUM_PROCS"] = str(len(hosts))
+                env["DTPU_SLOTS"] = str(assignment[agent_id])
+                jax_platform = cfg.get("environment", {}).get("jax_platform")
+                if jax_platform:
+                    env["DTPU_JAX_PLATFORM"] = jax_platform
+                self.m.agent_hub.enqueue(
+                    agent_id,
+                    {
+                        "type": "START",
+                        "alloc_id": alloc_id,
+                        "task_id": task_id,
+                        "entrypoint": cfg.get("entrypoint", ""),
+                        "env": env,
+                    },
+                )
+
+        def on_preempt(a_id: str) -> None:
+            self.m.alloc_service.signal_preempt(a_id)
+
+        self.m.rm.pool(resources.get("resource_pool")).submit(
+            request, on_start, on_preempt
+        )
+
+    def _live_alloc(self, trial_id: int) -> Optional[str]:
+        with self.m._lock:
+            return self.m._trial_allocs.get(trial_id)
+
+    def preempt(self, trial_id: int) -> None:
+        alloc_id = self._live_alloc(trial_id)
+        if alloc_id is None:
+            return
+        alloc = self.m.alloc_service.get(alloc_id)
+        if alloc is None:
+            # Still queued: withdraw the request; the trial never started.
+            self.m.rm.pool().release(alloc_id)
+            exp, t_id = self.m._alloc_index.get(alloc_id, (None, None))
+            if exp is not None:
+                exp.trial_exited(t_id, 0, "preempted while pending")
+        else:
+            self.m.alloc_service.signal_preempt(alloc_id)
+
+    def kill(self, trial_id: int) -> None:
+        alloc_id = self._live_alloc(trial_id)
+        if alloc_id is None:
+            return
+        alloc = self.m.alloc_service.get(alloc_id)
+        if alloc is None:
+            self.m.rm.pool().release(alloc_id)
+            return
+        assignment = self.m.rm.pool().assignment_of(alloc_id) or {}
+        for agent_id in assignment:
+            self.m.agent_hub.enqueue(
+                agent_id, {"type": "KILL", "alloc_id": alloc_id}
+            )
+
+
+class Master:
+    def __init__(
+        self,
+        db_path: str = ":memory:",
+        pools_config: Optional[Dict[str, Dict]] = None,
+        external_url: str = "http://127.0.0.1:8080",
+        preempt_timeout_s: float = 600.0,
+    ) -> None:
+        self.cluster_id = uuid.uuid4().hex[:8]
+        self.external_url = external_url
+        self.db = db_mod.Database(db_path)
+        self.rm = ResourceManager(pools_config)
+        self.alloc_service = AllocationService(preempt_timeout_s=preempt_timeout_s)
+        self.agent_hub = AgentHub()
+        self.launcher = RMTrialLauncher(self)
+        self.experiments: Dict[int, Experiment] = {}
+        self._alloc_index: Dict[str, tuple] = {}   # alloc_id -> (exp, trial_id)
+        self._trial_allocs: Dict[int, str] = {}    # trial_id -> latest alloc_id
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.alloc_service.set_exit_hook(self._allocation_exited)
+        self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
+        self._ticker.start()
+
+    # -- background pump (replaces the actor system's message loop) ----------
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(1.0):
+            try:
+                self.rm.tick_all()
+                for alloc_id in self.alloc_service.overdue_preemptions():
+                    assignment = self.rm.pool().assignment_of(alloc_id) or {}
+                    for agent_id in assignment:
+                        self.agent_hub.enqueue(
+                            agent_id, {"type": "KILL", "alloc_id": alloc_id}
+                        )
+            except Exception:  # noqa: BLE001
+                logger.exception("tick loop error")
+
+    def shutdown(self) -> None:
+        self._stop.set()
+
+    # -- allocation exits ------------------------------------------------------
+    def _allocation_exited(self, alloc) -> None:
+        self.db.upsert_allocation(
+            alloc.id, state="TERMINATED", ended_at=time.time(),
+            exit_reason=alloc.exit_reason,
+        )
+        self.rm.pool().release(alloc.id)
+        with self._lock:
+            exp_trial = self._alloc_index.pop(alloc.id, None)
+            if exp_trial and self._trial_allocs.get(exp_trial[1]) == alloc.id:
+                del self._trial_allocs[exp_trial[1]]
+        if exp_trial:
+            exp, trial_id = exp_trial
+            exp.trial_exited(trial_id, alloc.exit_code or 0, alloc.exit_reason or "")
+
+    # -- experiments -----------------------------------------------------------
+    def create_experiment(self, config: Dict[str, Any]) -> int:
+        exp_id = self.db.add_experiment(config)
+        exp = Experiment(exp_id, config, self.db, self.launcher)
+        with self._lock:
+            self.experiments[exp_id] = exp
+        exp.start()
+        return exp_id
+
+    def get_experiment(self, exp_id: int) -> Optional[Experiment]:
+        with self._lock:
+            return self.experiments.get(exp_id)
+
+    def restore_experiments(self) -> int:
+        """Master-restart recovery (ref: restore.go:59 restoreExperiment)."""
+        n = 0
+        for row in self.db.list_experiments():
+            if row["state"] in db_mod.TERMINAL_STATES:
+                continue
+            exp = Experiment(row["id"], row["config"], self.db, self.launcher)
+            snapshot = row.get("searcher_snapshot")
+            trial_rows = self.db.list_trials(row["id"])
+            if snapshot:
+                exp.restore(snapshot, trial_rows)
+            else:
+                exp.start()
+            with self._lock:
+                self.experiments[row["id"]] = exp
+            if snapshot:
+                exp.relaunch_live_trials()
+            n += 1
+        return n
+
+    # -- agent events -----------------------------------------------------------
+    def agent_event(self, agent_id: str, event: Dict[str, Any]) -> None:
+        kind = event.get("type")
+        if kind == "EXITED":
+            self.alloc_service.complete(
+                event["alloc_id"],
+                exit_code=int(event.get("exit_code", 0)),
+                reason=event.get("reason", ""),
+            )
+        else:
+            logger.warning("unknown agent event %r from %s", kind, agent_id)

@@ -1,0 +1,355 @@
+"""Master persistence: SQLite.
+
+Rebuild of the reference's Postgres layer (`master/internal/db/postgres_*.go`,
+124 migration pairs) scaled to an embedded store: experiments, trials,
+metrics, checkpoints, task logs, allocations, and experiment snapshots (the
+crash-recovery payload, ref `db/postgres_snapshots.go`). SQLite in WAL mode
+is deliberate: a TPU-pod control plane is a single master process; the DB
+interface is thin enough to swap Postgres in behind the same methods later.
+
+Thread-safety: one connection per call site via `_conn()` (sqlite3 handles
+locking; WAL allows concurrent readers with one writer).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+SCHEMA = """
+CREATE TABLE IF NOT EXISTS experiments (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    state TEXT NOT NULL,
+    config TEXT NOT NULL,          -- experiment config (JSON)
+    searcher_snapshot TEXT,        -- crash-recovery searcher state (JSON)
+    progress REAL DEFAULT 0.0,
+    project_id INTEGER DEFAULT 1,
+    created_at REAL, updated_at REAL
+);
+CREATE TABLE IF NOT EXISTS trials (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    experiment_id INTEGER NOT NULL REFERENCES experiments(id),
+    request_id INTEGER NOT NULL,   -- searcher request id
+    state TEXT NOT NULL,
+    hparams TEXT NOT NULL,         -- JSON
+    seed INTEGER DEFAULT 0,
+    restarts INTEGER DEFAULT 0,
+    run_id INTEGER DEFAULT 0,      -- increments per restart
+    latest_checkpoint TEXT,        -- storage uuid
+    steps_completed INTEGER DEFAULT 0,
+    searcher_metric REAL,
+    created_at REAL, updated_at REAL
+);
+CREATE TABLE IF NOT EXISTS metrics (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    trial_id INTEGER NOT NULL REFERENCES trials(id),
+    grp TEXT NOT NULL,             -- training / validation / custom
+    steps_completed INTEGER NOT NULL,
+    trial_run_id INTEGER DEFAULT 0,
+    body TEXT NOT NULL,            -- JSON metrics dict
+    report_time REAL
+);
+CREATE INDEX IF NOT EXISTS metrics_trial ON metrics(trial_id, grp, steps_completed);
+CREATE TABLE IF NOT EXISTS checkpoints (
+    uuid TEXT PRIMARY KEY,
+    trial_id INTEGER,
+    task_id TEXT,
+    allocation_id TEXT,
+    state TEXT NOT NULL,           -- COMPLETED / DELETED
+    resources TEXT,                -- JSON list of files
+    metadata TEXT,                 -- JSON
+    steps_completed INTEGER DEFAULT 0,
+    report_time REAL
+);
+CREATE TABLE IF NOT EXISTS task_logs (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    task_id TEXT NOT NULL,
+    ts REAL,
+    level TEXT DEFAULT 'INFO',
+    log TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS task_logs_task ON task_logs(task_id, id);
+CREATE TABLE IF NOT EXISTS allocations (
+    id TEXT PRIMARY KEY,           -- allocation id
+    task_id TEXT,
+    trial_id INTEGER,
+    state TEXT NOT NULL,
+    slots INTEGER DEFAULT 0,
+    started_at REAL, ended_at REAL, exit_reason TEXT
+);
+"""
+
+# Experiment states (ref: master/pkg/model/experiment.go state machine).
+ACTIVE, PAUSED, STOPPING, COMPLETED, CANCELED, ERRORED = (
+    "ACTIVE", "PAUSED", "STOPPING", "COMPLETED", "CANCELED", "ERRORED",
+)
+TERMINAL_STATES = {COMPLETED, CANCELED, ERRORED}
+
+
+class Database:
+    def __init__(self, path: str = ":memory:") -> None:
+        self._path = path
+        self._local = threading.local()
+        self._memory_conn: Optional[sqlite3.Connection] = None
+        if path == ":memory:":
+            # in-memory DBs are per-connection; share one with a lock
+            self._memory_conn = sqlite3.connect(":memory:", check_same_thread=False)
+            self._memory_lock = threading.Lock()
+            self._memory_conn.executescript(SCHEMA)
+        else:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            conn = sqlite3.connect(path)
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.executescript(SCHEMA)
+            conn.commit()
+            conn.close()
+
+    def _conn(self) -> sqlite3.Connection:
+        if self._memory_conn is not None:
+            return self._memory_conn
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self._path, timeout=30.0)
+            conn.execute("PRAGMA journal_mode=WAL")
+            self._local.conn = conn
+        return conn
+
+    def _execute(self, sql: str, args: tuple = ()) -> sqlite3.Cursor:
+        if self._memory_conn is not None:
+            with self._memory_lock:
+                cur = self._memory_conn.execute(sql, args)
+                self._memory_conn.commit()
+                return cur
+        conn = self._conn()
+        cur = conn.execute(sql, args)
+        conn.commit()
+        return cur
+
+    def _query(self, sql: str, args: tuple = ()) -> List[sqlite3.Row]:
+        if self._memory_conn is not None:
+            with self._memory_lock:
+                self._memory_conn.row_factory = sqlite3.Row
+                return self._memory_conn.execute(sql, args).fetchall()
+        conn = self._conn()
+        conn.row_factory = sqlite3.Row
+        return conn.execute(sql, args).fetchall()
+
+    # -- experiments ---------------------------------------------------------
+    def add_experiment(self, config: Dict[str, Any], state: str = ACTIVE) -> int:
+        now = time.time()
+        cur = self._execute(
+            "INSERT INTO experiments (state, config, created_at, updated_at)"
+            " VALUES (?,?,?,?)",
+            (state, json.dumps(config), now, now),
+        )
+        return int(cur.lastrowid)
+
+    def get_experiment(self, exp_id: int) -> Optional[Dict[str, Any]]:
+        rows = self._query("SELECT * FROM experiments WHERE id=?", (exp_id,))
+        return self._exp_row(rows[0]) if rows else None
+
+    def list_experiments(self) -> List[Dict[str, Any]]:
+        return [self._exp_row(r) for r in self._query(
+            "SELECT * FROM experiments ORDER BY id")]
+
+    @staticmethod
+    def _exp_row(r: sqlite3.Row) -> Dict[str, Any]:
+        d = dict(r)
+        d["config"] = json.loads(d["config"])
+        if d.get("searcher_snapshot"):
+            d["searcher_snapshot"] = json.loads(d["searcher_snapshot"])
+        return d
+
+    def set_experiment_state(self, exp_id: int, state: str) -> None:
+        self._execute(
+            "UPDATE experiments SET state=?, updated_at=? WHERE id=?",
+            (state, time.time(), exp_id),
+        )
+
+    def set_experiment_progress(self, exp_id: int, progress: float) -> None:
+        self._execute(
+            "UPDATE experiments SET progress=?, updated_at=? WHERE id=?",
+            (progress, time.time(), exp_id),
+        )
+
+    def save_searcher_snapshot(self, exp_id: int, snapshot: Dict[str, Any]) -> None:
+        self._execute(
+            "UPDATE experiments SET searcher_snapshot=?, updated_at=? WHERE id=?",
+            (json.dumps(snapshot), time.time(), exp_id),
+        )
+
+    # -- trials --------------------------------------------------------------
+    def add_trial(
+        self, exp_id: int, request_id: int, hparams: Dict[str, Any],
+        seed: int = 0, state: str = ACTIVE,
+    ) -> int:
+        now = time.time()
+        cur = self._execute(
+            "INSERT INTO trials (experiment_id, request_id, state, hparams,"
+            " seed, created_at, updated_at) VALUES (?,?,?,?,?,?,?)",
+            (exp_id, request_id, state, json.dumps(hparams), seed, now, now),
+        )
+        return int(cur.lastrowid)
+
+    def get_trial(self, trial_id: int) -> Optional[Dict[str, Any]]:
+        rows = self._query("SELECT * FROM trials WHERE id=?", (trial_id,))
+        if not rows:
+            return None
+        d = dict(rows[0])
+        d["hparams"] = json.loads(d["hparams"])
+        return d
+
+    def list_trials(self, exp_id: int) -> List[Dict[str, Any]]:
+        out = []
+        for r in self._query(
+            "SELECT * FROM trials WHERE experiment_id=? ORDER BY id", (exp_id,)
+        ):
+            d = dict(r)
+            d["hparams"] = json.loads(d["hparams"])
+            out.append(d)
+        return out
+
+    def update_trial(self, trial_id: int, **fields: Any) -> None:
+        allowed = {
+            "state", "restarts", "run_id", "latest_checkpoint",
+            "steps_completed", "searcher_metric",
+        }
+        sets, args = [], []
+        for k, v in fields.items():
+            if k not in allowed:
+                raise ValueError(f"bad trial field {k}")
+            sets.append(f"{k}=?")
+            args.append(v)
+        sets.append("updated_at=?")
+        args.append(time.time())
+        args.append(trial_id)
+        self._execute(f"UPDATE trials SET {', '.join(sets)} WHERE id=?", tuple(args))
+
+    # -- metrics -------------------------------------------------------------
+    def add_metrics(
+        self, trial_id: int, group: str, steps_completed: int,
+        body: Dict[str, Any], trial_run_id: int = 0, report_time: Optional[float] = None,
+    ) -> None:
+        self._execute(
+            "INSERT INTO metrics (trial_id, grp, steps_completed, trial_run_id,"
+            " body, report_time) VALUES (?,?,?,?,?,?)",
+            (
+                trial_id, group, steps_completed, trial_run_id,
+                json.dumps(body), report_time or time.time(),
+            ),
+        )
+
+    def get_metrics(
+        self, trial_id: int, group: Optional[str] = None
+    ) -> List[Dict[str, Any]]:
+        sql = "SELECT * FROM metrics WHERE trial_id=?"
+        args: tuple = (trial_id,)
+        if group:
+            sql += " AND grp=?"
+            args += (group,)
+        sql += " ORDER BY id"
+        out = []
+        for r in self._query(sql, args):
+            d = dict(r)
+            d["body"] = json.loads(d["body"])
+            out.append(d)
+        return out
+
+    def best_validation(
+        self, trial_id: int, metric: str, smaller_is_better: bool = True
+    ) -> Optional[float]:
+        vals = [
+            m["body"].get(metric)
+            for m in self.get_metrics(trial_id, "validation")
+            if m["body"].get(metric) is not None
+        ]
+        if not vals:
+            return None
+        return min(vals) if smaller_is_better else max(vals)
+
+    # -- checkpoints ----------------------------------------------------------
+    def add_checkpoint(
+        self, uuid: str, *, trial_id: Optional[int], task_id: str,
+        allocation_id: str, resources: List[str], metadata: Dict[str, Any],
+        state: str = "COMPLETED",
+    ) -> None:
+        self._execute(
+            "INSERT OR REPLACE INTO checkpoints (uuid, trial_id, task_id,"
+            " allocation_id, state, resources, metadata, steps_completed,"
+            " report_time) VALUES (?,?,?,?,?,?,?,?,?)",
+            (
+                uuid, trial_id, task_id, allocation_id, state,
+                json.dumps(resources), json.dumps(metadata),
+                int(metadata.get("steps_completed", 0)), time.time(),
+            ),
+        )
+
+    def get_checkpoint(self, uuid: str) -> Optional[Dict[str, Any]]:
+        rows = self._query("SELECT * FROM checkpoints WHERE uuid=?", (uuid,))
+        if not rows:
+            return None
+        d = dict(rows[0])
+        d["resources"] = json.loads(d["resources"] or "[]")
+        d["metadata"] = json.loads(d["metadata"] or "{}")
+        return d
+
+    def list_checkpoints(self, trial_id: int) -> List[Dict[str, Any]]:
+        return [
+            self.get_checkpoint(r["uuid"])
+            for r in self._query(
+                "SELECT uuid FROM checkpoints WHERE trial_id=? AND state='COMPLETED'"
+                " ORDER BY steps_completed", (trial_id,),
+            )
+        ]
+
+    def mark_checkpoint_deleted(self, uuid: str) -> None:
+        self._execute("UPDATE checkpoints SET state='DELETED' WHERE uuid=?", (uuid,))
+
+    # -- task logs -------------------------------------------------------------
+    def add_task_logs(self, task_id: str, lines: List[Dict[str, Any]]) -> None:
+        for line in lines:
+            self._execute(
+                "INSERT INTO task_logs (task_id, ts, level, log) VALUES (?,?,?,?)",
+                (
+                    task_id, line.get("ts", time.time()),
+                    line.get("level", "INFO"), line["log"],
+                ),
+            )
+
+    def get_task_logs(self, task_id: str, after_id: int = 0, limit: int = 1000) -> List[Dict[str, Any]]:
+        return [
+            dict(r)
+            for r in self._query(
+                "SELECT * FROM task_logs WHERE task_id=? AND id>? ORDER BY id LIMIT ?",
+                (task_id, after_id, limit),
+            )
+        ]
+
+    # -- allocations ------------------------------------------------------------
+    def upsert_allocation(self, alloc_id: str, **fields: Any) -> None:
+        existing = self._query("SELECT id FROM allocations WHERE id=?", (alloc_id,))
+        if not existing:
+            self._execute(
+                "INSERT INTO allocations (id, task_id, trial_id, state, slots,"
+                " started_at) VALUES (?,?,?,?,?,?)",
+                (
+                    alloc_id, fields.get("task_id"), fields.get("trial_id"),
+                    fields.get("state", "PENDING"), fields.get("slots", 0),
+                    time.time(),
+                ),
+            )
+        else:
+            sets, args = [], []
+            for k in ("state", "ended_at", "exit_reason"):
+                if k in fields:
+                    sets.append(f"{k}=?")
+                    args.append(fields[k])
+            if sets:
+                args.append(alloc_id)
+                self._execute(
+                    f"UPDATE allocations SET {', '.join(sets)} WHERE id=?",
+                    tuple(args),
+                )

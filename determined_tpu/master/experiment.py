@@ -1,0 +1,339 @@
+"""Experiment + trial state machines.
+
+Rebuild of `master/internal/experiment.go:103` (experiment actor: drives the
+searcher, spawns trials, snapshots for crash recovery) and
+`internal/trial.go:53` (trial actor: allocation requests, restart budget).
+The actor mailboxes become a single lock + condition per experiment — the
+direction the reference itself was migrating (plain services over actors).
+
+Flow (ref call stack SURVEY.md §3.1/§3.4):
+- create → searcher.initial_operations → Create ops become trial rows +
+  launcher.launch calls;
+- the trial harness long-polls `current_searcher_op` (ValidateAfter target),
+  trains to it, then `op_completed(metric)` feeds the searcher, whose new
+  ops route back to trials;
+- trial exits: clean+closed → searcher.trial_closed; failure → restart up
+  to max_restarts (run_id++, resume from latest checkpoint), then
+  searcher.trial_exited_early;
+- every searcher event is snapshotted to the DB (crash recovery, ref
+  restore.go:59).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Protocol
+
+from determined_tpu.master import db as db_mod
+from determined_tpu.searcher import Close, Create, Shutdown, ValidateAfter, make_searcher
+
+logger = logging.getLogger("determined_tpu.master")
+
+
+class TrialLauncher(Protocol):
+    """How trials become running processes (wired by the Master to the RM)."""
+
+    def launch(self, experiment: "Experiment", trial: "TrialRecord") -> None: ...
+    def preempt(self, trial_id: int) -> None: ...
+    def kill(self, trial_id: int) -> None: ...
+
+
+@dataclasses.dataclass
+class TrialRecord:
+    trial_id: int
+    request_id: int
+    hparams: Dict[str, Any]
+    seed: int
+    state: str = db_mod.ACTIVE
+    target_length: int = 0        # max ValidateAfter length issued so far
+    completed_length: int = 0
+    close_requested: bool = False
+    exited: bool = False
+    restarts: int = 0
+    run_id: int = 0
+
+
+class Experiment:
+    def __init__(
+        self,
+        exp_id: int,
+        config: Dict[str, Any],
+        database: db_mod.Database,
+        launcher: TrialLauncher,
+    ) -> None:
+        self.id = exp_id
+        self.config = config
+        self.db = database
+        self.launcher = launcher
+        self.state = db_mod.ACTIVE
+        self.max_restarts = int(config.get("max_restarts", 5))
+        self.searcher = make_searcher(
+            config.get("searcher", {"name": "single", "max_length": 1}),
+            config.get("hyperparameters", {}),
+            seed=int(config.get("reproducibility", {}).get("experiment_seed", 0)),
+        )
+        self.trials: Dict[int, TrialRecord] = {}          # trial_id -> record
+        self._by_request: Dict[int, int] = {}             # request_id -> trial_id
+        self._cancel_requested = False
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        with self._cond:
+            self._process_ops(self.searcher.initial_operations())
+            self._snapshot()
+
+    def restore(self, snapshot: Dict[str, Any], trial_rows: List[Dict[str, Any]]) -> None:
+        """Crash recovery: rebuild searcher + trial records from the DB."""
+        with self._cond:
+            self.searcher.restore(snapshot)
+            for row in trial_rows:
+                rec = TrialRecord(
+                    trial_id=row["id"],
+                    request_id=row["request_id"],
+                    hparams=row["hparams"],
+                    seed=row["seed"],
+                    state=row["state"],
+                    completed_length=row["steps_completed"],
+                    restarts=row["restarts"],
+                    run_id=row["run_id"],
+                    exited=row["state"] in db_mod.TERMINAL_STATES,
+                )
+                self.trials[rec.trial_id] = rec
+                self._by_request[rec.request_id] = rec.trial_id
+            # In-flight ValidateAfter/Close ops are not persisted; re-derive
+            # each live trial's goal from the restored searcher state.
+            for rec in self.trials.values():
+                if rec.exited:
+                    continue
+                target = self.searcher.method.current_target(rec.request_id)
+                if target is None or rec.completed_length >= target:
+                    # No further work (or the trial already trained to its
+                    # final target and only the Close was lost in the crash).
+                    rec.close_requested = True
+                else:
+                    rec.target_length = target
+
+    def relaunch_live_trials(self) -> None:
+        """After restore: put every non-terminal trial back in flight."""
+        for rec in self.trials.values():
+            if not rec.exited:
+                rec.run_id += 1
+                self.db.update_trial(rec.trial_id, run_id=rec.run_id)
+                self.launcher.launch(self, rec)
+
+    # -- op processing (ref: experiment.go:662 processOperations) -------------
+    def _process_ops(self, ops: List[Any]) -> None:
+        """Route searcher operations. Caller holds the lock."""
+        for op in ops:
+            if isinstance(op, Create):
+                trial_id = self.db.add_trial(
+                    self.id, op.request_id, op.hparams, seed=op.seed
+                )
+                rec = TrialRecord(
+                    trial_id=trial_id,
+                    request_id=op.request_id,
+                    hparams=op.hparams,
+                    seed=op.seed,
+                )
+                self.trials[trial_id] = rec
+                self._by_request[op.request_id] = trial_id
+                self._process_ops(self.searcher.trial_created(op.request_id))
+                if self.state == db_mod.ACTIVE:
+                    self.launcher.launch(self, rec)
+            elif isinstance(op, ValidateAfter):
+                rec = self._rec(op.request_id)
+                rec.target_length = max(rec.target_length, op.length)
+                self._cond.notify_all()
+            elif isinstance(op, Close):
+                rec = self._rec(op.request_id)
+                rec.close_requested = True
+                self._cond.notify_all()
+            elif isinstance(op, Shutdown):
+                # Searcher is done creating work; experiment finishes when
+                # trials drain (checked in _maybe_finish).
+                pass
+        self._maybe_finish()
+
+    def _rec(self, request_id: int) -> TrialRecord:
+        return self.trials[self._by_request[request_id]]
+
+    def _snapshot(self) -> None:
+        self.db.save_searcher_snapshot(self.id, self.searcher.snapshot())
+        self.db.set_experiment_progress(self.id, self.searcher.progress())
+
+    def _maybe_finish(self) -> None:
+        if self.state not in (db_mod.ACTIVE, db_mod.STOPPING):
+            return
+        if not self.searcher.shutdown:
+            return
+        if any(not r.exited for r in self.trials.values()):
+            return
+        errored = [r for r in self.trials.values() if r.state == db_mod.ERRORED]
+        self.state = (
+            db_mod.ERRORED
+            if len(errored) == len(self.trials) and self.trials
+            else db_mod.COMPLETED
+        )
+        self.db.set_experiment_state(self.id, self.state)
+        self._cond.notify_all()
+
+    # -- harness-facing API (called from HTTP request threads) -----------------
+    def current_searcher_op(
+        self, trial_id: int, timeout: float = 60.0
+    ) -> Dict[str, Any]:
+        """Long-poll the trial's current target (ref: api.proto:971)."""
+        import time
+
+        deadline = time.time() + timeout
+        with self._cond:
+            while True:
+                rec = self.trials[trial_id]
+                if rec.close_requested or self.state in db_mod.TERMINAL_STATES:
+                    return {"completed": True, "op": None}
+                if rec.target_length > rec.completed_length:
+                    return {"op": {"length": rec.target_length}, "completed": False}
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    # no new work yet; harness polls again
+                    return {"op": None, "completed": False}
+                self._cond.wait(timeout=min(remaining, 5.0))
+
+    def op_completed(self, trial_id: int, length: int, metric: float) -> None:
+        """Chief reported the searcher metric at `length` (ref: api.proto:982)."""
+        with self._cond:
+            rec = self.trials[trial_id]
+            rec.completed_length = max(rec.completed_length, length)
+            self.db.update_trial(
+                trial_id, steps_completed=rec.completed_length, searcher_metric=metric
+            )
+            self._process_ops(
+                self.searcher.validation_completed(rec.request_id, metric, length)
+            )
+            self._snapshot()
+
+    def report_progress(self, trial_id: int, progress: float) -> None:
+        del trial_id, progress  # experiment progress derives from the searcher
+        self.db.set_experiment_progress(self.id, self.searcher.progress())
+
+    def trial_exited(self, trial_id: int, exit_code: int, reason: str = "") -> None:
+        """Allocation for this trial ended (ref: trial.go:458 allocationExited)."""
+        with self._cond:
+            rec = self.trials[trial_id]
+            if rec.exited:
+                return
+            clean = exit_code == 0
+            if self._cancel_requested:
+                rec.exited = True
+                rec.state = db_mod.CANCELED
+                self.db.update_trial(trial_id, state=db_mod.CANCELED)
+                if all(r.exited for r in self.trials.values()):
+                    self.state = db_mod.CANCELED
+                    self.db.set_experiment_state(self.id, self.state)
+                self._cond.notify_all()
+                return
+            if clean and (rec.close_requested or self.state == db_mod.STOPPING):
+                rec.exited = True
+                rec.state = db_mod.COMPLETED
+                self.db.update_trial(trial_id, state=db_mod.COMPLETED)
+                self._process_ops(self.searcher.trial_closed(rec.request_id))
+            elif clean and self.state == db_mod.PAUSED:
+                pass  # preempted by pause; relaunched on activate
+            elif not clean and rec.restarts < self.max_restarts:
+                rec.restarts += 1
+                rec.run_id += 1
+                self.db.update_trial(
+                    trial_id, restarts=rec.restarts, run_id=rec.run_id
+                )
+                logger.info(
+                    "trial %d restart %d/%d", trial_id, rec.restarts, self.max_restarts
+                )
+                if self.state == db_mod.ACTIVE:
+                    self.launcher.launch(self, rec)
+            elif clean:
+                # Exited 0 without close_requested (e.g. single-op dummy or
+                # user returned early): treat as closed.
+                rec.exited = True
+                rec.state = db_mod.COMPLETED
+                self.db.update_trial(trial_id, state=db_mod.COMPLETED)
+                self._process_ops(self.searcher.trial_closed(rec.request_id))
+            else:
+                rec.exited = True
+                rec.state = db_mod.ERRORED
+                self.db.update_trial(trial_id, state=db_mod.ERRORED)
+                logger.warning("trial %d errored: %s", trial_id, reason)
+                self._process_ops(
+                    self.searcher.trial_exited_early(rec.request_id, reason)
+                )
+            self._snapshot()
+
+    # -- user controls (ref: api_experiment.go activate/pause/cancel/kill) -----
+    def pause(self) -> None:
+        with self._cond:
+            if self.state != db_mod.ACTIVE:
+                return
+            self.state = db_mod.PAUSED
+            self.db.set_experiment_state(self.id, self.state)
+        for rec in self.trials.values():
+            if not rec.exited:
+                self.launcher.preempt(rec.trial_id)
+
+    def activate(self) -> None:
+        with self._cond:
+            if self.state != db_mod.PAUSED:
+                return
+            self.state = db_mod.ACTIVE
+            self.db.set_experiment_state(self.id, self.state)
+            live = [r for r in self.trials.values() if not r.exited]
+        for rec in live:
+            rec.run_id += 1
+            self.db.update_trial(rec.trial_id, run_id=rec.run_id)
+            self.launcher.launch(self, rec)
+
+    def cancel(self) -> None:
+        """Graceful stop: preempt everything, mark CANCELED when drained."""
+        with self._cond:
+            if self.state in db_mod.TERMINAL_STATES:
+                return
+            self.state = db_mod.STOPPING
+            self._cancel_requested = True
+            live = [r for r in self.trials.values() if not r.exited]
+            if not live:
+                self.state = db_mod.CANCELED
+                self.db.set_experiment_state(self.id, self.state)
+                self._cond.notify_all()
+                return
+        for rec in live:
+            self.launcher.preempt(rec.trial_id)
+
+    def kill(self) -> None:
+        with self._cond:
+            if self.state in db_mod.TERMINAL_STATES:
+                return
+            self.state = db_mod.STOPPING
+            live = [r for r in self.trials.values() if not r.exited]
+        for rec in live:
+            self.launcher.kill(rec.trial_id)
+        with self._cond:
+            for rec in self.trials.values():
+                if not rec.exited:
+                    rec.exited = True
+                    rec.state = db_mod.CANCELED
+                    self.db.update_trial(rec.trial_id, state=db_mod.CANCELED)
+            self.state = db_mod.CANCELED
+            self.db.set_experiment_state(self.id, self.state)
+            self._cond.notify_all()
+
+    def wait_done(self, timeout: Optional[float] = None) -> str:
+        import time
+
+        deadline = None if timeout is None else time.time() + timeout
+        with self._cond:
+            while self.state not in db_mod.TERMINAL_STATES:
+                remaining = None if deadline is None else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    break
+                self._cond.wait(timeout=remaining if remaining else 5.0)
+            return self.state

@@ -1,0 +1,57 @@
+"""Master daemon entrypoint: `python -m determined_tpu.master.main`.
+
+Rebuild of `determined-master` (master/cmd): bring up DB + RM + API server,
+restore non-terminal experiments from the DB (crash recovery,
+ref restore.go:59), serve until signaled.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import threading
+
+from determined_tpu.master.api_server import ApiServer
+from determined_tpu.master.core import Master
+
+logger = logging.getLogger("determined_tpu.master")
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="determined_tpu master")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--db", default="dtpu_master.db",
+                        help="sqlite path (':memory:' for ephemeral)")
+    parser.add_argument("--external-url", default=None,
+                        help="URL agents/tasks use to reach this master")
+    parser.add_argument("--pools", default=None,
+                        help='JSON pools config, e.g. {"default":{"scheduler":{"type":"priority"}}}')
+    parser.add_argument("--preempt-timeout", type=float, default=600.0)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    pools = json.loads(args.pools) if args.pools else None
+    master = Master(
+        db_path=args.db, pools_config=pools,
+        preempt_timeout_s=args.preempt_timeout,
+    )
+    api = ApiServer(master, host=args.host, port=args.port)
+    master.external_url = args.external_url or f"http://127.0.0.1:{api.port}"
+    restored = master.restore_experiments()
+    if restored:
+        logger.info("restored %d experiment(s)", restored)
+    api.start()
+    logger.info("master listening on %s (cluster %s)", api.url, master.cluster_id)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda s, f: stop.set())
+    signal.signal(signal.SIGINT, lambda s, f: stop.set())
+    stop.wait()
+    api.stop()
+    master.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,156 @@
+"""Resource manager: pools of agents + scheduler application.
+
+Rebuild of `internal/rm/agentrm/resource_pool.go:113` (allocateRequest /
+allocateResources / Receive): a pool owns agents and a pending queue; every
+`tick()` runs the scheduler and applies its decision — start callbacks fire
+for newly-placed gangs, preempt callbacks for victims. Ticks run after any
+state change (submit/release/agent join) plus on a timer owned by the
+Master (replacing the actor message pump).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Callable, Dict, List, Optional
+
+from determined_tpu.master.scheduler import (
+    Agent,
+    Assignment,
+    Decision,
+    PoolState,
+    Request,
+    make_scheduler,
+)
+
+logger = logging.getLogger("determined_tpu.master")
+
+StartCb = Callable[[Request, Assignment], None]
+PreemptCb = Callable[[str], None]
+
+
+@dataclasses.dataclass
+class _Entry:
+    request: Request
+    on_start: StartCb
+    on_preempt: PreemptCb
+
+
+class ResourcePool:
+    def __init__(self, name: str = "default", scheduler_config: Optional[Dict] = None) -> None:
+        self.name = name
+        self.scheduler = make_scheduler(scheduler_config)
+        self._agents: Dict[str, Agent] = {}
+        self._entries: Dict[str, _Entry] = {}           # alloc_id -> entry
+        self._pending: List[str] = []                   # alloc_ids
+        self._running: Dict[str, Assignment] = {}       # alloc_id -> placement
+        self._order = 0
+        self._lock = threading.Lock()
+
+    # -- agents --------------------------------------------------------------
+    def add_agent(self, agent_id: str, slots: int) -> None:
+        with self._lock:
+            self._agents[agent_id] = Agent(agent_id, slots)
+        self.tick()
+
+    def remove_agent(self, agent_id: str) -> List[str]:
+        """Returns alloc_ids that lost resources (caller fails them over)."""
+        with self._lock:
+            agent = self._agents.pop(agent_id, None)
+            victims = list(agent.used) if agent else []
+        for alloc_id in victims:
+            self.release(alloc_id)
+        return victims
+
+    def agents_snapshot(self) -> Dict[str, Dict]:
+        with self._lock:
+            return {
+                a.id: {"slots": a.slots, "used": sum(a.used.values()),
+                       "enabled": a.enabled}
+                for a in self._agents.values()
+            }
+
+    # -- requests ------------------------------------------------------------
+    def submit(
+        self, request: Request, on_start: StartCb, on_preempt: PreemptCb
+    ) -> None:
+        with self._lock:
+            self._order += 1
+            request.order = self._order
+            self._entries[request.alloc_id] = _Entry(request, on_start, on_preempt)
+            self._pending.append(request.alloc_id)
+        self.tick()
+
+    def release(self, alloc_id: str) -> None:
+        """Free resources (allocation exited or was canceled while pending)."""
+        with self._lock:
+            self._entries.pop(alloc_id, None)
+            if alloc_id in self._pending:
+                self._pending.remove(alloc_id)
+            self._running.pop(alloc_id, None)
+            for agent in self._agents.values():
+                agent.used.pop(alloc_id, None)
+        self.tick()
+
+    def assignment_of(self, alloc_id: str) -> Optional[Assignment]:
+        with self._lock:
+            return dict(self._running.get(alloc_id, {})) or None
+
+    # -- scheduling ----------------------------------------------------------
+    def tick(self) -> None:
+        to_fire: List = []
+        with self._lock:
+            state = PoolState(
+                agents=self._agents,
+                pending=[self._entries[a].request for a in self._pending
+                         if a in self._entries],
+                running={a: self._entries[a].request for a in self._running
+                         if a in self._entries},
+                assignments=self._running,
+            )
+            decision: Decision = self.scheduler.schedule(state)
+            for req, asg in decision.to_start:
+                if req.alloc_id not in self._pending:
+                    continue
+                self._pending.remove(req.alloc_id)
+                self._running[req.alloc_id] = asg
+                for agent_id, n in asg.items():
+                    self._agents[agent_id].used[req.alloc_id] = n
+                to_fire.append(("start", self._entries[req.alloc_id], asg))
+            for alloc_id in decision.to_preempt:
+                entry = self._entries.get(alloc_id)
+                if entry is not None:
+                    to_fire.append(("preempt", entry, None))
+        # Callbacks outside the lock: they reach into allocation/agent layers.
+        for kind, entry, asg in to_fire:
+            try:
+                if kind == "start":
+                    entry.on_start(entry.request, asg)
+                else:
+                    entry.on_preempt(entry.request.alloc_id)
+            except Exception:  # noqa: BLE001
+                logger.exception("%s callback failed for %s", kind, entry.request.alloc_id)
+
+    # -- introspection --------------------------------------------------------
+    def queue_snapshot(self) -> Dict[str, List[str]]:
+        with self._lock:
+            return {"pending": list(self._pending), "running": list(self._running)}
+
+
+class ResourceManager:
+    """Named pools (ref: resource_manager_iface.go, one iface over backends)."""
+
+    def __init__(self, pools_config: Optional[Dict[str, Dict]] = None) -> None:
+        cfgs = pools_config or {"default": {}}
+        self.pools: Dict[str, ResourcePool] = {
+            name: ResourcePool(name, cfg.get("scheduler")) for name, cfg in cfgs.items()
+        }
+
+    def pool(self, name: Optional[str] = None) -> ResourcePool:
+        if not name:
+            name = "default" if "default" in self.pools else next(iter(self.pools))
+        return self.pools[name]
+
+    def tick_all(self) -> None:
+        for pool in self.pools.values():
+            pool.tick()

@@ -1,0 +1,287 @@
+"""Schedulers + slot fitting for resource pools.
+
+Rebuild of `internal/rm/agentrm/{scheduler.go:17,fair_share.go:54,
+priority.go:19,round_robin.go,fitting.go:23}` with TPU gang semantics:
+
+- a *slot* is one TPU chip; an *agent* is one TPU host (VM);
+- allocations are gangs — a request for N slots is satisfied all-or-nothing
+  (a pjit program needs its whole mesh);
+- multi-host fits require whole idle hosts (a multi-host TPU slice uses
+  every chip on each of its hosts — unlike fungible GPU slots, partial
+  hosts can't join a slice), and uniform slots-per-host;
+- preemption is checkpoint-and-requeue (priority scheduler), which maps
+  exactly onto preemptible TPU slices.
+
+Schedulers are pure: `schedule()` takes the pool state and returns
+(assignments, preemptions); the RM applies them. That keeps every policy
+property-testable without agents or a master (the reference tests its
+schedulers the same way: fair_share_test.go etc.).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class Agent:
+    id: str
+    slots: int
+    enabled: bool = True
+    # alloc_id -> slots in use on this agent
+    used: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    @property
+    def free(self) -> int:
+        return self.slots - sum(self.used.values()) if self.enabled else 0
+
+    @property
+    def idle(self) -> bool:
+        return self.enabled and not self.used
+
+
+@dataclasses.dataclass
+class Request:
+    """A pending or running allocation request."""
+
+    alloc_id: str
+    slots: int
+    priority: int = 50          # lower number = more important (ref: priority.go)
+    weight: float = 1.0         # fair-share weight (per experiment/job)
+    group_id: str = ""          # fair-share group (experiment id)
+    preemptible: bool = True
+    order: int = 0              # FIFO arrival order
+
+
+Assignment = Dict[str, int]  # agent_id -> slots
+
+
+@dataclasses.dataclass
+class PoolState:
+    agents: Dict[str, Agent]
+    pending: List[Request]
+    running: Dict[str, Request]          # alloc_id -> request
+    assignments: Dict[str, Assignment]   # alloc_id -> placement
+
+
+@dataclasses.dataclass
+class Decision:
+    to_start: List[Tuple[Request, Assignment]]
+    to_preempt: List[str]  # alloc_ids
+
+
+# ---------------------------------------------------------------------------
+# Fitting (ref: fitting.go / fitting_methods.go best-fit)
+# ---------------------------------------------------------------------------
+def fit(request_slots: int, agents: Dict[str, Agent]) -> Optional[Assignment]:
+    """Place a gang of `request_slots` chips; None if it doesn't fit."""
+    if request_slots == 0:
+        # Zero-slot (aux/CPU) tasks land on the least-loaded agent.
+        candidates = [a for a in agents.values() if a.enabled]
+        if not candidates:
+            return None
+        best = max(candidates, key=lambda a: a.free)
+        return {best.id: 0}
+
+    # Single-host best-fit: the enabled agent with the least leftover room.
+    single = [a for a in agents.values() if a.free >= request_slots]
+    if single:
+        best = min(single, key=lambda a: a.free - request_slots)
+        return {best.id: request_slots}
+
+    # Multi-host: whole idle hosts, uniform slots per host.
+    idle = sorted((a for a in agents.values() if a.idle), key=lambda a: a.id)
+    if not idle:
+        return None
+    per_host = idle[0].slots
+    if any(a.slots != per_host for a in idle) or per_host == 0:
+        return None  # heterogeneous pools can't host a slice
+    if request_slots % per_host != 0:
+        return None
+    n_hosts = request_slots // per_host
+    if n_hosts > len(idle):
+        return None
+    return {a.id: per_host for a in idle[:n_hosts]}
+
+
+def _apply(agents: Dict[str, Agent], alloc_id: str, asg: Assignment) -> None:
+    for agent_id, n in asg.items():
+        agents[agent_id].used[alloc_id] = n
+
+
+def _release(agents: Dict[str, Agent], alloc_id: str) -> None:
+    for a in agents.values():
+        a.used.pop(alloc_id, None)
+
+
+def _clone_agents(agents: Dict[str, Agent]) -> Dict[str, Agent]:
+    return {
+        k: Agent(a.id, a.slots, a.enabled, dict(a.used)) for k, a in agents.items()
+    }
+
+
+# ---------------------------------------------------------------------------
+# Schedulers
+# ---------------------------------------------------------------------------
+class FifoScheduler:
+    """Strict arrival order; a gang that can't fit blocks everything behind
+    it (predictable, the reference's round_robin analog for gangs)."""
+
+    def schedule(self, pool: PoolState) -> Decision:
+        agents = _clone_agents(pool.agents)
+        to_start: List[Tuple[Request, Assignment]] = []
+        for req in sorted(pool.pending, key=lambda r: r.order):
+            asg = fit(req.slots, agents)
+            if asg is None:
+                break
+            _apply(agents, req.alloc_id, asg)
+            to_start.append((req, asg))
+        return Decision(to_start, [])
+
+
+class PriorityScheduler:
+    """Priority with optional preemption (ref: priority.go:32,201).
+
+    Pending requests are served in (priority, order). If `preemption` is on
+    and a pending request can't fit, running allocations of strictly lower
+    importance (higher number) are preempted lowest-first until it fits.
+    """
+
+    def __init__(self, preemption: bool = True) -> None:
+        self.preemption = preemption
+
+    def schedule(self, pool: PoolState) -> Decision:
+        agents = _clone_agents(pool.agents)
+        to_start: List[Tuple[Request, Assignment]] = []
+        to_preempt: List[str] = []
+
+        for req in sorted(pool.pending, key=lambda r: (r.priority, r.order)):
+            asg = fit(req.slots, agents)
+            if asg is None and self.preemption:
+                # Victims: preemptible, strictly less important, largest
+                # priority number first, newest first.
+                victims = sorted(
+                    (
+                        r for r in pool.running.values()
+                        if r.preemptible
+                        and r.priority > req.priority
+                        and r.alloc_id not in to_preempt
+                    ),
+                    key=lambda r: (-r.priority, -r.order),
+                )
+                planned: List[str] = []
+                for v in victims:
+                    planned.append(v.alloc_id)
+                    _release(agents, v.alloc_id)
+                    asg = fit(req.slots, agents)
+                    if asg is not None:
+                        break
+                if asg is None:
+                    # Even preempting everything eligible doesn't help; undo.
+                    for v_id in planned:
+                        _apply(agents, v_id, pool.assignments[v_id])
+                    continue
+                to_preempt.extend(planned)
+                # Preempted slots free asynchronously (checkpoint first), so
+                # don't also start the new gang this tick — it starts next
+                # tick once the slots are actually free.
+                continue
+            if asg is None:
+                continue
+            _apply(agents, req.alloc_id, asg)
+            to_start.append((req, asg))
+        return Decision(to_start, to_preempt)
+
+
+class FairShareScheduler:
+    """Weighted fair share over groups (ref: fair_share.go:54).
+
+    Each group's fair slot share = total_slots * weight / sum(weights),
+    iteratively redistributing unused share. Groups above their share get
+    preempted (newest allocations first); groups below get pending requests
+    started in arrival order.
+    """
+
+    def schedule(self, pool: PoolState) -> Decision:
+        total_slots = sum(a.slots for a in pool.agents.values() if a.enabled)
+        groups: Dict[str, List[Request]] = {}
+        for r in list(pool.running.values()) + pool.pending:
+            groups.setdefault(r.group_id, []).append(r)
+        if not groups:
+            return Decision([], [])
+
+        # Iterative water-filling: groups wanting less than their share cede
+        # the remainder to the others.
+        demand = {
+            g: sum(r.slots for r in rs) for g, rs in groups.items()
+        }
+        weight = {
+            g: max((r.weight for r in rs), default=1.0) for g, rs in groups.items()
+        }
+        share: Dict[str, int] = {g: 0 for g in groups}
+        remaining, active = total_slots, set(groups)
+        while remaining > 0 and active:
+            wsum = sum(weight[g] for g in active)
+            gave = 0
+            for g in sorted(active):
+                s = int(remaining * weight[g] / wsum)
+                take = min(s, demand[g] - share[g])
+                share[g] += take
+                gave += take
+            for g in list(active):
+                if share[g] >= demand[g]:
+                    active.discard(g)
+            if gave == 0:
+                # hand out leftovers one at a time to break rounding stalls
+                for g in sorted(active):
+                    if share[g] < demand[g]:
+                        share[g] += 1
+                        gave += 1
+                        break
+                if gave == 0:
+                    break
+            remaining = total_slots - sum(share.values())
+
+        agents = _clone_agents(pool.agents)
+        to_start: List[Tuple[Request, Assignment]] = []
+        to_preempt: List[str] = []
+        for g, rs in sorted(groups.items()):
+            running = sorted(
+                (r for r in rs if r.alloc_id in pool.running), key=lambda r: r.order
+            )
+            pending = sorted(
+                (r for r in rs if r.alloc_id not in pool.running),
+                key=lambda r: r.order,
+            )
+            used = sum(r.slots for r in running)
+            # Over share: preempt newest first until within share.
+            while used > share[g] and running:
+                victim = running.pop()
+                if not victim.preemptible:
+                    continue
+                to_preempt.append(victim.alloc_id)
+                _release(agents, victim.alloc_id)
+                used -= victim.slots
+            # Under share: start pending requests that keep us within share.
+            for req in pending:
+                if used + req.slots > share[g]:
+                    continue
+                asg = fit(req.slots, agents)
+                if asg is None:
+                    continue
+                _apply(agents, req.alloc_id, asg)
+                to_start.append((req, asg))
+                used += req.slots
+        return Decision(to_start, to_preempt)
+
+
+def make_scheduler(config: Optional[Dict] = None):
+    cfg = config or {}
+    kind = cfg.get("type", "priority")
+    if kind == "fifo" or kind == "round_robin":
+        return FifoScheduler()
+    if kind == "priority":
+        return PriorityScheduler(preemption=bool(cfg.get("preemption", True)))
+    if kind == "fair_share":
+        return FairShareScheduler()
+    raise ValueError(f"unknown scheduler type {kind!r}")

@@ -1,0 +1,165 @@
+"""Python SDK: programmatic client over the REST API.
+
+Rebuild of the reference's `determined.experimental.client`
+(`harness/determined/experimental/client.py`: login/create_experiment/
+object wrappers under `common/experimental/`).
+
+    from determined_tpu.sdk import Determined
+    d = Determined("http://master:8080")
+    exp = d.create_experiment(config)
+    exp.wait()
+    best = exp.top_checkpoint()
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from determined_tpu.common.api_session import Session
+
+TERMINAL = ("COMPLETED", "CANCELED", "ERRORED")
+
+
+class Checkpoint:
+    def __init__(self, session: Session, data: Dict[str, Any]) -> None:
+        self._session = session
+        self.uuid = data["uuid"]
+        self.trial_id = data.get("trial_id")
+        self.steps_completed = data.get("steps_completed", 0)
+        self.resources = data.get("resources", [])
+        self.metadata = data.get("metadata", {})
+
+
+class Trial:
+    def __init__(self, session: Session, data: Dict[str, Any]) -> None:
+        self._session = session
+        self._data = data
+        self.id = data["id"]
+
+    @property
+    def state(self) -> str:
+        return self._session.get(f"/api/v1/trials/{self.id}")["state"]
+
+    @property
+    def hparams(self) -> Dict[str, Any]:
+        return self._data["hparams"]
+
+    def metrics(self, group: Optional[str] = None) -> List[Dict[str, Any]]:
+        return self._session.get(
+            f"/api/v1/trials/{self.id}/metrics",
+            params={"group": group} if group else None,
+        )["metrics"]
+
+    def checkpoints(self) -> List[Checkpoint]:
+        return [
+            Checkpoint(self._session, c)
+            for c in self._session.get(
+                f"/api/v1/trials/{self.id}/checkpoints"
+            )["checkpoints"]
+        ]
+
+    def logs(self) -> List[str]:
+        out = self._session.get(
+            "/api/v1/task_logs", params={"task_id": f"trial-{self.id}"}
+        )["logs"]
+        return [line["log"] for line in out]
+
+
+class Experiment:
+    def __init__(self, session: Session, exp_id: int) -> None:
+        self._session = session
+        self.id = exp_id
+
+    def _get(self) -> Dict[str, Any]:
+        return self._session.get(f"/api/v1/experiments/{self.id}")
+
+    @property
+    def state(self) -> str:
+        return self._get()["state"]
+
+    @property
+    def config(self) -> Dict[str, Any]:
+        return self._get()["config"]
+
+    @property
+    def progress(self) -> float:
+        return float(self._get().get("progress") or 0.0)
+
+    def trials(self) -> List[Trial]:
+        return [
+            Trial(self._session, t)
+            for t in self._session.get(
+                f"/api/v1/experiments/{self.id}/trials"
+            )["trials"]
+        ]
+
+    def wait(self, timeout: float = 3600.0, interval: float = 2.0) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            state = self.state
+            if state in TERMINAL:
+                return state
+            time.sleep(interval)
+        raise TimeoutError(f"experiment {self.id} still {self.state}")
+
+    def pause(self) -> None:
+        self._session.post(f"/api/v1/experiments/{self.id}/pause")
+
+    def activate(self) -> None:
+        self._session.post(f"/api/v1/experiments/{self.id}/activate")
+
+    def cancel(self) -> None:
+        self._session.post(f"/api/v1/experiments/{self.id}/cancel")
+
+    def kill(self) -> None:
+        self._session.post(f"/api/v1/experiments/{self.id}/kill")
+
+    def best_trial(self) -> Optional[Trial]:
+        scfg = self.config.get("searcher", {})
+        smaller = bool(scfg.get("smaller_is_better", True))
+        trials = [
+            t for t in self.trials()
+            if t._data.get("searcher_metric") is not None
+        ]
+        if not trials:
+            return None
+        return (min if smaller else max)(
+            trials, key=lambda t: t._data["searcher_metric"]
+        )
+
+    def top_checkpoint(self) -> Optional[Checkpoint]:
+        best = self.best_trial()
+        if best is None:
+            return None
+        ckpts = best.checkpoints()
+        return ckpts[-1] if ckpts else None
+
+
+class Determined:
+    """Entry point (ref: experimental/client.py Determined)."""
+
+    def __init__(self, master_url: str) -> None:
+        self._session = Session(master_url)
+
+    def create_experiment(self, config: Dict[str, Any]) -> Experiment:
+        resp = self._session.post(
+            "/api/v1/experiments", json_body={"config": config}
+        )
+        return Experiment(self._session, int(resp["id"]))
+
+    def get_experiment(self, exp_id: int) -> Experiment:
+        return Experiment(self._session, exp_id)
+
+    def list_experiments(self) -> List[Experiment]:
+        return [
+            Experiment(self._session, e["id"])
+            for e in self._session.get("/api/v1/experiments")["experiments"]
+        ]
+
+    def get_trial(self, trial_id: int) -> Trial:
+        return Trial(
+            self._session, self._session.get(f"/api/v1/trials/{trial_id}")
+        )
+
+    def master_info(self) -> Dict[str, Any]:
+        return self._session.get("/api/v1/master")

@@ -40,6 +40,10 @@ class AdaptiveASHASearch(SearchMethod):
         divisor: float = 4.0,
     ) -> None:
         rungs = bracket_rungs(max_rungs, mode)
+        # Never exceed the trial budget: with max_trials < bracket count the
+        # padding of every bracket to >=1 trial would overshoot; drop the
+        # most conservative brackets instead (ref: adaptive_asha.go caps).
+        rungs = rungs[: max(1, max_trials)]
         per = max(1, max_trials // len(rungs))
         self.brackets: List[ASHASearch] = []
         remaining = max_trials
@@ -103,6 +107,9 @@ class AdaptiveASHASearch(SearchMethod):
         total = sum(b.n_created for b in self.brackets)
         closed = sum(b.n_closed for b in self.brackets)
         return closed / total if total else 0.0
+
+    def current_target(self, request_id):
+        return self.brackets[self._bracket_of(request_id)].current_target(request_id)
 
     # -- fault tolerance (nested state) --------------------------------------
     def snapshot(self) -> Dict[str, Any]:

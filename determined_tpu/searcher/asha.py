@@ -107,3 +107,12 @@ class ASHASearch(SearchMethod):
         if not self.n_created:
             return 0.0
         return self.n_closed / self.n_created
+
+    def current_target(self, request_id):
+        key = str(request_id)
+        r = self.trial_rungs.get(key, 0)
+        # Already validated at its current rung without being promoted →
+        # the (possibly lost) decision was Close.
+        if any(rid == request_id for _, rid in self.rungs[r]):
+            return None
+        return self.lengths[r]

@@ -78,6 +78,12 @@ class SearchMethod(abc.ABC):
     def progress(self) -> float:
         return 0.0
 
+    def current_target(self, request_id: int) -> Optional[int]:
+        """The cumulative length this trial should train to next, or None if
+        it should close. Used by experiment restore to re-derive in-flight
+        ValidateAfter ops (they are not persisted; the method state is)."""
+        return None
+
     # -- fault tolerance -----------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
         """Default: every attribute (must be JSON-serializable)."""

@@ -52,6 +52,9 @@ class _FixedLengthMethod(SearchMethod):
     def progress(self) -> float:
         return self.n_closed / self.n_trials if self.n_trials else 0.0
 
+    def current_target(self, request_id):
+        return self.max_length
+
 
 class SingleSearch(_FixedLengthMethod):
     """One trial with directly-sampled hyperparameters (single.go)."""

@@ -92,12 +92,49 @@ def load_pytree(directory: str, like: Any, shardings: Optional[Any] = None) -> A
     )
     out = []
     for (path, leaf), sh in zip(leaves, shard_leaves):
-        fname = os.path.join(directory, f"{_leaf_name(path)}.npy")
-        if not os.path.exists(fname):
-            raise FileNotFoundError(f"checkpoint missing leaf {fname}")
-        arr = np.load(fname)
+        name = _leaf_name(path)
+        fname = os.path.join(directory, f"{name}.npy")
+        if os.path.exists(fname):
+            arr = np.load(fname)
+        else:
+            arr = _assemble_shards(directory, name, leaf)
         if sh is not None:
             out.append(jax.device_put(arr, sh))
         else:
             out.append(jax.numpy.asarray(arr))
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _assemble_shards(directory: str, name: str, like_leaf: Any) -> np.ndarray:
+    """Reassemble '{name}.shard<start0>_<start1>....npy' files into the full
+    array (multi-host sharded saves have no single '{name}.npy')."""
+    prefix = f"{name}.shard"
+    shard_files = [
+        f for f in os.listdir(directory)
+        if f.startswith(prefix) and f.endswith(".npy")
+    ]
+    if not shard_files:
+        raise FileNotFoundError(
+            f"checkpoint missing leaf {name} (no .npy or shard files)"
+        )
+    shape = tuple(like_leaf.shape)
+    dtype = np.dtype(getattr(like_leaf, "dtype", np.float32).__str__())
+    full = np.zeros(shape, dtype=dtype)
+    covered = 0
+    for f in shard_files:
+        starts_str = f[len(prefix):-len(".npy")]
+        starts = [int(s) for s in starts_str.split("_")] if starts_str else []
+        shard = np.load(os.path.join(directory, f))
+        if len(starts) != shard.ndim:
+            raise ValueError(f"malformed shard filename {f} for shape {shape}")
+        idx = tuple(
+            slice(st, st + dim) for st, dim in zip(starts, shard.shape)
+        )
+        full[idx] = shard
+        covered += shard.size
+    if covered < full.size:
+        raise ValueError(
+            f"shards for {name} cover {covered} of {full.size} elements; "
+            "checkpoint is incomplete"
+        )
+    return full

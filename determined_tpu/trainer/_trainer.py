@@ -246,8 +246,12 @@ class Trainer:
                 self.core.distributed, length=to_batches(max_length, bpe)
             )
 
-        if latest_checkpoint is None and self.core.info is not None:
-            latest_checkpoint = self.core.info.latest_checkpoint
+        if (
+            latest_checkpoint is None
+            and self.core.info is not None
+            and self.core.info.trial is not None
+        ):
+            latest_checkpoint = self.core.info.trial.latest_checkpoint
         if latest_checkpoint:
             self._restore_checkpoint(latest_checkpoint)
 
@@ -282,15 +286,22 @@ class Trainer:
             pending = []
             t_report = time.time()
 
+        # Host-side step counter: one device sync here, none in the loop —
+        # reading state["step"] per batch would block on the in-flight step
+        # and kill host/device overlap.
+        step = self.steps_completed
+        last_ckpt_step = -1
+
         for op in searcher.operations():
             target = to_batches(op.length, bpe)
-            while self.steps_completed < target:
+            while step < target:
                 batch = self._put_batch(next(train_iter))
                 self._state, metrics = self._step_fn(self.state, batch)
                 pending.append(metrics)
-                step = self.steps_completed
+                step += 1
 
-                if step % rep_period == 0:
+                boundary = step % rep_period == 0 or step == target
+                if boundary:
                     flush_report()
                     if self.core.distributed.is_chief:
                         op.report_progress(float(step))
@@ -301,9 +312,15 @@ class Trainer:
                 if ckpt_period and step % ckpt_period == 0:
                     flush_report()
                     self._save_checkpoint()
-                if self.core.preempt.should_preempt():
+                    last_ckpt_step = step
+                # Preemption is a collective (ZMQ broadcast) — checking every
+                # batch would put a TCP roundtrip in the hot loop, so it
+                # shares the report boundary (the reference's analog knob is
+                # scheduling_unit granularity).
+                if boundary and self.core.preempt.should_preempt():
                     flush_report()
                     self._save_checkpoint()
+                    last_ckpt_step = step
                     logger.info("preempted at step %d; exiting cleanly", step)
                     preempted = True
                     break
@@ -323,7 +340,10 @@ class Trainer:
                     metric = 0.0
                 op.report_completed(float(metric))
 
-        if ckpt_period or preempted or self.core.info is not None:
+        if (
+            (ckpt_period or preempted or self.core.info is not None)
+            and last_ckpt_step != step
+        ):
             self._save_checkpoint()
         return last_val
 

@@ -1,0 +1,113 @@
+"""CLI + SDK tests against a live (agentless) master.
+
+Mirrors the reference's harness/tests/cli tests: command plumbing and the
+experimental client, driven against the real API server. No agents are
+started — experiments stay queued, which is enough to exercise the
+endpoints; full-lifecycle coverage lives in test_devcluster.py.
+"""
+import json
+
+import pytest
+
+from determined_tpu.cli.cli import main as cli_main
+from determined_tpu.master.api_server import ApiServer
+from determined_tpu.master.core import Master
+from determined_tpu.sdk import Determined
+
+CONFIG = {
+    "entrypoint": "determined_tpu.exec.builtin_trials:SyntheticTrial",
+    "searcher": {"name": "random", "max_trials": 2, "max_length": 5},
+    "hyperparameters": {"lr": {"type": "log", "minval": -4, "maxval": -2}},
+    "resources": {"slots_per_trial": 1},
+}
+
+
+@pytest.fixture()
+def live_master():
+    master = Master()
+    api = ApiServer(master)
+    api.start()
+    master.external_url = api.url
+    yield master, api
+    api.stop()
+    master.shutdown()
+
+
+class TestSDK:
+    def test_experiment_roundtrip(self, live_master):
+        master, api = live_master
+        d = Determined(api.url)
+        exp = d.create_experiment(CONFIG)
+        assert exp.state == "ACTIVE"
+        assert exp.config["searcher"]["name"] == "random"
+        trials = exp.trials()
+        assert len(trials) == 2
+        assert all(t.state == "ACTIVE" for t in trials)
+        assert {"lr"} == set(trials[0].hparams)
+
+        exp.kill()
+        assert exp.wait(timeout=10) == "CANCELED"
+        assert d.master_info()["cluster_id"] == master.cluster_id
+
+    def test_best_trial_and_metrics(self, live_master):
+        master, api = live_master
+        d = Determined(api.url)
+        exp = d.create_experiment(CONFIG)
+        t1, t2 = [t.id for t in exp.trials()]
+        master.db.add_metrics(t1, "validation", 5, {"loss": 0.9})
+        master.db.add_metrics(t2, "validation", 5, {"loss": 0.1})
+        master.db.update_trial(t1, searcher_metric=0.9)
+        master.db.update_trial(t2, searcher_metric=0.1)
+        best = exp.best_trial()
+        assert best is not None and best.id == t2
+        assert d.get_trial(t2).metrics("validation")[0]["body"]["loss"] == 0.1
+
+
+class TestCLI:
+    def _run(self, api, *argv):
+        cli_main(["--master", api.url, *argv])
+
+    def test_create_list_describe(self, live_master, tmp_path, capsys):
+        master, api = live_master
+        cfg_path = tmp_path / "config.json"
+        cfg_path.write_text(json.dumps(CONFIG))
+        self._run(api, "experiment", "create", str(cfg_path))
+        out = capsys.readouterr().out
+        assert "Created experiment 1" in out
+
+        self._run(api, "experiment", "list")
+        out = capsys.readouterr().out
+        assert "random" in out and "ACTIVE" in out
+
+        self._run(api, "trial", "list", "1")
+        out = capsys.readouterr().out
+        assert "ACTIVE" in out
+
+        self._run(api, "experiment", "kill", "1")
+        out = capsys.readouterr().out
+        assert "CANCELED" in out
+
+    def test_config_override(self, live_master, tmp_path, capsys):
+        master, api = live_master
+        cfg_path = tmp_path / "config.json"
+        cfg_path.write_text(json.dumps(CONFIG))
+        self._run(
+            api, "experiment", "create", str(cfg_path),
+            "-O", "searcher.max_trials=1",
+            "-O", "resources.slots_per_trial=4",
+        )
+        capsys.readouterr()
+        exp = master.get_experiment(1)
+        assert exp.config["searcher"]["max_trials"] == 1
+        assert exp.config["resources"]["slots_per_trial"] == 4
+        assert len(exp.trials) == 1
+
+    def test_agent_and_master_info(self, live_master, capsys):
+        master, api = live_master
+        master.agent_hub.register("a1", 4, "default")
+        self._run(api, "agent", "list")
+        out = capsys.readouterr().out
+        assert "a1" in out
+        self._run(api, "master", "info")
+        out = capsys.readouterr().out
+        assert master.cluster_id in out

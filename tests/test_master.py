@@ -1,0 +1,379 @@
+"""Master-layer unit tests: DB, schedulers/fitting, allocation service,
+experiment FSM (with a deferred fake launcher), crash restore.
+
+Mirrors the reference's scheduler property tests (fair_share_test.go,
+priority_test.go, fitting_test.go), rendezvous/allgather service tests
+(internal/task/*_test.go), and experiment snapshot tests (restore.go).
+"""
+import threading
+import time
+
+import pytest
+
+from determined_tpu.master import db as db_mod
+from determined_tpu.master.allocation import AllocationService
+from determined_tpu.master.experiment import Experiment
+from determined_tpu.master.scheduler import (
+    Agent,
+    FairShareScheduler,
+    FifoScheduler,
+    PoolState,
+    PriorityScheduler,
+    Request,
+    fit,
+)
+
+SPACE = {"lr": {"type": "log", "minval": -4, "maxval": -1}}
+
+
+class TestDB:
+    def test_experiment_roundtrip(self):
+        db = db_mod.Database()
+        eid = db.add_experiment({"searcher": {"name": "single"}})
+        exp = db.get_experiment(eid)
+        assert exp["state"] == "ACTIVE"
+        assert exp["config"]["searcher"]["name"] == "single"
+        db.set_experiment_state(eid, "COMPLETED")
+        assert db.get_experiment(eid)["state"] == "COMPLETED"
+
+    def test_trial_and_metrics(self):
+        db = db_mod.Database()
+        eid = db.add_experiment({})
+        tid = db.add_trial(eid, 1, {"lr": 0.1}, seed=7)
+        db.add_metrics(tid, "validation", 10, {"loss": 0.5})
+        db.add_metrics(tid, "validation", 20, {"loss": 0.3})
+        db.add_metrics(tid, "training", 20, {"loss": 0.9})
+        assert len(db.get_metrics(tid)) == 3
+        assert db.best_validation(tid, "loss") == 0.3
+        assert db.best_validation(tid, "loss", smaller_is_better=False) == 0.5
+        db.update_trial(tid, latest_checkpoint="abc", steps_completed=20)
+        assert db.get_trial(tid)["latest_checkpoint"] == "abc"
+
+    def test_checkpoints(self):
+        db = db_mod.Database()
+        db.add_checkpoint(
+            "u1", trial_id=1, task_id="t", allocation_id="a",
+            resources=["x.npy"], metadata={"steps_completed": 5},
+        )
+        assert db.get_checkpoint("u1")["steps_completed"] == 5
+        assert len(db.list_checkpoints(1)) == 1
+        db.mark_checkpoint_deleted("u1")
+        assert db.list_checkpoints(1) == []
+
+
+def _agents(spec):
+    return {aid: Agent(aid, slots) for aid, slots in spec.items()}
+
+
+class TestFitting:
+    def test_single_host_best_fit(self):
+        agents = _agents({"a": 8, "b": 4})
+        assert fit(4, agents) == {"b": 4}  # tightest fit wins
+        assert fit(8, agents) == {"a": 8}
+
+    def test_multi_host_whole_hosts(self):
+        agents = _agents({"a": 4, "b": 4, "c": 4})
+        asg = fit(8, agents)
+        assert asg is not None and sum(asg.values()) == 8
+        assert all(v == 4 for v in asg.values())
+
+    def test_multi_host_rejects_partial(self):
+        agents = _agents({"a": 4, "b": 4})
+        agents["a"].used["x"] = 1  # host a not idle
+        assert fit(8, agents) is None
+        assert fit(6, agents) is None  # not a multiple of 4 either
+
+    def test_zero_slot(self):
+        assert fit(0, _agents({"a": 4})) == {"a": 0}
+
+
+def _pool(agents, pending, running=None, assignments=None):
+    return PoolState(
+        agents=agents, pending=pending, running=running or {},
+        assignments=assignments or {},
+    )
+
+
+class TestSchedulers:
+    def test_fifo_blocks_behind_big_gang(self):
+        agents = _agents({"a": 4})
+        reqs = [
+            Request("r1", 8, order=1),  # can never fit -> blocks r2
+            Request("r2", 2, order=2),
+        ]
+        d = FifoScheduler().schedule(_pool(agents, reqs))
+        assert d.to_start == []
+
+    def test_priority_preempts_lower(self):
+        agents = _agents({"a": 4})
+        low = Request("low", 4, priority=90, order=1)
+        agents["a"].used["low"] = 4
+        high = Request("high", 4, priority=10, order=2)
+        d = PriorityScheduler().schedule(
+            _pool(agents, [high], {"low": low}, {"low": {"a": 4}})
+        )
+        assert d.to_preempt == ["low"]
+        assert d.to_start == []  # starts next tick, after slots free
+
+    def test_priority_no_preempt_for_equal_priority(self):
+        agents = _agents({"a": 4})
+        running = Request("r1", 4, priority=50, order=1)
+        agents["a"].used["r1"] = 4
+        d = PriorityScheduler().schedule(
+            _pool(agents, [Request("r2", 4, priority=50, order=2)],
+                  {"r1": running}, {"r1": {"a": 4}})
+        )
+        assert d.to_preempt == [] and d.to_start == []
+
+    def test_fair_share_splits_between_groups(self):
+        agents = _agents({"a": 8})
+        reqs = [
+            Request(f"g1-{i}", 2, group_id="g1", order=i) for i in range(3)
+        ] + [
+            Request(f"g2-{i}", 2, group_id="g2", order=10 + i) for i in range(3)
+        ]
+        d = FairShareScheduler().schedule(_pool(agents, reqs))
+        started = {r.alloc_id for r, _ in d.to_start}
+        g1 = sum(1 for s in started if s.startswith("g1"))
+        g2 = sum(1 for s in started if s.startswith("g2"))
+        assert g1 == 2 and g2 == 2  # 4 slots each = 2 two-slot trials each
+
+    def test_fair_share_preempts_over_share(self):
+        agents = _agents({"a": 8})
+        running = {}
+        assignments = {}
+        for i in range(4):  # g1 hogs everything
+            r = Request(f"g1-{i}", 2, group_id="g1", order=i)
+            running[r.alloc_id] = r
+            agents["a"].used[r.alloc_id] = 2
+            assignments[r.alloc_id] = {"a": 2}
+        pending = [Request(f"g2-{i}", 2, group_id="g2", order=10 + i) for i in range(2)]
+        d = FairShareScheduler().schedule(
+            _pool(agents, pending, running, assignments)
+        )
+        assert len(d.to_preempt) >= 1  # g1 must give slots back
+
+
+class TestAllocationService:
+    def test_rendezvous_collects_and_publishes(self):
+        svc = AllocationService()
+        svc.create("a1", task_id="t", trial_id=1, num_processes=2, slots=2)
+        results = {}
+
+        def worker(rank):
+            svc.rendezvous_arrive("a1", rank, f"10.0.0.{rank}")
+            results[rank] = svc.rendezvous_info("a1", timeout=10)
+
+        ts = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=15)
+        assert results[0]["container_addrs"] == ["10.0.0.0", "10.0.0.1"]
+        assert results[1]["coordinator_address"] == "10.0.0.0"
+
+    def test_preemption_longpoll_and_ack(self):
+        svc = AllocationService()
+        svc.create("a1", task_id="t", trial_id=1, num_processes=1, slots=1)
+        assert svc.should_preempt("a1", timeout=0.1) is False
+        got = {}
+
+        def waiter():
+            got["flag"] = svc.should_preempt("a1", timeout=10)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.1)
+        svc.signal_preempt("a1")
+        t.join(timeout=5)
+        assert got["flag"] is True
+        svc.ack_preempt("a1")
+        assert svc.get("a1").preempt_acked
+
+    def test_overdue_preemptions(self):
+        svc = AllocationService(preempt_timeout_s=0.05)
+        svc.create("a1", task_id="t", trial_id=1, num_processes=1, slots=1)
+        svc.signal_preempt("a1")
+        time.sleep(0.1)
+        assert svc.overdue_preemptions() == ["a1"]
+        svc.complete("a1", exit_code=137, reason="killed")
+        assert svc.overdue_preemptions() == []
+
+    def test_allgather_rounds(self):
+        svc = AllocationService()
+        svc.create("a1", task_id="t", trial_id=1, num_processes=3, slots=3)
+        out = [None] * 3
+
+        def worker(rank):
+            out[rank] = svc.allgather("a1", rank, f"data{rank}", timeout=10)
+
+        ts = [threading.Thread(target=worker, args=(r,)) for r in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=15)
+        assert out[0] == out[1] == out[2] == ["data0", "data1", "data2"]
+
+    def test_exit_hook(self):
+        svc = AllocationService()
+        seen = []
+        svc.set_exit_hook(lambda a: seen.append((a.id, a.exit_code)))
+        svc.create("a1", task_id="t", trial_id=1, num_processes=1, slots=1)
+        svc.complete("a1", exit_code=1, reason="boom")
+        assert seen == [("a1", 1)]
+        svc.complete("a1", exit_code=0)  # idempotent
+        assert len(seen) == 1
+
+
+class FakeLauncher:
+    """Records launches; the test drives trial lifecycles by hand."""
+
+    def __init__(self):
+        self.launched = []
+        self.preempted = []
+        self.killed = []
+
+    def launch(self, experiment, rec):
+        self.launched.append((experiment, rec))
+
+    def preempt(self, trial_id):
+        self.preempted.append(trial_id)
+
+    def kill(self, trial_id):
+        self.killed.append(trial_id)
+
+
+def _drive_trial(exp, rec, metric=0.5):
+    """Simulate a harness: consume ops until closed, reporting `metric`."""
+    while True:
+        resp = exp.current_searcher_op(rec.trial_id, timeout=0)
+        if resp.get("completed"):
+            exp.trial_exited(rec.trial_id, 0)
+            return
+        if resp.get("op") is None:
+            return  # no work yet (waiting on other trials)
+        exp.op_completed(rec.trial_id, resp["op"]["length"], metric)
+
+
+class TestExperimentFSM:
+    def _make(self, config):
+        db = db_mod.Database()
+        eid = db.add_experiment(config)
+        launcher = FakeLauncher()
+        exp = Experiment(eid, config, db, launcher)
+        return db, launcher, exp
+
+    def test_single_trial_completes(self):
+        db, launcher, exp = self._make(
+            {"searcher": {"name": "single", "max_length": 10},
+             "hyperparameters": SPACE}
+        )
+        exp.start()
+        assert len(launcher.launched) == 1
+        _, rec = launcher.launched[0]
+        _drive_trial(exp, rec)
+        assert exp.state == db_mod.COMPLETED
+        assert db.get_trial(rec.trial_id)["state"] == db_mod.COMPLETED
+
+    def test_random_search_all_trials(self):
+        db, launcher, exp = self._make(
+            {"searcher": {"name": "random", "max_trials": 4, "max_length": 5},
+             "hyperparameters": SPACE}
+        )
+        exp.start()
+        assert len(launcher.launched) == 4
+        for _, rec in list(launcher.launched):
+            _drive_trial(exp, rec)
+        assert exp.state == db_mod.COMPLETED
+        assert db.get_experiment(exp.id)["progress"] == 1.0
+
+    def test_asha_promotes_and_completes(self):
+        db, launcher, exp = self._make(
+            {"searcher": {"name": "asha", "max_trials": 8, "max_length": 100,
+                          "num_rungs": 2, "divisor": 4},
+             "hyperparameters": SPACE}
+        )
+        exp.start()
+        assert len(launcher.launched) == 8
+        # Feed distinct metrics; lower = better = promoted.
+        for i, (_, rec) in enumerate(list(launcher.launched)):
+            while True:
+                resp = exp.current_searcher_op(rec.trial_id, timeout=0)
+                if resp.get("completed"):
+                    exp.trial_exited(rec.trial_id, 0)
+                    break
+                if resp["op"] is None:
+                    break
+                exp.op_completed(rec.trial_id, resp["op"]["length"], float(i))
+        assert exp.state == db_mod.COMPLETED
+        lengths = [t["steps_completed"] for t in db.list_trials(exp.id)]
+        assert max(lengths) == 100 and min(lengths) == 25
+
+    def test_restart_budget_then_error(self):
+        db, launcher, exp = self._make(
+            {"searcher": {"name": "single", "max_length": 10},
+             "hyperparameters": SPACE, "max_restarts": 2}
+        )
+        exp.start()
+        _, rec = launcher.launched[0]
+        for i in range(3):
+            exp.trial_exited(rec.trial_id, 1, "crash")
+        # 2 restarts consumed, 3rd failure errors the trial + experiment.
+        assert len(launcher.launched) == 3  # initial + 2 restarts
+        assert db.get_trial(rec.trial_id)["state"] == db_mod.ERRORED
+        assert exp.state == db_mod.ERRORED
+
+    def test_pause_activate_resume(self):
+        db, launcher, exp = self._make(
+            {"searcher": {"name": "single", "max_length": 10},
+             "hyperparameters": SPACE}
+        )
+        exp.start()
+        _, rec = launcher.launched[0]
+        exp.pause()
+        assert launcher.preempted == [rec.trial_id]
+        exp.trial_exited(rec.trial_id, 0)  # graceful preempt exit
+        assert not rec.exited  # paused, not done
+        exp.activate()
+        assert len(launcher.launched) == 2  # relaunched
+        assert rec.run_id == 1
+        _drive_trial(exp, rec)
+        assert exp.state == db_mod.COMPLETED
+
+    def test_cancel_marks_canceled(self):
+        db, launcher, exp = self._make(
+            {"searcher": {"name": "random", "max_trials": 2, "max_length": 10},
+             "hyperparameters": SPACE}
+        )
+        exp.start()
+        exp.cancel()
+        for _, rec in launcher.launched:
+            exp.trial_exited(rec.trial_id, 0)
+        assert exp.state == db_mod.CANCELED
+        assert all(
+            t["state"] == db_mod.CANCELED for t in db.list_trials(exp.id)
+        )
+
+    def test_snapshot_restore_resumes_search(self):
+        config = {
+            "searcher": {"name": "asha", "max_trials": 4, "max_length": 100,
+                         "num_rungs": 2},
+            "hyperparameters": SPACE,
+        }
+        db, launcher, exp = self._make(config)
+        exp.start()
+        _, rec0 = launcher.launched[0]
+        resp = exp.current_searcher_op(rec0.trial_id, timeout=0)
+        exp.op_completed(rec0.trial_id, resp["op"]["length"], 0.1)
+
+        # "Crash": rebuild from DB rows + snapshot.
+        row = db.get_experiment(exp.id)
+        launcher2 = FakeLauncher()
+        exp2 = Experiment(exp.id, config, db, launcher2)
+        exp2.restore(row["searcher_snapshot"], db.list_trials(exp.id))
+        exp2.relaunch_live_trials()
+        assert len(launcher2.launched) == 4  # all trials still live
+        # Drive everything to completion on the restored FSM.
+        for _, rec in list(launcher2.launched):
+            _drive_trial(exp2, rec, metric=float(rec.trial_id))
+        assert exp2.state == db_mod.COMPLETED

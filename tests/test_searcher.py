@@ -141,6 +141,10 @@ class TestAdaptiveASHA:
         assert res.n_trials == 12
         assert res.total_units < 12 * 1000
 
+    def test_max_trials_not_exceeded_by_bracket_padding(self):
+        s = AdaptiveASHASearch(1000, 2, mode="standard", max_rungs=4)
+        assert sum(b.max_trials for b in s.brackets) == 2
+
     def test_conservative_more_brackets_than_aggressive(self):
         cons = AdaptiveASHASearch(1000, 12, mode="conservative", max_rungs=3)
         aggr = AdaptiveASHASearch(1000, 12, mode="aggressive", max_rungs=3)
