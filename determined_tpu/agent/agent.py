@@ -85,8 +85,19 @@ class AgentDaemon:
         )
 
     def run_forever(self) -> None:
-        self.register()
+        needs_register = True
         while not self._stop.is_set():
+            if needs_register:
+                # Retry registration until the master accepts it — a single
+                # swallowed failure here must not leave the agent invisible
+                # (the master answers polls for unknown agents too).
+                try:
+                    self.register()
+                    needs_register = False
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("register failed (%s); retrying", e)
+                    time.sleep(2)
+                    continue
             try:
                 resp = self.session.get(
                     f"/api/v1/agents/{self.agent_id}/actions",
@@ -95,13 +106,13 @@ class AgentDaemon:
             except Exception as e:  # noqa: BLE001
                 logger.warning("poll failed (%s); retrying", e)
                 time.sleep(2)
-                # Master may have restarted: re-register so slots reappear.
-                try:
-                    self.register()
-                except Exception:  # noqa: BLE001
-                    pass
+                needs_register = True  # master may have restarted
                 continue
             for action in resp.get("actions", []):
+                if action.get("type") == "REREGISTER":
+                    # Master doesn't know us (restart or liveness reap).
+                    needs_register = True
+                    continue
                 try:
                     self.handle(action)
                 except Exception:  # noqa: BLE001

@@ -1,0 +1,8 @@
+"""Data layer: native (C++) token loader + shard tooling.
+
+See native/dataloader.cpp (prefetch engine) and tokens.py (format + python
+fallback + TokenDataset iterator).
+"""
+from determined_tpu.data.tokens import TokenDataset, write_token_shard
+
+__all__ = ["TokenDataset", "write_token_shard"]

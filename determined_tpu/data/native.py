@@ -1,0 +1,126 @@
+"""ctypes binding for the native data loader (native/dataloader.cpp).
+
+Build model: no pip install in the target environment, so the .so is built
+lazily with g++ into ``native/_build/`` the first time it's needed (a few
+hundred ms, cached by source mtime). If no compiler is available the pure-
+python fallback in tokens.py takes over — same batch stream bit-for-bit
+(both sides implement splitmix64 offsets), so tests can assert equivalence.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "dataloader.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "_build")
+_SO = os.path.join(_BUILD_DIR, "libdtpu_dataloader.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[str]:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        _SRC, "-o", _SO,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """The compiled loader library, or None (→ python fallback)."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        so = _build()
+        if so is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(so)
+        lib.dl_open.restype = ctypes.c_void_p
+        lib.dl_open.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.dl_total_tokens.restype = ctypes.c_uint64
+        lib.dl_total_tokens.argtypes = [ctypes.c_void_p]
+        lib.dl_next.restype = ctypes.c_int
+        lib.dl_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
+        lib.dl_skip.restype = None
+        lib.dl_skip.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.dl_close.restype = None
+        lib.dl_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class NativeLoader:
+    """Thin RAII wrapper over the C handle."""
+
+    def __init__(
+        self,
+        paths: List[str],
+        token_bytes: int,
+        batch: int,
+        seq: int,
+        seed: int = 0,
+        shuffle: bool = True,
+        n_threads: int = 2,
+        queue_depth: int = 4,
+    ) -> None:
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native loader unavailable (no g++?)")
+        self._lib = lib
+        arr = (ctypes.c_char_p * len(paths))(
+            *[p.encode() for p in paths]
+        )
+        self._handle = lib.dl_open(
+            arr, len(paths), token_bytes, batch, seq,
+            ctypes.c_uint64(seed), int(shuffle), n_threads, queue_depth,
+        )
+        if not self._handle:
+            raise ValueError(
+                f"dl_open failed (paths readable? enough tokens for seq={seq}?)"
+            )
+        self.batch = batch
+        self.seq = seq
+
+    @property
+    def total_tokens(self) -> int:
+        return int(self._lib.dl_total_tokens(self._handle))
+
+    def next_into(self, out) -> None:
+        """Fill a preallocated int32 numpy array [batch, seq] in place."""
+        ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        rc = self._lib.dl_next(self._handle, ptr)
+        if rc != 0:
+            raise RuntimeError("dl_next failed (loader closed?)")
+
+    def skip(self, n_batches: int) -> None:
+        self._lib.dl_skip(self._handle, ctypes.c_uint64(n_batches))
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.dl_close(self._handle)
+            self._handle = None
+
+    def __del__(self) -> None:  # best-effort; explicit close preferred
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass

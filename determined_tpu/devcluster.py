@@ -65,7 +65,7 @@ class DevCluster:
 
     def kill_agent(self, agent: AgentDaemon) -> None:
         agent.stop()
-        self.master.rm.pool().remove_agent(agent.agent_id)
+        self.master.lose_agent(agent.agent_id)
 
     # -- client-side --------------------------------------------------------
     def session(self) -> Session:

@@ -52,8 +52,12 @@ class AgentHub:
     def poll(self, agent_id: str, timeout: float = 30.0) -> List[Dict[str, Any]]:
         deadline = time.time() + timeout
         with self._cond:
-            if agent_id in self._agents:
-                self._agents[agent_id]["last_seen"] = time.time()
+            if agent_id not in self._agents:
+                # Unknown to this master (restart, or reaped as dead while
+                # actually alive): tell the agent to re-register so its
+                # slots come back (ref: aproto ErrAgentMustReconnect).
+                return [{"type": "REREGISTER"}]
+            self._agents[agent_id]["last_seen"] = time.time()
             while True:
                 q = self._queues.get(agent_id, [])
                 if q:
@@ -63,6 +67,32 @@ class AgentHub:
                 if remaining <= 0:
                     return []
                 self._cond.wait(timeout=min(remaining, 5.0))
+
+    def remove(self, agent_id: str) -> Optional[Dict[str, Any]]:
+        with self._cond:
+            info = self._agents.pop(agent_id, None)
+            self._queues.pop(agent_id, None)
+            self._cond.notify_all()
+            return info
+
+    def reap_stale(self, timeout_s: float) -> List[str]:
+        """Remove agents silent for > timeout_s; returns their ids."""
+        cutoff = time.time() - timeout_s
+        with self._cond:
+            stale = [
+                aid for aid, a in self._agents.items() if a["last_seen"] < cutoff
+            ]
+            for aid in stale:
+                self._agents.pop(aid, None)
+                self._queues.pop(aid, None)
+            if stale:
+                self._cond.notify_all()
+            return stale
+
+    def pool_of(self, agent_id: str) -> Optional[str]:
+        with self._lock:
+            a = self._agents.get(agent_id)
+            return a["pool"] if a else None
 
     def list(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
@@ -94,9 +124,11 @@ class RMTrialLauncher:
             group_id=str(experiment.id),
             preemptible=True,
         )
+        pool_name = resources.get("resource_pool") or self.m.rm.pool().name
         with self.m._lock:
             self.m._alloc_index[alloc_id] = (experiment, rec.trial_id)
             self.m._trial_allocs[rec.trial_id] = alloc_id
+            self.m._alloc_pool[alloc_id] = pool_name
 
         def on_start(req: Request, assignment: Dict[str, int]) -> None:
             hosts = sorted(assignment)
@@ -150,9 +182,7 @@ class RMTrialLauncher:
         def on_preempt(a_id: str) -> None:
             self.m.alloc_service.signal_preempt(a_id)
 
-        self.m.rm.pool(resources.get("resource_pool")).submit(
-            request, on_start, on_preempt
-        )
+        self.m.rm.pool(pool_name).submit(request, on_start, on_preempt)
 
     def _live_alloc(self, trial_id: int) -> Optional[str]:
         with self.m._lock:
@@ -165,7 +195,7 @@ class RMTrialLauncher:
         alloc = self.m.alloc_service.get(alloc_id)
         if alloc is None:
             # Still queued: withdraw the request; the trial never started.
-            self.m.rm.pool().release(alloc_id)
+            self.m.pool_of(alloc_id).release(alloc_id)
             exp, t_id = self.m._alloc_index.get(alloc_id, (None, None))
             if exp is not None:
                 exp.trial_exited(t_id, 0, "preempted while pending")
@@ -178,9 +208,9 @@ class RMTrialLauncher:
             return
         alloc = self.m.alloc_service.get(alloc_id)
         if alloc is None:
-            self.m.rm.pool().release(alloc_id)
+            self.m.pool_of(alloc_id).release(alloc_id)
             return
-        assignment = self.m.rm.pool().assignment_of(alloc_id) or {}
+        assignment = self.m.pool_of(alloc_id).assignment_of(alloc_id) or {}
         for agent_id in assignment:
             self.m.agent_hub.enqueue(
                 agent_id, {"type": "KILL", "alloc_id": alloc_id}
@@ -194,6 +224,7 @@ class Master:
         pools_config: Optional[Dict[str, Dict]] = None,
         external_url: str = "http://127.0.0.1:8080",
         preempt_timeout_s: float = 600.0,
+        agent_timeout_s: float = 120.0,
     ) -> None:
         self.cluster_id = uuid.uuid4().hex[:8]
         self.external_url = external_url
@@ -202,14 +233,21 @@ class Master:
         self.alloc_service = AllocationService(preempt_timeout_s=preempt_timeout_s)
         self.agent_hub = AgentHub()
         self.launcher = RMTrialLauncher(self)
+        self.agent_timeout_s = agent_timeout_s
         self.experiments: Dict[int, Experiment] = {}
         self._alloc_index: Dict[str, tuple] = {}   # alloc_id -> (exp, trial_id)
         self._trial_allocs: Dict[int, str] = {}    # trial_id -> latest alloc_id
+        self._alloc_pool: Dict[str, str] = {}      # alloc_id -> pool name
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self.alloc_service.set_exit_hook(self._allocation_exited)
         self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
         self._ticker.start()
+
+    def pool_of(self, alloc_id: str):
+        with self._lock:
+            name = self._alloc_pool.get(alloc_id)
+        return self.rm.pool(name)
 
     # -- background pump (replaces the actor system's message loop) ----------
     def _tick_loop(self) -> None:
@@ -217,13 +255,28 @@ class Master:
             try:
                 self.rm.tick_all()
                 for alloc_id in self.alloc_service.overdue_preemptions():
-                    assignment = self.rm.pool().assignment_of(alloc_id) or {}
+                    assignment = self.pool_of(alloc_id).assignment_of(alloc_id) or {}
                     for agent_id in assignment:
                         self.agent_hub.enqueue(
                             agent_id, {"type": "KILL", "alloc_id": alloc_id}
                         )
+                # Agent failure detection: an agent silent past the timeout
+                # is gone — fail its allocations over (trial restart budget
+                # applies; ref agent reattach flow, containers/manager.go:76).
+                for agent_id in self.agent_hub.reap_stale(self.agent_timeout_s):
+                    self.lose_agent(agent_id)
             except Exception:  # noqa: BLE001
                 logger.exception("tick loop error")
+
+    def lose_agent(self, agent_id: str) -> None:
+        """Remove a dead agent and fail over everything it was running."""
+        logger.warning("agent %s lost; failing over its allocations", agent_id)
+        self.agent_hub.remove(agent_id)
+        for pool in self.rm.pools.values():
+            for alloc_id in pool.remove_agent(agent_id):
+                self.alloc_service.complete(
+                    alloc_id, exit_code=1, reason=f"agent {agent_id} lost"
+                )
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -234,9 +287,10 @@ class Master:
             alloc.id, state="TERMINATED", ended_at=time.time(),
             exit_reason=alloc.exit_reason,
         )
-        self.rm.pool().release(alloc.id)
+        self.pool_of(alloc.id).release(alloc.id)
         with self._lock:
             exp_trial = self._alloc_index.pop(alloc.id, None)
+            self._alloc_pool.pop(alloc.id, None)
             if exp_trial and self._trial_allocs.get(exp_trial[1]) == alloc.id:
                 del self._trial_allocs[exp_trial[1]]
         if exp_trial:

@@ -128,6 +128,17 @@ class Database:
         conn.commit()
         return cur
 
+    def _executemany(self, sql: str, rows: List[tuple]) -> None:
+        """One transaction for the whole batch (one commit/fsync, not N)."""
+        if self._memory_conn is not None:
+            with self._memory_lock:
+                self._memory_conn.executemany(sql, rows)
+                self._memory_conn.commit()
+            return
+        conn = self._conn()
+        conn.executemany(sql, rows)
+        conn.commit()
+
     def _query(self, sql: str, args: tuple = ()) -> List[sqlite3.Row]:
         if self._memory_conn is not None:
             with self._memory_lock:
@@ -310,14 +321,14 @@ class Database:
 
     # -- task logs -------------------------------------------------------------
     def add_task_logs(self, task_id: str, lines: List[Dict[str, Any]]) -> None:
-        for line in lines:
-            self._execute(
-                "INSERT INTO task_logs (task_id, ts, level, log) VALUES (?,?,?,?)",
-                (
-                    task_id, line.get("ts", time.time()),
-                    line.get("level", "INFO"), line["log"],
-                ),
-            )
+        now = time.time()
+        self._executemany(
+            "INSERT INTO task_logs (task_id, ts, level, log) VALUES (?,?,?,?)",
+            [
+                (task_id, line.get("ts", now), line.get("level", "INFO"), line["log"])
+                for line in lines
+            ],
+        )
 
     def get_task_logs(self, task_id: str, after_id: int = 0, limit: int = 1000) -> List[Dict[str, Any]]:
         return [

@@ -183,8 +183,10 @@ class PriorityScheduler:
                     continue
                 to_preempt.extend(planned)
                 # Preempted slots free asynchronously (checkpoint first), so
-                # don't also start the new gang this tick — it starts next
-                # tick once the slots are actually free.
+                # the gang starts next tick — but its claim must be RESERVED
+                # now, or lower-priority requests later in this loop would
+                # grab the slots the preemption just freed.
+                _apply(agents, req.alloc_id, asg)
                 continue
             if asg is None:
                 continue

@@ -25,6 +25,7 @@ tiles them onto the MXU without padding.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -50,6 +51,16 @@ class GPTConfig:
     remat: bool = True
     attn_impl: str = "auto"            # see models.attention
     z_loss: float = 1e-4               # logit-norm regularizer (stability)
+    # Pipeline parallelism (DeepSpeed PipelineModule analog, TPU-style:
+    # stages sharded over the mesh's `pipeline` axis, microbatches advanced
+    # by ppermute inside one compiled program — parallel/pipeline.py).
+    pipeline_stages: int = 1
+    num_microbatches: int = 0          # 0 → 2 × stages (reasonable bubble)
+    # Mixture of experts (cifar10_moe / DeepSpeed-MoE analog): n_experts > 0
+    # replaces every block's MLP with a top-1 (switch) MoE layer; experts
+    # shard over the mesh's `expert` axis (GSPMD inserts the all-to-alls).
+    n_experts: int = 0
+    capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -58,7 +69,13 @@ class GPTConfig:
 
     def n_params(self) -> int:
         d, f, l, v, s = self.d_model, self.d_ff, self.n_layers, self.vocab_size, self.seq_len
-        per_block = 4 * d * d + 2 * d * f + (3 * d + d) + (f + d) + 4 * d
+        attn = 4 * d * d + (3 * d + d)
+        if self.n_experts:
+            e = self.n_experts
+            mlp = d * e + e * (d * f + f) + e * (f * d) + d
+        else:
+            mlp = 2 * d * f + f + d
+        per_block = attn + mlp + 4 * d
         embed = v * d + s * d
         head = 0 if self.tie_embeddings else d * v
         return l * per_block + embed + head + 2 * d
@@ -111,23 +128,36 @@ class GPT(Model):
         # GPT-2 residual-projection scaling: std/sqrt(2L).
         res_init = jax.nn.initializers.normal(0.02 / (2 * l) ** 0.5)
         pd = c.param_dtype
+        blocks: Dict[str, Any] = {
+            "ln1_scale": jnp.ones((l, d), pd),
+            "ln1_bias": jnp.zeros((l, d), pd),
+            "wqkv": init(keys[2], (l, d, 3, h, hd), pd),
+            "bqkv": jnp.zeros((l, 3, h, hd), pd),
+            "wo": res_init(keys[3], (l, h, hd, d), pd),
+            "bo": jnp.zeros((l, d), pd),
+            "ln2_scale": jnp.ones((l, d), pd),
+            "ln2_bias": jnp.zeros((l, d), pd),
+        }
+        if c.n_experts:
+            e = c.n_experts
+            blocks.update(
+                router=init(keys[4], (l, d, e), pd),
+                we_in=init(keys[5], (l, e, d, f), pd),
+                be_in=jnp.zeros((l, e, f), pd),
+                we_out=res_init(keys[7], (l, e, f, d), pd),
+                bo_mlp=jnp.zeros((l, d), pd),
+            )
+        else:
+            blocks.update(
+                wi=init(keys[4], (l, d, f), pd),
+                bi=jnp.zeros((l, f), pd),
+                wo_mlp=res_init(keys[5], (l, f, d), pd),
+                bo_mlp=jnp.zeros((l, d), pd),
+            )
         params: Dict[str, Any] = {
             "tok_embed": init(keys[0], (c.vocab_size, d), pd),
             "pos_embed": init(keys[1], (c.seq_len, d), pd),
-            "blocks": {
-                "ln1_scale": jnp.ones((l, d), pd),
-                "ln1_bias": jnp.zeros((l, d), pd),
-                "wqkv": init(keys[2], (l, d, 3, h, hd), pd),
-                "bqkv": jnp.zeros((l, 3, h, hd), pd),
-                "wo": res_init(keys[3], (l, h, hd, d), pd),
-                "bo": jnp.zeros((l, d), pd),
-                "ln2_scale": jnp.ones((l, d), pd),
-                "ln2_bias": jnp.zeros((l, d), pd),
-                "wi": init(keys[4], (l, d, f), pd),
-                "bi": jnp.zeros((l, f), pd),
-                "wo_mlp": res_init(keys[5], (l, f, d), pd),
-                "bo_mlp": jnp.zeros((l, d), pd),
-            },
+            "blocks": blocks,
             "lnf_scale": jnp.ones((d,), pd),
             "lnf_bias": jnp.zeros((d,), pd),
         }
@@ -136,27 +166,40 @@ class GPT(Model):
         return params
 
     def logical_axes(self) -> Dict[str, Any]:
+        c = self.config
+        blocks: Dict[str, Any] = {
+            "ln1_scale": ("layers", "norm"),
+            "ln1_bias": ("layers", "norm"),
+            "wqkv": ("layers", "embed", None, "heads", "head_dim"),
+            "bqkv": ("layers", None, "heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+            "bo": ("layers", "norm"),
+            "ln2_scale": ("layers", "norm"),
+            "ln2_bias": ("layers", "norm"),
+        }
+        if c.n_experts:
+            blocks.update(
+                router=("layers", "embed", None),
+                we_in=("layers", "expert", "embed", "mlp"),
+                be_in=("layers", "expert", "mlp"),
+                we_out=("layers", "expert", "mlp", "embed"),
+                bo_mlp=("layers", "norm"),
+            )
+        else:
+            blocks.update(
+                wi=("layers", "embed", "mlp"),
+                bi=("layers", "mlp"),
+                wo_mlp=("layers", "mlp", "embed"),
+                bo_mlp=("layers", "norm"),
+            )
         axes: Dict[str, Any] = {
             "tok_embed": ("vocab", "embed"),
             "pos_embed": (None, "embed"),
-            "blocks": {
-                "ln1_scale": ("layers", "norm"),
-                "ln1_bias": ("layers", "norm"),
-                "wqkv": ("layers", "embed", None, "heads", "head_dim"),
-                "bqkv": ("layers", None, "heads", "head_dim"),
-                "wo": ("layers", "heads", "head_dim", "embed"),
-                "bo": ("layers", "norm"),
-                "ln2_scale": ("layers", "norm"),
-                "ln2_bias": ("layers", "norm"),
-                "wi": ("layers", "embed", "mlp"),
-                "bi": ("layers", "mlp"),
-                "wo_mlp": ("layers", "mlp", "embed"),
-                "bo_mlp": ("layers", "norm"),
-            },
+            "blocks": blocks,
             "lnf_scale": ("norm",),
             "lnf_bias": ("norm",),
         }
-        if not self.config.tie_embeddings:
+        if not c.tie_embeddings:
             axes["head"] = ("embed", "vocab")
         return axes
 
@@ -166,7 +209,65 @@ class GPT(Model):
             return x
         return lax.with_sharding_constraint(x, NamedSharding(self.mesh, spec))
 
-    def _block(self, x: jax.Array, blk: Dict[str, jax.Array]) -> jax.Array:
+    def _moe_mlp(
+        self, h: jax.Array, blk: Dict[str, jax.Array], manual: bool
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Top-1 (switch) MoE: returns (output, load-balance aux loss).
+
+        Dispatch is the standard capacity-bucketed einsum form: tokens route
+        to [E, C, D] buckets; with `we_in`/`we_out` sharded over the expert
+        mesh axis GSPMD lowers the dispatch/combine einsums to all-to-alls
+        over ICI (SURVEY.md §2.5 EP row).
+        """
+        c = self.config
+        b, s, d = h.shape
+        e = c.n_experts
+        t = b * s
+        cap = max(1, int(c.capacity_factor * t / e))
+        x = h.reshape(t, d)
+
+        gates = jax.nn.softmax(
+            jnp.einsum("td,de->te", x, blk["router"].astype(c.dtype)).astype(
+                jnp.float32
+            )
+        )  # [T, E] fp32: routing decisions must not round in bf16
+        idx = jnp.argmax(gates, axis=-1)
+        gate = jnp.max(gates, axis=-1)
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [T, E]
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot   # position in expert
+        within = pos < cap
+        dispatch = jnp.einsum(
+            "te,tec->tec", onehot * within,
+            jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32),
+        )  # [T, E, C]
+
+        xe = jnp.einsum("tec,td->ecd", dispatch.astype(c.dtype), x)
+        if not manual:
+            xe = self._constrain(xe, P("expert", None, None))
+        he = jax.nn.gelu(
+            jnp.einsum("ecd,edf->ecf", xe, blk["we_in"].astype(c.dtype))
+            + blk["be_in"].astype(c.dtype)[:, None, :]
+        )
+        ye = jnp.einsum("ecf,efd->ecd", he, blk["we_out"].astype(c.dtype))
+        if not manual:
+            ye = self._constrain(ye, P("expert", None, None))
+        combine = dispatch * gate[:, None, None]
+        y = jnp.einsum("tec,ecd->td", combine.astype(c.dtype), ye)
+        y = y + blk["bo_mlp"].astype(c.dtype)
+
+        # Switch-transformer load-balance loss: E * Σ_e fraction_tokens_e ·
+        # mean_gate_e — pushes the router toward uniform expert usage.
+        frac = jnp.mean(onehot, axis=0)
+        mean_gate = jnp.mean(gates, axis=0)
+        aux = e * jnp.sum(frac * mean_gate)
+        return y.reshape(b, s, d), aux
+
+    def _block(
+        self, x: jax.Array, blk: Dict[str, jax.Array], *, manual: bool = False
+    ) -> Tuple[jax.Array, jax.Array]:
+        """One transformer block → (x, moe_aux). `manual` = running inside a
+        shard_map manual region (pipeline stage): no sharding constraints, no
+        nested shard_map (dense attention)."""
         c = self.config
         act_spec = P(("data", "fsdp"), "context", None)
 
@@ -176,37 +277,41 @@ class GPT(Model):
             + blk["bqkv"].astype(c.dtype)
         )
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        o = attn_mod.attention(q, k, v, mesh=self.mesh, causal=True, impl=c.attn_impl)
+        if manual:
+            o = attn_mod.attention(q, k, v, mesh=None, causal=True, impl="dense")
+        else:
+            o = attn_mod.attention(
+                q, k, v, mesh=self.mesh, causal=True, impl=c.attn_impl
+            )
         o = jnp.einsum("bshk,hkd->bsd", o, blk["wo"].astype(c.dtype))
         o = o + blk["bo"].astype(c.dtype)
-        x = self._constrain(x + o, act_spec)
+        x = x + o
+        if not manual:
+            x = self._constrain(x, act_spec)
 
         h = _layernorm(x, blk["ln2_scale"], blk["ln2_bias"])
-        h = jnp.einsum("bsd,df->bsf", h, blk["wi"].astype(c.dtype))
-        h = jax.nn.gelu(h + blk["bi"].astype(c.dtype))
-        h = jnp.einsum("bsf,fd->bsd", h, blk["wo_mlp"].astype(c.dtype))
-        h = h + blk["bo_mlp"].astype(c.dtype)
-        return self._constrain(x + h, act_spec)
+        if c.n_experts:
+            m, aux = self._moe_mlp(h, blk, manual)
+        else:
+            m = jnp.einsum("bsd,df->bsf", h, blk["wi"].astype(c.dtype))
+            m = jax.nn.gelu(m + blk["bi"].astype(c.dtype))
+            m = jnp.einsum("bsf,fd->bsd", m, blk["wo_mlp"].astype(c.dtype))
+            m = m + blk["bo_mlp"].astype(c.dtype)
+            aux = jnp.zeros((), jnp.float32)
+        x = x + m
+        if not manual:
+            x = self._constrain(x, act_spec)
+        return x, aux
 
-    def apply(self, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
-        """tokens [B, S] int32 → logits [B, S, V] (compute dtype)."""
+    def _embed(self, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
         c = self.config
-        b, s = tokens.shape
+        s = tokens.shape[1]
         x = params["tok_embed"].astype(c.dtype)[tokens]
         x = x + params["pos_embed"].astype(c.dtype)[:s]
-        x = self._constrain(x, P(("data", "fsdp"), "context", None))
+        return self._constrain(x, P(("data", "fsdp"), "context", None))
 
-        block_fn = self._block
-        if c.remat:
-            block_fn = jax.checkpoint(
-                block_fn,
-                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            )
-
-        def body(carry: jax.Array, blk: Dict[str, jax.Array]) -> Tuple[jax.Array, None]:
-            return block_fn(carry, blk), None
-
-        x, _ = lax.scan(body, x, params["blocks"])
+    def _head(self, params: Dict[str, Any], x: jax.Array) -> jax.Array:
+        c = self.config
         x = _layernorm(x, params["lnf_scale"], params["lnf_bias"])
         w_out = (
             params["tok_embed"].T if c.tie_embeddings else params["head"]
@@ -214,13 +319,117 @@ class GPT(Model):
         logits = jnp.einsum("bsd,dv->bsv", x, w_out)
         return self._constrain(logits, P(("data", "fsdp"), "context", "tensor"))
 
+    def _forward(
+        self, params: Dict[str, Any], tokens: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """→ (logits [B, S, V], moe aux loss)."""
+        c = self.config
+        if c.pipeline_stages > 1:
+            return self._apply_pipelined(params, tokens)
+
+        x = self._embed(params, tokens)
+        block_fn = functools.partial(self._block, manual=False)
+        if c.remat:
+            block_fn = jax.checkpoint(
+                block_fn,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+
+        def body(carry, blk):
+            x, aux = carry
+            x, blk_aux = block_fn(x, blk)
+            return (x, aux + blk_aux), None
+
+        (x, aux), _ = lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), params["blocks"]
+        )
+        return self._head(params, x), aux
+
+    def _apply_pipelined(
+        self, params: Dict[str, Any], tokens: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """GPipe schedule over the mesh's `pipeline` axis (parallel/pipeline.py).
+
+        Embedding and LM head stay outside the pipeline (replicated across
+        stages); block params reshape [L, ...] → [stages, L/stages, ...] and
+        shard over `pipeline`; other mesh axes stay under GSPMD control
+        (shard_map axis_names={'pipeline'} partial-manual mode).
+        """
+        from jax import shard_map
+
+        from determined_tpu.parallel.pipeline import pipeline_apply
+
+        c = self.config
+        n_stages = c.pipeline_stages
+        assert self.mesh is not None, "pipeline parallelism needs a mesh"
+        assert self.mesh.shape["pipeline"] == n_stages, (
+            f"mesh pipeline axis {self.mesh.shape['pipeline']} != "
+            f"config pipeline_stages {n_stages}"
+        )
+        assert c.n_layers % n_stages == 0
+        assert not c.n_experts, "MoE+pipeline composition not supported yet"
+        b = tokens.shape[0]
+        m = c.num_microbatches or 2 * n_stages
+        assert b % m == 0, f"batch {b} not divisible by {m} microbatches"
+
+        x = self._embed(params, tokens)
+        # Carries through the pipeline's scan/ppermute stay fp32: bf16
+        # loop-carried values under partial-manual shard_map trip an XLA
+        # SPMD-partitioner check failure ("invalid binary instruction opcode
+        # copy"); compute inside each block still runs in the compute dtype.
+        micro = x.reshape(m, b // m, *x.shape[1:]).astype(jnp.float32)
+        micro = self._constrain(micro, P(None, ("data", "fsdp"), "context", None))
+
+        per_stage = c.n_layers // n_stages
+        stage_blocks = jax.tree.map(
+            lambda leaf: leaf.reshape(n_stages, per_stage, *leaf.shape[1:]),
+            params["blocks"],
+        )
+
+        block_fn = functools.partial(self._block, manual=True)
+        if c.remat:
+            block_fn = jax.checkpoint(
+                block_fn,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+
+        def stage_fn(sp, act):
+            sp = jax.tree.map(lambda leaf: leaf[0], sp)  # drop stage dim (=1)
+
+            def body(carry, blk):
+                out, _aux = block_fn(carry.astype(c.dtype), blk)
+                return out.astype(jnp.float32), None
+
+            out, _ = lax.scan(body, act, sp)
+            return out
+
+        piped = shard_map(
+            functools.partial(pipeline_apply, stage_fn),
+            mesh=self.mesh,
+            in_specs=(
+                jax.tree.map(lambda _: P("pipeline"), stage_blocks),
+                P(),
+            ),
+            out_specs=P(),
+            axis_names={"pipeline"},
+            check_vma=False,
+        )
+        out = piped(stage_blocks, micro)  # [M, mb, S, D] fp32
+        x = out.reshape(b, *out.shape[2:]).astype(c.dtype)
+        return self._head(params, x), jnp.zeros((), jnp.float32)
+
+    def apply(self, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
+        """tokens [B, S] int32 → logits [B, S, V] (compute dtype)."""
+        return self._forward(params, tokens)[0]
+
     # -- loss --------------------------------------------------------------
     def loss(
         self, params: Dict[str, Any], batch: Dict[str, jax.Array], rng: jax.Array
     ) -> Tuple[jax.Array, Metrics]:
         del rng  # no dropout in the pretraining configs
         tokens = batch["tokens"]
-        logits = self.apply(params, tokens).astype(jnp.float32)
+        logits, moe_aux = self._forward(params, tokens)
+        logits = logits.astype(jnp.float32)
         # Next-token prediction: position i predicts token i+1.
         logits = logits[:, :-1]
         targets = tokens[:, 1:]
@@ -239,6 +448,10 @@ class GPT(Model):
         loss = jnp.sum(nll * mask) / n
         if self.config.z_loss:
             loss = loss + self.config.z_loss * jnp.sum(jnp.square(lse) * mask) / n
+        if self.config.n_experts:
+            # 0.01 is the standard switch-transformer aux weight; mean over
+            # layers (aux accumulated once per block in the scan).
+            loss = loss + 0.01 * moe_aux / self.config.n_layers
         acc = jnp.sum((jnp.argmax(logits, -1) == targets) * mask) / n
         return loss, {"loss": loss, "accuracy": acc, "tokens": jnp.sum(mask)}
 

@@ -1,0 +1,268 @@
+// Native data loader: mmap'd token shards -> prefetched [batch, seq] blocks.
+//
+// The TPU-native answer to the reference platform's high-throughput input
+// pipelines (which it delegated to torch DataLoader workers): on a TPU host
+// the input pipeline must keep the chips fed without stealing the Python
+// thread that drives the device queue, so batch assembly runs here on C++
+// threads and Python only moves ready buffers (ctypes, zero-copy into the
+// caller's numpy array).
+//
+// Design:
+// - Shards are flat little-endian token files (uint16 or int32), mmap'd
+//   read-only; the "dataset" is their concatenation.
+// - Batch i is DETERMINISTIC given (seed, i): each row's start offset comes
+//   from splitmix64(seed, i*rows + r) (shuffle mode) or a strided cursor
+//   (sequential mode). Determinism makes resume O(1): skip(n) just advances
+//   the batch counter — the exact analog of the trainer's data fast-forward,
+//   without replaying generation.
+// - A bounded ring of worker threads assembles batches ahead of the
+//   consumer (queue_depth deep), blocking when full.
+//
+// C ABI only (no pybind11 in this environment); see
+// determined_tpu/data/native.py for the ctypes binding.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <mutex>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Shard {
+  const uint8_t* data = nullptr;
+  size_t bytes = 0;
+  int fd = -1;
+};
+
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+struct Batch {
+  uint64_t index;
+  std::vector<int32_t> tokens;
+};
+
+struct Loader {
+  std::vector<Shard> shards;
+  uint64_t total_tokens = 0;
+  int token_bytes = 2;  // 2 = uint16, 4 = int32
+  int batch = 0;
+  int seq = 0;
+  uint64_t seed = 0;
+  bool shuffle = true;
+  // producer state
+  std::atomic<uint64_t> next_to_produce{0};
+  uint64_t next_to_consume = 0;
+  size_t queue_depth = 4;
+  std::deque<Batch> ready;
+  std::mutex mu;
+  std::condition_variable cv_ready;
+  std::condition_variable cv_space;
+  std::vector<std::thread> workers;
+  bool stopping = false;
+
+  int32_t token_at(uint64_t idx) const {
+    // Locate the shard holding global token idx (shard count is small:
+    // linear scan beats binary search in practice for <100 shards).
+    for (const Shard& s : shards) {
+      uint64_t n = s.bytes / token_bytes;
+      if (idx < n) {
+        if (token_bytes == 2) {
+          uint16_t v;
+          std::memcpy(&v, s.data + idx * 2, 2);
+          return static_cast<int32_t>(v);
+        }
+        int32_t v;
+        std::memcpy(&v, s.data + idx * 4, 4);
+        return v;
+      }
+      idx -= n;
+    }
+    return 0;  // unreachable given bounds checks upstream
+  }
+
+  void fill_row(uint64_t start, int32_t* out) const {
+    // Rows never wrap shard boundaries logically; they wrap the dataset.
+    for (int t = 0; t < seq; ++t) {
+      out[t] = token_at((start + t) % total_tokens);
+    }
+  }
+
+  void assemble(uint64_t batch_idx, std::vector<int32_t>& out) const {
+    out.resize(static_cast<size_t>(batch) * seq);
+    uint64_t max_start = total_tokens > static_cast<uint64_t>(seq)
+                             ? total_tokens - seq
+                             : 1;
+    for (int r = 0; r < batch; ++r) {
+      uint64_t start;
+      if (shuffle) {
+        start = splitmix64(seed ^ (batch_idx * static_cast<uint64_t>(batch) + r)) %
+                max_start;
+      } else {
+        start = (batch_idx * static_cast<uint64_t>(batch) + r) *
+                static_cast<uint64_t>(seq) % max_start;
+      }
+      fill_row(start, out.data() + static_cast<size_t>(r) * seq);
+    }
+  }
+
+  void worker_loop() {
+    while (true) {
+      uint64_t idx;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_space.wait(lk, [&] {
+          return stopping ||
+                 (ready.size() < queue_depth &&
+                  next_to_produce.load() < next_to_consume + 2 * queue_depth);
+        });
+        if (stopping) return;
+        idx = next_to_produce.fetch_add(1);
+      }
+      Batch b;
+      b.index = idx;
+      assemble(idx, b.tokens);
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        ready.push_back(std::move(b));
+        cv_ready.notify_all();
+      }
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle (heap pointer) or nullptr on failure.
+void* dl_open(const char** paths, int n_paths, int token_bytes, int batch,
+              int seq, uint64_t seed, int shuffle, int n_threads,
+              int queue_depth) {
+  if (n_paths <= 0 || (token_bytes != 2 && token_bytes != 4) || batch <= 0 ||
+      seq <= 0) {
+    return nullptr;
+  }
+  auto* L = new Loader();
+  L->token_bytes = token_bytes;
+  L->batch = batch;
+  L->seq = seq;
+  L->seed = seed;
+  L->shuffle = shuffle != 0;
+  L->queue_depth = queue_depth > 0 ? queue_depth : 4;
+  // Every failure path must release shards already mapped — callers probe
+  // (native-then-fallback), so leaks here accumulate per attempt.
+  auto fail = [&L]() -> void* {
+    for (Shard& s : L->shards) {
+      munmap(const_cast<uint8_t*>(s.data), s.bytes);
+      ::close(s.fd);
+    }
+    delete L;
+    return nullptr;
+  };
+  for (int i = 0; i < n_paths; ++i) {
+    Shard s;
+    s.fd = ::open(paths[i], O_RDONLY);
+    if (s.fd < 0) return fail();
+    struct stat st;
+    if (fstat(s.fd, &st) != 0 || st.st_size == 0) {
+      ::close(s.fd);
+      return fail();
+    }
+    s.bytes = static_cast<size_t>(st.st_size) -
+              (static_cast<size_t>(st.st_size) % token_bytes);
+    s.data = static_cast<const uint8_t*>(
+        mmap(nullptr, s.bytes, PROT_READ, MAP_PRIVATE, s.fd, 0));
+    if (s.data == MAP_FAILED) {
+      ::close(s.fd);
+      return fail();
+    }
+    madvise(const_cast<uint8_t*>(s.data), s.bytes, MADV_RANDOM);
+    L->shards.push_back(s);
+    L->total_tokens += s.bytes / token_bytes;
+  }
+  if (L->total_tokens < static_cast<uint64_t>(seq) + 1) {
+    return fail();  // not enough tokens for one row
+  }
+  int threads = n_threads > 0 ? n_threads : 2;
+  for (int i = 0; i < threads; ++i) {
+    L->workers.emplace_back([L] { L->worker_loop(); });
+  }
+  return L;
+}
+
+uint64_t dl_total_tokens(void* handle) {
+  return static_cast<Loader*>(handle)->total_tokens;
+}
+
+// Fills out[batch*seq] with the NEXT batch (in-order). Returns 0 on success.
+int dl_next(void* handle, int32_t* out) {
+  auto* L = static_cast<Loader*>(handle);
+  std::unique_lock<std::mutex> lk(L->mu);
+  uint64_t want = L->next_to_consume;
+  for (;;) {
+    for (auto it = L->ready.begin(); it != L->ready.end(); ++it) {
+      if (it->index == want) {
+        std::memcpy(out, it->tokens.data(), it->tokens.size() * 4);
+        L->ready.erase(it);
+        L->next_to_consume = want + 1;
+        L->cv_space.notify_all();
+        return 0;
+      }
+    }
+    // Drop stale batches produced before a skip().
+    while (!L->ready.empty() && L->ready.front().index < want) {
+      L->ready.pop_front();
+      L->cv_space.notify_all();
+    }
+    L->cv_ready.wait(lk);
+    if (L->stopping) return 1;
+  }
+}
+
+// O(1) resume fast-forward: batches are deterministic in their index.
+void dl_skip(void* handle, uint64_t n_batches) {
+  auto* L = static_cast<Loader*>(handle);
+  std::unique_lock<std::mutex> lk(L->mu);
+  L->next_to_consume += n_batches;
+  uint64_t p = L->next_to_produce.load();
+  if (p < L->next_to_consume) L->next_to_produce.store(L->next_to_consume);
+  // Anything already assembled for skipped indices is stale.
+  std::deque<Batch> kept;
+  for (auto& b : L->ready) {
+    if (b.index >= L->next_to_consume) kept.push_back(std::move(b));
+  }
+  L->ready.swap(kept);
+  L->cv_space.notify_all();
+}
+
+void dl_close(void* handle) {
+  auto* L = static_cast<Loader*>(handle);
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->stopping = true;
+    L->cv_space.notify_all();
+    L->cv_ready.notify_all();
+  }
+  for (auto& t : L->workers) t.join();
+  for (Shard& s : L->shards) {
+    munmap(const_cast<uint8_t*>(const_cast<const uint8_t*>(s.data)), s.bytes);
+    ::close(s.fd);
+  }
+  delete L;
+}
+
+}  // extern "C"

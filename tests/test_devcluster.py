@@ -89,6 +89,41 @@ class TestDevClusterE2E:
         # trial still finished its full length.
         assert all(t["steps_completed"] == 2 for t in trials)
 
+    def test_agent_failure_fails_over_trial(self, tmp_path):
+        # Dedicated cluster: we kill one of its agents mid-trial.
+        with DevCluster(n_agents=2, slots_per_agent=1) as dc:
+            deadline = time.time() + 30
+            while time.time() < deadline and len(dc.master.agent_hub.list()) < 2:
+                time.sleep(0.2)
+            cfg = _config(
+                tmp_path,
+                searcher={"name": "single", "max_length": 30, "metric": "loss"},
+                hyperparameters={
+                    "model": "mnist-mlp", "batch_size": 16, "lr": 1e-3,
+                    "sleep_s": 0.3,
+                },
+                max_restarts=2,
+            )
+            exp_id = dc.create_experiment(cfg)
+            # Wait for the trial to be running on some agent.
+            deadline = time.time() + 120
+            victim = None
+            while time.time() < deadline and victim is None:
+                for agent in dc.agents:
+                    if agent._tasks:
+                        victim = agent
+                        break
+                time.sleep(0.3)
+            assert victim is not None, "trial never started"
+
+            dc.kill_agent(victim)  # agent dies; master fails the alloc over
+
+            state = dc.wait_experiment(exp_id, timeout=300)
+            assert state == "COMPLETED"
+            trial = dc.master.db.list_trials(exp_id)[0]
+            assert trial["restarts"] >= 1  # failure consumed restart budget
+            assert trial["steps_completed"] == 30
+
     def test_pause_checkpoint_resume(self, cluster, tmp_path):
         cfg = _config(
             tmp_path,
