@@ -110,7 +110,11 @@ class AgentDaemon:
                 continue
             for action in resp.get("actions", []):
                 if action.get("type") == "REREGISTER":
-                    # Master doesn't know us (restart or liveness reap).
+                    # Master doesn't know us (restart or liveness reap). Our
+                    # allocations were failed over on the master side, so
+                    # kill the local orphans before advertising free slots —
+                    # otherwise they'd fight the restarted trial for chips.
+                    self._kill_all_tasks()
                     needs_register = True
                     continue
                 try:
@@ -118,12 +122,15 @@ class AgentDaemon:
                 except Exception:  # noqa: BLE001
                     logger.exception("action failed: %s", action.get("type"))
 
-    def stop(self) -> None:
-        self._stop.set()
+    def _kill_all_tasks(self) -> None:
         with self._lock:
             tasks = list(self._tasks.values())
         for t in tasks:
             self._kill(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._kill_all_tasks()
 
     # -- actions ---------------------------------------------------------------
     def handle(self, action: Dict[str, Any]) -> None:

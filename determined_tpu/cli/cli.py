@@ -184,6 +184,59 @@ def ckpt_list(args: argparse.Namespace) -> None:
     )
 
 
+# -- commands (NTSC) -----------------------------------------------------------
+def cmd_run(args: argparse.Namespace) -> None:
+    entrypoint = " ".join(args.cmd)
+    cfg = {"entrypoint": entrypoint, "resources": {"slots": args.slots}}
+    resp = _session(args).post("/api/v1/commands", json_body={"config": cfg})
+    print(f"Launched command {resp['task_id']}")
+
+
+def cmd_list(args: argparse.Namespace) -> None:
+    cmds = _session(args).get("/api/v1/commands")["commands"]
+    _table(cmds, ["task_id", "task_type", "state", "exit_code"])
+
+
+def cmd_logs(args: argparse.Namespace) -> None:
+    logs = _session(args).get(
+        "/api/v1/task_logs", params={"task_id": args.task_id}
+    )["logs"]
+    for line in logs:
+        print(line["log"])
+
+
+def cmd_kill(args: argparse.Namespace) -> None:
+    _session(args).post(f"/api/v1/commands/{args.task_id}/kill")
+    print(f"killed {args.task_id}")
+
+
+# -- model registry ------------------------------------------------------------
+def model_create(args: argparse.Namespace) -> None:
+    _session(args).post(
+        "/api/v1/models",
+        json_body={"name": args.name, "description": args.description or ""},
+    )
+    print(f"Created model {args.name}")
+
+
+def model_list(args: argparse.Namespace) -> None:
+    models = _session(args).get("/api/v1/models")["models"]
+    _table(models, ["name", "description"])
+
+
+def model_register(args: argparse.Namespace) -> None:
+    resp = _session(args).post(
+        f"/api/v1/models/{args.name}/versions",
+        json_body={"checkpoint_uuid": args.checkpoint_uuid},
+    )
+    print(f"Registered {args.name} v{resp['version']}")
+
+
+def model_versions(args: argparse.Namespace) -> None:
+    versions = _session(args).get(f"/api/v1/models/{args.name}/versions")["versions"]
+    _table(versions, ["version", "checkpoint_uuid"])
+
+
 # -- cluster ------------------------------------------------------------------
 def agent_list(args: argparse.Namespace) -> None:
     agents = _session(args).get("/api/v1/agents")["agents"]
@@ -273,6 +326,35 @@ def build_parser() -> argparse.ArgumentParser:
     v = ckpt.add_parser("list")
     v.add_argument("trial_id", type=int)
     v.set_defaults(fn=ckpt_list)
+
+    cmd = sub.add_parser("cmd", aliases=["command"]).add_subparsers(
+        dest="verb", required=True)
+    v = cmd.add_parser("run")
+    v.add_argument("--slots", type=int, default=0)
+    v.add_argument("cmd", nargs=argparse.REMAINDER)
+    v.set_defaults(fn=cmd_run)
+    cmd.add_parser("list").set_defaults(fn=cmd_list)
+    v = cmd.add_parser("logs")
+    v.add_argument("task_id")
+    v.set_defaults(fn=cmd_logs)
+    v = cmd.add_parser("kill")
+    v.add_argument("task_id")
+    v.set_defaults(fn=cmd_kill)
+
+    model = sub.add_parser("model", aliases=["m"]).add_subparsers(
+        dest="verb", required=True)
+    v = model.add_parser("create")
+    v.add_argument("name")
+    v.add_argument("--description", default="")
+    v.set_defaults(fn=model_create)
+    model.add_parser("list").set_defaults(fn=model_list)
+    v = model.add_parser("register-version")
+    v.add_argument("name")
+    v.add_argument("checkpoint_uuid")
+    v.set_defaults(fn=model_register)
+    v = model.add_parser("versions")
+    v.add_argument("name")
+    v.set_defaults(fn=model_versions)
 
     agent = sub.add_parser("agent", aliases=["a"]).add_subparsers(
         dest="verb", required=True)

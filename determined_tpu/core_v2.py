@@ -1,0 +1,115 @@
+"""Unmanaged trials: run anywhere, report to the master.
+
+Rebuild of the reference's experimental Core API v2
+(`harness/determined/experimental/core_v2/_core_v2.py:219` +
+`_unmanaged.py`): a training script running OUTSIDE the cluster (laptop,
+colab VM, externally-scheduled TPU) creates an unmanaged experiment+trial
+over the REST API and gets a full core Context — metrics, checkpoints,
+searcher ops, progress all land in the master exactly like managed trials;
+only scheduling/preemption are absent (the master never launches anything:
+`unmanaged: true` experiments use a null launcher). A heartbeat thread
+marks liveness (ref: core/_heartbeat.py).
+
+    ctx = core_v2.init(master_url="http://master:8080",
+                       config={"name": "laptop-run", "searcher": {...}})
+    for op in ctx.searcher.operations(): ...
+"""
+from __future__ import annotations
+
+import atexit
+import logging
+import threading
+from typing import Any, Dict, Optional
+
+from determined_tpu.common.api_session import Session
+from determined_tpu.core._checkpoint import CheckpointContext
+from determined_tpu.core._context import Context
+from determined_tpu.core._distributed import DistributedContext, DummyDistributedContext
+from determined_tpu.core._preempt import DummyPreemptContext
+from determined_tpu.core._searcher import SearcherContext
+from determined_tpu.core._train import TrainContext
+from determined_tpu.storage import from_config as storage_from_config
+
+logger = logging.getLogger("determined_tpu.core_v2")
+
+
+class _Heartbeat(threading.Thread):
+    def __init__(self, session: Session, trial_id: int, interval_s: float = 30.0):
+        super().__init__(daemon=True, name="unmanaged-heartbeat")
+        self._session = session
+        self._trial_id = trial_id
+        self._interval = interval_s
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self._session.post(
+                    f"/api/v1/trials/{self._trial_id}/status",
+                    json_body={"status": "RUNNING"},
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.warning("heartbeat failed: %s", e)
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+class UnmanagedContext(Context):
+    def __init__(self, *, trial_id: int, experiment_id: int, heartbeat: _Heartbeat,
+                 **kw: Any) -> None:
+        super().__init__(**kw)
+        self.trial_id = trial_id
+        self.experiment_id = experiment_id
+        self._heartbeat = heartbeat
+
+    def close(self) -> None:
+        self._heartbeat.close()
+        super().close()
+
+
+def init(
+    *,
+    master_url: str,
+    config: Optional[Dict[str, Any]] = None,
+    distributed: Optional[DistributedContext] = None,
+    checkpoint_storage: Optional[Dict[str, Any]] = None,
+) -> UnmanagedContext:
+    """Create an unmanaged experiment + trial and return its core Context."""
+    config = dict(config or {})
+    config["unmanaged"] = True
+    config.setdefault("entrypoint", "unmanaged")
+    config.setdefault("searcher", {"name": "single", "max_length": 1})
+    if checkpoint_storage is not None:
+        config.setdefault("checkpoint_storage", checkpoint_storage)
+
+    session = Session(master_url)
+    exp_id = int(
+        session.post("/api/v1/experiments", json_body={"config": config})["id"]
+    )
+    trials = session.get(f"/api/v1/experiments/{exp_id}/trials")["trials"]
+    assert trials, "unmanaged experiment should have created its trial"
+    trial_id = int(trials[0]["id"])
+    logger.info("unmanaged experiment %d / trial %d created", exp_id, trial_id)
+
+    dist = distributed or DummyDistributedContext()
+    storage = storage_from_config(config.get("checkpoint_storage"))
+    heartbeat = _Heartbeat(session, trial_id)
+    heartbeat.start()
+    ctx = UnmanagedContext(
+        trial_id=trial_id,
+        experiment_id=exp_id,
+        heartbeat=heartbeat,
+        distributed=dist,
+        train=TrainContext(session, trial_id),
+        checkpoint=CheckpointContext(
+            dist, storage, session=session,
+            task_id=f"unmanaged-{trial_id}", allocation_id=f"un.{trial_id}",
+            trial_id=trial_id,
+        ),
+        preempt=DummyPreemptContext(dist),
+        searcher=SearcherContext(session, dist, trial_id),
+        session=session,
+    )
+    atexit.register(heartbeat.close)
+    return ctx

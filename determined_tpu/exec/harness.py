@@ -64,6 +64,13 @@ def run(entrypoint: str) -> int:
     scfg = cfg.get("searcher", {})
     try:
         with core.init() as ctx:
+            tb_dir = None
+            if cfg.get("tensorboard", True):
+                import tempfile
+
+                tb_dir = os.path.join(
+                    tempfile.gettempdir(), f"dtpu-tb-{info.task_id}"
+                )
             trainer = Trainer(
                 trial,
                 ctx,
@@ -71,6 +78,8 @@ def run(entrypoint: str) -> int:
                 seed=info.trial.trial_seed,
                 searcher_metric=scfg.get("metric", "loss"),
                 smaller_is_better=bool(scfg.get("smaller_is_better", True)),
+                profiling=bool(cfg.get("profiling", {}).get("enabled", False)),
+                tensorboard_dir=tb_dir,
             )
             trainer.fit(
                 validation_period=parse_unit(cfg.get("min_validation_period")),

@@ -81,7 +81,9 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         return {}
 
     def post_status(r: ApiRequest):
-        return {}  # informational; the FSM owns real state
+        # Doubles as the unmanaged-trial heartbeat (core_v2._Heartbeat).
+        m.record_heartbeat(int(r.groups[0]))
+        return {}
 
     def best_validation(r: ApiRequest):
         trial_id = int(r.groups[0])
@@ -248,6 +250,81 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
     def trial_checkpoints(r: ApiRequest):
         return {"checkpoints": m.db.list_checkpoints(int(r.groups[0]))}
 
+    # -- NTSC commands ----------------------------------------------------------
+    def create_command(r: ApiRequest):
+        return {"task_id": m.create_command(r.body["config"])}
+
+    def list_commands(r: ApiRequest):
+        return {"commands": m.list_commands()}
+
+    def kill_command(r: ApiRequest):
+        m.kill_command(r.groups[0])
+        return {}
+
+    # -- model registry ---------------------------------------------------------
+    def create_model(r: ApiRequest):
+        m.db.add_model(
+            r.body["name"], r.body.get("description", ""), r.body.get("metadata")
+        )
+        return m.db.get_model(r.body["name"])
+
+    def list_models(r: ApiRequest):
+        return {"models": m.db.list_models()}
+
+    def get_model(r: ApiRequest):
+        model = m.db.get_model(r.groups[0])
+        if model is None:
+            raise ApiError(404, "no such model")
+        return model
+
+    def create_model_version(r: ApiRequest):
+        name = r.groups[0]
+        if m.db.get_model(name) is None:
+            raise ApiError(404, "no such model")
+        if m.db.get_checkpoint(r.body["checkpoint_uuid"]) is None:
+            raise ApiError(404, "no such checkpoint")
+        version = m.db.add_model_version(
+            name, r.body["checkpoint_uuid"], r.body.get("metadata")
+        )
+        return {"version": version}
+
+    def list_model_versions(r: ApiRequest):
+        return {"versions": m.db.list_model_versions(r.groups[0])}
+
+    # -- workspaces / projects ----------------------------------------------------
+    def create_workspace(r: ApiRequest):
+        return {"id": m.db.add_workspace(r.body["name"])}
+
+    def list_workspaces(r: ApiRequest):
+        return {"workspaces": m.db.list_workspaces()}
+
+    def create_project(r: ApiRequest):
+        return {
+            "id": m.db.add_project(
+                r.body["name"], int(r.body.get("workspace_id", 1))
+            )
+        }
+
+    def list_projects(r: ApiRequest):
+        wid = r.q("workspace_id")
+        return {"projects": m.db.list_projects(int(wid) if wid else None)}
+
+    # -- webhooks -----------------------------------------------------------------
+    def create_webhook(r: ApiRequest):
+        return {
+            "id": m.db.add_webhook(
+                r.body["url"],
+                r.body.get("trigger_states", ["COMPLETED", "ERRORED"]),
+            )
+        }
+
+    def list_webhooks(r: ApiRequest):
+        return {"webhooks": m.db.list_webhooks()}
+
+    def delete_webhook(r: ApiRequest):
+        m.db.delete_webhook(int(r.groups[0]))
+        return {}
+
     def master_info(r: ApiRequest):
         return {
             "cluster_id": m.cluster_id,
@@ -281,6 +358,21 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("GET", r"/api/v1/agents/([\w.\-]+)/actions", agent_actions),
         R("POST", r"/api/v1/agents/([\w.\-]+)/events", agent_events),
         R("GET", r"/api/v1/agents", list_agents),
+        R("POST", r"/api/v1/commands", create_command),
+        R("GET", r"/api/v1/commands", list_commands),
+        R("POST", r"/api/v1/commands/([\w.\-]+)/kill", kill_command),
+        R("POST", r"/api/v1/models", create_model),
+        R("GET", r"/api/v1/models", list_models),
+        R("GET", r"/api/v1/models/([\w.\-]+)/versions", list_model_versions),
+        R("POST", r"/api/v1/models/([\w.\-]+)/versions", create_model_version),
+        R("GET", r"/api/v1/models/([\w.\-]+)", get_model),
+        R("POST", r"/api/v1/workspaces", create_workspace),
+        R("GET", r"/api/v1/workspaces", list_workspaces),
+        R("POST", r"/api/v1/projects", create_project),
+        R("GET", r"/api/v1/projects", list_projects),
+        R("POST", r"/api/v1/webhooks", create_webhook),
+        R("GET", r"/api/v1/webhooks", list_webhooks),
+        R("DELETE", r"/api/v1/webhooks/(\d+)", delete_webhook),
         R("POST", r"/api/v1/experiments", create_experiment),
         R("GET", r"/api/v1/experiments", list_experiments),
         R("GET", r"/api/v1/experiments/(\d+)", get_experiment),

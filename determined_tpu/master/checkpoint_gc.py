@@ -1,0 +1,104 @@
+"""Checkpoint garbage collection: the save_*_best/latest retention policy.
+
+Rebuild of the reference's GC pipeline (`internal/checkpoint_gc.go:30` +
+`harness/determined/exec/gc_checkpoints.py:53` + the expconf
+`save_experiment_best / save_trial_best / save_trial_latest` knobs): when an
+experiment reaches a terminal state, every checkpoint not retained by the
+policy is deleted from storage and marked DELETED in the DB. The reference
+ran deletion inside a scheduled container; here the master deletes directly
+through the storage manager (it has the storage config), keeping the same
+policy semantics and DB accounting.
+
+Policy (expconf semantics):
+- save_trial_latest:    keep the N most recent checkpoints of each trial;
+- save_trial_best:      keep each trial's N best (by searcher metric at the
+                        checkpoint's steps_completed, falling back to the
+                        trial's searcher metric);
+- save_experiment_best: keep the N best checkpoints across the experiment.
+A checkpoint survives if ANY rule retains it.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Set
+
+from determined_tpu.master import db as db_mod
+from determined_tpu.storage import from_config as storage_from_config
+
+logger = logging.getLogger("determined_tpu.master")
+
+DEFAULTS = {"save_experiment_best": 0, "save_trial_best": 1, "save_trial_latest": 1}
+
+
+def _trial_metric_table(
+    db: db_mod.Database, trial_id: int, metric_name: str
+) -> Dict[int, float]:
+    """steps_completed -> metric, fetched once per trial (not per checkpoint)."""
+    table: Dict[int, float] = {}
+    for m in db.get_metrics(trial_id, "validation"):
+        if metric_name in m["body"]:
+            table[m["steps_completed"]] = float(m["body"][metric_name])
+    return table
+
+
+def plan_gc(
+    db: db_mod.Database, exp_id: int, config: Dict[str, Any]
+) -> List[Dict[str, Any]]:
+    """Checkpoints of `exp_id` the policy does NOT retain."""
+    storage_cfg = config.get("checkpoint_storage") or {}
+    policy = {k: int(storage_cfg.get(k, v)) for k, v in DEFAULTS.items()}
+    scfg = config.get("searcher", {})
+    metric_name = scfg.get("metric", "loss")
+    smaller = bool(scfg.get("smaller_is_better", True))
+
+    # Never GC a checkpoint the model registry references — a registered
+    # model version must stay downloadable (ref: registry/GC interaction).
+    keep: Set[str] = set(db.referenced_checkpoint_uuids())
+    all_ckpts: List[Dict[str, Any]] = []
+    scored: List[tuple] = []
+
+    for trial in db.list_trials(exp_id):
+        ckpts = db.list_checkpoints(trial["id"])
+        all_ckpts.extend(ckpts)
+        # latest N (list_checkpoints is steps-ordered)
+        for c in ckpts[-policy["save_trial_latest"]:] if policy["save_trial_latest"] else []:
+            keep.add(c["uuid"])
+        metric_table = _trial_metric_table(db, trial["id"], metric_name)
+        fallback = trial.get("searcher_metric")
+        trial_scored = []
+        for c in ckpts:
+            metric = metric_table.get(c["steps_completed"], fallback)
+            if metric is not None:
+                sort_key = metric if smaller else -metric
+                trial_scored.append((sort_key, c["uuid"]))
+                scored.append((sort_key, c["uuid"]))
+        trial_scored.sort()
+        for _, uuid in trial_scored[: policy["save_trial_best"]]:
+            keep.add(uuid)
+
+    scored.sort()
+    for _, uuid in scored[: policy["save_experiment_best"]]:
+        keep.add(uuid)
+
+    return [c for c in all_ckpts if c["uuid"] not in keep]
+
+
+def run_gc(db: db_mod.Database, exp_id: int, config: Dict[str, Any]) -> int:
+    """Delete non-retained checkpoints; returns how many were removed."""
+    victims = plan_gc(db, exp_id, config)
+    if not victims:
+        return 0
+    storage = storage_from_config(config.get("checkpoint_storage"))
+    n = 0
+    for c in victims:
+        try:
+            storage.delete(c["uuid"])
+        except FileNotFoundError:
+            pass  # already gone; still mark deleted
+        except Exception:  # noqa: BLE001 - one bad delete must not stop GC
+            logger.exception("failed to delete checkpoint %s", c["uuid"])
+            continue
+        db.mark_checkpoint_deleted(c["uuid"])
+        n += 1
+    logger.info("experiment %d GC: deleted %d checkpoint(s)", exp_id, n)
+    return n

@@ -18,11 +18,12 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from determined_tpu import _info
-from determined_tpu.master import db as db_mod
+from determined_tpu.master import checkpoint_gc, db as db_mod
 from determined_tpu.master.allocation import AllocationService
 from determined_tpu.master.experiment import Experiment, TrialRecord
 from determined_tpu.master.rm import ResourceManager
 from determined_tpu.master.scheduler import Request
+from determined_tpu.master.webhooks import WebhookShipper
 
 logger = logging.getLogger("determined_tpu.master")
 
@@ -131,53 +132,22 @@ class RMTrialLauncher:
             self.m._alloc_pool[alloc_id] = pool_name
 
         def on_start(req: Request, assignment: Dict[str, int]) -> None:
-            hosts = sorted(assignment)
-            self.m.alloc_service.create(
-                alloc_id, task_id=task_id, trial_id=rec.trial_id,
-                num_processes=len(hosts), slots=slots,
-            )
-            self.m.db.upsert_allocation(
-                alloc_id, task_id=task_id, trial_id=rec.trial_id,
-                state="ASSIGNED", slots=slots,
-            )
             trial_row = self.m.db.get_trial(rec.trial_id) or {}
-            for rank, agent_id in enumerate(hosts):
-                info = _info.ClusterInfo(
-                    master_url=self.m.external_url,
-                    cluster_id=self.m.cluster_id,
-                    agent_id=agent_id,
-                    session_token="",
-                    task_id=task_id,
-                    allocation_id=alloc_id,
-                    task_type="TRIAL",
-                    trial=_info.TrialInfo(
-                        trial_id=rec.trial_id,
-                        experiment_id=experiment.id,
-                        trial_seed=rec.seed,
-                        hparams=rec.hparams,
-                        config=cfg,
-                        latest_checkpoint=trial_row.get("latest_checkpoint"),
-                        trial_run_id=rec.run_id,
-                    ),
-                    checkpoint_storage=cfg.get("checkpoint_storage"),
-                )
-                env = info.to_env()
-                env["DTPU_ALLOC_RANK"] = str(rank)
-                env["DTPU_ALLOC_NUM_PROCS"] = str(len(hosts))
-                env["DTPU_SLOTS"] = str(assignment[agent_id])
-                jax_platform = cfg.get("environment", {}).get("jax_platform")
-                if jax_platform:
-                    env["DTPU_JAX_PLATFORM"] = jax_platform
-                self.m.agent_hub.enqueue(
-                    agent_id,
-                    {
-                        "type": "START",
-                        "alloc_id": alloc_id,
-                        "task_id": task_id,
-                        "entrypoint": cfg.get("entrypoint", ""),
-                        "env": env,
-                    },
-                )
+            trial_info = _info.TrialInfo(
+                trial_id=rec.trial_id,
+                experiment_id=experiment.id,
+                trial_seed=rec.seed,
+                hparams=rec.hparams,
+                config=cfg,
+                latest_checkpoint=trial_row.get("latest_checkpoint"),
+                trial_run_id=rec.run_id,
+            )
+            self.m.enqueue_start_actions(
+                alloc_id=alloc_id, task_id=task_id, task_type="TRIAL",
+                entrypoint=cfg.get("entrypoint", ""), assignment=assignment,
+                slots=slots, config=cfg, trial_info=trial_info,
+                trial_id=rec.trial_id,
+            )
 
         def on_preempt(a_id: str) -> None:
             self.m.alloc_service.signal_preempt(a_id)
@@ -225,6 +195,7 @@ class Master:
         external_url: str = "http://127.0.0.1:8080",
         preempt_timeout_s: float = 600.0,
         agent_timeout_s: float = 120.0,
+        unmanaged_timeout_s: float = 300.0,
     ) -> None:
         self.cluster_id = uuid.uuid4().hex[:8]
         self.external_url = external_url
@@ -234,20 +205,105 @@ class Master:
         self.agent_hub = AgentHub()
         self.launcher = RMTrialLauncher(self)
         self.agent_timeout_s = agent_timeout_s
+        self.unmanaged_timeout_s = unmanaged_timeout_s
+        self._heartbeats: Dict[int, float] = {}    # trial_id -> last beat
         self.experiments: Dict[int, Experiment] = {}
         self._alloc_index: Dict[str, tuple] = {}   # alloc_id -> (exp, trial_id)
         self._trial_allocs: Dict[int, str] = {}    # trial_id -> latest alloc_id
         self._alloc_pool: Dict[str, str] = {}      # alloc_id -> pool name
+        self._commands: Dict[str, Dict[str, Any]] = {}  # task_id -> command info
+        self._cmd_counter = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        self.webhooks = WebhookShipper(self.db)
+        # Background worker for slow reactions to FSM events (checkpoint GC):
+        # the state-change hook fires under the experiment lock and must not
+        # do storage IO inline.
+        import queue as queue_mod
+
+        self._work: "queue_mod.Queue" = queue_mod.Queue()
+        self._worker = threading.Thread(target=self._work_loop, daemon=True)
+        self._worker.start()
         self.alloc_service.set_exit_hook(self._allocation_exited)
         self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
         self._ticker.start()
+
+    def _work_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                job = self._work.get(timeout=1.0)
+            except Exception:  # noqa: BLE001 - queue.Empty
+                continue
+            try:
+                job()
+            except Exception:  # noqa: BLE001
+                logger.exception("background job failed")
+
+    def _on_exp_state(self, exp: Experiment, state: str) -> None:
+        self.webhooks.notify(exp.id, state, exp.config)
+        if state in db_mod.TERMINAL_STATES:
+            config = exp.config
+            exp_id = exp.id
+            self._work.put(
+                lambda: checkpoint_gc.run_gc(self.db, exp_id, config)
+            )
 
     def pool_of(self, alloc_id: str):
         with self._lock:
             name = self._alloc_pool.get(alloc_id)
         return self.rm.pool(name)
+
+    def enqueue_start_actions(
+        self,
+        *,
+        alloc_id: str,
+        task_id: str,
+        task_type: str,
+        entrypoint: str,
+        assignment: Dict[str, int],
+        slots: int,
+        config: Dict[str, Any],
+        trial_info: Optional[_info.TrialInfo] = None,
+        trial_id: Optional[int] = None,
+    ) -> None:
+        """Single source of the DTPU_* env contract: turn a placement into
+        per-host START actions (shared by trials and NTSC tasks — the
+        reference's TaskSpec builder role, master/pkg/tasks/task.go)."""
+        hosts = sorted(assignment)
+        self.alloc_service.create(
+            alloc_id, task_id=task_id, trial_id=trial_id,
+            num_processes=len(hosts), slots=slots,
+        )
+        self.db.upsert_allocation(
+            alloc_id, task_id=task_id, trial_id=trial_id,
+            state="ASSIGNED", slots=slots,
+        )
+        for rank, agent_id in enumerate(hosts):
+            info = _info.ClusterInfo(
+                master_url=self.external_url,
+                cluster_id=self.cluster_id,
+                agent_id=agent_id,
+                session_token="",
+                task_id=task_id,
+                allocation_id=alloc_id,
+                task_type=task_type,
+                trial=trial_info,
+                checkpoint_storage=config.get("checkpoint_storage"),
+            )
+            env = info.to_env()
+            env["DTPU_ALLOC_RANK"] = str(rank)
+            env["DTPU_ALLOC_NUM_PROCS"] = str(len(hosts))
+            env["DTPU_SLOTS"] = str(assignment[agent_id])
+            jax_platform = config.get("environment", {}).get("jax_platform")
+            if jax_platform:
+                env["DTPU_JAX_PLATFORM"] = jax_platform
+            self.agent_hub.enqueue(
+                agent_id,
+                {
+                    "type": "START", "alloc_id": alloc_id, "task_id": task_id,
+                    "entrypoint": entrypoint, "env": env,
+                },
+            )
 
     # -- background pump (replaces the actor system's message loop) ----------
     def _tick_loop(self) -> None:
@@ -265,21 +321,63 @@ class Master:
                 # applies; ref agent reattach flow, containers/manager.go:76).
                 for agent_id in self.agent_hub.reap_stale(self.agent_timeout_s):
                     self.lose_agent(agent_id)
+                self._reap_unmanaged()
             except Exception:  # noqa: BLE001
                 logger.exception("tick loop error")
+
+    def record_heartbeat(self, trial_id: int) -> None:
+        with self._lock:
+            self._heartbeats[trial_id] = time.time()
+
+    def _reap_unmanaged(self) -> None:
+        """Unmanaged-trial liveness: a silent driver means the trial errored
+        (ref: core_v2 heartbeat contract; no allocation exists to observe)."""
+        now = time.time()
+        with self._lock:
+            exps = [e for e in self.experiments.values() if e.unmanaged]
+        for exp in exps:
+            if exp.state in db_mod.TERMINAL_STATES:
+                continue
+            for rec in list(exp.trials.values()):
+                if rec.exited:
+                    continue
+                with self._lock:
+                    # Grace period starts at first observation of the trial.
+                    last = self._heartbeats.setdefault(rec.trial_id, now)
+                if now - last > self.unmanaged_timeout_s:
+                    logger.warning(
+                        "unmanaged trial %d heartbeat lost; marking errored",
+                        rec.trial_id,
+                    )
+                    exp.trial_exited(rec.trial_id, 1, "heartbeat lost")
 
     def lose_agent(self, agent_id: str) -> None:
         """Remove a dead agent and fail over everything it was running."""
         logger.warning("agent %s lost; failing over its allocations", agent_id)
         self.agent_hub.remove(agent_id)
         for pool in self.rm.pools.values():
+            # Snapshot placements BEFORE release: surviving hosts of a
+            # multi-agent gang still run their processes and must be killed,
+            # or they'd fight the restarted trial for the chips.
+            victims: Dict[str, Dict[str, int]] = {}
+            with pool._lock:
+                agent = pool._agents.get(agent_id)
+                if agent:
+                    for alloc_id in agent.used:
+                        victims[alloc_id] = dict(pool._running.get(alloc_id, {}))
             for alloc_id in pool.remove_agent(agent_id):
+                for other_agent in victims.get(alloc_id, {}):
+                    if other_agent != agent_id:
+                        self.agent_hub.enqueue(
+                            other_agent, {"type": "KILL", "alloc_id": alloc_id}
+                        )
                 self.alloc_service.complete(
                     alloc_id, exit_code=1, reason=f"agent {agent_id} lost"
                 )
 
     def shutdown(self) -> None:
         self._stop.set()
+        self.webhooks.stop()
 
     # -- allocation exits ------------------------------------------------------
     def _allocation_exited(self, alloc) -> None:
@@ -300,7 +398,10 @@ class Master:
     # -- experiments -----------------------------------------------------------
     def create_experiment(self, config: Dict[str, Any]) -> int:
         exp_id = self.db.add_experiment(config)
+        if config.get("project_id"):
+            self.db.set_experiment_project(exp_id, int(config["project_id"]))
         exp = Experiment(exp_id, config, self.db, self.launcher)
+        exp.on_state_change = self._on_exp_state
         with self._lock:
             self.experiments[exp_id] = exp
         exp.start()
@@ -317,6 +418,7 @@ class Master:
             if row["state"] in db_mod.TERMINAL_STATES:
                 continue
             exp = Experiment(row["id"], row["config"], self.db, self.launcher)
+            exp.on_state_change = self._on_exp_state
             snapshot = row.get("searcher_snapshot")
             trial_rows = self.db.list_trials(row["id"])
             if snapshot:
@@ -329,6 +431,76 @@ class Master:
                 exp.relaunch_live_trials()
             n += 1
         return n
+
+    # -- NTSC generic tasks (ref: internal/command/{command.go,ntsc.go}) --------
+    def create_command(self, config: Dict[str, Any]) -> str:
+        """Run a generic task (COMMAND/NOTEBOOK/SHELL/TENSORBOARD shapes; the
+        non-command types currently differ only in their default entrypoint —
+        proxying is not implemented yet)."""
+        task_type = config.get("task_type", "COMMAND").upper()
+        entrypoint = config.get("entrypoint", "")
+        if not entrypoint:
+            raise ValueError("command config needs an entrypoint")
+        resources = config.get("resources", {})
+        slots = int(resources.get("slots", 0))
+        with self._lock:
+            self._cmd_counter += 1
+            n = self._cmd_counter
+        task_id = f"cmd-{n}"
+        alloc_id = f"cmd.{n}.0"
+        pool_name = resources.get("resource_pool") or self.rm.pool().name
+        with self._lock:
+            self._alloc_pool[alloc_id] = pool_name
+            self._commands[task_id] = {
+                "task_id": task_id, "alloc_id": alloc_id, "config": config,
+                "task_type": task_type, "state": "PENDING",
+            }
+
+        def on_start(req: Request, assignment: Dict[str, int]) -> None:
+            with self._lock:
+                self._commands[task_id]["state"] = "RUNNING"
+            self.enqueue_start_actions(
+                alloc_id=alloc_id, task_id=task_id, task_type=task_type,
+                entrypoint=entrypoint, assignment=assignment, slots=slots,
+                config=config,
+            )
+
+        request = Request(
+            alloc_id=alloc_id, slots=slots,
+            priority=int(resources.get("priority", 50)),
+            group_id=task_id, preemptible=False,
+        )
+        self.rm.pool(pool_name).submit(
+            request, on_start,
+            lambda a_id: self.alloc_service.signal_preempt(a_id),
+        )
+        return task_id
+
+    def list_commands(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            cmds = [dict(c) for c in self._commands.values()]
+        for c in cmds:
+            alloc = self.alloc_service.get(c["alloc_id"])
+            if alloc is not None and alloc.state == "TERMINATED":
+                c["state"] = "TERMINATED"
+                c["exit_code"] = alloc.exit_code
+            c.pop("config", None)
+        return cmds
+
+    def kill_command(self, task_id: str) -> None:
+        with self._lock:
+            cmd = self._commands.get(task_id)
+        if cmd is None:
+            raise KeyError(task_id)
+        alloc_id = cmd["alloc_id"]
+        if self.alloc_service.get(alloc_id) is None:
+            self.pool_of(alloc_id).release(alloc_id)
+            with self._lock:
+                self._commands[task_id]["state"] = "TERMINATED"
+            return
+        assignment = self.pool_of(alloc_id).assignment_of(alloc_id) or {}
+        for agent_id in assignment:
+            self.agent_hub.enqueue(agent_id, {"type": "KILL", "alloc_id": alloc_id})
 
     # -- agent events -----------------------------------------------------------
     def agent_event(self, agent_id: str, event: Dict[str, Any]) -> None:

@@ -80,6 +80,40 @@ CREATE TABLE IF NOT EXISTS allocations (
     slots INTEGER DEFAULT 0,
     started_at REAL, ended_at REAL, exit_reason TEXT
 );
+CREATE TABLE IF NOT EXISTS webhooks (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    url TEXT NOT NULL,
+    trigger_states TEXT NOT NULL   -- JSON list of experiment states
+);
+CREATE TABLE IF NOT EXISTS models (
+    name TEXT PRIMARY KEY,
+    description TEXT DEFAULT '',
+    metadata TEXT DEFAULT '{}',
+    created_at REAL
+);
+CREATE TABLE IF NOT EXISTS model_versions (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    model_name TEXT NOT NULL REFERENCES models(name),
+    version INTEGER NOT NULL,
+    checkpoint_uuid TEXT NOT NULL,
+    metadata TEXT DEFAULT '{}',
+    created_at REAL,
+    UNIQUE (model_name, version)
+);
+CREATE TABLE IF NOT EXISTS workspaces (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT UNIQUE NOT NULL,
+    created_at REAL
+);
+CREATE TABLE IF NOT EXISTS projects (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT NOT NULL,
+    workspace_id INTEGER NOT NULL REFERENCES workspaces(id),
+    created_at REAL,
+    UNIQUE (workspace_id, name)
+);
+INSERT OR IGNORE INTO workspaces (id, name, created_at) VALUES (1, 'Uncategorized', 0);
+INSERT OR IGNORE INTO projects (id, name, workspace_id, created_at) VALUES (1, 'Uncategorized', 1, 0);
 """
 
 # Experiment states (ref: master/pkg/model/experiment.go state machine).
@@ -364,3 +398,125 @@ class Database:
                     f"UPDATE allocations SET {', '.join(sets)} WHERE id=?",
                     tuple(args),
                 )
+
+    def get_allocation(self, alloc_id: str) -> Optional[Dict[str, Any]]:
+        rows = self._query("SELECT * FROM allocations WHERE id=?", (alloc_id,))
+        return dict(rows[0]) if rows else None
+
+    def list_allocations(self, task_prefix: str = "") -> List[Dict[str, Any]]:
+        return [
+            dict(r)
+            for r in self._query(
+                "SELECT * FROM allocations WHERE task_id LIKE ? ORDER BY started_at",
+                (f"{task_prefix}%",),
+            )
+        ]
+
+    # -- webhooks (ref: internal/webhooks) -------------------------------------
+    def add_webhook(self, url: str, trigger_states: List[str]) -> int:
+        cur = self._execute(
+            "INSERT INTO webhooks (url, trigger_states) VALUES (?,?)",
+            (url, json.dumps(trigger_states)),
+        )
+        return int(cur.lastrowid)
+
+    def list_webhooks(self) -> List[Dict[str, Any]]:
+        out = []
+        for r in self._query("SELECT * FROM webhooks ORDER BY id"):
+            d = dict(r)
+            d["trigger_states"] = json.loads(d["trigger_states"])
+            out.append(d)
+        return out
+
+    def delete_webhook(self, webhook_id: int) -> None:
+        self._execute("DELETE FROM webhooks WHERE id=?", (webhook_id,))
+
+    # -- model registry (ref: internal/api_model.go) ---------------------------
+    def add_model(self, name: str, description: str = "", metadata: Optional[Dict] = None) -> None:
+        self._execute(
+            "INSERT INTO models (name, description, metadata, created_at)"
+            " VALUES (?,?,?,?)",
+            (name, description, json.dumps(metadata or {}), time.time()),
+        )
+
+    def get_model(self, name: str) -> Optional[Dict[str, Any]]:
+        rows = self._query("SELECT * FROM models WHERE name=?", (name,))
+        if not rows:
+            return None
+        d = dict(rows[0])
+        d["metadata"] = json.loads(d["metadata"])
+        return d
+
+    def list_models(self) -> List[Dict[str, Any]]:
+        return [
+            {**dict(r), "metadata": json.loads(r["metadata"])}
+            for r in self._query("SELECT * FROM models ORDER BY name")
+        ]
+
+    def add_model_version(
+        self, model_name: str, checkpoint_uuid: str, metadata: Optional[Dict] = None
+    ) -> int:
+        rows = self._query(
+            "SELECT COALESCE(MAX(version), 0) AS v FROM model_versions WHERE model_name=?",
+            (model_name,),
+        )
+        version = int(rows[0]["v"]) + 1
+        self._execute(
+            "INSERT INTO model_versions (model_name, version, checkpoint_uuid,"
+            " metadata, created_at) VALUES (?,?,?,?,?)",
+            (model_name, version, checkpoint_uuid, json.dumps(metadata or {}), time.time()),
+        )
+        return version
+
+    def referenced_checkpoint_uuids(self) -> List[str]:
+        """Checkpoints pinned by model-registry versions (GC must keep them)."""
+        return [
+            r["checkpoint_uuid"]
+            for r in self._query(
+                "SELECT DISTINCT checkpoint_uuid FROM model_versions"
+            )
+        ]
+
+    def list_model_versions(self, model_name: str) -> List[Dict[str, Any]]:
+        return [
+            {**dict(r), "metadata": json.loads(r["metadata"])}
+            for r in self._query(
+                "SELECT * FROM model_versions WHERE model_name=? ORDER BY version",
+                (model_name,),
+            )
+        ]
+
+    # -- workspaces / projects (ref: internal/workspace, internal/project) -----
+    def add_workspace(self, name: str) -> int:
+        cur = self._execute(
+            "INSERT INTO workspaces (name, created_at) VALUES (?,?)",
+            (name, time.time()),
+        )
+        return int(cur.lastrowid)
+
+    def list_workspaces(self) -> List[Dict[str, Any]]:
+        return [dict(r) for r in self._query("SELECT * FROM workspaces ORDER BY id")]
+
+    def add_project(self, name: str, workspace_id: int) -> int:
+        cur = self._execute(
+            "INSERT INTO projects (name, workspace_id, created_at) VALUES (?,?,?)",
+            (name, workspace_id, time.time()),
+        )
+        return int(cur.lastrowid)
+
+    def list_projects(self, workspace_id: Optional[int] = None) -> List[Dict[str, Any]]:
+        if workspace_id is None:
+            return [dict(r) for r in self._query("SELECT * FROM projects ORDER BY id")]
+        return [
+            dict(r)
+            for r in self._query(
+                "SELECT * FROM projects WHERE workspace_id=? ORDER BY id",
+                (workspace_id,),
+            )
+        ]
+
+    def set_experiment_project(self, exp_id: int, project_id: int) -> None:
+        self._execute(
+            "UPDATE experiments SET project_id=?, updated_at=? WHERE id=?",
+            (project_id, time.time(), exp_id),
+        )

@@ -68,6 +68,9 @@ class Experiment:
         self.launcher = launcher
         self.state = db_mod.ACTIVE
         self.max_restarts = int(config.get("max_restarts", 5))
+        #: unmanaged experiments are never scheduled — an external process
+        #: drives the trial over the API (core_v2, ref _unmanaged.py).
+        self.unmanaged = bool(config.get("unmanaged"))
         self.searcher = make_searcher(
             config.get("searcher", {"name": "single", "max_length": 1}),
             config.get("hyperparameters", {}),
@@ -78,6 +81,19 @@ class Experiment:
         self._cancel_requested = False
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
+        #: fired on every state transition (master wires GC + webhooks).
+        #: MUST NOT call back into the experiment (invoked under the lock) —
+        #: the master's hook just enqueues onto a background worker.
+        self.on_state_change: Optional[Any] = None
+
+    def _announce_state(self) -> None:
+        self.db.set_experiment_state(self.id, self.state)
+        cb = self.on_state_change
+        if cb is not None:
+            try:
+                cb(self, self.state)
+            except Exception:  # noqa: BLE001
+                logger.exception("state-change hook failed for exp %d", self.id)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:
@@ -118,6 +134,8 @@ class Experiment:
 
     def relaunch_live_trials(self) -> None:
         """After restore: put every non-terminal trial back in flight."""
+        if self.unmanaged:
+            return
         for rec in self.trials.values():
             if not rec.exited:
                 rec.run_id += 1
@@ -141,7 +159,7 @@ class Experiment:
                 self.trials[trial_id] = rec
                 self._by_request[op.request_id] = trial_id
                 self._process_ops(self.searcher.trial_created(op.request_id))
-                if self.state == db_mod.ACTIVE:
+                if self.state == db_mod.ACTIVE and not self.unmanaged:
                     self.launcher.launch(self, rec)
             elif isinstance(op, ValidateAfter):
                 rec = self._rec(op.request_id)
@@ -150,6 +168,13 @@ class Experiment:
             elif isinstance(op, Close):
                 rec = self._rec(op.request_id)
                 rec.close_requested = True
+                if self.unmanaged and not rec.exited:
+                    # No allocation will ever exit; the Close decision is the
+                    # end of the trial's platform lifecycle.
+                    rec.exited = True
+                    rec.state = db_mod.COMPLETED
+                    self.db.update_trial(rec.trial_id, state=db_mod.COMPLETED)
+                    self._process_ops(self.searcher.trial_closed(rec.request_id))
                 self._cond.notify_all()
             elif isinstance(op, Shutdown):
                 # Searcher is done creating work; experiment finishes when
@@ -177,7 +202,7 @@ class Experiment:
             if len(errored) == len(self.trials) and self.trials
             else db_mod.COMPLETED
         )
-        self.db.set_experiment_state(self.id, self.state)
+        self._announce_state()
         self._cond.notify_all()
 
     # -- harness-facing API (called from HTTP request threads) -----------------
@@ -231,7 +256,7 @@ class Experiment:
                 self.db.update_trial(trial_id, state=db_mod.CANCELED)
                 if all(r.exited for r in self.trials.values()):
                     self.state = db_mod.CANCELED
-                    self.db.set_experiment_state(self.id, self.state)
+                    self._announce_state()
                 self._cond.notify_all()
                 return
             if clean and (rec.close_requested or self.state == db_mod.STOPPING):
@@ -241,7 +266,7 @@ class Experiment:
                 self._process_ops(self.searcher.trial_closed(rec.request_id))
             elif clean and self.state == db_mod.PAUSED:
                 pass  # preempted by pause; relaunched on activate
-            elif not clean and rec.restarts < self.max_restarts:
+            elif not clean and rec.restarts < self.max_restarts and not self.unmanaged:
                 rec.restarts += 1
                 rec.run_id += 1
                 self.db.update_trial(
@@ -275,7 +300,7 @@ class Experiment:
             if self.state != db_mod.ACTIVE:
                 return
             self.state = db_mod.PAUSED
-            self.db.set_experiment_state(self.id, self.state)
+            self._announce_state()
         for rec in self.trials.values():
             if not rec.exited:
                 self.launcher.preempt(rec.trial_id)
@@ -285,7 +310,7 @@ class Experiment:
             if self.state != db_mod.PAUSED:
                 return
             self.state = db_mod.ACTIVE
-            self.db.set_experiment_state(self.id, self.state)
+            self._announce_state()
             live = [r for r in self.trials.values() if not r.exited]
         for rec in live:
             rec.run_id += 1
@@ -302,7 +327,7 @@ class Experiment:
             live = [r for r in self.trials.values() if not r.exited]
             if not live:
                 self.state = db_mod.CANCELED
-                self.db.set_experiment_state(self.id, self.state)
+                self._announce_state()
                 self._cond.notify_all()
                 return
         for rec in live:
@@ -323,7 +348,7 @@ class Experiment:
                     rec.state = db_mod.CANCELED
                     self.db.update_trial(rec.trial_id, state=db_mod.CANCELED)
             self.state = db_mod.CANCELED
-            self.db.set_experiment_state(self.id, self.state)
+            self._announce_state()
             self._cond.notify_all()
 
     def wait_done(self, timeout: Optional[float] = None) -> str:

@@ -1,0 +1,70 @@
+"""Webhooks: experiment state-change notifications.
+
+Rebuild of `internal/webhooks/{webhook.go,shipper.go}`: registered URLs get
+a JSON POST whenever an experiment enters one of their trigger states. A
+single shipper thread drains a queue so slow endpoints never block the
+experiment FSM; deliveries retry a few times then drop (matching the
+reference's at-most-a-few-tries shipper semantics).
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict
+
+import requests
+
+from determined_tpu.master import db as db_mod
+
+logger = logging.getLogger("determined_tpu.master")
+
+
+class WebhookShipper:
+    def __init__(self, database: db_mod.Database, max_retries: int = 3) -> None:
+        self.db = database
+        self._queue: "queue.Queue[Dict[str, Any]]" = queue.Queue()
+        self._max_retries = max_retries
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="webhook-shipper"
+        )
+        self._thread.start()
+
+    def notify(self, exp_id: int, state: str, config: Dict[str, Any]) -> None:
+        """Queue deliveries for every webhook triggered by `state`."""
+        for hook in self.db.list_webhooks():
+            if state in hook["trigger_states"]:
+                self._queue.put(
+                    {
+                        "url": hook["url"],
+                        "payload": {
+                            "event": "experiment_state_change",
+                            "experiment_id": exp_id,
+                            "state": state,
+                            "searcher": config.get("searcher", {}).get("name"),
+                            "timestamp": time.time(),
+                        },
+                    }
+                )
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = self._queue.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            for attempt in range(self._max_retries):
+                try:
+                    requests.post(item["url"], json=item["payload"], timeout=10)
+                    break
+                except requests.RequestException as e:
+                    logger.warning(
+                        "webhook delivery to %s failed (%d/%d): %s",
+                        item["url"], attempt + 1, self._max_retries, e,
+                    )
+                    time.sleep(min(2.0 ** attempt, 10.0))
+
+    def stop(self) -> None:
+        self._stop.set()

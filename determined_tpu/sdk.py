@@ -135,6 +135,26 @@ class Experiment:
         return ckpts[-1] if ckpts else None
 
 
+class Model:
+    """Registered model + its checkpoint-backed versions (ref: model registry)."""
+
+    def __init__(self, session: Session, name: str) -> None:
+        self._session = session
+        self.name = name
+
+    def register_version(
+        self, checkpoint_uuid: str, metadata: Optional[Dict[str, Any]] = None
+    ) -> int:
+        resp = self._session.post(
+            f"/api/v1/models/{self.name}/versions",
+            json_body={"checkpoint_uuid": checkpoint_uuid, "metadata": metadata or {}},
+        )
+        return int(resp["version"])
+
+    def versions(self) -> List[Dict[str, Any]]:
+        return self._session.get(f"/api/v1/models/{self.name}/versions")["versions"]
+
+
 class Determined:
     """Entry point (ref: experimental/client.py Determined)."""
 
@@ -163,3 +183,66 @@ class Determined:
 
     def master_info(self) -> Dict[str, Any]:
         return self._session.get("/api/v1/master")
+
+    # -- model registry ------------------------------------------------------
+    def create_model(
+        self, name: str, description: str = "", metadata: Optional[Dict[str, Any]] = None
+    ) -> Model:
+        self._session.post(
+            "/api/v1/models",
+            json_body={"name": name, "description": description,
+                       "metadata": metadata or {}},
+        )
+        return Model(self._session, name)
+
+    def get_model(self, name: str) -> Model:
+        self._session.get(f"/api/v1/models/{name}")  # 404 if missing
+        return Model(self._session, name)
+
+    def list_models(self) -> List[Dict[str, Any]]:
+        return self._session.get("/api/v1/models")["models"]
+
+    # -- commands (NTSC) -----------------------------------------------------
+    def run_command(
+        self, entrypoint: str, slots: int = 0, **config: Any
+    ) -> str:
+        cfg = {"entrypoint": entrypoint, "resources": {"slots": slots}, **config}
+        return self._session.post(
+            "/api/v1/commands", json_body={"config": cfg}
+        )["task_id"]
+
+    def list_commands(self) -> List[Dict[str, Any]]:
+        return self._session.get("/api/v1/commands")["commands"]
+
+    def task_logs(self, task_id: str) -> List[str]:
+        out = self._session.get(
+            "/api/v1/task_logs", params={"task_id": task_id}
+        )["logs"]
+        return [line["log"] for line in out]
+
+    # -- workspaces / projects ----------------------------------------------
+    def create_workspace(self, name: str) -> int:
+        return int(self._session.post(
+            "/api/v1/workspaces", json_body={"name": name})["id"])
+
+    def create_project(self, name: str, workspace_id: int = 1) -> int:
+        return int(self._session.post(
+            "/api/v1/projects",
+            json_body={"name": name, "workspace_id": workspace_id})["id"])
+
+    def list_workspaces(self) -> List[Dict[str, Any]]:
+        return self._session.get("/api/v1/workspaces")["workspaces"]
+
+    def list_projects(self, workspace_id: Optional[int] = None) -> List[Dict[str, Any]]:
+        return self._session.get(
+            "/api/v1/projects",
+            params={"workspace_id": workspace_id} if workspace_id else None,
+        )["projects"]
+
+    # -- webhooks ------------------------------------------------------------
+    def create_webhook(self, url: str, trigger_states: Optional[List[str]] = None) -> int:
+        return int(self._session.post(
+            "/api/v1/webhooks",
+            json_body={"url": url,
+                       "trigger_states": trigger_states or ["COMPLETED", "ERRORED"]},
+        )["id"])

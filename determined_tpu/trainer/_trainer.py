@@ -63,6 +63,8 @@ class Trainer:
         seed: int = 0,
         searcher_metric: str = "loss",
         smaller_is_better: bool = True,
+        profiling: bool = False,
+        tensorboard_dir: Optional[str] = None,
     ) -> None:
         self.trial = trial
         self.core = core_context or core_mod.init()
@@ -78,6 +80,45 @@ class Trainer:
         self._state: Optional[Dict[str, Any]] = None
         self._step_fn = None
         self._eval_fn = None
+
+        # Observability (chief-only): system/device metrics to the master
+        # (ref ProfilerAgent) + tfevents scalars for TensorBoard.
+        self._profiler = None
+        self._tb_writer = None
+        self._tb_manager = None
+        if self.core.distributed.is_chief:
+            if profiling:
+                from determined_tpu.profiler import ProfilerAgent
+
+                self._profiler = ProfilerAgent(self.core.train)
+            if tensorboard_dir:
+                from determined_tpu.tensorboard import (
+                    EventFileWriter,
+                    TensorboardManager,
+                )
+
+                self._tb_writer = EventFileWriter(tensorboard_dir)
+                storage = getattr(self.core.checkpoint, "_storage", None)
+                task_id = getattr(self.core.checkpoint, "_task_id", "") or "local"
+                if storage is not None:
+                    self._tb_manager = TensorboardManager(
+                        storage, task_id, tensorboard_dir
+                    )
+
+    def _tb_scalars(self, step: int, metrics: Dict[str, Any], prefix: str = "") -> None:
+        if self._tb_writer is not None:
+            self._tb_writer.add_scalars(
+                step, {f"{prefix}{k}": v for k, v in metrics.items()}
+            )
+
+    def _tb_sync(self) -> None:
+        if self._tb_writer is not None:
+            self._tb_writer.flush()
+        if self._tb_manager is not None:
+            try:
+                self._tb_manager.sync()
+            except Exception:  # noqa: BLE001
+                logger.exception("tensorboard sync failed")
 
     # -- state construction -------------------------------------------------
     def _param_shardings(self) -> Any:
@@ -282,7 +323,11 @@ class Trainer:
             }
             dt = time.time() - t_report
             agg["batches_per_second"] = len(host) / dt if dt > 0 else 0.0
-            self.core.train.report_training_metrics(self.steps_completed, agg)
+            steps_now = self.steps_completed
+            self.core.train.report_training_metrics(steps_now, agg)
+            self._tb_scalars(steps_now, agg)
+            if self._profiler is not None:
+                self._profiler.set_steps_completed(steps_now)
             pending = []
             t_report = time.time()
 
@@ -291,6 +336,8 @@ class Trainer:
         # and kill host/device overlap.
         step = self.steps_completed
         last_ckpt_step = -1
+        if self._profiler is not None:
+            self._profiler.start()
 
         for op in searcher.operations():
             target = to_batches(op.length, bpe)
@@ -309,10 +356,12 @@ class Trainer:
                     last_val = self._validate()
                     if last_val and self.core.distributed.is_chief:
                         self.core.train.report_validation_metrics(step, last_val)
+                        self._tb_scalars(step, last_val, prefix="val_")
                 if ckpt_period and step % ckpt_period == 0:
                     flush_report()
                     self._save_checkpoint()
                     last_ckpt_step = step
+                    self._tb_sync()
                 # Preemption is a collective (ZMQ broadcast) — checking every
                 # batch would put a TCP roundtrip in the hot loop, so it
                 # shares the report boundary (the reference's analog knob is
@@ -334,6 +383,7 @@ class Trainer:
                     self.core.train.report_validation_metrics(
                         self.steps_completed, last_val
                     )
+                    self._tb_scalars(self.steps_completed, last_val, prefix="val_")
                 metric = last_val.get(self.searcher_metric)
                 if metric is None:
                     # no validation data: fall back to last train loss
@@ -345,6 +395,9 @@ class Trainer:
             and last_ckpt_step != step
         ):
             self._save_checkpoint()
+        if self._profiler is not None:
+            self._profiler.stop()
+        self._tb_sync()
         return last_val
 
 

@@ -1,0 +1,235 @@
+"""Master feature tests: checkpoint GC policy, model registry,
+workspaces/projects, webhooks, NTSC commands (via live master + agent)."""
+import json
+import threading
+import time
+
+import pytest
+
+from determined_tpu.master import db as db_mod
+from determined_tpu.master.checkpoint_gc import plan_gc, run_gc
+from determined_tpu.master.api_server import ApiServer
+from determined_tpu.master.core import Master
+from determined_tpu.sdk import Determined
+
+
+def _seed_experiment(db, n_trials=2, ckpts_per_trial=3, storage=None):
+    cfg = {
+        "searcher": {"name": "random", "max_trials": n_trials, "max_length": 30,
+                     "metric": "loss"},
+        "checkpoint_storage": storage or {},
+    }
+    eid = db.add_experiment(cfg)
+    for t in range(n_trials):
+        tid = db.add_trial(eid, t + 1, {"lr": 0.1})
+        for i in range(ckpts_per_trial):
+            steps = (i + 1) * 10
+            uuid = f"ck-{tid}-{i}"
+            db.add_checkpoint(
+                uuid, trial_id=tid, task_id=f"trial-{tid}", allocation_id="a",
+                resources=["x.npy"], metadata={"steps_completed": steps},
+            )
+            # later checkpoints are better (loss falls with steps); trial 1's
+            # final loss is the experiment best
+            db.add_metrics(tid, "validation", steps,
+                           {"loss": 1.0 / steps + 0.1 * t})
+    return eid, cfg
+
+
+class TestCheckpointGC:
+    def test_policy_keeps_best_and_latest(self):
+        db = db_mod.Database()
+        eid, cfg = _seed_experiment(db)
+        cfg["checkpoint_storage"] = {
+            "save_trial_latest": 1, "save_trial_best": 1, "save_experiment_best": 0,
+        }
+        victims = {c["uuid"] for c in plan_gc(db, eid, cfg)}
+        # Per trial: latest (i=2, steps 30) is also best (loss falls) -> keep
+        # one per trial, delete the other two.
+        assert victims == {"ck-1-0", "ck-1-1", "ck-2-0", "ck-2-1"}
+
+    def test_save_trial_best_with_distinct_best(self):
+        db = db_mod.Database()
+        eid = db.add_experiment({})
+        tid = db.add_trial(eid, 1, {})
+        for i, loss in enumerate([0.1, 0.9, 0.5]):  # best is the FIRST ckpt
+            steps = (i + 1) * 10
+            db.add_checkpoint(f"c{i}", trial_id=tid, task_id="t", allocation_id="a",
+                              resources=[], metadata={"steps_completed": steps})
+            db.add_metrics(tid, "validation", steps, {"loss": loss})
+        cfg = {"searcher": {"metric": "loss"},
+               "checkpoint_storage": {"save_trial_latest": 1, "save_trial_best": 1}}
+        victims = {c["uuid"] for c in plan_gc(db, eid, cfg)}
+        assert victims == {"c1"}  # c0 = best, c2 = latest
+
+    def test_registry_pinned_checkpoints_survive_gc(self):
+        db = db_mod.Database()
+        eid, cfg = _seed_experiment(db, n_trials=1)
+        cfg["checkpoint_storage"] = {"save_trial_latest": 1, "save_trial_best": 0}
+        db.add_model("prod-model")
+        db.add_model_version("prod-model", "ck-1-0")  # pin the oldest ckpt
+        victims = {c["uuid"] for c in plan_gc(db, eid, cfg)}
+        assert "ck-1-0" not in victims
+        assert victims == {"ck-1-1"}
+
+    def test_run_gc_deletes_storage_and_marks_db(self, tmp_path):
+        db = db_mod.Database()
+        storage_cfg = {
+            "type": "shared_fs", "host_path": str(tmp_path),
+            "save_trial_latest": 1, "save_trial_best": 0,
+        }
+        eid, cfg = _seed_experiment(db, n_trials=1, storage=storage_cfg)
+        for i in range(3):
+            (tmp_path / f"ck-1-{i}").mkdir()
+            (tmp_path / f"ck-1-{i}" / "x.npy").write_bytes(b"data")
+        n = run_gc(db, eid, cfg)
+        assert n == 2
+        assert (tmp_path / "ck-1-2").exists()
+        assert not (tmp_path / "ck-1-0").exists()
+        assert db.get_checkpoint("ck-1-0")["state"] == "DELETED"
+        assert db.list_checkpoints(1) == [db.get_checkpoint("ck-1-2")]
+
+    def test_gc_fires_on_experiment_completion(self, tmp_path):
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        master.external_url = api.url
+        try:
+            # Experiment with no agents: kill it -> terminal -> GC job runs.
+            cfg = {
+                "entrypoint": "x:y",
+                "searcher": {"name": "single", "max_length": 1},
+                "checkpoint_storage": {"type": "shared_fs",
+                                       "host_path": str(tmp_path),
+                                       "save_trial_latest": 1},
+            }
+            exp_id = master.create_experiment(cfg)
+            trial = master.db.list_trials(exp_id)[0]
+            for i in range(2):
+                (tmp_path / f"k{i}").mkdir()
+                master.db.add_checkpoint(
+                    f"k{i}", trial_id=trial["id"], task_id="t", allocation_id="a",
+                    resources=[], metadata={"steps_completed": i + 1},
+                )
+            master.get_experiment(exp_id).kill()
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if master.db.get_checkpoint("k0")["state"] == "DELETED":
+                    break
+                time.sleep(0.1)
+            assert master.db.get_checkpoint("k0")["state"] == "DELETED"
+            assert master.db.get_checkpoint("k1")["state"] == "COMPLETED"
+        finally:
+            api.stop()
+            master.shutdown()
+
+
+@pytest.fixture()
+def live(tmp_path):
+    master = Master()
+    api = ApiServer(master)
+    api.start()
+    master.external_url = api.url
+    yield master, api
+    api.stop()
+    master.shutdown()
+
+
+class TestModelRegistry:
+    def test_roundtrip(self, live):
+        master, api = live
+        d = Determined(api.url)
+        master.db.add_checkpoint("u1", trial_id=None, task_id="t",
+                                 allocation_id="a", resources=[], metadata={})
+        model = d.create_model("gpt2-finetuned", "demo")
+        assert model.register_version("u1") == 1
+        assert model.register_version("u1") == 2
+        versions = model.versions()
+        assert [v["version"] for v in versions] == [1, 2]
+        assert d.list_models()[0]["name"] == "gpt2-finetuned"
+
+    def test_version_requires_real_checkpoint(self, live):
+        master, api = live
+        d = Determined(api.url)
+        d.create_model("m1")
+        with pytest.raises(Exception):
+            d.get_model("m1")._session.post(
+                "/api/v1/models/m1/versions",
+                json_body={"checkpoint_uuid": "nope"},
+            )
+
+
+class TestWorkspaces:
+    def test_hierarchy(self, live):
+        master, api = live
+        d = Determined(api.url)
+        wid = d.create_workspace("research")
+        pid = d.create_project("llm", wid)
+        assert any(w["name"] == "Uncategorized" for w in d.list_workspaces())
+        assert any(p["id"] == pid for p in d.list_projects(wid))
+        exp = d.create_experiment({
+            "entrypoint": "x:y", "project_id": pid,
+            "searcher": {"name": "single", "max_length": 1},
+        })
+        assert master.db.get_experiment(exp.id)["project_id"] == pid
+
+
+class TestWebhooks:
+    def test_fires_on_terminal_state(self, live):
+        master, api = live
+        received = []
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        class Sink(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                received.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        sink = HTTPServer(("127.0.0.1", 0), Sink)
+        threading.Thread(target=sink.serve_forever, daemon=True).start()
+        try:
+            d = Determined(api.url)
+            d.create_webhook(
+                f"http://127.0.0.1:{sink.server_address[1]}/hook",
+                ["CANCELED"],
+            )
+            exp = d.create_experiment({
+                "entrypoint": "x:y",
+                "searcher": {"name": "single", "max_length": 1},
+            })
+            exp.kill()
+            deadline = time.time() + 10
+            while time.time() < deadline and not received:
+                time.sleep(0.1)
+            assert received and received[0]["state"] == "CANCELED"
+            assert received[0]["experiment_id"] == exp.id
+        finally:
+            sink.shutdown()
+
+
+class TestCommands:
+    def test_command_runs_via_devcluster(self, tmp_path):
+        from determined_tpu.devcluster import DevCluster
+
+        with DevCluster(n_agents=1, slots_per_agent=1) as dc:
+            deadline = time.time() + 30
+            while time.time() < deadline and not dc.master.agent_hub.list():
+                time.sleep(0.2)
+            d = Determined(dc.api.url)
+            task_id = d.run_command("echo hello-from-command")
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                cmds = d.list_commands()
+                if cmds and cmds[0].get("state") == "TERMINATED":
+                    break
+                time.sleep(0.5)
+            cmds = d.list_commands()
+            assert cmds[0]["state"] == "TERMINATED"
+            assert cmds[0]["exit_code"] == 0
+            logs = d.task_logs(task_id)
+            assert any("hello-from-command" in line for line in logs)

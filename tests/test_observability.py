@@ -1,0 +1,159 @@
+"""Profiler, tfevents writer/manager, and unmanaged-trial tests."""
+import time
+
+import pytest
+
+from determined_tpu.core._train import DummyTrainContext
+from determined_tpu.master.api_server import ApiServer
+from determined_tpu.master.core import Master
+from determined_tpu.profiler import ProfilerAgent
+from determined_tpu.storage.shared import SharedFSStorageManager
+from determined_tpu.tensorboard import (
+    EventFileWriter,
+    TensorboardManager,
+    read_scalars,
+)
+
+
+class TestProfiler:
+    def test_samples_and_reports(self):
+        train = DummyTrainContext()
+        agent = ProfilerAgent(
+            train, sample_interval_s=0.02, report_every=3, max_reports=5
+        )
+        agent.set_steps_completed(7)
+        agent.start()
+        deadline = time.time() + 10
+        while time.time() < deadline and not train._reported:
+            time.sleep(0.05)
+        agent.stop()
+        assert train._reported
+        group, steps, metrics = train._reported[0]
+        assert group == "profiling" and steps == 7
+        assert "cpu_util" in metrics or "memory_used_bytes" in metrics
+
+    def test_max_reports_cap(self):
+        train = DummyTrainContext()
+        agent = ProfilerAgent(
+            train, sample_interval_s=0.005, report_every=1, max_reports=2
+        )
+        agent.start()
+        time.sleep(0.5)
+        agent.stop()
+        assert len(train._reported) <= 3  # cap + possible final flush
+
+
+class TestTensorboard:
+    def test_write_and_read_scalars(self, tmp_path):
+        w = EventFileWriter(str(tmp_path))
+        w.add_scalars(1, {"loss": 2.5, "accuracy": 0.5})
+        w.add_scalars(2, {"loss": 1.25})
+        w.close()
+        events = read_scalars(w.path)
+        # event 0 is the file_version header
+        assert events[1]["step"] == 1
+        assert abs(events[1]["scalars"]["loss"] - 2.5) < 1e-6
+        assert abs(events[1]["scalars"]["accuracy"] - 0.5) < 1e-6
+        assert events[2]["step"] == 2
+
+    def test_tfrecord_framing_crc(self, tmp_path):
+        # TensorBoard validates CRCs; corrupt one byte and the record's crc
+        # must no longer match.
+        from determined_tpu.tensorboard import _frame, _masked_crc
+
+        rec = b"hello-tfevents"
+        framed = _frame(rec)
+        import struct
+
+        (length,) = struct.unpack_from("<Q", framed, 0)
+        assert length == len(rec)
+        (data_crc,) = struct.unpack_from("<I", framed, 12 + length)
+        assert data_crc == _masked_crc(rec)
+        assert _masked_crc(b"hellp-tfevents") != data_crc
+
+    def test_manager_syncs_incrementally(self, tmp_path):
+        logdir = tmp_path / "logs"
+        store_root = tmp_path / "store"
+        storage = SharedFSStorageManager(str(store_root))
+        w = EventFileWriter(str(logdir))
+        w.add_scalars(1, {"loss": 1.0})
+        w.flush()
+        mgr = TensorboardManager(storage, "trial-9", str(logdir))
+        assert len(mgr.sync()) == 1
+        assert mgr.sync() == []  # unchanged -> nothing re-uploaded
+        w.add_scalars(2, {"loss": 0.5})
+        w.flush()
+        assert len(mgr.sync()) == 1  # grew -> re-synced
+        w.close()
+
+
+class TestUnmanaged:
+    def test_unmanaged_trial_end_to_end(self, tmp_path):
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        master.external_url = api.url
+        try:
+            from determined_tpu import core_v2
+
+            ctx = core_v2.init(
+                master_url=api.url,
+                config={
+                    "name": "laptop-run",
+                    "searcher": {"name": "single", "max_length": 5,
+                                 "metric": "loss"},
+                },
+                checkpoint_storage={"type": "shared_fs",
+                                    "host_path": str(tmp_path)},
+            )
+            # Drive the single op like a training script would.
+            for op in ctx.searcher.operations():
+                for step in range(1, op.length + 1):
+                    ctx.train.report_training_metrics(step, {"loss": 1.0 / step})
+                ctx.train.report_validation_metrics(op.length, {"loss": 0.2})
+                op.report_completed(0.2)
+            ctx.close()
+
+            exp = master.get_experiment(ctx.experiment_id)
+            assert exp.wait_done(timeout=10) == "COMPLETED"
+            trial = master.db.get_trial(ctx.trial_id)
+            assert trial["state"] == "COMPLETED"
+            assert master.db.get_metrics(ctx.trial_id, "training")
+            assert master.db.best_validation(ctx.trial_id, "loss") == 0.2
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_unmanaged_heartbeat_loss_errors_trial(self):
+        master = Master(unmanaged_timeout_s=0.2)
+        try:
+            exp_id = master.create_experiment(
+                {"unmanaged": True, "entrypoint": "unmanaged",
+                 "searcher": {"name": "single", "max_length": 1}}
+            )
+            exp = master.get_experiment(exp_id)
+            trial_id = master.db.list_trials(exp_id)[0]["id"]
+            master.record_heartbeat(trial_id)
+            # No further heartbeats: the tick loop must reap the trial.
+            deadline = time.time() + 10
+            while time.time() < deadline and exp.state == "ACTIVE":
+                time.sleep(0.2)
+            assert exp.state == "ERRORED"
+            assert master.db.get_trial(trial_id)["state"] == "ERRORED"
+        finally:
+            master.shutdown()
+
+    def test_unmanaged_never_scheduled(self):
+        master = Master()
+        try:
+            exp_id = master.create_experiment(
+                {"unmanaged": True, "entrypoint": "unmanaged",
+                 "searcher": {"name": "single", "max_length": 1}}
+            )
+            # no allocation requests were queued
+            assert master.rm.pool().queue_snapshot() == {
+                "pending": [], "running": [],
+            }
+            assert master.db.list_trials(exp_id)
+        finally:
+            master.shutdown()
