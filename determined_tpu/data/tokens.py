@@ -20,6 +20,18 @@ import numpy as np
 MAGIC_DTYPE = {2: np.uint16, 4: np.int32}
 
 
+def expand_shards(patterns: List[str]) -> List[str]:
+    """Glob-expand shard path patterns (sorted, deduplicated)."""
+    import glob as glob_mod
+
+    out: List[str] = []
+    for pattern in patterns:
+        matches = sorted(glob_mod.glob(pattern))
+        out.extend(matches if matches else [pattern])
+    seen = set()
+    return [p for p in out if not (p in seen or seen.add(p))]
+
+
 def write_token_shard(path: str, tokens: np.ndarray, token_bytes: int = 2) -> None:
     """Write a flat little-endian token shard."""
     dtype = MAGIC_DTYPE[token_bytes]

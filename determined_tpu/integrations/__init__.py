@@ -1,0 +1,2 @@
+"""Framework integrations (ref: model_hub + determined.transformers):
+hf — HuggingFace Flax causal LMs as platform trials."""

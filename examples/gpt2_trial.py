@@ -1,0 +1,70 @@
+"""GPT-2 pretraining trial: the flagship recipe.
+
+The platform analog of the reference's `examples/hf_trainer_api/
+hf_language_modeling` GPT-2 recipe, built on the native GPT + token-shard
+data loader. Used by examples/gpt2_pretrain.json (32-chip dp×fsdp) and
+examples/long_context_ring.json (ring attention over a 16-way context
+axis).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator
+
+import numpy as np
+import optax
+
+from determined_tpu.models import GPT
+from determined_tpu.models.gpt import GPTConfig
+from determined_tpu.trainer import JAXTrial
+
+
+class GPT2PretrainTrial(JAXTrial):
+    def _config(self) -> GPTConfig:
+        return GPTConfig(**self.hparams.get("model_config", {}))
+
+    def build_model(self, mesh):
+        return GPT(self._config(), mesh=mesh)
+
+    def build_optimizer(self):
+        lr = float(self.hparams.get("lr", 3e-4))
+        warmup = int(self.hparams.get("warmup_steps", 0))
+        if warmup:
+            schedule = optax.warmup_cosine_decay_schedule(
+                0.0, lr, warmup,
+                int(self.hparams.get("decay_steps", 100_000)),
+                end_value=lr * 0.1,
+            )
+        else:
+            schedule = lr
+        return optax.chain(
+            optax.clip_by_global_norm(1.0),
+            optax.adamw(schedule, b2=0.95, weight_decay=0.1),
+        )
+
+    def _dataset(self, seed: int):
+        cfg = self._config()
+        b = int(self.hparams.get("batch_size", 8))
+        patterns = self.hparams.get("token_shards", [])
+        if patterns:
+            from determined_tpu.data import TokenDataset, expand_shards
+
+            return TokenDataset(expand_shards(patterns), b, cfg.seq_len, seed=seed)
+        # No shards configured: synthetic stream (smoke tests / dry runs).
+        rng = np.random.default_rng(seed)
+
+        def synthetic() -> Iterator[Dict[str, Any]]:
+            while True:
+                yield {
+                    "tokens": rng.integers(
+                        0, cfg.vocab_size, (b, cfg.seq_len)
+                    ).astype(np.int32)
+                }
+
+        return synthetic()
+
+    def build_training_data(self):
+        return self._dataset(seed=0)
+
+    def build_validation_data(self):
+        it = iter(self._dataset(seed=1))
+        return [next(it) for _ in range(4)]
