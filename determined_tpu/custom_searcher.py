@@ -1,0 +1,105 @@
+"""SearchRunner: run a user-defined search method against a live master.
+
+Rebuild of the reference's LocalSearchRunner / RemoteSearchRunner
+(`harness/determined/searcher/_search_runner.py:242`,
+`_remote_search_runner.py:14`): the user subclasses the SAME `SearchMethod`
+interface the built-in algorithms use (determined_tpu.searcher.base) and
+the runner pumps master-side searcher events through it, posting the
+returned operations back:
+
+    class MySearch(SearchMethod):
+        def initial_operations(self, rt): return [rt.create(), ...]
+        def on_validation_completed(self, rt, rid, metric, length): ...
+
+    SearchRunner("http://master:8080", MySearch(), space, exp_config).run()
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+from determined_tpu.common.api_session import Session
+from determined_tpu.searcher.base import SearchMethod, SearchRuntime
+from determined_tpu.searcher.ops import Operation, Shutdown, to_json
+
+logger = logging.getLogger("determined_tpu.custom_searcher")
+
+
+class SearchRunner:
+    def __init__(
+        self,
+        master_url: str,
+        method: SearchMethod,
+        hparam_space: Dict[str, Any],
+        exp_config: Dict[str, Any],
+        seed: int = 0,
+    ) -> None:
+        self.session = Session(master_url)
+        self.method = method
+        self.rt = SearchRuntime(hparam_space, seed)
+        config = dict(exp_config)
+        config["hyperparameters"] = hparam_space
+        searcher_cfg = dict(config.get("searcher", {}))
+        searcher_cfg["name"] = "custom"
+        config["searcher"] = searcher_cfg
+        self.config = config
+        self.experiment_id: Optional[int] = None
+
+    def _post_ops(self, ops: List[Operation]) -> bool:
+        """Returns True if a Shutdown was posted."""
+        if not ops:
+            return False
+        self.session.post(
+            f"/api/v1/experiments/{self.experiment_id}/searcher/operations",
+            json_body={"operations": [to_json(op) for op in ops]},
+        )
+        return any(isinstance(op, Shutdown) for op in ops)
+
+    def _dispatch(self, event: Dict[str, Any]) -> List[Operation]:
+        kind = event["type"]
+        if kind == "initial_operations":
+            return self.method.initial_operations(self.rt)
+        if kind == "trial_created":
+            return self.method.on_trial_created(self.rt, event["request_id"])
+        if kind == "validation_completed":
+            # The master's Searcher already normalized the metric to
+            # minimize-form (base.py _sign) before recording the event;
+            # flipping again here would cancel it.
+            return self.method.on_validation_completed(
+                self.rt, event["request_id"], float(event["metric"]),
+                int(event["length"]),
+            )
+        if kind == "trial_closed":
+            return self.method.on_trial_closed(self.rt, event["request_id"])
+        if kind == "trial_exited_early":
+            return self.method.on_trial_exited_early(
+                self.rt, event["request_id"], event.get("reason", "errored")
+            )
+        logger.warning("unknown searcher event %r", kind)
+        return []
+
+    def run(self, poll_timeout: float = 60.0) -> int:
+        """Create the experiment and drive it to completion; returns exp id."""
+        resp = self.session.post(
+            "/api/v1/experiments", json_body={"config": self.config}
+        )
+        self.experiment_id = int(resp["id"])
+        logger.info("custom search driving experiment %d", self.experiment_id)
+
+        after = 0
+        done = False
+        while True:
+            resp = self.session.get(
+                f"/api/v1/experiments/{self.experiment_id}/searcher/events",
+                params={"after": after, "timeout_seconds": poll_timeout},
+                timeout=poll_timeout + 10,
+            )
+            for event in resp["events"]:
+                after = max(after, event["id"])
+                done = self._post_ops(self._dispatch(event)) or done
+            if resp.get("experiment_state") in ("COMPLETED", "CANCELED", "ERRORED"):
+                return self.experiment_id
+            if done and not resp["events"]:
+                time.sleep(1.0)
+        return self.experiment_id

@@ -3,6 +3,11 @@
 See native/dataloader.cpp (prefetch engine) and tokens.py (format + python
 fallback + TokenDataset iterator).
 """
-from determined_tpu.data.tokens import TokenDataset, expand_shards, write_token_shard
+from determined_tpu.data.tokens import (
+    TokenDataset,
+    expand_shards,
+    lm_dataset,
+    write_token_shard,
+)
 
-__all__ = ["TokenDataset", "expand_shards", "write_token_shard"]
+__all__ = ["TokenDataset", "expand_shards", "lm_dataset", "write_token_shard"]

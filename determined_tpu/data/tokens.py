@@ -87,6 +87,30 @@ class _PythonLoader:
         pass
 
 
+def lm_dataset(
+    patterns: Optional[List[str]],
+    batch_size: int,
+    seq_len: int,
+    vocab_size: int,
+    seed: int = 0,
+):
+    """Shared trial-data helper: TokenDataset over glob-expanded shards when
+    configured, else an infinite synthetic stream (smoke tests/dry runs)."""
+    if patterns:
+        return TokenDataset(expand_shards(patterns), batch_size, seq_len, seed=seed)
+    rng = np.random.default_rng(seed)
+
+    def synthetic() -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            yield {
+                "tokens": rng.integers(
+                    0, vocab_size, (batch_size, seq_len)
+                ).astype(np.int32)
+            }
+
+    return synthetic()
+
+
 class TokenDataset:
     """Iterator of {"tokens": int32 [B, S]} batches over token shards.
 

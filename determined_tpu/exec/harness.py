@@ -52,6 +52,18 @@ def run(entrypoint: str) -> int:
 
         jax.config.update("jax_platforms", plat)
     info = core._context._info.get_cluster_info()
+    # Persistent XLA compilation cache shared across an experiment's trials:
+    # every ASHA rung re-jits the same program shapes, so later trials start
+    # in seconds instead of recompiling (SURVEY.md §7.9 — net-new vs. the
+    # reference, whose per-container torch processes had no analog).
+    cache_dir = (info.trial.config if info and info.trial else {}).get(
+        "environment", {}
+    ).get("compilation_cache_dir", "/tmp/dtpu-xla-cache")
+    if cache_dir:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     assert info is not None and info.trial is not None, "harness needs a trial env"
     cfg: Dict[str, Any] = info.trial.config
     trial_cls = import_entrypoint(entrypoint)

@@ -112,19 +112,12 @@ class HFTrial(JAXTrial):
         )
 
     def _dataset(self, seed: int):
+        from determined_tpu.data import lm_dataset
+
         b, s = self._shape()
-        patterns = self.hparams.get("token_shards")
-        if patterns:
-            from determined_tpu.data import TokenDataset, expand_shards
-
-            return TokenDataset(expand_shards(patterns), b, s, seed=seed)
-        rng = np.random.default_rng(seed)
-
-        def synthetic():
-            while True:
-                yield {"tokens": rng.integers(0, self._vocab(), (b, s)).astype(np.int32)}
-
-        return synthetic()
+        return lm_dataset(
+            self.hparams.get("token_shards"), b, s, self._vocab(), seed=seed
+        )
 
     def build_training_data(self) -> Iterator[Dict[str, Any]]:
         return self._dataset(seed=0)

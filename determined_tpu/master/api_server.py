@@ -32,6 +32,14 @@ class ApiError(Exception):
         self.status = status
 
 
+class _PlainText(Exception):
+    """Control-flow: handler responds with text/plain (Prometheus scrape)."""
+
+    def __init__(self, text: str) -> None:
+        super().__init__("plaintext response")
+        self.text = text
+
+
 class ApiRequest:
     def __init__(self, groups: Tuple[str, ...], body: Dict[str, Any], query: Dict[str, List[str]]):
         self.groups = groups
@@ -241,6 +249,29 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
     def list_trials(r: ApiRequest):
         return {"trials": m.db.list_trials(int(r.groups[0]))}
 
+    def searcher_events(r: ApiRequest):
+        exp = m.get_experiment(int(r.groups[0]))
+        if exp is None:
+            raise ApiError(404, "no such experiment")
+        try:
+            events = exp.get_searcher_events(
+                after_id=int(r.q("after", "0") or 0),
+                timeout=r.qfloat("timeout_seconds", 60.0),
+            )
+        except ValueError as e:
+            raise ApiError(400, str(e))
+        return {"events": events, "experiment_state": exp.state}
+
+    def post_searcher_ops(r: ApiRequest):
+        exp = m.get_experiment(int(r.groups[0]))
+        if exp is None:
+            raise ApiError(404, "no such experiment")
+        try:
+            exp.post_searcher_operations(r.body.get("operations", []))
+        except ValueError as e:
+            raise ApiError(400, str(e))
+        return {}
+
     def get_trial(r: ApiRequest):
         row = m.db.get_trial(int(r.groups[0]))
         if row is None:
@@ -332,6 +363,31 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
             "agents": m.agent_hub.list(),
         }
 
+    def prometheus_metrics(r: ApiRequest):
+        # Cluster-state gauges in Prometheus text format (ref:
+        # internal/prom/det_state_metrics.go:91 — allocation/slot gauges).
+        lines = []
+
+        def gauge(name: str, value: float, labels: str = "") -> None:
+            lines.append(f"dtpu_{name}{{{labels}}} {value}")
+
+        for pool_name, pool in m.rm.pools.items():
+            agents = pool.agents_snapshot()
+            gauge("agents", len(agents), f'pool="{pool_name}"')
+            gauge("slots_total", sum(a["slots"] for a in agents.values()),
+                  f'pool="{pool_name}"')
+            gauge("slots_used", sum(a["used"] for a in agents.values()),
+                  f'pool="{pool_name}"')
+            q = pool.queue_snapshot()
+            gauge("allocations_pending", len(q["pending"]), f'pool="{pool_name}"')
+            gauge("allocations_running", len(q["running"]), f'pool="{pool_name}"')
+        by_state: Dict[str, int] = {}
+        for e in m.db.list_experiments():
+            by_state[e["state"]] = by_state.get(e["state"], 0) + 1
+        for state, n in sorted(by_state.items()):
+            gauge("experiments", n, f'state="{state}"')
+        raise _PlainText("\n".join(lines) + "\n")
+
     R = lambda method, pat, h: (method, re.compile(f"^{pat}$"), h)  # noqa: E731
     return [
         R("POST", r"/api/v1/trials/(\d+)/metrics", post_metrics),
@@ -378,7 +434,11 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("GET", r"/api/v1/experiments/(\d+)", get_experiment),
         R("POST", r"/api/v1/experiments/(\d+)/(pause|activate|cancel|kill)", exp_action),
         R("GET", r"/api/v1/experiments/(\d+)/trials", list_trials),
+        R("GET", r"/api/v1/experiments/(\d+)/searcher/events", searcher_events),
+        R("POST", r"/api/v1/experiments/(\d+)/searcher/operations", post_searcher_ops),
         R("GET", r"/api/v1/master", master_info),
+        R("GET", r"/prom/metrics", prometheus_metrics),
+        R("GET", r"/metrics", prometheus_metrics),
     ]
 
 
@@ -414,6 +474,13 @@ class ApiServer:
                                 ApiRequest(match.groups(), body, parse_qs(parsed.query))
                             )
                             self._send(200, result if result is not None else {})
+                        except _PlainText as pt:
+                            data = pt.text.encode()
+                            self.send_response(200)
+                            self.send_header("Content-Type", "text/plain; version=0.0.4")
+                            self.send_header("Content-Length", str(len(data)))
+                            self.end_headers()
+                            self.wfile.write(data)
                         except (BrokenPipeError, ConnectionResetError):
                             # Long-poll client went away (e.g. task exited
                             # mid-response); nothing to answer.

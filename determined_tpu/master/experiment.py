@@ -120,17 +120,20 @@ class Experiment:
                 self.trials[rec.trial_id] = rec
                 self._by_request[rec.request_id] = rec.trial_id
             # In-flight ValidateAfter/Close ops are not persisted; re-derive
-            # each live trial's goal from the restored searcher state.
-            for rec in self.trials.values():
-                if rec.exited:
-                    continue
-                target = self.searcher.method.current_target(rec.request_id)
-                if target is None or rec.completed_length >= target:
-                    # No further work (or the trial already trained to its
-                    # final target and only the Close was lost in the crash).
-                    rec.close_requested = True
-                else:
-                    rec.target_length = target
+            # each live trial's goal from the restored searcher state. For
+            # external-ops methods (custom search) the runner owns targets:
+            # leave trials idle until it posts new operations.
+            if not getattr(self.searcher.method, "external_ops", False):
+                for rec in self.trials.values():
+                    if rec.exited:
+                        continue
+                    target = self.searcher.method.current_target(rec.request_id)
+                    if target is None or rec.completed_length >= target:
+                        # No further work (or the trial already trained to
+                        # its final target and only the Close was lost).
+                        rec.close_requested = True
+                    else:
+                        rec.target_length = target
 
     def relaunch_live_trials(self) -> None:
         """After restore: put every non-terminal trial back in flight."""
@@ -181,6 +184,9 @@ class Experiment:
                 # trials drain (checked in _maybe_finish).
                 pass
         self._maybe_finish()
+        # Wake long-polls unconditionally: custom-searcher event pushes
+        # return no ops, so the per-op notifies above don't fire for them.
+        self._cond.notify_all()
 
     def _rec(self, request_id: int) -> TrialRecord:
         return self.trials[self._by_request[request_id]]
@@ -237,6 +243,54 @@ class Experiment:
             self._process_ops(
                 self.searcher.validation_completed(rec.request_id, metric, length)
             )
+            self._snapshot()
+
+    # -- custom searcher (ref: api.proto GetSearcherEvents/PostSearcherOps) ---
+    def get_searcher_events(
+        self, after_id: int = 0, timeout: float = 60.0
+    ) -> List[Dict[str, Any]]:
+        import time
+
+        from determined_tpu.searcher.custom import CustomSearch
+
+        method = self.searcher.method
+        if not isinstance(method, CustomSearch):
+            raise ValueError("experiment does not use a custom searcher")
+        deadline = time.time() + timeout
+        with self._cond:
+            while True:
+                events = method.events_after(after_id)
+                if events or self.state in db_mod.TERMINAL_STATES:
+                    return events
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return []
+                self._cond.wait(timeout=min(remaining, 5.0))
+
+    def post_searcher_operations(self, ops_json: List[Dict[str, Any]]) -> None:
+        from determined_tpu.searcher import Shutdown as ShutdownOp, from_json
+        from determined_tpu.searcher.custom import CustomSearch
+
+        if not isinstance(self.searcher.method, CustomSearch):
+            # Injecting ops into a built-in searcher would collide with its
+            # own request ids and corrupt its state.
+            raise ValueError("experiment does not use a custom searcher")
+
+        ops = [from_json(o) for o in ops_json]
+        with self._cond:
+            for op in ops:
+                # External Creates carry runner-chosen request ids; keep the
+                # master's id allocator ahead of them.
+                rid = getattr(op, "request_id", None)
+                if rid is not None:
+                    self.searcher.rt._next_id = max(
+                        self.searcher.rt._next_id, rid + 1
+                    )
+                # Externally-posted ops bypass Searcher._route, which is
+                # what normally latches the shutdown flag.
+                if isinstance(op, ShutdownOp):
+                    self.searcher.shutdown = True
+            self._process_ops(ops)
             self._snapshot()
 
     def report_progress(self, trial_id: int, progress: float) -> None:

@@ -79,4 +79,33 @@ def attention(
             check_vma=False,
         )(q, k, v)
 
+    if impl == "ulysses":
+        # All-to-all head<->sequence swap: each device runs full-sequence
+        # attention for H/(tensor*context) heads
+        # (determined_tpu.parallel.ulysses). Heads stay sharded over tensor
+        # like the other impls — omitting it would silently replicate
+        # activations across the tensor axis.
+        if mesh is None:
+            raise ValueError("ulysses attention needs a mesh")
+        ctx = mesh.shape.get("context", 1)
+        tp = mesh.shape.get("tensor", 1)
+        local_heads = q.shape[2] // max(tp, 1)
+        if q.shape[2] % max(tp, 1) != 0 or local_heads % max(ctx, 1) != 0:
+            raise ValueError(
+                f"ulysses needs heads ({q.shape[2]}) divisible by "
+                f"tensor ({tp}) and heads/tensor ({local_heads}) divisible "
+                f"by the context axis ({ctx})"
+            )
+        from determined_tpu.parallel.ulysses import ulysses_attention
+
+        spec = P(BATCH_AXES, "context", "tensor", None)
+
+        def local(q_, k_, v_):
+            return ulysses_attention(q_, k_, v_, axis_name="context", causal=causal)
+
+        return shard_map(
+            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -49,6 +49,10 @@ def make_method(config: Dict[str, Any]) -> SearchMethod:
             max_rungs=int(config.get("max_rungs", 4)),
             divisor=float(config.get("divisor", 4)),
         )
+    if name == "custom":
+        from determined_tpu.searcher.custom import CustomSearch
+
+        return CustomSearch()
     raise ValueError(f"unknown searcher {name!r}")
 
 

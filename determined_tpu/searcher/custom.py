@@ -1,0 +1,65 @@
+"""Custom search: user-defined algorithms drive the searcher over the API.
+
+Rebuild of the reference's custom-searcher pipeline (`master/pkg/searcher/
+custom_search.go` + `api.proto:1644 GetSearcherEvents / :1655
+PostSearcherOperations` + the Python `searcher/_search_runner.py`): the
+master-side method is a mailbox — every searcher event is queued for an
+external *search runner* process, which replies with the operations
+(Create/ValidateAfter/Close/Shutdown) to apply.
+
+Master side: `CustomSearch` (built by make_method for name="custom").
+Client side: `SearchRunner` in determined_tpu.custom_searcher — the user
+subclasses the SAME `SearchMethod` interface the built-ins use and runs it
+anywhere with API access.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from determined_tpu.searcher.base import SearchMethod, SearchRuntime
+from determined_tpu.searcher.ops import Operation
+
+
+class CustomSearch(SearchMethod):
+    #: restore must not re-derive/close trial targets — the external runner
+    #: owns them (Experiment.restore checks this flag).
+    external_ops = True
+
+    def __init__(self) -> None:
+        self.events: List[Dict[str, Any]] = []
+        self.next_event_id = 1
+
+    def _push(self, kind: str, **payload: Any) -> List[Operation]:
+        self.events.append({"id": self.next_event_id, "type": kind, **payload})
+        self.next_event_id += 1
+        return []
+
+    # Every searcher event becomes a queued message; operations arrive
+    # asynchronously via Experiment.post_searcher_operations.
+    def initial_operations(self, rt: SearchRuntime) -> List[Operation]:
+        return self._push("initial_operations")
+
+    def on_trial_created(self, rt: SearchRuntime, request_id: int) -> List[Operation]:
+        return self._push("trial_created", request_id=request_id)
+
+    def on_validation_completed(
+        self, rt: SearchRuntime, request_id: int, metric: float, length: int
+    ) -> List[Operation]:
+        return self._push(
+            "validation_completed", request_id=request_id,
+            metric=metric, length=length,
+        )
+
+    def on_trial_closed(self, rt: SearchRuntime, request_id: int) -> List[Operation]:
+        return self._push("trial_closed", request_id=request_id)
+
+    def on_trial_exited_early(
+        self, rt: SearchRuntime, request_id: int, reason: str = "errored"
+    ) -> List[Operation]:
+        return self._push("trial_exited_early", request_id=request_id, reason=reason)
+
+    def events_after(self, after_id: int) -> List[Dict[str, Any]]:
+        return [e for e in self.events if e["id"] > after_id]
+
+    def progress(self) -> float:
+        return 0.0  # only the external runner knows

@@ -8,9 +8,6 @@ axis).
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Iterator
-
-import numpy as np
 import optax
 
 from determined_tpu.models import GPT
@@ -42,25 +39,16 @@ class GPT2PretrainTrial(JAXTrial):
         )
 
     def _dataset(self, seed: int):
+        from determined_tpu.data import lm_dataset
+
         cfg = self._config()
-        b = int(self.hparams.get("batch_size", 8))
-        patterns = self.hparams.get("token_shards", [])
-        if patterns:
-            from determined_tpu.data import TokenDataset, expand_shards
-
-            return TokenDataset(expand_shards(patterns), b, cfg.seq_len, seed=seed)
-        # No shards configured: synthetic stream (smoke tests / dry runs).
-        rng = np.random.default_rng(seed)
-
-        def synthetic() -> Iterator[Dict[str, Any]]:
-            while True:
-                yield {
-                    "tokens": rng.integers(
-                        0, cfg.vocab_size, (b, cfg.seq_len)
-                    ).astype(np.int32)
-                }
-
-        return synthetic()
+        return lm_dataset(
+            self.hparams.get("token_shards", []),
+            int(self.hparams.get("batch_size", 8)),
+            cfg.seq_len,
+            cfg.vocab_size,
+            seed=seed,
+        )
 
     def build_training_data(self):
         return self._dataset(seed=0)
