@@ -60,12 +60,13 @@ class AgentDaemon:
         slots: Any = "auto",
         pool: str = "default",
         python_exe: Optional[str] = None,
+        token: str = "",
     ) -> None:
         self.master_url = master_url
         self.agent_id = agent_id or socket.gethostname()
         self.slots = detect_slots(slots)
         self.pool = pool
-        self.session = Session(master_url)
+        self.session = Session(master_url, token=token)
         self.python_exe = python_exe or sys.executable
         self._tasks: Dict[str, _Task] = {}
         self._lock = threading.Lock()
@@ -236,10 +237,14 @@ def main() -> None:
     parser.add_argument("--slots", default="auto",
                         help='"auto", or an int (artificial slots)')
     parser.add_argument("--pool", default="default")
+    parser.add_argument("--token", default=os.environ.get("DTPU_TOKEN", ""),
+                        help="auth token (when the master has users configured)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
     slots: Any = args.slots if args.slots == "auto" else int(args.slots)
-    AgentDaemon(args.master_url, args.agent_id, slots, args.pool).run_forever()
+    AgentDaemon(
+        args.master_url, args.agent_id, slots, args.pool, token=args.token
+    ).run_forever()
 
 
 if __name__ == "__main__":

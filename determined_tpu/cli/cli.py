@@ -29,7 +29,19 @@ def _session(args: argparse.Namespace) -> Session:
     master = args.master or os.environ.get("DTPU_MASTER")
     if not master:
         _die("no master address (use --master or set DTPU_MASTER)")
-    return Session(master)
+    token = getattr(args, "token", None) or os.environ.get("DTPU_TOKEN", "")
+    return Session(master, token=token)
+
+
+def auth_login(args: argparse.Namespace) -> None:
+    import getpass
+
+    password = args.password or getpass.getpass("password: ")
+    resp = _session(args).post(
+        "/api/v1/auth/login",
+        json_body={"username": args.username, "password": password},
+    )
+    print(f"export DTPU_TOKEN={resp['token']}")
 
 
 def _load_config(path: str) -> Dict[str, Any]:
@@ -287,7 +299,15 @@ def dev_cluster(args: argparse.Namespace) -> None:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dtpu", description="determined_tpu CLI")
     p.add_argument("--master", "-m", default=None, help="master URL")
+    p.add_argument("--token", "-T", default=None,
+                   help="auth token (or DTPU_TOKEN env)")
     sub = p.add_subparsers(dest="noun", required=True)
+
+    auth = sub.add_parser("auth").add_subparsers(dest="verb", required=True)
+    v = auth.add_parser("login")
+    v.add_argument("username")
+    v.add_argument("--password", default=None)
+    v.set_defaults(fn=auth_login)
 
     exp = sub.add_parser("experiment", aliases=["e"]).add_subparsers(
         dest="verb", required=True)

@@ -33,18 +33,27 @@ class ApiError(Exception):
 
 
 class _PlainText(Exception):
-    """Control-flow: handler responds with text/plain (Prometheus scrape)."""
+    """Control-flow: handler responds with a non-JSON body (Prometheus
+    scrape, WebUI HTML)."""
 
-    def __init__(self, text: str) -> None:
+    def __init__(self, text: str, content_type: str = "text/plain; version=0.0.4") -> None:
         super().__init__("plaintext response")
         self.text = text
+        self.content_type = content_type
 
 
 class ApiRequest:
-    def __init__(self, groups: Tuple[str, ...], body: Dict[str, Any], query: Dict[str, List[str]]):
+    def __init__(
+        self,
+        groups: Tuple[str, ...],
+        body: Dict[str, Any],
+        query: Dict[str, List[str]],
+        token: Optional[str] = None,
+    ):
         self.groups = groups
         self.body = body
         self.query = query
+        self.token = token  # Bearer token from the Authorization header
 
     def q(self, name: str, default: Optional[str] = None) -> Optional[str]:
         vals = self.query.get(name)
@@ -363,6 +372,21 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
             "agents": m.agent_hub.list(),
         }
 
+    def auth_login(r: ApiRequest):
+        token = m.auth.login(r.body.get("username", ""), r.body.get("password", ""))
+        if token is None:
+            raise ApiError(401, "invalid credentials")
+        return {"token": token}
+
+    def auth_logout(r: ApiRequest):
+        m.auth.logout(r.token or r.body.get("token", ""))
+        return {}
+
+    def webui_page(r: ApiRequest):
+        from determined_tpu.master.webui import PAGE
+
+        raise _PlainText(PAGE, content_type="text/html; charset=utf-8")
+
     def prometheus_metrics(r: ApiRequest):
         # Cluster-state gauges in Prometheus text format (ref:
         # internal/prom/det_state_metrics.go:91 — allocation/slot gauges).
@@ -437,8 +461,11 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("GET", r"/api/v1/experiments/(\d+)/searcher/events", searcher_events),
         R("POST", r"/api/v1/experiments/(\d+)/searcher/operations", post_searcher_ops),
         R("GET", r"/api/v1/master", master_info),
+        R("POST", r"/api/v1/auth/login", auth_login),
+        R("POST", r"/api/v1/auth/logout", auth_logout),
         R("GET", r"/prom/metrics", prometheus_metrics),
         R("GET", r"/metrics", prometheus_metrics),
+        R("GET", r"/(?:ui)?", webui_page),
     ]
 
 
@@ -454,8 +481,17 @@ class ApiServer:
             def log_message(self, fmt: str, *args: Any) -> None:
                 logger.debug("http: " + fmt, *args)
 
+            AUTH_EXEMPT = ("/api/v1/auth/login", "/", "/ui", "/metrics",
+                           "/prom/metrics")
+
             def _dispatch(self, method: str) -> None:
                 parsed = urlparse(self.path)
+                header = self.headers.get("Authorization", "")
+                token = header[7:] if header.startswith("Bearer ") else None
+                if master.auth.enabled and parsed.path not in self.AUTH_EXEMPT:
+                    if master.auth.validate(token) is None:
+                        self._send(401, {"error": "authentication required"})
+                        return
                 body: Dict[str, Any] = {}
                 length = int(self.headers.get("Content-Length") or 0)
                 if length:
@@ -471,13 +507,16 @@ class ApiServer:
                     if match:
                         try:
                             result = handler(
-                                ApiRequest(match.groups(), body, parse_qs(parsed.query))
+                                ApiRequest(
+                                    match.groups(), body,
+                                    parse_qs(parsed.query), token=token,
+                                )
                             )
                             self._send(200, result if result is not None else {})
                         except _PlainText as pt:
                             data = pt.text.encode()
                             self.send_response(200)
-                            self.send_header("Content-Type", "text/plain; version=0.0.4")
+                            self.send_header("Content-Type", pt.content_type)
                             self.send_header("Content-Length", str(len(data)))
                             self.end_headers()
                             self.wfile.write(data)

@@ -1,0 +1,96 @@
+"""Authentication: user sessions + task tokens.
+
+Rebuild of the reference's session auth (`internal/user` session tokens;
+RBAC is EE-gated there and out of scope here): optional — a master started
+with a `users` map requires a Bearer token on every API call except login,
+the WebUI page, and /metrics. Tasks the master launches get their own
+short-lived tokens injected via DTPU_SESSION_TOKEN, so harness→master
+traffic authenticates without user credentials.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import secrets
+import threading
+import time
+from typing import Dict, Optional
+
+
+def _hash(password: str, salt: str) -> str:
+    return hashlib.pbkdf2_hmac(
+        "sha256", password.encode(), salt.encode(), 100_000
+    ).hex()
+
+
+class AuthService:
+    def __init__(self, users: Optional[Dict[str, str]] = None,
+                 session_ttl_s: float = 7 * 24 * 3600.0) -> None:
+        self.enabled = bool(users)
+        self._salt = secrets.token_hex(8)
+        self._users = {
+            name: _hash(password, self._salt)
+            for name, password in (users or {}).items()
+        }
+        self._tokens: Dict[str, Dict] = {}   # token -> {user, expires}
+        self._ttl = session_ttl_s
+        self._lock = threading.Lock()
+
+    def login(self, username: str, password: str) -> Optional[str]:
+        want = self._users.get(username)
+        if want is None or not hmac.compare_digest(want, _hash(password, self._salt)):
+            return None
+        token = secrets.token_urlsafe(24)
+        with self._lock:
+            self._tokens[token] = {
+                "user": username, "expires": time.time() + self._ttl,
+            }
+        return token
+
+    def issue_task_token(self, task_id: str) -> str:
+        """Credential for a task the master itself launched."""
+        if not self.enabled:
+            return ""
+        token = secrets.token_urlsafe(24)
+        with self._lock:
+            self._tokens[token] = {
+                "user": f"task:{task_id}", "expires": time.time() + self._ttl,
+            }
+        return token
+
+    def validate(self, token: Optional[str]) -> Optional[str]:
+        """Returns the principal name, or None if invalid/expired."""
+        if not self.enabled:
+            return "anonymous"
+        if not token:
+            return None
+        with self._lock:
+            entry = self._tokens.get(token)
+            if entry is None:
+                return None
+            if time.time() > entry["expires"]:
+                del self._tokens[token]
+                return None
+            return entry["user"]
+
+    def logout(self, token: str) -> None:
+        with self._lock:
+            self._tokens.pop(token, None)
+
+    def revoke_for_task(self, task_id: str) -> None:
+        """Drop a finished task's tokens — they must not outlive the task."""
+        principal = f"task:{task_id}"
+        with self._lock:
+            for tok in [
+                t for t, e in self._tokens.items() if e["user"] == principal
+            ]:
+                del self._tokens[tok]
+
+    def sweep(self) -> None:
+        """Remove expired tokens (the store must not grow unboundedly)."""
+        now = time.time()
+        with self._lock:
+            for tok in [
+                t for t, e in self._tokens.items() if now > e["expires"]
+            ]:
+                del self._tokens[tok]

@@ -196,6 +196,7 @@ class Master:
         preempt_timeout_s: float = 600.0,
         agent_timeout_s: float = 120.0,
         unmanaged_timeout_s: float = 300.0,
+        users: Optional[Dict[str, str]] = None,
     ) -> None:
         self.cluster_id = uuid.uuid4().hex[:8]
         self.external_url = external_url
@@ -203,6 +204,9 @@ class Master:
         self.rm = ResourceManager(pools_config)
         self.alloc_service = AllocationService(preempt_timeout_s=preempt_timeout_s)
         self.agent_hub = AgentHub()
+        from determined_tpu.master.auth import AuthService
+
+        self.auth = AuthService(users)
         self.launcher = RMTrialLauncher(self)
         self.agent_timeout_s = agent_timeout_s
         self.unmanaged_timeout_s = unmanaged_timeout_s
@@ -213,6 +217,7 @@ class Master:
         self._alloc_pool: Dict[str, str] = {}      # alloc_id -> pool name
         self._commands: Dict[str, Dict[str, Any]] = {}  # task_id -> command info
         self._cmd_counter = 0
+        self._provisioners: List[Any] = []  # ProvisionerService
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self.webhooks = WebhookShipper(self.db)
@@ -283,7 +288,7 @@ class Master:
                 master_url=self.external_url,
                 cluster_id=self.cluster_id,
                 agent_id=agent_id,
-                session_token="",
+                session_token=self.auth.issue_task_token(task_id),
                 task_id=task_id,
                 allocation_id=alloc_id,
                 task_type=task_type,
@@ -322,6 +327,7 @@ class Master:
                 for agent_id in self.agent_hub.reap_stale(self.agent_timeout_s):
                     self.lose_agent(agent_id)
                 self._reap_unmanaged()
+                self.auth.sweep()
             except Exception:  # noqa: BLE001
                 logger.exception("tick loop error")
 
@@ -375,9 +381,30 @@ class Master:
                     alloc_id, exit_code=1, reason=f"agent {agent_id} lost"
                 )
 
+    def attach_provisioner(self, service: Any) -> None:
+        """Autoscale a pool (master/provisioner.py ProvisionerService).
+
+        The service runs on its own ticker thread (backend calls can block
+        for minutes); terminated agents are cleaned up via lose_agent. A
+        token-less backend on a secured master gets an agent token minted.
+        """
+        backend = getattr(service, "backend", None)
+        if (
+            self.auth.enabled
+            and backend is not None
+            and hasattr(backend, "token")
+            and not backend.token
+        ):
+            backend.token = self.auth.issue_task_token("provisioned-agent")
+        service.on_terminate = self.lose_agent
+        self._provisioners.append(service)
+        service.start()
+
     def shutdown(self) -> None:
         self._stop.set()
         self.webhooks.stop()
+        for svc in self._provisioners:
+            svc.stop()
 
     # -- allocation exits ------------------------------------------------------
     def _allocation_exited(self, alloc) -> None:
@@ -385,6 +412,7 @@ class Master:
             alloc.id, state="TERMINATED", ended_at=time.time(),
             exit_reason=alloc.exit_reason,
         )
+        self.auth.revoke_for_task(alloc.task_id)
         self.pool_of(alloc.id).release(alloc.id)
         with self._lock:
             exp_trial = self._alloc_index.pop(alloc.id, None)

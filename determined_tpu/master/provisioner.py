@@ -1,0 +1,283 @@
+"""Provisioner: autoscale agents from queue depth.
+
+Rebuild of `internal/rm/agentrm/provisioner/{provisioner.go,
+scaledecider/scale_decider.go}`: a scale decider computes the desired agent
+count from pending demand and idle time; a backend launches/terminates
+agent instances. Backends:
+
+- LocalProvisioner — spawns agent daemons on this box (devcluster analog of
+  the reference's `det deploy local` agents; also the test vehicle for the
+  decider, like the reference's scale_decider tests).
+- GCPTPUProvisioner — emits the gcloud TPU-VM commands it would run
+  (`create`/`delete` of tpu-vm instances with startup scripts that launch
+  the agent). Zero-egress environments run it in dry-run mode; the command
+  stream is the contract (ref: provisioner/gcp/gcp.go + agentsetup).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Protocol
+
+from determined_tpu.master.rm import ResourcePool
+
+logger = logging.getLogger("determined_tpu.master")
+
+
+@dataclasses.dataclass
+class ScaleDecision:
+    launch: int                 # new instances to create
+    terminate: List[str]        # idle agent ids to tear down
+
+
+class ScaleDecider:
+    """Pure policy (ref: scale_decider.go): agents needed for the pending
+    queue, bounded by min/max instances; idle agents past the timeout are
+    terminated (newest-idle last, so long-idle agents go first)."""
+
+    def __init__(
+        self,
+        slots_per_instance: int,
+        min_instances: int = 0,
+        max_instances: int = 8,
+        idle_timeout_s: float = 300.0,
+        boot_timeout_s: float = 600.0,
+    ) -> None:
+        assert slots_per_instance > 0
+        self.slots_per_instance = slots_per_instance
+        self.min_instances = min_instances
+        self.max_instances = max_instances
+        self.idle_timeout_s = idle_timeout_s
+        #: a launched instance counts toward capacity until it registers or
+        #: this long passes — without this, every tick during a TPU VM's
+        #: minutes-long boot would launch another instance.
+        self.boot_timeout_s = boot_timeout_s
+        self._idle_since: Dict[str, float] = {}
+        self._pending_boots: List[float] = []  # launch timestamps
+        self._known_agents: set = set()
+
+    def decide(self, pool: ResourcePool) -> ScaleDecision:
+        now = time.time()
+        agents = pool.agents_snapshot()
+        pending_slots = int(pool.queue_snapshot()["pending_slots"])
+
+        # Retire pending boots: one per newly-registered agent, plus any
+        # that exceeded the boot timeout (instance presumed dead).
+        for aid in agents:
+            if aid not in self._known_agents and self._pending_boots:
+                self._pending_boots.pop(0)
+        self._known_agents = set(agents)
+        self._pending_boots = [
+            t for t in self._pending_boots if now - t < self.boot_timeout_s
+        ]
+        booting = len(self._pending_boots)
+
+        # Track idleness.
+        for aid, info in agents.items():
+            if info["used"] == 0:
+                self._idle_since.setdefault(aid, now)
+            else:
+                self._idle_since.pop(aid, None)
+        for aid in list(self._idle_since):
+            if aid not in agents:
+                del self._idle_since[aid]
+
+        free_slots = sum(
+            a["slots"] - a["used"] for a in agents.values() if a["enabled"]
+        ) + booting * self.slots_per_instance
+        deficit = max(0, pending_slots - free_slots)
+        import math
+
+        need = math.ceil(deficit / self.slots_per_instance) if deficit else 0
+        total = len(agents) + booting
+        launch = min(need, self.max_instances - total)
+        launch = max(launch, self.min_instances - total)
+        launch = max(0, launch)
+        self._pending_boots.extend([now] * launch)
+
+        terminate: List[str] = []
+        if pending_slots == 0:
+            excess = len(agents) - self.min_instances
+            candidates = sorted(
+                (
+                    (since, aid) for aid, since in self._idle_since.items()
+                    if now - since > self.idle_timeout_s
+                ),
+            )
+            terminate = [aid for _, aid in candidates[: max(0, excess)]]
+        return ScaleDecision(launch=launch, terminate=terminate)
+
+
+class ProvisionerBackend(Protocol):
+    def launch(self, n: int) -> None: ...
+    def terminate(self, agent_ids: List[str]) -> None: ...
+
+
+class LocalProvisioner:
+    """Spawn agent daemons in-process (threads), one per 'instance'."""
+
+    def __init__(
+        self, master_url: str, slots_per_instance: int, pool: str = "default",
+        prefix: str = "auto-agent", token: str = "",
+    ) -> None:
+        self.master_url = master_url
+        self.slots = slots_per_instance
+        self.pool = pool
+        self.prefix = prefix
+        self.token = token  # required when the master has auth enabled
+        self._counter = 0
+        self.agents: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def launch(self, n: int) -> None:
+        from determined_tpu.agent.agent import AgentDaemon
+
+        for _ in range(n):
+            with self._lock:
+                self._counter += 1
+                agent_id = f"{self.prefix}-{self._counter}"
+            agent = AgentDaemon(
+                self.master_url, agent_id=agent_id, slots=self.slots,
+                pool=self.pool, token=self.token,
+            )
+            threading.Thread(
+                target=agent.run_forever, daemon=True, name=agent_id
+            ).start()
+            with self._lock:
+                self.agents[agent_id] = agent
+            logger.info("provisioned local agent %s (%d slots)", agent_id, self.slots)
+
+    def terminate(self, agent_ids: List[str]) -> None:
+        for aid in agent_ids:
+            with self._lock:
+                agent = self.agents.pop(aid, None)
+            if agent is not None:
+                agent.stop()  # type: ignore[attr-defined]
+                logger.info("terminated local agent %s", aid)
+
+
+class GCPTPUProvisioner:
+    """TPU-VM autoscaling via gcloud; dry_run collects the command stream.
+
+    Instance unit = one TPU VM slice of `accelerator_type` (e.g. v5e-8);
+    the startup script installs and launches the agent pointed at this
+    master (ref: provisioner/agentsetup/agent_setup.go).
+    """
+
+    def __init__(
+        self,
+        master_url: str,
+        *,
+        project: str,
+        zone: str,
+        accelerator_type: str = "v5litepod-8",
+        runtime_version: str = "v2-alpha-tpuv5-lite",
+        pool: str = "default",
+        prefix: str = "dtpu-agent",
+        dry_run: bool = True,
+        token: str = "",
+    ) -> None:
+        self.master_url = master_url
+        self.project = project
+        self.zone = zone
+        self.accelerator_type = accelerator_type
+        self.runtime_version = runtime_version
+        self.pool = pool
+        self.prefix = prefix
+        self.dry_run = dry_run
+        self.token = token  # required when the master has auth enabled
+        self._counter = 0
+        self.commands: List[List[str]] = []  # dry-run audit trail
+
+    def _startup_script(self) -> str:
+        token_flag = f" --token {self.token}" if self.token else ""
+        return (
+            "#! /bin/bash\n"
+            f"python3 -m determined_tpu.agent.agent "
+            f"--master-url {self.master_url} --slots auto --pool {self.pool} "
+            f"--agent-id $(hostname){token_flag}\n"
+        )
+
+    def _run(self, cmd: List[str]) -> None:
+        self.commands.append(cmd)
+        if self.dry_run:
+            logger.info("[dry-run] %s", " ".join(cmd))
+            return
+        import subprocess
+
+        subprocess.run(cmd, check=True, capture_output=True, timeout=600)
+
+    def launch(self, n: int) -> None:
+        for _ in range(n):
+            self._counter += 1
+            name = f"{self.prefix}-{self._counter}"
+            # list-form exec (no shell): the script's real newlines pass
+            # through as the metadata value — no quoting/escaping layer.
+            self._run([
+                "gcloud", "compute", "tpus", "tpu-vm", "create", name,
+                f"--project={self.project}", f"--zone={self.zone}",
+                f"--accelerator-type={self.accelerator_type}",
+                f"--version={self.runtime_version}",
+                f"--metadata=startup-script={self._startup_script()}",
+            ])
+
+    def terminate(self, agent_ids: List[str]) -> None:
+        for aid in agent_ids:
+            self._run([
+                "gcloud", "compute", "tpus", "tpu-vm", "delete", aid,
+                f"--project={self.project}", f"--zone={self.zone}", "--quiet",
+            ])
+
+
+class ProvisionerService:
+    """Run the decider against a pool and apply via the backend.
+
+    Owns its own ticker thread: backend calls can block for minutes (gcloud
+    create), which must never stall the master's 1 Hz housekeeping tick.
+    `on_terminate` lets the master clean up terminated agents immediately
+    (they won't say goodbye).
+    """
+
+    def __init__(
+        self, pool: ResourcePool, decider: ScaleDecider,
+        backend: ProvisionerBackend, interval_s: float = 2.0,
+        on_terminate=None,
+    ) -> None:
+        self.pool = pool
+        self.decider = decider
+        self.backend = backend
+        self.interval_s = interval_s
+        self.on_terminate = on_terminate
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def tick(self) -> ScaleDecision:
+        decision = self.decider.decide(self.pool)
+        if decision.launch:
+            self.backend.launch(decision.launch)
+        if decision.terminate:
+            self.backend.terminate(decision.terminate)
+            if self.on_terminate is not None:
+                for agent_id in decision.terminate:
+                    self.on_terminate(agent_id)
+        return decision
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"provisioner-{self.pool.name}"
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 - one bad tick must not end scaling
+                logger.exception("provisioner tick failed")
+
+    def stop(self) -> None:
+        self._stop.set()

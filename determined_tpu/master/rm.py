@@ -12,7 +12,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
-from typing import Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from determined_tpu.master.scheduler import (
     Agent,
@@ -132,9 +132,17 @@ class ResourcePool:
                 logger.exception("%s callback failed for %s", kind, entry.request.alloc_id)
 
     # -- introspection --------------------------------------------------------
-    def queue_snapshot(self) -> Dict[str, List[str]]:
+    def queue_snapshot(self) -> Dict[str, Any]:
         with self._lock:
-            return {"pending": list(self._pending), "running": list(self._running)}
+            return {
+                "pending": list(self._pending),
+                "running": list(self._running),
+                "pending_slots": sum(
+                    self._entries[a].request.slots
+                    for a in self._pending
+                    if a in self._entries
+                ),
+            }
 
 
 class ResourceManager:

@@ -1,0 +1,121 @@
+"""WebUI: a single-file cluster dashboard served by the master.
+
+The reference ships a 112k-LoC React SPA (`webui/react`); this is the
+platform's minimal equivalent — one self-contained HTML page (no build
+step, no external assets; it must work from an air-gapped TPU pod) that
+polls the same REST API the CLI/SDK use and renders experiments, trials,
+agents/queues, and live trial logs.
+"""
+
+PAGE = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>determined_tpu</title>
+<style>
+  body { font-family: ui-monospace, SFMono-Regular, Menlo, monospace;
+         margin: 2rem; background: #0d1117; color: #c9d1d9; }
+  h1 { font-size: 1.2rem; } h2 { font-size: 1rem; margin-top: 1.6rem; }
+  table { border-collapse: collapse; width: 100%; font-size: 0.85rem; }
+  th, td { text-align: left; padding: 4px 10px; border-bottom: 1px solid #21262d; }
+  th { color: #8b949e; font-weight: 600; }
+  .ACTIVE { color: #58a6ff; } .COMPLETED { color: #3fb950; }
+  .ERRORED { color: #f85149; } .CANCELED, .STOPPING { color: #d29922; }
+  .PAUSED { color: #8b949e; }
+  button { background: #21262d; color: #c9d1d9; border: 1px solid #30363d;
+           border-radius: 4px; padding: 2px 8px; cursor: pointer; }
+  pre { background: #161b22; padding: 10px; max-height: 320px;
+        overflow-y: auto; font-size: 0.78rem; }
+  .bar { display: inline-block; width: 120px; height: 8px; background: #21262d;
+         border-radius: 4px; vertical-align: middle; }
+  .bar > div { height: 100%; background: #58a6ff; border-radius: 4px; }
+</style>
+</head>
+<body>
+<h1>determined_tpu <span id="cluster"></span></h1>
+<h2>Agents</h2><table id="agents"></table>
+<h2>Experiments</h2><table id="exps"></table>
+<h2>Trials <span id="exp-label"></span></h2><table id="trials"></table>
+<h2>Logs <span id="log-label"></span></h2><pre id="logs">(click a trial)</pre>
+<div id="login" style="display:none">
+  <h2>Login</h2>
+  <input id="u" placeholder="username"> <input id="p" type="password"
+    placeholder="password"> <button onclick="doLogin()">login</button>
+  <span id="login-err" class="ERRORED"></span>
+</div>
+<script>
+let selExp = null, selTrial = null, logAfter = 0;
+const $ = (id) => document.getElementById(id);
+const cell = (t) => `<td>${t}</td>`;
+const state = (s) => `<td class="${s}">${s}</td>`;
+
+async function j(path) {
+  const headers = {};
+  const tok = localStorage.getItem('dtpu_token');
+  if (tok) headers['Authorization'] = 'Bearer ' + tok;
+  const r = await fetch(path, {headers});
+  if (r.status === 401) { $('login').style.display = 'block'; throw 'auth'; }
+  return r.json();
+}
+
+async function doLogin() {
+  const r = await fetch('/api/v1/auth/login', {
+    method: 'POST', headers: {'Content-Type': 'application/json'},
+    body: JSON.stringify({username: $('u').value, password: $('p').value}),
+  });
+  if (r.status !== 200) { $('login-err').textContent = 'invalid credentials'; return; }
+  localStorage.setItem('dtpu_token', (await r.json()).token);
+  $('login').style.display = 'none';
+  refresh();
+}
+
+async function refresh() {
+  try {
+    const info = await j('/api/v1/master');
+    $('cluster').textContent = `· cluster ${info.cluster_id} · v${info.version}`;
+    const agents = info.agents || {};
+    $('agents').innerHTML = '<tr><th>id</th><th>pool</th><th>slots</th></tr>' +
+      Object.entries(agents).map(([id, a]) =>
+        `<tr>${cell(id)}${cell(a.pool)}${cell(a.slots)}</tr>`).join('');
+
+    const exps = (await j('/api/v1/experiments')).experiments.slice().reverse();
+    $('exps').innerHTML =
+      '<tr><th>id</th><th>state</th><th>progress</th><th>searcher</th><th></th></tr>' +
+      exps.map(e => {
+        const pct = Math.round((e.progress || 0) * 100);
+        return `<tr>${cell(e.id)}${state(e.state)}` +
+          `<td><span class="bar"><div style="width:${pct}%"></div></span> ${pct}%</td>` +
+          cell((e.config.searcher || {}).name || '') +
+          `<td><button onclick="selExp=${e.id};refresh()">trials</button></td></tr>`;
+      }).join('');
+
+    if (selExp !== null) {
+      $('exp-label').textContent = `· experiment ${selExp}`;
+      const trials = (await j(`/api/v1/experiments/${selExp}/trials`)).trials;
+      $('trials').innerHTML =
+        '<tr><th>id</th><th>state</th><th>steps</th><th>restarts</th><th>metric</th><th>hparams</th><th></th></tr>' +
+        trials.map(t =>
+          `<tr>${cell(t.id)}${state(t.state)}${cell(t.steps_completed)}` +
+          cell(t.restarts) + cell(t.searcher_metric ?? '') +
+          cell(JSON.stringify(t.hparams)) +
+          `<td><button onclick="selTrial=${t.id};logAfter=0;$('logs').textContent='';refresh()">logs</button></td></tr>`
+        ).join('');
+    }
+
+    if (selTrial !== null) {
+      $('log-label').textContent = `· trial ${selTrial}`;
+      const out = await j(`/api/v1/task_logs?task_id=trial-${selTrial}&after=${logAfter}`);
+      for (const line of out.logs) {
+        $('logs').textContent += line.log + '\\n';
+        logAfter = line.id;
+      }
+      $('logs').scrollTop = $('logs').scrollHeight;
+    }
+  } catch (e) { console.error(e); }
+}
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

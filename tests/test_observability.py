@@ -151,9 +151,8 @@ class TestUnmanaged:
                  "searcher": {"name": "single", "max_length": 1}}
             )
             # no allocation requests were queued
-            assert master.rm.pool().queue_snapshot() == {
-                "pending": [], "running": [],
-            }
+            snap = master.rm.pool().queue_snapshot()
+            assert snap["pending"] == [] and snap["running"] == []
             assert master.db.list_trials(exp_id)
         finally:
             master.shutdown()

@@ -1,0 +1,193 @@
+"""Provisioner scale-decider + local backend autoscaling e2e; auth; WebUI."""
+import time
+
+import pytest
+import requests
+
+from determined_tpu.master.api_server import ApiServer
+from determined_tpu.master.core import Master
+from determined_tpu.master.provisioner import (
+    GCPTPUProvisioner,
+    LocalProvisioner,
+    ProvisionerService,
+    ScaleDecider,
+)
+from determined_tpu.master.rm import ResourcePool
+from determined_tpu.master.scheduler import Request
+
+
+def _noop_cb(*a):
+    pass
+
+
+class TestScaleDecider:
+    def test_scales_up_for_pending(self):
+        pool = ResourcePool("p")
+        decider = ScaleDecider(slots_per_instance=4, max_instances=8)
+        pool.submit(Request("a1", 8), _noop_cb, _noop_cb)
+        d = decider.decide(pool)
+        assert d.launch == 2 and d.terminate == []
+
+    def test_respects_max_instances(self):
+        pool = ResourcePool("p")
+        decider = ScaleDecider(slots_per_instance=1, max_instances=2)
+        pool.submit(Request("a1", 8), _noop_cb, _noop_cb)
+        assert decider.decide(pool).launch == 2
+
+    def test_no_relaunch_storm_while_booting(self):
+        # A launched instance takes minutes to register; repeated ticks must
+        # not launch more for the same demand.
+        pool = ResourcePool("p")
+        decider = ScaleDecider(slots_per_instance=4, max_instances=8,
+                               boot_timeout_s=600)
+        pool.submit(Request("a1", 8), _noop_cb, _noop_cb)
+        assert decider.decide(pool).launch == 2
+        for _ in range(5):  # instance still booting
+            assert decider.decide(pool).launch == 0
+        # First instance registers: its pending-boot slot retires, no extra.
+        pool.add_agent("vm-1", 4)
+        assert decider.decide(pool).launch == 0
+
+    def test_terminates_idle_after_timeout(self):
+        pool = ResourcePool("p")
+        pool.add_agent("idle-1", 4)
+        decider = ScaleDecider(slots_per_instance=4, idle_timeout_s=0.05)
+        decider.decide(pool)  # records idle start
+        time.sleep(0.1)
+        d = decider.decide(pool)
+        assert d.terminate == ["idle-1"]
+
+    def test_min_instances_floor(self):
+        pool = ResourcePool("p")
+        decider = ScaleDecider(
+            slots_per_instance=4, min_instances=1, idle_timeout_s=0.0
+        )
+        d = decider.decide(pool)
+        assert d.launch == 1  # scale to floor even with no demand
+        pool.add_agent("a", 4)
+        time.sleep(0.01)
+        decider.decide(pool)
+        d = decider.decide(pool)
+        assert d.terminate == []  # floor protects the last agent
+
+    def test_busy_agents_not_terminated(self):
+        pool = ResourcePool("p")
+        pool.add_agent("busy", 4)
+        pool.submit(Request("a1", 4), _noop_cb, _noop_cb)  # occupies the agent
+        decider = ScaleDecider(slots_per_instance=4, idle_timeout_s=0.0)
+        time.sleep(0.01)
+        assert decider.decide(pool).terminate == []
+
+
+class TestGCPDryRun:
+    def test_command_stream(self):
+        prov = GCPTPUProvisioner(
+            "http://master:8080", project="proj", zone="us-central2-b",
+            dry_run=True,
+        )
+        prov.launch(2)
+        prov.terminate(["dtpu-agent-1"])
+        assert len(prov.commands) == 3
+        assert prov.commands[0][:5] == [
+            "gcloud", "compute", "tpus", "tpu-vm", "create"]
+        assert "--accelerator-type=v5litepod-8" in prov.commands[0]
+        assert prov.commands[2][4] == "delete"
+
+
+class TestLocalAutoscaleE2E:
+    def test_pending_experiment_provisions_agent(self, tmp_path):
+        master = Master(agent_timeout_s=600)
+        api = ApiServer(master)
+        api.start()
+        master.external_url = api.url
+        try:
+            backend = LocalProvisioner(api.url, slots_per_instance=1)
+            decider = ScaleDecider(slots_per_instance=1, max_instances=2,
+                                   idle_timeout_s=600)
+            master.attach_provisioner(
+                ProvisionerService(master.rm.pool(), decider, backend)
+            )
+            # No agents at all: the experiment queues, the provisioner must
+            # notice and spawn one, and the trial must then complete.
+            exp_id = master.create_experiment({
+                "entrypoint": "determined_tpu.exec.builtin_trials:SyntheticTrial",
+                "searcher": {"name": "single", "max_length": 2, "metric": "loss"},
+                "hyperparameters": {"model": "mnist-mlp", "batch_size": 16},
+                "resources": {"slots_per_trial": 1},
+                "scheduling_unit": 1,
+                "checkpoint_storage": {"type": "shared_fs",
+                                       "host_path": str(tmp_path)},
+                "environment": {"jax_platform": "cpu"},
+                "max_restarts": 0,
+            })
+            exp = master.get_experiment(exp_id)
+            assert exp.wait_done(timeout=240) == "COMPLETED"
+            assert len(backend.agents) == 1
+        finally:
+            for agent in list(backend.agents.values()):
+                agent.stop()
+            api.stop()
+            master.shutdown()
+
+
+class TestAuth:
+    @pytest.fixture()
+    def secured(self):
+        master = Master(users={"admin": "hunter2"})
+        api = ApiServer(master)
+        api.start()
+        master.external_url = api.url
+        yield master, api
+        api.stop()
+        master.shutdown()
+
+    def test_rejects_without_token(self, secured):
+        master, api = secured
+        r = requests.get(f"{api.url}/api/v1/experiments", timeout=10)
+        assert r.status_code == 401
+
+    def test_login_and_use(self, secured):
+        master, api = secured
+        r = requests.post(
+            f"{api.url}/api/v1/auth/login",
+            json={"username": "admin", "password": "hunter2"}, timeout=10,
+        )
+        token = r.json()["token"]
+        r = requests.get(
+            f"{api.url}/api/v1/experiments",
+            headers={"Authorization": f"Bearer {token}"}, timeout=10,
+        )
+        assert r.status_code == 200
+
+    def test_bad_password(self, secured):
+        master, api = secured
+        r = requests.post(
+            f"{api.url}/api/v1/auth/login",
+            json={"username": "admin", "password": "wrong"}, timeout=10,
+        )
+        assert r.status_code == 401
+
+    def test_exempt_paths_open(self, secured):
+        master, api = secured
+        assert requests.get(f"{api.url}/metrics", timeout=10).status_code == 200
+        assert requests.get(f"{api.url}/", timeout=10).status_code == 200
+
+    def test_task_tokens_issued(self, secured):
+        master, api = secured
+        token = master.auth.issue_task_token("trial-1")
+        assert master.auth.validate(token) == "task:trial-1"
+
+
+class TestWebUI:
+    def test_dashboard_served(self):
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            r = requests.get(f"{api.url}/", timeout=10)
+            assert r.status_code == 200
+            assert "text/html" in r.headers["Content-Type"]
+            assert "determined_tpu" in r.text and "Experiments" in r.text
+        finally:
+            api.stop()
+            master.shutdown()
