@@ -28,6 +28,13 @@ def import_entrypoint(entrypoint: str) -> Any:
     return getattr(module, attr)
 
 
+def resolve_mesh(hparams: Dict[str, Any], cfg: Dict[str, Any]):
+    """Mesh from hparams beats config: lets a searcher sweep parallelism
+    layouts (mesh autotuning — the platform's DeepSpeed-autotune analog)."""
+    mesh_cfg = hparams.get("mesh") or cfg.get("mesh")
+    return make_mesh(MeshConfig(**mesh_cfg)) if mesh_cfg else None
+
+
 def parse_unit(spec: Any) -> Optional[TrainUnit]:
     """expconf-style length: {"batches": N} | {"epochs": N} | int (batches)."""
     if spec is None:
@@ -69,9 +76,7 @@ def run(entrypoint: str) -> int:
     trial_cls = import_entrypoint(entrypoint)
     trial = trial_cls(info.trial.hparams)
 
-    mesh = None
-    if cfg.get("mesh"):
-        mesh = make_mesh(MeshConfig(**cfg["mesh"]))
+    mesh = resolve_mesh(info.trial.hparams, cfg)
 
     scfg = cfg.get("searcher", {})
     try:

@@ -49,11 +49,13 @@ class ApiRequest:
         body: Dict[str, Any],
         query: Dict[str, List[str]],
         token: Optional[str] = None,
+        client_ip: str = "",
     ):
         self.groups = groups
         self.body = body
         self.query = query
         self.token = token  # Bearer token from the Authorization header
+        self.client_ip = client_ip
 
     def q(self, name: str, default: Optional[str] = None) -> Optional[str]:
         vals = self.query.get(name)
@@ -170,6 +172,30 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         # preempted gracefully (ref: exec/launch.py:16 SLURM handler).
         m.alloc_service.signal_preempt(r.groups[0])
         return {}
+
+    def register_proxy(r: ApiRequest):
+        alloc = m.alloc_service.get(r.groups[0])
+        if alloc is None:
+            raise ApiError(404, "no such allocation")
+        # SSRF guard: a task may only expose itself. Allowed hosts are the
+        # caller's own address (the task registers from the host it runs on)
+        # and the allocation's rendezvous addresses — never arbitrary
+        # master-network targets like cloud metadata endpoints.
+        allowed = {r.client_ip, "127.0.0.1", "localhost"}
+        allowed.update(a.split(":")[0] for a in alloc.addrs.values())
+        host = r.body.get("host") or r.client_ip or "127.0.0.1"
+        if host not in allowed:
+            raise ApiError(403, f"proxy host {host!r} is not this allocation")
+        m.proxy.register(alloc.task_id, host, int(r.body["port"]))
+        return {"url": f"/proxy/{alloc.task_id}/"}
+
+    def list_proxies(r: ApiRequest):
+        return {
+            "proxies": {
+                task_id: {"host": h, "port": p}
+                for task_id, (h, p) in m.proxy.list().items()
+            }
+        }
 
     def rendezvous_arrive(r: ApiRequest):
         m.alloc_service.rendezvous_arrive(
@@ -429,6 +455,8 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("GET", r"/api/v1/allocations/([\w.\-]+)/signals/preemption", preemption_signal),
         R("POST", r"/api/v1/allocations/([\w.\-]+)/signals/ack_preemption", ack_preemption),
         R("POST", r"/api/v1/allocations/([\w.\-]+)/signals/preemption_from_task", preempt_from_task),
+        R("POST", r"/api/v1/allocations/([\w.\-]+)/proxy", register_proxy),
+        R("GET", r"/api/v1/proxies", list_proxies),
         R("POST", r"/api/v1/allocations/([\w.\-]+)/rendezvous", rendezvous_arrive),
         R("GET", r"/api/v1/allocations/([\w.\-]+)/rendezvous", rendezvous_info),
         R("POST", r"/api/v1/allocations/([\w.\-]+)/allgather", allgather),
@@ -484,10 +512,35 @@ class ApiServer:
             AUTH_EXEMPT = ("/api/v1/auth/login", "/", "/ui", "/metrics",
                            "/prom/metrics")
 
+            def _auth_token(self, parsed) -> Optional[str]:
+                """Bearer header, else cookie, else ?token= (browser UIs
+                reaching proxied pages can't set headers)."""
+                header = self.headers.get("Authorization", "")
+                if header.startswith("Bearer "):
+                    return header[7:]
+                cookie = self.headers.get("Cookie", "")
+                for part in cookie.split(";"):
+                    name, _, value = part.strip().partition("=")
+                    if name == "dtpu_token" and value:
+                        return value
+                q = parse_qs(parsed.query).get("token")
+                return q[0] if q else None
+
             def _dispatch(self, method: str) -> None:
                 parsed = urlparse(self.path)
-                header = self.headers.get("Authorization", "")
-                token = header[7:] if header.startswith("Bearer ") else None
+                token = self._auth_token(parsed)
+                if parsed.path.startswith("/proxy/"):
+                    # Raw pass-through to a task service. Same auth gate as
+                    # the API (the reference authenticates proxy traffic via
+                    # session cookies; we accept cookie/query tokens too).
+                    if (
+                        master.auth.enabled
+                        and master.auth.validate(token) is None
+                    ):
+                        self._send(401, {"error": "authentication required"})
+                        return
+                    self._proxy(method, parsed)
+                    return
                 if master.auth.enabled and parsed.path not in self.AUTH_EXEMPT:
                     if master.auth.validate(token) is None:
                         self._send(401, {"error": "authentication required"})
@@ -510,6 +563,7 @@ class ApiServer:
                                 ApiRequest(
                                     match.groups(), body,
                                     parse_qs(parsed.query), token=token,
+                                    client_ip=self.client_address[0],
                                 )
                             )
                             self._send(200, result if result is not None else {})
@@ -533,6 +587,26 @@ class ApiServer:
                             self._send(500, {"error": str(e)})
                         return
                 self._send(404, {"error": f"no route {method} {parsed.path}"})
+
+            def _proxy(self, method: str, parsed) -> None:
+                parts = parsed.path.split("/", 3)  # '', 'proxy', task_id, rest
+                task_id = parts[2] if len(parts) > 2 else ""
+                rest = "/" + (parts[3] if len(parts) > 3 else "")
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                status, headers, data = master.proxy.forward(
+                    task_id, method, rest, parsed.query,
+                    dict(self.headers), body,
+                )
+                try:
+                    self.send_response(status)
+                    for k, v in headers.items():
+                        self.send_header(k, v)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
 
             def _send(self, status: int, payload: Dict[str, Any]) -> None:
                 data = json.dumps(payload).encode()

@@ -47,6 +47,11 @@ class AuthService:
             }
         return token
 
+    #: task/agent tokens live until revoked at task exit; the 30-day ceiling
+    #: only bounds leakage if revocation is missed. Tying them to the user
+    #: session TTL would 401 healthy long-running trials mid-training.
+    TASK_TOKEN_TTL_S = 30 * 24 * 3600.0
+
     def issue_task_token(self, task_id: str) -> str:
         """Credential for a task the master itself launched."""
         if not self.enabled:
@@ -54,7 +59,8 @@ class AuthService:
         token = secrets.token_urlsafe(24)
         with self._lock:
             self._tokens[token] = {
-                "user": f"task:{task_id}", "expires": time.time() + self._ttl,
+                "user": f"task:{task_id}",
+                "expires": time.time() + self.TASK_TOKEN_TTL_S,
             }
         return token
 

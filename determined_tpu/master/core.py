@@ -205,8 +205,10 @@ class Master:
         self.alloc_service = AllocationService(preempt_timeout_s=preempt_timeout_s)
         self.agent_hub = AgentHub()
         from determined_tpu.master.auth import AuthService
+        from determined_tpu.master.proxy import ProxyRegistry
 
         self.auth = AuthService(users)
+        self.proxy = ProxyRegistry()
         self.launcher = RMTrialLauncher(self)
         self.agent_timeout_s = agent_timeout_s
         self.unmanaged_timeout_s = unmanaged_timeout_s
@@ -413,6 +415,7 @@ class Master:
             exit_reason=alloc.exit_reason,
         )
         self.auth.revoke_for_task(alloc.task_id)
+        self.proxy.unregister(alloc.task_id)
         self.pool_of(alloc.id).release(alloc.id)
         with self._lock:
             exp_trial = self._alloc_index.pop(alloc.id, None)

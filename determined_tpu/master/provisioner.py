@@ -191,13 +191,16 @@ class GCPTPUProvisioner:
         self._counter = 0
         self.commands: List[List[str]] = []  # dry-run audit trail
 
-    def _startup_script(self) -> str:
+    def _startup_script(self, instance_name: str) -> str:
+        # --agent-id = the TPU instance name (NOT $(hostname): a TPU VM's
+        # hostname is the node name, and scale-down deletes by agent id —
+        # they must match or idle VMs are never terminated).
         token_flag = f" --token {self.token}" if self.token else ""
         return (
             "#! /bin/bash\n"
             f"python3 -m determined_tpu.agent.agent "
             f"--master-url {self.master_url} --slots auto --pool {self.pool} "
-            f"--agent-id $(hostname){token_flag}\n"
+            f"--agent-id {instance_name}{token_flag}\n"
         )
 
     def _run(self, cmd: List[str]) -> None:
@@ -210,17 +213,24 @@ class GCPTPUProvisioner:
         subprocess.run(cmd, check=True, capture_output=True, timeout=600)
 
     def launch(self, n: int) -> None:
+        import tempfile
+
         for _ in range(n):
             self._counter += 1
             name = f"{self.prefix}-{self._counter}"
-            # list-form exec (no shell): the script's real newlines pass
-            # through as the metadata value — no quoting/escaping layer.
+            # Startup script goes via --metadata-from-file: embedding it in
+            # argv would leak the agent auth token to `ps` and the logs.
+            script = tempfile.NamedTemporaryFile(
+                "w", suffix=".sh", prefix="dtpu-startup-", delete=False
+            )
+            script.write(self._startup_script(name))
+            script.close()
             self._run([
                 "gcloud", "compute", "tpus", "tpu-vm", "create", name,
                 f"--project={self.project}", f"--zone={self.zone}",
                 f"--accelerator-type={self.accelerator_type}",
                 f"--version={self.runtime_version}",
-                f"--metadata=startup-script={self._startup_script()}",
+                f"--metadata-from-file=startup-script={script.name}",
             ])
 
     def terminate(self, agent_ids: List[str]) -> None:

@@ -1,0 +1,81 @@
+"""Proxy: route HTTP to services running inside task allocations.
+
+Rebuild of `master/internal/proxy/{proxy.go,tcp.go}`: interactive tasks
+(notebooks, TensorBoards, custom dashboards) listen on a port inside their
+allocation; they register `(host, port)` with the master, and the master
+serves `/proxy/{task_id}/...` by forwarding the request — so users reach
+every task UI through the one master address, exactly like the reference's
+notebook/TB tunneling. (WebSocket upgrade is not implemented yet; plain
+HTTP covers TensorBoard and most dashboards.)
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional, Tuple
+
+import requests
+
+logger = logging.getLogger("determined_tpu.master")
+
+HOP_HEADERS = {
+    "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
+    "te", "trailers", "transfer-encoding", "upgrade", "host",
+    "content-length",
+    # requests transparently decompresses bodies; forwarding the original
+    # Content-Encoding with a decompressed body corrupts every gzip page.
+    "content-encoding",
+}
+
+
+class ProxyRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._targets: Dict[str, Tuple[str, int]] = {}  # task_id -> (host, port)
+
+    def register(self, task_id: str, host: str, port: int) -> None:
+        with self._lock:
+            self._targets[task_id] = (host, port)
+        logger.info("proxy: %s -> %s:%d", task_id, host, port)
+
+    def unregister(self, task_id: str) -> None:
+        with self._lock:
+            self._targets.pop(task_id, None)
+
+    def target(self, task_id: str) -> Optional[Tuple[str, int]]:
+        with self._lock:
+            return self._targets.get(task_id)
+
+    def list(self) -> Dict[str, Tuple[str, int]]:
+        with self._lock:
+            return dict(self._targets)
+
+    def forward(
+        self, task_id: str, method: str, path: str, query: str,
+        headers: Dict[str, str], body: bytes,
+    ) -> Tuple[int, Dict[str, str], bytes]:
+        """Forward one request; returns (status, headers, body)."""
+        target = self.target(task_id)
+        if target is None:
+            return 502, {}, b'{"error": "no proxy target for task"}'
+        host, port = target
+        url = f"http://{host}:{port}{path}"
+        if query:
+            url += f"?{query}"
+        fwd_headers = {
+            k: v for k, v in headers.items() if k.lower() not in HOP_HEADERS
+        }
+        try:
+            resp = requests.request(
+                method, url, headers=fwd_headers,
+                data=body if body else None, timeout=60,
+                allow_redirects=False,
+            )
+        except requests.RequestException as e:
+            logger.warning("proxy to %s failed: %s", task_id, e)
+            return 502, {}, f'{{"error": "proxy failed: {e}"}}'.encode()
+        out_headers = {
+            k: v for k, v in resp.headers.items()
+            if k.lower() not in HOP_HEADERS
+        }
+        return resp.status_code, out_headers, resp.content

@@ -46,8 +46,13 @@ PAGE = """<!doctype html>
 <script>
 let selExp = null, selTrial = null, logAfter = 0;
 const $ = (id) => document.getElementById(id);
-const cell = (t) => `<td>${t}</td>`;
-const state = (s) => `<td class="${s}">${s}</td>`;
+// Escape EVERYTHING interpolated into innerHTML: hparams/searcher names are
+// user-controlled strings (unescaped they'd be stored XSS able to lift the
+// auth token from localStorage).
+const esc = (t) => String(t).replace(/[&<>"']/g,
+  (c) => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+const cell = (t) => `<td>${esc(t)}</td>`;
+const state = (s) => `<td class="${esc(s)}">${esc(s)}</td>`;
 
 async function j(path) {
   const headers = {};
@@ -64,7 +69,10 @@ async function doLogin() {
     body: JSON.stringify({username: $('u').value, password: $('p').value}),
   });
   if (r.status !== 200) { $('login-err').textContent = 'invalid credentials'; return; }
-  localStorage.setItem('dtpu_token', (await r.json()).token);
+  const tok = (await r.json()).token;
+  localStorage.setItem('dtpu_token', tok);
+  // Cookie lets /proxy/ pages (which can't set headers) authenticate too.
+  document.cookie = 'dtpu_token=' + tok + '; path=/; SameSite=Strict';
   $('login').style.display = 'none';
   refresh();
 }

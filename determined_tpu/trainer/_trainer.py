@@ -323,6 +323,7 @@ class Trainer:
             }
             dt = time.time() - t_report
             agg["batches_per_second"] = len(host) / dt if dt > 0 else 0.0
+            self._last_throughput = agg["batches_per_second"]
             steps_now = self.steps_completed
             self.core.train.report_training_metrics(steps_now, agg)
             self._tb_scalars(steps_now, agg)
@@ -384,10 +385,14 @@ class Trainer:
                         self.steps_completed, last_val
                     )
                     self._tb_scalars(self.steps_completed, last_val, prefix="val_")
-                metric = last_val.get(self.searcher_metric)
-                if metric is None:
-                    # no validation data: fall back to last train loss
-                    metric = 0.0
+                # Throughput is a first-class searcher metric (mesh/batch
+                # autotuning sweeps maximize it); validation metrics win on
+                # name collision.
+                completion = {
+                    "batches_per_second": getattr(self, "_last_throughput", 0.0),
+                    **last_val,
+                }
+                metric = completion.get(self.searcher_metric, 0.0)
                 op.report_completed(float(metric))
 
         if (

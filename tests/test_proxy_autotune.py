@@ -1,0 +1,190 @@
+"""Proxy routing through the master + mesh-autotune searcher flow."""
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+import requests
+
+from determined_tpu.master.api_server import ApiServer
+from determined_tpu.master.core import Master
+
+
+@pytest.fixture()
+def live():
+    master = Master()
+    api = ApiServer(master)
+    api.start()
+    master.external_url = api.url
+    yield master, api
+    api.stop()
+    master.shutdown()
+
+
+def _backend_server(payload: bytes):
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = payload + self.path.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            data = self.rfile.read(n)
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)  # echo
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+class TestProxy:
+    def test_forwarding(self, live):
+        master, api = live
+        srv = _backend_server(b"task-ui:")
+        try:
+            master.alloc_service.create(
+                "nb.1.0", task_id="cmd-1", trial_id=None,
+                num_processes=1, slots=0,
+            )
+            # task registers its UI port
+            requests.post(
+                f"{api.url}/api/v1/allocations/nb.1.0/proxy",
+                json={"host": "127.0.0.1", "port": srv.server_address[1]},
+                timeout=10,
+            ).raise_for_status()
+            r = requests.get(f"{api.url}/proxy/cmd-1/some/page?x=1", timeout=10)
+            assert r.status_code == 200
+            assert r.text == "task-ui:/some/page?x=1"
+            # POST bodies pass through
+            r = requests.post(
+                f"{api.url}/proxy/cmd-1/echo", data=b"hello", timeout=10
+            )
+            assert r.content == b"hello"
+            # listing
+            proxies = requests.get(f"{api.url}/api/v1/proxies", timeout=10).json()
+            assert "cmd-1" in proxies["proxies"]
+        finally:
+            srv.shutdown()
+
+    def test_unknown_target_502(self, live):
+        master, api = live
+        r = requests.get(f"{api.url}/proxy/nope/", timeout=10)
+        assert r.status_code == 502
+
+    def test_unregistered_on_exit(self, live):
+        master, api = live
+        srv = _backend_server(b"x")
+        try:
+            master.alloc_service.create(
+                "nb.2.0", task_id="cmd-2", trial_id=None,
+                num_processes=1, slots=0,
+            )
+            master.proxy.register("cmd-2", "127.0.0.1", srv.server_address[1])
+            master.alloc_service.complete("nb.2.0", 0)
+            assert master.proxy.target("cmd-2") is None
+        finally:
+            srv.shutdown()
+
+
+class TestMeshAutotune:
+    def test_grid_over_meshes_maximizes_throughput(self, tmp_path):
+        # FSM-level: grid over mesh candidates, searcher metric is
+        # batches_per_second maximized; best mesh wins.
+        from determined_tpu.master import db as db_mod
+        from determined_tpu.master.experiment import Experiment
+
+        config = {
+            "searcher": {"name": "grid", "max_length": 10,
+                         "metric": "batches_per_second",
+                         "smaller_is_better": False},
+            "hyperparameters": {
+                "mesh": {"type": "categorical", "vals": [
+                    {"data": 8}, {"data": 4, "fsdp": 2}, {"data": 2, "fsdp": 4},
+                ]},
+            },
+        }
+        db = db_mod.Database()
+        eid = db.add_experiment(config)
+
+        class FakeLauncher:
+            launched = []
+
+            def launch(self, e, rec):
+                self.launched.append(rec)
+
+            def preempt(self, t):
+                pass
+
+            def kill(self, t):
+                pass
+
+        launcher = FakeLauncher()
+        exp = Experiment(eid, config, db, launcher)
+        exp.start()
+        assert len(launcher.launched) == 3
+        # throughput depends on the mesh; {data:4,fsdp:2} is "fastest"
+        speed = {8: 10.0, 4: 25.0, 2: 15.0}
+        for rec in list(launcher.launched):
+            thpt = speed[rec.hparams["mesh"]["data"]]
+            while True:
+                resp = exp.current_searcher_op(rec.trial_id, timeout=0)
+                if resp.get("completed"):
+                    exp.trial_exited(rec.trial_id, 0)
+                    break
+                exp.op_completed(rec.trial_id, resp["op"]["length"], thpt)
+        assert exp.state == "COMPLETED"
+        trials = db.list_trials(eid)
+        best = max(trials, key=lambda t: t["searcher_metric"])
+        assert best["hparams"]["mesh"] == {"data": 4, "fsdp": 2}
+
+    def test_harness_prefers_hparam_mesh(self, devices8):
+        from determined_tpu.exec.harness import resolve_mesh
+
+        mesh = resolve_mesh(
+            {"mesh": {"data": 2, "fsdp": 4}}, {"mesh": {"data": 8}}
+        )
+        assert mesh.shape["data"] == 2 and mesh.shape["fsdp"] == 4
+        mesh = resolve_mesh({}, {"mesh": {"data": 8}})
+        assert mesh.shape["data"] == 8
+        assert resolve_mesh({}, {}) is None
+
+    def test_trainer_reports_throughput_metric(self, tmp_path):
+        import optax
+
+        from determined_tpu import core
+        from determined_tpu.models import MnistMLP
+        from determined_tpu.models.vision import MLPConfig
+        from determined_tpu.trainer import Batch, JAXTrial, Trainer
+
+        class T(JAXTrial):
+            def build_model(self, mesh):
+                return MnistMLP(MLPConfig(in_dim=8, hidden=16, n_classes=2))
+
+            def build_optimizer(self):
+                return optax.sgd(0.1)
+
+            def build_training_data(self):
+                import numpy as np
+
+                rng = np.random.default_rng(0)
+                while True:
+                    yield {
+                        "image": rng.normal(size=(8, 8)).astype("float32"),
+                        "label": rng.integers(0, 2, (8,)).astype("int32"),
+                    }
+
+        ctx = core._context._dummy_init(checkpoint_storage=str(tmp_path))
+        trainer = Trainer(T(), ctx, searcher_metric="batches_per_second")
+        trainer.fit(max_length=Batch(5), report_period=Batch(5))
+        assert getattr(trainer, "_last_throughput", 0.0) > 0.0
