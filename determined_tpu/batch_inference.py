@@ -1,0 +1,83 @@
+"""Batch inference: map a processor over a dataset on the cluster.
+
+Rebuild of the reference's experimental TorchBatchProcessor
+(`harness/determined/pytorch/experimental/_torch_batch_process.py:24,123`):
+subclass `BatchProcessor`, point an experiment (or off-cluster script) at
+`run_batch_inference`, and the dataset is partitioned across the
+allocation's workers — each rank processes batches `rank::size`, with
+periodic synchronization so preemption/restart resumes from the last
+completed sync point.
+
+    class Embedder(BatchProcessor):
+        def setup(self, core_ctx): self.params = load(...)
+        def process_batch(self, batch, batch_idx): write embeddings...
+
+    run_batch_inference(Embedder(), dataset, core_ctx, sync_every=10)
+"""
+from __future__ import annotations
+
+import abc
+import logging
+from typing import Any, Iterable, Optional
+
+from determined_tpu import core as core_mod
+
+logger = logging.getLogger("determined_tpu.batch_inference")
+
+
+class BatchProcessor(abc.ABC):
+    def setup(self, core_context: core_mod.Context) -> None:
+        """Load models/outputs writers; called once before processing."""
+
+    @abc.abstractmethod
+    def process_batch(self, batch: Any, batch_idx: int) -> None:
+        """Handle one batch (rank-local; write outputs yourself)."""
+
+    def on_sync(self, batches_done: int) -> None:
+        """Called at each cross-worker sync point (e.g. flush outputs)."""
+
+    def teardown(self) -> None:
+        """Called after the final batch."""
+
+
+def run_batch_inference(
+    processor: BatchProcessor,
+    dataset: Iterable[Any],
+    core_context: Optional[core_mod.Context] = None,
+    sync_every: int = 50,
+) -> int:
+    """Partition `dataset` over the allocation and run the processor.
+
+    Returns the number of batches this rank processed. Batches are assigned
+    round-robin by index (rank i takes batches i, i+size, ...), matching the
+    reference's worker sharding; `sync_every` barriers keep workers loosely
+    in step and give preemption a clean boundary.
+    """
+    ctx = core_context or core_mod.init()
+    dist = ctx.distributed
+    rank, size = dist.rank, dist.size
+    processor.setup(ctx)
+
+    mine = 0
+    preempted = False
+    # Sync points are GLOBAL index boundaries (every sync_every*size
+    # batches), so all ranks execute identical barrier/broadcast counts —
+    # per-rank counters would deadlock when the dataset doesn't divide
+    # evenly (one rank syncs inside the loop, another only at the end).
+    sync_stride = max(1, sync_every) * size
+    for idx, batch in enumerate(dataset):
+        if idx % size == rank:
+            processor.process_batch(batch, idx)
+            mine += 1
+        if (idx + 1) % sync_stride == 0:
+            dist.barrier()
+            processor.on_sync(mine)
+            if ctx.preempt.should_preempt():
+                logger.info("batch inference preempted at batch %d", idx)
+                preempted = True
+                break
+    if not preempted:
+        dist.barrier()
+        processor.on_sync(mine)
+    processor.teardown()
+    return mine

@@ -70,6 +70,13 @@ def _table(rows: List[Dict[str, Any]], cols: List[str]) -> None:
 # -- experiment --------------------------------------------------------------
 def exp_create(args: argparse.Namespace) -> None:
     config = _load_config(args.config)
+    if args.model_dir:
+        from determined_tpu.common.context_dir import bundle
+
+        data = bundle(args.model_dir)
+        resp = _session(args).post_bytes("/api/v1/files", data)
+        config["context"] = resp["id"]
+        print(f"Uploaded context {args.model_dir} ({len(data)} bytes)")
     if args.config_override:
         for kv in args.config_override:
             path, _, raw = kv.partition("=")
@@ -313,6 +320,8 @@ def build_parser() -> argparse.ArgumentParser:
         dest="verb", required=True)
     c = exp.add_parser("create")
     c.add_argument("config")
+    c.add_argument("model_dir", nargs="?", default=None,
+                   help="context directory to ship with the experiment")
     c.add_argument("--config-override", "-O", action="append",
                    help="dot.path=json overrides")
     c.add_argument("--follow", "-f", action="store_true")

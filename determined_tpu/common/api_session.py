@@ -69,6 +69,19 @@ class Session:
     def get(self, path: str, **kw: Any) -> Any:
         return self._request("GET", path, **kw).json()
 
+    def get_bytes(self, path: str, **kw: Any) -> bytes:
+        return self._request("GET", path, **kw).content
+
+    def post_bytes(self, path: str, data: bytes, **kw: Any) -> Any:
+        url = f"{self.master_url}{path}"
+        resp = self._http.post(
+            url, data=data,
+            headers={"Content-Type": "application/octet-stream"},
+            timeout=kw.get("timeout", self._timeout),
+        )
+        resp.raise_for_status()
+        return resp.json()
+
     def post(self, path: str, json_body: Optional[Dict[str, Any]] = None, **kw: Any) -> Any:
         resp = self._request("POST", path, json_body=json_body, **kw)
         return resp.json() if resp.content else None

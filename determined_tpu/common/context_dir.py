@@ -1,0 +1,61 @@
+"""Context-directory bundling: ship user model code with an experiment.
+
+Rebuild of the reference's context upload (`harness/determined/common/
+context.py` bundling + the model-def tgz download in
+`exec/prep_container.py:23`): `dtpu experiment create config.yaml DIR`
+tars DIR (ignoring VCS/caches), uploads it to the master's file store, and
+every task of the experiment extracts it into its working directory before
+the entrypoint runs — so `entrypoint: "model_def:MyTrial"` resolves against
+the user's shipped code, no pre-installed PYTHONPATH needed.
+"""
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+from typing import List, Optional
+
+IGNORE_DIRS = {".git", "__pycache__", ".pytest_cache", ".ipynb_checkpoints",
+               "node_modules", ".venv", "venv"}
+IGNORE_SUFFIXES = (".pyc", ".pyo", ".so")
+
+
+def bundle(directory: str, max_bytes: int = 96 * 1024 * 1024) -> bytes:
+    """tar.gz `directory` (contents at the archive root)."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        for root, dirs, files in os.walk(directory):
+            dirs[:] = [d for d in dirs if d not in IGNORE_DIRS]
+            for fname in sorted(files):
+                if fname.endswith(IGNORE_SUFFIXES):
+                    continue
+                full = os.path.join(root, fname)
+                rel = os.path.relpath(full, directory)
+                tar.add(full, arcname=rel)
+    data = buf.getvalue()
+    if len(data) > max_bytes:
+        raise ValueError(
+            f"context directory {directory} is {len(data)} bytes compressed; "
+            f"cap is {max_bytes} (exclude data files — ship code only)"
+        )
+    return data
+
+
+def extract(data: bytes, dest: str) -> List[str]:
+    """Extract a context bundle; returns the extracted member names."""
+    os.makedirs(dest, exist_ok=True)
+    names: List[str] = []
+    with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
+        for member in tar.getmembers():
+            # path-traversal guard: members must stay under dest
+            target = os.path.realpath(os.path.join(dest, member.name))
+            if not target.startswith(os.path.realpath(dest) + os.sep):
+                raise ValueError(f"unsafe path in context bundle: {member.name}")
+            names.append(member.name)
+        try:
+            tar.extractall(dest, filter="data")
+        except TypeError:
+            # filter= landed in 3.10.12/3.11.4; the manual path-traversal
+            # guard above already covers older interpreters.
+            tar.extractall(dest)
+    return names

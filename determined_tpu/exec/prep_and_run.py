@@ -50,7 +50,7 @@ def rendezvous(master_url: str, alloc_id: str, rank: int, num_procs: int) -> Non
     """Run the rendezvous protocol; mutates os.environ for the entrypoint."""
     if num_procs <= 1:
         return
-    session = Session(master_url)
+    session = _task_session(master_url)
     ip = _my_ip(master_url)
     if rank == 0:
         coord_port, chief_port = ipc.free_port(), ipc.free_port()
@@ -79,6 +79,36 @@ def rendezvous(master_url: str, alloc_id: str, rank: int, num_procs: int) -> Non
     os.environ["DTPU_CHIEF_PORT"] = chief_port
 
 
+def _task_session(master_url: str) -> Session:
+    """Session carrying the task's credential (DTPU_SESSION_TOKEN): on an
+    auth-enabled master, rendezvous/files/signals all require it."""
+    return Session(master_url, token=os.environ.get("DTPU_SESSION_TOKEN", ""))
+
+
+def prepare_context(master_url: str) -> None:
+    """Download + extract the experiment's shipped code directory and make
+    it the working directory / import root (ref: prep_container.py:23
+    model-def tgz download)."""
+    context_id = os.environ.get("DTPU_CONTEXT_ID")
+    if not context_id:
+        return
+    import tempfile
+
+    from determined_tpu.common.context_dir import extract
+
+    session = _task_session(master_url)
+    data = session.get_bytes(f"/api/v1/files/{context_id}")
+    dest = tempfile.mkdtemp(prefix="dtpu-context-")
+    extract(data, dest)
+    os.chdir(dest)
+    sys.path.insert(0, dest)
+    # Child processes (shell entrypoints) resolve imports there too.
+    os.environ["PYTHONPATH"] = (
+        dest + os.pathsep + os.environ.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    logger.info("context %s extracted to %s", context_id, dest)
+
+
 def main() -> int:
     logging.basicConfig(level=logging.INFO)
     master_url = os.environ["DTPU_MASTER"]
@@ -87,6 +117,7 @@ def main() -> int:
     num_procs = int(os.environ.get("DTPU_ALLOC_NUM_PROCS", "1"))
     entrypoint = os.environ.get("DTPU_ENTRYPOINT", "")
 
+    prepare_context(master_url)
     rendezvous(master_url, alloc_id, rank, num_procs)
 
     if ":" in entrypoint and " " not in entrypoint:
@@ -95,7 +126,7 @@ def main() -> int:
         def on_sigterm(signum, frame):  # noqa: ANN001
             logger.info("SIGTERM: requesting preemption")
             try:
-                Session(master_url).post(
+                _task_session(master_url).post(
                     f"/api/v1/allocations/{alloc_id}/signals/preemption_from_task"
                 )
             except Exception:  # noqa: BLE001

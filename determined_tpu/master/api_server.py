@@ -25,6 +25,10 @@ logger = logging.getLogger("determined_tpu.master")
 
 Handler = Callable[["ApiRequest"], Any]
 
+#: hard cap on any request body (context uploads are the largest legitimate
+#: payload; their own cap is slightly smaller so the error is specific).
+MAX_BODY_BYTES = 128 * 1024 * 1024
+
 
 class ApiError(Exception):
     def __init__(self, status: int, message: str) -> None:
@@ -36,9 +40,9 @@ class _PlainText(Exception):
     """Control-flow: handler responds with a non-JSON body (Prometheus
     scrape, WebUI HTML)."""
 
-    def __init__(self, text: str, content_type: str = "text/plain; version=0.0.4") -> None:
+    def __init__(self, text, content_type: str = "text/plain; version=0.0.4") -> None:
         super().__init__("plaintext response")
-        self.text = text
+        self.text = text  # str or bytes
         self.content_type = content_type
 
 
@@ -50,12 +54,14 @@ class ApiRequest:
         query: Dict[str, List[str]],
         token: Optional[str] = None,
         client_ip: str = "",
+        raw: bytes = b"",
     ):
         self.groups = groups
         self.body = body
         self.query = query
         self.token = token  # Bearer token from the Authorization header
         self.client_ip = client_ip
+        self.raw = raw      # non-JSON request body (file uploads)
 
     def q(self, name: str, default: Optional[str] = None) -> Optional[str]:
         vals = self.query.get(name)
@@ -177,13 +183,23 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         alloc = m.alloc_service.get(r.groups[0])
         if alloc is None:
             raise ApiError(404, "no such allocation")
-        # SSRF guard: a task may only expose itself. Allowed hosts are the
-        # caller's own address (the task registers from the host it runs on)
-        # and the allocation's rendezvous addresses — never arbitrary
-        # master-network targets like cloud metadata endpoints.
-        allowed = {r.client_ip, "127.0.0.1", "localhost"}
+        # Ownership: with auth on, a task token may only register ITS OWN
+        # allocation (user principals — operators — may register any).
+        principal = m.auth.validate(r.token)
+        if (
+            m.auth.enabled
+            and principal
+            and principal.startswith("task:")
+            and principal != f"task:{alloc.task_id}"
+        ):
+            raise ApiError(403, "token does not own this allocation")
+        # SSRF guard: a task may only expose itself — the caller's own
+        # address or the allocation's rendezvous addresses. No hardcoded
+        # loopback: 127.0.0.1 here is the MASTER's loopback (only valid
+        # when the task itself is local, i.e. client_ip is loopback).
+        allowed = {r.client_ip}
         allowed.update(a.split(":")[0] for a in alloc.addrs.values())
-        host = r.body.get("host") or r.client_ip or "127.0.0.1"
+        host = r.body.get("host") or r.client_ip
         if host not in allowed:
             raise ApiError(403, f"proxy host {host!r} is not this allocation")
         m.proxy.register(alloc.task_id, host, int(r.body["port"]))
@@ -391,6 +407,22 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         m.db.delete_webhook(int(r.groups[0]))
         return {}
 
+    # -- context files (model-def upload, ref: common/context.py bundling) -----
+    MAX_CONTEXT_BYTES = 96 * 1024 * 1024
+
+    def upload_file(r: ApiRequest):
+        if not r.raw:
+            raise ApiError(400, "empty upload")
+        if len(r.raw) > MAX_CONTEXT_BYTES:
+            raise ApiError(413, "context too large (96MB cap)")
+        return {"id": m.db.put_file(r.raw)}
+
+    def download_file(r: ApiRequest):
+        data = m.db.get_file(r.groups[0])
+        if data is None:
+            raise ApiError(404, "no such file")
+        raise _PlainText(data, content_type="application/octet-stream")
+
     def master_info(r: ApiRequest):
         return {
             "cluster_id": m.cluster_id,
@@ -466,6 +498,8 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("GET", r"/api/v1/agents/([\w.\-]+)/actions", agent_actions),
         R("POST", r"/api/v1/agents/([\w.\-]+)/events", agent_events),
         R("GET", r"/api/v1/agents", list_agents),
+        R("POST", r"/api/v1/files", upload_file),
+        R("GET", r"/api/v1/files/([0-9a-f]+)", download_file),
         R("POST", r"/api/v1/commands", create_command),
         R("GET", r"/api/v1/commands", list_commands),
         R("POST", r"/api/v1/commands/([\w.\-]+)/kill", kill_command),
@@ -546,13 +580,22 @@ class ApiServer:
                         self._send(401, {"error": "authentication required"})
                         return
                 body: Dict[str, Any] = {}
+                raw: bytes = b""
                 length = int(self.headers.get("Content-Length") or 0)
+                if length > MAX_BODY_BYTES:
+                    # Reject BEFORE reading: buffering an attacker-chosen
+                    # Content-Length would OOM the master.
+                    self._send(413, {"error": "request body too large"})
+                    return
                 if length:
-                    try:
-                        body = json.loads(self.rfile.read(length) or b"{}")
-                    except json.JSONDecodeError:
-                        self._send(400, {"error": "bad json"})
-                        return
+                    raw = self.rfile.read(length)
+                    ctype = self.headers.get("Content-Type", "application/json")
+                    if "json" in ctype:
+                        try:
+                            body = json.loads(raw or b"{}")
+                        except json.JSONDecodeError:
+                            self._send(400, {"error": "bad json"})
+                            return
                 for m_, pat, handler in routes:
                     if m_ != method:
                         continue
@@ -564,11 +607,16 @@ class ApiServer:
                                     match.groups(), body,
                                     parse_qs(parsed.query), token=token,
                                     client_ip=self.client_address[0],
+                                    raw=raw,
                                 )
                             )
                             self._send(200, result if result is not None else {})
                         except _PlainText as pt:
-                            data = pt.text.encode()
+                            data = (
+                                pt.text.encode()
+                                if isinstance(pt.text, str)
+                                else pt.text
+                            )
                             self.send_response(200)
                             self.send_header("Content-Type", pt.content_type)
                             self.send_header("Content-Length", str(len(data)))

@@ -304,6 +304,8 @@ class Master:
             jax_platform = config.get("environment", {}).get("jax_platform")
             if jax_platform:
                 env["DTPU_JAX_PLATFORM"] = jax_platform
+            if config.get("context"):
+                env["DTPU_CONTEXT_ID"] = str(config["context"])
             self.agent_hub.enqueue(
                 agent_id,
                 {

@@ -72,6 +72,11 @@ CREATE TABLE IF NOT EXISTS task_logs (
     log TEXT NOT NULL
 );
 CREATE INDEX IF NOT EXISTS task_logs_task ON task_logs(task_id, id);
+CREATE TABLE IF NOT EXISTS files (
+    id TEXT PRIMARY KEY,           -- content hash
+    data BLOB NOT NULL,            -- tar.gz of a context directory
+    created_at REAL
+);
 CREATE TABLE IF NOT EXISTS allocations (
     id TEXT PRIMARY KEY,           -- allocation id
     task_id TEXT,
@@ -411,6 +416,21 @@ class Database:
                 (f"{task_prefix}%",),
             )
         ]
+
+    # -- context files (ref: model-def tgz, internal/api_experiment upload) ----
+    def put_file(self, data: bytes) -> str:
+        import hashlib
+
+        file_id = hashlib.sha256(data).hexdigest()[:24]
+        self._execute(
+            "INSERT OR IGNORE INTO files (id, data, created_at) VALUES (?,?,?)",
+            (file_id, data, time.time()),
+        )
+        return file_id
+
+    def get_file(self, file_id: str) -> Optional[bytes]:
+        rows = self._query("SELECT data FROM files WHERE id=?", (file_id,))
+        return bytes(rows[0]["data"]) if rows else None
 
     # -- webhooks (ref: internal/webhooks) -------------------------------------
     def add_webhook(self, url: str, trigger_states: List[str]) -> int:

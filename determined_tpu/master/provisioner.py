@@ -225,13 +225,19 @@ class GCPTPUProvisioner:
             )
             script.write(self._startup_script(name))
             script.close()
-            self._run([
-                "gcloud", "compute", "tpus", "tpu-vm", "create", name,
-                f"--project={self.project}", f"--zone={self.zone}",
-                f"--accelerator-type={self.accelerator_type}",
-                f"--version={self.runtime_version}",
-                f"--metadata-from-file=startup-script={script.name}",
-            ])
+            try:
+                self._run([
+                    "gcloud", "compute", "tpus", "tpu-vm", "create", name,
+                    f"--project={self.project}", f"--zone={self.zone}",
+                    f"--accelerator-type={self.accelerator_type}",
+                    f"--version={self.runtime_version}",
+                    f"--metadata-from-file=startup-script={script.name}",
+                ])
+            finally:
+                # the file carries the agent token; never leave it behind
+                import os
+
+                os.unlink(script.name)
 
     def terminate(self, agent_ids: List[str]) -> None:
         for aid in agent_ids:

@@ -62,9 +62,25 @@ class ProxyRegistry:
         url = f"http://{host}:{port}{path}"
         if query:
             url += f"?{query}"
-        fwd_headers = {
-            k: v for k, v in headers.items() if k.lower() not in HOP_HEADERS
-        }
+        fwd_headers = {}
+        for k, v in headers.items():
+            kl = k.lower()
+            if kl in HOP_HEADERS:
+                continue
+            if kl == "authorization":
+                # NEVER forward master credentials into user task code.
+                continue
+            if kl == "cookie":
+                # Strip the master auth cookie; pass the rest (the task's
+                # own app cookies, e.g. a notebook session).
+                kept = [
+                    c for c in v.split(";")
+                    if c.strip().partition("=")[0] != "dtpu_token"
+                ]
+                if not kept:
+                    continue
+                v = ";".join(kept)
+            fwd_headers[k] = v
         try:
             resp = requests.request(
                 method, url, headers=fwd_headers,

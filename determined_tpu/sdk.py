@@ -161,7 +161,16 @@ class Determined:
     def __init__(self, master_url: str) -> None:
         self._session = Session(master_url)
 
-    def create_experiment(self, config: Dict[str, Any]) -> Experiment:
+    def create_experiment(
+        self, config: Dict[str, Any], model_dir: Optional[str] = None
+    ) -> Experiment:
+        if model_dir:
+            from determined_tpu.common.context_dir import bundle
+
+            config = dict(config)
+            config["context"] = self._session.post_bytes(
+                "/api/v1/files", bundle(model_dir)
+            )["id"]
         resp = self._session.post(
             "/api/v1/experiments", json_body={"config": config}
         )

@@ -82,4 +82,11 @@ def from_config(config: Optional[dict], base_dir: Optional[str] = None) -> Stora
         )
     if typ == "gcs":
         return GCSStorageManager(config["bucket"], config.get("prefix", ""))
+    if typ == "s3":
+        from determined_tpu.storage.s3 import S3StorageManager
+
+        return S3StorageManager(
+            config["bucket"], config.get("prefix", ""),
+            endpoint_url=config.get("endpoint_url"),
+        )
     raise ValueError(f"unknown checkpoint storage type: {typ}")

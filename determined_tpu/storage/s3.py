@@ -1,0 +1,83 @@
+"""S3 checkpoint storage (ref: common/storage/s3.py:23 S3StorageManager).
+
+Gated on boto3: TPU-focused images usually ship without AWS SDKs, so the
+import happens at construction with a clear error. The object layout is
+identical to GCS: `{prefix}{storage_id}/{relative_path}`.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional
+
+from determined_tpu.storage.base import StorageManager
+
+
+class S3StorageManager(StorageManager):
+    def __init__(self, bucket: str, prefix: str = "", endpoint_url: Optional[str] = None) -> None:
+        super().__init__(f"s3://{bucket}/{prefix}")
+        try:
+            import boto3  # type: ignore[import-not-found]
+        except ImportError as e:
+            raise RuntimeError(
+                "S3 checkpoint storage needs boto3, which is not installed "
+                "in this environment; use gcs or shared_fs storage"
+            ) from e
+        self._client = boto3.client("s3", endpoint_url=endpoint_url)
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        if self.prefix:
+            self.prefix += "/"
+
+    def _key(self, storage_id: str, rel: str = "") -> str:
+        return f"{self.prefix}{storage_id}/{rel}" if rel else f"{self.prefix}{storage_id}/"
+
+    def upload(self, src: str, storage_id: str, paths: Optional[List[str]] = None) -> None:
+        rels = paths if paths is not None else self._list_dir(src)
+        for rel in rels:
+            self._client.upload_file(
+                os.path.join(src, rel), self.bucket, self._key(storage_id, rel)
+            )
+
+    def list_files(self, storage_id: str) -> List[str]:
+        out: List[str] = []
+        token = None
+        base = self._key(storage_id)
+        while True:
+            kw = {"Bucket": self.bucket, "Prefix": base}
+            if token:
+                kw["ContinuationToken"] = token
+            resp = self._client.list_objects_v2(**kw)
+            out.extend(
+                obj["Key"][len(base):] for obj in resp.get("Contents", [])
+            )
+            if not resp.get("IsTruncated"):
+                return sorted(out)
+            token = resp.get("NextContinuationToken")
+
+    def download(
+        self, storage_id: str, dst: str,
+        selector: Optional[Callable[[str], bool]] = None,
+    ) -> None:
+        for rel in self.list_files(storage_id):
+            if selector is not None and not selector(rel):
+                continue
+            target = os.path.join(dst, rel)
+            os.makedirs(os.path.dirname(target) or dst, exist_ok=True)
+            self._client.download_file(
+                self.bucket, self._key(storage_id, rel), target
+            )
+
+    def delete(self, storage_id: str, paths: Optional[List[str]] = None) -> List[str]:
+        rels = list(paths if paths is not None else self.list_files(storage_id))
+        # DeleteObjects hard-caps at 1000 keys per request.
+        for i in range(0, len(rels), 1000):
+            self._client.delete_objects(
+                Bucket=self.bucket,
+                Delete={
+                    "Objects": [
+                        {"Key": self._key(storage_id, rel)}
+                        for rel in rels[i: i + 1000]
+                    ]
+                },
+            )
+        return rels

@@ -1,0 +1,94 @@
+"""Batch inference: worker partitioning + sync semantics (threaded
+Execution fixture, like the reference's parallel tests) and storage gating."""
+import threading
+
+import pytest
+
+from determined_tpu.batch_inference import BatchProcessor, run_batch_inference
+from determined_tpu.core._checkpoint import DummyCheckpointContext
+from determined_tpu.core._context import Context
+from determined_tpu.core._preempt import DummyPreemptContext
+from determined_tpu.core._searcher import DummySearcherContext
+from determined_tpu.core._train import DummyTrainContext
+from determined_tpu.storage.shared import SharedFSStorageManager
+from tests.parallel import run_parallel
+
+
+class Collector(BatchProcessor):
+    def __init__(self):
+        self.batches = []
+        self.syncs = 0
+        self.torn_down = False
+
+    def process_batch(self, batch, batch_idx):
+        self.batches.append((batch_idx, batch))
+
+    def on_sync(self, n):
+        self.syncs += 1
+
+    def teardown(self):
+        self.torn_down = True
+
+
+def _ctx(dist, tmp):
+    return Context(
+        distributed=dist,
+        train=DummyTrainContext(),
+        checkpoint=DummyCheckpointContext(dist, SharedFSStorageManager(str(tmp))),
+        preempt=DummyPreemptContext(dist),
+        searcher=DummySearcherContext(dist),
+    )
+
+
+class TestBatchInference:
+    def test_partitions_across_workers(self, tmp_path):
+        dataset = [f"item-{i}" for i in range(20)]
+        collectors = {}
+
+        def worker(dist):
+            proc = Collector()
+            collectors[dist.rank] = proc
+            ctx = _ctx(dist, tmp_path)
+            n = run_batch_inference(proc, dataset, ctx, sync_every=4)
+            return n
+
+        counts = run_parallel(4, worker)
+        assert sum(counts) == 20
+        seen = sorted(
+            idx for c in collectors.values() for idx, _ in c.batches
+        )
+        assert seen == list(range(20))  # full coverage, no duplicates
+        # rank r got exactly batches r::4
+        for rank, proc in collectors.items():
+            assert all(idx % 4 == rank for idx, _ in proc.batches)
+        assert all(c.torn_down and c.syncs >= 1 for c in collectors.values())
+
+    def test_single_process(self, tmp_path):
+        from determined_tpu.core._distributed import DummyDistributedContext
+
+        proc = Collector()
+        n = run_batch_inference(
+            proc, list(range(7)), _ctx(DummyDistributedContext(), tmp_path),
+            sync_every=3,
+        )
+        assert n == 7 and proc.torn_down
+
+
+class TestStorageGating:
+    def test_s3_clear_error_without_boto3(self):
+        from determined_tpu.storage import from_config
+
+        try:
+            import boto3  # noqa: F401
+
+            pytest.skip("boto3 installed here; gating not applicable")
+        except ImportError:
+            pass
+        with pytest.raises(RuntimeError, match="boto3"):
+            from_config({"type": "s3", "bucket": "b"})
+
+    def test_unknown_type(self):
+        from determined_tpu.storage import from_config
+
+        with pytest.raises(ValueError, match="unknown"):
+            from_config({"type": "carrier-pigeon"})

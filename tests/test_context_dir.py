@@ -1,0 +1,114 @@
+"""Context-directory shipping: bundle/extract unit tests + cluster e2e
+where the trial class lives ONLY in the shipped directory."""
+import textwrap
+import time
+
+import pytest
+
+from determined_tpu.common.context_dir import bundle, extract
+
+
+class TestBundle:
+    def test_roundtrip(self, tmp_path):
+        src = tmp_path / "src"
+        (src / "pkg").mkdir(parents=True)
+        (src / "model_def.py").write_text("X = 41\n")
+        (src / "pkg" / "__init__.py").write_text("")
+        (src / "junk.pyc").write_bytes(b"\x00")
+        (src / ".git").mkdir()
+        (src / ".git" / "config").write_text("secret")
+
+        data = bundle(str(src))
+        dest = tmp_path / "dest"
+        names = extract(data, str(dest))
+        assert "model_def.py" in names
+        assert (dest / "model_def.py").read_text() == "X = 41\n"
+        assert not (dest / "junk.pyc").exists()
+        assert not (dest / ".git").exists()
+
+    def test_size_cap(self, tmp_path):
+        src = tmp_path / "big"
+        src.mkdir()
+        import os
+
+        (src / "blob.bin").write_bytes(os.urandom(2 * 1024 * 1024))
+        with pytest.raises(ValueError, match="cap"):
+            bundle(str(src), max_bytes=1024 * 1024)
+
+    def test_content_addressed_id(self, tmp_path):
+        from determined_tpu.master.db import Database
+
+        src = tmp_path / "s"
+        src.mkdir()
+        (src / "a.py").write_text("pass\n")
+        db = Database()
+        data = bundle(str(src))
+        assert db.put_file(data) == db.put_file(data)  # dedup by hash
+        assert db.get_file(db.put_file(data)) == data
+
+
+MODEL_DEF = textwrap.dedent("""
+    import numpy as np
+    import optax
+    from determined_tpu.trainer import JAXTrial
+    from determined_tpu.models import MnistMLP
+    from determined_tpu.models.vision import MLPConfig
+
+    class ShippedTrial(JAXTrial):
+        def build_model(self, mesh):
+            return MnistMLP(MLPConfig(in_dim=16, hidden=16, n_classes=2))
+
+        def build_optimizer(self):
+            return optax.adam(1e-2)
+
+        def build_training_data(self):
+            rng = np.random.default_rng(0)
+            while True:
+                yield {
+                    "image": rng.normal(size=(8, 16)).astype(np.float32),
+                    "label": rng.integers(0, 2, (8,)).astype(np.int32),
+                }
+
+        def build_validation_data(self):
+            rng = np.random.default_rng(1)
+            return [{
+                "image": rng.normal(size=(8, 16)).astype(np.float32),
+                "label": rng.integers(0, 2, (8,)).astype(np.int32),
+            }]
+""")
+
+
+class TestContextE2E:
+    def test_trial_code_shipped_with_experiment(self, tmp_path):
+        from determined_tpu.devcluster import DevCluster
+        from determined_tpu.sdk import Determined
+
+        model_dir = tmp_path / "model"
+        model_dir.mkdir()
+        (model_dir / "model_def.py").write_text(MODEL_DEF)
+
+        with DevCluster(n_agents=1, slots_per_agent=1) as dc:
+            deadline = time.time() + 30
+            while time.time() < deadline and not dc.master.agent_hub.list():
+                time.sleep(0.2)
+            d = Determined(dc.api.url)
+            exp = d.create_experiment(
+                {
+                    # resolvable ONLY from the shipped context dir
+                    "entrypoint": "model_def:ShippedTrial",
+                    "searcher": {"name": "single", "max_length": 3,
+                                 "metric": "loss"},
+                    "hyperparameters": {},
+                    "resources": {"slots_per_trial": 1},
+                    "scheduling_unit": 1,
+                    "checkpoint_storage": {"type": "shared_fs",
+                                           "host_path": str(tmp_path / "ckpt")},
+                    "environment": {"jax_platform": "cpu"},
+                    "max_restarts": 0,
+                },
+                model_dir=str(model_dir),
+            )
+            state = exp.wait(timeout=240)
+            trial = exp.trials()[0]
+            assert state == "COMPLETED", trial.logs()[-20:]
+            assert trial.metrics("validation")
