@@ -229,6 +229,56 @@ def cmd_kill(args: argparse.Namespace) -> None:
     print(f"killed {args.task_id}")
 
 
+# -- interactive tasks (notebook / tensorboard) --------------------------------
+def tb_start(args: argparse.Namespace) -> None:
+    session = _session(args)
+    task_ids = []
+    storage_cfg = None
+    for exp_id in args.experiment_ids:
+        exp = session.get(f"/api/v1/experiments/{exp_id}")
+        exp_storage = exp["config"].get("checkpoint_storage")
+        if storage_cfg is None:
+            storage_cfg = exp_storage
+        elif exp_storage != storage_cfg:
+            # One TB task syncs from one backend; mixing would silently show
+            # no data for the mismatched experiments.
+            _die(
+                f"experiment {exp_id} uses a different checkpoint_storage; "
+                "start separate tensorboards per storage backend"
+            )
+        task_ids += [
+            f"trial-{t['id']}"
+            for t in session.get(f"/api/v1/experiments/{exp_id}/trials")["trials"]
+        ]
+    if not task_ids:
+        _die("no trials found for those experiments")
+    cfg = {
+        "task_type": "TENSORBOARD",
+        "entrypoint": (
+            "python -m determined_tpu.exec.tensorboard --tasks "
+            + ",".join(task_ids)
+        ),
+        "resources": {"slots": 0},
+        "checkpoint_storage": storage_cfg,
+    }
+    resp = session.post("/api/v1/commands", json_body={"config": cfg})
+    master = args.master or os.environ.get("DTPU_MASTER")
+    print(f"Started tensorboard {resp['task_id']}")
+    print(f"  open {master}/proxy/{resp['task_id']}/ once it registers")
+
+
+def notebook_start(args: argparse.Namespace) -> None:
+    cfg = {
+        "task_type": "NOTEBOOK",
+        "entrypoint": "python -m determined_tpu.exec.notebook",
+        "resources": {"slots": args.slots},
+    }
+    resp = _session(args).post("/api/v1/commands", json_body={"config": cfg})
+    master = args.master or os.environ.get("DTPU_MASTER")
+    print(f"Started notebook {resp['task_id']}")
+    print(f"  open {master}/proxy/{resp['task_id']}/ once it registers")
+
+
 # -- model registry ------------------------------------------------------------
 def model_create(args: argparse.Namespace) -> None:
     _session(args).post(
@@ -369,6 +419,18 @@ def build_parser() -> argparse.ArgumentParser:
     v = cmd.add_parser("kill")
     v.add_argument("task_id")
     v.set_defaults(fn=cmd_kill)
+
+    tb = sub.add_parser("tensorboard", aliases=["tb"]).add_subparsers(
+        dest="verb", required=True)
+    v = tb.add_parser("start")
+    v.add_argument("experiment_ids", type=int, nargs="+")
+    v.set_defaults(fn=tb_start)
+
+    nb = sub.add_parser("notebook", aliases=["nb"]).add_subparsers(
+        dest="verb", required=True)
+    v = nb.add_parser("start")
+    v.add_argument("--slots", type=int, default=0)
+    v.set_defaults(fn=notebook_start)
 
     model = sub.add_parser("model", aliases=["m"]).add_subparsers(
         dest="verb", required=True)

@@ -1,0 +1,47 @@
+"""Notebook task: run JupyterLab behind the master proxy.
+
+Rebuild of the reference's notebook task wiring: find jupyter, bind a free
+port, register the proxy target (authenticated with the task token), exec.
+Fails loudly (exit 1) when jupyter isn't in the task image — registering a
+proxy for a server that will never exist would advertise a dead URL.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import subprocess
+import sys
+
+logger = logging.getLogger("determined_tpu.exec.notebook")
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    lab = shutil.which("jupyter")
+    if lab is None:
+        logger.error("jupyter is not installed in this task image")
+        return 1
+
+    from determined_tpu.common.api_session import Session
+    from determined_tpu.common.ipc import free_port
+
+    port = free_port()
+    master = os.environ.get("DTPU_MASTER")
+    alloc = os.environ.get("DTPU_ALLOCATION_ID")
+    if master and alloc:
+        # host omitted: the master defaults to this request's source address
+        # (registering 127.0.0.1 would point the proxy at the MASTER's
+        # loopback and be rejected for remote agents).
+        Session(master, token=os.environ.get("DTPU_SESSION_TOKEN", "")).post(
+            f"/api/v1/allocations/{alloc}/proxy", json_body={"port": port}
+        )
+    return subprocess.call([
+        lab, "lab", "--ip=0.0.0.0", f"--port={port}",
+        "--no-browser", "--allow-root",
+        "--ServerApp.token=", "--ServerApp.password=",
+    ])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

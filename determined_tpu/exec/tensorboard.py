@@ -1,0 +1,189 @@
+"""TensorBoard-serving task: view trial metrics through the master proxy.
+
+Rebuild of the reference's TB task (`harness/determined/exec/tensorboard.py`
++ tensorboard/fetchers): continuously syncs the trials' tfevents files down
+from checkpoint storage and serves them. If the real `tensorboard` binary is
+installed it is used; otherwise a built-in zero-dependency scalar viewer
+(reading the tfevents files with determined_tpu.tensorboard.read_scalars)
+serves the same data — TPU images often ship without TF/TensorBoard.
+
+Launched by `dtpu tensorboard start <exp_id>` as a command task; registers
+its port with the master proxy so the UI is at /proxy/{task_id}/.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List
+
+logger = logging.getLogger("determined_tpu.exec.tensorboard")
+
+
+def _sync_loop(storage_cfg: Dict, task_ids: List[str], logdir: str, stop) -> None:
+    from determined_tpu.storage import from_config
+
+    storage = from_config(storage_cfg)
+    while not stop.is_set():
+        for task_id in task_ids:
+            dest = os.path.join(logdir, task_id)
+            try:
+                storage.download(f"tensorboard/{task_id}", dest)
+            except FileNotFoundError:
+                pass
+            except Exception as e:  # noqa: BLE001
+                logger.warning("sync %s failed: %s", task_id, e)
+        stop.wait(15.0)
+
+
+def _register_proxy(port: int) -> None:
+    master = os.environ.get("DTPU_MASTER")
+    alloc = os.environ.get("DTPU_ALLOCATION_ID")
+    if not master or not alloc:
+        return
+    from determined_tpu.common.api_session import Session
+
+    # host omitted: the master defaults to this request's source address
+    # (hardcoding 127.0.0.1 would be the MASTER's loopback and is rejected
+    # by the SSRF guard for tasks on remote agents).
+    Session(master, token=os.environ.get("DTPU_SESSION_TOKEN", "")).post(
+        f"/api/v1/allocations/{alloc}/proxy", json_body={"port": port}
+    )
+
+
+VIEWER_PAGE = """<!doctype html><html><head><meta charset="utf-8">
+<title>scalars</title><style>
+body{font-family:monospace;background:#0d1117;color:#c9d1d9;margin:2rem}
+svg{background:#161b22;border-radius:6px;margin:6px}
+text{fill:#8b949e;font-size:11px}</style></head><body>
+<h1>trial scalars</h1><div id="charts"></div><script>
+async function main(){
+  const data = await (await fetch('data.json')).json();
+  for (const [tag, series] of Object.entries(data)) {
+    let html = `<h3>${tag.replace(/[&<>]/g,'')}</h3>`;
+    for (const [run, pts] of Object.entries(series)) {
+      if (!pts.length) continue;
+      const xs = pts.map(p=>p[0]), ys = pts.map(p=>p[1]);
+      const [xmin,xmax]=[Math.min(...xs),Math.max(...xs)];
+      const [ymin,ymax]=[Math.min(...ys),Math.max(...ys)];
+      const W=420,H=120,pad=8;
+      const px=x=>pad+(W-2*pad)*(xmax>xmin?(x-xmin)/(xmax-xmin):0.5);
+      const py=y=>H-pad-(H-2*pad)*(ymax>ymin?(y-ymin)/(ymax-ymin):0.5);
+      const d=pts.map((p,i)=>(i?'L':'M')+px(p[0])+','+py(p[1])).join(' ');
+      html += `<svg width="${W}" height="${H}">`+
+        `<path d="${d}" fill="none" stroke="#58a6ff" stroke-width="1.5"/>`+
+        `<text x="${pad}" y="12">${run.replace(/[&<>]/g,'')} · last ${ys[ys.length-1].toPrecision(4)}</text></svg>`;
+    }
+    document.getElementById('charts').innerHTML += html;
+  }
+}
+main(); setInterval(main, 10000);
+</script></body></html>"""
+
+
+#: tfevents are append-only: cache parses keyed by (path, size) so polling
+#: clients don't re-decode unchanged files every request.
+_parse_cache: Dict[str, tuple] = {}
+
+
+def _read_scalars_cached(path: str):
+    from determined_tpu.tensorboard import read_scalars
+
+    size = os.path.getsize(path)
+    cached = _parse_cache.get(path)
+    if cached is not None and cached[0] == size:
+        return cached[1]
+    events = read_scalars(path)
+    _parse_cache[path] = (size, events)
+    return events
+
+
+def _collect_scalars(logdir: str) -> Dict[str, Dict[str, List]]:
+    out: Dict[str, Dict[str, List]] = {}
+    for root, _, files in os.walk(logdir):
+        run = os.path.relpath(root, logdir)
+        for fname in files:
+            if "tfevents" not in fname:
+                continue
+            try:
+                events = _read_scalars_cached(os.path.join(root, fname))
+            except Exception:  # noqa: BLE001 - partial writes are normal
+                continue
+            for ev in events:
+                for tag, value in ev.get("scalars", {}).items():
+                    out.setdefault(tag, {}).setdefault(run, []).append(
+                        [ev.get("step", 0), value]
+                    )
+    for tag in out.values():
+        for pts in tag.values():
+            pts.sort()
+    return out
+
+
+def _serve_builtin(logdir: str, port: int) -> None:
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.endswith("data.json"):
+                body = json.dumps(_collect_scalars(logdir)).encode()
+                ctype = "application/json"
+            else:
+                body = VIEWER_PAGE.encode()
+                ctype = "text/html; charset=utf-8"
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("0.0.0.0", port), H)
+    logger.info("built-in scalar viewer on :%d", port)
+    httpd.serve_forever()
+
+
+def main() -> None:
+    import argparse
+
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tasks", required=True,
+                        help="comma-separated task ids (trial-<id>, ...)")
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args()
+
+    storage_cfg = json.loads(os.environ.get("DTPU_CHECKPOINT_STORAGE", "{}"))
+    logdir = os.path.abspath("./tb-logs")
+    task_ids = [t for t in args.tasks.split(",") if t]
+
+    stop = threading.Event()
+    threading.Thread(
+        target=_sync_loop, args=(storage_cfg, task_ids, logdir, stop),
+        daemon=True,
+    ).start()
+
+    from determined_tpu.common.ipc import free_port
+
+    port = args.port or free_port()
+    _register_proxy(port)
+
+    tb = shutil.which("tensorboard")
+    if tb:
+        os.makedirs(logdir, exist_ok=True)
+        # No --path_prefix: the master proxy strips /proxy/{task_id} before
+        # forwarding, so the backend must serve at /.
+        sys.exit(subprocess.call([
+            tb, "--logdir", logdir, "--port", str(port), "--host", "0.0.0.0",
+        ]))
+    _serve_builtin(logdir, port)
+
+
+if __name__ == "__main__":
+    main()

@@ -561,6 +561,12 @@ class ApiServer:
                 return q[0] if q else None
 
             def _dispatch(self, method: str) -> None:
+                if getattr(self.server, "stopping", False):
+                    # One choke point for ALL response paths (JSON, plain,
+                    # proxy): a stopped server's lingering handler threads
+                    # must not keep serving keep-alive clients from stale
+                    # state across an in-process restart.
+                    self.close_connection = True
                 parsed = urlparse(self.path)
                 token = self._auth_token(parsed)
                 if parsed.path.startswith("/proxy/"):
@@ -661,6 +667,13 @@ class ApiServer:
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                if getattr(self.server, "stopping", False):
+                    # Keep-alive connections would otherwise let lingering
+                    # handler threads keep serving clients from a stopped
+                    # server's state (in-process restarts; a real crash
+                    # resets connections at the OS level).
+                    self.send_header("Connection", "close")
+                    self.close_connection = True
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -698,5 +711,6 @@ class ApiServer:
         self._thread.start()
 
     def stop(self) -> None:
+        self._httpd.stopping = True
         self._httpd.shutdown()
         self._httpd.server_close()

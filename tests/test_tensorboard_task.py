@@ -1,0 +1,72 @@
+"""TensorBoard-serving task e2e: train with tfevents sync, start the TB
+task via the CLI flow, read scalars through the master proxy."""
+import json
+import time
+
+import requests
+
+from determined_tpu.devcluster import DevCluster
+from determined_tpu.sdk import Determined
+
+
+class TestTensorboardTask:
+    def test_viewer_through_proxy(self, tmp_path):
+        with DevCluster(n_agents=2, slots_per_agent=1) as dc:
+            deadline = time.time() + 30
+            while time.time() < deadline and len(dc.master.agent_hub.list()) < 2:
+                time.sleep(0.2)
+            d = Determined(dc.api.url)
+            exp = d.create_experiment({
+                "entrypoint": "determined_tpu.exec.builtin_trials:SyntheticTrial",
+                "searcher": {"name": "single", "max_length": 4, "metric": "loss"},
+                "hyperparameters": {"model": "mnist-mlp", "batch_size": 16},
+                "resources": {"slots_per_trial": 1},
+                "scheduling_unit": 2,
+                "tensorboard": True,
+                "checkpoint_storage": {"type": "shared_fs",
+                                       "host_path": str(tmp_path)},
+                "environment": {"jax_platform": "cpu"},
+            })
+            assert exp.wait(timeout=240) == "COMPLETED"
+            trial_id = exp.trials()[0].id
+
+            # Start the TB task the way `dtpu tensorboard start` does.
+            task_id = dc.session().post(
+                "/api/v1/commands",
+                json_body={"config": {
+                    "task_type": "TENSORBOARD",
+                    "entrypoint": (
+                        "python -m determined_tpu.exec.tensorboard "
+                        f"--tasks trial-{trial_id}"
+                    ),
+                    "resources": {"slots": 0},
+                    "checkpoint_storage": {"type": "shared_fs",
+                                           "host_path": str(tmp_path)},
+                }},
+            )["task_id"]
+
+            # Wait for it to register with the proxy, then pull the data.
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                if dc.master.proxy.target(task_id):
+                    break
+                time.sleep(0.5)
+            assert dc.master.proxy.target(task_id), "TB task never registered"
+
+            deadline = time.time() + 60
+            data = {}
+            while time.time() < deadline:
+                r = requests.get(
+                    f"{dc.api.url}/proxy/{task_id}/data.json", timeout=10
+                )
+                data = r.json()
+                if data.get("loss"):
+                    break
+                time.sleep(2)
+            assert "loss" in data, f"no scalars synced: {list(data)}"
+            run = f"trial-{trial_id}"
+            assert run in data["loss"]
+            assert len(data["loss"][run]) >= 1  # (step, value) points
+            page = requests.get(f"{dc.api.url}/proxy/{task_id}/", timeout=10)
+            assert "trial scalars" in page.text
+            dc.session().post(f"/api/v1/commands/{task_id}/kill")
