@@ -234,11 +234,13 @@ def tb_start(args: argparse.Namespace) -> None:
     session = _session(args)
     task_ids = []
     storage_cfg = None
+    storage_seen = False
     for exp_id in args.experiment_ids:
         exp = session.get(f"/api/v1/experiments/{exp_id}")
         exp_storage = exp["config"].get("checkpoint_storage")
-        if storage_cfg is None:
+        if not storage_seen:
             storage_cfg = exp_storage
+            storage_seen = True
         elif exp_storage != storage_cfg:
             # One TB task syncs from one backend; mixing would silently show
             # no data for the mismatched experiments.

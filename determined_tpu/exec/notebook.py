@@ -23,23 +23,26 @@ def main() -> int:
         logger.error("jupyter is not installed in this task image")
         return 1
 
-    from determined_tpu.common.api_session import Session
+    import secrets
+
     from determined_tpu.common.ipc import free_port
+    from determined_tpu.exec.proxy_util import register_proxy
 
     port = free_port()
-    master = os.environ.get("DTPU_MASTER")
-    alloc = os.environ.get("DTPU_ALLOCATION_ID")
-    if master and alloc:
-        # host omitted: the master defaults to this request's source address
-        # (registering 127.0.0.1 would point the proxy at the MASTER's
-        # loopback and be rejected for remote agents).
-        Session(master, token=os.environ.get("DTPU_SESSION_TOKEN", "")).post(
-            f"/api/v1/allocations/{alloc}/proxy", json_body={"port": port}
-        )
+    register_proxy(port)
+    # Jupyter keeps ITS OWN token: the port binds 0.0.0.0 so the master can
+    # proxy to it, which means anything on the agent's network can also
+    # reach it directly — disabling jupyter auth would hand out root RCE.
+    # The tokenized URL goes to the task log (`dtpu cmd logs <task>`).
+    jupyter_token = secrets.token_hex(16)
+    task_id = os.environ.get("DTPU_TASK_ID", "")
+    logger.info(
+        "open <master>/proxy/%s/lab?token=%s", task_id, jupyter_token
+    )
     return subprocess.call([
         lab, "lab", "--ip=0.0.0.0", f"--port={port}",
         "--no-browser", "--allow-root",
-        "--ServerApp.token=", "--ServerApp.password=",
+        f"--ServerApp.token={jupyter_token}",
     ])
 
 

@@ -1,0 +1,22 @@
+"""Shared task-side proxy registration (one copy of the contract)."""
+from __future__ import annotations
+
+import os
+
+
+def register_proxy(port: int) -> None:
+    """Expose `port` through the master's /proxy/{task_id}/ route.
+
+    Host is omitted on purpose: the master defaults the target to this
+    request's source address (hardcoding 127.0.0.1 would name the MASTER's
+    loopback and be rejected by the SSRF guard for remote agents).
+    """
+    master = os.environ.get("DTPU_MASTER")
+    alloc = os.environ.get("DTPU_ALLOCATION_ID")
+    if not master or not alloc:
+        return
+    from determined_tpu.common.api_session import Session
+
+    Session(master, token=os.environ.get("DTPU_SESSION_TOKEN", "")).post(
+        f"/api/v1/allocations/{alloc}/proxy", json_body={"port": port}
+    )

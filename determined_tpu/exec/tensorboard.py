@@ -42,19 +42,7 @@ def _sync_loop(storage_cfg: Dict, task_ids: List[str], logdir: str, stop) -> Non
         stop.wait(15.0)
 
 
-def _register_proxy(port: int) -> None:
-    master = os.environ.get("DTPU_MASTER")
-    alloc = os.environ.get("DTPU_ALLOCATION_ID")
-    if not master or not alloc:
-        return
-    from determined_tpu.common.api_session import Session
-
-    # host omitted: the master defaults to this request's source address
-    # (hardcoding 127.0.0.1 would be the MASTER's loopback and is rejected
-    # by the SSRF guard for tasks on remote agents).
-    Session(master, token=os.environ.get("DTPU_SESSION_TOKEN", "")).post(
-        f"/api/v1/allocations/{alloc}/proxy", json_body={"port": port}
-    )
+from determined_tpu.exec.proxy_util import register_proxy as _register_proxy
 
 
 VIEWER_PAGE = """<!doctype html><html><head><meta charset="utf-8">
@@ -65,6 +53,7 @@ text{fill:#8b949e;font-size:11px}</style></head><body>
 <h1>trial scalars</h1><div id="charts"></div><script>
 async function main(){
   const data = await (await fetch('data.json')).json();
+  let page = '';
   for (const [tag, series] of Object.entries(data)) {
     let html = `<h3>${tag.replace(/[&<>]/g,'')}</h3>`;
     for (const [run, pts] of Object.entries(series)) {
@@ -80,8 +69,11 @@ async function main(){
         `<path d="${d}" fill="none" stroke="#58a6ff" stroke-width="1.5"/>`+
         `<text x="${pad}" y="12">${run.replace(/[&<>]/g,'')} · last ${ys[ys.length-1].toPrecision(4)}</text></svg>`;
     }
-    document.getElementById('charts').innerHTML += html;
+    page += html;
   }
+  // replace (never append): refreshes must update charts in place, not
+  // stack duplicate copies.
+  document.getElementById('charts').innerHTML = page;
 }
 main(); setInterval(main, 10000);
 </script></body></html>"""

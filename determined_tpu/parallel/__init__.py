@@ -10,6 +10,7 @@ from determined_tpu.parallel.mesh import (
     AXIS_NAMES,
     MeshConfig,
     make_mesh,
+    make_multislice_mesh,
     batch_axes,
 )
 from determined_tpu.parallel.sharding import (
@@ -27,6 +28,7 @@ __all__ = [
     "AXIS_NAMES",
     "MeshConfig",
     "make_mesh",
+    "make_multislice_mesh",
     "batch_axes",
     "ShardingRules",
     "DEFAULT_RULES",

@@ -126,3 +126,40 @@ def test_pipeline_matches_sequential(devices8):
     for s in range(n_stages):
         want = jax.vmap(lambda a: stage_fn(w[s], a))(want)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5)
+
+
+class TestMultisliceMesh:
+    def test_two_virtual_slices(self, devices8):
+        from determined_tpu.parallel.mesh import MeshConfig, make_multislice_mesh
+
+        # 2 "slices" of 4 devices: per-slice mesh data=2 x tensor=2, data
+        # multiplied across slices -> global data=4.
+        mesh = make_multislice_mesh(
+            MeshConfig(data=2, tensor=2), dcn_data=2, devices=devices8
+        )
+        assert mesh.shape["data"] == 4 and mesh.shape["tensor"] == 2
+
+    def test_single_slice_falls_back(self, devices8):
+        from determined_tpu.parallel.mesh import MeshConfig, make_multislice_mesh
+
+        mesh = make_multislice_mesh(
+            MeshConfig(data=8), dcn_data=1, devices=devices8
+        )
+        assert mesh.shape["data"] == 8
+
+    def test_sharded_step_on_multislice_mesh(self, devices8):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from determined_tpu.parallel.mesh import MeshConfig, make_multislice_mesh
+
+        mesh = make_multislice_mesh(
+            MeshConfig(data=2, fsdp=2), dcn_data=2, devices=devices8
+        )
+        x = jax.device_put(
+            jnp.arange(32.0).reshape(8, 4),
+            NamedSharding(mesh, P(("data", "fsdp"))),
+        )
+        y = jax.jit(lambda a: (a * 2).sum())(x)
+        assert float(y) == float(jnp.arange(32.0).sum() * 2)
