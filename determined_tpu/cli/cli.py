@@ -324,6 +324,26 @@ def master_info(args: argparse.Namespace) -> None:
     print(json.dumps(_session(args).get("/api/v1/master"), indent=2))
 
 
+# -- job queue -----------------------------------------------------------------
+def queue_list(args: argparse.Namespace) -> None:
+    queues = _session(args).get("/api/v1/queues")["queues"]
+    for pool, q in queues.items():
+        print(f"pool {pool}: {q['pending_slots']} pending slot(s)")
+        for i, alloc in enumerate(q["pending"]):
+            print(f"  {i + 1}. {alloc} (pending)")
+        for alloc in q["running"]:
+            print(f"  -  {alloc} (running)")
+
+
+def queue_move(args: argparse.Namespace) -> None:
+    _session(args).post(
+        "/api/v1/queues/move",
+        json_body={"alloc_id": args.alloc_id, "ahead_of": args.ahead_of,
+                   "pool": args.pool},
+    )
+    print(f"moved {args.alloc_id}" + (f" ahead of {args.ahead_of}" if args.ahead_of else " to front"))
+
+
 # -- daemons ------------------------------------------------------------------
 def master_up(args: argparse.Namespace) -> None:
     sys.argv = ["dtpu-master"] + (args.rest or [])
@@ -455,6 +475,15 @@ def build_parser() -> argparse.ArgumentParser:
     v = agent.add_parser("run")
     v.add_argument("rest", nargs=argparse.REMAINDER)
     v.set_defaults(fn=agent_run)
+
+    queue = sub.add_parser("queue", aliases=["q"]).add_subparsers(
+        dest="verb", required=True)
+    queue.add_parser("list").set_defaults(fn=queue_list)
+    v = queue.add_parser("move")
+    v.add_argument("alloc_id")
+    v.add_argument("--ahead-of", default=None)
+    v.add_argument("--pool", default=None)
+    v.set_defaults(fn=queue_move)
 
     master = sub.add_parser("master").add_subparsers(dest="verb", required=True)
     master.add_parser("info").set_defaults(fn=master_info)

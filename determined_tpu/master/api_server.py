@@ -271,9 +271,34 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
     def list_agents(r: ApiRequest):
         return {"agents": m.agent_hub.list()}
 
+    # -- job queue --------------------------------------------------------------
+    def queue_list(r: ApiRequest):
+        out = {}
+        for name, pool in m.rm.pools.items():
+            snap = pool.queue_snapshot()
+            out[name] = {
+                "pending": snap["pending"],
+                "running": snap["running"],
+                "pending_slots": snap["pending_slots"],
+            }
+        return {"queues": out}
+
+    def queue_move(r: ApiRequest):
+        pool = m.rm.pool(r.body.get("pool"))
+        try:
+            pool.reorder(
+                r.body["alloc_id"], ahead_of=r.body.get("ahead_of")
+            )
+        except KeyError as e:
+            raise ApiError(404, str(e))
+        return {}
+
     # -- experiments (user/CLI) -------------------------------------------------
     def create_experiment(r: ApiRequest):
-        exp_id = m.create_experiment(r.body["config"])
+        try:
+            exp_id = m.create_experiment(r.body["config"])
+        except ValueError as e:
+            raise ApiError(400, str(e))
         return {"id": exp_id}
 
     def list_experiments(r: ApiRequest):
@@ -498,6 +523,8 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("GET", r"/api/v1/agents/([\w.\-]+)/actions", agent_actions),
         R("POST", r"/api/v1/agents/([\w.\-]+)/events", agent_events),
         R("GET", r"/api/v1/agents", list_agents),
+        R("GET", r"/api/v1/queues", queue_list),
+        R("POST", r"/api/v1/queues/move", queue_move),
         R("POST", r"/api/v1/files", upload_file),
         R("GET", r"/api/v1/files/([0-9a-f]+)", download_file),
         R("POST", r"/api/v1/commands", create_command),

@@ -430,6 +430,11 @@ class Master:
 
     # -- experiments -----------------------------------------------------------
     def create_experiment(self, config: Dict[str, Any]) -> int:
+        from determined_tpu.master import expconf
+
+        errors = expconf.validate(config)
+        if errors:
+            raise ValueError("invalid experiment config: " + "; ".join(errors))
         exp_id = self.db.add_experiment(config)
         if config.get("project_id"):
             self.db.set_experiment_project(exp_id, int(config["project_id"]))

@@ -1,0 +1,151 @@
+"""Experiment-config validation: reject bad configs at submission.
+
+Rebuild of the reference's expconf schema layer (`schemas/expconf/v0/*.json`
++ cluster-side validation in `master/pkg/schemas`) scaled to hand-rolled
+checks: the JSON-schema/codegen machinery is overkill at this config size,
+but the user-facing property is the same — a bad config fails at
+`experiment create` with a list of specific errors, not as a cryptic trial
+crash minutes later.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+KNOWN_SEARCHERS = {"single", "random", "grid", "asha", "adaptive_asha", "custom"}
+NEEDS_MAX_TRIALS = {"random", "asha", "adaptive_asha"}
+KNOWN_STORAGE = {"shared_fs", "gcs", "s3"}
+KNOWN_HP_TYPES = {"const", "categorical", "int", "double", "log"}
+MESH_AXES = {"data", "fsdp", "tensor", "pipeline", "context", "expert"}
+
+
+def _check_unit(spec: Any, field: str, errors: List[str]) -> None:
+    if spec is None:
+        return
+    if isinstance(spec, int):
+        if spec <= 0:
+            errors.append(f"{field} must be a positive int")
+        return
+    if isinstance(spec, dict) and ("batches" in spec or "epochs" in spec):
+        key = "batches" if "batches" in spec else "epochs"
+        if not isinstance(spec[key], int) or spec[key] <= 0:
+            errors.append(f"{field}.{key} must be a positive int")
+        return
+    errors.append(f'{field} must be an int or {{"batches"|"epochs": N}}')
+
+
+def _check_hparams(space: Dict[str, Any], prefix: str, errors: List[str]) -> None:
+    for name, spec in space.items():
+        path = f"{prefix}{name}"
+        if not isinstance(spec, dict):
+            continue  # bare value == const
+        if "type" not in spec:
+            _check_hparams(spec, f"{path}.", errors)  # nested group
+            continue
+        t = spec["type"]
+        if t not in KNOWN_HP_TYPES:
+            errors.append(f"hyperparameters.{path}: unknown type {t!r}")
+            continue
+        if t == "categorical" and not spec.get("vals"):
+            errors.append(f"hyperparameters.{path}: categorical needs vals")
+        if t in ("int", "double", "log"):
+            if "minval" not in spec or "maxval" not in spec:
+                errors.append(
+                    f"hyperparameters.{path}: {t} needs minval and maxval"
+                )
+            elif not all(
+                isinstance(spec[k], (int, float)) and not isinstance(spec[k], bool)
+                for k in ("minval", "maxval")
+            ):
+                errors.append(
+                    f"hyperparameters.{path}: minval/maxval must be numbers"
+                )
+            elif spec["minval"] > spec["maxval"]:
+                errors.append(
+                    f"hyperparameters.{path}: minval > maxval"
+                )
+
+
+def validate(config: Dict[str, Any]) -> List[str]:
+    """Returns a list of human-readable errors (empty = valid)."""
+    errors: List[str] = []
+    if not isinstance(config, dict):
+        return ["config must be a JSON object"]
+
+    if not config.get("unmanaged") and not config.get("entrypoint"):
+        errors.append("entrypoint is required (\"pkg.module:TrialClass\" or a command)")
+
+    searcher = config.get("searcher", {})
+    if not isinstance(searcher, dict):
+        errors.append("searcher must be an object")
+    else:
+        name = searcher.get("name", "single")
+        if name not in KNOWN_SEARCHERS:
+            errors.append(
+                f"searcher.name {name!r} unknown (one of {sorted(KNOWN_SEARCHERS)})"
+            )
+        if name in NEEDS_MAX_TRIALS and not searcher.get("max_trials"):
+            errors.append(f"searcher.name={name} requires searcher.max_trials")
+        if name != "custom":
+            ml = searcher.get("max_length")
+            if ml is not None and (not isinstance(ml, int) or ml <= 0):
+                errors.append("searcher.max_length must be a positive int")
+
+    resources = config.get("resources", {})
+    if isinstance(resources, dict):
+        slots = resources.get("slots_per_trial", 1)
+        if not isinstance(slots, int) or slots < 0:
+            errors.append("resources.slots_per_trial must be an int >= 0")
+        prio = resources.get("priority", 50)
+        if not isinstance(prio, int) or not 0 <= prio <= 99:
+            errors.append("resources.priority must be an int in [0, 99]")
+    else:
+        errors.append("resources must be an object")
+
+    mesh = config.get("mesh")
+    if mesh is not None:
+        if not isinstance(mesh, dict):
+            errors.append("mesh must be an object of axis sizes")
+        else:
+            for axis, size in mesh.items():
+                if axis not in MESH_AXES:
+                    errors.append(
+                        f"mesh.{axis}: unknown axis (one of {sorted(MESH_AXES)})"
+                    )
+                elif not isinstance(size, int) or (size < 1 and size != -1):
+                    errors.append(f"mesh.{axis} must be a positive int (or -1)")
+
+    storage = config.get("checkpoint_storage")
+    if storage is not None:
+        if not isinstance(storage, dict):
+            errors.append("checkpoint_storage must be an object")
+        else:
+            typ = storage.get("type", "shared_fs")
+            if typ not in KNOWN_STORAGE:
+                errors.append(
+                    f"checkpoint_storage.type {typ!r} unknown "
+                    f"(one of {sorted(KNOWN_STORAGE)})"
+                )
+            if typ == "shared_fs" and not storage.get("host_path"):
+                errors.append("checkpoint_storage.host_path required for shared_fs")
+            if typ in ("gcs", "s3") and not storage.get("bucket"):
+                errors.append(f"checkpoint_storage.bucket required for {typ}")
+            for key in ("save_experiment_best", "save_trial_best", "save_trial_latest"):
+                v = storage.get(key)
+                if v is not None and (not isinstance(v, int) or v < 0):
+                    errors.append(f"checkpoint_storage.{key} must be an int >= 0")
+
+    _check_unit(config.get("min_validation_period"), "min_validation_period", errors)
+    _check_unit(config.get("min_checkpoint_period"), "min_checkpoint_period", errors)
+    _check_unit(config.get("scheduling_unit"), "scheduling_unit", errors)
+
+    mr = config.get("max_restarts")
+    if mr is not None and (not isinstance(mr, int) or mr < 0):
+        errors.append("max_restarts must be an int >= 0")
+
+    hp = config.get("hyperparameters", {})
+    if isinstance(hp, dict):
+        _check_hparams(hp, "", errors)
+    else:
+        errors.append("hyperparameters must be an object")
+
+    return errors

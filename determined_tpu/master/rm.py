@@ -131,6 +131,30 @@ class ResourcePool:
             except Exception:  # noqa: BLE001
                 logger.exception("%s callback failed for %s", kind, entry.request.alloc_id)
 
+    def reorder(self, alloc_id: str, *, ahead_of: Optional[str] = None) -> None:
+        """Move a PENDING request ahead of another (or to the queue front).
+
+        Ref: job queue move-ahead ops (internal/job/jobservice). Priority
+        still wins in the priority scheduler; reordering settles ties and
+        drives strict FIFO order.
+        """
+        with self._lock:
+            if alloc_id not in self._pending:
+                raise KeyError(f"{alloc_id} is not pending")
+            entry = self._entries[alloc_id]
+            if ahead_of is None:
+                target_order = min(
+                    (self._entries[a].request.order for a in self._pending
+                     if a in self._entries),
+                    default=0,
+                )
+            else:
+                if ahead_of not in self._pending:
+                    raise KeyError(f"{ahead_of} is not pending")
+                target_order = self._entries[ahead_of].request.order
+            entry.request.order = target_order - 1
+        self.tick()
+
     # -- introspection --------------------------------------------------------
     def queue_snapshot(self) -> Dict[str, Any]:
         with self._lock:

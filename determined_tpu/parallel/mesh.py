@@ -115,6 +115,10 @@ def make_multislice_mesh(
         dcn_data = max(1, len(slice_ids))
     if dcn_data == 1:
         return make_mesh(config, devices)
+    if len(devices) % dcn_data != 0:
+        raise ValueError(
+            f"{len(devices)} devices not divisible by dcn_data={dcn_data} slices"
+        )
     per_slice = len(devices) // dcn_data
     config = (config or MeshConfig()).resolve(per_slice)
     ici_shape = config.axis_sizes()

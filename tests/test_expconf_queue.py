@@ -1,0 +1,109 @@
+"""Config validation at submission + job-queue reordering."""
+import pytest
+
+from determined_tpu.master.api_server import ApiServer
+from determined_tpu.master.core import Master
+from determined_tpu.master.expconf import validate
+from determined_tpu.master.rm import ResourcePool
+from determined_tpu.master.scheduler import Request
+
+GOOD = {
+    "entrypoint": "m:T",
+    "searcher": {"name": "random", "max_trials": 4, "max_length": 10},
+    "hyperparameters": {"lr": {"type": "log", "minval": -4, "maxval": -1}},
+    "resources": {"slots_per_trial": 2, "priority": 30},
+    "mesh": {"data": 2, "tensor": 1},
+    "min_validation_period": {"batches": 5},
+    "checkpoint_storage": {"type": "gcs", "bucket": "b", "save_trial_best": 1},
+    "max_restarts": 2,
+}
+
+
+class TestExpconfValidation:
+    def test_good_config_passes(self):
+        assert validate(GOOD) == []
+
+    @pytest.mark.parametrize(
+        "mutate,needle",
+        [
+            (lambda c: c.pop("entrypoint"), "entrypoint"),
+            (lambda c: c["searcher"].update(name="nope"), "searcher.name"),
+            (lambda c: c["searcher"].pop("max_trials"), "max_trials"),
+            (lambda c: c["searcher"].update(max_length=-5), "max_length"),
+            (lambda c: c["resources"].update(slots_per_trial="x"), "slots_per_trial"),
+            (lambda c: c["resources"].update(priority=500), "priority"),
+            (lambda c: c["mesh"].update(warp=2), "mesh.warp"),
+            (lambda c: c["mesh"].update(data=0), "mesh.data"),
+            (lambda c: c["checkpoint_storage"].update(type="ftp"), "checkpoint_storage.type"),
+            (lambda c: c["checkpoint_storage"].pop("bucket"), "bucket"),
+            (lambda c: c["checkpoint_storage"].update(save_trial_best=-1), "save_trial_best"),
+            (lambda c: c.update(min_validation_period={"parsecs": 3}), "min_validation_period"),
+            (lambda c: c.update(max_restarts=-1), "max_restarts"),
+            (lambda c: c["hyperparameters"].update(bad={"type": "zeta"}), "unknown type"),
+            (lambda c: c["hyperparameters"].update(
+                lr={"type": "log", "minval": 2, "maxval": -2}), "minval > maxval"),
+            (lambda c: c["hyperparameters"].update(
+                ch={"type": "categorical"}), "vals"),
+        ],
+    )
+    def test_bad_configs_name_the_problem(self, mutate, needle):
+        import copy
+
+        cfg = copy.deepcopy(GOOD)
+        mutate(cfg)
+        errors = validate(cfg)
+        assert errors and any(needle in e for e in errors), errors
+
+    def test_unmanaged_needs_no_entrypoint(self):
+        assert validate({"unmanaged": True, "searcher": {"name": "single"}}) == []
+
+    def test_api_rejects_bad_config_with_400(self):
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            import requests
+
+            r = requests.post(
+                f"{api.url}/api/v1/experiments",
+                json={"config": {"searcher": {"name": "bogus"}}}, timeout=10,
+            )
+            assert r.status_code == 400
+            assert "searcher.name" in r.json()["error"]
+            assert master.db.list_experiments() == []  # nothing persisted
+        finally:
+            api.stop()
+            master.shutdown()
+
+
+class TestQueueOps:
+    def _pool_with_queue(self):
+        pool = ResourcePool("p")  # no agents: everything stays pending
+        started = []
+        for i in range(3):
+            pool.submit(
+                Request(f"a{i}", 4), lambda *a: started.append(a), lambda *a: None
+            )
+        return pool, started
+
+    def test_move_to_front(self):
+        pool, _ = self._pool_with_queue()
+        pool.reorder("a2")
+        pool.add_agent("agent", 4)  # one slot set: strict FIFO picks front
+        assert pool.queue_snapshot()["running"] == ["a2"]
+
+    def test_move_ahead_of(self):
+        pool, _ = self._pool_with_queue()
+        pool.reorder("a2", ahead_of="a1")
+        pool.add_agent("agent", 4)
+        # a0 kept front position; a2 must now be strictly ahead of a1
+        # (it may tie with a0 — the stable sort keeps a0 first).
+        assert pool.queue_snapshot()["running"] == ["a0"]
+        orders = {a: pool._entries[a].request.order for a in pool._entries}
+        assert orders["a2"] < orders["a1"]
+        assert orders["a0"] <= orders["a2"]
+
+    def test_unknown_alloc_raises(self):
+        pool, _ = self._pool_with_queue()
+        with pytest.raises(KeyError):
+            pool.reorder("nope")
