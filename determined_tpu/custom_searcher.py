@@ -34,8 +34,16 @@ class SearchRunner:
         hparam_space: Dict[str, Any],
         exp_config: Dict[str, Any],
         seed: int = 0,
+        token: str = "",
     ) -> None:
-        self.session = Session(master_url)
+        # The runner is a *user-side* tool (it creates experiments and
+        # drives their searchers — admin surface), so against a secured
+        # master it needs a user session token, never a task token.
+        import os
+
+        self.session = Session(
+            master_url, token=token or os.environ.get("DTPU_TOKEN", "")
+        )
         self.method = method
         self.rt = SearchRuntime(hparam_space, seed)
         config = dict(exp_config)

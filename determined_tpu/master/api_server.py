@@ -29,6 +29,80 @@ Handler = Callable[["ApiRequest"], Any]
 #: payload; their own cap is slightly smaller so the error is specific).
 MAX_BODY_BYTES = 128 * 1024 * 1024
 
+#: Routes a `task:` principal (DTPU_SESSION_TOKEN injected into a launched
+#: task) may call — the harness-facing surface only. Everything else
+#: (experiment/model/workspace admin, agent registration, queue moves,
+#: webhooks) returns 403 for task tokens.
+TASK_TOKEN_ROUTES = re.compile(
+    r"^/api/v1/("
+    r"trials/\d+(/.*)?"
+    r"|checkpoints"
+    r"|checkpoints/[0-9a-f-]+"
+    r"|allocations/[\w.\-]+/.*"
+    r"|task_logs"
+    r"|files/[0-9a-f]+"
+    r"|experiments/\d+"            # GET-only routes: config echo (harness)
+    r"|experiments/\d+/trials"     # and trial discovery (TensorBoard task)
+    r"|proxies"
+    r"|master"
+    r"|auth/logout"
+    r")$"
+)
+
+#: Routes an `agent:` principal (token issued to a master-provisioned agent)
+#: may call: registration/long-poll/event reporting + task-log shipping.
+AGENT_TOKEN_ROUTES = re.compile(
+    r"^/api/v1/("
+    r"agents(/[\w.\-]+/(actions|events))?"
+    r"|task_logs"
+    r"|master"
+    r"|auth/logout"
+    r")$"
+)
+
+
+def principal_allowed(principal: str, path: str) -> bool:
+    """Authorization by principal class (ref: the reference gates admin
+    RPCs on user sessions; task/allocation tokens only reach the trial
+    surface — internal/api_trials.go auth interceptors)."""
+    if principal.startswith("task:"):
+        return TASK_TOKEN_ROUTES.match(path) is not None
+    if principal.startswith("agent:"):
+        return AGENT_TOKEN_ROUTES.match(path) is not None
+    return True  # real users: full surface (roles arrive with RBAC)
+
+
+def task_identity_violation(
+    master: Master, principal: str, method: str, path: str,
+    body: Dict[str, Any],
+) -> Optional[str]:
+    """Identity-level checks for `task:` principals, beyond the class-level
+    allowlist: a task token must not WRITE another principal's state
+    (fabricated metrics steer the victim's searcher; a spoofed checkpoint
+    report overwrites its latest_checkpoint; a foreign rendezvous arrive
+    corrupts its address table). Reads stay class-level until RBAC.
+    Trial task ids are `trial-<id>` (core.py), which gives the mapping."""
+    task_id = principal[len("task:"):]
+    am = re.match(r"^/api/v1/allocations/([\w.\-]+)/", path)
+    if am:
+        alloc = master.alloc_service.get(am.group(1))
+        if alloc is not None and alloc.task_id != task_id:
+            return "token does not own this allocation"
+    if method == "GET":
+        return None
+    tm = re.match(r"^/api/v1/trials/(\d+)(/|$)", path)
+    if tm and task_id != f"trial-{tm.group(1)}":
+        return "task token may only write its own trial"
+    if path == "/api/v1/checkpoints":
+        trial_id = body.get("trial_id")
+        if trial_id is not None and task_id != f"trial-{trial_id}":
+            return "task token may only report checkpoints for its own trial"
+    if path == "/api/v1/task_logs":
+        claimed = body.get("task_id")
+        if claimed and claimed != task_id:
+            return "task token may only ship its own logs"
+    return None
+
 
 class ApiError(Exception):
     def __init__(self, status: int, message: str) -> None:
@@ -183,16 +257,8 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         alloc = m.alloc_service.get(r.groups[0])
         if alloc is None:
             raise ApiError(404, "no such allocation")
-        # Ownership: with auth on, a task token may only register ITS OWN
-        # allocation (user principals — operators — may register any).
-        principal = m.auth.validate(r.token)
-        if (
-            m.auth.enabled
-            and principal
-            and principal.startswith("task:")
-            and principal != f"task:{alloc.task_id}"
-        ):
-            raise ApiError(403, "token does not own this allocation")
+        # Ownership (task token ↔ its own allocation) is enforced for all
+        # /allocations/ routes in _dispatch via task_identity_violation.
         # SSRF guard: a task may only expose itself — the caller's own
         # address or the allocation's rendezvous addresses. No hardcoded
         # loopback: 127.0.0.1 here is the MASTER's loopback (only valid
@@ -600,25 +666,44 @@ class ApiServer:
                     # Raw pass-through to a task service. Same auth gate as
                     # the API (the reference authenticates proxy traffic via
                     # session cookies; we accept cookie/query tokens too).
-                    if (
-                        master.auth.enabled
-                        and master.auth.validate(token) is None
-                    ):
-                        self._send(401, {"error": "authentication required"})
-                        return
+                    # User principals only: a leaked task/agent token must
+                    # not reach proxied interactive services (notebooks are
+                    # a code-execution surface).
+                    if master.auth.enabled:
+                        principal = master.auth.validate(token)
+                        if principal is None:
+                            self._send(
+                                401, {"error": "authentication required"}
+                            )
+                            return
+                        if principal.startswith(("task:", "agent:")):
+                            self._send(403, {
+                                "error": "task/agent tokens may not access "
+                                         "proxied services"
+                            })
+                            return
                     self._proxy(method, parsed)
                     return
+                principal: Optional[str] = None
                 if master.auth.enabled and parsed.path not in self.AUTH_EXEMPT:
-                    if master.auth.validate(token) is None:
+                    principal = master.auth.validate(token)
+                    if principal is None:
                         self._send(401, {"error": "authentication required"})
+                        return
+                    if not principal_allowed(principal, parsed.path):
+                        self._send(403, {
+                            "error": f"{principal} may not access {parsed.path}"
+                        })
                         return
                 body: Dict[str, Any] = {}
                 raw: bytes = b""
                 length = int(self.headers.get("Content-Length") or 0)
                 if length > MAX_BODY_BYTES:
                     # Reject BEFORE reading: buffering an attacker-chosen
-                    # Content-Length would OOM the master.
-                    self._send(413, {"error": "request body too large"})
+                    # Content-Length would OOM the master. The unread body
+                    # would desync this keep-alive connection — close it.
+                    self._send(413, {"error": "request body too large"},
+                               close=True)
                     return
                 if length:
                     raw = self.rfile.read(length)
@@ -629,6 +714,13 @@ class ApiServer:
                         except json.JSONDecodeError:
                             self._send(400, {"error": "bad json"})
                             return
+                if principal is not None and principal.startswith("task:"):
+                    err = task_identity_violation(
+                        master, principal, method, parsed.path, body
+                    )
+                    if err:
+                        self._send(403, {"error": err})
+                        return
                 for m_, pat, handler in routes:
                     if m_ != method:
                         continue
@@ -674,6 +766,12 @@ class ApiServer:
                 task_id = parts[2] if len(parts) > 2 else ""
                 rest = "/" + (parts[3] if len(parts) > 3 else "")
                 length = int(self.headers.get("Content-Length") or 0)
+                if length > MAX_BODY_BYTES:
+                    # Same pre-read cap as _dispatch: an attacker-supplied
+                    # Content-Length must not buffer into master memory.
+                    self._send(413, {"error": "request body too large"},
+                               close=True)
+                    return
                 body = self.rfile.read(length) if length else b""
                 status, headers, data = master.proxy.forward(
                     task_id, method, rest, parsed.query,
@@ -689,11 +787,18 @@ class ApiServer:
                 except (BrokenPipeError, ConnectionResetError):
                     pass
 
-            def _send(self, status: int, payload: Dict[str, Any]) -> None:
+            def _send(self, status: int, payload: Dict[str, Any],
+                      close: bool = False) -> None:
                 data = json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                if close:
+                    # Rejected without reading the declared body: the next
+                    # keep-alive request would parse body bytes as a
+                    # request line. Tear the connection down.
+                    self.send_header("Connection", "close")
+                    self.close_connection = True
                 if getattr(self.server, "stopping", False):
                     # Keep-alive connections would otherwise let lingering
                     # handler threads keep serving clients from a stopped

@@ -53,13 +53,27 @@ class AuthService:
     TASK_TOKEN_TTL_S = 30 * 24 * 3600.0
 
     def issue_task_token(self, task_id: str) -> str:
-        """Credential for a task the master itself launched."""
+        """Credential for a task the master itself launched.
+
+        Task principals (`task:<id>`) are scoped: the API server only lets
+        them call harness-facing routes (metrics, searcher, checkpoints,
+        allocation signals, logs) — a leaked trial token must not be able
+        to create/kill experiments or register agents.
+        """
+        return self._issue(f"task:{task_id}")
+
+    def issue_agent_token(self, agent_id: str) -> str:
+        """Credential for an agent the master provisioned (`agent:<id>`
+        principal, scoped to agent registration/polling + log shipping)."""
+        return self._issue(f"agent:{agent_id}")
+
+    def _issue(self, principal: str) -> str:
         if not self.enabled:
             return ""
         token = secrets.token_urlsafe(24)
         with self._lock:
             self._tokens[token] = {
-                "user": f"task:{task_id}",
+                "user": principal,
                 "expires": time.time() + self.TASK_TOKEN_TTL_S,
             }
         return token

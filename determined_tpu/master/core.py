@@ -399,7 +399,7 @@ class Master:
             and hasattr(backend, "token")
             and not backend.token
         ):
-            backend.token = self.auth.issue_task_token("provisioned-agent")
+            backend.token = self.auth.issue_agent_token("provisioned-agent")
         service.on_terminate = self.lose_agent
         self._provisioners.append(service)
         service.start()

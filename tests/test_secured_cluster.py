@@ -16,6 +16,115 @@ from determined_tpu.master.core import Master
 from determined_tpu.common.api_session import Session
 
 
+class TestTokenScoping:
+    """Task/agent tokens are scoped to their own API surface (ref: the
+    reference gates admin RPCs on user sessions; allocation tokens only
+    reach the trial surface)."""
+
+    def test_task_token_cannot_reach_admin_routes(self):
+        master = Master(users={"admin": "pw"})
+        api = ApiServer(master)
+        api.start()
+        try:
+            task_tok = master.auth.issue_task_token("trial-9")
+            hdr = {"Authorization": f"Bearer {task_tok}"}
+            # Admin surface: denied.
+            for method, path in [
+                ("post", "/api/v1/experiments"),
+                ("get", "/api/v1/agents"),
+                ("post", "/api/v1/agents"),
+                ("post", "/api/v1/queues/move"),
+                ("post", "/api/v1/webhooks"),
+                ("post", "/api/v1/models"),
+                ("post", "/api/v1/experiments/1/kill"),
+            ]:
+                r = getattr(requests, method)(
+                    api.url + path, json={}, headers=hdr, timeout=10
+                )
+                assert r.status_code == 403, (method, path, r.status_code)
+            # Harness surface: permitted (may 404/400 on content, never 403)
+            # — for the task's OWN task_id.
+            r = requests.post(
+                f"{api.url}/api/v1/task_logs",
+                json={"task_id": "trial-9", "logs": []}, headers=hdr,
+                timeout=10,
+            )
+            assert r.status_code not in (401, 403)
+            r = requests.get(
+                f"{api.url}/api/v1/trials/1/metrics", headers=hdr, timeout=10
+            )
+            assert r.status_code not in (401, 403)
+
+            # Identity checks: a trial's token may not write ANOTHER trial's
+            # surface (spoofed metrics/checkpoints steer the victim's
+            # searcher), nor drive searcher ops, nor reach /proxy/.
+            r = requests.post(
+                f"{api.url}/api/v1/trials/7/metrics",
+                json={"metrics": {"loss": 0.0}}, headers=hdr, timeout=10,
+            )
+            assert r.status_code == 403
+            r = requests.post(
+                f"{api.url}/api/v1/checkpoints",
+                json={"uuid": "0" * 8, "trial_id": 7}, headers=hdr, timeout=10,
+            )
+            assert r.status_code == 403
+            r = requests.post(
+                f"{api.url}/api/v1/task_logs",
+                json={"task_id": "trial-7", "logs": []}, headers=hdr,
+                timeout=10,
+            )
+            assert r.status_code == 403
+            r = requests.post(
+                f"{api.url}/api/v1/experiments/1/searcher/operations",
+                json={"operations": []}, headers=hdr, timeout=10,
+            )
+            assert r.status_code == 403
+            r = requests.get(
+                f"{api.url}/proxy/any-task/", headers=hdr, timeout=10
+            )
+            assert r.status_code == 403
+            # ...while its OWN trial surface still works (trial-9 ↔ trial 9).
+            r = requests.post(
+                f"{api.url}/api/v1/trials/9/metrics",
+                json={"group": "training", "steps_completed": 1,
+                      "metrics": {"loss": 1.0}},
+                headers=hdr, timeout=10,
+            )
+            assert r.status_code not in (401, 403)
+
+            agent_tok = master.auth.issue_agent_token("a1")
+            ahdr = {"Authorization": f"Bearer {agent_tok}"}
+            r = requests.post(
+                f"{api.url}/api/v1/experiments", json={}, headers=ahdr,
+                timeout=10,
+            )
+            assert r.status_code == 403
+            r = requests.get(
+                f"{api.url}/api/v1/agents", headers=ahdr, timeout=10
+            )
+            assert r.status_code not in (401, 403)
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_proxy_body_size_capped(self):
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            # Claim an enormous body without sending it; the master must
+            # reject from the header alone (no buffering).
+            r = requests.post(
+                f"{api.url}/proxy/some-task/x",
+                headers={"Content-Length": str(1 << 40)},
+                timeout=10,
+            )
+            assert r.status_code == 413
+        finally:
+            api.stop()
+            master.shutdown()
+
+
 class TestSecuredCluster:
     def test_full_trial_flow_with_auth(self, tmp_path):
         master = Master(users={"admin": "s3cret"})
