@@ -20,6 +20,7 @@ from typing import Optional
 
 import jax
 from jax import shard_map
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, PartitionSpec as P
 
 from determined_tpu.ops.flash_attention import flash_attention
@@ -55,16 +56,22 @@ def attention(
 
     if impl == "flash":
         if mesh is None:
-            return flash_attention(q, k, v, causal=causal)
-        spec = P(BATCH_AXES, None, "tensor", None)
+            out = flash_attention(q, k, v, causal=causal)
+        else:
+            spec = P(BATCH_AXES, None, "tensor", None)
 
-        def local(q_, k_, v_):
-            return flash_attention(q_, k_, v_, causal=causal)
+            def local(q_, k_, v_):
+                return flash_attention(q_, k_, v_, causal=causal)
 
-        return shard_map(
-            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False,
-        )(q, k, v)
+            out = shard_map(
+                local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False,
+            )(q, k, v)
+        # Remat boundary marker: "dots saveable" policies don't recognize a
+        # pallas_call as a dot, so without this name the whole flash forward
+        # re-runs inside the backward (models/gpt.py combines the dots
+        # policy with save_only_these_names("flash_out")).
+        return checkpoint_name(out, "flash_out")
 
     if impl == "ring":
         if mesh is None:

@@ -49,6 +49,11 @@ class GPTConfig:
     param_dtype: Any = jnp.float32     # master params
     tie_embeddings: bool = True
     remat: bool = True
+    # Keep attention OUTSIDE the remat boundary: flash attention is a
+    # custom_vjp whose residuals (q/k/v/o/lse) are rebuilt by re-running the
+    # whole forward kernel when rematted — saving them (~60MB/layer at the
+    # bench shapes) is far cheaper than the recompute (~8ms/step).
+    remat_attention: bool = False
     attn_impl: str = "auto"            # see models.attention
     z_loss: float = 1e-4               # logit-norm regularizer (stability)
     # Pipeline parallelism (DeepSpeed PipelineModule analog, TPU-style:
@@ -100,6 +105,17 @@ def tiny(seq_len: int = 128) -> GPTConfig:
     return GPTConfig(
         vocab_size=256, n_layers=2, n_heads=4, d_model=64, d_ff=256,
         seq_len=seq_len, remat=False,
+    )
+
+
+def _remat_policy():
+    """Per-block remat policy: save matmul outputs AND the flash-attention
+    kernel output (named in models/attention.py — pallas_call results are
+    invisible to the dots policy, and recomputing the attention forward
+    inside the backward costs ~8ms/step on the GPT-2 bench)."""
+    return jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        jax.checkpoint_policies.save_only_these_names("flash_out"),
     )
 
 
@@ -268,6 +284,12 @@ class GPT(Model):
         """One transformer block → (x, moe_aux). `manual` = running inside a
         shard_map manual region (pipeline stage): no sharding constraints, no
         nested shard_map (dense attention)."""
+        x = self._attn_half(x, blk, manual=manual)
+        return self._mlp_half(x, blk, manual=manual)
+
+    def _attn_half(
+        self, x: jax.Array, blk: Dict[str, jax.Array], *, manual: bool = False
+    ) -> jax.Array:
         c = self.config
         act_spec = P(("data", "fsdp"), "context", None)
 
@@ -288,6 +310,13 @@ class GPT(Model):
         x = x + o
         if not manual:
             x = self._constrain(x, act_spec)
+        return x
+
+    def _mlp_half(
+        self, x: jax.Array, blk: Dict[str, jax.Array], *, manual: bool = False
+    ) -> Tuple[jax.Array, jax.Array]:
+        c = self.config
+        act_spec = P(("data", "fsdp"), "context", None)
 
         h = _layernorm(x, blk["ln2_scale"], blk["ln2_bias"])
         if c.n_experts:
@@ -328,12 +357,19 @@ class GPT(Model):
             return self._apply_pipelined(params, tokens)
 
         x = self._embed(params, tokens)
-        block_fn = functools.partial(self._block, manual=False)
-        if c.remat:
-            block_fn = jax.checkpoint(
-                block_fn,
-                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        if c.remat and not c.remat_attention:
+            attn_fn = functools.partial(self._attn_half, manual=False)
+            mlp_fn = jax.checkpoint(
+                functools.partial(self._mlp_half, manual=False),
+                policy=_remat_policy(),
             )
+
+            def block_fn(x, blk):
+                return mlp_fn(attn_fn(x, blk), blk)
+        else:
+            block_fn = functools.partial(self._block, manual=False)
+            if c.remat:
+                block_fn = jax.checkpoint(block_fn, policy=_remat_policy())
 
         def body(carry, blk):
             x, aux = carry
@@ -388,10 +424,7 @@ class GPT(Model):
 
         block_fn = functools.partial(self._block, manual=True)
         if c.remat:
-            block_fn = jax.checkpoint(
-                block_fn,
-                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            )
+            block_fn = jax.checkpoint(block_fn, policy=_remat_policy())
 
         def stage_fn(sp, act):
             sp = jax.tree.map(lambda leaf: leaf[0], sp)  # drop stage dim (=1)

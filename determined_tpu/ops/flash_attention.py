@@ -36,6 +36,31 @@ def _causal_mask(q_offset: jax.Array, k_offset: jax.Array, bq: int, bk: int) -> 
     return rows >= cols
 
 
+def _causal_dispatch(qi, ki, block_q, block_k, causal, compute):
+    """Run `compute(masked)` for one (qi, ki) block in the right causal
+    regime — shared by all three kernels so the boundary logic lives once:
+
+    - block fully above the diagonal: contributes nothing, skip all work;
+    - block straddling the diagonal: compute with the element mask;
+    - block fully below: compute without the iota/where VPU work.
+    """
+    if not causal:
+        compute(masked=False)
+        return
+    first_q, last_q = qi * block_q, qi * block_q + (block_q - 1)
+    first_k, last_k = ki * block_k, ki * block_k + (block_k - 1)
+    on_diag = (last_k > first_q) & (first_k <= last_q)
+    below = last_k <= first_q
+
+    @pl.when(on_diag)
+    def _():
+        compute(masked=True)
+
+    @pl.when(below)
+    def _():
+        compute(masked=False)
+
+
 # ---------------------------------------------------------------------------
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
@@ -49,36 +74,34 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)  # [bq, d]
-        k = k_ref[0].astype(jnp.float32)  # [bk, d]
-        v = v_ref[0].astype(jnp.float32)
+    def _compute(masked):
+        # MXU dots take the native (bf16) inputs and accumulate in fp32 via
+        # preferred_element_type — casting inputs to fp32 first would run
+        # the MXU at a fraction of its bf16 rate.
+        q = q_ref[0]  # [bq, d]
+        k = k_ref[0]  # [bk, d]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [bq, bk]
-        if causal:
+        ) * scale  # [bq, bk] fp32
+        if masked:
             mask = _causal_mask(qi * block_q, ki * block_k, block_q, block_k)
             s = jnp.where(mask, s, NEG_INF)
         # m/l live in lane-padded (block_q, 128) scratch; column 0 is real.
         m_prev = m_scr[:, 0:1]  # [bq, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
-        if causal:
+        if masked:
             p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m_prev - m_new)
         l_scr[:, 0:1] = l_scr[:, 0:1] * corr + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         m_scr[:, 0:1] = m_new
 
-    if causal:
-        # Whole block above the diagonal contributes nothing: skip its MXU work.
-        @pl.when(ki * block_k <= qi * block_q + (block_q - 1))
-        def _():
-            _compute()
-    else:
-        _compute()
+    _causal_dispatch(qi, ki, block_q, block_k, causal, _compute)
 
     @pl.when(ki == num_k_blocks - 1)
     def _epilogue():
@@ -133,6 +156,167 @@ def _flash_fwd_pallas(
         interpret=interpret,
     )(q, k, v)
     return o, lse.reshape(bh, s_q)
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward kernels (TPU): dq pass + dk/dv pass.
+#
+# Standard flash backward split: recomputing p costs one extra QK^T matmul
+# per pass but keeps every accumulator in VMEM scratch — dq accumulates
+# over the k-block grid dimension, dk/dv over the q-block dimension. All
+# MXU dots take bf16 inputs with fp32 accumulation.
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, causal, block_q, block_k, num_k_blocks):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute(masked):
+        q = q_ref[0]    # [bq, d] bf16
+        k = k_ref[0]    # [bk, d]
+        v = v_ref[0]
+        do = do_ref[0]  # [bq, d]
+        lse = lse_ref[0].reshape(block_q, 1)    # [bq, 1] fp32
+        delta = delta_ref[0].reshape(block_q, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if masked:
+            mask = _causal_mask(qi * block_q, ki * block_k, block_q, block_k)
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk] fp32
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    _causal_dispatch(qi, ki, block_q, block_k, causal, _compute)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _epilogue():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    scale, causal, block_q, block_k, num_q_blocks):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute(masked):
+        q = q_ref[0]    # [bq, d]
+        k = k_ref[0]    # [bk, d]
+        v = v_ref[0]
+        do = do_ref[0]  # [bq, d]
+        lse = lse_ref[0].reshape(block_q, 1)
+        delta = delta_ref[0].reshape(block_q, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if masked:
+            mask = _causal_mask(qi * block_q, ki * block_k, block_q, block_k)
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)                    # [bq, bk] fp32
+        pt = p.astype(do.dtype)
+        dv_scr[:] += jax.lax.dot_general(
+            pt, do, (((0,), (0,)), ((), ())),   # pᵀ·do → [bk, d]
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),    # dsᵀ·q → [bk, d]
+            preferred_element_type=jnp.float32,
+        )
+
+    _causal_dispatch(qi, ki, block_q, block_k, causal, _compute)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _epilogue():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
+                      interpret=False):
+    """q/k/v/o/do: [BH, S, D], lse: [BH, S] fp32 → (dq, dk, dv)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, s_q, d = q.shape
+    s_k = k.shape[1]
+    nq = pl.cdiv(s_q, block_q)
+    nk = pl.cdiv(s_k, block_k)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )  # [BH, Sq]
+    lse3 = lse.reshape(bh, 1, s_q)
+    delta3 = delta.reshape(bh, 1, s_q)
+
+    row_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # q
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # k
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # v
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # do
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),   # lse
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),   # delta
+    ]
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_k_blocks=nk,
+        ),
+        grid=(bh, nq, nk),
+        in_specs=row_specs,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta3)
+
+    col_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # q
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # k
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # v
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # do
+        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # lse
+        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # delta
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_q_blocks=nq,
+        ),
+        grid=(bh, nk, nq),
+        in_specs=col_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s_k, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta3)
+    return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
@@ -243,6 +427,11 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
 
 def _flash_bwd(scale, causal, block_q, block_k, res, do):
     q, k, v, o, lse = res
+    if _use_pallas():
+        return _flash_bwd_pallas(
+            q, k, v, o, lse, do, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        )
     return _blockwise_bwd_ref(
         q, k, v, o, lse, do, scale=scale, causal=causal, block_k=block_k
     )
