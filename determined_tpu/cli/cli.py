@@ -281,6 +281,49 @@ def notebook_start(args: argparse.Namespace) -> None:
     print(f"  open {master}/proxy/{resp['task_id']}/ once it registers")
 
 
+# -- shells (ref: internal/command/shell_manager.go + cli/tunnel.py) -----------
+def shell_start(args: argparse.Namespace) -> None:
+    import secrets
+
+    token = secrets.token_hex(16)
+    cfg = {
+        "task_type": "SHELL",
+        "entrypoint": "python -m determined_tpu.exec.shell",
+        "resources": {"slots": args.slots},
+        # The shell token is this design's analog of the reference's
+        # injected ssh public key: a per-task credential carried in the
+        # task config (master/pkg/ssh keygen + shell_manager.go).
+        "environment": {"variables": {"DTPU_SHELL_TOKEN": token}},
+    }
+    resp = _session(args).post("/api/v1/commands", json_body={"config": cfg})
+    print(f"Started shell {resp['task_id']}")
+    print(f"  dtpu shell open {resp['task_id']}")
+
+
+def _shell_token_of(session, task_id: str) -> str:
+    for c in session.get("/api/v1/commands")["commands"]:
+        if c["task_id"] == task_id:
+            return (
+                c.get("config", {}).get("environment", {})
+                .get("variables", {}).get("DTPU_SHELL_TOKEN", "")
+            )
+    _die(f"no such task {task_id}")
+
+
+def shell_open(args: argparse.Namespace) -> None:
+    from determined_tpu.cli.shell_client import ShellError, run_shell
+
+    session = _session(args)
+    token = _shell_token_of(session, args.task_id)
+    if not token:
+        _die(f"{args.task_id} is not a shell task (no shell token)")
+    master = args.master or os.environ.get("DTPU_MASTER", "")
+    try:
+        run_shell(master, args.task_id, token, user_token=session.token)
+    except ShellError as e:
+        _die(str(e))
+
+
 # -- model registry ------------------------------------------------------------
 def model_create(args: argparse.Namespace) -> None:
     _session(args).post(
@@ -447,6 +490,20 @@ def build_parser() -> argparse.ArgumentParser:
     v = tb.add_parser("start")
     v.add_argument("experiment_ids", type=int, nargs="+")
     v.set_defaults(fn=tb_start)
+
+    shell = sub.add_parser("shell", aliases=["sh"]).add_subparsers(
+        dest="verb", required=True
+    )
+    v = shell.add_parser("start")
+    v.add_argument("--slots", type=int, default=0)
+    v.set_defaults(fn=shell_start)
+    v = shell.add_parser("open")
+    v.add_argument("task_id")
+    v.set_defaults(fn=shell_open)
+    shell.add_parser("list").set_defaults(fn=cmd_list)
+    v = shell.add_parser("kill")
+    v.add_argument("task_id")
+    v.set_defaults(fn=cmd_kill)
 
     nb = sub.add_parser("notebook", aliases=["nb"]).add_subparsers(
         dest="verb", required=True)

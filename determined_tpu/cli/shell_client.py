@@ -1,0 +1,120 @@
+"""Client side of `dtpu shell open`: tunnel a PTY through the master.
+
+Rebuild of the reference's `harness/determined/cli/tunnel.py` (there it
+splices stdin/stdout to a TCP tunnel for ssh's ProxyCommand; here the
+tunnel IS the shell — see determined_tpu/exec/shell.py for the redesign
+rationale). Kept separate from cli.py so tests can drive a shell session
+over pipes without a TTY.
+"""
+from __future__ import annotations
+
+import select
+import socket
+import sys
+from typing import Optional
+from urllib.parse import urlparse
+
+
+class ShellError(Exception):
+    pass
+
+
+def connect_shell(
+    master_url: str, task_id: str, shell_token: str,
+    user_token: str = "",
+) -> "tuple[socket.socket, bytes]":
+    """Dial the master, upgrade into the task's PTY tunnel. Returns the
+    socket (handshake consumed) plus any tunnel bytes that raced the
+    handshake (e.g. the shell prompt)."""
+    parsed = urlparse(master_url)
+    host = parsed.hostname or "127.0.0.1"
+    port = parsed.port or (443 if parsed.scheme == "https" else 80)
+    sock = socket.create_connection((host, port), timeout=30)
+    if parsed.scheme == "https":
+        # The handshake carries credentials; they must not cross the wire
+        # in cleartext when the master is TLS.
+        import ssl
+
+        sock = ssl.create_default_context().wrap_socket(
+            sock, server_hostname=host
+        )
+    try:
+        query = f"shell_token={shell_token}"
+        if user_token:
+            query += f"&token={user_token}"
+        head = (
+            f"GET /proxy/{task_id}/?{query} HTTP/1.1\r\n"
+            f"Host: {host}:{port}\r\n"
+            "Connection: Upgrade\r\n"
+            "Upgrade: websocket\r\n"
+            "\r\n"
+        ).encode()
+        sock.sendall(head)
+        resp = b""
+        while b"\r\n\r\n" not in resp and len(resp) < 64 * 1024:
+            chunk = sock.recv(4096)
+            if not chunk:
+                raise ShellError("connection closed during handshake")
+            resp += chunk
+        head_text, _, early = resp.partition(b"\r\n\r\n")
+        status_line = head_text.split(b"\r\n", 1)[0].decode(errors="replace")
+        if " 101 " not in status_line + " ":
+            raise ShellError(f"shell handshake failed: {status_line}")
+        sock.settimeout(None)
+        return sock, early
+    except Exception:
+        sock.close()
+        raise
+
+
+def run_shell(
+    master_url: str, task_id: str, shell_token: str,
+    user_token: str = "",
+    stdin_fd: Optional[int] = None,
+    stdout_fd: Optional[int] = None,
+) -> None:
+    """Bridge the local terminal (or any fd pair) to the remote PTY."""
+    import os
+
+    stdin_fd = sys.stdin.fileno() if stdin_fd is None else stdin_fd
+    stdout_fd = sys.stdout.fileno() if stdout_fd is None else stdout_fd
+    sock, early = connect_shell(master_url, task_id, shell_token, user_token)
+
+    restore = None
+    if os.isatty(stdin_fd):
+        import termios
+        import tty
+
+        saved = termios.tcgetattr(stdin_fd)
+        tty.setraw(stdin_fd)
+        restore = (stdin_fd, saved)
+    try:
+        if early:
+            os.write(stdout_fd, early)
+        stdin_open = True
+        while True:
+            rlist = [sock] + ([stdin_fd] if stdin_open else [])
+            r, _, _ = select.select(rlist, [], [])
+            if sock in r:
+                data = sock.recv(65536)
+                if not data:
+                    break
+                os.write(stdout_fd, data)
+            if stdin_fd in r:
+                data = os.read(stdin_fd, 65536)
+                if not data:
+                    # Local EOF: stop forwarding input, keep draining
+                    # remote output until the shell exits.
+                    stdin_open = False
+                    try:
+                        sock.shutdown(socket.SHUT_WR)
+                    except OSError:
+                        pass
+                    continue
+                sock.sendall(data)
+    finally:
+        if restore is not None:
+            import termios
+
+            termios.tcsetattr(restore[0], termios.TCSADRAIN, restore[1])
+        sock.close()

@@ -30,6 +30,10 @@ class Session:
         if token:
             self._http.headers["Authorization"] = f"Bearer {token}"
 
+    @property
+    def token(self) -> str:
+        return self._token
+
     def _request(
         self,
         method: str,

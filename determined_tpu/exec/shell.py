@@ -1,0 +1,166 @@
+"""Shell task: interactive PTY in the task environment, behind the proxy.
+
+Rebuild of the reference's shell feature (`master/internal/command/
+shell_manager.go`, `harness/determined/cli/tunnel.py`, `master/pkg/ssh`
+keygen): there, `det shell` generates an ssh keypair, injects the public
+key into an sshd running in the task container, and tunnels the TCP stream
+through the master. On TPU VMs the transport is redesigned — a PTY server
+that accepts a WebSocket-style upgrade handshake and then bridges raw
+bytes to a forked shell — because TPU tasks are processes on a VM the
+master already authenticates: a per-task shell token (the config analog of
+the injected ssh key) replaces key distribution, and the master's
+/proxy/{task}/ upgrade tunnel replaces the TCP tunnel. Capability is
+identical: `dtpu shell open <task>` gets an interactive shell where the
+task runs.
+
+Protocol per connection:
+  client: GET /?shell_token=<token> HTTP/1.1 + Upgrade headers
+  server: HTTP/1.1 101 Switching Protocols, then raw PTY bytes both ways.
+Each connection gets a fresh shell; the server survives disconnects.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pty
+import select
+import signal
+import socket
+import sys
+import threading
+from urllib.parse import parse_qs, urlparse
+
+logger = logging.getLogger("determined_tpu.exec.shell")
+
+
+def _reap(pid: int) -> None:
+    """Reap the shell child without leaving a zombie: SIGHUP alone doesn't
+    guarantee a prompt exit, and a WNOHANG waitpid right after the kill
+    almost never wins the race — escalate and block (the server is
+    long-lived; each leaked zombie would persist for the task's lifetime)."""
+    import time
+
+    try:
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            done, _ = os.waitpid(pid, os.WNOHANG)
+            if done:
+                return
+            time.sleep(0.05)
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        os.waitpid(pid, 0)
+    except ChildProcessError:
+        pass  # already reaped
+
+
+def _serve_connection(conn: socket.socket, token: str) -> None:
+    try:
+        head = b""
+        while b"\r\n\r\n" not in head and len(head) < 64 * 1024:
+            chunk = conn.recv(4096)
+            if not chunk:
+                return
+            head += chunk
+        head_text, _, early = head.partition(b"\r\n\r\n")
+        request_line = head_text.split(b"\r\n", 1)[0].decode(errors="replace")
+        try:
+            _, raw_path, _ = request_line.split(" ", 2)
+        except ValueError:
+            conn.sendall(b"HTTP/1.1 400 Bad Request\r\n\r\n")
+            return
+        q = parse_qs(urlparse(raw_path).query)
+        got = (q.get("shell_token") or [""])[0]
+        if not token or got != token:
+            # Same reasoning as the notebook's jupyter token: the port
+            # binds 0.0.0.0, so anything on the agent network can reach
+            # it — an unauthenticated PTY would be remote root.
+            conn.sendall(b"HTTP/1.1 403 Forbidden\r\n\r\nbad shell token")
+            return
+        conn.sendall(
+            b"HTTP/1.1 101 Switching Protocols\r\n"
+            b"Upgrade: websocket\r\nConnection: Upgrade\r\n\r\n"
+        )
+
+        pid, fd = pty.fork()
+        if pid == 0:  # child: the user's shell
+            shell = os.environ.get("SHELL") or "/bin/bash"
+            if not os.path.exists(shell):
+                shell = "/bin/sh"
+            os.execv(shell, [shell, "-i"])
+            os._exit(127)  # pragma: no cover
+
+        try:
+            if early:
+                os.write(fd, early)
+            conn.setblocking(True)
+            conn_open = True
+            while True:
+                rlist = [fd] + ([conn] if conn_open else [])
+                r, _, _ = select.select(rlist, [], [], 60.0)
+                if conn in r:
+                    data = conn.recv(4096)
+                    if not data:
+                        # Half-close (piped/scripted client sent EOF): stop
+                        # reading input but keep draining the PTY until the
+                        # shell exits — its output must still reach the
+                        # client.
+                        conn_open = False
+                    else:
+                        os.write(fd, data)
+                if fd in r:
+                    try:
+                        data = os.read(fd, 4096)
+                    except OSError:  # shell exited, pty closed
+                        break
+                    if not data:
+                        break
+                    conn.sendall(data)
+        finally:
+            try:
+                os.kill(pid, signal.SIGHUP)
+            except ProcessLookupError:
+                pass
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+            _reap(pid)
+    except OSError:
+        pass
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    from determined_tpu.common.ipc import free_port
+    from determined_tpu.exec.proxy_util import register_proxy
+
+    token = os.environ.get("DTPU_SHELL_TOKEN", "")
+    if not token:
+        logger.error("DTPU_SHELL_TOKEN not set; refusing to serve an "
+                     "unauthenticated PTY")
+        return 1
+    port = free_port()
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("0.0.0.0", port))
+    srv.listen(4)
+    register_proxy(port)
+    task_id = os.environ.get("DTPU_TASK_ID", "")
+    logger.info("shell ready: dtpu shell open %s", task_id)
+    while True:
+        conn, _ = srv.accept()
+        threading.Thread(
+            target=_serve_connection, args=(conn, token), daemon=True
+        ).start()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

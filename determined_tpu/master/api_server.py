@@ -682,6 +682,10 @@ class ApiServer:
                                          "proxied services"
                             })
                             return
+                    connection = self.headers.get("Connection", "")
+                    if "upgrade" in connection.lower():
+                        self._proxy_upgrade(method, parsed)
+                        return
                     self._proxy(method, parsed)
                     return
                 principal: Optional[str] = None
@@ -786,6 +790,24 @@ class ApiServer:
                     self.wfile.write(data)
                 except (BrokenPipeError, ConnectionResetError):
                     pass
+
+            def _proxy_upgrade(self, method: str, parsed) -> None:
+                """WebSocket (or any Upgrade) pass-through: hand the raw
+                connection to the proxy's byte tunnel (ref: proxy/ws.go
+                hijacks the conn and io.Copies both ways)."""
+                parts = parsed.path.split("/", 3)
+                task_id = parts[2] if len(parts) > 2 else ""
+                rest = "/" + (parts[3] if len(parts) > 3 else "")
+                err = master.proxy.tunnel_upgrade(
+                    task_id, method, rest, parsed.query,
+                    dict(self.headers), self.connection, self.rfile,
+                )
+                if err is not None:
+                    self._send(502, {"error": err}, close=True)
+                    return
+                # The connection carried opaque tunnel bytes; it cannot be
+                # reused for HTTP.
+                self.close_connection = True
 
             def _send(self, status: int, payload: Dict[str, Any],
                       close: bool = False) -> None:

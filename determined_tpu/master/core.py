@@ -304,6 +304,15 @@ class Master:
             jax_platform = config.get("environment", {}).get("jax_platform")
             if jax_platform:
                 env["DTPU_JAX_PLATFORM"] = jax_platform
+            # User env vars (ref expconf environment.environment_variables):
+            # applied before the DTPU_* contract so they cannot clobber it.
+            user_env = {
+                str(k): str(v)
+                for k, v in config.get("environment", {})
+                .get("variables", {}).items()
+                if not str(k).startswith("DTPU_") or str(k) == "DTPU_SHELL_TOKEN"
+            }
+            env = {**user_env, **env}
             if config.get("context"):
                 env["DTPU_CONTEXT_ID"] = str(config["context"])
             self.agent_hub.enqueue(

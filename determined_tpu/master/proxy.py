@@ -1,22 +1,31 @@
-"""Proxy: route HTTP to services running inside task allocations.
+"""Proxy: route HTTP and WebSocket/upgrade traffic to task services.
 
-Rebuild of `master/internal/proxy/{proxy.go,tcp.go}`: interactive tasks
-(notebooks, TensorBoards, custom dashboards) listen on a port inside their
-allocation; they register `(host, port)` with the master, and the master
-serves `/proxy/{task_id}/...` by forwarding the request — so users reach
-every task UI through the one master address, exactly like the reference's
-notebook/TB tunneling. (WebSocket upgrade is not implemented yet; plain
-HTTP covers TensorBoard and most dashboards.)
+Rebuild of `master/internal/proxy/{proxy.go,ws.go,tcp.go}`: interactive
+tasks (notebooks, TensorBoards, shells, custom dashboards) listen on a port
+inside their allocation; they register `(host, port)` with the master, and
+the master serves `/proxy/{task_id}/...` by forwarding the request — so
+users reach every task UI through the one master address, exactly like the
+reference's notebook/TB tunneling.
+
+Upgrade requests (`Connection: Upgrade`, e.g. WebSocket) switch to a raw
+byte tunnel (`tunnel_upgrade`): the master replays the handshake to the
+task service and then splices both sockets until either side closes — the
+WS protocol itself stays opaque, which is all Jupyter kernels and the PTY
+shell need (ws.go does the same: hijack + io.Copy both ways).
 """
 from __future__ import annotations
 
 import logging
+import socket
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, IO, Optional, Tuple
 
 import requests
 
 logger = logging.getLogger("determined_tpu.master")
+
+#: Read chunk for tunnel splicing.
+TUNNEL_CHUNK = 64 * 1024
 
 HOP_HEADERS = {
     "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
@@ -26,6 +35,40 @@ HOP_HEADERS = {
     # Content-Encoding with a decompressed body corrupts every gzip page.
     "content-encoding",
 }
+
+
+def _strip_master_credentials(headers: Dict[str, str]) -> Dict[str, str]:
+    """Drop master credentials before anything reaches task code: the
+    Authorization header, Proxy-Authorization, and the dtpu_token cookie
+    (task code is user code — it must never see a user session token)."""
+    out: Dict[str, str] = {}
+    for k, v in headers.items():
+        kl = k.lower()
+        if kl in ("authorization", "proxy-authorization"):
+            continue
+        if kl == "cookie":
+            kept = [
+                c for c in v.split(";")
+                if c.strip().partition("=")[0] != "dtpu_token"
+            ]
+            if not kept:
+                continue
+            v = ";".join(kept)
+        out[k] = v
+    return out
+
+
+def _strip_token_query(query: str) -> str:
+    """Remove the master auth `token=` parameter from a query string (the
+    browser/CLI uses it because it can't set headers); everything else —
+    e.g. the shell task's own shell_token — passes through."""
+    if not query:
+        return query
+    kept = [
+        part for part in query.split("&")
+        if part.partition("=")[0] != "token"
+    ]
+    return "&".join(kept)
 
 
 class ProxyRegistry:
@@ -60,27 +103,13 @@ class ProxyRegistry:
             return 502, {}, b'{"error": "no proxy target for task"}'
         host, port = target
         url = f"http://{host}:{port}{path}"
+        query = _strip_token_query(query)
         if query:
             url += f"?{query}"
-        fwd_headers = {}
-        for k, v in headers.items():
-            kl = k.lower()
-            if kl in HOP_HEADERS:
-                continue
-            if kl == "authorization":
-                # NEVER forward master credentials into user task code.
-                continue
-            if kl == "cookie":
-                # Strip the master auth cookie; pass the rest (the task's
-                # own app cookies, e.g. a notebook session).
-                kept = [
-                    c for c in v.split(";")
-                    if c.strip().partition("=")[0] != "dtpu_token"
-                ]
-                if not kept:
-                    continue
-                v = ";".join(kept)
-            fwd_headers[k] = v
+        fwd_headers = {
+            k: v for k, v in _strip_master_credentials(headers).items()
+            if k.lower() not in HOP_HEADERS
+        }
         try:
             resp = requests.request(
                 method, url, headers=fwd_headers,
@@ -95,3 +124,83 @@ class ProxyRegistry:
             if k.lower() not in HOP_HEADERS
         }
         return resp.status_code, out_headers, resp.content
+
+    def tunnel_upgrade(
+        self, task_id: str, method: str, path: str, query: str,
+        headers: Dict[str, str], client_sock: socket.socket,
+        client_rfile: IO[bytes],
+    ) -> Optional[str]:
+        """Service an Upgrade (WebSocket) request as a raw byte tunnel.
+
+        Replays the handshake to the task service, then splices both
+        directions until either side closes. Returns an error string if the
+        tunnel could not be established (caller sends the HTTP error);
+        returns None after a successful tunnel ends — the connection is
+        spent and must be closed.
+        """
+        target = self.target(task_id)
+        if target is None:
+            return "no proxy target for task"
+        host, port = target
+        query = _strip_token_query(query)
+        url = path + (f"?{query}" if query else "")
+        head_lines = [f"{method} {url} HTTP/1.1", f"Host: {host}:{port}"]
+        for k, v in _strip_master_credentials(headers).items():
+            if k.lower() in ("host", "content-length"):
+                continue
+            head_lines.append(f"{k}: {v}")
+        head = ("\r\n".join(head_lines) + "\r\n\r\n").encode()
+
+        try:
+            backend = socket.create_connection((host, port), timeout=30)
+        except OSError as e:
+            return f"connect to task service failed: {e}"
+        try:
+            backend.settimeout(None)
+            backend.sendall(head)
+
+            def pump_client_to_backend() -> None:
+                # Read via the handler's buffered rfile: frames the client
+                # sent right behind the handshake are already buffered
+                # there and would be lost reading the raw socket.
+                try:
+                    while True:
+                        data = client_rfile.read1(TUNNEL_CHUNK)
+                        if not data:
+                            break
+                        backend.sendall(data)
+                except OSError:
+                    pass
+                finally:
+                    try:
+                        backend.shutdown(socket.SHUT_WR)
+                    except OSError:
+                        pass
+
+            t = threading.Thread(
+                target=pump_client_to_backend,
+                name=f"ws-tunnel-{task_id}", daemon=True,
+            )
+            t.start()
+            try:
+                while True:
+                    data = backend.recv(TUNNEL_CHUNK)
+                    if not data:
+                        break
+                    client_sock.sendall(data)
+            except OSError:
+                pass
+            finally:
+                # Unblock the client-side pump (rfile.read1 blocks on a
+                # live client that just stopped typing).
+                try:
+                    client_sock.shutdown(socket.SHUT_RD)
+                except OSError:
+                    pass
+            t.join(timeout=5.0)
+            return None
+        finally:
+            try:
+                backend.close()
+            except OSError:
+                pass
