@@ -41,7 +41,10 @@ def connect_shell(
     try:
         query = f"shell_token={shell_token}"
         if user_token:
-            query += f"&token={user_token}"
+            # dtpu_token, not token: the master consumes (and the proxy
+            # strips) dtpu_token; `token` would be forwarded to the task
+            # service, which owns that name (Jupyter).
+            query += f"&dtpu_token={user_token}"
         head = (
             f"GET /proxy/{task_id}/?{query} HTTP/1.1\r\n"
             f"Host: {host}:{port}\r\n"
@@ -50,13 +53,12 @@ def connect_shell(
             "\r\n"
         ).encode()
         sock.sendall(head)
-        resp = b""
-        while b"\r\n\r\n" not in resp and len(resp) < 64 * 1024:
-            chunk = sock.recv(4096)
-            if not chunk:
-                raise ShellError("connection closed during handshake")
-            resp += chunk
-        head_text, _, early = resp.partition(b"\r\n\r\n")
+        from determined_tpu.common.netutil import read_http_head
+
+        try:
+            head_text, early = read_http_head(sock)
+        except (ConnectionError, ValueError) as e:
+            raise ShellError(f"shell handshake failed: {e}") from e
         status_line = head_text.split(b"\r\n", 1)[0].decode(errors="replace")
         if " 101 " not in status_line + " ":
             raise ShellError(f"shell handshake failed: {status_line}")
@@ -93,6 +95,16 @@ def run_shell(
             os.write(stdout_fd, early)
         stdin_open = True
         while True:
+            # TLS: a record may decrypt to more bytes than one recv returned;
+            # those sit in the SSL object's buffer where select() on the raw
+            # fd can't see them — drain before blocking or the shell freezes
+            # until the server happens to send more.
+            if getattr(sock, "pending", None) is not None and sock.pending():
+                data = sock.recv(65536)
+                if not data:
+                    break
+                os.write(stdout_fd, data)
+                continue
             rlist = [sock] + ([stdin_fd] if stdin_open else [])
             r, _, _ = select.select(rlist, [], [])
             if sock in r:

@@ -6,21 +6,34 @@ This carries *control-plane* python objects only (metrics dicts, checkpoint
 selectors, preemption flags) — never tensors. The data plane is XLA
 collectives over ICI/DCN, compiled into the jitted program.
 
-Design difference from the reference: instead of PUB/SUB + PUSH/PULL (which
-needs a slow-joiner sync dance), we use a single ROUTER socket on the chief
-and DEALER sockets on workers. ROUTER gives reliable, addressable delivery,
-so gather/broadcast need no sync protocol.
+Design differences from the reference:
+
+- instead of PUB/SUB + PUSH/PULL (which needs a slow-joiner sync dance), a
+  single ROUTER socket on the chief and DEALER sockets on workers. ROUTER
+  gives reliable, addressable delivery, so gather/broadcast need no sync
+  protocol;
+- every message carries a **channel** tag, and each endpoint runs one
+  receiver thread that sorts arrivals into per-(rank, channel) inboxes.
+  Channels make concurrent collectives from different threads safe as long
+  as each thread uses its own channel: the async checkpoint writer runs its
+  collective upload on the "checkpoint" channel while the step loop polls
+  preemption on "main", and neither can steal the other's frames. (ZMQ
+  sockets are not thread-safe, so all socket ops are mutex-guarded and only
+  the receiver thread ever recv()s after startup.)
 """
 from __future__ import annotations
 
 import pickle
 import socket
 import threading
-from typing import Any, List, Optional
+import time
+from typing import Any, Callable, Dict, Hashable, List, Optional
 
 import zmq
 
 _HELLO = b"__hello__"
+_POLL_MS = 50  # receiver-thread recv timeout; bounds send-lock hold time
+CHANNEL_MAIN = "main"
 
 
 def free_port() -> int:
@@ -29,6 +42,89 @@ def free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+class _Inbox:
+    """Receiver-side state shared by both ends of the star: per-key FIFOs
+    of arrived frames, a condition variable for waiters, and receiver-death
+    propagation (a dead receiver must fail waiters loudly — they would
+    otherwise block forever on a condition nothing will ever notify)."""
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._queues: Dict[Hashable, List[Any]] = {}
+        self._error: Optional[BaseException] = None
+
+    def put(self, key: Hashable, obj: Any) -> None:
+        with self._cond:
+            self._queues.setdefault(key, []).append(obj)
+            self._cond.notify_all()
+
+    def die(self, err: BaseException) -> None:
+        with self._cond:
+            self._error = err
+            self._cond.notify_all()
+
+    def get(self, key: Hashable, timeout_s: Optional[float], what: str) -> Any:
+        """Pop the next frame for `key`, waiting as needed. Raises
+        TimeoutError on deadline and RuntimeError if the receiver died."""
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        with self._cond:
+            while not self._queues.get(key):
+                if self._error is not None:
+                    raise RuntimeError(
+                        f"IPC receiver thread died: {self._error!r}"
+                    ) from self._error
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(f"{what} timed out")
+                self._cond.wait(timeout=remaining)
+            return self._queues[key].pop(0)
+
+
+class _ReceiverLoop:
+    """One background thread owning all recv()s on a socket; `handle`
+    stashes each payload. ZMQError during shutdown is an orderly exit; any
+    other failure (ETERM, a malformed frame in `handle`) is routed to the
+    inbox so blocked collectives fail instead of hanging."""
+
+    def __init__(
+        self,
+        name: str,
+        sock_lock: threading.Lock,
+        recv: Callable[[], bytes],
+        handle: Callable[[bytes], None],
+        inbox: _Inbox,
+        is_closed: Callable[[], bool],
+    ) -> None:
+        self._sock_lock = sock_lock
+        self._recv = recv
+        self._handle = handle
+        self._inbox = inbox
+        self._is_closed = is_closed
+        self.thread = threading.Thread(target=self._run, name=name, daemon=True)
+
+    def _run(self) -> None:
+        while not self._is_closed():
+            try:
+                try:
+                    with self._sock_lock:
+                        if self._is_closed():
+                            return
+                        payload = self._recv()
+                except zmq.Again:
+                    continue
+                except zmq.ZMQError as e:
+                    if self._is_closed():
+                        return  # orderly close() tearing the socket down
+                    self._inbox.die(e)
+                    return
+                self._handle(payload)
+            except BaseException as e:  # noqa: BLE001 — malformed frame etc.
+                self._inbox.die(e)
+                return
 
 
 class ChiefServer:
@@ -45,18 +141,22 @@ class ChiefServer:
             self._sock.bind(f"tcp://*:{port}")
             self.port = port
         self._identities: List[bytes] = []
-        # Per-rank FIFO of data frames that arrived early: a fast worker may
-        # send its next payload (or its first one, during accept) before
-        # slower workers catch up. ZMQ preserves per-connection ordering, so
-        # per-rank deques keep rounds aligned without sequence numbers.
-        self._inbox: dict = {}
+        # Arrived-but-unclaimed frames, keyed (rank, channel). ZMQ preserves
+        # per-connection ordering, so per-key FIFOs keep collective rounds
+        # aligned without sequence numbers.
+        self._inbox = _Inbox()
+        self._sock_lock = threading.Lock()
+        self._closed = False
+        self._receiver: Optional[_ReceiverLoop] = None
 
     def _stash(self, payload: bytes) -> None:
-        rank, obj = pickle.loads(payload)
-        self._inbox.setdefault(rank, []).append(obj)
+        if payload == _HELLO:
+            return
+        rank, channel, obj = pickle.loads(payload)
+        self._inbox.put((rank, channel), obj)
 
     def accept(self, timeout_s: float = 120.0) -> None:
-        """Wait for all workers to say hello."""
+        """Wait for all workers to say hello, then start the receiver."""
         self._sock.setsockopt(zmq.RCVTIMEO, int(timeout_s * 1000))
         while len(self._identities) < self._num_workers:
             ident, payload = self._sock.recv_multipart()
@@ -65,39 +165,49 @@ class ChiefServer:
                     self._identities.append(ident)
             else:
                 self._stash(payload)
-        self._sock.setsockopt(zmq.RCVTIMEO, -1)
-
-    def gather(self, timeout_s: Optional[float] = None) -> List[Any]:
-        """Receive one object from every worker (ranks 1..n), rank-ordered."""
-        self._sock.setsockopt(
-            zmq.RCVTIMEO, -1 if timeout_s is None else int(timeout_s * 1000)
+        self._sock.setsockopt(zmq.RCVTIMEO, _POLL_MS)
+        self._receiver = _ReceiverLoop(
+            "dtpu-ipc-chief-recv",
+            self._sock_lock,
+            lambda: self._sock.recv_multipart()[1],
+            self._stash,
+            self._inbox,
+            lambda: self._closed,
         )
-        out: dict = {}
-        for rank in range(1, self._num_workers + 1):
-            queued = self._inbox.get(rank)
-            if queued:
-                out[rank] = queued.pop(0)
-        while len(out) < self._num_workers:
-            ident, payload = self._sock.recv_multipart()
-            if payload == _HELLO:
-                continue
-            rank, obj = pickle.loads(payload)
-            if rank in out:
-                self._inbox.setdefault(rank, []).append(obj)
-            else:
-                out[rank] = obj
-        return [out[r] for r in sorted(out)]
+        self._receiver.thread.start()
 
-    def broadcast(self, obj: Any) -> None:
-        payload = pickle.dumps(obj)
-        for ident in self._identities:
-            self._sock.send_multipart([ident, payload])
+    def gather(
+        self, timeout_s: Optional[float] = None, channel: str = CHANNEL_MAIN
+    ) -> List[Any]:
+        """Receive one object from every worker (ranks 1..n), rank-ordered."""
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        out: List[Any] = []
+        for rank in range(1, self._num_workers + 1):
+            remaining = None if deadline is None else deadline - time.monotonic()
+            out.append(
+                self._inbox.get(
+                    (rank, channel),
+                    remaining,
+                    f"gather({channel!r}) waiting for rank {rank}",
+                )
+            )
+        return out
+
+    def broadcast(self, obj: Any, channel: str = CHANNEL_MAIN) -> None:
+        payload = pickle.dumps((channel, obj))
+        with self._sock_lock:
+            for ident in self._identities:
+                self._sock.send_multipart([ident, payload])
 
     def close(self) -> None:
+        self._closed = True
+        if self._receiver is not None:
+            self._receiver.thread.join(timeout=5)
         # Bounded linger: lets in-flight frames flush from the IO thread
         # without pinning dead sockets forever. linger=0 here would race
         # with delivery of the last send.
-        self._sock.close(linger=10_000)
+        with self._sock_lock:
+            self._sock.close(linger=10_000)
 
 
 class WorkerClient:
@@ -108,19 +218,40 @@ class WorkerClient:
         self._ctx = zmq.Context.instance()
         self._sock = self._ctx.socket(zmq.DEALER)
         self._sock.connect(f"tcp://{chief_addr}")
+        self._sock.setsockopt(zmq.RCVTIMEO, _POLL_MS)
         self._sock.send(_HELLO)
+        self._inbox = _Inbox()
+        self._sock_lock = threading.Lock()
+        self._closed = False
+        self._receiver = _ReceiverLoop(
+            "dtpu-ipc-worker-recv",
+            self._sock_lock,
+            self._sock.recv,
+            self._stash,
+            self._inbox,
+            lambda: self._closed,
+        )
+        self._receiver.thread.start()
 
-    def send(self, obj: Any) -> None:
-        self._sock.send(pickle.dumps((self._rank, obj)))
+    def _stash(self, payload: bytes) -> None:
+        channel, obj = pickle.loads(payload)
+        self._inbox.put(channel, obj)
 
-    def recv(self, timeout_s: Optional[float] = None) -> Any:
+    def send(self, obj: Any, channel: str = CHANNEL_MAIN) -> None:
+        payload = pickle.dumps((self._rank, channel, obj))
+        with self._sock_lock:
+            self._sock.send(payload)
+
+    def recv(
+        self, timeout_s: Optional[float] = None, channel: str = CHANNEL_MAIN
+    ) -> Any:
         # No default timeout: the chief may legitimately spend many minutes
         # between collectives (e.g. uploading a multi-GB shard before the
-        # checkpoint barrier); a ticking RCVTIMEO here would kill the job.
-        self._sock.setsockopt(
-            zmq.RCVTIMEO, -1 if timeout_s is None else int(timeout_s * 1000)
-        )
-        return pickle.loads(self._sock.recv())
+        # checkpoint barrier); a ticking timeout here would kill the job.
+        return self._inbox.get(channel, timeout_s, f"recv({channel!r})")
 
     def close(self) -> None:
-        self._sock.close(linger=10_000)
+        self._closed = True
+        self._receiver.thread.join(timeout=5)
+        with self._sock_lock:
+            self._sock.close(linger=10_000)

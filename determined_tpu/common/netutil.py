@@ -1,0 +1,31 @@
+"""Small socket helpers shared by the raw-tunnel endpoints (shell task
+server, shell CLI client) so handshake parsing has one implementation."""
+from __future__ import annotations
+
+import socket
+from typing import Tuple
+
+MAX_HEAD_BYTES = 64 * 1024
+
+
+def read_http_head(
+    sock: socket.socket, max_bytes: int = MAX_HEAD_BYTES
+) -> Tuple[bytes, bytes]:
+    """Accumulate an HTTP head up to the blank line.
+
+    Returns (head, extra) where `head` is everything before CRLFCRLF and
+    `extra` any bytes that raced the handshake (e.g. a shell prompt).
+    Raises ConnectionError on EOF before the terminator and ValueError when
+    the head exceeds `max_bytes` (instead of silently truncating into a
+    confusing parse failure).
+    """
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        if len(buf) >= max_bytes:
+            raise ValueError(f"HTTP head exceeds {max_bytes} bytes")
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise ConnectionError("connection closed before HTTP head completed")
+        buf += chunk
+    head, _, extra = buf.partition(b"\r\n\r\n")
+    return head, extra

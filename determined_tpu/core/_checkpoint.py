@@ -30,6 +30,12 @@ logger = logging.getLogger("determined_tpu.core")
 
 METADATA_FILE = "metadata.json"
 
+# All collectives in upload() ride a dedicated channel so the async
+# checkpoint writer may call it from a background thread while the step
+# loop runs main-channel collectives (preemption polls, searcher ops)
+# concurrently. See common/ipc.py channel semantics.
+CKPT_CHANNEL = "checkpoint"
+
 
 def merge_metadata(all_metadata: List[Optional[Dict[str, Any]]]) -> Dict[str, Any]:
     """Merge per-rank metadata dicts; later ranks must not conflict.
@@ -83,7 +89,8 @@ class CheckpointContext:
         """
         if shard and self._dist.size > 1:
             storage_id = self._dist.broadcast(
-                str(uuid.uuid4()) if self._dist.is_chief else None
+                str(uuid.uuid4()) if self._dist.is_chief else None,
+                channel=CKPT_CHANNEL,
             )
         else:
             if not self._dist.is_chief:
@@ -101,8 +108,8 @@ class CheckpointContext:
         self._storage.upload(ckpt_dir, storage_id, paths=my_files)
 
         if shard and self._dist.size > 1:
-            gathered_files = self._dist.gather(my_files)
-            gathered_md = self._dist.gather(metadata)
+            gathered_files = self._dist.gather(my_files, channel=CKPT_CHANNEL)
+            gathered_md = self._dist.gather(metadata, channel=CKPT_CHANNEL)
         else:
             gathered_files, gathered_md = [my_files], [metadata]
 
@@ -129,7 +136,7 @@ class CheckpointContext:
             except BaseException as e:  # noqa: BLE001 - re-raised after barrier
                 chief_err = e
         if shard and self._dist.size > 1:
-            self._dist.barrier()
+            self._dist.barrier(channel=CKPT_CHANNEL)
         if chief_err is not None:
             raise chief_err
         return storage_id

@@ -68,32 +68,39 @@ class DistributedContext:
         return self.rank == 0
 
     # -- collectives (control-plane objects only) --------------------------
-    def gather(self, obj: Any) -> Optional[List[Any]]:
+    #
+    # `channel` isolates concurrent collective streams: calls on different
+    # channels never steal each other's frames, so a background thread (the
+    # async checkpoint writer) may run its collectives on its own channel
+    # while the main thread uses the default. Calls on the SAME channel must
+    # stay single-threaded per process and issue in the same order on every
+    # rank — the usual collective contract.
+    def gather(self, obj: Any, channel: str = ipc.CHANNEL_MAIN) -> Optional[List[Any]]:
         """Every process sends; chief receives the ordered list, others None."""
         if self.size == 1:
             return [obj]
         if self._server is not None:
-            return [obj] + self._server.gather()
+            return [obj] + self._server.gather(channel=channel)
         assert self._client is not None
-        self._client.send(obj)
+        self._client.send(obj, channel=channel)
         return None
 
-    def broadcast(self, obj: Any) -> Any:
+    def broadcast(self, obj: Any, channel: str = ipc.CHANNEL_MAIN) -> Any:
         """Chief's object is returned on every process."""
         if self.size == 1:
             return obj
         if self._server is not None:
-            self._server.broadcast(obj)
+            self._server.broadcast(obj, channel=channel)
             return obj
         assert self._client is not None
-        return self._client.recv()
+        return self._client.recv(channel=channel)
 
-    def allgather(self, obj: Any) -> List[Any]:
-        gathered = self.gather(obj)
-        return self.broadcast(gathered)
+    def allgather(self, obj: Any, channel: str = ipc.CHANNEL_MAIN) -> List[Any]:
+        gathered = self.gather(obj, channel=channel)
+        return self.broadcast(gathered, channel=channel)
 
-    def barrier(self) -> None:
-        self.allgather(None)
+    def barrier(self, channel: str = ipc.CHANNEL_MAIN) -> None:
+        self.allgather(None, channel=channel)
 
     def close(self) -> None:
         if self._closed:

@@ -32,6 +32,9 @@ from urllib.parse import parse_qs, urlparse
 
 logger = logging.getLogger("determined_tpu.exec.shell")
 
+# Idle seconds of PTY silence after client EOF before the shell is reaped.
+EOF_IDLE_GRACE_S = float(os.environ.get("DTPU_SHELL_EOF_GRACE_S", "60"))
+
 
 def _reap(pid: int) -> None:
     """Reap the shell child without leaving a zombie: SIGHUP alone doesn't
@@ -57,14 +60,16 @@ def _reap(pid: int) -> None:
 
 
 def _serve_connection(conn: socket.socket, token: str) -> None:
+    from determined_tpu.common.netutil import read_http_head
+
     try:
-        head = b""
-        while b"\r\n\r\n" not in head and len(head) < 64 * 1024:
-            chunk = conn.recv(4096)
-            if not chunk:
-                return
-            head += chunk
-        head_text, _, early = head.partition(b"\r\n\r\n")
+        try:
+            head_text, early = read_http_head(conn)
+        except ConnectionError:
+            return
+        except ValueError:
+            conn.sendall(b"HTTP/1.1 400 Bad Request\r\n\r\n")
+            return
         request_line = head_text.split(b"\r\n", 1)[0].decode(errors="replace")
         try:
             _, raw_path, _ = request_line.split(" ", 2)
@@ -73,7 +78,15 @@ def _serve_connection(conn: socket.socket, token: str) -> None:
             return
         q = parse_qs(urlparse(raw_path).query)
         got = (q.get("shell_token") or [""])[0]
-        if not token or got != token:
+        # compare_digest: the token is the only gate on a 0.0.0.0 port; a
+        # byte-at-a-time compare would leak timing (repo convention:
+        # master/auth.py does the same).
+        import hmac
+
+        if not token or not hmac.compare_digest(
+            got.encode("utf-8", "surrogateescape"),
+            token.encode("utf-8", "surrogateescape"),
+        ):  # bytes compare: str compare_digest raises on non-ASCII input
             # Same reasoning as the notebook's jupyter token: the port
             # binds 0.0.0.0, so anything on the agent network can reach
             # it — an unauthenticated PTY would be remote root.
@@ -93,21 +106,31 @@ def _serve_connection(conn: socket.socket, token: str) -> None:
             os._exit(127)  # pragma: no cover
 
         try:
+            import time
+
             if early:
                 os.write(fd, early)
             conn.setblocking(True)
             conn_open = True
+            # After client EOF we can't tell a deliberate half-close (piped
+            # input, output still wanted) from an abrupt disconnect — both
+            # read as b"". Drain the PTY under an idle grace: each burst of
+            # output extends the deadline, so a long scripted command keeps
+            # streaming, while an interactive bash idling at its prompt
+            # (dropped connection) is reaped instead of leaking the PTY +
+            # thread for the task's lifetime. Scripted commands silent for
+            # longer than the grace should run under `dtpu cmd` instead.
+            eof_deadline = None
             while True:
+                if eof_deadline is not None and time.monotonic() > eof_deadline:
+                    break
                 rlist = [fd] + ([conn] if conn_open else [])
-                r, _, _ = select.select(rlist, [], [], 60.0)
+                r, _, _ = select.select(rlist, [], [], 10.0)
                 if conn in r:
                     data = conn.recv(4096)
                     if not data:
-                        # Half-close (piped/scripted client sent EOF): stop
-                        # reading input but keep draining the PTY until the
-                        # shell exits — its output must still reach the
-                        # client.
                         conn_open = False
+                        eof_deadline = time.monotonic() + EOF_IDLE_GRACE_S
                     else:
                         os.write(fd, data)
                 if fd in r:
@@ -118,6 +141,11 @@ def _serve_connection(conn: socket.socket, token: str) -> None:
                     if not data:
                         break
                     conn.sendall(data)
+                    if eof_deadline is not None:
+                        # Still producing output after client EOF: extend the
+                        # grace (idle timeout, not a hard cap) so a long
+                        # scripted command finishes streaming.
+                        eof_deadline = time.monotonic() + EOF_IDLE_GRACE_S
         finally:
             try:
                 os.kill(pid, signal.SIGHUP)

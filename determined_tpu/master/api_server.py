@@ -639,9 +639,15 @@ class ApiServer:
             AUTH_EXEMPT = ("/api/v1/auth/login", "/", "/ui", "/metrics",
                            "/prom/metrics")
 
-            def _auth_token(self, parsed) -> Optional[str]:
-                """Bearer header, else cookie, else ?token= (browser UIs
-                reaching proxied pages can't set headers)."""
+            def _auth_token(self, parsed, proxy: bool = False) -> Optional[str]:
+                """Bearer header, else cookie, else query param (browser UIs
+                and raw upgrade sockets can't always set headers).
+
+                Proxy routes accept only `dtpu_token=` from the query and
+                ignore `token=`: `token` belongs to the proxied service
+                (Jupyter authenticates with exactly that name), so consuming
+                it as master auth would both misread Jupyter tokens and
+                invite session tokens into URLs we forward to task code."""
                 header = self.headers.get("Authorization", "")
                 if header.startswith("Bearer "):
                     return header[7:]
@@ -650,8 +656,9 @@ class ApiServer:
                     name, _, value = part.strip().partition("=")
                     if name == "dtpu_token" and value:
                         return value
-                q = parse_qs(parsed.query).get("token")
-                return q[0] if q else None
+                q = parse_qs(parsed.query)
+                got = q.get("dtpu_token") or (None if proxy else q.get("token"))
+                return got[0] if got else None
 
             def _dispatch(self, method: str) -> None:
                 if getattr(self.server, "stopping", False):
@@ -661,8 +668,9 @@ class ApiServer:
                     # state across an in-process restart.
                     self.close_connection = True
                 parsed = urlparse(self.path)
-                token = self._auth_token(parsed)
-                if parsed.path.startswith("/proxy/"):
+                is_proxy = parsed.path.startswith("/proxy/")
+                token = self._auth_token(parsed, proxy=is_proxy)
+                if is_proxy:
                     # Raw pass-through to a task service. Same auth gate as
                     # the API (the reference authenticates proxy traffic via
                     # session cookies; we accept cookie/query tokens too).

@@ -197,9 +197,14 @@ class Master:
         agent_timeout_s: float = 120.0,
         unmanaged_timeout_s: float = 300.0,
         users: Optional[Dict[str, str]] = None,
+        config_defaults: Optional[Dict[str, Any]] = None,
     ) -> None:
         self.cluster_id = uuid.uuid4().hex[:8]
         self.external_url = external_url
+        # Cluster-admin experiment-config defaults (the reference's
+        # task_container_defaults + cluster-level checkpoint_storage in
+        # master.yaml), merged under every submitted config at create time.
+        self.config_defaults: Dict[str, Any] = config_defaults or {}
         self.db = db_mod.Database(db_path)
         self.rm = ResourceManager(pools_config)
         self.alloc_service = AllocationService(preempt_timeout_s=preempt_timeout_s)
@@ -441,9 +446,12 @@ class Master:
     def create_experiment(self, config: Dict[str, Any]) -> int:
         from determined_tpu.master import expconf
 
-        errors = expconf.validate(config)
-        if errors:
-            raise ValueError("invalid experiment config: " + "; ".join(errors))
+        # Shim old versions forward, merge cluster + builtin defaults under
+        # the submitted config, validate; the MERGED config is what's stored
+        # (and echoed by get_experiment) — what you read is what runs.
+        config, shim_notes = expconf.apply(config, self.config_defaults)
+        for note in shim_notes:
+            logger.info("experiment config shim: %s", note)
         exp_id = self.db.add_experiment(config)
         if config.get("project_id"):
             self.db.set_experiment_project(exp_id, int(config["project_id"]))

@@ -1,15 +1,26 @@
-"""Experiment-config validation: reject bad configs at submission.
+"""Experiment-config pipeline: shim → merge defaults → validate.
 
 Rebuild of the reference's expconf schema layer (`schemas/expconf/v0/*.json`
-+ cluster-side validation in `master/pkg/schemas`) scaled to hand-rolled
-checks: the JSON-schema/codegen machinery is overkill at this config size,
-but the user-facing property is the same — a bad config fails at
-`experiment create` with a list of specific errors, not as a cryptic trial
-crash minutes later.
++ cluster-side merge in `master/pkg/schemas/schemas.go` + versioned shims in
+`master/pkg/schemas/expconf/legacy.go`) scaled to hand-rolled checks: the
+JSON-schema/codegen machinery is overkill at this config size, but the
+user-facing properties are the same —
+
+- a bad config fails at `experiment create` with a list of specific errors,
+  not as a cryptic trial crash minutes later;
+- cluster-admin defaults are merged UNDER the submitted config at create
+  time (submitted values win; dicts merge recursively, lists and scalars
+  replace — the reference's schemas.Merge semantics), and the stored config
+  echoes the fully-merged result so `get_experiment` shows what will run;
+- old config versions are shimmed forward at submission, so an upgrade
+  never strands yesterday's yaml.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List
+import copy
+from typing import Any, Dict, List, Tuple
+
+CURRENT_VERSION = 1
 
 KNOWN_SEARCHERS = {"single", "random", "grid", "asha", "adaptive_asha", "custom"}
 NEEDS_MAX_TRIALS = {"random", "asha", "adaptive_asha"}
@@ -63,6 +74,120 @@ def _check_hparams(space: Dict[str, Any], prefix: str, errors: List[str]) -> Non
                 errors.append(
                     f"hyperparameters.{path}: minval > maxval"
                 )
+
+
+# Framework-level defaults (the reference's expconf field defaults, e.g.
+# `schemas/expconf/v0/experiment.json` "default" annotations). Cluster
+# defaults merge on top of these; the submitted config on top of those.
+# checkpoint_storage is deliberately absent: a partial storage default (say,
+# save_* counts without host_path) would manufacture an invalid config for
+# users who submitted none.
+BUILTIN_DEFAULTS: Dict[str, Any] = {
+    "version": CURRENT_VERSION,
+    "searcher": {"name": "single"},
+    "resources": {"slots_per_trial": 1, "priority": 50},
+    "max_restarts": 5,
+    "scheduling_unit": 100,
+}
+
+
+def merge(submitted: Any, defaults: Any) -> Any:
+    """Merge `defaults` under `submitted` (submitted wins).
+
+    The reference's schemas.Merge semantics (`master/pkg/schemas/
+    schemas.go`): objects merge recursively; arrays and scalars from the
+    submitted config replace the default wholesale. `hyperparameters` is
+    NOT special-cased — a cluster default there fills in like anything
+    else (matching the reference, which merges uniformly).
+    """
+    if isinstance(submitted, dict) and isinstance(defaults, dict):
+        out = {k: copy.deepcopy(v) for k, v in defaults.items()}
+        for k, v in submitted.items():
+            out[k] = merge(v, defaults.get(k)) if k in defaults else copy.deepcopy(v)
+        return out
+    if submitted is None:
+        return copy.deepcopy(defaults)
+    return copy.deepcopy(submitted)
+
+
+def shim(config: Dict[str, Any]) -> Tuple[Dict[str, Any], List[str]]:
+    """Upgrade an old-version config to CURRENT_VERSION in place-ish.
+
+    Returns (new_config, notes) where notes describe each rewrite (they go
+    to the experiment log so users learn the new spelling). The analog of
+    the reference's `expconf/legacy.go` shims (adaptive/adaptive_simple →
+    adaptive_asha, step-based lengths → batches). Raises ValueError for
+    versions newer than this master understands.
+    """
+    version = config.get("version", 0 if _looks_v0(config) else CURRENT_VERSION)
+    if not isinstance(version, int) or version < 0:
+        raise ValueError(f"config version must be a non-negative int, got {version!r}")
+    if version > CURRENT_VERSION:
+        raise ValueError(
+            f"config version {version} is newer than this master supports "
+            f"(max {CURRENT_VERSION}); upgrade the master"
+        )
+    out = copy.deepcopy(config)
+    notes: List[str] = []
+    if version < 1:
+        searcher = out.get("searcher")
+        if isinstance(searcher, dict):
+            name = searcher.get("name")
+            if name in ("adaptive", "adaptive_simple"):
+                searcher["name"] = "adaptive_asha"
+                notes.append(
+                    f"searcher.name {name!r} is the v0 spelling; "
+                    "shimmed to 'adaptive_asha'"
+                )
+            if "max_steps" in searcher and "max_length" not in searcher:
+                searcher["max_length"] = searcher.pop("max_steps")
+                notes.append(
+                    "searcher.max_steps is the v0 spelling; shimmed to "
+                    "max_length (batches)"
+                )
+        storage = out.get("checkpoint_storage")
+        if isinstance(storage, dict) and storage.get("type") == "google_cloud_storage":
+            storage["type"] = "gcs"
+            notes.append(
+                "checkpoint_storage.type 'google_cloud_storage' is the v0 "
+                "spelling; shimmed to 'gcs'"
+            )
+    out["version"] = CURRENT_VERSION
+    return out, notes
+
+
+def _looks_v0(config: Dict[str, Any]) -> bool:
+    """Versionless configs are assumed current UNLESS they use a v0-only
+    spelling — then we shim rather than reject, so pre-versioning yamls
+    keep working across the upgrade."""
+    searcher = config.get("searcher")
+    if isinstance(searcher, dict):
+        if searcher.get("name") in ("adaptive", "adaptive_simple"):
+            return True
+        if "max_steps" in searcher:
+            return True
+    storage = config.get("checkpoint_storage")
+    if isinstance(storage, dict) and storage.get("type") == "google_cloud_storage":
+        return True
+    return False
+
+
+def apply(
+    config: Dict[str, Any],
+    cluster_defaults: Dict[str, Any] | None = None,
+) -> Tuple[Dict[str, Any], List[str]]:
+    """Full submission pipeline: shim → merge cluster + builtin defaults →
+    validate. Returns (merged_config, shim_notes); raises ValueError with
+    the full error list on an invalid config."""
+    if not isinstance(config, dict):
+        raise ValueError("invalid experiment config: config must be a JSON object")
+    shimmed, notes = shim(config)
+    defaults = merge(cluster_defaults or {}, BUILTIN_DEFAULTS)
+    merged = merge(shimmed, defaults)
+    errors = validate(merged)
+    if errors:
+        raise ValueError("invalid experiment config: " + "; ".join(errors))
+    return merged, notes
 
 
 def validate(config: Dict[str, Any]) -> List[str]:

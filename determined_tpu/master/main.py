@@ -29,6 +29,10 @@ def main() -> None:
     parser.add_argument("--pools", default=None,
                         help='JSON pools config, e.g. {"default":{"scheduler":{"type":"priority"}}}')
     parser.add_argument("--preempt-timeout", type=float, default=600.0)
+    parser.add_argument(
+        "--config-defaults", default=None,
+        help="JSON experiment-config defaults merged under every submitted "
+             'config (master.yaml analog), e.g. {"max_restarts": 2}')
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -36,6 +40,9 @@ def main() -> None:
     master = Master(
         db_path=args.db, pools_config=pools,
         preempt_timeout_s=args.preempt_timeout,
+        config_defaults=(
+            json.loads(args.config_defaults) if args.config_defaults else None
+        ),
     )
     api = ApiServer(master, host=args.host, port=args.port)
     master.external_url = args.external_url or f"http://127.0.0.1:{api.port}"

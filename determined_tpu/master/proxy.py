@@ -59,14 +59,18 @@ def _strip_master_credentials(headers: Dict[str, str]) -> Dict[str, str]:
 
 
 def _strip_token_query(query: str) -> str:
-    """Remove the master auth `token=` parameter from a query string (the
-    browser/CLI uses it because it can't set headers); everything else —
-    e.g. the shell task's own shell_token — passes through."""
+    """Remove the master auth `dtpu_token=` query parameter (the CLI's
+    upgrade handshake uses it because raw sockets can't set cookies).
+    Everything else passes through untouched — notably Jupyter's own
+    `token=` param, which shares a browser-friendly name with nothing of
+    ours on purpose (stripping `token` would break the documented
+    `/proxy/<task>/lab?token=<jupyter-token>` flow), and the shell task's
+    `shell_token`."""
     if not query:
         return query
     kept = [
         part for part in query.split("&")
-        if part.partition("=")[0] != "token"
+        if part.partition("=")[0] != "dtpu_token"
     ]
     return "&".join(kept)
 

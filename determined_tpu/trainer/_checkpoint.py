@@ -17,7 +17,8 @@ from __future__ import annotations
 import json
 import os
 import re
-from typing import Any, Dict, List, Optional
+import threading
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import numpy as np
@@ -40,19 +41,21 @@ def _leaf_name(path) -> str:
     return re.sub(r"[^A-Za-z0-9_.-]", "_", name)
 
 
-def save_pytree(tree: Any, directory: str) -> List[str]:
-    """Write every addressable leaf of `tree` under `directory`.
+def snapshot_pytree(tree: Any) -> Dict[str, np.ndarray]:
+    """Device→host snapshot of every addressable leaf of `tree`.
 
-    Returns the list of files this process wrote (for sharded upload).
+    This is the only part of a save that must block the step loop: once the
+    arrays are host numpy, serialization and upload can proceed on a
+    background thread while training continues (the state buffers are
+    donated to the next step, so we must copy before it runs). Returns
+    {filename (sans .npy): array}.
     """
-    os.makedirs(directory, exist_ok=True)
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
-    written: List[str] = []
     names = [_leaf_name(path) for path, _ in leaves]
     if len(set(names)) != len(names):
         raise ValueError("pytree keypaths collide after sanitization")
+    snap: Dict[str, np.ndarray] = {}
     for (path, leaf), name in zip(leaves, names):
-        fname = f"{name}.npy"
         if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
             # Save only shards this host owns; fully-addressable arrays are
             # the single-host case below.
@@ -62,21 +65,88 @@ def save_pytree(tree: Any, directory: str) -> List[str]:
                 idx = "_".join(
                     f"{s.start or 0}" for s in shard.index if isinstance(s, slice)
                 )
-                sname = f"{name}.shard{idx}.npy"
-                np.save(os.path.join(directory, sname), np.asarray(shard.data))
-                written.append(sname)
+                snap[f"{name}.shard{idx}"] = np.asarray(shard.data)
             continue
-        np.save(os.path.join(directory, fname), np.asarray(jax.device_get(leaf)))
-        written.append(fname)
+        snap[name] = np.asarray(jax.device_get(leaf))
+    return snap
+
+
+def write_snapshot(snap: Dict[str, np.ndarray], directory: str) -> List[str]:
+    """Serialize a host snapshot to `directory`; returns files written."""
+    os.makedirs(directory, exist_ok=True)
+    written: List[str] = []
+    for name, arr in snap.items():
+        np.save(os.path.join(directory, f"{name}.npy"), arr)
+        written.append(f"{name}.npy")
     if jax.process_index() == 0:
+        leaf_names = sorted({n.split(".shard")[0] for n in snap})
         manifest = {
-            "leaves": names,
+            "leaves": leaf_names,
             "structure": "keypath-flat-v1",
         }
         with open(os.path.join(directory, MANIFEST), "w") as f:
             json.dump(manifest, f)
         written.append(MANIFEST)
     return written
+
+
+def save_pytree(tree: Any, directory: str) -> List[str]:
+    """Write every addressable leaf of `tree` under `directory`.
+
+    Returns the list of files this process wrote (for sharded upload).
+    Synchronous convenience path: snapshot + write in one call.
+    """
+    return write_snapshot(snapshot_pytree(tree), directory)
+
+
+class AsyncCheckpointWriter:
+    """Single-lane background checkpoint pipeline (orbax AsyncCheckpointer
+    semantics): `submit(work)` runs `work` on a daemon thread; at most one
+    save is in flight, so a second `submit` (or `wait`) first joins the
+    previous one. Exceptions surface at the next `wait()`/`submit()` rather
+    than being lost — a failed checkpoint must fail the run, not pass
+    silently.
+
+    On a multi-host pod every process drives its own writer and `work`
+    typically ends in a collective `CheckpointContext.upload(shard=True)`;
+    the single-lane rule keeps those collectives matched across hosts
+    (saves are issued in step order on every host).
+    """
+
+    def __init__(self) -> None:
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._result: Any = None
+
+    @property
+    def in_flight(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def submit(self, work: Callable[[], Any]) -> None:
+        self.wait()
+
+        def run() -> None:
+            try:
+                self._result = work()
+            except BaseException as e:  # noqa: BLE001 — repropagated in wait()
+                self._error = e
+
+        self._thread = threading.Thread(
+            target=run, name="dtpu-ckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    def wait(self) -> Any:
+        """Block until the in-flight save (if any) finishes; return its
+        result. Raises if it failed."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+        result, self._result = self._result, None
+        return result
 
 
 def load_pytree(directory: str, like: Any, shardings: Optional[Any] = None) -> Any:

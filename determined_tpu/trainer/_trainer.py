@@ -80,6 +80,7 @@ class Trainer:
         self._state: Optional[Dict[str, Any]] = None
         self._step_fn = None
         self._eval_fn = None
+        self._ckpt_writer = ckpt_io.AsyncCheckpointWriter()
 
         # Observability (chief-only): system/device metrics to the master
         # (ref ProfilerAgent) + tfevents scalars for TensorBoard.
@@ -215,26 +216,50 @@ class Trainer:
         return jax.tree.map(put, batch)
 
     # -- checkpoint --------------------------------------------------------
-    def _save_checkpoint(self) -> str:
-        state = self.state
+    def _save_checkpoint(self, *, sync: bool = False) -> Optional[str]:
+        """Checkpoint the train state.
+
+        Async by default: the step loop blocks only for the device→host
+        snapshot (plus joining any still-running previous save); .npy
+        serialization and the (possibly collective) storage upload run on a
+        background thread. `sync=True` waits and returns the storage_id —
+        used at preemption/exit where the process must not die with an
+        upload in flight.
+        """
+        # Join any in-flight save BEFORE snapshotting: the old snapshot is
+        # still referenced by its work() closure, and holding two full host
+        # copies of model+optimizer state can OOM the host.
+        self._ckpt_writer.wait()
         steps = self.steps_completed
+        snapshot = ckpt_io.snapshot_pytree(self.state)
         sharded = jax.process_count() > 1 or self.core.distributed.size > 1
-        with tempfile.TemporaryDirectory() as tmp:
-            written = ckpt_io.save_pytree(state, tmp)
-            if self.core.distributed.is_chief:
-                with open(os.path.join(tmp, TRAINER_METADATA), "w") as f:
-                    json.dump({"steps_completed": steps, "seed": self.seed}, f)
-                written.append(TRAINER_METADATA)
-            storage_id = self.core.checkpoint.upload(
-                tmp,
-                metadata={"steps_completed": steps},
-                shard=sharded,
-                paths=written,
-            )
-        logger.info("saved checkpoint %s at step %d", storage_id, steps)
-        return storage_id
+        is_chief = self.core.distributed.is_chief
+        checkpoint_ctx = self.core.checkpoint
+        seed = self.seed
+
+        def work() -> str:
+            with tempfile.TemporaryDirectory() as tmp:
+                written = ckpt_io.write_snapshot(snapshot, tmp)
+                if is_chief:
+                    with open(os.path.join(tmp, TRAINER_METADATA), "w") as f:
+                        json.dump({"steps_completed": steps, "seed": seed}, f)
+                    written.append(TRAINER_METADATA)
+                storage_id = checkpoint_ctx.upload(
+                    tmp,
+                    metadata={"steps_completed": steps},
+                    shard=sharded,
+                    paths=written,
+                )
+            logger.info("saved checkpoint %s at step %d", storage_id, steps)
+            return storage_id
+
+        self._ckpt_writer.submit(work)
+        if sync:
+            return self._ckpt_writer.wait()
+        return None
 
     def _restore_checkpoint(self, storage_id: str) -> None:
+        self._ckpt_writer.wait()  # never read while a save is in flight
         state = self.state  # materialize to know structure + shardings
         shardings = jax.tree.map(lambda x: x.sharding, state)
         with self.core.checkpoint.restore_path(storage_id) as path:
@@ -299,12 +324,28 @@ class Trainer:
         if self._step_fn is None:
             self._step_fn = self._build_step_fn()
 
-        train_iter = iter(self.trial.build_training_data())
         # Fast-forward the stream past batches consumed before the restored
         # checkpoint, so resumed training sees the same data order as an
         # uninterrupted run (ref: pytorch/samplers.py skip-batch samplers).
-        for _ in range(self.steps_completed):
-            next(train_iter)
+        # Datasets exposing .skip(n_batches) (TokenDataset, the native
+        # loader) fast-forward in O(1); otherwise assemble-and-discard.
+        train_data = self.trial.build_training_data()
+        resume_steps = self.steps_completed
+        skipped = False
+        if resume_steps and hasattr(train_data, "skip"):
+            # In-place contract: skip() mutates and returns None (our
+            # datasets) or self (fluent style) — both count as skipped.
+            # A skip() returning a NEW object (e.g. tf.data's, which is
+            # non-mutating and counts elements rather than batches) falls
+            # back to discard; the probe was a no-op on the original, so
+            # the fallback never double-skips.
+            result = train_data.skip(resume_steps)
+            if result is None or result is train_data:
+                skipped = True
+        train_iter = iter(train_data)
+        if not skipped:
+            for _ in range(resume_steps):
+                next(train_iter)
         pending: List[Any] = []  # on-device metrics since last report
         last_val: Dict[str, float] = {}
         t_report = time.time()
@@ -340,66 +381,85 @@ class Trainer:
         if self._profiler is not None:
             self._profiler.start()
 
-        for op in searcher.operations():
-            target = to_batches(op.length, bpe)
-            while step < target:
-                batch = self._put_batch(next(train_iter))
-                self._state, metrics = self._step_fn(self.state, batch)
-                pending.append(metrics)
-                step += 1
+        # The finally-join below keeps a raising step loop from abandoning
+        # an in-flight background save: the daemon writer thread would
+        # otherwise run its checkpoint-channel collectives against a core
+        # context the caller is already tearing down, and its failure (or a
+        # half-registered checkpoint) would go unreported.
+        try:
+            fit_error = None
+            for op in searcher.operations():
+                target = to_batches(op.length, bpe)
+                while step < target:
+                    batch = self._put_batch(next(train_iter))
+                    self._state, metrics = self._step_fn(self.state, batch)
+                    pending.append(metrics)
+                    step += 1
 
-                boundary = step % rep_period == 0 or step == target
-                if boundary:
-                    flush_report()
-                    if self.core.distributed.is_chief:
-                        op.report_progress(float(step))
-                if val_period and step % val_period == 0 and step < target:
-                    last_val = self._validate()
-                    if last_val and self.core.distributed.is_chief:
-                        self.core.train.report_validation_metrics(step, last_val)
-                        self._tb_scalars(step, last_val, prefix="val_")
-                if ckpt_period and step % ckpt_period == 0:
-                    flush_report()
-                    self._save_checkpoint()
-                    last_ckpt_step = step
-                    self._tb_sync()
-                # Preemption is a collective (ZMQ broadcast) — checking every
-                # batch would put a TCP roundtrip in the hot loop, so it
-                # shares the report boundary (the reference's analog knob is
-                # scheduling_unit granularity).
-                if boundary and self.core.preempt.should_preempt():
-                    flush_report()
-                    self._save_checkpoint()
-                    last_ckpt_step = step
-                    logger.info("preempted at step %d; exiting cleanly", step)
-                    preempted = True
+                    boundary = step % rep_period == 0 or step == target
+                    if boundary:
+                        flush_report()
+                        if self.core.distributed.is_chief:
+                            op.report_progress(float(step))
+                    if val_period and step % val_period == 0 and step < target:
+                        last_val = self._validate()
+                        if last_val and self.core.distributed.is_chief:
+                            self.core.train.report_validation_metrics(step, last_val)
+                            self._tb_scalars(step, last_val, prefix="val_")
+                    if ckpt_period and step % ckpt_period == 0:
+                        flush_report()
+                        self._save_checkpoint()
+                        last_ckpt_step = step
+                        self._tb_sync()
+                    # Preemption is a collective (ZMQ broadcast) — checking every
+                    # batch would put a TCP roundtrip in the hot loop, so it
+                    # shares the report boundary (the reference's analog knob is
+                    # scheduling_unit granularity).
+                    if boundary and self.core.preempt.should_preempt():
+                        flush_report()
+                        self._save_checkpoint(sync=True)
+                        last_ckpt_step = step
+                        logger.info("preempted at step %d; exiting cleanly", step)
+                        preempted = True
+                        break
+                if preempted:
                     break
-            if preempted:
-                break
 
-            flush_report()
-            last_val = self._validate()
-            if self.core.distributed.is_chief:
-                if last_val:
-                    self.core.train.report_validation_metrics(
-                        self.steps_completed, last_val
-                    )
-                    self._tb_scalars(self.steps_completed, last_val, prefix="val_")
-                # Throughput is a first-class searcher metric (mesh/batch
-                # autotuning sweeps maximize it); validation metrics win on
-                # name collision.
-                completion = {
-                    "batches_per_second": getattr(self, "_last_throughput", 0.0),
-                    **last_val,
-                }
-                metric = completion.get(self.searcher_metric, 0.0)
-                op.report_completed(float(metric))
+                flush_report()
+                last_val = self._validate()
+                if self.core.distributed.is_chief:
+                    if last_val:
+                        self.core.train.report_validation_metrics(
+                            self.steps_completed, last_val
+                        )
+                        self._tb_scalars(self.steps_completed, last_val, prefix="val_")
+                    # Throughput is a first-class searcher metric (mesh/batch
+                    # autotuning sweeps maximize it); validation metrics win on
+                    # name collision.
+                    completion = {
+                        "batches_per_second": getattr(self, "_last_throughput", 0.0),
+                        **last_val,
+                    }
+                    metric = completion.get(self.searcher_metric, 0.0)
+                    op.report_completed(float(metric))
 
-        if (
-            (ckpt_period or preempted or self.core.info is not None)
-            and last_ckpt_step != step
-        ):
-            self._save_checkpoint()
+            if (
+                (ckpt_period or preempted or self.core.info is not None)
+                and last_ckpt_step != step
+            ):
+                self._save_checkpoint(sync=True)
+        except BaseException as e:
+            fit_error = e
+            raise
+        finally:
+            try:
+                self._ckpt_writer.wait()  # surface any failed background save
+            except BaseException:
+                if fit_error is None:
+                    raise
+                # The loop's own exception is the primary failure; log the
+                # checkpoint one rather than masking it.
+                logger.exception("background checkpoint failed during teardown")
         if self._profiler is not None:
             self._profiler.stop()
         self._tb_sync()

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from determined_tpu.trainer._checkpoint import (
+    AsyncCheckpointWriter,
     _assemble_shards,
     load_pytree,
     save_pytree,
@@ -47,3 +48,45 @@ class TestShardReassembly:
         np.save(tmp_path / "a.shard0.npy", np.zeros(4, np.float32))
         with pytest.raises(ValueError, match="incomplete"):
             _assemble_shards(str(tmp_path), "a", jnp.zeros(8, jnp.float32))
+
+
+class TestAsyncWriter:
+    def test_background_result(self):
+        import threading
+
+        w = AsyncCheckpointWriter()
+        started = threading.Event()
+        release = threading.Event()
+
+        def work():
+            started.set()
+            release.wait(timeout=5)
+            return "ckpt-1"
+
+        w.submit(work)
+        assert started.wait(timeout=5)
+        assert w.in_flight  # submit returned while work still running
+        release.set()
+        assert w.wait() == "ckpt-1"
+        assert not w.in_flight
+
+    def test_single_lane_ordering(self):
+        order = []
+        w = AsyncCheckpointWriter()
+        w.submit(lambda: order.append("first"))
+        w.submit(lambda: order.append("second"))  # joins the first
+        w.wait()
+        assert order == ["first", "second"]
+
+    def test_error_surfaces_at_wait(self):
+        w = AsyncCheckpointWriter()
+
+        def boom():
+            raise RuntimeError("upload failed")
+
+        w.submit(boom)
+        with pytest.raises(RuntimeError, match="upload failed"):
+            w.wait()
+        # Error is consumed: the writer is reusable afterwards.
+        w.submit(lambda: 7)
+        assert w.wait() == 7

@@ -29,6 +29,43 @@ def test_broadcast():
     assert all(res == {"payload": 42} for res in out)
 
 
+def test_concurrent_channels():
+    """Collectives on different channels may run from different threads
+    concurrently without stealing each other's frames — the contract the
+    async checkpoint writer relies on (its collective upload rides the
+    'checkpoint' channel while the step loop broadcasts preemption flags
+    on 'main')."""
+    import threading
+
+    def fn(ctx):
+        results = {}
+
+        def ckpt_thread():
+            # Background "checkpoint": broadcast + gather + barrier on its
+            # own channel, deliberately racing the main-channel traffic.
+            for i in range(20):
+                sid = ctx.broadcast(
+                    f"ckpt-{i}" if ctx.is_chief else None, channel="checkpoint"
+                )
+                gathered = ctx.gather((ctx.rank, sid), channel="checkpoint")
+                if ctx.is_chief:
+                    assert [g[1] for g in gathered] == [sid] * ctx.size
+                ctx.barrier(channel="checkpoint")
+            results["ckpt"] = True
+
+        t = threading.Thread(target=ckpt_thread)
+        t.start()
+        flags = [ctx.broadcast(i if ctx.is_chief else None) for i in range(50)]
+        t.join(timeout=30)
+        assert not t.is_alive(), "checkpoint-channel thread hung"
+        return flags, results.get("ckpt")
+
+    out = run_parallel(3, fn)
+    for flags, ckpt_ok in out:
+        assert flags == list(range(50))
+        assert ckpt_ok is True
+
+
 def test_barrier_and_repeated_collectives():
     def fn(ctx):
         acc = []

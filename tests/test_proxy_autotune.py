@@ -77,6 +77,33 @@ class TestProxy:
         finally:
             srv.shutdown()
 
+    def test_jupyter_token_passes_dtpu_token_stripped(self, live):
+        """The proxied service owns the `token=` query param (Jupyter
+        authenticates with it); only the master's `dtpu_token=` is consumed
+        and stripped before forwarding."""
+        master, api = live
+        srv = _backend_server(b"path:")
+        try:
+            master.alloc_service.create(
+                "nb.2.0", task_id="cmd-q", trial_id=None,
+                num_processes=1, slots=0,
+            )
+            requests.post(
+                f"{api.url}/api/v1/allocations/nb.2.0/proxy",
+                json={"host": "127.0.0.1", "port": srv.server_address[1]},
+                timeout=10,
+            ).raise_for_status()
+            r = requests.get(
+                f"{api.url}/proxy/cmd-q/lab?token=jup-tok&dtpu_token=sess&a=1",
+                timeout=10,
+            )
+            assert r.status_code == 200
+            assert "token=jup-tok" in r.text  # Jupyter's token forwarded
+            assert "a=1" in r.text
+            assert "sess" not in r.text  # master credential stripped
+        finally:
+            srv.shutdown()
+
     def test_unknown_target_502(self, live):
         master, api = live
         r = requests.get(f"{api.url}/proxy/nope/", timeout=10)
