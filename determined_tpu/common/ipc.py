@@ -203,6 +203,10 @@ class ChiefServer:
         self._closed = True
         if self._receiver is not None:
             self._receiver.thread.join(timeout=5)
+        # Wake any thread still blocked in gather(): after close nothing
+        # will ever notify its condition (pre-rewrite, the socket teardown
+        # itself failed the blocked recv).
+        self._inbox.die(RuntimeError("IPC endpoint closed"))
         # Bounded linger: lets in-flight frames flush from the IO thread
         # without pinning dead sockets forever. linger=0 here would race
         # with delivery of the last send.
@@ -253,5 +257,6 @@ class WorkerClient:
     def close(self) -> None:
         self._closed = True
         self._receiver.thread.join(timeout=5)
+        self._inbox.die(RuntimeError("IPC endpoint closed"))
         with self._sock_lock:
             self._sock.close(linger=10_000)

@@ -76,15 +76,13 @@ def attention(
     if impl == "ring":
         if mesh is None:
             raise ValueError("ring attention needs a mesh")
-        spec = P(BATCH_AXES, "context", "tensor", None)
+        # make_ring_attention handles zigzag placement (permute in, ring
+        # with balanced causal work, permute out). The gathers stay inside
+        # this jitted program; pipelines that pre-zigzag their data should
+        # call ring_attention directly in their own shard_map.
+        from determined_tpu.parallel.ring import make_ring_attention
 
-        def local(q_, k_, v_):
-            return ring_attention(q_, k_, v_, axis_name="context", causal=causal)
-
-        return shard_map(
-            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False,
-        )(q, k, v)
+        return make_ring_attention(mesh, causal=causal)(q, k, v)
 
     if impl == "ulysses":
         # All-to-all head<->sequence swap: each device runs full-sequence

@@ -166,8 +166,9 @@ def _flash_fwd_pallas(
 # over the k-block grid dimension, dk/dv over the q-block dimension. All
 # MXU dots take bf16 inputs with fp32 accumulation.
 # ---------------------------------------------------------------------------
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, scale, causal, block_q, block_k, num_k_blocks):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
+                   dq_ref, dq_scr, *, scale, causal, block_q, block_k,
+                   num_k_blocks):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -182,6 +183,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         do = do_ref[0]  # [bq, d]
         lse = lse_ref[0].reshape(block_q, 1)    # [bq, 1] fp32
         delta = delta_ref[0].reshape(block_q, 1)
+        dlse = dlse_ref[0].reshape(block_q, 1)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -192,7 +194,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        # dL/ds = p∘(dp − delta + dlse): the dlse term is the cotangent of
+        # the returned log-sum-exp (dlse/ds_k = p_k), which ring attention
+        # feeds back through its partial-softmax merge.
+        ds = (p * (dp - delta + dlse) * scale).astype(q.dtype)
         dq_scr[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -204,7 +209,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *,
                     scale, causal, block_q, block_k, num_q_blocks):
     ki = pl.program_id(1)
@@ -222,6 +227,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]  # [bq, d]
         lse = lse_ref[0].reshape(block_q, 1)
         delta = delta_ref[0].reshape(block_q, 1)
+        dlse = dlse_ref[0].reshape(block_q, 1)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -237,7 +243,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        ds = (p * (dp - delta + dlse) * scale).astype(q.dtype)
         dk_scr[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),    # dsᵀ·q → [bk, d]
             preferred_element_type=jnp.float32,
@@ -252,8 +258,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
-                      interpret=False):
-    """q/k/v/o/do: [BH, S, D], lse: [BH, S] fp32 → (dq, dk, dv)."""
+                      interpret=False, dlse=None):
+    """q/k/v/o/do: [BH, S, D], lse (+optional dlse): [BH, S] fp32 →
+    (dq, dk, dv)."""
     from jax.experimental.pallas import tpu as pltpu
 
     bh, s_q, d = q.shape
@@ -263,8 +270,11 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     )  # [BH, Sq]
+    if dlse is None:
+        dlse = jnp.zeros_like(lse)
     lse3 = lse.reshape(bh, 1, s_q)
     delta3 = delta.reshape(bh, 1, s_q)
+    dlse3 = dlse.astype(jnp.float32).reshape(bh, 1, s_q)
 
     row_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # q
@@ -273,6 +283,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # do
         pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),   # lse
         pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),   # delta
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),   # dlse
     ]
     dq = pl.pallas_call(
         functools.partial(
@@ -285,7 +296,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
         out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse3, delta3)
+    )(q, k, v, do, lse3, delta3, dlse3)
 
     col_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # q
@@ -294,6 +305,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
         pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # do
         pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # lse
         pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # delta
+        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # dlse
     ]
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -315,7 +327,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse3, delta3)
+    )(q, k, v, do, lse3, delta3, dlse3)
     return dq, dk, dv
 
 
@@ -363,7 +375,8 @@ def _blockwise_fwd_ref(q, k, v, *, scale, causal, block_k):
     return o, lse
 
 
-def _blockwise_bwd_ref(q, k, v, o, lse, do, *, scale, causal, block_k):
+def _blockwise_bwd_ref(q, k, v, o, lse, do, *, scale, causal, block_k,
+                       dlse=None):
     """Flash backward: recompute per-block p from lse; O(S·block) memory."""
     bh, s_q, d = q.shape
     s_k = k.shape[1]
@@ -373,6 +386,10 @@ def _blockwise_bwd_ref(q, k, v, o, lse, do, *, scale, causal, block_k):
     rows = jnp.arange(s_q)
     do32 = do.astype(jnp.float32)
     delta = jnp.sum(do32 * o.astype(jnp.float32), axis=-1)  # [BH, Sq]
+    if dlse is not None:
+        # lse-cotangent folds into the same p∘(·) term as delta (see the
+        # Pallas dq kernel); keeping them combined avoids a second pass.
+        delta = delta - dlse.astype(jnp.float32)
 
     def step(dq_acc, blk):
         k_j, v_j, j = blk
@@ -406,9 +423,11 @@ def _use_pallas() -> bool:
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, block_q, block_k):
-    o, _ = _flash_core(q, k, v, scale, causal, block_q, block_k)
-    return o
+def _flash_lse(q, k, v, scale, causal, block_q, block_k):
+    """Differentiable (o, lse): the lse cotangent feeds the ds term in the
+    backward (ring attention differentiates through its partial-softmax
+    merge, which weights partials by exp(lse_i − lse_total))."""
+    return _flash_core(q, k, v, scale, causal, block_q, block_k)
 
 
 def _flash_core(q, k, v, scale, causal, block_q, block_k):
@@ -420,24 +439,31 @@ def _flash_core(q, k, v, scale, causal, block_q, block_k):
     return _blockwise_fwd_ref(q, k, v, scale=scale, causal=causal, block_k=block_k)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+def _flash_lse_fwd(q, k, v, scale, causal, block_q, block_k):
     o, lse = _flash_core(q, k, v, scale, causal, block_q, block_k)
-    return o, (q, k, v, o, lse)
+    return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, res, do):
+def _flash_lse_bwd(scale, causal, block_q, block_k, res, cts):
     q, k, v, o, lse = res
+    do, dlse = cts
     if _use_pallas():
         return _flash_bwd_pallas(
             q, k, v, o, lse, do, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, dlse=dlse,
         )
     return _blockwise_bwd_ref(
-        q, k, v, o, lse, do, scale=scale, causal=causal, block_k=block_k
+        q, k, v, o, lse, do, scale=scale, causal=causal, block_k=block_k,
+        dlse=dlse,
     )
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    o, _ = _flash_lse(q, k, v, scale, causal, block_q, block_k)
+    return o
 
 
 def flash_attention(
@@ -478,3 +504,43 @@ def flash_attention(
 
     o = _flash(fold(q), fold(k), fold(v), scale, causal, block_q, block_k)
     return o.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+) -> Tuple[jax.Array, jax.Array]:
+    """flash_attention that also returns the log-sum-exp per query.
+
+    q/k/v: [B, S, H, D] → (o [B, Sq, H, D], lse [B, Sq, H] fp32). Both
+    outputs are differentiable — this is the inner kernel for ring
+    attention, whose cross-device merge needs (o, lse) partials.
+    """
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    if causal and s_q != s_k:
+        raise ValueError(
+            f"causal flash attention requires s_q == s_k, got ({s_q}, {s_k})"
+        )
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_k)
+    if s_q % block_q or s_k % block_k:
+        raise ValueError(
+            f"seq lengths ({s_q}, {s_k}) must be divisible by blocks "
+            f"({block_q}, {block_k})"
+        )
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    o, lse = _flash_lse(fold(q), fold(k), fold(v), scale, causal, block_q, block_k)
+    o = o.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    lse = lse.reshape(b, h, s_q).transpose(0, 2, 1)
+    return o, lse

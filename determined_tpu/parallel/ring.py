@@ -2,58 +2,113 @@
 
 Net-new vs. the reference, which had no sequence/context parallelism at all
 (SURVEY.md §2.5: "Absent — no hits for ring/ulysses/sequence-parallel").
-Design follows the Ring Attention pattern: each device owns one contiguous
-sequence chunk of Q/K/V; K/V chunks rotate around the ring via `ppermute`
-while every device accumulates blockwise attention for its Q chunk with a
-running log-sum-exp (numerically exact, not approximate).
+Design follows the Ring Attention pattern: each device owns one sequence
+chunk of Q/K/V; K/V chunks rotate around the ring via `ppermute` while every
+device merges blockwise-softmax partials for its Q chunk (numerically exact,
+not approximate).
+
+Three properties matter for TPU throughput:
+
+- the per-block inner attention is the Pallas flash kernel
+  (`determined_tpu.ops.flash_attention.flash_attention_lse`), so every ring
+  step runs fused MXU attention with fp32 accumulation — not an einsum that
+  materializes [B, H, Sq, Sk] scores;
+- with `layout="zigzag"` each device owns global chunks (i, 2R−1−i), which
+  makes causal work *identical* on every ring step and every device (2
+  half-chunk attends per step); the naive contiguous layout leaves device
+  R−1 doing R× the work of device 0 and forces compute-then-discard steps;
+- steps (or step-parts) that cannot contribute are skipped via `lax.switch`
+  on the kv chunk's origin, not computed-and-masked.
 
 Communication rides ICI neighbor links (ppermute), overlapping with the
-per-step attention compute; peak memory is O(S_local²) per step instead of
-O(S²) — this is what makes million-token contexts feasible on a pod.
-
-The inner per-block attention is einsum-based here; `attn_impl` exists so the
-Pallas flash kernel (determined_tpu.ops.flash_attention) can be swapped in
-for the fused MXU path.
+per-step attention compute; peak memory is O(S_local·block) per step instead
+of O(S²) — this is what makes million-token contexts feasible on a pod.
 """
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from determined_tpu.ops.flash_attention import flash_attention_lse
 
-def _block_attn_update(q, k, v, m, l, acc, *, scale, mask):
-    """One blockwise-softmax accumulation step.
 
-    q: [B, Sq, H, D], k/v: [B, Sk, H, D], m/l: [B, H, Sq], acc like q.
-    mask: [Sq, Sk] boolean (True = attend) or None.
+# ---------------------------------------------------------------------------
+# Zigzag chunk placement
+# ---------------------------------------------------------------------------
+def zigzag_indices(seq_len: int, ring_size: int) -> np.ndarray:
+    """Permutation taking contiguous global order → zigzag device order.
+
+    The sequence splits into 2R chunks; device i owns chunks (i, 2R−1−i)
+    concatenated. Under a causal mask this balances work exactly: at every
+    ring step each device attends two half-chunks' worth of keys (one full,
+    or the diagonal's two triangles), instead of device i doing i+1 steps
+    of useful work.
     """
-    # fp32 accumulation: bf16 inputs must not round the scores pre-softmax.
-    scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale  # [B, H, Sq, Sk]
-    if mask is not None:
-        scores = jnp.where(mask[None, None], scores, -jnp.inf)
-    block_max = jnp.max(scores, axis=-1)  # [B, H, Sq]
-    new_m = jnp.maximum(m, block_max)
-    # Rows with no unmasked entries yet keep m=-inf; guard exp(-inf - -inf).
-    safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
-    p = jnp.exp(scores - safe_m[..., None])  # [B, H, Sq, Sk]
-    if mask is not None:
-        p = jnp.where(mask[None, None], p, 0.0)
-    corr = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - safe_m))  # [B, H, Sq]
-    new_l = l * corr + jnp.sum(p, axis=-1)
-    new_acc = acc * corr[..., None].swapaxes(1, 2) + jnp.einsum(
-        "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32
-    )
-    return new_m, new_l, new_acc
+    if seq_len % (2 * ring_size):
+        raise ValueError(
+            f"zigzag needs seq_len ({seq_len}) divisible by 2*ring ({2 * ring_size})"
+        )
+    chunk = seq_len // (2 * ring_size)
+    order = []
+    for i in range(ring_size):
+        order.extend(range(i * chunk, (i + 1) * chunk))
+        j = 2 * ring_size - 1 - i
+        order.extend(range(j * chunk, (j + 1) * chunk))
+    return np.asarray(order, dtype=np.int32)
 
 
+def inverse_permutation(perm: np.ndarray) -> np.ndarray:
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(len(perm), dtype=perm.dtype)
+    return inv
+
+
+def _fit_block(seq: int, want: int) -> int:
+    """Largest block size ≤ `want` dividing `seq` (flash requires block | seq).
+
+    Prefers lane-friendly multiples of 128 when one divides; falls back to
+    the largest plain divisor (correct at any size, just less MXU-efficient)."""
+    want = min(want, seq)
+    for b in range(want - want % 128, 0, -128):
+        if seq % b == 0:
+            return b
+    b = want
+    while seq % b:
+        b -= 1
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Partial-softmax merge
+# ---------------------------------------------------------------------------
+def _merge(acc, lse_run, o_p, lse_p):
+    """Fold a normalized partial (o_p, lse_p) into the running (acc, lse).
+
+    acc/lse_run: fp32 [.., S, H, D] / [.., S, H]; the merge weight
+    exp(lse_i − lse_total) is the standard blockwise-softmax combination —
+    exact, and differentiable end to end (lse_p carries a cotangent back
+    into the flash kernel's backward).
+    """
+    lse_new = jnp.logaddexp(lse_run, lse_p)
+    # Slots nothing has touched yet have lse_run = lse_new = −inf; the
+    # subtraction would be NaN. They contribute weight 0 either way.
+    safe = jnp.where(jnp.isneginf(lse_new), 0.0, lse_new)
+    w_old = jnp.where(jnp.isneginf(lse_run), 0.0, jnp.exp(lse_run - safe))
+    w_new = jnp.where(jnp.isneginf(lse_p), 0.0, jnp.exp(lse_p - safe))
+    acc_new = acc * w_old[..., None] + o_p.astype(jnp.float32) * w_new[..., None]
+    return acc_new, lse_new
+
+
+# ---------------------------------------------------------------------------
+# Core (per-shard, call inside shard_map)
+# ---------------------------------------------------------------------------
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -62,76 +117,154 @@ def ring_attention(
     axis_name: str = "context",
     causal: bool = True,
     scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    layout: str = "contiguous",
 ) -> jax.Array:
     """Exact attention with Q/K/V sequence-sharded over `axis_name`.
 
-    Call inside shard_map. Shapes per device: [B, S_local, H, D]. Devices
-    must hold consecutive sequence chunks in axis-index order.
+    Call inside shard_map. Shapes per device: [B, S_local, H, D].
 
-    Note: with causal=True the plain contiguous layout leaves later chunks
-    with more work (steps where kv_idx > q_idx are computed-then-discarded);
-    zigzag/striped chunk placement is the standard load-balance fix and can
-    be layered on top by permuting chunks at the data-loading step.
+    layout="contiguous" (default): devices hold consecutive chunks in
+    axis-index order — the safe contract for arbitrary callers; causal work
+    is imbalanced across ranks.
+    layout="zigzag" (causal only): each device holds global chunks
+    (i, 2R−1−i) — see `zigzag_indices` — which balances causal work
+    exactly. Opt-in because feeding contiguous data to the zigzag math
+    would be silently wrong; `make_ring_attention` applies the permutation
+    for global arrays, data loaders should emit it directly.
     """
     ring_size = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
-    _, s_local, _, d = q.shape
+    b, s_local, h, d = q.shape
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
 
-    if ring_size == 1:
-        # Same fp32 accumulation as the multi-device path: numerics must not
-        # change when only the parallelism layout changes.
-        acc_dtype = jnp.promote_types(q.dtype, jnp.float32)
-        m0 = jnp.full(q.shape[:1] + (q.shape[2], s_local), -jnp.inf, acc_dtype)
-        mask = (
-            jnp.tril(jnp.ones((s_local, s_local), bool)) if causal else None
+    def flash(q_, k_, v_, *, causal):
+        # Flash requires block | seq; shrink to the largest divisor so any
+        # (even) local length works — the einsum ring this replaced had no
+        # length constraint, and per-call lengths here include half-chunks.
+        bq = _fit_block(q_.shape[1], block_q)
+        bk = _fit_block(k_.shape[1], block_k)
+        return flash_attention_lse(
+            q_, k_, v_, causal=causal, scale=scale, block_q=bq, block_k=bk
         )
-        m, l, acc = _block_attn_update(
-            q, k, v, m0, jnp.zeros_like(m0), jnp.zeros(q.shape, acc_dtype),
-            scale=scale, mask=mask,
-        )
-        return (acc / l[..., None].swapaxes(1, 2)).astype(q.dtype)
 
-    b, _, h, _ = q.shape
-    m0 = jnp.full((b, h, s_local), -jnp.inf, jnp.promote_types(q.dtype, jnp.float32))
-    l0 = jnp.zeros_like(m0)
-    acc0 = jnp.zeros(q.shape, m0.dtype)
+    if ring_size == 1:
+        o, _ = flash(q, k, v, causal=causal)
+        return o
+
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full((b, s_local, h), -jnp.inf, jnp.float32)
     perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
-    tri = jnp.tril(jnp.ones((s_local, s_local), bool))
+
+    if not causal:
+        # Every step attends the full received chunk; layout is irrelevant.
+        def step(carry, _):
+            k_cur, v_cur, acc, lse_run = carry
+            o_p, lse_p = flash(q, k_cur, v_cur, causal=False)
+            acc, lse_run = _merge(acc, lse_run, o_p, lse_p)
+            k_nxt = lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = lax.ppermute(v_cur, axis_name, perm)
+            return (k_nxt, v_nxt, acc, lse_run), None
+
+        (_, _, acc, lse_run), _ = lax.scan(
+            step, (k, v, acc0, lse0), None, length=ring_size
+        )
+        return acc.astype(q.dtype)
+
+    if layout == "zigzag":
+        if s_local % 2:
+            raise ValueError("zigzag layout needs an even local sequence")
+        c = s_local // 2
+
+        def diag(k_cur, v_cur, acc, lse_run):
+            # Own chunks (i, 2R−1−i): q1·k1 and q2·k2 are causal triangles,
+            # q2·k1 is a full block (chunk 2R−1−i is strictly after chunk i).
+            q1, q2 = q[:, :c], q[:, c:]
+            k1, k2 = k_cur[:, :c], k_cur[:, c:]
+            v1, v2 = v_cur[:, :c], v_cur[:, c:]
+            o11, l11 = flash(q1, k1, v1, causal=True)
+            o21, l21 = flash(q2, k1, v1, causal=False)
+            o22, l22 = flash(q2, k2, v2, causal=True)
+            acc1, lse1 = _merge(acc[:, :c], lse_run[:, :c], o11, l11)
+            acc2, lse2 = _merge(acc[:, c:], lse_run[:, c:], o21, l21)
+            acc2, lse2 = _merge(acc2, lse2, o22, l22)
+            return (
+                jnp.concatenate([acc1, acc2], axis=1),
+                jnp.concatenate([lse1, lse2], axis=1),
+            )
+
+        def kv_before(k_cur, v_cur, acc, lse_run):
+            # kv from rank j < i: its first chunk (j) precedes both of ours
+            # → full attend; its second (2R−1−j) follows both → skip.
+            o_p, lse_p = flash(q, k_cur[:, :c], v_cur[:, :c], causal=False)
+            return _merge(acc, lse_run, o_p, lse_p)
+
+        def kv_after(k_cur, v_cur, acc, lse_run):
+            # kv from rank j > i: both its chunks precede our second chunk
+            # (j < 2R−1−i and 2R−1−j < 2R−1−i) and follow our first → only
+            # q2 attends, against the whole received kv.
+            o_p, lse_p = flash(q[:, c:], k_cur, v_cur, causal=False)
+            acc2, lse2 = _merge(acc[:, c:], lse_run[:, c:], o_p, lse_p)
+            return (
+                jnp.concatenate([acc[:, :c], acc2], axis=1),
+                jnp.concatenate([lse_run[:, :c], lse2], axis=1),
+            )
+
+        branches = (diag, kv_before, kv_after)
+
+        def step(carry, step_idx):
+            k_cur, v_cur, acc, lse_run = carry
+            kv_idx = (my_idx - step_idx) % ring_size
+            case = jnp.where(kv_idx == my_idx, 0, jnp.where(kv_idx < my_idx, 1, 2))
+            acc, lse_run = lax.switch(case, branches, k_cur, v_cur, acc, lse_run)
+            k_nxt = lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = lax.ppermute(v_cur, axis_name, perm)
+            return (k_nxt, v_nxt, acc, lse_run), None
+
+        (_, _, acc, lse_run), _ = lax.scan(
+            step, (k, v, acc0, lse0), jnp.arange(ring_size)
+        )
+        return acc.astype(q.dtype)
+
+    if layout != "contiguous":
+        raise ValueError(f"unknown ring layout {layout!r}")
+
+    # Contiguous causal: chunk j contributes fully when j < i, triangularly
+    # when j == i, never when j > i (skipped — the pre-r2 code computed and
+    # discarded those steps). Load stays imbalanced across ranks; prefer
+    # zigzag when the data layout allows.
+    def c_diag(k_cur, v_cur, acc, lse_run):
+        o_p, lse_p = flash(q, k_cur, v_cur, causal=True)
+        return _merge(acc, lse_run, o_p, lse_p)
+
+    def c_before(k_cur, v_cur, acc, lse_run):
+        o_p, lse_p = flash(q, k_cur, v_cur, causal=False)
+        return _merge(acc, lse_run, o_p, lse_p)
+
+    def c_skip(k_cur, v_cur, acc, lse_run):
+        return acc, lse_run
+
+    branches = (c_diag, c_before, c_skip)
 
     def step(carry, step_idx):
-        k_cur, v_cur, m, l, acc = carry
-        # After `step_idx` rotations we hold the chunk originally owned by
-        # (my_idx - step_idx) mod ring_size.
+        k_cur, v_cur, acc, lse_run = carry
         kv_idx = (my_idx - step_idx) % ring_size
-        if causal:
-            # kv chunk strictly before ours: attend fully; same chunk:
-            # triangular; after ours: no contribution.
-            diag = kv_idx == my_idx
-            mask = jnp.where(diag, tri, jnp.full_like(tri, True))
-            contributes = kv_idx <= my_idx
-        else:
-            mask = None
-            contributes = jnp.bool_(True)
-
-        new_m, new_l, new_acc = _block_attn_update(
-            q, k_cur, v_cur, m, l, acc, scale=scale, mask=mask
-        )
-        m = jnp.where(contributes, new_m, m)
-        l = jnp.where(contributes, new_l, l)
-        acc = jnp.where(contributes, new_acc, acc)
-        # Rotate K/V to the next device; overlappable with the next block's
-        # compute by XLA (async collective permute).
+        case = jnp.where(kv_idx == my_idx, 0, jnp.where(kv_idx < my_idx, 1, 2))
+        acc, lse_run = lax.switch(case, branches, k_cur, v_cur, acc, lse_run)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, m, l, acc), None
+        return (k_nxt, v_nxt, acc, lse_run), None
 
-    (_, _, m, l, acc), _ = lax.scan(
-        step, (k, v, m0, l0, acc0), jnp.arange(ring_size)
+    (_, _, acc, lse_run), _ = lax.scan(
+        step, (k, v, acc0, lse0), jnp.arange(ring_size)
     )
-    return (acc / l[..., None].swapaxes(1, 2)).astype(q.dtype)
+    return acc.astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Global-array wrapper
+# ---------------------------------------------------------------------------
 def make_ring_attention(
     mesh: Mesh,
     *,
@@ -139,13 +272,53 @@ def make_ring_attention(
     batch_axes=("data", "fsdp"),
     seq_axis: str = "context",
     heads_axis: str = "tensor",
+    zigzag: Optional[bool] = None,
+    block_q: int = 512,
+    block_k: int = 512,
 ):
-    """Global-array wrapper: shard_map ring_attention over the mesh."""
+    """shard_map ring_attention over the mesh, on global [B, S, H, D] arrays.
+
+    With zigzag (default for causal) the global sequence is permuted into
+    zigzag device order before the shard_map and the output permuted back —
+    convenient for tests and ad-hoc use. Training input pipelines should
+    instead emit tokens in zigzag order (`zigzag_indices`) and keep the
+    whole model in that order; the permutation here costs a gather each way.
+    """
+    if zigzag is None:
+        zigzag = causal
+    ring = mesh.shape.get(seq_axis, 1)
     spec = P(batch_axes, seq_axis, heads_axis, None)
-    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
-    return shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
-    )
+
+    def mapped(layout):
+        fn = functools.partial(
+            ring_attention,
+            axis_name=seq_axis,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+            layout=layout,
+        )
+        return shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+
+    if not (zigzag and causal and ring > 1):
+        return mapped("contiguous")
+
+    def wrapper(q, k, v):
+        s = q.shape[1]
+        if s % (2 * ring):
+            # Sequence won't split into 2R chunks — contiguous ring still
+            # computes the exact result, just with imbalanced causal work.
+            return mapped("contiguous")(q, k, v)
+        perm = zigzag_indices(s, ring)
+        inv = inverse_permutation(perm)
+        qz, kz, vz = (jnp.take(x, perm, axis=1) for x in (q, k, v))
+        out = mapped("zigzag")(qz, kz, vz)
+        return jnp.take(out, inv, axis=1)
+
+    return wrapper
 
 
 def reference_attention(q, k, v, *, causal: bool = True, scale=None):

@@ -66,6 +66,47 @@ def test_concurrent_channels():
         assert ckpt_ok is True
 
 
+def test_close_wakes_blocked_recv():
+    """A thread blocked in a timeout-less collective is failed loudly when
+    the endpoint closes, instead of sleeping forever on a condition nothing
+    will notify."""
+    import threading
+
+    from determined_tpu.common import ipc
+
+    port = ipc.free_port()
+    results = {}
+
+    def chief():
+        srv = ipc.ChiefServer(1, port=port)
+        srv.accept()
+        srv.close()
+
+    def worker():
+        cli = ipc.WorkerClient(f"127.0.0.1:{port}", 1)
+
+        def blocked():
+            try:
+                cli.recv(channel="never")
+            except BaseException as e:  # noqa: BLE001
+                results["err"] = e
+
+        t = threading.Thread(target=blocked)
+        t.start()
+        import time
+
+        time.sleep(0.3)  # let it block
+        cli.close()
+        t.join(timeout=10)
+        results["done"] = not t.is_alive()
+
+    tc, tw = threading.Thread(target=chief), threading.Thread(target=worker)
+    tc.start(); tw.start()
+    tc.join(timeout=15); tw.join(timeout=15)
+    assert results.get("done") is True
+    assert isinstance(results.get("err"), RuntimeError)
+
+
 def test_barrier_and_repeated_collectives():
     def fn(ctx):
         acc = []

@@ -59,6 +59,66 @@ def test_ring_attention_matches_dense(devices8, causal):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
 
 
+def test_zigzag_indices_roundtrip():
+    from determined_tpu.parallel.ring import inverse_permutation, zigzag_indices
+
+    perm = zigzag_indices(16, 4)
+    # Device 0 owns chunks 0 and 7, device 1 chunks 1 and 6, ...
+    assert list(perm[:4]) == [0, 1, 14, 15]
+    assert list(perm[4:8]) == [2, 3, 12, 13]
+    inv = inverse_permutation(perm)
+    np.testing.assert_array_equal(perm[inv], np.arange(16))
+    with pytest.raises(ValueError, match="divisible"):
+        zigzag_indices(12, 4)  # 12 % 8 != 0
+
+
+def test_ring_attention_contiguous_layout_matches(devices8):
+    """The explicit contiguous layout (for pipelines that can't reorder
+    tokens) stays exact, now with skip-instead-of-discard steps."""
+    mesh = make_mesh(MeshConfig(data=2, context=4), devices8)
+    b, s, h, d = 2, 32, 2, 16
+    key = jax.random.PRNGKey(3)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, h, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, h, d), jnp.float32)
+    ring = make_ring_attention(mesh, causal=True, zigzag=False)
+    got = jax.jit(ring)(q, k, v)
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_nonpow2_chunks(devices8):
+    """Half-chunk lengths that no power-of-two block divides: the inner
+    flash block shrinks to a divisor instead of raising (the einsum ring
+    this replaced had no length constraint)."""
+    mesh = make_mesh(MeshConfig(data=1, context=4), devices8[:4])
+    b, s, h, d = 2, 48, 2, 8  # local 12, zigzag half-chunk 6
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, h, d))
+    k = jax.random.normal(kk, (b, s, h, d))
+    v = jax.random.normal(kv, (b, s, h, d))
+    got = jax.jit(make_ring_attention(mesh, causal=True))(q, k, v)
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_odd_seq_falls_back(devices8):
+    """Seq not divisible by 2*ring: the wrapper silently uses the exact
+    contiguous path instead of failing."""
+    mesh = make_mesh(MeshConfig(data=1, context=4), devices8[:4])
+    b, s, h, d = 2, 20, 2, 8  # 20 % 8 != 0, but 20 % 4 == 0
+    key = jax.random.PRNGKey(5)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, h, d))
+    k = jax.random.normal(kk, (b, s, h, d))
+    v = jax.random.normal(kv, (b, s, h, d))
+    got = jax.jit(make_ring_attention(mesh, causal=True))(q, k, v)
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
 def test_ring_attention_grads_match(devices8):
     mesh = make_mesh(MeshConfig(data=1, context=4), devices8[:4])
     b, s, h, d = 2, 16, 2, 8
