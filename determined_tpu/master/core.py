@@ -180,11 +180,7 @@ class RMTrialLauncher:
         if alloc is None:
             self.m.pool_of(alloc_id).release(alloc_id)
             return
-        assignment = self.m.pool_of(alloc_id).assignment_of(alloc_id) or {}
-        for agent_id in assignment:
-            self.m.agent_hub.enqueue(
-                agent_id, {"type": "KILL", "alloc_id": alloc_id}
-            )
+        self.m.kill_allocation(alloc_id)
 
 
 class Master:
@@ -198,6 +194,7 @@ class Master:
         unmanaged_timeout_s: float = 300.0,
         users: Optional[Dict[str, str]] = None,
         config_defaults: Optional[Dict[str, Any]] = None,
+        kube_client: Optional[Any] = None,
     ) -> None:
         self.cluster_id = uuid.uuid4().hex[:8]
         self.external_url = external_url
@@ -206,7 +203,16 @@ class Master:
         # master.yaml), merged under every submitted config at create time.
         self.config_defaults: Dict[str, Any] = config_defaults or {}
         self.db = db_mod.Database(db_path)
-        self.rm = ResourceManager(pools_config)
+        self.rm = ResourceManager(pools_config, kube_client=kube_client)
+        # Backends that observe exits themselves (k8s pod phases) report
+        # them here — the same endpoint the agent EXITED event reaches
+        # (agent_event below). Agent pools never call it.
+        for _pool in self.rm.pools.values():
+            _pool.on_alloc_exit = (
+                lambda a, c, r: self.alloc_service.complete(
+                    a, exit_code=c, reason=r
+                )
+            )
         self.alloc_service = AllocationService(preempt_timeout_s=preempt_timeout_s)
         self.agent_hub = AgentHub()
         from determined_tpu.master.auth import AuthService
@@ -265,6 +271,11 @@ class Master:
             name = self._alloc_pool.get(alloc_id)
         return self.rm.pool(name)
 
+    def kill_allocation(self, alloc_id: str) -> None:
+        """Hard-stop a placed allocation, whatever realizes it: KILL actions
+        to agents, pod deletion on a Kubernetes pool (pool hook)."""
+        self.pool_of(alloc_id).kill_alloc(alloc_id, self.agent_hub)
+
     def enqueue_start_actions(
         self,
         *,
@@ -279,8 +290,10 @@ class Master:
         trial_id: Optional[int] = None,
     ) -> None:
         """Single source of the DTPU_* env contract: turn a placement into
-        per-host START actions (shared by trials and NTSC tasks — the
-        reference's TaskSpec builder role, master/pkg/tasks/task.go)."""
+        per-host task starts (shared by trials and NTSC tasks — the
+        reference's TaskSpec builder role, master/pkg/tasks/task.go).
+        Dispatch is per RM backend: agent pools get START actions on the
+        long-poll, Kubernetes pools get pods created with the same env."""
         hosts = sorted(assignment)
         self.alloc_service.create(
             alloc_id, task_id=task_id, trial_id=trial_id,
@@ -290,6 +303,7 @@ class Master:
             alloc_id, task_id=task_id, trial_id=trial_id,
             state="ASSIGNED", slots=slots,
         )
+        rank_envs: List[tuple] = []
         for rank, agent_id in enumerate(hosts):
             info = _info.ClusterInfo(
                 master_url=self.external_url,
@@ -320,25 +334,22 @@ class Master:
             env = {**user_env, **env}
             if config.get("context"):
                 env["DTPU_CONTEXT_ID"] = str(config["context"])
-            self.agent_hub.enqueue(
-                agent_id,
-                {
-                    "type": "START", "alloc_id": alloc_id, "task_id": task_id,
-                    "entrypoint": entrypoint, "env": env,
-                },
-            )
+            rank_envs.append((agent_id, env))
+
+        self.pool_of(alloc_id).start(
+            alloc_id=alloc_id, task_id=task_id, entrypoint=entrypoint,
+            rank_envs=rank_envs, agent_hub=self.agent_hub,
+        )
 
     # -- background pump (replaces the actor system's message loop) ----------
     def _tick_loop(self) -> None:
         while not self._stop.wait(1.0):
             try:
                 self.rm.tick_all()
+                for pool in self.rm.pools.values():
+                    pool.sync()  # backend state poll (k8s pod phases; agent no-op)
                 for alloc_id in self.alloc_service.overdue_preemptions():
-                    assignment = self.pool_of(alloc_id).assignment_of(alloc_id) or {}
-                    for agent_id in assignment:
-                        self.agent_hub.enqueue(
-                            agent_id, {"type": "KILL", "alloc_id": alloc_id}
-                        )
+                    self.kill_allocation(alloc_id)
                 # Agent failure detection: an agent silent past the timeout
                 # is gone — fail its allocations over (trial restart budget
                 # applies; ref agent reattach flow, containers/manager.go:76).
@@ -553,9 +564,7 @@ class Master:
             with self._lock:
                 self._commands[task_id]["state"] = "TERMINATED"
             return
-        assignment = self.pool_of(alloc_id).assignment_of(alloc_id) or {}
-        for agent_id in assignment:
-            self.agent_hub.enqueue(agent_id, {"type": "KILL", "alloc_id": alloc_id})
+        self.kill_allocation(alloc_id)
 
     # -- agent events -----------------------------------------------------------
     def agent_event(self, agent_id: str, event: Dict[str, Any]) -> None:

@@ -46,6 +46,41 @@ class ResourcePool:
         self._running: Dict[str, Assignment] = {}       # alloc_id -> placement
         self._order = 0
         self._lock = threading.Lock()
+        #: Backends that observe task exits themselves (k8s pod phases) call
+        #: this with (alloc_id, exit_code, reason); the agent backend leaves
+        #: it alone — exits arrive as agent EXITED events instead.
+        self.on_alloc_exit: Optional[Callable[[str, int, str], None]] = None
+
+    # -- backend realization hooks (one iface over backends; overridden by
+    # -- the Kubernetes pool) ------------------------------------------------
+    def start(
+        self,
+        *,
+        alloc_id: str,
+        task_id: str,
+        entrypoint: str,
+        rank_envs: List,
+        agent_hub: Any,
+    ) -> None:
+        """Realize a placement: per-host START actions on the agent queues."""
+        for agent_id, env in rank_envs:
+            agent_hub.enqueue(
+                agent_id,
+                {
+                    "type": "START", "alloc_id": alloc_id, "task_id": task_id,
+                    "entrypoint": entrypoint, "env": env,
+                },
+            )
+
+    def kill_alloc(self, alloc_id: str, agent_hub: Any) -> None:
+        """Hard-stop a placed allocation: KILL actions to its agents."""
+        assignment = self.assignment_of(alloc_id) or {}
+        for agent_id in assignment:
+            agent_hub.enqueue(agent_id, {"type": "KILL", "alloc_id": alloc_id})
+
+    def sync(self) -> None:
+        """Backend-side state poll (node inventory, pod phases); no-op for
+        the agent backend, whose state arrives by registration/heartbeat."""
 
     # -- agents --------------------------------------------------------------
     def add_agent(self, agent_id: str, slots: int) -> None:
@@ -170,13 +205,30 @@ class ResourcePool:
 
 
 class ResourceManager:
-    """Named pools (ref: resource_manager_iface.go, one iface over backends)."""
+    """Named pools (ref: resource_manager_iface.go, one iface over backends).
 
-    def __init__(self, pools_config: Optional[Dict[str, Dict]] = None) -> None:
+    Two backends per the reference: the agent RM (default) and the
+    Kubernetes RM (pool config {"type": "kubernetes"}, which realizes
+    placements as pods — master/kubernetes.py). `kube_client` supplies the
+    clientset for k8s pools (a fake in tests, LocalProcessKubeClient in the
+    single-box devcluster)."""
+
+    def __init__(
+        self,
+        pools_config: Optional[Dict[str, Dict]] = None,
+        kube_client: Optional[Any] = None,
+    ) -> None:
         cfgs = pools_config or {"default": {}}
-        self.pools: Dict[str, ResourcePool] = {
-            name: ResourcePool(name, cfg.get("scheduler")) for name, cfg in cfgs.items()
-        }
+        self.pools: Dict[str, ResourcePool] = {}
+        for name, cfg in cfgs.items():
+            if cfg.get("type") == "kubernetes":
+                from determined_tpu.master.kubernetes import KubernetesResourcePool
+
+                self.pools[name] = KubernetesResourcePool(
+                    name, cfg.get("scheduler"), client=kube_client
+                )
+            else:
+                self.pools[name] = ResourcePool(name, cfg.get("scheduler"))
 
     def pool(self, name: Optional[str] = None) -> ResourcePool:
         if not name:

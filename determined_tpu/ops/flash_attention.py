@@ -461,11 +461,6 @@ def _flash_lse_bwd(scale, causal, block_q, block_k, res, cts):
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-def _flash(q, k, v, scale, causal, block_q, block_k):
-    o, _ = _flash_lse(q, k, v, scale, causal, block_q, block_k)
-    return o
-
-
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -479,31 +474,13 @@ def flash_attention(
     """Fused attention; q/k/v: [B, S, H, D] (same layout as ring/ulysses).
 
     Heads fold into the grid's batch dimension; block sizes clamp to the
-    sequence length (and must divide it).
+    sequence length (and must divide it). Delegates to flash_attention_lse
+    (one shape contract); XLA drops the unused lse output.
     """
-    b, s_q, h, d = q.shape
-    s_k = k.shape[1]
-    if causal and s_q != s_k:
-        # The causal mask top-left aligns sequences (row i sees keys <= i at
-        # absolute offset 0), which silently drops the K/V tail in decode /
-        # kv-cache layouts; those need an explicit offset, not this kernel.
-        raise ValueError(
-            f"causal flash attention requires s_q == s_k, got ({s_q}, {s_k})"
-        )
-    scale = scale if scale is not None else 1.0 / (d ** 0.5)
-    block_q = min(block_q, s_q)
-    block_k = min(block_k, s_k)
-    if s_q % block_q or s_k % block_k:
-        raise ValueError(
-            f"seq lengths ({s_q}, {s_k}) must be divisible by blocks "
-            f"({block_q}, {block_k})"
-        )
-
-    def fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-
-    o = _flash(fold(q), fold(k), fold(v), scale, causal, block_q, block_k)
-    return o.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    o, _ = flash_attention_lse(
+        q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k
+    )
+    return o
 
 
 def flash_attention_lse(
@@ -525,6 +502,9 @@ def flash_attention_lse(
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     if causal and s_q != s_k:
+        # The causal mask top-left aligns sequences (row i sees keys <= i at
+        # absolute offset 0), which silently drops the K/V tail in decode /
+        # kv-cache layouts; those need an explicit offset, not this kernel.
         raise ValueError(
             f"causal flash attention requires s_q == s_k, got ({s_q}, {s_k})"
         )
