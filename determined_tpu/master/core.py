@@ -58,8 +58,14 @@ class AgentHub:
                 # actually alive): tell the agent to re-register so its
                 # slots come back (ref: aproto ErrAgentMustReconnect).
                 return [{"type": "REREGISTER"}]
-            self._agents[agent_id]["last_seen"] = time.time()
             while True:
+                # Refresh liveness every wait cycle, not just at poll entry:
+                # an agent blocked in a 30s long-poll is connected and alive,
+                # and must not age past agent_timeout_s while it waits (that
+                # spurious reap fails over healthy allocations).
+                if agent_id not in self._agents:
+                    return [{"type": "REREGISTER"}]
+                self._agents[agent_id]["last_seen"] = time.time()
                 q = self._queues.get(agent_id, [])
                 if q:
                     self._queues[agent_id] = []

@@ -208,13 +208,16 @@ class KubernetesResourcePool(ResourcePool):
         for name in known - set(nodes):
             # Node gone (pool scale-down, host failure): every gang with a
             # pod there fails over, same semantics as a lost agent.
+            # (remove_agent → our release() tears the pods down.)
             for alloc_id in self.remove_agent(name):
                 exits.append((alloc_id, 1, f"node {name} lost"))
-                self._delete_pods(alloc_id)
 
-        phases = self.client.pod_phases()
+        # Gangs BEFORE phases: a gang registered between the two snapshots
+        # is simply absent here and checked next tick. The other order reads
+        # its fresh pods as phase-None and tears down a healthy trial.
         with self._pods_lock:
             gangs = {a: list(ns) for a, ns in self._pods.items()}
+        phases = self.client.pod_phases()
         for alloc_id, pod_names in gangs.items():
             pod_phases = [phases.get(n) for n in pod_names]
             if any(p == FAILED or p is None for p in pod_phases):

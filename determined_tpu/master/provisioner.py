@@ -8,10 +8,16 @@ agent instances. Backends:
 - LocalProvisioner — spawns agent daemons on this box (devcluster analog of
   the reference's `det deploy local` agents; also the test vehicle for the
   decider, like the reference's scale_decider tests).
-- GCPTPUProvisioner — emits the gcloud TPU-VM commands it would run
-  (`create`/`delete` of tpu-vm instances with startup scripts that launch
-  the agent). Zero-egress environments run it in dry-run mode; the command
-  stream is the contract (ref: provisioner/gcp/gcp.go + agentsetup).
+- GCPTPUProvisioner — creates/deletes TPU-VM slices through an
+  InstanceDriver: GcloudTPUDriver executes the gcloud calls (dry_run still
+  available for audit), FakeTPUDriver is the faithful in-memory double for
+  tests (and can spawn real local agents, so autoscale e2es run the whole
+  loop on one box). Preemptible (spot) slices are first-class: the backend
+  polls instance states each tick, and a RECLAIMED slice is cleaned up and
+  reported lost — the master fails the trial over to its restart budget
+  (checkpoint-requeue), the queue deepens, and the decider re-provisions.
+  Ref: provisioner/gcp/gcp.go + agentsetup, and the spot state machine in
+  rm/agentrm/provisioner/aws/aws_spot.go (reclaim → requeue → replace).
 """
 from __future__ import annotations
 
@@ -158,38 +164,211 @@ class LocalProvisioner:
                 logger.info("terminated local agent %s", aid)
 
 
-class GCPTPUProvisioner:
-    """TPU-VM autoscaling via gcloud; dry_run collects the command stream.
+# Instance states an InstanceDriver reports (the subset of GCP TPU-VM
+# states the provisioner must react to).
+CREATING = "CREATING"
+READY = "READY"
+RECLAIMED = "RECLAIMED"   # spot/preemptible slice taken back by the platform
 
-    Instance unit = one TPU VM slice of `accelerator_type` (e.g. v5e-8);
-    the startup script installs and launches the agent pointed at this
-    master (ref: provisioner/agentsetup/agent_setup.go).
+
+class InstanceDriver(Protocol):
+    """Cloud-side effects behind one seam (so the backend logic is testable
+    with a faithful fake, and 'gcloud' is an implementation detail)."""
+
+    def create(self, name: str, startup_script: str, preemptible: bool) -> None: ...
+    def delete(self, name: str) -> None: ...
+    def list_instances(self) -> Dict[str, str]: ...   # name -> state
+
+
+class GcloudTPUDriver:
+    """Executes real gcloud TPU-VM calls (dry_run records them instead)."""
+
+    def __init__(
+        self,
+        *,
+        project: str,
+        zone: str,
+        accelerator_type: str = "v5litepod-8",
+        runtime_version: str = "v2-alpha-tpuv5-lite",
+        dry_run: bool = False,
+    ) -> None:
+        self.project = project
+        self.zone = zone
+        self.accelerator_type = accelerator_type
+        self.runtime_version = runtime_version
+        self.dry_run = dry_run
+        self.commands: List[List[str]] = []  # audit trail (always recorded)
+        self._dry_instances: Dict[str, str] = {}
+
+    def _run(self, cmd: List[str], timeout: float = 600.0) -> str:
+        self.commands.append(cmd)
+        if self.dry_run:
+            logger.info("[dry-run] %s", " ".join(cmd))
+            return ""
+        import subprocess
+
+        out = subprocess.run(
+            cmd, check=True, capture_output=True, timeout=timeout, text=True
+        )
+        return out.stdout
+
+    def create(self, name: str, startup_script: str, preemptible: bool) -> None:
+        import os
+        import tempfile
+
+        # Startup script goes via --metadata-from-file: embedding it in
+        # argv would leak the agent auth token to `ps` and the logs.
+        script = tempfile.NamedTemporaryFile(
+            "w", suffix=".sh", prefix="dtpu-startup-", delete=False
+        )
+        script.write(startup_script)
+        script.close()
+        try:
+            cmd = [
+                "gcloud", "compute", "tpus", "tpu-vm", "create", name,
+                f"--project={self.project}", f"--zone={self.zone}",
+                f"--accelerator-type={self.accelerator_type}",
+                f"--version={self.runtime_version}",
+                f"--metadata-from-file=startup-script={script.name}",
+            ]
+            if preemptible:
+                cmd.append("--preemptible")
+            self._run(cmd)
+            if self.dry_run:
+                self._dry_instances[name] = READY
+        finally:
+            # the file carries the agent token; never leave it behind
+            os.unlink(script.name)
+
+    def delete(self, name: str) -> None:
+        self._run([
+            "gcloud", "compute", "tpus", "tpu-vm", "delete", name,
+            f"--project={self.project}", f"--zone={self.zone}", "--quiet",
+        ])
+        self._dry_instances.pop(name, None)
+
+    def list_instances(self) -> Dict[str, str]:
+        if self.dry_run:
+            return dict(self._dry_instances)
+        import json
+
+        out = self._run([
+            "gcloud", "compute", "tpus", "tpu-vm", "list",
+            f"--project={self.project}", f"--zone={self.zone}",
+            "--format=json",
+        ], timeout=120.0)
+        states: Dict[str, str] = {}
+        for inst in json.loads(out or "[]"):
+            name = inst.get("name", "").rsplit("/", 1)[-1]
+            state = inst.get("state", "")
+            # TPU-VM state vocabulary → the three states we act on. Dead or
+            # dying states must map to RECLAIMED or poll() never frees them;
+            # transient states (and unknown future ones) map to CREATING —
+            # never kill on a state we don't understand, the boot timeout /
+            # agent reap covers truly stuck instances.
+            if state in (
+                "PREEMPTED", "TERMINATED", "STOPPED", "STOPPING",
+                "DELETING", "REPAIRING", "SUSPENDED",
+            ):
+                states[name] = RECLAIMED
+            elif state == "READY":
+                states[name] = READY
+            else:  # CREATING, STARTING, RESTARTING, REIMAGING, unknown
+                states[name] = CREATING
+        return states
+
+
+class FakeTPUDriver:
+    """Faithful in-memory driver: instances with states, optional REAL local
+    agents per instance (autoscale e2es run the whole loop), and a reclaim()
+    knob to simulate the platform taking a spot slice back."""
+
+    def __init__(
+        self,
+        *,
+        master_url: str = "",
+        slots_per_instance: int = 1,
+        pool: str = "default",
+        spawn_agents: bool = False,
+        token: str = "",
+    ) -> None:
+        self.master_url = master_url
+        self.slots = slots_per_instance
+        self.pool_name = pool
+        self.spawn_agents = spawn_agents
+        self.token = token
+        self.instances: Dict[str, str] = {}
+        self.created_preemptible: Dict[str, bool] = {}
+        self._agents: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def create(self, name: str, startup_script: str, preemptible: bool) -> None:
+        with self._lock:
+            self.instances[name] = READY
+            self.created_preemptible[name] = preemptible
+        if self.spawn_agents:
+            from determined_tpu.agent.agent import AgentDaemon
+
+            agent = AgentDaemon(
+                self.master_url, agent_id=name, slots=self.slots,
+                pool=self.pool_name, token=self.token,
+            )
+            threading.Thread(
+                target=agent.run_forever, daemon=True, name=name
+            ).start()
+            with self._lock:
+                self._agents[name] = agent
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            self.instances.pop(name, None)
+            agent = self._agents.pop(name, None)
+        if agent is not None:
+            agent.stop()  # type: ignore[attr-defined]
+
+    def list_instances(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self.instances)
+
+    def reclaim(self, name: str) -> None:
+        """Platform takes the spot slice back: the VM (and its agent) dies
+        abruptly — no goodbye to the master."""
+        with self._lock:
+            self.instances[name] = RECLAIMED
+            agent = self._agents.pop(name, None)
+        if agent is not None:
+            agent.stop()  # type: ignore[attr-defined]
+
+
+class GCPTPUProvisioner:
+    """TPU-VM autoscaling through an InstanceDriver.
+
+    Instance unit = one TPU VM slice of the driver's accelerator_type; the
+    startup script installs and launches the agent pointed at this master
+    (ref: provisioner/agentsetup/agent_setup.go). With preemptible=True the
+    slices are spot capacity and poll() handles reclaims.
     """
 
     def __init__(
         self,
         master_url: str,
         *,
-        project: str,
-        zone: str,
-        accelerator_type: str = "v5litepod-8",
-        runtime_version: str = "v2-alpha-tpuv5-lite",
+        driver: InstanceDriver,
         pool: str = "default",
         prefix: str = "dtpu-agent",
-        dry_run: bool = True,
+        preemptible: bool = False,
         token: str = "",
     ) -> None:
         self.master_url = master_url
-        self.project = project
-        self.zone = zone
-        self.accelerator_type = accelerator_type
-        self.runtime_version = runtime_version
+        self.driver = driver
         self.pool = pool
         self.prefix = prefix
-        self.dry_run = dry_run
+        self.preemptible = preemptible
         self.token = token  # required when the master has auth enabled
         self._counter = 0
-        self.commands: List[List[str]] = []  # dry-run audit trail
+        self._expected: set = set()  # instances we created and haven't deleted
+        self._pending_deletes: set = set()  # failed husk deletes, retried per poll
+        self._lock = threading.Lock()
 
     def _startup_script(self, instance_name: str) -> str:
         # --agent-id = the TPU instance name (NOT $(hostname): a TPU VM's
@@ -203,48 +382,52 @@ class GCPTPUProvisioner:
             f"--agent-id {instance_name}{token_flag}\n"
         )
 
-    def _run(self, cmd: List[str]) -> None:
-        self.commands.append(cmd)
-        if self.dry_run:
-            logger.info("[dry-run] %s", " ".join(cmd))
-            return
-        import subprocess
-
-        subprocess.run(cmd, check=True, capture_output=True, timeout=600)
-
     def launch(self, n: int) -> None:
-        import tempfile
-
         for _ in range(n):
-            self._counter += 1
-            name = f"{self.prefix}-{self._counter}"
-            # Startup script goes via --metadata-from-file: embedding it in
-            # argv would leak the agent auth token to `ps` and the logs.
-            script = tempfile.NamedTemporaryFile(
-                "w", suffix=".sh", prefix="dtpu-startup-", delete=False
-            )
-            script.write(self._startup_script(name))
-            script.close()
-            try:
-                self._run([
-                    "gcloud", "compute", "tpus", "tpu-vm", "create", name,
-                    f"--project={self.project}", f"--zone={self.zone}",
-                    f"--accelerator-type={self.accelerator_type}",
-                    f"--version={self.runtime_version}",
-                    f"--metadata-from-file=startup-script={script.name}",
-                ])
-            finally:
-                # the file carries the agent token; never leave it behind
-                import os
-
-                os.unlink(script.name)
+            with self._lock:
+                self._counter += 1
+                name = f"{self.prefix}-{self._counter}"
+                self._expected.add(name)
+            self.driver.create(name, self._startup_script(name), self.preemptible)
 
     def terminate(self, agent_ids: List[str]) -> None:
         for aid in agent_ids:
-            self._run([
-                "gcloud", "compute", "tpus", "tpu-vm", "delete", aid,
-                f"--project={self.project}", f"--zone={self.zone}", "--quiet",
-            ])
+            with self._lock:
+                self._expected.discard(aid)
+            self.driver.delete(aid)
+
+    def poll(self) -> List[str]:
+        """Reconcile against the cloud; returns instances lost to spot
+        reclaim (or vanished outright). The caller reports them to the
+        master, which fails their allocations over — checkpoint-requeue —
+        and the scale decider re-provisions for the re-queued demand."""
+        states = self.driver.list_instances()
+        lost: List[str] = []
+        with self._lock:
+            expected = set(self._expected)
+            retry = set(self._pending_deletes)
+        for name in expected:
+            state = states.get(name)
+            if state == RECLAIMED or state is None:
+                lost.append(name)
+                with self._lock:
+                    self._expected.discard(name)
+                if state == RECLAIMED:
+                    retry.add(name)  # husk still holds quota until deleted
+                logger.warning("instance %s lost (spot reclaim or failure)", name)
+        for name in retry:
+            try:
+                self.driver.delete(name)
+            except Exception:  # noqa: BLE001
+                logger.exception(
+                    "deleting reclaimed instance %s failed; will retry", name
+                )
+                with self._lock:
+                    self._pending_deletes.add(name)
+            else:
+                with self._lock:
+                    self._pending_deletes.discard(name)
+        return lost
 
 
 class ProvisionerService:
@@ -270,6 +453,13 @@ class ProvisionerService:
         self._thread: Optional[threading.Thread] = None
 
     def tick(self) -> ScaleDecision:
+        # Reconcile first: spot reclaims discovered now free capacity
+        # records and re-queue work before this tick's scale decision.
+        poll = getattr(self.backend, "poll", None)
+        if poll is not None:
+            for agent_id in poll():
+                if self.on_terminate is not None:
+                    self.on_terminate(agent_id)
         decision = self.decider.decide(self.pool)
         if decision.launch:
             self.backend.launch(decision.launch)
