@@ -170,7 +170,12 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         return {}
 
     def get_metrics(r: ApiRequest):
-        return {"metrics": m.db.get_metrics(int(r.groups[0]), r.q("group"))}
+        return {
+            "metrics": m.db.get_metrics(
+                int(r.groups[0]), r.q("group"),
+                after_id=int(r.q("after") or 0),
+            )
+        }
 
     def post_progress(r: ApiRequest):
         trial_id = int(r.groups[0])

@@ -293,13 +293,22 @@ class Database:
         )
 
     def get_metrics(
-        self, trial_id: int, group: Optional[str] = None
+        self,
+        trial_id: int,
+        group: Optional[str] = None,
+        after_id: int = 0,
     ) -> List[Dict[str, Any]]:
+        """Rows for a trial, optionally only those with id > after_id — the
+        incremental cursor the WebUI's 2s chart poll rides (same pattern as
+        task-log tailing) so long trials don't refetch their whole history."""
         sql = "SELECT * FROM metrics WHERE trial_id=?"
         args: tuple = (trial_id,)
         if group:
             sql += " AND grp=?"
             args += (group,)
+        if after_id:
+            sql += " AND id>?"
+            args += (after_id,)
         sql += " ORDER BY id"
         out = []
         for r in self._query(sql, args):

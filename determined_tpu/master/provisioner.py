@@ -64,6 +64,15 @@ class ScaleDecider:
         self._pending_boots: List[float] = []  # launch timestamps
         self._known_agents: set = set()
 
+    def notify_instance_lost(self, name: str) -> None:
+        """An instance we were counting on is gone (spot reclaim, failed
+        boot). If it never registered as an agent, retire one pending-boot
+        credit immediately — otherwise the decider keeps counting the dead
+        instance's slots as arriving capacity for up to boot_timeout_s and
+        stalls the replacement launch for the requeued work."""
+        if name not in self._known_agents and self._pending_boots:
+            self._pending_boots.pop(0)
+
     def decide(self, pool: ResourcePool) -> ScaleDecision:
         now = time.time()
         agents = pool.agents_snapshot()
@@ -387,8 +396,12 @@ class GCPTPUProvisioner:
             with self._lock:
                 self._counter += 1
                 name = f"{self.prefix}-{self._counter}"
-                self._expected.add(name)
+            # _expected only after a successful create: a failed gcloud call
+            # must not leave a ghost that the next poll() misreports as a
+            # spot reclaim (phantom lose_agent alerts).
             self.driver.create(name, self._startup_script(name), self.preemptible)
+            with self._lock:
+                self._expected.add(name)
 
     def terminate(self, agent_ids: List[str]) -> None:
         for aid in agent_ids:
@@ -458,6 +471,7 @@ class ProvisionerService:
         poll = getattr(self.backend, "poll", None)
         if poll is not None:
             for agent_id in poll():
+                self.decider.notify_instance_lost(agent_id)
                 if self.on_terminate is not None:
                     self.on_terminate(agent_id)
         decision = self.decider.decide(self.pool)

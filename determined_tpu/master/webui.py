@@ -4,7 +4,11 @@ The reference ships a 112k-LoC React SPA (`webui/react`); this is the
 platform's minimal equivalent — one self-contained HTML page (no build
 step, no external assets; it must work from an air-gapped TPU pod) that
 polls the same REST API the CLI/SDK use and renders experiments, trials,
-agents/queues, and live trial logs.
+agents/queues, live trial logs, per-trial metric line charts, and an
+HP-search view (rung scatter + parallel coordinates — the capability of
+the reference's ExperimentDetails charts and HP visualizations,
+webui/react/src/pages/ExperimentDetails). Charts are hand-rolled SVG so
+the no-build-step constraint holds.
 """
 
 PAGE = """<!doctype html>
@@ -36,6 +40,10 @@ PAGE = """<!doctype html>
 <h2>Agents</h2><table id="agents"></table>
 <h2>Experiments</h2><table id="exps"></table>
 <h2>Trials <span id="exp-label"></span></h2><table id="trials"></table>
+<h2>HP search <span id="hp-label"></span></h2>
+<div id="hpviz">(click an experiment's trials)</div>
+<h2>Metrics <span id="chart-label"></span></h2>
+<div id="charts">(click a trial)</div>
 <h2>Logs <span id="log-label"></span></h2><pre id="logs">(click a trial)</pre>
 <div id="login" style="display:none">
   <h2>Login</h2>
@@ -77,6 +85,169 @@ async function doLogin() {
   refresh();
 }
 
+// --- SVG charts (no build step, no libs) ------------------------------
+const SVGNS = 'http://www.w3.org/2000/svg';
+function svgEl(tag, attrs, parent) {
+  const el = document.createElementNS(SVGNS, tag);
+  for (const [k, v] of Object.entries(attrs)) el.setAttribute(k, v);
+  if (parent) parent.appendChild(el);
+  return el;
+}
+function bounds(vals) {
+  let lo = Math.min(...vals), hi = Math.max(...vals);
+  if (!isFinite(lo) || !isFinite(hi)) { lo = 0; hi = 1; }
+  if (lo === hi) { lo -= 0.5; hi += 0.5; }
+  return [lo, hi];
+}
+const PALETTE = ['#58a6ff', '#3fb950', '#d29922', '#f85149', '#bc8cff',
+                 '#39c5cf', '#ff7b72', '#7ee787'];
+
+// series: [{name, points: [[x, y], ...]}] -> an SVG line chart node.
+function lineChart(title, series, w = 470, h = 170) {
+  const pad = {l: 52, r: 8, t: 20, b: 22};
+  const svg = svgEl('svg', {width: w, height: h, style:
+    'background:#161b22;border:1px solid #21262d;border-radius:4px;margin:4px'});
+  const xs = series.flatMap(s => s.points.map(p => p[0]));
+  const ys = series.flatMap(s => s.points.map(p => p[1]));
+  if (!xs.length) return svg;
+  const [x0, x1] = bounds(xs), [y0, y1] = bounds(ys);
+  const X = (x) => pad.l + (x - x0) / (x1 - x0) * (w - pad.l - pad.r);
+  const Y = (y) => h - pad.b - (y - y0) / (y1 - y0) * (h - pad.t - pad.b);
+  const txt = (x, y, t, anchor = 'start', fill = '#8b949e') => {
+    const e = svgEl('text', {x, y, fill, 'font-size': 10,
+                             'text-anchor': anchor}, svg);
+    e.textContent = t;  // textContent: no HTML parsing, no injection
+  };
+  txt(pad.l, 12, title, 'start', '#c9d1d9');
+  for (const f of [0, 0.5, 1]) {
+    const yv = y0 + f * (y1 - y0);
+    svgEl('line', {x1: pad.l, x2: w - pad.r, y1: Y(yv), y2: Y(yv),
+                   stroke: '#21262d'}, svg);
+    txt(pad.l - 4, Y(yv) + 3, yv.toPrecision(3), 'end');
+  }
+  txt(pad.l, h - 6, x0.toPrecision(4)); txt(w - pad.r, h - 6, x1.toPrecision(4), 'end');
+  series.forEach((s, i) => {
+    const color = PALETTE[i % PALETTE.length];
+    svgEl('polyline', {
+      points: s.points.map(p => `${X(p[0])},${Y(p[1])}`).join(' '),
+      fill: 'none', stroke: color, 'stroke-width': 1.5}, svg);
+    txt(w - pad.r - 90 * (series.length - 1 - i), 12, s.name, 'end', color);
+  });
+  return svg;
+}
+
+// Trials scatter: steps vs metric — ASHA's rungs appear as vertical bands.
+function rungScatter(trials, w = 470, h = 190) {
+  const pad = {l: 52, r: 10, t: 20, b: 22};
+  const svg = svgEl('svg', {width: w, height: h, style:
+    'background:#161b22;border:1px solid #21262d;border-radius:4px;margin:4px'});
+  const pts = trials.filter(t => t.searcher_metric != null)
+    .map(t => [t.steps_completed, Number(t.searcher_metric), t.state, t.id]);
+  if (!pts.length) return svg;
+  const [x0, x1] = bounds(pts.map(p => p[0]));
+  const [y0, y1] = bounds(pts.map(p => p[1]));
+  const X = (x) => pad.l + (x - x0) / (x1 - x0) * (w - pad.l - pad.r);
+  const Y = (y) => h - pad.b - (y - y0) / (y1 - y0) * (h - pad.t - pad.b);
+  const txt = (x, y, t, anchor = 'start') => {
+    const e = svgEl('text', {x, y, fill: '#8b949e', 'font-size': 10,
+                             'text-anchor': anchor}, svg);
+    e.textContent = t;
+  };
+  txt(pad.l, 12, 'rungs: steps vs searcher metric (point = trial)');
+  for (const f of [0, 0.5, 1]) {
+    const yv = y0 + f * (y1 - y0);
+    txt(pad.l - 4, Y(yv) + 3, yv.toPrecision(3), 'end');
+  }
+  txt(pad.l, h - 6, String(x0)); txt(w - pad.r, h - 6, String(x1), 'end');
+  const color = {COMPLETED: '#3fb950', ERRORED: '#f85149', ACTIVE: '#58a6ff'};
+  for (const [x, y, st, id] of pts) {
+    const c = svgEl('circle', {cx: X(x), cy: Y(y), r: 3.5,
+      fill: color[st] || '#8b949e', opacity: 0.85}, svg);
+    const t = svgEl('title', {}, c);
+    t.textContent = `trial ${id}: ${y}`;
+  }
+  return svg;
+}
+
+// Parallel coordinates: one axis per numeric hparam + the searcher metric;
+// one polyline per trial, colored cold->hot by metric rank.
+function parallelCoords(trials, w = 470, h = 190) {
+  const pad = {l: 30, r: 30, t: 26, b: 14};
+  const svg = svgEl('svg', {width: w, height: h, style:
+    'background:#161b22;border:1px solid #21262d;border-radius:4px;margin:4px'});
+  const flat = (obj, prefix = '') => Object.entries(obj || {}).flatMap(
+    ([k, v]) => (v && typeof v === 'object' && !Array.isArray(v))
+      ? flat(v, prefix + k + '.')
+      : (typeof v === 'number' ? [[prefix + k, v]] : []));
+  const rows = trials.filter(t => t.searcher_metric != null)
+    .map(t => ({hp: Object.fromEntries(flat(t.hparams)),
+                metric: Number(t.searcher_metric)}));
+  if (!rows.length) return svg;
+  const axes = [...new Set(rows.flatMap(r => Object.keys(r.hp)))].sort();
+  axes.push('searcher metric');
+  rows.forEach(r => { r.hp['searcher metric'] = r.metric; });
+  const span = {};
+  for (const a of axes) span[a] = bounds(
+    rows.map(r => r.hp[a]).filter(v => v != null));
+  const AX = (i) => pad.l + i / Math.max(1, axes.length - 1) * (w - pad.l - pad.r);
+  const Y = (a, v) =>
+    h - pad.b - (v - span[a][0]) / (span[a][1] - span[a][0]) * (h - pad.t - pad.b);
+  axes.forEach((a, i) => {
+    svgEl('line', {x1: AX(i), x2: AX(i), y1: pad.t, y2: h - pad.b,
+                   stroke: '#30363d'}, svg);
+    const e = svgEl('text', {x: AX(i), y: pad.t - 10, fill: '#8b949e',
+      'font-size': 9, 'text-anchor': 'middle'}, svg);
+    e.textContent = a;  // textContent: hparam names are user-controlled
+  });
+  const [m0, m1] = bounds(rows.map(r => r.metric));
+  for (const r of rows) {
+    const f = (r.metric - m0) / (m1 - m0);  // 0 = best-ish blue, 1 = red
+    const col = `rgb(${Math.round(88 + f * 160)},${Math.round(166 - f * 90)},255)`;
+    svgEl('polyline', {
+      points: axes.filter(a => r.hp[a] != null)
+        .map((a) => `${AX(axes.indexOf(a))},${Y(a, r.hp[a])}`).join(' '),
+      fill: 'none', stroke: col, opacity: 0.65, 'stroke-width': 1.2}, svg);
+  }
+  return svg;
+}
+
+// Incremental accumulator (same pattern as log tailing): each 2s tick
+// fetches only rows after the cursor — a long trial's history is
+// transferred once, not on every refresh.
+let metState = {trial: null, after: 0, byKey: {}};
+
+async function drawTrialCharts(trialId) {
+  if (metState.trial !== trialId) metState = {trial: trialId, after: 0, byKey: {}};
+  const rows = (await j(
+    `/api/v1/trials/${trialId}/metrics?after=${metState.after}`)).metrics;
+  for (const row of rows) {
+    metState.after = Math.max(metState.after, row.id);
+    for (const [k, v] of Object.entries(row.body)) {
+      if (typeof v !== 'number' || !isFinite(v)) continue;
+      (metState.byKey[k] ??= {})[row.grp] ??= [];
+      metState.byKey[k][row.grp].push([row.steps_completed, v]);
+    }
+  }
+  if (!rows.length && $('charts').childNodes.length > 1) return; // nothing new
+  const div = $('charts');
+  div.textContent = '';
+  $('chart-label').textContent = `· trial ${trialId}`;
+  for (const key of Object.keys(metState.byKey).sort().slice(0, 8)) {
+    const series = Object.entries(metState.byKey[key]).map(
+      ([grp, points]) => ({name: grp, points}));
+    div.appendChild(lineChart(key, series));
+  }
+  if (!div.childNodes.length) div.textContent = '(no scalar metrics yet)';
+}
+
+function drawHpViz(trials) {
+  const div = $('hpviz');
+  div.textContent = '';
+  $('hp-label').textContent = `· experiment ${selExp}`;
+  div.appendChild(rungScatter(trials));
+  div.appendChild(parallelCoords(trials));
+}
+
 async function refresh() {
   try {
     const info = await j('/api/v1/master');
@@ -108,9 +279,11 @@ async function refresh() {
           cell(JSON.stringify(t.hparams)) +
           `<td><button onclick="selTrial=${t.id};logAfter=0;$('logs').textContent='';refresh()">logs</button></td></tr>`
         ).join('');
+      drawHpViz(trials);
     }
 
     if (selTrial !== null) {
+      await drawTrialCharts(selTrial);
       $('log-label').textContent = `· trial ${selTrial}`;
       const out = await j(`/api/v1/task_logs?task_id=trial-${selTrial}&after=${logAfter}`);
       for (const line of out.logs) {
