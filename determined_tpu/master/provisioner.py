@@ -61,31 +61,66 @@ class ScaleDecider:
         #: minutes-long boot would launch another instance.
         self.boot_timeout_s = boot_timeout_s
         self._idle_since: Dict[str, float] = {}
-        self._pending_boots: List[float] = []  # launch timestamps
+        # Boot credits: (launch timestamp, instance name once known). decide()
+        # issues anonymous credits; the provisioner service names them after
+        # the backend reports which instances it actually created, so losses
+        # and registrations retire exactly the right credit.
+        self._pending_boots: List[List] = []  # [ts, Optional[name]]
         self._known_agents: set = set()
+
+    def _retire_boot(self, name: str) -> None:
+        """Remove the credit for `name` — exact match first, else one
+        anonymous credit (backends that don't report names)."""
+        for i, (ts, n) in enumerate(self._pending_boots):
+            if n == name:
+                del self._pending_boots[i]
+                return
+        for i, (ts, n) in enumerate(self._pending_boots):
+            if n is None:
+                del self._pending_boots[i]
+                return
+
+    def reconcile_launch(self, requested: int, created: List[str]) -> None:
+        """Called by the service after backend.launch: name the credits of
+        the instances that were actually created and drop the credits of
+        failed creates — phantom capacity for a create that never happened
+        would stall the replacement launch for up to boot_timeout_s."""
+        names = list(created)
+        for entry in self._pending_boots:
+            if entry[1] is None and names:
+                entry[1] = names.pop(0)
+        failed = requested - len(created)
+        for _ in range(failed):
+            for i in range(len(self._pending_boots) - 1, -1, -1):
+                if self._pending_boots[i][1] is None:
+                    del self._pending_boots[i]
+                    break
 
     def notify_instance_lost(self, name: str) -> None:
         """An instance we were counting on is gone (spot reclaim, failed
-        boot). If it never registered as an agent, retire one pending-boot
-        credit immediately — otherwise the decider keeps counting the dead
-        instance's slots as arriving capacity for up to boot_timeout_s and
-        stalls the replacement launch for the requeued work."""
-        if name not in self._known_agents and self._pending_boots:
-            self._pending_boots.pop(0)
+        boot). Retire ITS credit — identity matters: popping someone else's
+        would undercount genuinely-arriving capacity and over-launch. An
+        instance that already registered has no credit left; this is then a
+        no-op."""
+        for i, (ts, n) in enumerate(self._pending_boots):
+            if n == name:
+                del self._pending_boots[i]
+                return
 
     def decide(self, pool: ResourcePool) -> ScaleDecision:
         now = time.time()
         agents = pool.agents_snapshot()
         pending_slots = int(pool.queue_snapshot()["pending_slots"])
 
-        # Retire pending boots: one per newly-registered agent, plus any
-        # that exceeded the boot timeout (instance presumed dead).
+        # Retire pending boots: one per newly-registered agent (its own
+        # credit when named), plus any that exceeded the boot timeout
+        # (instance presumed dead).
         for aid in agents:
             if aid not in self._known_agents and self._pending_boots:
-                self._pending_boots.pop(0)
+                self._retire_boot(aid)
         self._known_agents = set(agents)
         self._pending_boots = [
-            t for t in self._pending_boots if now - t < self.boot_timeout_s
+            e for e in self._pending_boots if now - e[0] < self.boot_timeout_s
         ]
         booting = len(self._pending_boots)
 
@@ -110,7 +145,7 @@ class ScaleDecider:
         launch = min(need, self.max_instances - total)
         launch = max(launch, self.min_instances - total)
         launch = max(0, launch)
-        self._pending_boots.extend([now] * launch)
+        self._pending_boots.extend([now, None] for _ in range(launch))
 
         terminate: List[str] = []
         if pending_slots == 0:
@@ -126,7 +161,7 @@ class ScaleDecider:
 
 
 class ProvisionerBackend(Protocol):
-    def launch(self, n: int) -> None: ...
+    def launch(self, n: int) -> Optional[List[str]]: ...
     def terminate(self, agent_ids: List[str]) -> None: ...
 
 
@@ -146,13 +181,15 @@ class LocalProvisioner:
         self.agents: Dict[str, object] = {}
         self._lock = threading.Lock()
 
-    def launch(self, n: int) -> None:
+    def launch(self, n: int) -> List[str]:
         from determined_tpu.agent.agent import AgentDaemon
 
+        created: List[str] = []
         for _ in range(n):
             with self._lock:
                 self._counter += 1
                 agent_id = f"{self.prefix}-{self._counter}"
+            created.append(agent_id)
             agent = AgentDaemon(
                 self.master_url, agent_id=agent_id, slots=self.slots,
                 pool=self.pool, token=self.token,
@@ -163,6 +200,7 @@ class LocalProvisioner:
             with self._lock:
                 self.agents[agent_id] = agent
             logger.info("provisioned local agent %s (%d slots)", agent_id, self.slots)
+        return created
 
     def terminate(self, agent_ids: List[str]) -> None:
         for aid in agent_ids:
@@ -391,17 +429,31 @@ class GCPTPUProvisioner:
             f"--agent-id {instance_name}{token_flag}\n"
         )
 
-    def launch(self, n: int) -> None:
+    def launch(self, n: int) -> List[str]:
+        """Create up to n instances; returns the names actually created so
+        the scale decider can drop boot credits for failed creates. A
+        create failure (quota, API error) stops the batch — later creates
+        would almost certainly fail the same way; demand persists, so the
+        next tick retries."""
+        created: List[str] = []
         for _ in range(n):
             with self._lock:
                 self._counter += 1
                 name = f"{self.prefix}-{self._counter}"
-            # _expected only after a successful create: a failed gcloud call
-            # must not leave a ghost that the next poll() misreports as a
-            # spot reclaim (phantom lose_agent alerts).
-            self.driver.create(name, self._startup_script(name), self.preemptible)
+            try:
+                # _expected only after a successful create: a failed gcloud
+                # call must not leave a ghost that the next poll()
+                # misreports as a spot reclaim (phantom lose_agent alerts).
+                self.driver.create(
+                    name, self._startup_script(name), self.preemptible
+                )
+            except Exception:  # noqa: BLE001
+                logger.exception("instance create failed for %s", name)
+                break
             with self._lock:
                 self._expected.add(name)
+            created.append(name)
+        return created
 
     def terminate(self, agent_ids: List[str]) -> None:
         for aid in agent_ids:
@@ -476,7 +528,9 @@ class ProvisionerService:
                     self.on_terminate(agent_id)
         decision = self.decider.decide(self.pool)
         if decision.launch:
-            self.backend.launch(decision.launch)
+            created = self.backend.launch(decision.launch)
+            if created is not None:
+                self.decider.reconcile_launch(decision.launch, created)
         if decision.terminate:
             self.backend.terminate(decision.terminate)
             if self.on_terminate is not None:

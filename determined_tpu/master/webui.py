@@ -213,29 +213,39 @@ function parallelCoords(trials, w = 470, h = 190) {
 
 // Incremental accumulator (same pattern as log tailing): each 2s tick
 // fetches only rows after the cursor — a long trial's history is
-// transferred once, not on every refresh.
-let metState = {trial: null, after: 0, byKey: {}};
+// transferred once, not on every refresh. Points are keyed by step with
+// the trial_run_id kept: a restarted trial re-reports steps from its
+// checkpoint, and the newer run's values must replace the superseded
+// run's (otherwise the polylines backtrack).
+let metState = {trial: null, after: 0, byKey: {}, drawn: false};
 
 async function drawTrialCharts(trialId) {
-  if (metState.trial !== trialId) metState = {trial: trialId, after: 0, byKey: {}};
+  if (metState.trial !== trialId)
+    metState = {trial: trialId, after: 0, byKey: {}, drawn: false};
   const rows = (await j(
     `/api/v1/trials/${trialId}/metrics?after=${metState.after}`)).metrics;
   for (const row of rows) {
     metState.after = Math.max(metState.after, row.id);
+    const run = row.trial_run_id || 0;
     for (const [k, v] of Object.entries(row.body)) {
       if (typeof v !== 'number' || !isFinite(v)) continue;
-      (metState.byKey[k] ??= {})[row.grp] ??= [];
-      metState.byKey[k][row.grp].push([row.steps_completed, v]);
+      const byStep = ((metState.byKey[k] ??= {})[row.grp] ??= {});
+      const prev = byStep[row.steps_completed];
+      if (!prev || run >= prev.run) byStep[row.steps_completed] = {run, v};
     }
   }
-  if (!rows.length && $('charts').childNodes.length > 1) return; // nothing new
+  if (!rows.length && metState.drawn) return; // nothing new: keep the DOM
   const div = $('charts');
   div.textContent = '';
   $('chart-label').textContent = `· trial ${trialId}`;
   for (const key of Object.keys(metState.byKey).sort().slice(0, 8)) {
     const series = Object.entries(metState.byKey[key]).map(
-      ([grp, points]) => ({name: grp, points}));
+      ([grp, byStep]) => ({name: grp, points:
+        Object.entries(byStep)
+          .map(([s, e]) => [Number(s), e.v])
+          .sort((a, b) => a[0] - b[0])}));
     div.appendChild(lineChart(key, series));
+    metState.drawn = true;
   }
   if (!div.childNodes.length) div.textContent = '(no scalar metrics yet)';
 }

@@ -1,0 +1,187 @@
+"""Chaos e2e (the reference's adaptive_chaos.yaml story) + API load gate.
+
+Chaos: agent churn during an adaptive-ASHA search with restart budgets,
+kill while checkpoints are flying, kill during the rendezvous window of a
+multi-process gang (ref fixture: e2e_tests/tests/fixtures/no_op/
+adaptive_chaos.yaml — trials keep completing through failure).
+
+Load: the reference gates API latency at p95 < 1s with < 1% errors
+(performance/src/api_performance_tests.ts:29-42); the same thresholds are
+asserted here against a master serving a populated DB under concurrent
+clients.
+"""
+import concurrent.futures
+import time
+
+import pytest
+
+from determined_tpu.devcluster import DevCluster
+
+ENTRY = "determined_tpu.exec.builtin_trials:SyntheticTrial"
+
+
+def _config(tmp_path, **over):
+    cfg = {
+        "entrypoint": ENTRY,
+        "searcher": {"name": "single", "max_length": 3, "metric": "loss"},
+        "hyperparameters": {"model": "mnist-mlp", "batch_size": 16, "lr": 1e-3},
+        "resources": {"slots_per_trial": 1},
+        "scheduling_unit": 1,
+        "min_checkpoint_period": {"batches": 1},
+        "checkpoint_storage": {"type": "shared_fs",
+                               "host_path": str(tmp_path / "ckpt")},
+        "environment": {"jax_platform": "cpu"},
+        "max_restarts": 3,
+    }
+    cfg.update(over)
+    return cfg
+
+
+class TestChaos:
+    def test_agent_churn_during_adaptive_asha(self, tmp_path):
+        """Kill-and-replace agents while an adaptive search runs; every
+        trial must still reach its rung through the restart budget."""
+        with DevCluster(n_agents=2, slots_per_agent=1) as dc:
+            exp_id = dc.create_experiment(_config(
+                tmp_path,
+                searcher={
+                    "name": "adaptive_asha", "metric": "loss",
+                    "max_trials": 4, "max_length": 6, "num_rungs": 2,
+                },
+                hyperparameters={
+                    "model": "mnist-mlp", "batch_size": 16,
+                    "lr": {"type": "log", "minval": -3, "maxval": -1},
+                    "sleep_s": 0.2,  # slow batches: churn lands mid-training
+                },
+            ))
+            exp = dc.master.get_experiment(exp_id)
+            assert exp is not None
+
+            churns = 0
+            deadline = time.time() + 600
+            replacement = 0
+            while exp.state not in ("COMPLETED", "ERRORED", "CANCELED"):
+                assert time.time() < deadline, f"stuck in {exp.state}"
+                # Kill a busy agent (mid-trial, possibly mid-checkpoint —
+                # every batch checkpoints) and bring up a replacement.
+                busy = [a for a in dc.agents if a._tasks]
+                if busy and churns < 3:
+                    victim = busy[0]
+                    dc.kill_agent(victim)
+                    dc.agents.remove(victim)
+                    replacement += 1
+                    dc.start_agent(f"replacement-{replacement}", 1)
+                    churns += 1
+                time.sleep(3.0)
+
+            assert exp.state == "COMPLETED", exp.state
+            assert churns >= 1, "chaos never actually fired"
+            trials = dc.master.db.list_trials(exp_id)
+            assert len(trials) == 4
+            # the churn really hit someone; the budget absorbed it
+            assert sum(t["restarts"] for t in trials) >= 1
+            assert all(t["state"] == "COMPLETED" for t in trials)
+
+    def test_kill_during_rendezvous(self, tmp_path):
+        """A 2-process gang loses one agent while the other is blocked in
+        the rendezvous long-poll; the master must fail the gang over and
+        the restarted trial complete on replacement capacity."""
+        with DevCluster(n_agents=2, slots_per_agent=1) as dc:
+            exp_id = dc.create_experiment(_config(
+                tmp_path,
+                resources={"slots_per_trial": 2},
+                searcher={"name": "single", "max_length": 3, "metric": "loss"},
+            ))
+            # Strike the moment a task process spawns: that is the
+            # rendezvous window (both ranks posting addresses and
+            # long-polling for the table).
+            deadline = time.time() + 120
+            victim = None
+            while time.time() < deadline and victim is None:
+                for agent in dc.agents:
+                    if agent._tasks:
+                        victim = agent
+                        break
+                time.sleep(0.05)
+            assert victim is not None, "gang never started"
+            dc.kill_agent(victim)
+            dc.agents.remove(victim)
+            dc.start_agent("replacement-rdv", 1)
+
+            state = dc.wait_experiment(exp_id, timeout=300)
+            assert state == "COMPLETED"
+            trial = dc.master.db.list_trials(exp_id)[0]
+            assert trial["restarts"] >= 1
+            assert trial["steps_completed"] == 3
+
+
+class TestApiLoadGate:
+    def test_p95_under_1s_and_error_rate_under_1pct(self):
+        """The reference's API performance gate (p95 < 1s, < 1% errors)
+        against a populated master under 8 concurrent clients."""
+        import requests
+
+        from determined_tpu.master.api_server import ApiServer
+        from determined_tpu.master.core import Master
+
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            # Populate: experiments, trials, metrics, logs — list endpoints
+            # must page through real content, not empty tables.
+            for e in range(10):
+                exp_id = master.db.add_experiment({
+                    "entrypoint": "x:T",
+                    "searcher": {"name": "random", "max_trials": 5},
+                })
+                for t in range(5):
+                    tid = master.db.add_trial(exp_id, t, {"lr": 0.1 * t})
+                    for step in range(1, 21):
+                        master.db.add_metrics(
+                            tid, "training", step, {"loss": 1.0 / step}
+                        )
+            paths = [
+                "/api/v1/experiments",
+                "/api/v1/experiments/1",
+                "/api/v1/experiments/1/trials",
+                "/api/v1/trials/1/metrics",
+                "/api/v1/master",
+                "/api/v1/queues",
+            ]
+            N_PER_WORKER = 40
+
+            def worker(seed):
+                # Per-worker tallies, summed after the barrier: a shared
+                # `errors += 1` from 8 threads is a lost-update race that
+                # could undercount and pass a breached gate.
+                lats, errs = [], 0
+                s = requests.Session()
+                for i in range(N_PER_WORKER):
+                    path = paths[(seed + i) % len(paths)]
+                    t0 = time.perf_counter()
+                    try:
+                        r = s.get(f"{api.url}{path}", timeout=10)
+                        ok = r.status_code == 200
+                    except Exception:
+                        ok = False
+                    lats.append(time.perf_counter() - t0)
+                    if not ok:
+                        errs += 1
+                return lats, errs
+
+            with concurrent.futures.ThreadPoolExecutor(8) as ex:
+                results = list(ex.map(worker, range(8)))
+            latencies = [t for lats, _ in results for t in lats]
+            errors = sum(e for _, e in results)
+
+            total = len(latencies)
+            assert total == 8 * N_PER_WORKER
+            p95 = sorted(latencies)[int(total * 0.95)]
+            error_rate = errors / total
+            print(f"p95={p95 * 1e3:.1f}ms error_rate={error_rate:.3%}")
+            assert p95 < 1.0, f"p95 {p95:.3f}s breaches the 1s gate"
+            assert error_rate < 0.01, f"error rate {error_rate:.2%} over 1%"
+        finally:
+            api.stop()
+            master.shutdown()

@@ -79,6 +79,40 @@ class TestScaleDecider:
         assert decider.decide(pool).terminate == []
 
 
+class TestBootCredits:
+    def test_failed_create_drops_credit_immediately(self):
+        """A create that never happened must not count as arriving capacity
+        for boot_timeout_s — the decider retries next tick."""
+        pool = ResourcePool("p")
+        decider = ScaleDecider(slots_per_instance=4, max_instances=8,
+                               boot_timeout_s=600)
+        pool.submit(Request("a1", 8), _noop_cb, _noop_cb)
+        d = decider.decide(pool)
+        assert d.launch == 2
+        # backend created only one of two
+        decider.reconcile_launch(2, ["vm-1"])
+        assert decider.decide(pool).launch == 1  # retry the failed one now
+
+    def test_lost_named_credit_retired_exactly(self):
+        """Spot reclaim during boot retires THAT instance's credit — not a
+        healthy booting sibling's."""
+        pool = ResourcePool("p")
+        decider = ScaleDecider(slots_per_instance=4, max_instances=8,
+                               boot_timeout_s=600)
+        pool.submit(Request("a1", 16), _noop_cb, _noop_cb)
+        assert decider.decide(pool).launch == 4
+        decider.reconcile_launch(4, ["vm-1", "vm-2", "vm-3", "vm-4"])
+        decider.notify_instance_lost("vm-2")
+        assert decider.decide(pool).launch == 1  # replace exactly vm-2
+        decider.reconcile_launch(1, ["vm-5"])
+        # a registered instance's credit is retired by name at registration
+        pool.add_agent("vm-1", 4)
+        assert decider.decide(pool).launch == 0
+        # losing an instance that already registered touches no credits
+        decider.notify_instance_lost("vm-1")
+        assert decider.decide(pool).launch == 0
+
+
 class TestGCPDriver:
     def test_command_stream(self):
         from determined_tpu.master.provisioner import GcloudTPUDriver
