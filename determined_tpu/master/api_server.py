@@ -61,6 +61,22 @@ AGENT_TOKEN_ROUTES = re.compile(
 )
 
 
+#: Cluster-administration surface: role `admin` only. Users/groups manage
+#: authorization itself; queue moves reorder other users' jobs; webhooks
+#: exfiltrate cluster events to external URLs; user-driven agent
+#: registration adds capacity (agents themselves use agent: tokens).
+ADMIN_ROUTES = re.compile(
+    r"^/api/v1/(users|groups)(/.*)?$"
+    r"|^/api/v1/queues/move$"
+    r"|^/api/v1/webhooks(/\d+)?$"
+    # Agent control plane: GET /actions destructively drains the agent's
+    # action queue (and refreshes its liveness), POST /events forges task
+    # exits. Agents authenticate with agent: tokens (class allowlist);
+    # user sessions touching these must be cluster admins.
+    r"|^/api/v1/agents/[\w.\-]+/(actions|events)$"
+)
+
+
 def principal_allowed(principal: str, path: str) -> bool:
     """Authorization by principal class (ref: the reference gates admin
     RPCs on user sessions; task/allocation tokens only reach the trial
@@ -69,7 +85,22 @@ def principal_allowed(principal: str, path: str) -> bool:
         return TASK_TOKEN_ROUTES.match(path) is not None
     if principal.startswith("agent:"):
         return AGENT_TOKEN_ROUTES.match(path) is not None
-    return True  # real users: full surface (roles arrive with RBAC)
+    return True  # users: per-role checks in user_allowed
+
+
+def user_allowed(role: str, method: str, path: str) -> bool:
+    """Role-based authorization for user principals (RBAC capability of
+    internal/rbac/api_rbac.go, scaled to three cluster roles).
+
+    GETs on the admin surface stay admin-gated too: group membership maps
+    users to capabilities, and the user list is reconnaissance."""
+    if ADMIN_ROUTES.match(path):
+        return role == "admin"
+    if method == "GET" or path == "/api/v1/auth/logout":
+        return True  # viewer floor
+    if path == "/api/v1/agents":
+        return role == "admin"  # user-driven capacity changes
+    return role in ("editor", "admin")
 
 
 def task_identity_violation(
@@ -310,6 +341,8 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
     # -- task logs -------------------------------------------------------------
     def post_task_logs(r: ApiRequest):
         m.db.add_task_logs(r.body["task_id"], r.body.get("logs", []))
+        if m.log_sink is not None:
+            m.log_sink.ship(r.body["task_id"], r.body.get("logs", []))
         return {}
 
     def get_task_logs(r: ApiRequest):
@@ -526,6 +559,59 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
             "agents": m.agent_hub.list(),
         }
 
+    # -- RBAC admin (ref internal/rbac + internal/usergroup) ----------------
+    def _persist_rbac():
+        m.db.set_kv("rbac", m.auth.rbac_state())
+
+    def list_users(r: ApiRequest):
+        state = m.auth.rbac_state()
+        return {"users": [
+            {"username": u, "role": role,
+             "effective_role": m.auth.effective_role(u)}
+            for u, role in sorted(state["roles"].items())
+        ]}
+
+    def set_user_role(r: ApiRequest):
+        try:
+            m.auth.set_user_role(r.groups[0], str(r.body.get("role", "")))
+        except KeyError as e:
+            raise ApiError(404, str(e))
+        except ValueError as e:
+            raise ApiError(400, str(e))
+        _persist_rbac()
+        return {}
+
+    def list_groups(r: ApiRequest):
+        return {"groups": m.auth.rbac_state()["groups"]}
+
+    def upsert_group(r: ApiRequest):
+        name = str(r.body.get("name", ""))
+        if not name:
+            raise ApiError(400, "group name required")
+        try:
+            m.auth.upsert_group(name, str(r.body.get("role", "viewer")))
+        except ValueError as e:
+            raise ApiError(400, str(e))
+        _persist_rbac()
+        return {}
+
+    def modify_group(r: ApiRequest):
+        try:
+            m.auth.modify_group_members(
+                r.groups[0],
+                add=[str(u) for u in r.body.get("add", [])],
+                remove=[str(u) for u in r.body.get("remove", [])],
+            )
+        except KeyError as e:
+            raise ApiError(404, str(e))
+        _persist_rbac()
+        return {}
+
+    def delete_group(r: ApiRequest):
+        m.auth.delete_group(r.groups[0])
+        _persist_rbac()
+        return {}
+
     def auth_login(r: ApiRequest):
         token = m.auth.login(r.body.get("username", ""), r.body.get("password", ""))
         if token is None:
@@ -621,6 +707,12 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("GET", r"/api/v1/experiments/(\d+)/searcher/events", searcher_events),
         R("POST", r"/api/v1/experiments/(\d+)/searcher/operations", post_searcher_ops),
         R("GET", r"/api/v1/master", master_info),
+        R("GET", r"/api/v1/users", list_users),
+        R("POST", r"/api/v1/users/([\w.\-]+)/role", set_user_role),
+        R("GET", r"/api/v1/groups", list_groups),
+        R("POST", r"/api/v1/groups", upsert_group),
+        R("POST", r"/api/v1/groups/([\w.\-]+)/members", modify_group),
+        R("DELETE", r"/api/v1/groups/([\w.\-]+)", delete_group),
         R("POST", r"/api/v1/auth/login", auth_login),
         R("POST", r"/api/v1/auth/logout", auth_logout),
         R("GET", r"/prom/metrics", prometheus_metrics),
@@ -712,6 +804,14 @@ class ApiServer:
                             "error": f"{principal} may not access {parsed.path}"
                         })
                         return
+                    if not principal.startswith(("task:", "agent:")):
+                        role = master.auth.effective_role(principal)
+                        if not user_allowed(role, method, parsed.path):
+                            self._send(403, {
+                                "error": f"role {role} may not {method} "
+                                         f"{parsed.path}"
+                            })
+                            return
                 body: Dict[str, Any] = {}
                 raw: bytes = b""
                 length = int(self.headers.get("Content-Length") or 0)
@@ -743,6 +843,14 @@ class ApiServer:
                         continue
                     match = pat.match(parsed.path)
                     if match:
+                        # One span per API request (the gin-middleware
+                        # analog of the reference's otel wiring); the route
+                        # PATTERN names the span, not the raw path —
+                        # bounded-cardinality names are the OTel norm.
+                        span = master.tracer.start_span(
+                            f"http {method} {pat.pattern}",
+                            {"http.method": method, "http.target": parsed.path},
+                        )
                         try:
                             result = handler(
                                 ApiRequest(
@@ -752,6 +860,7 @@ class ApiServer:
                                     raw=raw,
                                 )
                             )
+                            span.set_attribute("http.status_code", 200)
                             self._send(200, result if result is not None else {})
                         except _PlainText as pt:
                             data = (
@@ -769,12 +878,20 @@ class ApiServer:
                             # mid-response); nothing to answer.
                             pass
                         except ApiError as e:
+                            span.set_attribute("http.status_code", e.status)
+                            if e.status >= 500:
+                                span.status = "ERROR"
                             self._send(e.status, {"error": str(e)})
                         except KeyError as e:
+                            span.set_attribute("http.status_code", 404)
                             self._send(404, {"error": f"not found: {e}"})
                         except Exception as e:  # noqa: BLE001
+                            span.status = "ERROR"
+                            span.set_attribute("http.status_code", 500)
                             logger.exception("handler error %s %s", method, parsed.path)
                             self._send(500, {"error": str(e)})
+                        finally:
+                            master.tracer.end_span(span)
                         return
                 self._send(404, {"error": f"no route {method} {parsed.path}"})
 

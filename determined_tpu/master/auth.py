@@ -1,11 +1,22 @@
-"""Authentication: user sessions + task tokens.
+"""Authentication + RBAC: user sessions, roles, groups, task tokens.
 
-Rebuild of the reference's session auth (`internal/user` session tokens;
-RBAC is EE-gated there and out of scope here): optional — a master started
-with a `users` map requires a Bearer token on every API call except login,
-the WebUI page, and /metrics. Tasks the master launches get their own
+Rebuild of the reference's session auth (`internal/user` session tokens)
+plus the capability of its EE RBAC layer (`internal/rbac/api_rbac.go`,
+`internal/usergroup/`), scaled to the platform: three cluster roles —
+
+- ``viewer``  — read the whole API (GETs), nothing else;
+- ``editor``  — viewer + create/modify experiments, tasks, models;
+- ``admin``   — editor + cluster administration (users, groups, queues).
+
+A user's effective role is the strongest of their own role and the roles
+of the groups they belong to (the reference's role-assignment union,
+usergroup/service.go). Group membership and role overrides persist in the
+master DB and survive restarts. Auth is optional — a master started with
+a `users` map requires a Bearer token on every API call except login, the
+WebUI page, and /metrics. Tasks the master launches get their own
 short-lived tokens injected via DTPU_SESSION_TOKEN, so harness→master
-traffic authenticates without user credentials.
+traffic authenticates without user credentials (scoped by principal
+class, not role).
 """
 from __future__ import annotations
 
@@ -14,7 +25,10 @@ import hmac
 import secrets
 import threading
 import time
-from typing import Dict, Optional
+from typing import Any, Dict, List, Optional, Union
+
+ROLES = ("viewer", "editor", "admin")
+_ROLE_RANK = {r: i for i, r in enumerate(ROLES)}
 
 
 def _hash(password: str, salt: str) -> str:
@@ -24,17 +38,102 @@ def _hash(password: str, salt: str) -> str:
 
 
 class AuthService:
-    def __init__(self, users: Optional[Dict[str, str]] = None,
-                 session_ttl_s: float = 7 * 24 * 3600.0) -> None:
+    def __init__(
+        self,
+        users: Optional[Dict[str, Union[str, Dict[str, Any]]]] = None,
+        session_ttl_s: float = 7 * 24 * 3600.0,
+    ) -> None:
+        """`users` values are either a bare password (role defaults to
+        admin — the pre-RBAC contract, kept so existing configs keep their
+        capabilities) or {"password": ..., "role": "viewer"|"editor"|"admin"}.
+        """
         self.enabled = bool(users)
         self._salt = secrets.token_hex(8)
-        self._users = {
-            name: _hash(password, self._salt)
-            for name, password in (users or {}).items()
-        }
+        self._users: Dict[str, str] = {}
+        self._roles: Dict[str, str] = {}     # username -> assigned role
+        for name, spec in (users or {}).items():
+            if isinstance(spec, str):
+                password, role = spec, "admin"
+            else:
+                password = str(spec.get("password", ""))
+                role = str(spec.get("role", "editor"))
+            if role not in _ROLE_RANK:
+                raise ValueError(f"unknown role {role!r} for user {name!r}")
+            if not password:
+                # A forgotten "password" key must fail the config, not
+                # silently create an account anyone can log into with "".
+                raise ValueError(f"user {name!r} has an empty password")
+            self._users[name] = _hash(password, self._salt)
+            self._roles[name] = role
+        self._groups: Dict[str, Dict[str, Any]] = {}  # name -> {role, members}
         self._tokens: Dict[str, Dict] = {}   # token -> {user, expires}
         self._ttl = session_ttl_s
         self._lock = threading.Lock()
+
+    # -- RBAC --------------------------------------------------------------
+    def effective_role(self, username: str) -> str:
+        """Strongest of the user's own role and their groups' roles."""
+        with self._lock:
+            best = self._roles.get(username, "viewer")
+            for g in self._groups.values():
+                if username in g["members"]:
+                    if _ROLE_RANK[g["role"]] > _ROLE_RANK[best]:
+                        best = g["role"]
+            return best
+
+    def set_user_role(self, username: str, role: str) -> None:
+        if role not in _ROLE_RANK:
+            raise ValueError(f"unknown role {role!r}")
+        if username not in self._users:
+            raise KeyError(f"unknown user {username!r}")
+        with self._lock:
+            self._roles[username] = role
+
+    def upsert_group(self, name: str, role: str) -> None:
+        if role not in _ROLE_RANK:
+            raise ValueError(f"unknown role {role!r}")
+        with self._lock:
+            g = self._groups.setdefault(name, {"role": role, "members": set()})
+            g["role"] = role
+
+    def delete_group(self, name: str) -> None:
+        with self._lock:
+            self._groups.pop(name, None)
+
+    def modify_group_members(
+        self, name: str, add: List[str] = (), remove: List[str] = ()
+    ) -> None:
+        with self._lock:
+            if name not in self._groups:
+                raise KeyError(f"unknown group {name!r}")
+            members = self._groups[name]["members"]
+            members.update(add)
+            members.difference_update(remove)
+
+    def rbac_state(self) -> Dict[str, Any]:
+        """Snapshot for persistence (master DB) and the API."""
+        with self._lock:
+            return {
+                "roles": dict(self._roles),
+                "groups": {
+                    n: {"role": g["role"], "members": sorted(g["members"])}
+                    for n, g in self._groups.items()
+                },
+            }
+
+    def load_rbac_state(self, state: Optional[Dict[str, Any]]) -> None:
+        """Restore persisted role overrides + groups (master restart)."""
+        if not state:
+            return
+        with self._lock:
+            for user, role in state.get("roles", {}).items():
+                if user in self._users and role in _ROLE_RANK:
+                    self._roles[user] = role
+            for name, g in state.get("groups", {}).items():
+                self._groups[name] = {
+                    "role": g.get("role", "viewer"),
+                    "members": set(g.get("members", [])),
+                }
 
     def login(self, username: str, password: str) -> Optional[str]:
         want = self._users.get(username)

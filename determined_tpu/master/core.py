@@ -201,6 +201,9 @@ class Master:
         users: Optional[Dict[str, str]] = None,
         config_defaults: Optional[Dict[str, Any]] = None,
         kube_client: Optional[Any] = None,
+        trace_file: Optional[str] = None,
+        otlp_endpoint: Optional[str] = None,
+        log_sink_url: Optional[str] = None,
     ) -> None:
         self.cluster_id = uuid.uuid4().hex[:8]
         self.external_url = external_url
@@ -224,7 +227,18 @@ class Master:
         from determined_tpu.master.auth import AuthService
         from determined_tpu.master.proxy import ProxyRegistry
 
+        from determined_tpu.master.tracing import tracer_from_config
+
+        self.tracer = tracer_from_config(trace_file, otlp_endpoint)
+        self.log_sink = None
+        if log_sink_url:
+            from determined_tpu.master.logsink import ElasticLogSink
+
+            self.log_sink = ElasticLogSink(log_sink_url)
         self.auth = AuthService(users)
+        # Role overrides + groups persist across master restarts (the
+        # reference's usergroup tables; here the kv store).
+        self.auth.load_rbac_state(self.db.get_kv("rbac"))
         self.proxy = ProxyRegistry()
         self.launcher = RMTrialLauncher(self)
         self.agent_timeout_s = agent_timeout_s
@@ -234,6 +248,7 @@ class Master:
         self._alloc_index: Dict[str, tuple] = {}   # alloc_id -> (exp, trial_id)
         self._trial_allocs: Dict[int, str] = {}    # trial_id -> latest alloc_id
         self._alloc_pool: Dict[str, str] = {}      # alloc_id -> pool name
+        self._alloc_spans: Dict[str, Any] = {}     # alloc_id -> tracing span
         self._commands: Dict[str, Dict[str, Any]] = {}  # task_id -> command info
         self._cmd_counter = 0
         self._provisioners: List[Any] = []  # ProvisionerService
@@ -309,6 +324,18 @@ class Master:
             alloc_id, task_id=task_id, trial_id=trial_id,
             state="ASSIGNED", slots=slots,
         )
+        # Allocation lifecycle span (explicit start/end — completes in
+        # _allocation_exited, the long-span pattern of the reference's otel
+        # instrumentation).
+        span = self.tracer.start_span(
+            "allocation",
+            {
+                "alloc.id": alloc_id, "task.id": task_id,
+                "task.type": task_type, "slots": slots,
+            },
+        )
+        with self._lock:
+            self._alloc_spans[alloc_id] = span
         rank_envs: List[tuple] = []
         for rank, agent_id in enumerate(hosts):
             info = _info.ClusterInfo(
@@ -438,11 +465,23 @@ class Master:
     def shutdown(self) -> None:
         self._stop.set()
         self.webhooks.stop()
+        self.tracer.stop()
+        if self.log_sink is not None:
+            self.log_sink.stop()
         for svc in self._provisioners:
             svc.stop()
 
     # -- allocation exits ------------------------------------------------------
     def _allocation_exited(self, alloc) -> None:
+        with self._lock:
+            span = self._alloc_spans.pop(alloc.id, None)
+        if span is not None:
+            span.set_attribute("exit_code", alloc.exit_code or 0)
+            if alloc.exit_reason:
+                span.set_attribute("exit_reason", alloc.exit_reason)
+            if alloc.exit_code:
+                span.status = "ERROR"
+            self.tracer.end_span(span)
         self.db.upsert_allocation(
             alloc.id, state="TERMINATED", ended_at=time.time(),
             exit_reason=alloc.exit_reason,

@@ -117,6 +117,10 @@ CREATE TABLE IF NOT EXISTS projects (
     created_at REAL,
     UNIQUE (workspace_id, name)
 );
+CREATE TABLE IF NOT EXISTS kv (
+    key TEXT PRIMARY KEY,
+    value TEXT NOT NULL               -- JSON
+);
 INSERT OR IGNORE INTO workspaces (id, name, created_at) VALUES (1, 'Uncategorized', 0);
 INSERT OR IGNORE INTO projects (id, name, workspace_id, created_at) VALUES (1, 'Uncategorized', 1, 0);
 """
@@ -212,6 +216,18 @@ class Database:
         if d.get("searcher_snapshot"):
             d["searcher_snapshot"] = json.loads(d["searcher_snapshot"])
         return d
+
+    # -- generic kv (small master-owned state: RBAC assignments, etc.) -------
+    def set_kv(self, key: str, value: Any) -> None:
+        self._execute(
+            "INSERT INTO kv (key, value) VALUES (?, ?) "
+            "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
+            (key, json.dumps(value)),
+        )
+
+    def get_kv(self, key: str) -> Optional[Any]:
+        rows = self._query("SELECT value FROM kv WHERE key=?", (key,))
+        return json.loads(rows[0]["value"]) if rows else None
 
     def set_experiment_state(self, exp_id: int, state: str) -> None:
         self._execute(

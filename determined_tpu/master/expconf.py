@@ -24,7 +24,7 @@ CURRENT_VERSION = 1
 
 KNOWN_SEARCHERS = {"single", "random", "grid", "asha", "adaptive_asha", "custom"}
 NEEDS_MAX_TRIALS = {"random", "asha", "adaptive_asha"}
-KNOWN_STORAGE = {"shared_fs", "gcs", "s3"}
+KNOWN_STORAGE = {"shared_fs", "gcs", "s3", "azure"}
 KNOWN_HP_TYPES = {"const", "categorical", "int", "double", "log"}
 MESH_AXES = {"data", "fsdp", "tensor", "pipeline", "context", "expert"}
 
@@ -254,6 +254,8 @@ def validate(config: Dict[str, Any]) -> List[str]:
                 errors.append("checkpoint_storage.host_path required for shared_fs")
             if typ in ("gcs", "s3") and not storage.get("bucket"):
                 errors.append(f"checkpoint_storage.bucket required for {typ}")
+            if typ == "azure" and not storage.get("container"):
+                errors.append("checkpoint_storage.container required for azure")
             for key in ("save_experiment_best", "save_trial_best", "save_trial_latest"):
                 v = storage.get(key)
                 if v is not None and (not isinstance(v, int) or v < 0):

@@ -1,0 +1,112 @@
+"""External log sink: ship task logs to an Elasticsearch-compatible store.
+
+Rebuild of the reference's Elastic log backend (`master/internal/elastic/
+elastic_task_logs.go`): SQLite remains the system of record for the API's
+log reads (one pod's control plane), but fleets that outgrow it point
+`--log-sink-url` at an Elasticsearch/OpenSearch cluster and every ingested
+batch is ALSO shipped in `_bulk` NDJSON format on a background thread —
+the same queue-and-drain shape as the webhook shipper, so a slow or down
+sink never blocks the agents' log POSTs.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, List
+
+logger = logging.getLogger("determined_tpu.master")
+
+
+class ElasticLogSink:
+    def __init__(
+        self,
+        base_url: str,
+        index: str = "dtpu-task-logs",
+        *,
+        max_queue: int = 10_000,
+        flush_batch: int = 500,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.index = index
+        self._q: "queue.Queue[Dict[str, Any]]" = queue.Queue(maxsize=max_queue)
+        self._flush_batch = flush_batch
+        self._dropped = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="dtpu-log-sink", daemon=True
+        )
+        self._thread.start()
+
+    def ship(self, task_id: str, lines: List[Dict[str, Any]]) -> None:
+        """Enqueue log lines; never blocks the ingest path. Overflow drops
+        (counted) rather than stalling agents — the SQLite copy still has
+        everything."""
+        now = time.time()
+        for line in lines:
+            doc = {
+                "task_id": task_id,
+                "timestamp": line.get("ts", now),
+                "level": line.get("level", "INFO"),
+                "log": line.get("log", ""),
+            }
+            try:
+                self._q.put_nowait(doc)
+            except queue.Full:
+                self._dropped += 1
+
+    def _drain(self, block: bool) -> List[Dict[str, Any]]:
+        docs: List[Dict[str, Any]] = []
+        try:
+            docs.append(self._q.get(timeout=0.5 if block else 0))
+        except queue.Empty:
+            return docs
+        while len(docs) < self._flush_batch:
+            try:
+                docs.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        return docs
+
+    def _post_bulk(self, docs: List[Dict[str, Any]]) -> None:
+        import urllib.request
+
+        lines = []
+        for doc in docs:
+            lines.append(json.dumps({"index": {"_index": self.index}}))
+            lines.append(json.dumps(doc))
+        payload = ("\n".join(lines) + "\n").encode()
+        req = urllib.request.Request(
+            f"{self.base_url}/_bulk",
+            data=payload,
+            headers={"Content-Type": "application/x-ndjson"},
+        )
+        urllib.request.urlopen(req, timeout=30).read()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            docs = self._drain(block=True)
+            if not docs:
+                continue
+            try:
+                self._post_bulk(docs)
+            except Exception:  # noqa: BLE001 — sink loss must not cascade
+                self._dropped += len(docs)
+                logger.warning(
+                    "log sink %s unreachable; dropped %d lines "
+                    "(SQLite copy retained)", self.base_url, len(docs),
+                )
+
+    def stop(self) -> None:
+        self._stop.set()
+        # final best-effort drain
+        docs = self._drain(block=False)
+        while docs:
+            try:
+                self._post_bulk(docs)
+            except Exception:  # noqa: BLE001
+                break
+            docs = self._drain(block=False)
+        self._thread.join(timeout=5)

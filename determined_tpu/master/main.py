@@ -30,6 +30,16 @@ def main() -> None:
                         help='JSON pools config, e.g. {"default":{"scheduler":{"type":"priority"}}}')
     parser.add_argument("--preempt-timeout", type=float, default=600.0)
     parser.add_argument(
+        "--trace-file", default=None,
+        help="write OTLP-shaped spans (one JSON per line) to this file")
+    parser.add_argument(
+        "--otlp-endpoint", default=None,
+        help="export spans to this OTLP/HTTP collector base URL")
+    parser.add_argument(
+        "--log-sink-url", default=None,
+        help="also ship task logs to this Elasticsearch-compatible base URL "
+             "(_bulk format)")
+    parser.add_argument(
         "--config-defaults", default=None,
         help="JSON experiment-config defaults merged under every submitted "
              'config (master.yaml analog), e.g. {"max_restarts": 2}')
@@ -43,6 +53,9 @@ def main() -> None:
         config_defaults=(
             json.loads(args.config_defaults) if args.config_defaults else None
         ),
+        trace_file=args.trace_file,
+        otlp_endpoint=args.otlp_endpoint,
+        log_sink_url=args.log_sink_url,
     )
     api = ApiServer(master, host=args.host, port=args.port)
     master.external_url = args.external_url or f"http://127.0.0.1:{api.port}"

@@ -1,0 +1,260 @@
+"""Tracing: OTel-semantics spans for the master's request/allocation paths.
+
+Rebuild of the reference's OpenTelemetry wiring (`master/pkg/opentelemetry/
+otel.go:7` — gin/gorm instrumentation exporting OTLP). The SDK isn't baked
+into this image, so the span model is implemented directly with the same
+semantics and the OTLP/JSON wire shape:
+
+- spans carry trace_id/span_id/parent_span_id, ns timestamps, attributes,
+  and status; parenting is implicit via a contextvar, so nested `span()`
+  blocks across threads-of-request compose like OTel context propagation;
+- exporters: JSONL to a file (air-gapped default — each line is one
+  OTLP-shaped span, greppable and loadable into any OTel pipeline later)
+  or OTLP/HTTP JSON to a collector endpoint when one is reachable.
+
+Instrumented: every API request (http.method/route/status — the gin analog)
+and allocation lifecycles (explicit start/end, like gorm's long spans).
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import logging
+import secrets
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+logger = logging.getLogger("determined_tpu.master")
+
+_current_span: contextvars.ContextVar = contextvars.ContextVar(
+    "dtpu_current_span", default=None
+)
+
+
+def _ns(t: float) -> int:
+    return int(t * 1e9)
+
+
+class Span:
+    __slots__ = (
+        "trace_id", "span_id", "parent_span_id", "name", "start", "end",
+        "attributes", "status",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        trace_id: str,
+        parent_span_id: Optional[str],
+        attributes: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self.trace_id = trace_id
+        self.span_id = secrets.token_hex(8)
+        self.parent_span_id = parent_span_id
+        self.name = name
+        self.start = time.time()
+        self.end: Optional[float] = None
+        self.attributes: Dict[str, Any] = dict(attributes or {})
+        self.status = "OK"
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def to_otlp(self) -> Dict[str, Any]:
+        """One span in OTLP/JSON shape (the `spans` array element)."""
+        return {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            **(
+                {"parentSpanId": self.parent_span_id}
+                if self.parent_span_id else {}
+            ),
+            "name": self.name,
+            "startTimeUnixNano": _ns(self.start),
+            "endTimeUnixNano": _ns(self.end if self.end else time.time()),
+            "attributes": [
+                {"key": k, "value": _attr_value(v)}
+                for k, v in self.attributes.items()
+            ],
+            "status": {"code": 2 if self.status == "ERROR" else 1},
+        }
+
+
+def _attr_value(v: Any) -> Dict[str, Any]:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+class JsonlExporter:
+    """One OTLP-shaped span per line; air-gapped default."""
+
+    def __init__(self, path: str) -> None:
+        self._path = path
+        self._lock = threading.Lock()
+
+    def export(self, spans: List[Span]) -> None:
+        with self._lock, open(self._path, "a") as f:
+            for span in spans:
+                f.write(json.dumps(span.to_otlp()) + "\n")
+
+
+class OTLPHttpExporter:
+    """POST OTLP/JSON batches to a collector's /v1/traces endpoint.
+
+    Best-effort: trace loss must never take the control plane down with it.
+    """
+
+    def __init__(self, endpoint: str, service_name: str = "dtpu-master") -> None:
+        self.endpoint = endpoint.rstrip("/") + "/v1/traces"
+        self.service_name = service_name
+
+    def export(self, spans: List[Span]) -> None:
+        import urllib.request
+
+        payload = {
+            "resourceSpans": [{
+                "resource": {"attributes": [{
+                    "key": "service.name",
+                    "value": {"stringValue": self.service_name},
+                }]},
+                "scopeSpans": [{
+                    "scope": {"name": "determined_tpu"},
+                    "spans": [s.to_otlp() for s in spans],
+                }],
+            }]
+        }
+        req = urllib.request.Request(
+            self.endpoint,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10).read()
+        except Exception:  # noqa: BLE001
+            logger.warning("trace export to %s failed", self.endpoint)
+
+
+class Tracer:
+    """Span factory + batching pipeline (the OTel BatchSpanProcessor role:
+    finished spans queue up and flush on size/interval from one thread)."""
+
+    def __init__(
+        self, exporter: Any, *, batch_size: int = 64, flush_interval_s: float = 5.0
+    ) -> None:
+        self.exporter = exporter
+        self._batch: List[Span] = []
+        self._batch_size = batch_size
+        self._interval = flush_interval_s
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="dtpu-tracer-flush", daemon=True
+        )
+        self._thread.start()
+
+    # -- span lifecycle ----------------------------------------------------
+    @contextlib.contextmanager
+    def span(
+        self, name: str, attributes: Optional[Dict[str, Any]] = None
+    ) -> Iterator[Span]:
+        s = self.start_span(name, attributes)
+        token = _current_span.set(s)
+        try:
+            yield s
+        except BaseException:
+            s.status = "ERROR"
+            raise
+        finally:
+            _current_span.reset(token)
+            self.end_span(s)
+
+    def start_span(
+        self, name: str, attributes: Optional[Dict[str, Any]] = None
+    ) -> Span:
+        parent: Optional[Span] = _current_span.get()
+        if parent is not None:
+            return Span(name, parent.trace_id, parent.span_id, attributes)
+        return Span(name, secrets.token_hex(16), None, attributes)
+
+    def end_span(self, span: Span) -> None:
+        span.end = time.time()
+        with self._lock:
+            self._batch.append(span)
+            full = len(self._batch) >= self._batch_size
+        if full:
+            # Wake the flush thread instead of exporting inline: a slow
+            # collector must never stall the API thread that happened to
+            # end the 64th span.
+            self._wake.set()
+
+    def flush(self) -> None:
+        with self._lock:
+            batch, self._batch = self._batch, []
+        if batch:
+            try:
+                self.exporter.export(batch)
+            except Exception:  # noqa: BLE001
+                logger.exception("span export failed")
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=self._interval)
+            self._wake.clear()
+            if self._stop.is_set():
+                return  # stop() does the final flush
+            self.flush()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=5)
+        self.flush()
+
+
+class NullTracer:
+    """Tracing disabled: same surface, zero work on the hot path."""
+
+    @contextlib.contextmanager
+    def span(self, name: str, attributes: Optional[Dict[str, Any]] = None):
+        yield _NULL_SPAN
+
+    def start_span(self, name, attributes=None):
+        return _NULL_SPAN
+
+    def end_span(self, span) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+
+class _NullSpanType:
+    trace_id = span_id = parent_span_id = ""
+    status = "OK"
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpanType()
+
+
+def tracer_from_config(
+    trace_file: Optional[str] = None, otlp_endpoint: Optional[str] = None
+):
+    if otlp_endpoint:
+        return Tracer(OTLPHttpExporter(otlp_endpoint))
+    if trace_file:
+        return Tracer(JsonlExporter(trace_file))
+    return NullTracer()

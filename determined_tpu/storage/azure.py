@@ -1,0 +1,119 @@
+"""Azure Blob storage manager (ref: harness/determined/common/storage/
+azure.py:12 + azure_client.py).
+
+Same contract as the GCS/S3 managers. The azure-storage-blob client is
+imported lazily and gated; `container_client` can be injected (tests use an
+in-memory fake, the reference's strategy for its azure unit tests) so the
+manager's logic is exercised without the SDK or network.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, List, Optional
+
+from determined_tpu.storage.base import StorageManager
+
+
+class AzureStorageManager(StorageManager):
+    def __init__(
+        self,
+        container: str,
+        prefix: str = "",
+        connection_string: Optional[str] = None,
+        account_url: Optional[str] = None,
+        container_client: Optional[Any] = None,
+    ) -> None:
+        super().__init__(base_path=f"azure://{container}/{prefix}")
+        if container_client is not None:
+            self._container = container_client
+        else:
+            try:
+                from azure.storage.blob import (  # type: ignore
+                    BlobServiceClient,
+                )
+            except ImportError as e:
+                raise RuntimeError(
+                    "azure-storage-blob is not installed; use "
+                    "checkpoint_storage.type=shared_fs/gcs/s3 or install "
+                    "the Azure client"
+                ) from e
+            if connection_string:
+                svc = BlobServiceClient.from_connection_string(connection_string)
+            elif account_url:
+                # DefaultAzureCredential comes from azure-identity; imported
+                # lazily for the same gating reason.
+                from azure.identity import DefaultAzureCredential  # type: ignore
+
+                svc = BlobServiceClient(
+                    account_url, credential=DefaultAzureCredential()
+                )
+            else:
+                raise ValueError(
+                    "azure storage needs connection_string or account_url"
+                )
+            self._container = svc.get_container_client(container)
+        self._prefix = prefix.strip("/")
+
+    def _key(self, storage_id: str, rel: str = "") -> str:
+        parts = [p for p in (self._prefix, storage_id, rel) if p]
+        return "/".join(parts)
+
+    def upload(
+        self, src: str, storage_id: str, paths: Optional[List[str]] = None
+    ) -> None:
+        rels = paths if paths is not None else self._list_dir(src)
+        for rel in rels:
+            with open(os.path.join(src, rel), "rb") as f:
+                self._container.upload_blob(
+                    self._key(storage_id, rel), f, overwrite=True
+                )
+
+    def download(
+        self,
+        storage_id: str,
+        dst: str,
+        selector: Optional[Callable[[str], bool]] = None,
+    ) -> None:
+        prefix = self._key(storage_id) + "/"
+        exists = False
+        for name in self._blob_names(prefix):
+            rel = name[len(prefix):]
+            if not rel:
+                continue
+            exists = True
+            if selector is not None and not selector(rel):
+                continue
+            target = os.path.join(dst, rel)
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            stream = self._container.download_blob(name)
+            with open(target, "wb") as f:
+                f.write(stream.readall())
+        # Missing checkpoint is an error; a selector matching nothing in an
+        # existing checkpoint is not (mirrors SharedFSStorageManager).
+        if not exists:
+            raise FileNotFoundError(
+                f"checkpoint {storage_id} not found at azure://{prefix}"
+            )
+
+    def delete(
+        self, storage_id: str, paths: Optional[List[str]] = None
+    ) -> List[str]:
+        prefix = self._key(storage_id) + "/"
+        deleted = []
+        for name in list(self._blob_names(prefix)):
+            rel = name[len(prefix):]
+            if paths is not None and rel not in paths:
+                continue
+            self._container.delete_blob(name)
+            deleted.append(rel)
+        return deleted
+
+    def list_files(self, storage_id: str) -> List[str]:
+        prefix = self._key(storage_id) + "/"
+        return sorted(name[len(prefix):] for name in self._blob_names(prefix))
+
+    def _blob_names(self, prefix: str) -> List[str]:
+        out = []
+        for item in self._container.list_blobs(name_starts_with=prefix):
+            out.append(item if isinstance(item, str) else item.name)
+        return out

@@ -89,4 +89,12 @@ def from_config(config: Optional[dict], base_dir: Optional[str] = None) -> Stora
             config["bucket"], config.get("prefix", ""),
             endpoint_url=config.get("endpoint_url"),
         )
+    if typ == "azure":
+        from determined_tpu.storage.azure import AzureStorageManager
+
+        return AzureStorageManager(
+            config["container"], config.get("prefix", ""),
+            connection_string=config.get("connection_string"),
+            account_url=config.get("account_url"),
+        )
     raise ValueError(f"unknown checkpoint storage type: {typ}")

@@ -1,0 +1,171 @@
+"""RBAC: roles, groups, enforcement, persistence (VERDICT r1 missing #5;
+ref internal/rbac/api_rbac.go + internal/usergroup)."""
+import pytest
+import requests
+
+from determined_tpu.master.api_server import ApiServer
+from determined_tpu.master.core import Master
+
+USERS = {
+    "root": "rootpw",                                  # bare string = admin
+    "eve": {"password": "evepw", "role": "editor"},
+    "vic": {"password": "vicpw", "role": "viewer"},
+}
+
+GOOD_EXP = {
+    "entrypoint": "m:T", "unmanaged": True,
+    "searcher": {"name": "single"},
+}
+
+
+def _login(url, user, pw):
+    r = requests.post(
+        f"{url}/api/v1/auth/login",
+        json={"username": user, "password": pw}, timeout=10,
+    )
+    r.raise_for_status()
+    return {"Authorization": "Bearer " + r.json()["token"]}
+
+
+@pytest.fixture()
+def secured(tmp_path):
+    master = Master(db_path=str(tmp_path / "m.db"), users=USERS)
+    api = ApiServer(master)
+    api.start()
+    master.external_url = api.url
+    yield master, api
+    api.stop()
+    master.shutdown()
+
+
+class TestRoles:
+    def test_viewer_reads_but_cannot_write(self, secured):
+        _, api = secured
+        h = _login(api.url, "vic", "vicpw")
+        assert requests.get(
+            f"{api.url}/api/v1/experiments", headers=h, timeout=10
+        ).status_code == 200
+        r = requests.post(
+            f"{api.url}/api/v1/experiments",
+            json={"config": GOOD_EXP}, headers=h, timeout=10,
+        )
+        assert r.status_code == 403
+        assert "viewer" in r.json()["error"]
+
+    def test_editor_creates_but_no_admin_surface(self, secured):
+        _, api = secured
+        h = _login(api.url, "eve", "evepw")
+        r = requests.post(
+            f"{api.url}/api/v1/experiments",
+            json={"config": GOOD_EXP}, headers=h, timeout=10,
+        )
+        assert r.status_code == 200
+        for method, path, body in [
+            ("GET", "/api/v1/users", None),
+            ("POST", "/api/v1/groups", {"name": "g", "role": "admin"}),
+            ("POST", "/api/v1/webhooks",
+             {"url": "http://x/", "events": ["COMPLETED"]}),
+            ("POST", "/api/v1/queues/move", {"alloc_id": "x"}),
+        ]:
+            r = requests.request(
+                method, f"{api.url}{path}", json=body, headers=h, timeout=10
+            )
+            assert r.status_code == 403, (method, path, r.status_code)
+
+    def test_bare_string_user_is_admin(self, secured):
+        _, api = secured
+        h = _login(api.url, "root", "rootpw")
+        r = requests.get(f"{api.url}/api/v1/users", headers=h, timeout=10)
+        assert r.status_code == 200
+        users = {u["username"]: u for u in r.json()["users"]}
+        assert users["root"]["role"] == "admin"
+        assert users["vic"]["role"] == "viewer"
+
+    def test_agent_control_plane_admin_only(self, secured):
+        """GET /agents/{id}/actions drains the agent's action queue and
+        POST /events forges exits — user sessions below admin are barred
+        even though one is a GET."""
+        master, api = secured
+        for user, pw, want in (("vic", "vicpw", 403), ("eve", "evepw", 403),
+                               ("root", "rootpw", 200)):
+            h = _login(api.url, user, pw)
+            r = requests.get(
+                f"{api.url}/api/v1/agents/ag-1/actions?timeout_seconds=0",
+                headers=h, timeout=10,
+            )
+            assert r.status_code == want, (user, r.status_code)
+        r = requests.post(
+            f"{api.url}/api/v1/agents/ag-1/events",
+            json={"type": "EXITED", "alloc_id": "x"},
+            headers=_login(api.url, "eve", "evepw"), timeout=10,
+        )
+        assert r.status_code == 403
+
+    def test_empty_password_config_rejected(self):
+        with pytest.raises(ValueError, match="empty password"):
+            Master(users={"ops": {"role": "editor"}})
+
+    def test_task_tokens_unaffected_by_rbac(self, secured):
+        master, api = secured
+        tok = master.auth.issue_task_token("trial-1")
+        h = {"Authorization": "Bearer " + tok}
+        # still scoped by class allowlist, not roles
+        assert requests.get(
+            f"{api.url}/api/v1/master", headers=h, timeout=10
+        ).status_code == 200
+        assert requests.get(
+            f"{api.url}/api/v1/users", headers=h, timeout=10
+        ).status_code == 403
+
+
+class TestGroups:
+    def test_group_role_union_and_membership(self, secured):
+        master, api = secured
+        root = _login(api.url, "root", "rootpw")
+        r = requests.post(
+            f"{api.url}/api/v1/groups",
+            json={"name": "ops", "role": "admin"}, headers=root, timeout=10,
+        )
+        assert r.status_code == 200
+        requests.post(
+            f"{api.url}/api/v1/groups/ops/members",
+            json={"add": ["vic"]}, headers=root, timeout=10,
+        ).raise_for_status()
+        # vic's own role is viewer; group membership lifts them to admin
+        assert master.auth.effective_role("vic") == "admin"
+        vic = _login(api.url, "vic", "vicpw")
+        assert requests.get(
+            f"{api.url}/api/v1/users", headers=vic, timeout=10
+        ).status_code == 200
+        # removal drops the lift
+        requests.post(
+            f"{api.url}/api/v1/groups/ops/members",
+            json={"remove": ["vic"]}, headers=root, timeout=10,
+        ).raise_for_status()
+        assert master.auth.effective_role("vic") == "viewer"
+
+    def test_rbac_persists_across_restart(self, secured, tmp_path):
+        master, api = secured
+        root = _login(api.url, "root", "rootpw")
+        requests.post(
+            f"{api.url}/api/v1/groups",
+            json={"name": "sre", "role": "editor"}, headers=root, timeout=10,
+        ).raise_for_status()
+        requests.post(
+            f"{api.url}/api/v1/groups/sre/members",
+            json={"add": ["vic"]}, headers=root, timeout=10,
+        ).raise_for_status()
+        requests.post(
+            f"{api.url}/api/v1/users/eve/role",
+            json={"role": "viewer"}, headers=root, timeout=10,
+        ).raise_for_status()
+        db_path = master.db._path
+
+        api.stop()
+        master.shutdown()
+        m2 = Master(db_path=db_path, users=USERS)
+        try:
+            assert m2.auth.effective_role("vic") == "editor"  # via group
+            assert m2.auth.effective_role("eve") == "viewer"  # override kept
+        finally:
+            m2.shutdown()

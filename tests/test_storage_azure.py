@@ -1,0 +1,109 @@
+"""Azure Blob storage backend against an in-memory container client
+(VERDICT r1 missing #7; ref harness/determined/common/storage/azure.py)."""
+import io
+import os
+
+import pytest
+
+from determined_tpu.storage.azure import AzureStorageManager
+from determined_tpu.storage.base import from_config
+
+
+class _FakeContainerClient:
+    """The subset of azure.storage.blob.ContainerClient the manager uses."""
+
+    def __init__(self):
+        self.blobs = {}
+
+    def upload_blob(self, name, stream, overwrite=False):
+        if not overwrite and name in self.blobs:
+            raise ValueError(f"blob {name} exists")
+        self.blobs[name] = stream.read()
+
+    def download_blob(self, name):
+        data = self.blobs[name]
+
+        class _Stream:
+            def readall(self):
+                return data
+
+        return _Stream()
+
+    def delete_blob(self, name):
+        del self.blobs[name]
+
+    def list_blobs(self, name_starts_with=""):
+        return [n for n in sorted(self.blobs) if n.startswith(name_starts_with)]
+
+
+@pytest.fixture()
+def mgr():
+    return AzureStorageManager(
+        "ckpts", prefix="team", container_client=_FakeContainerClient()
+    )
+
+
+def _write_tree(root, files):
+    for rel, content in files.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(content)
+
+
+class TestAzureStorage:
+    def test_upload_download_roundtrip(self, mgr, tmp_path):
+        src = tmp_path / "src"
+        _write_tree(str(src), {"a.npy": b"AAA", "sub/b.npy": b"BBB"})
+        mgr.upload(str(src), "ck-1")
+        assert mgr.list_files("ck-1") == ["a.npy", "sub/b.npy"]
+
+        dst = tmp_path / "dst"
+        mgr.download("ck-1", str(dst))
+        assert (dst / "a.npy").read_bytes() == b"AAA"
+        assert (dst / "sub" / "b.npy").read_bytes() == b"BBB"
+
+    def test_selector_and_restore_path(self, mgr, tmp_path):
+        src = tmp_path / "src"
+        _write_tree(str(src), {"rank0.npy": b"0", "rank1.npy": b"1",
+                               "metadata.json": b"{}"})
+        mgr.upload(str(src), "ck-2")
+        with mgr.restore_path(
+            "ck-2", selector=lambda p: p != "rank1.npy"
+        ) as path:
+            assert sorted(os.listdir(path)) == ["metadata.json", "rank0.npy"]
+
+    def test_partial_upload_paths(self, mgr, tmp_path):
+        src = tmp_path / "src"
+        _write_tree(str(src), {"x": b"x", "y": b"y"})
+        mgr.upload(str(src), "ck-3", paths=["x"])
+        assert mgr.list_files("ck-3") == ["x"]
+
+    def test_delete(self, mgr, tmp_path):
+        src = tmp_path / "src"
+        _write_tree(str(src), {"x": b"x", "y": b"y"})
+        mgr.upload(str(src), "ck-4")
+        assert sorted(mgr.delete("ck-4", paths=["x"])) == ["x"]
+        assert mgr.list_files("ck-4") == ["y"]
+        assert sorted(mgr.delete("ck-4")) == ["y"]
+
+    def test_missing_checkpoint_raises(self, mgr, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            mgr.download("nope", str(tmp_path))
+
+    def test_prefix_isolation(self, tmp_path):
+        client = _FakeContainerClient()
+        a = AzureStorageManager("c", prefix="a", container_client=client)
+        b = AzureStorageManager("c", prefix="b", container_client=client)
+        src = tmp_path / "src"
+        _write_tree(str(src), {"f": b"f"})
+        a.upload(str(src), "ck")
+        with pytest.raises(FileNotFoundError):
+            b.download("ck", str(tmp_path / "out"))
+
+    def test_from_config_gated_without_sdk(self):
+        # No azure sdk in this image: constructing through expconf raises
+        # the informative gate, not an ImportError traceback.
+        with pytest.raises(RuntimeError, match="azure-storage-blob"):
+            from_config({"type": "azure", "container": "c",
+                         "connection_string": "cs"})
