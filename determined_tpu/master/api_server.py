@@ -708,7 +708,7 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("POST", r"/api/v1/experiments/(\d+)/searcher/operations", post_searcher_ops),
         R("GET", r"/api/v1/master", master_info),
         R("GET", r"/api/v1/users", list_users),
-        R("POST", r"/api/v1/users/([\w.\-]+)/role", set_user_role),
+        R("POST", r"/api/v1/users/([\w.@+\-]+)/role", set_user_role),
         R("GET", r"/api/v1/groups", list_groups),
         R("POST", r"/api/v1/groups", upsert_group),
         R("POST", r"/api/v1/groups/([\w.\-]+)/members", modify_group),
@@ -784,6 +784,16 @@ class ApiServer:
                         if principal.startswith(("task:", "agent:")):
                             self._send(403, {
                                 "error": "task/agent tokens may not access "
+                                         "proxied services"
+                            })
+                            return
+                        # Proxied services ARE code execution (notebook
+                        # kernels, PTY shells): the viewer role's read-only
+                        # contract must hold here too, not just on /api/v1.
+                        role = master.auth.effective_role(principal)
+                        if role not in ("editor", "admin"):
+                            self._send(403, {
+                                "error": f"role {role} may not access "
                                          "proxied services"
                             })
                             return

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import re
 import secrets
 import threading
 import time
@@ -87,11 +88,36 @@ class AuthService:
         if username not in self._users:
             raise KeyError(f"unknown user {username!r}")
         with self._lock:
+            if (
+                role != "admin"
+                and self._roles.get(username) == "admin"
+                and not any(
+                    r == "admin" and u != username
+                    for u, r in self._roles.items()
+                )
+            ):
+                # Demoting the last assigned admin would lock every admin
+                # route for everyone, persistently — no API recovery path.
+                raise ValueError(
+                    f"{username!r} is the last admin; assign another admin "
+                    "before demoting"
+                )
             self._roles[username] = role
+
+    #: Group names must round-trip through the API routes that manage them
+    #: (`/api/v1/groups/<name>/members`, DELETE) — a name outside the route
+    #: character class would create a role-granting group no API call can
+    #: ever modify or delete.
+    _NAME_RE = re.compile(r"^[\w.\-]+$")
 
     def upsert_group(self, name: str, role: str) -> None:
         if role not in _ROLE_RANK:
             raise ValueError(f"unknown role {role!r}")
+        if not self._NAME_RE.match(name):
+            raise ValueError(
+                f"group name {name!r} must match [A-Za-z0-9_.-]+ "
+                "(it appears in management URLs)"
+            )
         with self._lock:
             g = self._groups.setdefault(name, {"role": role, "members": set()})
             g["role"] = role

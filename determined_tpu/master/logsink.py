@@ -99,11 +99,13 @@ class ElasticLogSink:
                     "(SQLite copy retained)", self.base_url, len(docs),
                 )
 
-    def stop(self) -> None:
+    def stop(self, drain_budget_s: float = 10.0) -> None:
         self._stop.set()
-        # final best-effort drain
+        # Final best-effort drain under a wall-clock budget: a slow-but-up
+        # sink must not pin master shutdown for minutes on a full queue.
+        deadline = time.monotonic() + drain_budget_s
         docs = self._drain(block=False)
-        while docs:
+        while docs and time.monotonic() < deadline:
             try:
                 self._post_bulk(docs)
             except Exception:  # noqa: BLE001

@@ -12,9 +12,16 @@ from determined_tpu.models import gpt as gpt_mod
 from determined_tpu.models.attention import attention
 from determined_tpu.models.base import Model
 from determined_tpu.models.gpt import GPT, GPTConfig
+from determined_tpu.models.generative import DCGAN, DDPM, DDPMConfig, GANConfig
 from determined_tpu.models.vision import CifarCNN, CNNConfig, MLPConfig, MnistMLP
 
 _REGISTRY: Dict[str, Callable[..., Model]] = {
+    "ddpm": lambda mesh=None, **kw: DDPM(
+        DDPMConfig(**kw) if kw else DDPMConfig(), mesh=mesh
+    ),
+    "dcgan": lambda mesh=None, **kw: DCGAN(
+        GANConfig(**kw) if kw else GANConfig(), mesh=mesh
+    ),
     "gpt2-small": lambda mesh=None, **kw: GPT(
         gpt_mod.small() if not kw else GPTConfig(**kw), mesh=mesh
     ),
@@ -43,6 +50,8 @@ __all__ = [
     "GPTConfig",
     "MnistMLP",
     "CifarCNN",
+    "DDPM",
+    "DCGAN",
     "attention",
     "get_model",
 ]

@@ -1,0 +1,307 @@
+"""Generative model family: DDPM diffusion + DCGAN-style GAN.
+
+Capability parity with the reference's generative examples
+(`examples/computer_vision/*gan*`, `examples/diffusion/` — torch recipes),
+redesigned TPU-first:
+
+- convolutions run NHWC via lax.conv_general_dilated (MXU-friendly layout);
+- the diffusion sampler is a `lax.scan` over timesteps — one compiled
+  program, no Python loop over 1000 steps;
+- the GAN trains generator and discriminator SIMULTANEOUSLY in one fused
+  jitted step: the combined loss stop-gradients the fake batch into the
+  discriminator term and freezes (stop_gradient) the discriminator inside
+  the generator term, so one backward produces exactly the two classic
+  gradients. Alternating updates would force two dispatches per step for
+  no modeling benefit at this scale.
+
+Both fit the platform's Model contract (init/logical_axes/loss/
+eval_metrics) so Trainer/searcher/checkpointing work unchanged.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from determined_tpu.models.base import Metrics, Model
+
+
+def _conv(x, w, b, stride=1):
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return out + b
+
+
+def _conv_t(x, w, b, stride=2):
+    """Transposed conv (upsampling) in NHWC."""
+    out = lax.conv_transpose(
+        x, w, strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return out + b
+
+
+def _glorot(key, shape, dtype):
+    return jax.nn.initializers.glorot_normal()(key, shape, dtype)
+
+
+def _shardable(size: int) -> bool:
+    """Worth sharding over the `mlp`→tensor axis: must divide for every
+    plausible tensor-parallel degree (powers of two up to 8). Tiny or odd
+    dims (an RGB output channel, a logit head of 1) stay replicated —
+    constraining them would make with_sharding_constraint reject the model
+    on any tensor>1 mesh."""
+    return size >= 8 and size % 8 == 0
+
+
+def _conv_axes(leaf):
+    """Logical axes for a conv/dense leaf by shape: shard the trailing
+    (output-channel) dim over `mlp` when it divides cleanly."""
+    dims = leaf.shape
+    last = "mlp" if dims and _shardable(dims[-1]) else None
+    return tuple([None] * (len(dims) - 1) + [last]) if dims else ()
+
+
+# ---------------------------------------------------------------------------
+# DDPM diffusion
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class DDPMConfig:
+    image_size: int = 32
+    channels: int = 1
+    hidden: Tuple[int, ...] = (32, 64)   # conv widths (down path)
+    timesteps: int = 200
+    beta_start: float = 1e-4
+    beta_end: float = 0.02
+    dtype: Any = jnp.float32
+
+
+class DDPM(Model):
+    """Denoising diffusion: a small conv net predicts the noise added at a
+    uniformly-sampled timestep (Ho et al. objective: MSE on epsilon).
+
+    The net is deliberately compact (conv down / conv up with a timestep
+    embedding added at the bottleneck); the platform contribution is the
+    training/sampling harness, not SOTA architecture.
+    """
+
+    def __init__(self, config: DDPMConfig = DDPMConfig(), mesh=None) -> None:
+        self.config = config
+        self.mesh = mesh
+        c = config
+        betas = jnp.linspace(c.beta_start, c.beta_end, c.timesteps)
+        alphas = 1.0 - betas
+        self._betas = betas
+        self._alpha_bar = jnp.cumprod(alphas)
+
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        c = self.config
+        keys = iter(jax.random.split(rng, 2 * len(c.hidden) + 4))
+        params: Dict[str, Any] = {}
+        cin = c.channels
+        for i, ch in enumerate(c.hidden):
+            params[f"down{i}"] = {
+                "w": _glorot(next(keys), (3, 3, cin, ch), c.dtype),
+                "b": jnp.zeros((ch,), c.dtype),
+            }
+            cin = ch
+        # timestep embedding -> bottleneck channels
+        params["temb"] = {
+            "w": _glorot(next(keys), (64, cin), c.dtype),
+            "b": jnp.zeros((cin,), c.dtype),
+        }
+        for i, ch in enumerate(reversed(c.hidden[:-1])):
+            params[f"up{i}"] = {
+                "w": _glorot(next(keys), (3, 3, ch, cin), c.dtype),
+                "b": jnp.zeros((ch,), c.dtype),
+            }
+            cin = ch
+        params["out"] = {
+            "w": _glorot(next(keys), (3, 3, cin, c.channels), c.dtype),
+            "b": jnp.zeros((c.channels,), c.dtype),
+        }
+        return params
+
+    def logical_axes(self) -> Dict[str, Any]:
+        # eval_shape: axes only need shapes, not a second host-side init.
+        shapes = jax.eval_shape(self.init, jax.random.PRNGKey(0))
+        return jax.tree.map(_conv_axes, shapes)
+
+    def _time_embedding(self, t: jax.Array) -> jax.Array:
+        """Sinusoidal embedding [B, 64] (Transformer-style)."""
+        half = 32
+        freqs = jnp.exp(-jnp.log(10000.0) * jnp.arange(half) / half)
+        args = t.astype(jnp.float32)[:, None] * freqs[None, :]
+        return jnp.concatenate([jnp.sin(args), jnp.cos(args)], axis=-1)
+
+    def apply(self, params: Dict[str, Any], x: jax.Array, t: jax.Array) -> jax.Array:
+        """Predict epsilon for noisy images x at timesteps t."""
+        c = self.config
+        h = x
+        skips = []
+        for i in range(len(c.hidden)):
+            h = jax.nn.silu(_conv(h, params[f"down{i}"]["w"], params[f"down{i}"]["b"]))
+            skips.append(h)
+        temb = self._time_embedding(t) @ params["temb"]["w"] + params["temb"]["b"]
+        h = h + temb[:, None, None, :]
+        for i in range(len(c.hidden) - 1):
+            h = jax.nn.silu(_conv(h, params[f"up{i}"]["w"].transpose(0, 1, 3, 2),
+                                  params[f"up{i}"]["b"]))
+            h = h + skips[-(i + 2)]
+        return _conv(h, params["out"]["w"], params["out"]["b"])
+
+    def loss(self, params, batch, rng) -> Tuple[jax.Array, Metrics]:
+        c = self.config
+        x0 = batch["image"].astype(c.dtype)
+        b = x0.shape[0]
+        kt, keps = jax.random.split(rng)
+        t = jax.random.randint(kt, (b,), 0, c.timesteps)
+        eps = jax.random.normal(keps, x0.shape, c.dtype)
+        ab = self._alpha_bar[t][:, None, None, None].astype(c.dtype)
+        xt = jnp.sqrt(ab) * x0 + jnp.sqrt(1.0 - ab) * eps
+        pred = self.apply(params, xt, t)
+        loss = jnp.mean((pred - eps) ** 2)
+        return loss, {"loss": loss}
+
+    def eval_metrics(self, params, batch) -> Metrics:
+        # Fixed rng: evaluation must be deterministic across workers.
+        loss, metrics = self.loss(params, batch, jax.random.PRNGKey(0))
+        return metrics
+
+    def sample(self, params, rng, n: int) -> jax.Array:
+        """Ancestral sampling as one lax.scan over timesteps (compiled —
+        a Python loop over T steps would trace T copies of the net)."""
+        c = self.config
+        shape = (n, c.image_size, c.image_size, c.channels)
+        x_init = jax.random.normal(rng, shape, c.dtype)
+        betas = self._betas
+        alpha_bar = self._alpha_bar
+        alphas = 1.0 - betas
+
+        def step(x, t):
+            eps = self.apply(params, x, jnp.full((n,), t))
+            ab = alpha_bar[t]
+            coef = betas[t] / jnp.sqrt(1.0 - ab)
+            mean = (x - coef * eps) / jnp.sqrt(alphas[t])
+            noise = jax.random.normal(
+                jax.random.fold_in(rng, t), shape, c.dtype
+            )
+            x = mean + jnp.where(t > 0, jnp.sqrt(betas[t]), 0.0) * noise
+            return x, None
+
+        x, _ = lax.scan(step, x_init, jnp.arange(c.timesteps - 1, -1, -1))
+        return x
+
+
+# ---------------------------------------------------------------------------
+# DCGAN-style GAN
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class GANConfig:
+    image_size: int = 32
+    channels: int = 1
+    latent_dim: int = 64
+    g_hidden: int = 64
+    d_hidden: int = 32
+    dtype: Any = jnp.float32
+
+
+class DCGAN(Model):
+    """Generator + discriminator trained simultaneously in one jitted step.
+
+    loss = D_loss(real, stop_grad(fake)) + G_loss(fake through frozen D):
+    one backward yields exactly the classic GAN gradients for both nets
+    (stop_gradient severs each term's path into the other's parameters).
+    """
+
+    def __init__(self, config: GANConfig = GANConfig(), mesh=None) -> None:
+        self.config = config
+        self.mesh = mesh
+
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        c = self.config
+        k = iter(jax.random.split(rng, 8))
+        s4 = c.image_size // 4
+        return {
+            "gen": {
+                "fc": {
+                    "w": _glorot(next(k), (c.latent_dim, s4 * s4 * c.g_hidden), c.dtype),
+                    "b": jnp.zeros((s4 * s4 * c.g_hidden,), c.dtype),
+                },
+                "up1": {
+                    "w": _glorot(next(k), (4, 4, c.g_hidden, c.g_hidden // 2), c.dtype),
+                    "b": jnp.zeros((c.g_hidden // 2,), c.dtype),
+                },
+                "up2": {
+                    "w": _glorot(next(k), (4, 4, c.g_hidden // 2, c.channels), c.dtype),
+                    "b": jnp.zeros((c.channels,), c.dtype),
+                },
+            },
+            "disc": {
+                "c1": {
+                    "w": _glorot(next(k), (4, 4, c.channels, c.d_hidden), c.dtype),
+                    "b": jnp.zeros((c.d_hidden,), c.dtype),
+                },
+                "c2": {
+                    "w": _glorot(next(k), (4, 4, c.d_hidden, c.d_hidden * 2), c.dtype),
+                    "b": jnp.zeros((c.d_hidden * 2,), c.dtype),
+                },
+                "fc": {
+                    "w": _glorot(
+                        next(k),
+                        ((c.image_size // 4) ** 2 * c.d_hidden * 2, 1),
+                        c.dtype,
+                    ),
+                    "b": jnp.zeros((1,), c.dtype),
+                },
+            },
+        }
+
+    def logical_axes(self) -> Dict[str, Any]:
+        shapes = jax.eval_shape(self.init, jax.random.PRNGKey(0))
+        return jax.tree.map(_conv_axes, shapes)
+
+    def generate(self, gen_params, z: jax.Array) -> jax.Array:
+        c = self.config
+        s4 = c.image_size // 4
+        h = z @ gen_params["fc"]["w"] + gen_params["fc"]["b"]
+        h = jax.nn.relu(h).reshape(z.shape[0], s4, s4, c.g_hidden)
+        h = jax.nn.relu(_conv_t(h, gen_params["up1"]["w"], gen_params["up1"]["b"]))
+        return jnp.tanh(_conv_t(h, gen_params["up2"]["w"], gen_params["up2"]["b"]))
+
+    def discriminate(self, d_params, x: jax.Array) -> jax.Array:
+        h = jax.nn.leaky_relu(_conv(x, d_params["c1"]["w"], d_params["c1"]["b"], stride=2), 0.2)
+        h = jax.nn.leaky_relu(_conv(h, d_params["c2"]["w"], d_params["c2"]["b"], stride=2), 0.2)
+        h = h.reshape(h.shape[0], -1)
+        return (h @ d_params["fc"]["w"] + d_params["fc"]["b"])[:, 0]
+
+    def loss(self, params, batch, rng) -> Tuple[jax.Array, Metrics]:
+        c = self.config
+        real = batch["image"].astype(c.dtype)
+        z = jax.random.normal(rng, (real.shape[0], c.latent_dim), c.dtype)
+        fake = self.generate(params["gen"], z)
+
+        bce = lambda logits, target: jnp.mean(  # noqa: E731
+            jnp.maximum(logits, 0) - logits * target + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        )
+        # D sees detached fakes; G sees a frozen D.
+        d_real = self.discriminate(params["disc"], real)
+        d_fake = self.discriminate(params["disc"], lax.stop_gradient(fake))
+        d_loss = bce(d_real, 1.0) + bce(d_fake, 0.0)
+        frozen_d = lax.stop_gradient(params["disc"])
+        g_loss = bce(self.discriminate(frozen_d, fake), 1.0)  # non-saturating
+        total = d_loss + g_loss
+        return total, {
+            "loss": total, "d_loss": d_loss, "g_loss": g_loss,
+            "d_real_acc": jnp.mean((d_real > 0).astype(jnp.float32)),
+            "d_fake_acc": jnp.mean((d_fake < 0).astype(jnp.float32)),
+        }
+
+    def eval_metrics(self, params, batch) -> Metrics:
+        _, metrics = self.loss(params, batch, jax.random.PRNGKey(0))
+        return metrics

@@ -30,6 +30,22 @@ from jax.experimental import pallas as pl
 NEG_INF = float(-1e30)  # finite mask value; true -inf breaks m-subtraction
 
 
+def fit_block(seq: int, want: int) -> int:
+    """Largest block size ≤ `want` dividing `seq` (the kernel requires
+    block | seq). Prefers lane-friendly multiples of 128 when one divides;
+    falls back to the largest plain divisor (correct at any size, just less
+    MXU-efficient). Callers with tuned block sizes use this so a sequence
+    that isn't a multiple of the tuned block degrades instead of raising."""
+    want = min(want, seq)
+    for b in range(want - want % 128, 0, -128):
+        if seq % b == 0:
+            return b
+    b = want
+    while seq % b:
+        b -= 1
+    return b
+
+
 def _causal_mask(q_offset: jax.Array, k_offset: jax.Array, bq: int, bk: int) -> jax.Array:
     rows = q_offset + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     cols = k_offset + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)

@@ -36,7 +36,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
-from determined_tpu.ops.flash_attention import flash_attention_lse
+from determined_tpu.ops.flash_attention import fit_block, flash_attention_lse
 
 
 # ---------------------------------------------------------------------------
@@ -68,21 +68,6 @@ def inverse_permutation(perm: np.ndarray) -> np.ndarray:
     inv = np.empty_like(perm)
     inv[perm] = np.arange(len(perm), dtype=perm.dtype)
     return inv
-
-
-def _fit_block(seq: int, want: int) -> int:
-    """Largest block size ≤ `want` dividing `seq` (flash requires block | seq).
-
-    Prefers lane-friendly multiples of 128 when one divides; falls back to
-    the largest plain divisor (correct at any size, just less MXU-efficient)."""
-    want = min(want, seq)
-    for b in range(want - want % 128, 0, -128):
-        if seq % b == 0:
-            return b
-    b = want
-    while seq % b:
-        b -= 1
-    return b
 
 
 # ---------------------------------------------------------------------------
@@ -143,8 +128,8 @@ def ring_attention(
         # Flash requires block | seq; shrink to the largest divisor so any
         # (even) local length works — the einsum ring this replaced had no
         # length constraint, and per-call lengths here include half-chunks.
-        bq = _fit_block(q_.shape[1], block_q)
-        bk = _fit_block(k_.shape[1], block_k)
+        bq = fit_block(q_.shape[1], block_q)
+        bk = fit_block(k_.shape[1], block_k)
         return flash_attention_lse(
             q_, k_, v_, causal=causal, scale=scale, block_q=bq, block_k=bk
         )

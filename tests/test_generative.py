@@ -1,0 +1,214 @@
+"""Generative model family: DDPM + DCGAN (VERDICT §2.4 examples gap;
+parity with the reference's torch GAN/diffusion example recipes)."""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from determined_tpu.models.generative import (
+    DCGAN,
+    DDPM,
+    DDPMConfig,
+    GANConfig,
+)
+
+
+def _tiny_ddpm():
+    return DDPM(DDPMConfig(image_size=8, channels=1, hidden=(8, 16),
+                           timesteps=10))
+
+
+def _tiny_gan():
+    return DCGAN(GANConfig(image_size=8, channels=1, latent_dim=8,
+                           g_hidden=8, d_hidden=8))
+
+
+def _blob_batch(n=8, size=8):
+    rng = np.random.default_rng(0)
+    cx = rng.uniform(0.25, 0.75, (n, 1, 1, 1))
+    xs = np.linspace(0, 1, size).reshape(1, size, 1, 1)
+    ys = np.linspace(0, 1, size).reshape(1, 1, size, 1)
+    img = np.exp(-(((xs - cx) ** 2 + (ys - cx) ** 2) / 0.02)) * 2 - 1
+    return {"image": jnp.asarray(img, jnp.float32)}
+
+
+class TestDDPM:
+    def test_loss_finite_and_decreases(self):
+        import optax
+
+        model = _tiny_ddpm()
+        params = model.init(jax.random.PRNGKey(0))
+        tx = optax.adam(1e-3)
+        opt = tx.init(params)
+
+        @jax.jit
+        def step(params, opt, rng):
+            (loss, _), grads = jax.value_and_grad(model.loss, has_aux=True)(
+                params, _blob_batch(), rng
+            )
+            updates, opt = tx.update(grads, opt)
+            return optax.apply_updates(params, updates), opt, loss
+
+        rng = jax.random.PRNGKey(1)
+        first = None
+        for i in range(30):
+            rng, sub = jax.random.split(rng)
+            params, opt, loss = step(params, opt, sub)
+            if first is None:
+                first = float(loss)
+        assert np.isfinite(float(loss))
+        assert float(loss) < first
+
+    def test_sampler_shapes_and_finiteness(self):
+        model = _tiny_ddpm()
+        params = model.init(jax.random.PRNGKey(0))
+        out = jax.jit(lambda p, r: model.sample(p, r, 2))(
+            params, jax.random.PRNGKey(3)
+        )
+        assert out.shape == (2, 8, 8, 1)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_eval_deterministic(self):
+        model = _tiny_ddpm()
+        params = model.init(jax.random.PRNGKey(0))
+        m1 = model.eval_metrics(params, _blob_batch())
+        m2 = model.eval_metrics(params, _blob_batch())
+        assert float(m1["loss"]) == float(m2["loss"])
+
+    def test_trains_under_tensor_parallel_mesh(self, devices8):
+        """Size-1 output channels must stay replicated: a tensor>1 mesh
+        rejected the old axes at init (VERDICT-style regression guard)."""
+        import optax
+
+        from determined_tpu import core
+        from determined_tpu.parallel.mesh import MeshConfig, make_mesh
+        from determined_tpu.trainer import Batch, JAXTrial, Trainer
+
+        mesh = make_mesh(MeshConfig(data=2, tensor=2), devices8[:4])
+
+        class T(JAXTrial):
+            def build_model(self, m):
+                return _tiny_ddpm()
+
+            def build_optimizer(self):
+                return optax.adam(1e-3)
+
+            def build_training_data(self):
+                while True:
+                    yield {
+                        "image": np.asarray(_blob_batch()["image"]),
+                    }
+
+            def build_validation_data(self):
+                return [{"image": np.asarray(_blob_batch()["image"])}]
+
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            ctx = core._context._dummy_init(checkpoint_storage=d)
+            tr = Trainer(T(), ctx, mesh=mesh)
+            out = tr.fit(max_length=Batch(2))
+            assert np.isfinite(out["loss"])
+
+
+class TestDCGAN:
+    def test_simultaneous_grads_are_the_classic_ones(self):
+        """stop_gradient plumbing: D's gradient must be exactly the D-loss
+        gradient and G's exactly the (non-saturating) G-loss gradient —
+        no leakage between the two terms."""
+        model = _tiny_gan()
+        params = model.init(jax.random.PRNGKey(0))
+        batch = _blob_batch()
+        rng = jax.random.PRNGKey(1)
+
+        grads = jax.grad(lambda p: model.loss(p, batch, rng)[0])(params)
+
+        # Reference: G gradient from ONLY the generator term.
+        def g_only(gen_params):
+            z = jax.random.normal(rng, (8, model.config.latent_dim))
+            fake = model.generate(gen_params, z)
+            logits = model.discriminate(params["disc"], fake)
+            return jnp.mean(
+                jnp.maximum(logits, 0) - logits * 1.0
+                + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+            )
+
+        g_ref = jax.grad(g_only)(params["gen"])
+        for a, b in zip(jax.tree.leaves(grads["gen"]), jax.tree.leaves(g_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+        # Reference: D gradient from ONLY the discriminator term.
+        def d_only(d_params):
+            z = jax.random.normal(rng, (8, model.config.latent_dim))
+            fake = model.generate(params["gen"], z)
+            bce = lambda l, t: jnp.mean(  # noqa: E731
+                jnp.maximum(l, 0) - l * t + jnp.log1p(jnp.exp(-jnp.abs(l)))
+            )
+            return (
+                bce(model.discriminate(d_params, batch["image"]), 1.0)
+                + bce(model.discriminate(d_params, fake), 0.0)
+            )
+
+        d_ref = jax.grad(d_only)(params["disc"])
+        for a, b in zip(jax.tree.leaves(grads["disc"]), jax.tree.leaves(d_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+    def test_training_moves_both_nets(self):
+        import optax
+
+        model = _tiny_gan()
+        params = model.init(jax.random.PRNGKey(0))
+        tx = optax.adam(2e-4)
+        opt = tx.init(params)
+
+        @jax.jit
+        def step(params, opt, rng):
+            (loss, metrics), grads = jax.value_and_grad(
+                model.loss, has_aux=True
+            )(params, _blob_batch(), rng)
+            updates, opt = tx.update(grads, opt)
+            return optax.apply_updates(params, updates), opt, metrics
+
+        rng = jax.random.PRNGKey(2)
+        p0 = jax.tree.map(lambda x: np.asarray(x).copy(), params)
+        for _ in range(5):
+            rng, sub = jax.random.split(rng)
+            params, opt, metrics = step(params, opt, sub)
+        moved = jax.tree.map(
+            lambda a, b: float(np.abs(np.asarray(a) - b).max()), params, p0
+        )
+        assert all(v > 0 for v in jax.tree.leaves(moved["gen"]))
+        assert all(v > 0 for v in jax.tree.leaves(moved["disc"]))
+        for k in ("d_loss", "g_loss", "d_real_acc", "d_fake_acc"):
+            assert np.isfinite(float(metrics[k]))
+
+
+class TestTrials:
+    def test_trials_fit_on_cpu_mesh(self, tmp_path):
+        import os
+
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if repo_root not in sys.path:
+            sys.path.insert(0, repo_root)
+        import tempfile
+
+        from determined_tpu import core
+        from determined_tpu.trainer import Batch, Trainer
+        from examples.generative_trials import DCGANTrial, DiffusionTrial
+
+        for trial_cls, metric in ((DiffusionTrial, "loss"), (DCGANTrial, "g_loss")):
+            trial = trial_cls(hparams={
+                "model_config": {"image_size": 8, "channels": 1,
+                                 **({"hidden": (8, 16), "timesteps": 10}
+                                    if trial_cls is DiffusionTrial
+                                    else {"latent_dim": 8, "g_hidden": 8,
+                                          "d_hidden": 8})},
+                "batch_size": 8,
+            })
+            with tempfile.TemporaryDirectory() as d:
+                ctx = core._context._dummy_init(checkpoint_storage=d)
+                tr = Trainer(trial, ctx)
+                out = tr.fit(max_length=Batch(4))
+                assert np.isfinite(out[metric])

@@ -105,6 +105,55 @@ class TestRoles:
         with pytest.raises(ValueError, match="empty password"):
             Master(users={"ops": {"role": "editor"}})
 
+    def test_viewer_blocked_from_proxy(self, secured):
+        """Proxied services are code execution (notebook kernels, shells):
+        the read-only role must not reach them."""
+        _, api = secured
+        vic = _login(api.url, "vic", "vicpw")
+        r = requests.get(
+            f"{api.url}/proxy/some-task/", headers=vic, timeout=10
+        )
+        assert r.status_code == 403
+        assert "viewer" in r.json()["error"]
+        # editor reaches the proxy layer (502: no such task registered,
+        # which proves authorization passed)
+        eve = _login(api.url, "eve", "evepw")
+        r = requests.get(
+            f"{api.url}/proxy/some-task/", headers=eve, timeout=10
+        )
+        assert r.status_code == 502
+
+    def test_last_admin_cannot_demote_self(self, secured):
+        master, api = secured
+        root = _login(api.url, "root", "rootpw")
+        r = requests.post(
+            f"{api.url}/api/v1/users/root/role",
+            json={"role": "viewer"}, headers=root, timeout=10,
+        )
+        assert r.status_code == 400
+        assert "last admin" in r.json()["error"]
+        assert master.auth.effective_role("root") == "admin"
+        # promoting someone else first unblocks the demotion
+        requests.post(
+            f"{api.url}/api/v1/users/eve/role",
+            json={"role": "admin"}, headers=root, timeout=10,
+        ).raise_for_status()
+        requests.post(
+            f"{api.url}/api/v1/users/root/role",
+            json={"role": "viewer"}, headers=root, timeout=10,
+        ).raise_for_status()
+
+    def test_unroutable_group_name_rejected(self, secured):
+        _, api = secured
+        root = _login(api.url, "root", "rootpw")
+        r = requests.post(
+            f"{api.url}/api/v1/groups",
+            json={"name": "team/ml ops", "role": "viewer"},
+            headers=root, timeout=10,
+        )
+        assert r.status_code == 400
+        assert "management URLs" in r.json()["error"]
+
     def test_task_tokens_unaffected_by_rbac(self, secured):
         master, api = secured
         tok = master.auth.issue_task_token("trial-1")
