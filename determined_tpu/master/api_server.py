@@ -604,11 +604,16 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
             )
         except KeyError as e:
             raise ApiError(404, str(e))
+        except ValueError as e:  # last-admin lockout guard
+            raise ApiError(400, str(e))
         _persist_rbac()
         return {}
 
     def delete_group(r: ApiRequest):
-        m.auth.delete_group(r.groups[0])
+        try:
+            m.auth.delete_group(r.groups[0])
+        except ValueError as e:  # last-admin lockout guard
+            raise ApiError(400, str(e))
         _persist_rbac()
         return {}
 

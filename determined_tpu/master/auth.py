@@ -82,26 +82,49 @@ class AuthService:
                         best = g["role"]
             return best
 
+    def _effective_role_locked(
+        self,
+        username: str,
+        *,
+        roles: Optional[Dict[str, str]] = None,
+        groups: Optional[Dict[str, Dict[str, Any]]] = None,
+    ) -> str:
+        roles = self._roles if roles is None else roles
+        groups = self._groups if groups is None else groups
+        best = roles.get(username, "viewer")
+        for g in groups.values():
+            if username in g["members"] and _ROLE_RANK[g["role"]] > _ROLE_RANK[best]:
+                best = g["role"]
+        return best
+
+    def _require_admin_after(self, roles=None, groups=None) -> None:
+        """Reject a mutation that would take the cluster from having an
+        EFFECTIVE admin (assigned or via group) to having none — a
+        persistent lockout of every admin route with no API recovery path.
+        Clusters configured without any admin in the first place are left
+        alone. Called with the hypothetical post-mutation state, under the
+        lock."""
+        had = any(
+            self._effective_role_locked(u) == "admin" for u in self._users
+        )
+        has = any(
+            self._effective_role_locked(u, roles=roles, groups=groups) == "admin"
+            for u in self._users
+        )
+        if had and not has:
+            raise ValueError(
+                "change would remove the last admin; grant another user "
+                "admin (directly or via a group) first"
+            )
+
     def set_user_role(self, username: str, role: str) -> None:
         if role not in _ROLE_RANK:
             raise ValueError(f"unknown role {role!r}")
         if username not in self._users:
             raise KeyError(f"unknown user {username!r}")
         with self._lock:
-            if (
-                role != "admin"
-                and self._roles.get(username) == "admin"
-                and not any(
-                    r == "admin" and u != username
-                    for u, r in self._roles.items()
-                )
-            ):
-                # Demoting the last assigned admin would lock every admin
-                # route for everyone, persistently — no API recovery path.
-                raise ValueError(
-                    f"{username!r} is the last admin; assign another admin "
-                    "before demoting"
-                )
+            new_roles = {**self._roles, username: role}
+            self._require_admin_after(roles=new_roles)
             self._roles[username] = role
 
     #: Group names must round-trip through the API routes that manage them
@@ -119,12 +142,21 @@ class AuthService:
                 "(it appears in management URLs)"
             )
         with self._lock:
-            g = self._groups.setdefault(name, {"role": role, "members": set()})
-            g["role"] = role
+            current = self._groups.get(name, {"role": role, "members": set()})
+            new_groups = {
+                **self._groups,
+                name: {"role": role, "members": set(current["members"])},
+            }
+            self._require_admin_after(groups=new_groups)
+            self._groups[name] = new_groups[name]
 
     def delete_group(self, name: str) -> None:
         with self._lock:
-            self._groups.pop(name, None)
+            if name not in self._groups:
+                return
+            new_groups = {k: v for k, v in self._groups.items() if k != name}
+            self._require_admin_after(groups=new_groups)
+            del self._groups[name]
 
     def modify_group_members(
         self, name: str, add: List[str] = (), remove: List[str] = ()
@@ -132,9 +164,14 @@ class AuthService:
         with self._lock:
             if name not in self._groups:
                 raise KeyError(f"unknown group {name!r}")
-            members = self._groups[name]["members"]
-            members.update(add)
-            members.difference_update(remove)
+            g = self._groups[name]
+            new_members = (set(g["members"]) | set(add)) - set(remove)
+            new_groups = {
+                **self._groups, name: {"role": g["role"], "members": new_members},
+            }
+            self._require_admin_after(groups=new_groups)
+            g["members"].clear()
+            g["members"].update(new_members)
 
     def rbac_state(self) -> Dict[str, Any]:
         """Snapshot for persistence (master DB) and the API."""

@@ -389,6 +389,7 @@ class Master:
                 for agent_id in self.agent_hub.reap_stale(self.agent_timeout_s):
                     self.lose_agent(agent_id)
                 self._reap_unmanaged()
+                self._reap_idle_commands()
                 self.auth.sweep()
             except Exception:  # noqa: BLE001
                 logger.exception("tick loop error")
@@ -418,6 +419,37 @@ class Master:
                         rec.trial_id,
                     )
                     exp.trial_exited(rec.trial_id, 1, "heartbeat lost")
+
+    def _reap_idle_commands(self) -> None:
+        """Idle watcher for interactive tasks (ref: the reference's
+        notebook idle-timeout, internal/command idle detection): a RUNNING
+        command whose config sets `idle_timeout_s` is killed once no
+        proxied request (or tunnel input) has touched it for that long.
+        Opt-in per task — batch commands without the key run forever."""
+        now = time.time()
+        with self._lock:
+            cmds = [
+                dict(c) for c in self._commands.values()
+                if c["state"] == "RUNNING"
+            ]
+        for c in cmds:
+            timeout = (c.get("config") or {}).get("idle_timeout_s")
+            if not timeout:
+                continue
+            last = self.proxy.last_activity(c["task_id"])
+            if last is None:
+                # Not proxied (yet): measure from task start, so a notebook
+                # nobody ever opened still gets reaped.
+                last = c.get("started_at", now)
+            if now - last > float(timeout):
+                logger.info(
+                    "task %s idle %.0fs > %ss; killing (idle watcher)",
+                    c["task_id"], now - last, timeout,
+                )
+                try:
+                    self.kill_command(c["task_id"])
+                except Exception:  # noqa: BLE001
+                    logger.exception("idle kill failed for %s", c["task_id"])
 
     def lose_agent(self, agent_id: str) -> None:
         """Remove a dead agent and fail over everything it was running."""
@@ -486,6 +518,13 @@ class Master:
             alloc.id, state="TERMINATED", ended_at=time.time(),
             exit_reason=alloc.exit_reason,
         )
+        # Keep the command record truthful on natural/killed exits too —
+        # the idle watcher filters on it, and a stale RUNNING would make it
+        # re-kill a dead task every tick forever.
+        with self._lock:
+            for cmd in self._commands.values():
+                if cmd["alloc_id"] == alloc.id:
+                    cmd["state"] = "TERMINATED"
         self.auth.revoke_for_task(alloc.task_id)
         self.proxy.unregister(alloc.task_id)
         self.pool_of(alloc.id).release(alloc.id)
@@ -570,6 +609,7 @@ class Master:
         def on_start(req: Request, assignment: Dict[str, int]) -> None:
             with self._lock:
                 self._commands[task_id]["state"] = "RUNNING"
+                self._commands[task_id]["started_at"] = time.time()
             self.enqueue_start_actions(
                 alloc_id=alloc_id, task_id=task_id, task_type=task_type,
                 entrypoint=entrypoint, assignment=assignment, slots=slots,

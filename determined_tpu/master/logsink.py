@@ -70,7 +70,7 @@ class ElasticLogSink:
                 break
         return docs
 
-    def _post_bulk(self, docs: List[Dict[str, Any]]) -> None:
+    def _post_bulk(self, docs: List[Dict[str, Any]], timeout: float = 30.0) -> None:
         import urllib.request
 
         lines = []
@@ -83,7 +83,7 @@ class ElasticLogSink:
             data=payload,
             headers={"Content-Type": "application/x-ndjson"},
         )
-        urllib.request.urlopen(req, timeout=30).read()
+        urllib.request.urlopen(req, timeout=timeout).read()
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -105,9 +105,14 @@ class ElasticLogSink:
         # sink must not pin master shutdown for minutes on a full queue.
         deadline = time.monotonic() + drain_budget_s
         docs = self._drain(block=False)
-        while docs and time.monotonic() < deadline:
+        while docs:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
             try:
-                self._post_bulk(docs)
+                # Cap the post itself at the remaining budget: a single
+                # slow request must not overrun the drain budget 4x.
+                self._post_bulk(docs, timeout=remaining)
             except Exception:  # noqa: BLE001
                 break
             docs = self._drain(block=False)

@@ -18,6 +18,7 @@ from __future__ import annotations
 import logging
 import socket
 import threading
+import time
 from typing import Dict, IO, Optional, Tuple
 
 import requests
@@ -79,15 +80,30 @@ class ProxyRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._targets: Dict[str, Tuple[str, int]] = {}  # task_id -> (host, port)
+        # Last proxied-request time per task — the signal the master's idle
+        # watcher uses to reap abandoned notebooks (ref: the reference's
+        # idle-timeout detection watches proxy activity the same way).
+        self._activity: Dict[str, float] = {}
 
     def register(self, task_id: str, host: str, port: int) -> None:
         with self._lock:
             self._targets[task_id] = (host, port)
+            self._activity[task_id] = time.time()
         logger.info("proxy: %s -> %s:%d", task_id, host, port)
 
     def unregister(self, task_id: str) -> None:
         with self._lock:
             self._targets.pop(task_id, None)
+            self._activity.pop(task_id, None)
+
+    def touch(self, task_id: str) -> None:
+        with self._lock:
+            if task_id in self._activity:
+                self._activity[task_id] = time.time()
+
+    def last_activity(self, task_id: str) -> Optional[float]:
+        with self._lock:
+            return self._activity.get(task_id)
 
     def target(self, task_id: str) -> Optional[Tuple[str, int]]:
         with self._lock:
@@ -105,6 +121,7 @@ class ProxyRegistry:
         target = self.target(task_id)
         if target is None:
             return 502, {}, b'{"error": "no proxy target for task"}'
+        self.touch(task_id)
         host, port = target
         url = f"http://{host}:{port}{path}"
         query = _strip_token_query(query)
@@ -142,6 +159,7 @@ class ProxyRegistry:
         returns None after a successful tunnel ends — the connection is
         spent and must be closed.
         """
+        self.touch(task_id)
         target = self.target(task_id)
         if target is None:
             return "no proxy target for task"
@@ -172,6 +190,10 @@ class ProxyRegistry:
                         data = client_rfile.read1(TUNNEL_CHUNK)
                         if not data:
                             break
+                        # Client→task frames are user interaction: a kernel
+                        # WS held open for hours must count as active only
+                        # while the user actually sends (idle watcher).
+                        self.touch(task_id)
                         backend.sendall(data)
                 except OSError:
                     pass

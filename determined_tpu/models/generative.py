@@ -220,6 +220,14 @@ class DCGAN(Model):
     """
 
     def __init__(self, config: GANConfig = GANConfig(), mesh=None) -> None:
+        if config.image_size % 4:
+            # The generator upsamples 2x twice and the discriminator
+            # downsamples 2x twice; a non-multiple-of-4 size would fail deep
+            # inside the jitted step with a shape mismatch instead of here.
+            raise ValueError(
+                f"GANConfig.image_size ({config.image_size}) must be a "
+                "multiple of 4"
+            )
         self.config = config
         self.mesh = mesh
 

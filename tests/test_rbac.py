@@ -143,6 +143,40 @@ class TestRoles:
             json={"role": "viewer"}, headers=root, timeout=10,
         ).raise_for_status()
 
+    def test_group_paths_cannot_drop_last_effective_admin(self, secured):
+        """The lockout guard covers group mutations too: demoting/deleting
+        the group that grants the only admin is refused, and a group-held
+        admin unblocks demoting the assigned one."""
+        master, api = secured
+        root = _login(api.url, "root", "rootpw")
+        # vic becomes admin via group; root demotes self (allowed: vic holds
+        # admin through the group — the old assigned-only guard refused this)
+        requests.post(
+            f"{api.url}/api/v1/groups",
+            json={"name": "adm", "role": "admin"}, headers=root, timeout=10,
+        ).raise_for_status()
+        requests.post(
+            f"{api.url}/api/v1/groups/adm/members",
+            json={"add": ["vic"]}, headers=root, timeout=10,
+        ).raise_for_status()
+        requests.post(
+            f"{api.url}/api/v1/users/root/role",
+            json={"role": "viewer"}, headers=root, timeout=10,
+        ).raise_for_status()
+        # now the group is the ONLY source of admin: removing it must fail
+        vic = _login(api.url, "vic", "vicpw")
+        for method, path, body in [
+            ("DELETE", "/api/v1/groups/adm", None),
+            ("POST", "/api/v1/groups", {"name": "adm", "role": "viewer"}),
+            ("POST", "/api/v1/groups/adm/members", {"remove": ["vic"]}),
+        ]:
+            r = requests.request(
+                method, f"{api.url}{path}", json=body, headers=vic, timeout=10
+            )
+            assert r.status_code == 400, (method, path, r.status_code)
+            assert "last admin" in r.json()["error"]
+        assert master.auth.effective_role("vic") == "admin"
+
     def test_unroutable_group_name_rejected(self, secured):
         _, api = secured
         root = _login(api.url, "root", "rootpw")
