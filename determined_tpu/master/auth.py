@@ -75,12 +75,7 @@ class AuthService:
     def effective_role(self, username: str) -> str:
         """Strongest of the user's own role and their groups' roles."""
         with self._lock:
-            best = self._roles.get(username, "viewer")
-            for g in self._groups.values():
-                if username in g["members"]:
-                    if _ROLE_RANK[g["role"]] > _ROLE_RANK[best]:
-                        best = g["role"]
-            return best
+            return self._effective_role_locked(username)
 
     def _effective_role_locked(
         self,

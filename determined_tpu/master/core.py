@@ -434,6 +434,10 @@ class Master:
             ]
         for c in cmds:
             timeout = (c.get("config") or {}).get("idle_timeout_s")
+            try:
+                timeout = float(timeout) if timeout is not None else 0.0
+            except (TypeError, ValueError):
+                continue  # validated at create; belt-and-braces for old rows
             if not timeout:
                 continue
             last = self.proxy.last_activity(c["task_id"])
@@ -591,6 +595,17 @@ class Master:
         entrypoint = config.get("entrypoint", "")
         if not entrypoint:
             raise ValueError("command config needs an entrypoint")
+        idle = config.get("idle_timeout_s")
+        if idle is not None:
+            # Reject junk here with a 400: a non-numeric value would
+            # otherwise detonate inside the master tick loop every second.
+            try:
+                if float(idle) <= 0:
+                    raise ValueError
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"idle_timeout_s must be a positive number, got {idle!r}"
+                )
         resources = config.get("resources", {})
         slots = int(resources.get("slots", 0))
         with self._lock:

@@ -22,7 +22,11 @@ from determined_tpu.parallel.sharding import (
 )
 from determined_tpu.parallel.ring import ring_attention
 from determined_tpu.parallel.ulysses import ulysses_attention
-from determined_tpu.parallel.pipeline import pipeline_apply
+from determined_tpu.parallel.pipeline import (
+    circular_pipeline_apply,
+    pipeline_apply,
+    stack_circular_stages,
+)
 
 __all__ = [
     "AXIS_NAMES",
@@ -38,4 +42,6 @@ __all__ = [
     "ring_attention",
     "ulysses_attention",
     "pipeline_apply",
+    "circular_pipeline_apply",
+    "stack_circular_stages",
 ]

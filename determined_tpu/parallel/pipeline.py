@@ -1,4 +1,4 @@
-"""Pipeline parallelism: GPipe-style microbatch schedule over the `pipeline` axis.
+"""Pipeline parallelism: microbatch schedules over the `pipeline` axis.
 
 TPU-native replacement for the reference's DeepSpeed PipelineModule path
 (SURVEY.md §2.5: `use_pipeline_parallel`, pytorch/deepspeed/_deepspeed_context.py:241):
@@ -7,10 +7,18 @@ mesh's `pipeline` axis; activations advance between neighbor devices with
 `ppermute` inside a `lax.scan` over schedule ticks — fully compiled, no
 host-side scheduling.
 
-Schedule: plain GPipe fill-drain. M microbatches over S stages take
-M + S - 1 ticks; bubble fraction (S-1)/(M+S-1). Each device computes its
-stage every tick (idle ticks compute-then-discard — branchless, which XLA
-prefers over data-dependent control flow).
+Two schedules:
+
+- `pipeline_apply` — plain GPipe fill-drain. M microbatches over S stages
+  take M + S - 1 ticks; bubble fraction (S-1)/(M+S-1). Each device computes
+  its stage every tick (idle ticks compute-then-discard — branchless, which
+  XLA prefers over data-dependent control flow).
+- `circular_pipeline_apply` — interleaved/circular schedule (the
+  Megatron-interleaved / praxis circular-pipeline idea): each device holds
+  V *virtual* stages (device d runs global stages d, d+S, …, d+(V−1)·S) and
+  activations loop the ring V times. For the same total layers the bubble
+  shrinks from V·(S−1) stage-ticks to (S−1): fill-drain cost is paid once,
+  not once per V-sized chunk.
 """
 from __future__ import annotations
 
@@ -79,3 +87,110 @@ def pipeline_apply(
     # contributed zeros, so a psum is a broadcast.
     outputs = jnp.where(stage_idx == n_stages - 1, outputs, jnp.zeros_like(outputs))
     return lax.psum(outputs, axis_name)
+
+
+def circular_pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    *,
+    axis_name: str = "pipeline",
+) -> jax.Array:
+    """Interleaved (circular) schedule; call inside shard_map.
+
+    Args:
+      stage_fn: params, activation [mb, ...] -> activation [mb, ...].
+      stage_params: this device's V virtual stages stacked on a leading
+        axis — device d must hold global stages [v*S + d for v in range(V)]
+        (round-robin assignment; `stack_circular_stages` builds the global
+        layout).
+      microbatches: [M, mb, ...], M >= S (device 0 re-injects a returned
+        activation M − S ticks after it arrives; fewer microbatches would
+        need it before the ring delivers it).
+
+    Ticks: V·M + S − 1. At tick t device d serves injection idx = t − d
+    (virtual stage idx//M, microbatch idx%M); the ring hands each finished
+    circle back to device 0, which stashes it until its next-round slot or
+    records it as output after round V−1.
+
+    Returns [M, mb, ...] final outputs, replicated across the axis.
+    """
+    n_stages = lax.axis_size(axis_name)
+    d = lax.axis_index(axis_name)
+    v_stages = jax.tree.leaves(stage_params)[0].shape[0]
+    n_micro = microbatches.shape[0]
+    if n_micro < n_stages:
+        raise ValueError(
+            f"circular schedule needs microbatches ({n_micro}) >= pipeline "
+            f"stages ({n_stages})"
+        )
+    total = v_stages * n_micro
+    ticks = total + n_stages - 1
+    fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        incoming, stash, outputs = carry
+        idx = jnp.clip(t - d, 0, total - 1)   # injection this device serves
+        v = idx // n_micro
+        m = idx % n_micro
+        inj = jnp.where(
+            v == 0,
+            lax.dynamic_index_in_dim(microbatches, m, keepdims=False),
+            lax.dynamic_index_in_dim(stash, m, keepdims=False),
+        )
+        x = jnp.where(d == 0, inj, incoming)
+        params_v = jax.tree.map(
+            lambda p: lax.dynamic_index_in_dim(p, v, keepdims=False),
+            stage_params,
+        )
+        y = stage_fn(params_v, x)
+        incoming_next = lax.ppermute(y, axis_name, fwd)
+        # The frame device 0 just received completed the circle for
+        # injection t − (S−1); stash it for round v_r+1 or emit it.
+        idx_r = t - (n_stages - 1)
+        idx_rc = jnp.clip(idx_r, 0, total - 1)
+        v_r = idx_rc // n_micro
+        m_r = idx_rc % n_micro
+        arrived = (idx_r >= 0) & (d == 0)
+        final = v_r == v_stages - 1
+        prev_stash = lax.dynamic_index_in_dim(stash, m_r, keepdims=False)
+        stash = lax.dynamic_update_index_in_dim(
+            stash, jnp.where(arrived & ~final, incoming_next, prev_stash),
+            m_r, 0,
+        )
+        prev_out = lax.dynamic_index_in_dim(outputs, m_r, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(arrived & final, incoming_next, prev_out),
+            m_r, 0,
+        )
+        return (incoming_next, stash, outputs), None
+
+    zero = jnp.zeros_like(microbatches[0])
+    (_, _, outputs), _ = lax.scan(
+        tick,
+        (zero, jnp.zeros_like(microbatches), jnp.zeros_like(microbatches)),
+        jnp.arange(ticks),
+    )
+    # Outputs accumulate on device 0 (the circle's home); psum broadcasts.
+    outputs = jnp.where(d == 0, outputs, jnp.zeros_like(outputs))
+    return lax.psum(outputs, axis_name)
+
+
+def stack_circular_stages(global_params: Any, n_stages: int) -> Any:
+    """Re-stack [L, ...] global stage params (L = S·V) into the circular
+    layout [S, V, ...] where slot [d, v] holds global stage v·S + d —
+    shard the leading axis over `pipeline` and each device gets its V
+    virtual stages."""
+
+    def restack(p):
+        L = p.shape[0]
+        if L % n_stages:
+            raise ValueError(
+                f"global stages ({L}) must divide by pipeline size ({n_stages})"
+            )
+        v = L // n_stages
+        # idx[d, v] = v*S + d; fancy-indexing with it yields [S, V, ...].
+        idx = jnp.arange(L).reshape(v, n_stages).T
+        return jnp.asarray(p)[idx]
+
+    return jax.tree.map(restack, global_params)
