@@ -598,13 +598,18 @@ class Master:
         idle = config.get("idle_timeout_s")
         if idle is not None:
             # Reject junk here with a 400: a non-numeric value would
-            # otherwise detonate inside the master tick loop every second.
+            # otherwise detonate inside the master tick loop every second,
+            # and NaN/inf would silently disable the watcher.
+            import math
+
             try:
-                if float(idle) <= 0:
+                val = float(idle)
+                if val <= 0 or not math.isfinite(val):
                     raise ValueError
             except (TypeError, ValueError):
                 raise ValueError(
-                    f"idle_timeout_s must be a positive number, got {idle!r}"
+                    f"idle_timeout_s must be a positive finite number, "
+                    f"got {idle!r}"
                 )
         resources = config.get("resources", {})
         slots = int(resources.get("slots", 0))

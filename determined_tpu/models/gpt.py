@@ -67,6 +67,11 @@ class GPTConfig:
     # by ppermute inside one compiled program — parallel/pipeline.py).
     pipeline_stages: int = 1
     num_microbatches: int = 0          # 0 → 2 × stages (reasonable bubble)
+    # "gpipe" fill-drain, or "circular" (interleaved: each device runs
+    # pipeline_virtual_stages chunks of layers, round-robin over the ring;
+    # bubble shrinks V×; needs microbatches >= stages).
+    pipeline_schedule: str = "gpipe"
+    pipeline_virtual_stages: int = 2   # V for the circular schedule
     # Mixture of experts (cifar10_moe / DeepSpeed-MoE analog): n_experts > 0
     # replaces every block's MLP with a top-1 (switch) MoE layer; experts
     # shard over the mesh's `expert` axis (GSPMD inserts the all-to-alls).
@@ -400,7 +405,11 @@ class GPT(Model):
         """
         from jax import shard_map
 
-        from determined_tpu.parallel.pipeline import pipeline_apply
+        from determined_tpu.parallel.pipeline import (
+            circular_pipeline_apply,
+            pipeline_apply,
+            stack_circular_stages,
+        )
 
         c = self.config
         n_stages = c.pipeline_stages
@@ -423,18 +432,12 @@ class GPT(Model):
         micro = x.reshape(m, b // m, *x.shape[1:]).astype(jnp.float32)
         micro = self._constrain(micro, P(None, ("data", "fsdp"), "context", None))
 
-        per_stage = c.n_layers // n_stages
-        stage_blocks = jax.tree.map(
-            lambda leaf: leaf.reshape(n_stages, per_stage, *leaf.shape[1:]),
-            params["blocks"],
-        )
-
         block_fn = functools.partial(self._block, manual=True)
         if c.remat:
             block_fn = jax.checkpoint(block_fn, policy=_remat_policy())
 
-        def stage_fn(sp, act):
-            sp = jax.tree.map(lambda leaf: leaf[0], sp)  # drop stage dim (=1)
+        def blocks_scan(sp, act):
+            """Run a stack [k, ...] of blocks over one activation."""
 
             def body(carry, blk):
                 out, _aux = block_fn(carry.astype(c.dtype), blk)
@@ -443,8 +446,42 @@ class GPT(Model):
             out, _ = lax.scan(body, act, sp)
             return out
 
+        assert c.pipeline_schedule in ("gpipe", "circular"), (
+            f"unknown pipeline_schedule {c.pipeline_schedule!r} "
+            "(one of: gpipe, circular)"
+        )
+        circular = c.pipeline_schedule == "circular"
+        if circular:
+            # [L, ...] → [S·V, per, ...] → round-robin [S, V, per, ...]:
+            # device d runs global chunks d, d+S, … (interleaved schedule).
+            v = c.pipeline_virtual_stages
+            assert c.n_layers % (n_stages * v) == 0, (
+                f"n_layers {c.n_layers} must divide stages×virtual "
+                f"({n_stages}×{v})"
+            )
+            per_stage = c.n_layers // (n_stages * v)
+            global_stages = jax.tree.map(
+                lambda leaf: leaf.reshape(
+                    n_stages * v, per_stage, *leaf.shape[1:]
+                ),
+                params["blocks"],
+            )
+            stage_blocks = stack_circular_stages(global_stages, n_stages)
+            apply_fn = circular_pipeline_apply
+        else:
+            per_stage = c.n_layers // n_stages
+            stage_blocks = jax.tree.map(
+                lambda leaf: leaf.reshape(n_stages, per_stage, *leaf.shape[1:]),
+                params["blocks"],
+            )
+            apply_fn = pipeline_apply
+
+        def run(sp, mbs):
+            sp = jax.tree.map(lambda leaf: leaf[0], sp)  # drop S dim (=1)
+            return apply_fn(blocks_scan, sp, mbs)
+
         piped = shard_map(
-            functools.partial(pipeline_apply, stage_fn),
+            run,
             mesh=self.mesh,
             in_specs=(
                 jax.tree.map(lambda _: P("pipeline"), stage_blocks),

@@ -43,6 +43,54 @@ class TestPipelineParallel:
         )(params, batch)
         np.testing.assert_allclose(float(ref_loss), float(loss), rtol=2e-2)
 
+    def test_circular_pipelined_forward_matches_plain(self, devices8):
+        """Interleaved schedule (V virtual stages per device) is the same
+        math as the plain forward — only the tick order differs."""
+        batch = _batch()
+        plain = GPT(_cfg(n_layers=4))
+        params = plain.init(jax.random.PRNGKey(0))
+        ref_loss = plain.loss(params, batch, jax.random.PRNGKey(0))[0]
+
+        mesh = make_mesh(MeshConfig(data=2, pipeline=2, tensor=2), devices=devices8)
+        piped = GPT(
+            _cfg(
+                n_layers=4, pipeline_stages=2, num_microbatches=4,
+                pipeline_schedule="circular", pipeline_virtual_stages=2,
+            ),
+            mesh=mesh,
+        )
+        loss = jax.jit(
+            lambda p, b: piped.loss(p, b, jax.random.PRNGKey(0))[0]
+        )(params, batch)
+        np.testing.assert_allclose(float(ref_loss), float(loss), rtol=2e-2)
+
+    def test_circular_train_step_runs(self, devices8):
+        mesh = make_mesh(MeshConfig(data=4, pipeline=2), devices=devices8)
+        model = GPT(
+            _cfg(
+                n_layers=4, pipeline_stages=2, num_microbatches=4,
+                pipeline_schedule="circular", pipeline_virtual_stages=2,
+            ),
+            mesh=mesh,
+        )
+        params = model.init(jax.random.PRNGKey(0))
+        tx = optax.adam(1e-3)
+        opt = tx.init(params)
+        batch = _batch()
+
+        @jax.jit
+        def step(params, opt, batch):
+            (loss, _), grads = jax.value_and_grad(
+                lambda p: model.loss(p, batch, jax.random.PRNGKey(0)),
+                has_aux=True,
+            )(params)
+            updates, opt = tx.update(grads, opt)
+            return optax.apply_updates(params, updates), opt, loss
+
+        p1, opt, l1 = step(params, opt, batch)
+        p2, opt, l2 = step(p1, opt, batch)
+        assert float(l2) < float(l1)
+
     def test_pipelined_train_step_runs(self, devices8):
         mesh = make_mesh(MeshConfig(data=4, pipeline=2), devices=devices8)
         model = GPT(_cfg(pipeline_stages=2, num_microbatches=4), mesh=mesh)
