@@ -347,8 +347,20 @@ class GPT(Model):
     def _embed(self, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
         c = self.config
         s = tokens.shape[1]
-        x = params["tok_embed"].astype(c.dtype)[tokens]
-        x = x + params["pos_embed"].astype(c.dtype)[:s]
+        # Lay the lookup out so the gather's output sharding IS the
+        # activation sharding: the indices carry the batch/seq mesh axes and
+        # the (explicitly all-gathered) table carries none. Left to
+        # propagation, GSPMD inherits the table's fsdp/tensor sharding onto
+        # the gather output and then pays an involuntary full
+        # replicate-then-partition reshard to reach the activation spec
+        # (spmd_partitioner warning seen in the r2 multichip dryrun). The
+        # table all-gather itself is not a regression — XLA already emitted
+        # one to serve the gather.
+        tokens = self._constrain(tokens, P(("data", "fsdp"), "context"))
+        table = self._constrain(params["tok_embed"].astype(c.dtype), P(None, None))
+        x = table[tokens]
+        pos = self._constrain(params["pos_embed"].astype(c.dtype), P(None, None))
+        x = x + pos[:s]
         return self._constrain(x, P(("data", "fsdp"), "context", None))
 
     def _head(self, params: Dict[str, Any], x: jax.Array) -> jax.Array:
@@ -429,7 +441,25 @@ class GPT(Model):
         # loop-carried values under partial-manual shard_map trip an XLA
         # SPMD-partitioner check failure ("invalid binary instruction opcode
         # copy"); compute inside each block still runs in the compute dtype.
-        micro = x.reshape(m, b // m, *x.shape[1:]).astype(jnp.float32)
+        #
+        # Block-cyclic microbatching: x's batch dim is contiguously sharded
+        # over data×fsdp (device d owns rows [d·b/D, (d+1)·b/D)). A plain
+        # reshape(m, mb) hands microbatch j the contiguous rows
+        # [j·mb, (j+1)·mb) — a cross-device resharding GSPMD can only
+        # realize as a replicate-then-partition copy (the r2 dryrun
+        # warning). Splitting per shard instead keeps every row on its
+        # device: microbatch j takes rows [j·mb/D, (j+1)·mb/D) of each
+        # shard's block, so the reshape+transpose is local and the inverse
+        # below restores logits↔tokens alignment exactly.
+        mb = b // m
+        shards = self.mesh.shape.get("data", 1) * self.mesh.shape.get("fsdp", 1)
+        cyclic = shards > 1 and mb % shards == 0
+        if cyclic:
+            x4 = x.reshape(shards, m, mb // shards, *x.shape[1:])
+            micro = jnp.swapaxes(x4, 0, 1).reshape(m, mb, *x.shape[1:])
+        else:
+            micro = x.reshape(m, mb, *x.shape[1:])
+        micro = micro.astype(jnp.float32)
         micro = self._constrain(micro, P(None, ("data", "fsdp"), "context", None))
 
         block_fn = functools.partial(self._block, manual=True)
@@ -492,7 +522,14 @@ class GPT(Model):
             check_vma=False,
         )
         out = piped(stage_blocks, micro)  # [M, mb, S, D] fp32
-        x = out.reshape(b, *out.shape[2:]).astype(c.dtype)
+        if cyclic:
+            o4 = out.reshape(m, shards, mb // shards, *out.shape[2:])
+            x = jnp.swapaxes(o4, 0, 1).reshape(b, *out.shape[2:])
+        else:
+            x = out.reshape(b, *out.shape[2:])
+        x = self._constrain(
+            x, P(("data", "fsdp"), "context", None)
+        ).astype(c.dtype)
         return self._head(params, x), jnp.zeros((), jnp.float32)
 
     def apply(self, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
