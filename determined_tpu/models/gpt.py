@@ -344,9 +344,65 @@ class GPT(Model):
             x = self._constrain(x, act_spec)
         return x, aux
 
+    def _embed_raw(
+        self, tok_embed: jax.Array, pos_embed: jax.Array, tokens: jax.Array
+    ) -> jax.Array:
+        """Embedding math shared by the GSPMD path and the 1F1B stage-0
+        producer (no sharding constraints)."""
+        c = self.config
+        x = tok_embed.astype(c.dtype)[tokens]
+        return x + pos_embed.astype(c.dtype)[: tokens.shape[1]]
+
+    def _head_raw(
+        self,
+        lnf_scale: jax.Array,
+        lnf_bias: jax.Array,
+        w_out: jax.Array,
+        x: jax.Array,
+    ) -> jax.Array:
+        """Final layernorm + LM head shared by _head and the 1F1B last-stage
+        loss (no sharding constraints); w_out already in compute dtype."""
+        return jnp.einsum("bsd,dv->bsv", _layernorm(x, lnf_scale, lnf_bias), w_out)
+
+    def _next_token_sums(
+        self, logits: jax.Array, tokens: jax.Array, mask: jax.Array
+    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+        """Next-token objective SUMS (nll, z, correct, n) over fp32 logits —
+        shared by loss() and the per-microbatch 1F1B objective so the two
+        training paths cannot diverge formula-wise."""
+        logits = logits[:, :-1]
+        targets = tokens[:, 1:]
+        mk = mask[:, 1:]
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        target_logit = jnp.take_along_axis(
+            logits, targets[..., None], axis=-1
+        ).squeeze(-1)
+        nll_sum = jnp.sum((lse - target_logit) * mk)
+        z_sum = jnp.sum(jnp.square(lse) * mk)
+        acc_sum = jnp.sum((jnp.argmax(logits, -1) == targets) * mk)
+        return nll_sum, z_sum, acc_sum, jnp.sum(mk)
+
+    def _stage_scan_fn(self):
+        """fp32-boundary runner over a stack [k, ...] of blocks — the
+        stage_fn for every pipeline schedule (see the fp32 carry note in
+        _apply_pipelined)."""
+        c = self.config
+        block_fn = functools.partial(self._block, manual=True)
+        if c.remat:
+            block_fn = jax.checkpoint(block_fn, policy=_remat_policy())
+
+        def stage_fn(sp, act):
+            def body(carry, blk):
+                out, _aux = block_fn(carry.astype(c.dtype), blk)
+                return out.astype(jnp.float32), None
+
+            out, _ = lax.scan(body, act, sp)
+            return out
+
+        return stage_fn
+
     def _embed(self, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
         c = self.config
-        s = tokens.shape[1]
         # Lay the lookup out so the gather's output sharding IS the
         # activation sharding: the indices carry the batch/seq mesh axes and
         # the (explicitly all-gathered) table carries none. Left to
@@ -358,18 +414,18 @@ class GPT(Model):
         # one to serve the gather.
         tokens = self._constrain(tokens, P(("data", "fsdp"), "context"))
         table = self._constrain(params["tok_embed"].astype(c.dtype), P(None, None))
-        x = table[tokens]
         pos = self._constrain(params["pos_embed"].astype(c.dtype), P(None, None))
-        x = x + pos[:s]
+        x = self._embed_raw(table, pos, tokens)
         return self._constrain(x, P(("data", "fsdp"), "context", None))
 
     def _head(self, params: Dict[str, Any], x: jax.Array) -> jax.Array:
         c = self.config
-        x = _layernorm(x, params["lnf_scale"], params["lnf_bias"])
         w_out = (
             params["tok_embed"].T if c.tie_embeddings else params["head"]
         ).astype(c.dtype)
-        logits = jnp.einsum("bsd,dv->bsv", x, w_out)
+        logits = self._head_raw(
+            params["lnf_scale"], params["lnf_bias"], w_out, x
+        )
         return self._constrain(logits, P(("data", "fsdp"), "context", "tensor"))
 
     def _forward(
@@ -404,6 +460,28 @@ class GPT(Model):
             body, (x, jnp.zeros((), jnp.float32)), params["blocks"]
         )
         return self._head(params, x), aux
+
+    def _microbatch_split(self, x: jax.Array, m: int):
+        """[b, ...] → [m, b/m, ...] microbatches, block-cyclically per
+        data×fsdp shard when divisibility allows (comm-free under GSPMD —
+        see the layout comment in `_apply_pipelined`). Returns
+        (micro, cyclic, shards) so callers can invert the layout."""
+        b = x.shape[0]
+        mb = b // m
+        shards = 1
+        if self.mesh is not None:
+            shards = self.mesh.shape.get("data", 1) * self.mesh.shape.get(
+                "fsdp", 1
+            )
+        cyclic = shards > 1 and mb % shards == 0
+        if cyclic:
+            x4 = x.reshape(shards, m, mb // shards, *x.shape[1:])
+            return (
+                jnp.swapaxes(x4, 0, 1).reshape(m, mb, *x.shape[1:]),
+                cyclic,
+                shards,
+            )
+        return x.reshape(m, mb, *x.shape[1:]), cyclic, shards
 
     def _apply_pipelined(
         self, params: Dict[str, Any], tokens: jax.Array
@@ -452,34 +530,18 @@ class GPT(Model):
         # shard's block, so the reshape+transpose is local and the inverse
         # below restores logits↔tokens alignment exactly.
         mb = b // m
-        shards = self.mesh.shape.get("data", 1) * self.mesh.shape.get("fsdp", 1)
-        cyclic = shards > 1 and mb % shards == 0
-        if cyclic:
-            x4 = x.reshape(shards, m, mb // shards, *x.shape[1:])
-            micro = jnp.swapaxes(x4, 0, 1).reshape(m, mb, *x.shape[1:])
-        else:
-            micro = x.reshape(m, mb, *x.shape[1:])
+        micro, cyclic, shards = self._microbatch_split(x, m)
         micro = micro.astype(jnp.float32)
         micro = self._constrain(micro, P(None, ("data", "fsdp"), "context", None))
 
-        block_fn = functools.partial(self._block, manual=True)
-        if c.remat:
-            block_fn = jax.checkpoint(block_fn, policy=_remat_policy())
+        blocks_scan = self._stage_scan_fn()
 
-        def blocks_scan(sp, act):
-            """Run a stack [k, ...] of blocks over one activation."""
-
-            def body(carry, blk):
-                out, _aux = block_fn(carry.astype(c.dtype), blk)
-                return out.astype(jnp.float32), None
-
-            out, _ = lax.scan(body, act, sp)
-            return out
-
-        assert c.pipeline_schedule in ("gpipe", "circular"), (
+        assert c.pipeline_schedule in ("gpipe", "circular", "1f1b"), (
             f"unknown pipeline_schedule {c.pipeline_schedule!r} "
-            "(one of: gpipe, circular)"
+            "(one of: gpipe, circular, 1f1b)"
         )
+        # 1F1B is a *training* schedule (loss() runs it via _loss_1f1b);
+        # forward-only inference uses the fill-drain layout.
         circular = c.pipeline_schedule == "circular"
         if circular:
             # [L, ...] → [S·V, per, ...] → round-robin [S, V, per, ...]:
@@ -536,38 +598,177 @@ class GPT(Model):
         """tokens [B, S] int32 → logits [B, S, V] (compute dtype)."""
         return self._forward(params, tokens)[0]
 
+    # -- 1F1B training path ------------------------------------------------
+    def _loss_1f1b(
+        self, params: Dict[str, Any], batch: Dict[str, jax.Array]
+    ) -> Tuple[jax.Array, Metrics]:
+        """Memory-bounded pipelined training step (schedule="1f1b").
+
+        Embedding and head/loss move INSIDE the pipeline (stage 0 embeds each
+        microbatch from its int32 tokens; the last stage computes the
+        per-microbatch loss and seeds its backward immediately) so no [M,
+        mb, s, d] activation array ever materializes — the residency bound
+        is `one_f_one_b_stash_size` = O(S) stage inputs per device, vs
+        GPipe's O(M). The schedule itself computes finished gradients
+        (parallel/pipeline.py one_f_one_b_grads); a custom_vjp hands them to
+        the trainer's jax.grad unchanged. eval reuses this path and simply
+        discards the gradients.
+        """
+        from jax import shard_map
+        from determined_tpu.parallel.pipeline import one_f_one_b_grads
+
+        c = self.config
+        tokens = batch["tokens"]
+        mask = batch.get("loss_mask")
+        b, s = tokens.shape
+        n_stages = c.pipeline_stages
+        assert self.mesh is not None, "pipeline parallelism needs a mesh"
+        assert self.mesh.shape["pipeline"] == n_stages
+        assert c.n_layers % n_stages == 0
+        assert not c.n_experts, "MoE+pipeline composition not supported yet"
+        m = c.num_microbatches or 2 * n_stages
+        assert b % m == 0, f"batch {b} not divisible by {m} microbatches"
+        per_stage = c.n_layers // n_stages
+
+        mask_f = (
+            jnp.ones(tokens.shape, jnp.float32)
+            if mask is None
+            else mask.astype(jnp.float32)
+        )
+        tok3, _, _ = self._microbatch_split(tokens, m)
+        msk3, _, _ = self._microbatch_split(mask_f, m)
+        tok3 = self._constrain(tok3, P(None, ("data", "fsdp"), "context"))
+        msk3 = self._constrain(msk3, P(None, ("data", "fsdp"), "context"))
+
+        stage_fn = self._stage_scan_fn()
+
+        def emb_fn(ep, tok):
+            return self._embed_raw(
+                ep["tok_embed"], ep["pos_embed"], tok
+            ).astype(jnp.float32)
+
+        def loss_fn(lp, y, tok, msk):
+            """Per-microbatch SUM objective + [nll, z, acc, n] sums —
+            the same _head_raw/_next_token_sums math as the GSPMD path."""
+            w_out = (
+                lp["tok_embed"].T if c.tie_embeddings else lp["head"]
+            ).astype(c.dtype)
+            logits = self._head_raw(
+                lp["lnf_scale"], lp["lnf_bias"], w_out, y.astype(c.dtype)
+            ).astype(jnp.float32)
+            nll_sum, z_sum, acc_sum, n_tok = self._next_token_sums(
+                logits, tok, msk
+            )
+            obj = nll_sum + c.z_loss * z_sum
+            return obj, jnp.stack([nll_sum, z_sum, acc_sum, n_tok])
+
+        def fwd_impl(p):
+            stage_blocks = jax.tree.map(
+                lambda leaf: leaf.reshape(
+                    n_stages, per_stage, *leaf.shape[1:]
+                ),
+                p["blocks"],
+            )
+            ep = {"tok_embed": p["tok_embed"], "pos_embed": p["pos_embed"]}
+            lp = {"lnf_scale": p["lnf_scale"], "lnf_bias": p["lnf_bias"]}
+            if c.tie_embeddings:
+                lp["tok_embed"] = p["tok_embed"]
+            else:
+                lp["head"] = p["head"]
+
+            def run(sp, tk, mk, ep_, lp_):
+                sp = jax.tree.map(lambda leaf: leaf[0], sp)
+                return one_f_one_b_grads(
+                    stage_fn, sp, emb_fn, ep_, loss_fn, lp_, tk, mk
+                )
+
+            stage_spec = jax.tree.map(lambda _: P("pipeline"), stage_blocks)
+            msums, s_g, e_g, l_g = shard_map(
+                run,
+                mesh=self.mesh,
+                in_specs=(stage_spec, P(), P(), P(), P()),
+                out_specs=(P(), stage_spec, P(), P()),
+                axis_names={"pipeline"},
+                check_vma=False,
+            )(stage_blocks, tok3, msk3, ep, lp)
+
+            n = jnp.maximum(msums[3], 1.0)
+            loss = msums[0] / n + c.z_loss * msums[1] / n
+            metrics = {
+                "loss": loss,
+                "accuracy": msums[2] / n,
+                "tokens": msums[3],
+            }
+            # The schedule differentiated the per-microbatch SUM objective;
+            # the reported loss is sum/n. Gradients are linear in the seed,
+            # so scale once here.
+            inv_n = 1.0 / n
+            grads = {
+                "blocks": jax.tree.map(
+                    lambda g: g.reshape(c.n_layers, *g.shape[2:]) * inv_n,
+                    s_g,
+                ),
+                "tok_embed": e_g["tok_embed"] * inv_n,
+                "pos_embed": e_g["pos_embed"] * inv_n,
+                "lnf_scale": l_g["lnf_scale"] * inv_n,
+                "lnf_bias": l_g["lnf_bias"] * inv_n,
+            }
+            if c.tie_embeddings:
+                grads["tok_embed"] = (
+                    grads["tok_embed"] + l_g["tok_embed"] * inv_n
+                )
+            else:
+                grads["head"] = l_g["head"] * inv_n
+            return loss, metrics, grads
+
+        @jax.custom_vjp
+        def pipelined(p):
+            loss, metrics, _ = fwd_impl(p)
+            return loss, metrics
+
+        def pipelined_fwd(p):
+            loss, metrics, grads = fwd_impl(p)
+            return (loss, metrics), grads
+
+        def pipelined_bwd(grads, cot):
+            g_loss, _g_metrics = cot
+            return (jax.tree.map(lambda g: g * g_loss, grads),)
+
+        pipelined.defvjp(pipelined_fwd, pipelined_bwd)
+        return pipelined(params)
+
     # -- loss --------------------------------------------------------------
     def loss(
         self, params: Dict[str, Any], batch: Dict[str, jax.Array], rng: jax.Array
     ) -> Tuple[jax.Array, Metrics]:
         del rng  # no dropout in the pretraining configs
+        if self.config.pipeline_stages > 1 and (
+            self.config.pipeline_schedule == "1f1b"
+        ):
+            return self._loss_1f1b(params, batch)
         tokens = batch["tokens"]
         logits, moe_aux = self._forward(params, tokens)
-        logits = logits.astype(jnp.float32)
-        # Next-token prediction: position i predicts token i+1.
-        logits = logits[:, :-1]
-        targets = tokens[:, 1:]
         mask = batch.get("loss_mask")
         mask = (
-            jnp.ones(targets.shape, jnp.float32)
+            jnp.ones(tokens.shape, jnp.float32)
             if mask is None
-            else mask[:, 1:].astype(jnp.float32)
+            else mask.astype(jnp.float32)
         )
-        lse = jax.nn.logsumexp(logits, axis=-1)
-        target_logit = jnp.take_along_axis(
-            logits, targets[..., None], axis=-1
-        ).squeeze(-1)
-        nll = lse - target_logit
-        n = jnp.maximum(jnp.sum(mask), 1.0)
-        loss = jnp.sum(nll * mask) / n
+        # Next-token prediction: position i predicts token i+1 (shift and
+        # per-token sums live in _next_token_sums, shared with 1F1B).
+        nll_sum, z_sum, acc_sum, n_tok = self._next_token_sums(
+            logits.astype(jnp.float32), tokens, mask
+        )
+        n = jnp.maximum(n_tok, 1.0)
+        loss = nll_sum / n
         if self.config.z_loss:
-            loss = loss + self.config.z_loss * jnp.sum(jnp.square(lse) * mask) / n
+            loss = loss + self.config.z_loss * z_sum / n
         if self.config.n_experts:
             # 0.01 is the standard switch-transformer aux weight; mean over
             # layers (aux accumulated once per block in the scan).
             loss = loss + 0.01 * moe_aux / self.config.n_layers
-        acc = jnp.sum((jnp.argmax(logits, -1) == targets) * mask) / n
-        return loss, {"loss": loss, "accuracy": acc, "tokens": jnp.sum(mask)}
+        acc = acc_sum / n
+        return loss, {"loss": loss, "accuracy": acc, "tokens": n_tok}
 
     def eval_metrics(self, params: Dict[str, Any], batch: Dict[str, jax.Array]) -> Metrics:
         loss, metrics = self.loss(params, batch, jax.random.PRNGKey(0))

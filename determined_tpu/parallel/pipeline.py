@@ -176,6 +176,168 @@ def circular_pipeline_apply(
     return lax.psum(outputs, axis_name)
 
 
+def one_f_one_b_stash_size(n_micro: int, n_stages: int) -> int:
+    """In-flight activation stash entries per device under 1F1B: O(S), not
+    O(M). Device d holds at most 2·(S−1−d)+1 stage inputs; the SPMD program
+    is uniform across devices so the buffer is sized for device 0."""
+    return min(n_micro, 2 * n_stages - 1)
+
+
+def one_f_one_b_grads(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    emb_fn: Callable[[Any, jax.Array], jax.Array],
+    emb_params: Any,
+    loss_fn: Callable[[Any, jax.Array, jax.Array, jax.Array], Any],
+    loss_params: Any,
+    tokens_mb: jax.Array,
+    mask_mb: jax.Array,
+    *,
+    axis_name: str = "pipeline",
+):
+    """1F1B schedule (memory-bounded pipelining); call inside shard_map.
+
+    The capability the reference reached through DeepSpeed's PipeEngine
+    (`/root/reference/examples/deepspeed/pipeline_parallelism/distributed.yaml`):
+    forwards and backwards interleave per microbatch so each device stashes
+    only O(S) stage inputs instead of GPipe's O(M). jax.grad of a
+    forward-only scan cannot express that interleaving (autodiff replays all
+    forwards, then all backwards), so this runs the whole fwd+bwd schedule
+    explicitly — per-stage `jax.vjp` with stage-input recompute (remat) at
+    backward time — and returns finished gradients; callers expose it to
+    autodiff through `jax.custom_vjp` (models/gpt.py `_loss_1f1b`).
+
+    Timing (device d, microbatch m, tick t of M + 2S − 2):
+      forward  at t = m + d           (GPipe-rate fill)
+      backward at t = m + 2(S−1) − d  (last stage seeds its own backward in
+                                       the same tick its forward finishes)
+    Each tick has one forward and one backward sub-slot, each ending in the
+    collective ppermute every device must reach — warmup/drain sub-slots
+    compute-and-discard (branchless, like `pipeline_apply`).
+
+    Args:
+      stage_fn: (params, x [mb, ...]) -> y, same shape. Differentiated via
+        vjp per backward sub-slot, recomputing from the stashed input.
+      emb_fn: (emb_params, tokens [mb, s]) -> x — microbatch producer, run
+        on stage 0 (branchlessly everywhere; masked elsewhere).
+      loss_fn: (loss_params, y, tokens, mask) -> (objective, metric_sums)
+        run on the last stage. `objective` MUST be a per-microbatch SUM
+        (decomposable across microbatches): its unit-seeded cotangent starts
+        each microbatch's backward independently; the caller rescales the
+        returned grads afterwards (gradients are linear in the seed).
+      tokens_mb: [M, mb, s] int32; mask_mb: [M, mb, s] float32.
+
+    Returns (metric_sums, stage_grads, emb_grads, loss_grads): metric_sums /
+    emb_grads / loss_grads psum-replicated over the pipeline axis;
+    stage_grads per-device with a leading stacking axis of 1 (use out_spec
+    P(axis_name)).
+    """
+    n_stages = lax.axis_size(axis_name)
+    d = lax.axis_index(axis_name)
+    n_micro = tokens_mb.shape[0]
+    cap = one_f_one_b_stash_size(n_micro, n_stages)
+    ticks = n_micro + 2 * n_stages - 2
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+    def _masked_add(acc, delta, on):
+        return jax.tree.map(
+            lambda a, g: a + jnp.where(on, g, jnp.zeros_like(g)), acc, delta
+        )
+
+    def zeros_like_tree(tr):
+        return jax.tree.map(jnp.zeros_like, tr)
+
+    zero_act = jnp.zeros_like(emb_fn(emb_params, tokens_mb[0]))
+    stash0 = jnp.zeros((cap,) + zero_act.shape, zero_act.dtype)
+    # metric_sums shape comes from one abstract eval of loss_fn.
+    aux_shape = jax.eval_shape(
+        lambda: loss_fn(loss_params, zero_act, tokens_mb[0], mask_mb[0])[1]
+    )
+    msums0 = jnp.zeros(aux_shape.shape, aux_shape.dtype)
+
+    def tick(carry, t):
+        inc_f, inc_b, stash, msums, s_g, e_g, l_g = carry
+
+        # -- forward sub-slot ------------------------------------------------
+        f_idx = t - d
+        f_on = (f_idx >= 0) & (f_idx < n_micro)
+        mf = jnp.clip(f_idx, 0, n_micro - 1)
+        tok_f = lax.dynamic_index_in_dim(tokens_mb, mf, keepdims=False)
+        msk_f = lax.dynamic_index_in_dim(mask_mb, mf, keepdims=False)
+        # lax.cond keeps edge-only work (embedding on stage 0, LM head on
+        # the last stage) off the other devices — a real cost at vocab
+        # scale. Legal under SPMD because the collectives (ppermutes) sit
+        # outside the branches.
+        x_in = lax.cond(
+            d == 0, lambda: emb_fn(emb_params, tok_f), lambda: inc_f
+        )
+        y = stage_fn(stage_params, x_in)
+        slot = mf % cap
+        prev = lax.dynamic_index_in_dim(stash, slot, keepdims=False)
+        stash = lax.dynamic_update_index_in_dim(
+            stash, jnp.where(f_on, x_in, prev), slot, 0
+        )
+
+        # Last stage: per-microbatch loss fwd+bwd — dy seeds this tick's
+        # backward sub-slot for the same microbatch.
+        def loss_vjp():
+            obj, vjp_loss, aux = jax.vjp(
+                lambda lp, yy: loss_fn(lp, yy, tok_f, msk_f),
+                loss_params, y, has_aux=True,
+            )
+            d_lp, dy = vjp_loss(jnp.ones_like(obj))
+            return d_lp, dy, aux
+
+        d_lp, dy, aux = lax.cond(
+            d == n_stages - 1,
+            loss_vjp,
+            lambda: (zeros_like_tree(loss_params), jnp.zeros_like(y), msums0),
+        )
+        last_on = f_on & (d == n_stages - 1)
+        msums = msums + jnp.where(last_on, aux, jnp.zeros_like(aux))
+        l_g = _masked_add(l_g, d_lp, last_on)
+
+        # -- backward sub-slot ----------------------------------------------
+        b_idx = t - (2 * n_stages - 2 - d)
+        b_on = (b_idx >= 0) & (b_idx < n_micro)
+        mb_i = jnp.clip(b_idx, 0, n_micro - 1)
+        cot_y = jnp.where(d == n_stages - 1, dy, inc_b)
+        x_s = lax.dynamic_index_in_dim(stash, mb_i % cap, keepdims=False)
+        _, vjp_stage = jax.vjp(stage_fn, stage_params, x_s)
+        d_sp, dx = vjp_stage(cot_y)
+        s_g = _masked_add(s_g, d_sp, b_on)
+
+        # Stage 0's input cotangent is the embedding-output cotangent.
+        def emb_vjp():
+            tok_b = lax.dynamic_index_in_dim(tokens_mb, mb_i, keepdims=False)
+            _, vjp_emb = jax.vjp(lambda ep: emb_fn(ep, tok_b), emb_params)
+            (d_ep,) = vjp_emb(dx)
+            return d_ep
+
+        d_ep = lax.cond(
+            d == 0, emb_vjp, lambda: zeros_like_tree(emb_params)
+        )
+        e_g = _masked_add(e_g, d_ep, b_on & (d == 0))
+
+        inc_f = lax.ppermute(y, axis_name, fwd_perm)
+        inc_b = lax.ppermute(dx, axis_name, bwd_perm)
+        return (inc_f, inc_b, stash, msums, s_g, e_g, l_g), None
+    carry0 = (
+        zero_act, zero_act, stash0, msums0,
+        zeros_like_tree(stage_params), zeros_like_tree(emb_params),
+        zeros_like_tree(loss_params),
+    )
+    (_, _, _, msums, s_g, e_g, l_g), _ = lax.scan(
+        tick, carry0, jnp.arange(ticks)
+    )
+    msums = lax.psum(msums, axis_name)
+    e_g = lax.psum(e_g, axis_name)
+    l_g = lax.psum(l_g, axis_name)
+    s_g = jax.tree.map(lambda g: g[None], s_g)
+    return msums, s_g, e_g, l_g
+
+
 def stack_circular_stages(global_params: Any, n_stages: int) -> Any:
     """Re-stack [L, ...] global stage params (L = S·V) into the circular
     layout [S, V, ...] where slot [d, v] holds global stage v·S + d —
