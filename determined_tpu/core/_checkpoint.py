@@ -9,9 +9,11 @@ Mirrors the reference's `harness/determined/core/_checkpoint.py:171`:
 - `restore_path` streams the checkpoint down (with a per-rank selector for
   sharded restore) and cleans up after itself.
 
-On TPU the sharded path is the common case: orbax/ocdbt writes per-host
-shards of the GSPMD-sharded train state, and each host uploads only what it
-wrote.
+On TPU the sharded path is the common case: the trainer's checkpoint writer
+(trainer/_checkpoint.py — keypath-named .npy files, one per addressable
+shard) saves per-host shards of the GSPMD-sharded train state, each host
+uploads only what it wrote, and restore is lazy (per-device callbacks read
+only that device's region — no host materializes a full array).
 """
 from __future__ import annotations
 
