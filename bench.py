@@ -1,16 +1,24 @@
 """Headline benchmark: GPT-2-small pretraining step MFU on one TPU chip.
 
-Target (BASELINE.md): >= 35% MFU on the GPT-2 recipe. Prints ONE JSON line:
-  {"metric": "gpt2_mfu", "value": <percent>, "unit": "%", "vs_baseline": <x/35>}
+Target (BASELINE.md): >= 35% MFU on the GPT-2 recipe. Prints ONE JSON line
+whose primary metric stays gpt2_mfu; the other two BASELINE.md rows ride
+as extra fields on the same line:
+  {"metric": "gpt2_mfu", "value": <pct>, "unit": "%", "vs_baseline": <x/35>,
+   "tokens_per_sec_per_chip": <tok/s>, "asha_trials_per_hour": <trials/h>}
 
 Runs the real flagship path: determined_tpu GPT (Pallas flash attention,
 bf16 compute, remat, scan-over-layers) + adamw, jitted with donated state.
-Falls back to a tiny config on CPU so the script always completes.
+Falls back to a tiny config on CPU so the script always completes. The
+ASHA row runs an in-process devcluster (master + 4 agents) through an
+adaptive-ASHA search of no-op-class trials — platform throughput, not
+model math; skip with DTPU_BENCH_SKIP_ASHA=1.
 """
 from __future__ import annotations
 
 import functools
 import json
+import os
+import tempfile
 import time
 
 import jax
@@ -42,6 +50,50 @@ def peak_flops(device) -> float:
         if key in kind:
             return PEAK_FLOPS[key]
     return 197e12  # assume v5e (the BASELINE target hardware)
+
+
+def asha_trials_per_hour(n_trials: int = 8):
+    """BASELINE.md row 3: adaptive-ASHA trials/hour on no-op-class trials.
+
+    Wall-clock covers the experiment (create → COMPLETED) on a running
+    cluster — scheduler, gang allocation, process spawn, metric ingest and
+    rung decisions — matching the reference's HP-search benchmark framing
+    (`examples/hp_search_benchmarks/`). Returns None on any failure so the
+    headline MFU line still prints (the driver gates on it).
+    """
+    try:
+        from determined_tpu.devcluster import DevCluster
+
+        with tempfile.TemporaryDirectory() as tmp:
+            with DevCluster(n_agents=4, slots_per_agent=1) as dc:
+                t0 = time.perf_counter()
+                exp_id = dc.create_experiment({
+                    "entrypoint":
+                        "determined_tpu.exec.builtin_trials:SyntheticTrial",
+                    "searcher": {
+                        "name": "adaptive_asha", "metric": "loss",
+                        "max_trials": n_trials, "max_length": 4,
+                        "num_rungs": 2,
+                    },
+                    "hyperparameters": {
+                        "model": "mnist-mlp", "batch_size": 16,
+                        "lr": {"type": "log", "minval": -3, "maxval": -1},
+                    },
+                    "resources": {"slots_per_trial": 1},
+                    "scheduling_unit": 1,
+                    "checkpoint_storage": {
+                        "type": "shared_fs",
+                        "host_path": os.path.join(tmp, "ckpt"),
+                    },
+                    "environment": {"jax_platform": "cpu"},
+                })
+                state = dc.wait_experiment(exp_id, timeout=600)
+                dt = time.perf_counter() - t0
+                if state != "COMPLETED":
+                    return None
+                return n_trials / dt * 3600.0
+    except Exception:  # noqa: BLE001 — bench must still print the MFU line
+        return None
 
 
 def main() -> None:
@@ -105,16 +157,19 @@ def main() -> None:
     tokens_per_sec = batch_size * config.seq_len * inner / best_dt
     flops_per_token = config.train_flops_per_token()
     mfu = tokens_per_sec * flops_per_token / peak_flops(dev)
-    print(
-        json.dumps(
-            {
-                "metric": "gpt2_mfu",
-                "value": round(100.0 * mfu, 2),
-                "unit": "%",
-                "vs_baseline": round(mfu / 0.35, 3),
-            }
-        )
-    )
+    record = {
+        "metric": "gpt2_mfu",
+        "value": round(100.0 * mfu, 2),
+        "unit": "%",
+        "vs_baseline": round(mfu / 0.35, 3),
+        # BASELINE.md row 2: one jax device == one chip here.
+        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+    }
+    if not os.environ.get("DTPU_BENCH_SKIP_ASHA"):
+        asha = asha_trials_per_hour()
+        if asha is not None:
+            record["asha_trials_per_hour"] = round(asha, 1)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":
