@@ -32,12 +32,11 @@ def connect_shell(
     sock = socket.create_connection((host, port), timeout=30)
     if parsed.scheme == "https":
         # The handshake carries credentials; they must not cross the wire
-        # in cleartext when the master is TLS.
-        import ssl
+        # in cleartext when the master is TLS. Verification honors the same
+        # DTPU_MASTER_CERT bundle as Session (common/tls.py).
+        from determined_tpu.common.tls import client_context
 
-        sock = ssl.create_default_context().wrap_socket(
-            sock, server_hostname=host
-        )
+        sock = client_context().wrap_socket(sock, server_hostname=host)
     try:
         query = f"shell_token={shell_token}"
         if user_token:

@@ -21,12 +21,31 @@ class Session:
         token: str = "",
         max_retries: int = 5,
         timeout: float = 60.0,
+        cert: Optional[str] = None,
     ) -> None:
         self.master_url = master_url.rstrip("/")
         self._token = token
         self._max_retries = max_retries
         self._timeout = timeout
         self._http = requests.Session()
+        self._verify: Any = None
+        if self.master_url.startswith("https:"):
+            # Transport security (ref: common/api/certs.py): verify against
+            # the CA bundle from the `cert` argument or DTPU_MASTER_CERT —
+            # the self-signed bootstrap pins the master's own cert;
+            # "noverify" encrypts without verification. Passed per-request
+            # (NOT Session.verify): an ambient REQUESTS_CA_BUNDLE env var —
+            # common on managed images — silently overrides the session
+            # attribute but never an explicit request argument.
+            from determined_tpu.common.tls import requests_verify
+
+            self._verify = requests_verify(cert)
+            if self._verify is False:
+                import urllib3
+
+                urllib3.disable_warnings(
+                    urllib3.exceptions.InsecureRequestWarning
+                )
         if token:
             self._http.headers["Authorization"] = f"Bearer {token}"
 
@@ -54,6 +73,7 @@ class Session:
                     params=params,
                     timeout=timeout or self._timeout,
                     stream=stream,
+                    **({} if self._verify is None else {"verify": self._verify}),
                 )
                 if resp.status_code in RETRY_STATUSES:
                     raise requests.HTTPError(f"retryable status {resp.status_code}")
@@ -82,6 +102,7 @@ class Session:
             url, data=data,
             headers={"Content-Type": "application/octet-stream"},
             timeout=kw.get("timeout", self._timeout),
+            **({} if self._verify is None else {"verify": self._verify}),
         )
         resp.raise_for_status()
         return resp.json()

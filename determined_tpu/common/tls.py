@@ -1,0 +1,152 @@
+"""TLS for the master's API surface and every client of it.
+
+Rebuild of the reference's transport security story
+(`master/internal/proxy/tls.go`, `harness/determined/common/api/certs.py`):
+the master serves HTTPS (self-signed bootstrap, like `det deploy local`),
+and CLI/SDK/agents/task harnesses verify against a CA bundle delivered out
+of band — here the `DTPU_MASTER_CERT` env var / Session `cert` argument,
+the analog of the reference's `det_master.crt` cert store. The proxy's
+upgrade tunnels ride the same TLS listener (TLS terminates at the master;
+master→task hops stay on the private agent network, as in the reference).
+
+Cert verification modes (matching certs.py semantics):
+  - path to a PEM bundle: verify against exactly that CA (self-signed
+    bootstrap pins the master's own cert);
+  - "noverify": encrypt but skip verification (certs.py `noverify=True`);
+  - unset: the system trust store (public CAs).
+"""
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+import socket
+import ssl
+from typing import Optional, Sequence, Tuple
+
+CERT_ENV = "DTPU_MASTER_CERT"
+NOVERIFY = "noverify"
+
+
+def generate_self_signed(
+    directory: str,
+    hosts: Sequence[str] = (),
+    common_name: str = "determined-tpu-master",
+    days: int = 825,
+) -> Tuple[str, str]:
+    """Write a self-signed cert + key under `directory`; returns paths.
+
+    SANs cover localhost/127.0.0.1/this host plus `hosts` so one bootstrap
+    cert works for local devclusters and for agents dialing the master's
+    advertised address. Idempotent: existing files are reused (a restarted
+    master must keep the cert its fleet already pins).
+    """
+    cert_path = os.path.join(directory, "master-cert.pem")
+    key_path = os.path.join(directory, "master-key.pem")
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    if os.path.exists(cert_path) and os.path.exists(key_path):
+        # Reuse only while the existing cert still serves: not expired (or
+        # about to), and covering every requested host — a master restarted
+        # with a new advertised address must get a cert clients can verify,
+        # not a silent SAN mismatch.
+        try:
+            with open(cert_path, "rb") as f:
+                old = x509.load_pem_x509_certificate(f.read())
+            now = datetime.datetime.now(datetime.timezone.utc)
+            san = old.extensions.get_extension_for_class(
+                x509.SubjectAlternativeName
+            ).value
+            covered = {str(v) for v in san.get_values_for_type(x509.DNSName)}
+            covered |= {
+                str(v) for v in san.get_values_for_type(x509.IPAddress)
+            }
+            if old.not_valid_after_utc > now + datetime.timedelta(days=1) and (
+                set(hosts) <= covered
+            ):
+                return cert_path, key_path
+        except Exception:  # noqa: BLE001 — unreadable/garbage cert: replace
+            pass
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    names = {"localhost", socket.gethostname(), *hosts}
+    sans = []
+    for h in sorted(names):
+        try:
+            sans.append(x509.IPAddress(ipaddress.ip_address(h)))
+        except ValueError:
+            sans.append(x509.DNSName(h))
+    sans.append(x509.IPAddress(ipaddress.ip_address("127.0.0.1")))
+    subject = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, common_name)]
+    )
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(subject)
+        .issuer_name(subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(
+            x509.SubjectAlternativeName(sans), critical=False
+        )
+        .add_extension(
+            x509.BasicConstraints(ca=True, path_length=None), critical=True
+        )
+        .sign(key, hashes.SHA256())
+    )
+    os.makedirs(directory, exist_ok=True)
+    # Key first, restrictive mode, then cert: a crash between the writes
+    # must not leave a cert whose key is world-readable or missing.
+    fd = os.open(key_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "wb") as f:
+        f.write(
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption(),
+            )
+        )
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    return cert_path, key_path
+
+
+def resolve_cert(cert: Optional[str] = None) -> Optional[str]:
+    """Explicit argument wins; else the env var every process in the
+    cluster inherits (agents pass their environ to task subprocesses)."""
+    return cert if cert is not None else os.environ.get(CERT_ENV) or None
+
+
+def requests_verify(cert: Optional[str] = None):
+    """Value for requests' `verify=`: CA path, False for noverify, True
+    for the system store."""
+    cert = resolve_cert(cert)
+    if cert == NOVERIFY:
+        return False
+    return cert if cert else True
+
+
+def client_context(cert: Optional[str] = None) -> ssl.SSLContext:
+    """ssl.SSLContext for raw-socket clients (the shell tunnel)."""
+    cert = resolve_cert(cert)
+    if cert == NOVERIFY:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+    if cert:
+        return ssl.create_default_context(cafile=cert)
+    return ssl.create_default_context()
+
+
+def server_context(cert_path: str, key_path: str) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_path, key_path)
+    return ctx

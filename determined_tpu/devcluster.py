@@ -28,6 +28,7 @@ class DevCluster:
         db_path: str = ":memory:",
         scheduler: Optional[Dict[str, Any]] = None,
         preempt_timeout_s: float = 120.0,
+        tls: bool = False,
     ) -> None:
         # Trial subprocesses must import determined_tpu without installation.
         repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -42,8 +43,30 @@ class DevCluster:
             pools_config={"default": {"scheduler": scheduler or {"type": "priority"}}},
             preempt_timeout_s=preempt_timeout_s,
         )
-        self.api = ApiServer(self.master)
-        self.api.start()
+        self._cert_env_prev: Optional[str] = None
+        self._tls_dir: Optional[str] = None
+        self._tls = tls
+        try:
+            if tls:
+                # Self-signed bootstrap (det deploy local analog):
+                # in-process agents and their REAL trial subprocesses all
+                # verify against the cert via the inherited
+                # DTPU_MASTER_CERT env.
+                import tempfile
+
+                from determined_tpu.common import tls as tls_mod
+
+                self._tls_dir = tempfile.mkdtemp(prefix="dtpu-tls-")
+                cert, key = tls_mod.generate_self_signed(self._tls_dir)
+                self._cert_env_prev = os.environ.get(tls_mod.CERT_ENV)
+                os.environ[tls_mod.CERT_ENV] = cert
+                self.api = ApiServer(self.master, tls=(cert, key))
+            else:
+                self.api = ApiServer(self.master)
+            self.api.start()
+        except BaseException:
+            self._restore_tls_state()
+            raise
         self.master.external_url = self.api.url
         self.agents: List[AgentDaemon] = []
         self._agent_threads: List[threading.Thread] = []
@@ -81,11 +104,29 @@ class DevCluster:
         assert exp is not None
         return exp.wait_done(timeout=timeout)
 
+    def _restore_tls_state(self) -> None:
+        if not self._tls:
+            return
+        from determined_tpu.common.tls import CERT_ENV
+
+        if self._cert_env_prev is None:
+            os.environ.pop(CERT_ENV, None)
+        else:
+            os.environ[CERT_ENV] = self._cert_env_prev
+        if self._tls_dir is not None:
+            import shutil
+
+            # The dir holds the master's private key; don't leave copies
+            # strewn across /tmp after every TLS devcluster.
+            shutil.rmtree(self._tls_dir, ignore_errors=True)
+            self._tls_dir = None
+
     def stop(self) -> None:
         for agent in self.agents:
             agent.stop()
         self.master.shutdown()
         self.api.stop()
+        self._restore_tls_state()
 
     def __enter__(self) -> "DevCluster":
         return self

@@ -727,9 +727,21 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
 
 
 class ApiServer:
-    """HTTP front end; `serve_forever` in a daemon thread via start()."""
+    """HTTP(S) front end; `serve_forever` in a daemon thread via start().
 
-    def __init__(self, master: Master, host: str = "127.0.0.1", port: int = 0) -> None:
+    `tls=(cert_path, key_path)` serves HTTPS (ref: master TLS via
+    `internal/proxy/tls.go` config); the upgrade tunnels (shells, Jupyter
+    WS) ride the same listener, so TLS terminates at the master and
+    master→task hops stay on the private agent network.
+    """
+
+    def __init__(
+        self,
+        master: Master,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        tls: Optional[tuple] = None,
+    ) -> None:
         routes = build_routes(master)
 
         class _Handler(BaseHTTPRequestHandler):
@@ -988,19 +1000,44 @@ class ApiServer:
             def do_DELETE(self) -> None:  # noqa: N802
                 self._dispatch("DELETE")
 
+        ssl_ctx = None
+        if tls is not None:
+            from determined_tpu.common.tls import server_context
+
+            ssl_ctx = server_context(tls[0], tls[1])
+
         class _Server(ThreadingHTTPServer):
+            def get_request(self):  # noqa: ANN201
+                sock, addr = super().get_request()
+                if ssl_ctx is not None:
+                    # do_handshake_on_connect=False: the handshake then
+                    # happens at the handler thread's first read, so a
+                    # stalled client can't block the accept loop.
+                    sock = ssl_ctx.wrap_socket(
+                        sock, server_side=True, do_handshake_on_connect=False
+                    )
+                return sock, addr
+
             def handle_error(self, request, client_address):  # noqa: ANN001
                 import sys
 
                 exc = sys.exception()
                 if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
                     return  # client hung up mid-request (task exit); routine
+                import ssl as ssl_mod
+
+                if isinstance(exc, ssl_mod.SSLError) and ssl_ctx is not None:
+                    # Plaintext/bad-TLS probes on an HTTPS port are routine
+                    # noise; real handler OSErrors (ENOSPC, EMFILE) must
+                    # still surface.
+                    return
                 super().handle_error(request, client_address)
 
         self._httpd = _Server((host, port), _Handler)
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
-        self.url = f"http://{host}:{self.port}"
+        scheme = "https" if ssl_ctx is not None else "http"
+        self.url = f"{scheme}://{host}:{self.port}"
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:

@@ -43,6 +43,12 @@ def main() -> None:
         "--config-defaults", default=None,
         help="JSON experiment-config defaults merged under every submitted "
              'config (master.yaml analog), e.g. {"max_restarts": 2}')
+    parser.add_argument(
+        "--tls", action="store_true",
+        help="serve HTTPS; generates a self-signed cert next to --db if "
+             "--tls-cert/--tls-key are not given (det deploy local analog)")
+    parser.add_argument("--tls-cert", default=None)
+    parser.add_argument("--tls-key", default=None)
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -57,8 +63,41 @@ def main() -> None:
         otlp_endpoint=args.otlp_endpoint,
         log_sink_url=args.log_sink_url,
     )
-    api = ApiServer(master, host=args.host, port=args.port)
-    master.external_url = args.external_url or f"http://127.0.0.1:{api.port}"
+    if bool(args.tls_cert) != bool(args.tls_key):
+        parser.error("--tls-cert and --tls-key must be given together")
+    tls = None
+    if args.tls or args.tls_cert:
+        if args.tls_cert:
+            tls = (args.tls_cert, args.tls_key)
+        else:
+            import os
+            from urllib.parse import urlparse
+
+            from determined_tpu.common.tls import generate_self_signed
+
+            cert_dir = (
+                os.path.dirname(os.path.abspath(args.db))
+                if args.db != ":memory:" else "."
+            )
+            # The advertised address must be in the SANs or every remote
+            # agent/CLI fails hostname verification against the bootstrap.
+            hosts = []
+            if args.external_url:
+                h = urlparse(args.external_url).hostname
+                if h:
+                    hosts.append(h)
+            if args.host not in ("0.0.0.0", "::", ""):
+                hosts.append(args.host)
+            tls = generate_self_signed(cert_dir, hosts=hosts)
+            logger.info(
+                "TLS bootstrap cert at %s — distribute it to clients via "
+                "DTPU_MASTER_CERT", tls[0],
+            )
+    api = ApiServer(master, host=args.host, port=args.port, tls=tls)
+    scheme = "https" if tls else "http"
+    master.external_url = (
+        args.external_url or f"{scheme}://127.0.0.1:{api.port}"
+    )
     restored = master.restore_experiments()
     if restored:
         logger.info("restored %d experiment(s)", restored)
