@@ -71,6 +71,7 @@ class AgentDaemon:
         self._tasks: Dict[str, _Task] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        self._dead = False  # die(): suppress exit reports (abrupt loss)
 
     # -- lifecycle -----------------------------------------------------------
     def register(self) -> None:
@@ -132,6 +133,15 @@ class AgentDaemon:
     def stop(self) -> None:
         self._stop.set()
         self._kill_all_tasks()
+
+    def die(self) -> None:
+        """Abrupt death (spot-reclaim simulation): kill everything and
+        report NOTHING — the master must discover the loss itself
+        (provisioner reconcile / lose_agent), exactly as with a yanked VM.
+        A graceful stop() would race EXITED reports into the master and
+        misattribute the loss as a workload crash (budget charge)."""
+        self._dead = True
+        self.stop()
 
     # -- actions ---------------------------------------------------------------
     def handle(self, action: Dict[str, Any]) -> None:
@@ -200,6 +210,8 @@ class AgentDaemon:
         code = task.proc.wait()
         with self._lock:
             self._tasks.pop(task.alloc_id, None)
+        if self._dead:
+            return  # abrupt death: no goodbye (see die())
         try:
             self.session.post(
                 f"/api/v1/agents/{self.agent_id}/events",

@@ -87,8 +87,15 @@ class DevCluster:
         return agent
 
     def kill_agent(self, agent: AgentDaemon) -> None:
-        agent.stop()
+        # Order matters for failure attribution: the master learns of the
+        # loss FIRST (as with a real abrupt VM death — allocations complete
+        # as infra failures, no restart-budget charge), then the local
+        # process tree is torn down. The reverse order races the dying
+        # agent's EXITED report into the master and misattributes the loss
+        # as a workload crash. The task token is revoked at completion, so
+        # the briefly-surviving old process can no longer write.
         self.master.lose_agent(agent.agent_id)
+        agent.die()
 
     # -- client-side --------------------------------------------------------
     def session(self) -> Session:

@@ -41,6 +41,9 @@ class Allocation:
     # exit
     exit_code: Optional[int] = None
     exit_reason: Optional[str] = None
+    # True when the exit was the platform's fault (node lost, spot reclaim,
+    # pod evicted): trials requeue without charging their restart budget.
+    infra_failure: bool = False
 
 
 class AllocationService:
@@ -72,7 +75,10 @@ class AllocationService:
         with self._lock:
             return self._allocs.get(alloc_id)
 
-    def complete(self, alloc_id: str, exit_code: int = 0, reason: str = "") -> None:
+    def complete(
+        self, alloc_id: str, exit_code: int = 0, reason: str = "",
+        infra: bool = False,
+    ) -> None:
         """A task process group finished (or was killed)."""
         with self._cond:
             alloc = self._allocs.get(alloc_id)
@@ -81,6 +87,7 @@ class AllocationService:
             alloc.state = TERMINATED
             alloc.exit_code = exit_code
             alloc.exit_reason = reason
+            alloc.infra_failure = infra
             self._cond.notify_all()
         if self._on_exit is not None:
             self._on_exit(alloc)

@@ -218,10 +218,18 @@ class Master:
         # (agent_event below). Agent pools never call it.
         for _pool in self.rm.pools.values():
             _pool.on_alloc_exit = (
-                lambda a, c, r: self.alloc_service.complete(
-                    a, exit_code=c, reason=r
+                lambda a, c, r, infra=False: self.alloc_service.complete(
+                    a, exit_code=c, reason=r, infra=infra
                 )
             )
+        if kube_client is not None and getattr(kube_client, "log_sink", 1) is None:
+            # Pod stdout → the same store/sinks agent-shipped logs reach.
+            def _kube_logs(task_id: str, lines: List[Dict[str, Any]]) -> None:
+                self.db.add_task_logs(task_id, lines)
+                if self.log_sink is not None:
+                    self.log_sink.ship(task_id, lines)
+
+            kube_client.log_sink = _kube_logs
         self.alloc_service = AllocationService(preempt_timeout_s=preempt_timeout_s)
         self.agent_hub = AgentHub()
         from determined_tpu.master.auth import AuthService
@@ -476,7 +484,11 @@ class Master:
                             other_agent, {"type": "KILL", "alloc_id": alloc_id}
                         )
                 self.alloc_service.complete(
-                    alloc_id, exit_code=1, reason=f"agent {agent_id} lost"
+                    alloc_id, exit_code=1, reason=f"agent {agent_id} lost",
+                    # A lost host (spot reclaim, VM failure) is the
+                    # platform's fault: requeue without charging the trial's
+                    # restart budget (the aws_spot.go reclaim semantics).
+                    infra=True,
                 )
 
     def attach_provisioner(self, service: Any) -> None:
@@ -539,7 +551,10 @@ class Master:
                 del self._trial_allocs[exp_trial[1]]
         if exp_trial:
             exp, trial_id = exp_trial
-            exp.trial_exited(trial_id, alloc.exit_code or 0, alloc.exit_reason or "")
+            exp.trial_exited(
+                trial_id, alloc.exit_code or 0, alloc.exit_reason or "",
+                infra=alloc.infra_failure,
+            )
 
     # -- experiments -----------------------------------------------------------
     def create_experiment(self, config: Dict[str, Any]) -> int:

@@ -52,6 +52,14 @@ class TrialRecord:
     exited: bool = False
     restarts: int = 0
     run_id: int = 0
+    infra_requeues: int = 0       # free (non-budgeted) requeues consumed
+
+
+# Upper bound on free infra requeues per trial: generous for real platform
+# churn (a trial surviving 16 spot reclaims is unlucky, not broken) but
+# finite, so a deterministic failure misclassified as infra still
+# terminates through the restart budget.
+INFRA_REQUEUE_CAP = 16
 
 
 class Experiment:
@@ -297,8 +305,15 @@ class Experiment:
         del trial_id, progress  # experiment progress derives from the searcher
         self.db.set_experiment_progress(self.id, self.searcher.progress())
 
-    def trial_exited(self, trial_id: int, exit_code: int, reason: str = "") -> None:
-        """Allocation for this trial ended (ref: trial.go:458 allocationExited)."""
+    def trial_exited(
+        self, trial_id: int, exit_code: int, reason: str = "",
+        infra: bool = False,
+    ) -> None:
+        """Allocation for this trial ended (ref: trial.go:458 allocationExited).
+
+        `infra`: the exit was the platform's fault (node lost, spot reclaim,
+        pod evicted) — requeue from the latest checkpoint WITHOUT charging
+        max_restarts, which exists to bound *workload* crash loops."""
         with self._cond:
             rec = self.trials[trial_id]
             if rec.exited:
@@ -320,6 +335,27 @@ class Experiment:
                 self._process_ops(self.searcher.trial_closed(rec.request_id))
             elif clean and self.state == db_mod.PAUSED:
                 pass  # preempted by pause; relaunched on activate
+            elif (
+                not clean and infra and not self.unmanaged
+                and rec.infra_requeues < INFRA_REQUEUE_CAP
+            ):
+                # The cap bounds misclassified failures: a deterministic
+                # error reported as infra (e.g. RBAC rejection on every pod
+                # create) would otherwise relaunch forever — and the
+                # relaunch happens on this same call stack, so "forever"
+                # is a RecursionError in the master. Past the cap the exit
+                # falls through to the budgeted branch below.
+                rec.infra_requeues += 1
+                rec.run_id += 1
+                self.db.update_trial(trial_id, run_id=rec.run_id)
+                logger.info(
+                    "trial %d infra failure (%s): requeued (%d/%d infra), "
+                    "restart budget untouched (%d/%d)",
+                    trial_id, reason, rec.infra_requeues, INFRA_REQUEUE_CAP,
+                    rec.restarts, self.max_restarts,
+                )
+                if self.state == db_mod.ACTIVE:
+                    self.launcher.launch(self, rec)
             elif not clean and rec.restarts < self.max_restarts and not self.unmanaged:
                 rec.restarts += 1
                 rec.run_id += 1

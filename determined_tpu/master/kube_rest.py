@@ -1,0 +1,305 @@
+"""Kubernetes REST driver: a production KubeClient speaking the apiserver's
+HTTP API.
+
+Rebuild of the reference's client-go layer (`master/internal/rm/
+kubernetesrm/pods.go:63` clientset construction + `request_queue.go`
+retry discipline): in-cluster config comes from the standard pod
+environment (KUBERNETES_SERVICE_HOST/PORT + the serviceaccount token/CA/
+namespace files); every mutating call retries transient failures with
+backoff; pod stdout is followed over `GET .../log?follow=true` and shipped
+into the master's task-log store (the reference streams container logs via
+fluentbit→master; here the master pulls, which needs no agent in the pod).
+
+The pool-side contract (`master/kubernetes.py` KubeClient) is unchanged —
+the whole RM test matrix runs against this driver pointed at a fake
+apiserver speaking the same HTTP (tests/test_kube_rest.py).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import requests
+
+from determined_tpu.master.kubernetes import KubeClient, NodeInfo
+
+logger = logging.getLogger("determined_tpu.master")
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+TPU_RESOURCE = "google.com/tpu"
+SLOTS_LABEL = "determined-tpu/slots"
+MANAGED_LABEL = "determined-tpu/alloc"
+
+# Log shipper callback: (task_id, [{"log": line, "level": ...}, ...]).
+LogSink = Callable[[str, List[Dict[str, Any]]], None]
+
+
+class RestKubeClient(KubeClient):
+    """KubeClient over the apiserver REST API (bearer token + CA).
+
+    All arguments default to the in-cluster pod environment; tests inject a
+    fake apiserver URL. `image`: the container image pods run (must carry
+    this package; in-cluster default assumes the master's own image).
+    """
+
+    def __init__(
+        self,
+        base_url: Optional[str] = None,
+        token: Optional[str] = None,
+        ca_cert: Optional[str] = None,
+        namespace: Optional[str] = None,
+        image: str = "determined-tpu:latest",
+        sa_dir: str = SA_DIR,
+        max_retries: int = 5,
+        timeout: float = 30.0,
+    ) -> None:
+        if base_url is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError(
+                    "not in a cluster: KUBERNETES_SERVICE_HOST unset and no "
+                    "base_url given"
+                )
+            base_url = f"https://{host}:{port}"
+        self.base_url = base_url.rstrip("/")
+        if token is None:
+            token_path = os.path.join(sa_dir, "token")
+            if os.path.exists(token_path):
+                with open(token_path) as f:
+                    token = f.read().strip()
+        if ca_cert is None:
+            ca_path = os.path.join(sa_dir, "ca.crt")
+            if os.path.exists(ca_path):
+                ca_cert = ca_path
+        if namespace is None:
+            ns_path = os.path.join(sa_dir, "namespace")
+            if os.path.exists(ns_path):
+                with open(ns_path) as f:
+                    namespace = f.read().strip()
+        self.namespace = namespace or "default"
+        self.image = image
+        self._verify: Any = ca_cert if ca_cert else True
+        self._max_retries = max_retries
+        self._timeout = timeout
+        self._http = requests.Session()
+        if token:
+            self._http.headers["Authorization"] = f"Bearer {token}"
+        # name -> status.reason of Failed pods (failure attribution:
+        # Evicted/Preempted are infra, not workload crashes).
+        self._reasons: Dict[str, str] = {}
+        self._reasons_lock = threading.Lock()
+        # Pod log followers: name -> thread; sink wired by the master.
+        self.log_sink: Optional[LogSink] = None
+        self._followers: Dict[str, threading.Thread] = {}
+        self._followers_lock = threading.Lock()
+
+    # -- transport ---------------------------------------------------------
+    def _request(
+        self, method: str, path: str, *, json_body: Any = None,
+        params: Optional[Dict[str, str]] = None, ok_missing: bool = False,
+        ok_conflict: bool = False, stream: bool = False,
+    ) -> Optional[requests.Response]:
+        """Call the apiserver with request_queue.go-style retries: transient
+        statuses/conn errors back off and retry; 404 returns None when the
+        caller treats absence as success (delete of a gone pod); 409
+        returns None when the caller treats already-exists as success (a
+        create whose response was lost and retried — request_queue.go's
+        errDeletionPending/already-exists handling)."""
+        url = f"{self.base_url}{path}"
+        last: Optional[Exception] = None
+        for attempt in range(self._max_retries + 1):
+            try:
+                resp = self._http.request(
+                    method, url, json=json_body, params=params,
+                    timeout=self._timeout, stream=stream,
+                    # Explicit per request: an ambient REQUESTS_CA_BUNDLE
+                    # would silently override a session-level setting.
+                    verify=self._verify,
+                )
+                if ok_missing and resp.status_code == 404:
+                    return None
+                if ok_conflict and resp.status_code == 409:
+                    return None
+                if resp.status_code in (429, 500, 502, 503, 504):
+                    raise requests.HTTPError(
+                        f"retryable apiserver status {resp.status_code}"
+                    )
+                resp.raise_for_status()
+                return resp
+            except (
+                requests.ConnectionError, requests.Timeout, requests.HTTPError
+            ) as e:
+                last = e
+                if isinstance(e, requests.HTTPError) and e.response is not None:
+                    if e.response.status_code not in (429, 500, 502, 503, 504):
+                        raise
+                if attempt == self._max_retries:
+                    break
+                time.sleep(min(2.0 ** attempt * 0.1, 5.0))
+        assert last is not None
+        raise last
+
+    # -- KubeClient surface --------------------------------------------------
+    def list_nodes(self) -> List[NodeInfo]:
+        resp = self._request("GET", "/api/v1/nodes")
+        assert resp is not None
+        out: List[NodeInfo] = []
+        for item in resp.json().get("items", []):
+            meta = item.get("metadata", {})
+            status = item.get("status", {})
+            spec = item.get("spec", {})
+            if spec.get("unschedulable"):
+                continue
+            alloc = status.get("allocatable", {})
+            labels = meta.get("labels", {})
+            slots = int(alloc.get(TPU_RESOURCE, labels.get(SLOTS_LABEL, 0)))
+            if slots <= 0:
+                continue  # not a TPU host; nothing we can place
+            out.append(
+                NodeInfo(
+                    name=meta["name"], slots=slots,
+                    pool=labels.get("cloud.google.com/gke-nodepool", "default"),
+                )
+            )
+        return out
+
+    def create_pod(self, spec: Dict[str, Any]) -> str:
+        manifest = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": spec["name"],
+                "labels": spec.get("labels", {}),
+            },
+            "spec": {
+                # Pre-pinned by our gang scheduler (the GKE TPU-slice
+                # pattern: one pod per TPU VM host, placement decided
+                # before creation).
+                "nodeName": spec["node"],
+                "restartPolicy": "Never",
+                "tolerations": [
+                    {"key": TPU_RESOURCE, "operator": "Exists",
+                     "effect": "NoSchedule"},
+                ],
+                "containers": [
+                    {
+                        "name": "task",
+                        "image": self.image,
+                        "command": spec["command"],
+                        "env": [
+                            {"name": k, "value": str(v)}
+                            for k, v in spec.get("env", {}).items()
+                        ],
+                    }
+                ],
+            },
+        }
+        resp = self._request(
+            "POST", f"/api/v1/namespaces/{self.namespace}/pods",
+            json_body=manifest, ok_conflict=True,
+        )
+        if resp is None:
+            # 409: our earlier create succeeded but its response was lost
+            # before a retry (pod names are alloc-unique, so the conflict
+            # can only be our own pod) — adopt it instead of failing the
+            # gang and leaking a live pod.
+            logger.info("pod %s already exists; adopting", spec["name"])
+        task_id = spec.get("labels", {}).get("determined-tpu/task", "")
+        if self.log_sink is not None and task_id:
+            self._start_log_follower(spec["name"], task_id)
+        return spec["name"]
+
+    def delete_pod(self, name: str) -> None:
+        self._request(
+            "DELETE",
+            f"/api/v1/namespaces/{self.namespace}/pods/{name}",
+            params={"gracePeriodSeconds": "15"},
+            ok_missing=True,
+        )
+
+    def pod_phases(self) -> Dict[str, str]:
+        resp = self._request(
+            "GET", f"/api/v1/namespaces/{self.namespace}/pods",
+            params={"labelSelector": MANAGED_LABEL},
+        )
+        assert resp is not None
+        phases: Dict[str, str] = {}
+        reasons: Dict[str, str] = {}
+        for item in resp.json().get("items", []):
+            name = item.get("metadata", {}).get("name", "")
+            status = item.get("status", {})
+            phases[name] = status.get("phase", "Pending")
+            if status.get("reason"):
+                reasons[name] = status["reason"]
+        with self._reasons_lock:
+            self._reasons = reasons
+        return phases
+
+    def pod_status_reasons(self) -> Dict[str, str]:
+        with self._reasons_lock:
+            return dict(self._reasons)
+
+    # -- log shipping --------------------------------------------------------
+    def _start_log_follower(self, pod_name: str, task_id: str) -> None:
+        with self._followers_lock:
+            if pod_name in self._followers:
+                return
+            t = threading.Thread(
+                target=self._follow_logs, args=(pod_name, task_id),
+                name=f"kube-logs-{pod_name}", daemon=True,
+            )
+            self._followers[pod_name] = t
+        t.start()
+
+    def _follow_logs(self, pod_name: str, task_id: str) -> None:
+        """Stream the pod's stdout into the task-log sink until the stream
+        ends (pod finished or deleted). Batches lines to one sink call per
+        read burst — the same batching contract as the agent shipper."""
+        sink = self.log_sink
+        assert sink is not None
+        try:
+            # A pod still ContainerCreating 400s on /log ("container is
+            # waiting to start"); poll until it starts (404 = pod gone,
+            # give up). The deadline bounds pods stuck Pending forever.
+            deadline = time.time() + 600.0
+            while True:
+                try:
+                    resp = self._request(
+                        "GET",
+                        f"/api/v1/namespaces/{self.namespace}/pods/"
+                        f"{pod_name}/log",
+                        params={"follow": "true"},
+                        stream=True,
+                        ok_missing=True,
+                    )
+                except requests.HTTPError as e:
+                    if (
+                        e.response is not None
+                        and e.response.status_code == 400
+                        and time.time() < deadline
+                    ):
+                        time.sleep(2.0)
+                        continue
+                    raise
+                break
+            if resp is None:
+                return
+            batch: List[Dict[str, Any]] = []
+            for line in resp.iter_lines(decode_unicode=True):
+                if line is None:
+                    continue
+                batch.append({"log": str(line), "level": "INFO"})
+                if len(batch) >= 64:
+                    sink(task_id, batch)
+                    batch = []
+            if batch:
+                sink(task_id, batch)
+        except Exception:  # noqa: BLE001 — a dead follower must not crash RM
+            logger.exception("pod log follower for %s failed", pod_name)
+        finally:
+            with self._followers_lock:
+                self._followers.pop(pod_name, None)

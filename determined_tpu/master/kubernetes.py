@@ -46,6 +46,14 @@ RUNNING = "Running"
 SUCCEEDED = "Succeeded"
 FAILED = "Failed"
 
+# status.reason values that mean the NODE took the pod down, not the
+# workload (GKE spot reclaim / autoscaler drain / kubelet shutdown). These
+# take the infra-requeue path — no trial restart budget charged — matching
+# the agent RM's spot handling (provisioner reclaim → checkpoint-requeue).
+INFRA_POD_REASONS = frozenset(
+    {"Evicted", "Preempted", "NodeShutdown", "Terminated", "NodeLost"}
+)
+
 
 @dataclasses.dataclass
 class NodeInfo:
@@ -56,16 +64,42 @@ class NodeInfo:
     pool: str = "default"      # node-pool label, informational
 
 
-def _pod_name(task_id: str, rank: int) -> str:
-    base = re.sub(r"[^a-z0-9-]", "-", task_id.lower())
+def _pod_name(alloc_id: str, rank: int) -> str:
+    """Pod names are keyed by ALLOC id, not task id: a requeued trial gets
+    a fresh allocation, so its pods can never collide with the previous
+    run's still-terminating pods (15s delete grace under the REST driver),
+    never inherit their phases in sync(), and always get a fresh log
+    follower."""
+    base = re.sub(r"[^a-z0-9-]", "-", alloc_id.lower())
     return f"dtpu-{base}-r{rank}"
+
+
+def _creation_failure_is_infra(exc: BaseException) -> bool:
+    """Attribute a pod-creation failure: connection errors and 5xx that
+    survived retries are environmental (infra: free requeue); 4xx
+    rejections (bad manifest, RBAC, name conflict) would fail identically
+    on every requeue, so they charge the restart budget and terminate."""
+    try:
+        import requests
+
+        if isinstance(exc, requests.HTTPError) and exc.response is not None:
+            return exc.response.status_code >= 500
+    except ImportError:  # pragma: no cover
+        pass
+    if isinstance(exc, ValueError):
+        return "unknown node" in str(exc)  # scaled away = infra; else config
+    return True
 
 
 class KubeClient:
     """Minimal clientset surface the pool needs (ref pods.go clientset use).
 
-    A production driver would back this with the k8s REST API; tests use
-    the fakes below. Methods must be thread-safe."""
+    The production driver is `master/kube_rest.py` (apiserver REST API);
+    tests use the fakes below. Methods must be thread-safe."""
+
+    # Wired by the master to db.add_task_logs (+ ES sink): pod stdout ships
+    # into the task-log store like agent-run tasks.
+    log_sink: Optional[Callable[[str, List[Dict[str, Any]]], None]] = None
 
     def list_nodes(self) -> List[NodeInfo]:
         raise NotImplementedError
@@ -80,6 +114,11 @@ class KubeClient:
     def pod_phases(self) -> Dict[str, str]:
         """name -> PodPhase for every live pod this client knows."""
         raise NotImplementedError
+
+    def pod_status_reasons(self) -> Dict[str, str]:
+        """name -> status.reason for failed pods (e.g. "Evicted"); used to
+        attribute failures to infrastructure vs the workload. Optional."""
+        return {}
 
 
 class KubernetesResourcePool(ResourcePool):
@@ -136,7 +175,7 @@ class KubernetesResourcePool(ResourcePool):
         try:
             for rank, (node, env) in enumerate(ranks):
                 spec = {
-                    "name": _pod_name(task_id, rank),
+                    "name": _pod_name(alloc_id, rank),
                     "node": node,  # pre-pinned: gang decided by our scheduler
                     "labels": {
                         "determined-tpu/alloc": alloc_id,
@@ -157,7 +196,10 @@ class KubernetesResourcePool(ResourcePool):
                     logger.exception("cleanup of partial pod %s failed", name)
             self.release(alloc_id)
             if self.on_alloc_exit is not None:
-                self.on_alloc_exit(alloc_id, 1, f"pod creation failed: {e}")
+                self.on_alloc_exit(
+                    alloc_id, 1, f"pod creation failed: {e}",
+                    _creation_failure_is_infra(e),
+                )
             return []
         with self._pods_lock:
             self._pods[alloc_id] = names
@@ -197,7 +239,7 @@ class KubernetesResourcePool(ResourcePool):
 
         Called from the master tick loop (the polling analog of the
         reference's informer callbacks)."""
-        exits: List[Tuple[str, int, str]] = []
+        exits: List[Tuple[str, int, str, bool]] = []
 
         nodes = {n.name: n for n in self.client.list_nodes()}
         with self._lock:
@@ -207,10 +249,11 @@ class KubernetesResourcePool(ResourcePool):
                 self.add_agent(name, node.slots)
         for name in known - set(nodes):
             # Node gone (pool scale-down, host failure): every gang with a
-            # pod there fails over, same semantics as a lost agent.
+            # pod there fails over, same semantics as a lost agent —
+            # infrastructure, not the workload, so no budget charge.
             # (remove_agent → our release() tears the pods down.)
             for alloc_id in self.remove_agent(name):
-                exits.append((alloc_id, 1, f"node {name} lost"))
+                exits.append((alloc_id, 1, f"node {name} lost", True))
 
         # Gangs BEFORE phases: a gang registered between the two snapshots
         # is simply absent here and checked next tick. The other order reads
@@ -218,25 +261,40 @@ class KubernetesResourcePool(ResourcePool):
         with self._pods_lock:
             gangs = {a: list(ns) for a, ns in self._pods.items()}
         phases = self.client.pod_phases()
+        reasons = self.client.pod_status_reasons()
         for alloc_id, pod_names in gangs.items():
             pod_phases = [phases.get(n) for n in pod_names]
-            if any(p == FAILED or p is None for p in pod_phases):
-                which = [
-                    n for n, p in zip(pod_names, pod_phases)
-                    if p == FAILED or p is None
-                ]
+            bad = [
+                (n, p) for n, p in zip(pod_names, pod_phases)
+                if p == FAILED or p is None
+            ]
+            if bad:
+                # Failure attribution (ref: the spot state machine in
+                # aws_spot.go): a pod that VANISHED (deleted out from under
+                # us: node drain, preemption eviction) or Failed with an
+                # infra status.reason is the platform's fault — requeue
+                # without charging the trial's restart budget. A pod that
+                # Failed on its own (non-zero exit) is the workload's.
+                infra = all(
+                    p is None or reasons.get(n) in INFRA_POD_REASONS
+                    for n, p in bad
+                )
+                which = ", ".join(
+                    f"{n}({'gone' if p is None else reasons.get(n, FAILED)})"
+                    for n, p in bad
+                )
                 exits.append(
-                    (alloc_id, 1, f"pod(s) {', '.join(which)} failed")
+                    (alloc_id, 1, f"pod(s) {which} failed", infra)
                 )
                 self.release(alloc_id)  # single teardown point: deletes pods
             elif all(p == SUCCEEDED for p in pod_phases):
-                exits.append((alloc_id, 0, ""))
+                exits.append((alloc_id, 0, "", False))
                 self.release(alloc_id)
 
-        for alloc_id, code, reason in exits:
+        for alloc_id, code, reason, infra in exits:
             if self.on_alloc_exit is not None:
                 try:
-                    self.on_alloc_exit(alloc_id, code, reason)
+                    self.on_alloc_exit(alloc_id, code, reason, infra)
                 except Exception:  # noqa: BLE001
                     logger.exception("on_alloc_exit failed for %s", alloc_id)
 
@@ -307,6 +365,7 @@ class LocalProcessKubeClient(KubeClient):
         self._nodes = {n.name: n for n in nodes}
         self._procs: Dict[str, subprocess.Popen] = {}
         self._lock = threading.Lock()
+        self.log_sink = None
 
     def list_nodes(self) -> List[NodeInfo]:
         return list(self._nodes.values())
@@ -319,13 +378,54 @@ class LocalProcessKubeClient(KubeClient):
         proc = subprocess.Popen(
             spec["command"],
             env=env,
-            stdout=subprocess.DEVNULL,
+            stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             start_new_session=True,
         )
         with self._lock:
             self._procs[spec["name"]] = proc
+        # Ship pod stdout into the task-log store (the k8s path previously
+        # sent it to DEVNULL, so `dtpu trial logs` was blind to k8s tasks).
+        # Always drain — an undrained PIPE deadlocks the child once full.
+        task_id = spec.get("labels", {}).get("determined-tpu/task", "")
+        threading.Thread(
+            target=self._drain_logs, args=(proc, task_id),
+            name=f"pod-logs-{spec['name']}", daemon=True,
+        ).start()
         return spec["name"]
+
+    def _drain_logs(self, proc: subprocess.Popen, task_id: str) -> None:
+        import time as _time
+
+        assert proc.stdout is not None
+        batch: List[Dict[str, Any]] = []
+        last_flush = _time.monotonic()
+
+        def flush() -> None:
+            nonlocal batch, last_flush
+            sink = self.log_sink
+            if batch and sink is not None and task_id:
+                try:
+                    sink(task_id, batch)
+                except Exception:  # noqa: BLE001
+                    logger.exception("pod log sink failed")
+            batch = []
+            last_flush = _time.monotonic()
+
+        try:
+            # Batch per burst (one DB txn per flush, like the agent and
+            # REST-driver shippers) instead of one insert per line.
+            for raw in proc.stdout:
+                batch.append({
+                    "log": raw.decode("utf-8", "replace").rstrip("\n"),
+                    "level": "INFO",
+                })
+                if len(batch) >= 64 or _time.monotonic() - last_flush > 1.0:
+                    flush()
+        except (OSError, ValueError):
+            pass  # pipe closed at kill; routine
+        finally:
+            flush()
 
     def delete_pod(self, name: str) -> None:
         import os

@@ -379,12 +379,14 @@ class FakeTPUDriver:
 
     def reclaim(self, name: str) -> None:
         """Platform takes the spot slice back: the VM (and its agent) dies
-        abruptly — no goodbye to the master."""
+        abruptly — no goodbye to the master (die(), not stop(): a graceful
+        stop would race EXITED reports in and misattribute the reclaim as a
+        workload crash)."""
         with self._lock:
             self.instances[name] = RECLAIMED
             agent = self._agents.pop(name, None)
         if agent is not None:
-            agent.stop()  # type: ignore[attr-defined]
+            agent.die()  # type: ignore[attr-defined]
 
 
 class GCPTPUProvisioner:

@@ -49,7 +49,9 @@ class ResourcePool:
         #: Backends that observe task exits themselves (k8s pod phases) call
         #: this with (alloc_id, exit_code, reason); the agent backend leaves
         #: it alone — exits arrive as agent EXITED events instead.
-        self.on_alloc_exit: Optional[Callable[[str, int, str], None]] = None
+        # (alloc_id, exit_code, reason, infra_failure) — infra failures requeue
+        # trials without charging restart budget (kubernetes.py sync).
+        self.on_alloc_exit: Optional[Callable[..., None]] = None
 
     # -- backend realization hooks (one iface over backends; overridden by
     # -- the Kubernetes pool) ------------------------------------------------

@@ -79,7 +79,9 @@ class TestChaos:
             trials = dc.master.db.list_trials(exp_id)
             assert len(trials) == 4
             # the churn really hit someone; the budget absorbed it
-            assert sum(t["restarts"] for t in trials) >= 1
+            # agent loss is an infra failure: it requeues (run_id++)
+            # without charging the restart budget
+            assert sum(t["run_id"] for t in trials) >= 1
             assert all(t["state"] == "COMPLETED" for t in trials)
 
     def test_kill_during_rendezvous(self, tmp_path):
@@ -111,7 +113,7 @@ class TestChaos:
             state = dc.wait_experiment(exp_id, timeout=300)
             assert state == "COMPLETED"
             trial = dc.master.db.list_trials(exp_id)[0]
-            assert trial["restarts"] >= 1
+            assert trial["run_id"] >= 1  # infra requeue, budget untouched
             assert trial["steps_completed"] == 3
 
 

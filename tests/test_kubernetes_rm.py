@@ -75,7 +75,7 @@ class TestKubernetesPool:
         client = FakeKubeClient(_nodes(2, 4))
         pool = KubernetesResourcePool("k8s", None, client=client)
         exits = []
-        pool.on_alloc_exit = lambda a, c, r: exits.append((a, c, r))
+        pool.on_alloc_exit = lambda a, c, r, infra=False: exits.append((a, c, r))
         _submit(pool, "a1", 8)
         pool.sync()  # pods go Running
         name = next(iter(client.pods))
@@ -91,7 +91,7 @@ class TestKubernetesPool:
         client = FakeKubeClient(_nodes(1, 4))
         pool = KubernetesResourcePool("k8s", None, client=client)
         exits = []
-        pool.on_alloc_exit = lambda a, c, r: exits.append((a, c, r))
+        pool.on_alloc_exit = lambda a, c, r, infra=False: exits.append((a, c, r))
         _submit(pool, "a1", 4)
         pool.sync()
         for name in list(client.pods):
@@ -103,7 +103,7 @@ class TestKubernetesPool:
         client = FakeKubeClient(_nodes(2, 4))
         pool = KubernetesResourcePool("k8s", None, client=client)
         exits = []
-        pool.on_alloc_exit = lambda a, c, r: exits.append((a, c, r))
+        pool.on_alloc_exit = lambda a, c, r, infra=False: exits.append((a, c, r))
         _submit(pool, "a1", 8)
         client.remove_node("node-1")
         pool.sync()
@@ -131,7 +131,7 @@ class TestKubernetesPool:
         client = FakeKubeClient(_nodes(1, 4))
         pool = KubernetesResourcePool("k8s", None, client=client)
         exits = []
-        pool.on_alloc_exit = lambda a, c, r: exits.append((a, c))
+        pool.on_alloc_exit = lambda a, c, r, infra=False: exits.append((a, c))
         _submit(pool, "a1", 4)
         pool.kill_alloc("a1")
         assert client.pods == {}
@@ -157,7 +157,7 @@ class TestKubernetesPool:
         client.create_pod = flaky_create
         pool = KubernetesResourcePool("k8s", None, client=client)
         exits = []
-        pool.on_alloc_exit = lambda a, c, r: exits.append((a, c, r))
+        pool.on_alloc_exit = lambda a, c, r, infra=False: exits.append((a, c, r))
         _submit(pool, "a1", 8)
         assert client.pods == {}  # partial pod torn down
         assert exits and exits[0][0] == "a1" and exits[0][1] == 1
@@ -223,6 +223,10 @@ class TestKubernetesE2E:
             assert trials
             # pods cleaned up after the gang completed
             assert client.pod_phases() == {}
+            # pod stdout shipped into the task-log store (was DEVNULL in
+            # r2 — `dtpu trial logs` was blind to k8s tasks)
+            logs = master.db.get_task_logs(f"trial-{trials[0]['id']}")
+            assert logs, "no pod stdout reached the task-log store"
         finally:
             api.stop()
             master.shutdown()

@@ -323,6 +323,30 @@ class TestExperimentFSM:
         assert db.get_trial(rec.trial_id)["state"] == db_mod.ERRORED
         assert exp.state == db_mod.ERRORED
 
+    def test_infra_failures_requeue_without_budget_then_cap(self):
+        """Infra exits (node lost, pod evicted) requeue free of charge —
+        but only INFRA_REQUEUE_CAP times, so a deterministic failure
+        misclassified as infra still terminates via the budget."""
+        from determined_tpu.master.experiment import INFRA_REQUEUE_CAP
+
+        db, launcher, exp = self._make(
+            {"searcher": {"name": "single", "max_length": 10},
+             "hyperparameters": SPACE, "max_restarts": 1}
+        )
+        exp.start()
+        _, rec = launcher.launched[0]
+        for _ in range(INFRA_REQUEUE_CAP):
+            exp.trial_exited(rec.trial_id, 1, "node lost", infra=True)
+        assert rec.restarts == 0  # budget untouched
+        assert rec.run_id == INFRA_REQUEUE_CAP
+        assert len(launcher.launched) == 1 + INFRA_REQUEUE_CAP
+        # Past the cap, infra exits charge the budget and terminate.
+        exp.trial_exited(rec.trial_id, 1, "node lost", infra=True)
+        assert rec.restarts == 1
+        exp.trial_exited(rec.trial_id, 1, "node lost", infra=True)
+        assert db.get_trial(rec.trial_id)["state"] == db_mod.ERRORED
+        assert exp.state == db_mod.ERRORED
+
     def test_pause_activate_resume(self):
         db, launcher, exp = self._make(
             {"searcher": {"name": "single", "max_length": 10},
