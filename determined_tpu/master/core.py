@@ -518,6 +518,7 @@ class Master:
             self.log_sink.stop()
         for svc in self._provisioners:
             svc.stop()
+        self.db.close()  # drain the batched-write queue
 
     # -- allocation exits ------------------------------------------------------
     def _allocation_exited(self, alloc) -> None:

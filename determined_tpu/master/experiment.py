@@ -87,7 +87,12 @@ class Experiment:
         self.trials: Dict[int, TrialRecord] = {}          # trial_id -> record
         self._by_request: Dict[int, int] = {}             # request_id -> trial_id
         self._cancel_requested = False
-        self._lock = threading.Lock()
+        # RLock: trial_exited relaunches under the lock, and a launch that
+        # fails SYNCHRONOUSLY (k8s pod creation rejected after retries)
+        # re-enters trial_exited on the same stack — with a plain Lock that
+        # cycle deadlocks the master tick thread instead of walking the
+        # infra-requeue cap / restart budget down to ERRORED.
+        self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         #: fired on every state transition (master wires GC + webhooks).
         #: MUST NOT call back into the experiment (invoked under the lock) —
@@ -123,6 +128,10 @@ class Experiment:
                     completed_length=row["steps_completed"],
                     restarts=row["restarts"],
                     run_id=row["run_id"],
+                    # Persisted so the cap survives master restarts — else a
+                    # deterministic failure misclassified as infra gets a
+                    # fresh 16 free requeues per restart.
+                    infra_requeues=row["infra_requeues"],
                     exited=row["state"] in db_mod.TERMINAL_STATES,
                 )
                 self.trials[rec.trial_id] = rec
@@ -347,7 +356,10 @@ class Experiment:
                 # falls through to the budgeted branch below.
                 rec.infra_requeues += 1
                 rec.run_id += 1
-                self.db.update_trial(trial_id, run_id=rec.run_id)
+                self.db.update_trial(
+                    trial_id, run_id=rec.run_id,
+                    infra_requeues=rec.infra_requeues,
+                )
                 logger.info(
                     "trial %d infra failure (%s): requeued (%d/%d infra), "
                     "restart budget untouched (%d/%d)",

@@ -323,6 +323,33 @@ class TestExperimentFSM:
         assert db.get_trial(rec.trial_id)["state"] == db_mod.ERRORED
         assert exp.state == db_mod.ERRORED
 
+    def test_synchronous_launch_failure_walks_to_errored(self):
+        """A launcher failing INSIDE launch() (k8s pod creation rejected
+        after retries) re-enters trial_exited on the same stack; the
+        experiment lock must be re-entrant so the cycle walks the infra cap
+        and restart budget down to ERRORED instead of deadlocking the
+        master tick thread."""
+        from determined_tpu.master.experiment import INFRA_REQUEUE_CAP
+
+        config = {"searcher": {"name": "single", "max_length": 10},
+                  "hyperparameters": SPACE, "max_restarts": 1}
+        db = db_mod.Database()
+        eid = db.add_experiment(config)
+
+        class FailingLauncher(FakeLauncher):
+            def launch(self, experiment, rec):
+                self.launched.append((experiment, rec))
+                experiment.trial_exited(
+                    rec.trial_id, 1, "pod creation failed", infra=True
+                )
+
+        launcher = FailingLauncher()
+        exp = Experiment(eid, config, db, launcher)
+        exp.start()  # must RETURN (no deadlock, no RecursionError)
+        assert exp.state == db_mod.ERRORED
+        # initial + capped free requeues + 1 budgeted restart
+        assert len(launcher.launched) == 1 + INFRA_REQUEUE_CAP + 1
+
     def test_infra_failures_requeue_without_budget_then_cap(self):
         """Infra exits (node lost, pod evicted) requeue free of charge —
         but only INFRA_REQUEUE_CAP times, so a deterministic failure
