@@ -161,6 +161,43 @@ def trial_list(args: argparse.Namespace) -> None:
 
 def trial_logs(args: argparse.Namespace) -> None:
     session = _session(args)
+    filtered = (
+        getattr(args, "search", None) or getattr(args, "level", None)
+        or getattr(args, "since", None) or getattr(args, "until", None)
+        or getattr(args, "rank", None) is not None
+    )
+    if filtered and not args.follow:
+        # One-shot filtered query through /task_logs/search (ES-backed on
+        # fleets with a log sink, SQLite otherwise).
+        params = {"task_id": f"trial-{args.trial_id}"}
+        for key in ("search", "level", "since", "until", "rank"):
+            val = getattr(args, key, None)
+            if val is not None and val != "":
+                params[key] = val
+        for line in session.get(
+            "/api/v1/task_logs/search", params=params
+        )["logs"]:
+            print(line["log"])
+        return
+
+    def keep(line: dict) -> bool:
+        # --follow with filters: tail the cursor endpoint and filter
+        # client-side (the search endpoint has no after-id cursor).
+        if getattr(args, "search", None) and args.search not in line["log"]:
+            return False
+        if getattr(args, "level", None) and line.get("level") != args.level:
+            return False
+        if getattr(args, "rank", None) is not None and (
+            line.get("rank") != args.rank
+        ):
+            return False
+        ts = line.get("ts") or 0
+        if getattr(args, "since", None) and ts < args.since:
+            return False
+        if getattr(args, "until", None) and ts >= args.until:
+            return False
+        return True
+
     after = 0
     while True:
         logs = session.get(
@@ -168,7 +205,8 @@ def trial_logs(args: argparse.Namespace) -> None:
             params={"task_id": f"trial-{args.trial_id}", "after": after},
         )["logs"]
         for line in logs:
-            print(line["log"])
+            if not filtered or keep(line):
+                print(line["log"])
             after = line["id"]
         if not args.follow:
             if not logs:
@@ -351,6 +389,42 @@ def model_versions(args: argparse.Namespace) -> None:
     _table(versions, ["version", "checkpoint_uuid"])
 
 
+# -- config templates (ref: cli template set/describe/list) -------------------
+def template_set(args: argparse.Namespace) -> None:
+    with open(args.config_file) as f:
+        cfg = json.load(f)
+    _session(args).post(
+        "/api/v1/templates", json_body={"name": args.name, "config": cfg}
+    )
+    print(f"Set template {args.name}")
+
+
+def template_list(args: argparse.Namespace) -> None:
+    tpls = _session(args).get("/api/v1/templates")["templates"]
+    _table(tpls, ["name"])
+
+
+def template_show(args: argparse.Namespace) -> None:
+    print(json.dumps(
+        _session(args).get(f"/api/v1/templates/{args.name}")["config"],
+        indent=2,
+    ))
+
+
+def template_delete(args: argparse.Namespace) -> None:
+    _session(args).delete(f"/api/v1/templates/{args.name}")
+    print(f"Deleted template {args.name}")
+
+
+# -- audit log (ref: master audit trail) ---------------------------------------
+def master_audit(args: argparse.Namespace) -> None:
+    rows = _session(args).get(
+        "/api/v1/audit",
+        params={"username": args.username} if args.username else None,
+    )["audit"]
+    _table(rows, ["ts", "username", "method", "path", "status", "remote"])
+
+
 # -- cluster ------------------------------------------------------------------
 def agent_list(args: argparse.Namespace) -> None:
     agents = _session(args).get("/api/v1/agents")["agents"]
@@ -459,6 +533,13 @@ def build_parser() -> argparse.ArgumentParser:
     v = trial.add_parser("logs")
     v.add_argument("trial_id", type=int)
     v.add_argument("--follow", "-f", action="store_true")
+    v.add_argument("--search", default=None, help="substring filter")
+    v.add_argument("--level", default=None, help="log level filter")
+    v.add_argument("--since", type=float, default=None,
+                   help="unix timestamp lower bound")
+    v.add_argument("--until", type=float, default=None,
+                   help="unix timestamp upper bound")
+    v.add_argument("--rank", type=int, default=None, help="gang rank filter")
     v.set_defaults(fn=trial_logs)
     v = trial.add_parser("metrics")
     v.add_argument("trial_id", type=int)
@@ -544,6 +625,23 @@ def build_parser() -> argparse.ArgumentParser:
 
     master = sub.add_parser("master").add_subparsers(dest="verb", required=True)
     master.add_parser("info").set_defaults(fn=master_info)
+    v = master.add_parser("audit")
+    v.add_argument("--username", default=None)
+    v.set_defaults(fn=master_audit)
+
+    tpl = sub.add_parser("template").add_subparsers(dest="verb", required=True)
+    v = tpl.add_parser("set")
+    v.add_argument("name")
+    v.add_argument("config_file", help="JSON config fragment")
+    v.set_defaults(fn=template_set)
+    tpl.add_parser("list").set_defaults(fn=template_list)
+    v = tpl.add_parser("show")
+    v.add_argument("name")
+    v.set_defaults(fn=template_show)
+    v = tpl.add_parser("delete")
+    v.add_argument("name")
+    v.set_defaults(fn=template_delete)
+
     v = master.add_parser("up")
     v.add_argument("rest", nargs=argparse.REMAINDER)
     v.set_defaults(fn=master_up)

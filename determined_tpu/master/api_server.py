@@ -69,6 +69,7 @@ ADMIN_ROUTES = re.compile(
     r"^/api/v1/(users|groups)(/.*)?$"
     r"|^/api/v1/queues/move$"
     r"|^/api/v1/webhooks(/\d+)?$"
+    r"|^/api/v1/audit$"            # who-did-what is reconnaissance too
     # Agent control plane: GET /actions destructively drains the agent's
     # action queue (and refreshes its liveness), POST /events forges task
     # exits. Agents authenticate with agent: tokens (class allowlist);
@@ -339,11 +340,86 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         return {"data": data}
 
     # -- task logs -------------------------------------------------------------
+    # -- config templates (ref: internal/template/, api_templates.go) ---------
+    def set_template(r: ApiRequest):
+        name = r.body.get("name", "")
+        if not re.fullmatch(r"[\w.\-]+", name or ""):
+            # Must stay addressable by the GET/DELETE routes — a name the
+            # route pattern can't match would be creatable but undeletable.
+            raise ApiError(
+                400, "template name must match [A-Za-z0-9_.-]+"
+            )
+        cfg = r.body.get("config")
+        if not isinstance(cfg, dict):
+            raise ApiError(400, "template config must be an object")
+        m.db.set_template(name, cfg)
+        return {"name": name}
+
+    def list_templates(r: ApiRequest):
+        return {"templates": m.db.list_templates()}
+
+    def get_template(r: ApiRequest):
+        tpl = m.db.get_template(r.groups[0])
+        if tpl is None:
+            raise ApiError(404, f"no such template {r.groups[0]}")
+        return tpl
+
+    def delete_template(r: ApiRequest):
+        m.db.delete_template(r.groups[0])
+        return {}
+
+    # -- audit log (ref: internal/audit.go) -----------------------------------
+    def list_audit(r: ApiRequest):
+        return {
+            "audit": m.db.list_audit(
+                limit=int(r.q("limit", "1000") or 1000),
+                username=r.q("username", "") or None,
+            )
+        }
+
     def post_task_logs(r: ApiRequest):
         m.db.add_task_logs(r.body["task_id"], r.body.get("logs", []))
         if m.log_sink is not None:
             m.log_sink.ship(r.body["task_id"], r.body.get("logs", []))
         return {}
+
+    def search_task_logs(r: ApiRequest):
+        """Filtered log search (ref elastic_trial_logs.go query surface):
+        substring/level/time-range/rank. Served from Elasticsearch when the
+        sink is configured (the fleet-scale read path), SQLite otherwise —
+        same filters, same result shape either way."""
+        task_id = r.q("task_id", "")
+        kw = dict(
+            substring=r.q("search", "") or None,
+            level=r.q("level", "") or None,
+            since=float(r.q("since", "0") or 0) or None,
+            until=float(r.q("until", "0") or 0) or None,
+            rank=int(r.q("rank")) if r.q("rank") not in (None, "") else None,
+            limit=int(r.q("limit", "1000") or 1000),
+        )
+        backend = "sqlite"
+        want = r.q("backend", "")  # operators may force the SQLite system
+        if m.log_sink is not None and want != "sqlite":
+            try:
+                # Bound the ship lag: drain what's queued before querying.
+                m.log_sink.flush(timeout=2.0)
+                logs = m.log_sink.search(
+                    task_id,
+                    substring=kw["substring"] or "",
+                    level=kw["level"] or "",
+                    since=kw["since"] or 0.0,
+                    until=kw["until"] or 0.0,
+                    rank=kw["rank"],
+                    limit=kw["limit"],
+                )
+                backend = "elastic"
+            except Exception:  # noqa: BLE001 — ES down: the system of
+                # record still has every line (the sink is additive).
+                logger.exception("ES log search failed; serving SQLite")
+                logs = m.db.search_task_logs(task_id, **kw)
+        else:
+            logs = m.db.search_task_logs(task_id, **kw)
+        return {"logs": logs, "backend": backend}
 
     def get_task_logs(r: ApiRequest):
         return {
@@ -681,6 +757,12 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("POST", r"/api/v1/allocations/([\w.\-]+)/allgather", allgather),
         R("POST", r"/api/v1/task_logs", post_task_logs),
         R("GET", r"/api/v1/task_logs", get_task_logs),
+        R("GET", r"/api/v1/task_logs/search", search_task_logs),
+        R("POST", r"/api/v1/templates", set_template),
+        R("GET", r"/api/v1/templates", list_templates),
+        R("GET", r"/api/v1/templates/([\w.\-]+)", get_template),
+        R("DELETE", r"/api/v1/templates/([\w.\-]+)", delete_template),
+        R("GET", r"/api/v1/audit", list_audit),
         R("POST", r"/api/v1/agents", register_agent),
         R("GET", r"/api/v1/agents/([\w.\-]+)/actions", agent_actions),
         R("POST", r"/api/v1/agents/([\w.\-]+)/events", agent_events),
@@ -878,6 +960,7 @@ class ApiServer:
                             f"http {method} {pat.pattern}",
                             {"http.method": method, "http.target": parsed.path},
                         )
+                        status_code = 200
                         try:
                             result = handler(
                                 ApiRequest(
@@ -903,22 +986,48 @@ class ApiServer:
                         except (BrokenPipeError, ConnectionResetError):
                             # Long-poll client went away (e.g. task exited
                             # mid-response); nothing to answer.
-                            pass
+                            status_code = 0
                         except ApiError as e:
+                            status_code = e.status
                             span.set_attribute("http.status_code", e.status)
                             if e.status >= 500:
                                 span.status = "ERROR"
                             self._send(e.status, {"error": str(e)})
                         except KeyError as e:
+                            status_code = 404
                             span.set_attribute("http.status_code", 404)
                             self._send(404, {"error": f"not found: {e}"})
                         except Exception as e:  # noqa: BLE001
+                            status_code = 500
                             span.status = "ERROR"
                             span.set_attribute("http.status_code", 500)
                             logger.exception("handler error %s %s", method, parsed.path)
                             self._send(500, {"error": str(e)})
                         finally:
                             master.tracer.end_span(span)
+                            # Append-only audit of every mutating API call
+                            # (ref internal/audit.go): who, what, outcome.
+                            # Machine traffic is churn, not user action —
+                            # excluded by principal class AND by surface
+                            # (on auth-disabled clusters every harness POST
+                            # would otherwise flood the trail as
+                            # "anonymous").
+                            if (
+                                method in ("POST", "PATCH", "DELETE")
+                                and not (principal or "").startswith(
+                                    ("task:", "agent:")
+                                )
+                                and not TASK_TOKEN_ROUTES.match(parsed.path)
+                                and not AGENT_TOKEN_ROUTES.match(parsed.path)
+                            ):
+                                try:
+                                    master.db.add_audit(
+                                        principal or "anonymous", method,
+                                        parsed.path, status_code,
+                                        self.client_address[0],
+                                    )
+                                except Exception:  # noqa: BLE001
+                                    logger.exception("audit write failed")
                         return
                 self._send(404, {"error": f"no route {method} {parsed.path}"})
 

@@ -561,6 +561,18 @@ class Master:
     def create_experiment(self, config: Dict[str, Any]) -> int:
         from determined_tpu.master import expconf
 
+        # Template resolution first (ref master/internal/template/,
+        # api_templates.go): `template: name` pulls the named config
+        # fragment under the submitted config — submitted keys win, then
+        # the normal cluster/builtin defaulting applies below. The name is
+        # kept in the stored config for provenance.
+        tpl_name = config.get("template")
+        if tpl_name:
+            tpl = self.db.get_template(str(tpl_name))
+            if tpl is None:
+                raise ValueError(f"no such template: {tpl_name}")
+            config = dict(expconf.merge(config, tpl["config"]))
+            config["template"] = tpl_name
         # Shim old versions forward, merge cluster + builtin defaults under
         # the submitted config, validate; the MERGED config is what's stored
         # (and echoed by get_experiment) — what you read is what runs.

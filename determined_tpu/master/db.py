@@ -70,6 +70,7 @@ CREATE TABLE IF NOT EXISTS task_logs (
     task_id TEXT NOT NULL,
     ts REAL,
     level TEXT DEFAULT 'INFO',
+    rank INTEGER,                  -- process rank within the gang (nullable)
     log TEXT NOT NULL
 );
 CREATE INDEX IF NOT EXISTS task_logs_task ON task_logs(task_id, id);
@@ -122,6 +123,20 @@ CREATE TABLE IF NOT EXISTS kv (
     key TEXT PRIMARY KEY,
     value TEXT NOT NULL               -- JSON
 );
+CREATE TABLE IF NOT EXISTS templates (
+    name TEXT PRIMARY KEY,
+    config TEXT NOT NULL,             -- JSON experiment-config fragment
+    created_at REAL, updated_at REAL
+);
+CREATE TABLE IF NOT EXISTS audit_log (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    ts REAL NOT NULL,
+    username TEXT NOT NULL,
+    method TEXT NOT NULL,
+    path TEXT NOT NULL,
+    status INTEGER,
+    remote TEXT
+);
 INSERT OR IGNORE INTO workspaces (id, name, created_at) VALUES (1, 'Uncategorized', 0);
 INSERT OR IGNORE INTO projects (id, name, workspace_id, created_at) VALUES (1, 'Uncategorized', 1, 0);
 """
@@ -131,6 +146,7 @@ INSERT OR IGNORE INTO projects (id, name, workspace_id, created_at) VALUES (1, '
 # analog of the reference's migration pairs for pre-existing DB files.
 MIGRATIONS = (
     "ALTER TABLE trials ADD COLUMN infra_requeues INTEGER DEFAULT 0",
+    "ALTER TABLE task_logs ADD COLUMN rank INTEGER",  # log-search filter
 )
 
 
@@ -397,6 +413,56 @@ class Database:
         return d
 
     # -- generic kv (small master-owned state: RBAC assignments, etc.) -------
+    # -- config templates (ref: master/internal/template/) --------------------
+    def set_template(self, name: str, config: Dict[str, Any]) -> None:
+        now = time.time()
+        self._execute(
+            "INSERT INTO templates (name, config, created_at, updated_at)"
+            " VALUES (?,?,?,?) ON CONFLICT(name) DO UPDATE SET config=?,"
+            " updated_at=?",
+            (name, json.dumps(config), now, now, json.dumps(config), now),
+        )
+
+    def get_template(self, name: str) -> Optional[Dict[str, Any]]:
+        rows = self._query("SELECT * FROM templates WHERE name=?", (name,))
+        if not rows:
+            return None
+        d = dict(rows[0])
+        d["config"] = json.loads(d["config"])
+        return d
+
+    def list_templates(self) -> List[Dict[str, Any]]:
+        return [
+            {"name": r["name"], "config": json.loads(r["config"])}
+            for r in self._query("SELECT * FROM templates ORDER BY name")
+        ]
+
+    def delete_template(self, name: str) -> None:
+        self._execute("DELETE FROM templates WHERE name=?", (name,))
+
+    # -- audit log (ref: master/internal/audit.go) ----------------------------
+    def add_audit(
+        self, username: str, method: str, path: str, status: int,
+        remote: str = "",
+    ) -> None:
+        self._ingest(
+            "INSERT INTO audit_log (ts, username, method, path, status,"
+            " remote) VALUES (?,?,?,?,?,?)",
+            [(time.time(), username, method, path, status, remote)],
+        )
+
+    def list_audit(
+        self, limit: int = 1000, username: Optional[str] = None,
+    ) -> List[Dict[str, Any]]:
+        self._read_barrier()
+        sql = "SELECT * FROM audit_log"
+        args: tuple = ()
+        if username:
+            sql += " WHERE username=?"
+            args = (username,)
+        sql += " ORDER BY id DESC LIMIT ?"
+        return [dict(r) for r in self._query(sql, args + (limit,))]
+
     def set_kv(self, key: str, value: Any) -> None:
         self._execute(
             "INSERT INTO kv (key, value) VALUES (?, ?) "
@@ -571,12 +637,57 @@ class Database:
     def add_task_logs(self, task_id: str, lines: List[Dict[str, Any]]) -> None:
         now = time.time()
         self._ingest(
-            "INSERT INTO task_logs (task_id, ts, level, log) VALUES (?,?,?,?)",
+            "INSERT INTO task_logs (task_id, ts, level, rank, log)"
+            " VALUES (?,?,?,?,?)",
             [
-                (task_id, line.get("ts", now), line.get("level", "INFO"), line["log"])
+                (
+                    task_id, line.get("ts", now), line.get("level", "INFO"),
+                    line.get("rank"), line["log"],
+                )
                 for line in lines
             ],
         )
+
+    def search_task_logs(
+        self,
+        task_id: str,
+        *,
+        substring: Optional[str] = None,
+        level: Optional[str] = None,
+        since: Optional[float] = None,
+        until: Optional[float] = None,
+        rank: Optional[int] = None,
+        limit: int = 1000,
+    ) -> List[Dict[str, Any]]:
+        """Filtered log query (the reference's elastic_trial_logs.go filter
+        surface: search text, level, time range, rank). The SQLite arm of
+        the dual-backend read path — the master serves the same filters
+        from Elasticsearch when the sink is configured."""
+        self._read_barrier()
+        sql = "SELECT * FROM task_logs WHERE task_id=?"
+        args: List[Any] = [task_id]
+        if substring:
+            # instr(), not LIKE: byte-exact case-SENSITIVE literal substring
+            # with no metacharacters — the semantics the ES arm's escaped
+            # keyword wildcard produces, so both backends return the same
+            # lines for the same query.
+            sql += " AND instr(log, ?) > 0"
+            args.append(substring)
+        if level:
+            sql += " AND level=?"
+            args.append(level)
+        if since is not None:
+            sql += " AND ts>=?"
+            args.append(since)
+        if until is not None:
+            sql += " AND ts<?"
+            args.append(until)
+        if rank is not None:
+            sql += " AND rank=?"
+            args.append(rank)
+        sql += " ORDER BY id LIMIT ?"
+        args.append(limit)
+        return [dict(r) for r in self._query(sql, tuple(args))]
 
     def get_task_logs(self, task_id: str, after_id: int = 0, limit: int = 1000) -> List[Dict[str, Any]]:
         self._read_barrier()

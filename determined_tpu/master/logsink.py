@@ -50,12 +50,93 @@ class ElasticLogSink:
                 "task_id": task_id,
                 "timestamp": line.get("ts", now),
                 "level": line.get("level", "INFO"),
+                "rank": line.get("rank"),
                 "log": line.get("log", ""),
             }
             try:
                 self._q.put_nowait(doc)
             except queue.Full:
                 self._dropped += 1
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Wait for the queue to drain (tests / read-after-ship paths)."""
+        deadline = time.monotonic() + timeout
+        while not self._q.empty():
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(0.02)
+        # One more beat: the drained batch may still be mid-POST.
+        time.sleep(0.05)
+        return True
+
+    def search(
+        self,
+        task_id: str,
+        *,
+        substring: str = "",
+        level: str = "",
+        since: float = 0.0,
+        until: float = 0.0,
+        rank: Any = None,
+        limit: int = 1000,
+        timeout: float = 30.0,
+    ) -> List[Dict[str, Any]]:
+        """Filtered log query served FROM Elasticsearch — the read path the
+        reference implements in `elastic_trial_logs.go` (until r3 this sink
+        was write-only and SQLite stayed the fleet-scale bottleneck).
+        Returns rows in the same shape as db.search_task_logs."""
+        import urllib.request
+
+        filters: List[Dict[str, Any]] = [{"term": {"task_id": task_id}}]
+        if level:
+            filters.append({"term": {"level": level}})
+        if rank is not None:
+            filters.append({"term": {"rank": int(rank)}})
+        if since or until:
+            rng: Dict[str, Any] = {}
+            if since:
+                rng["gte"] = since
+            if until:
+                rng["lt"] = until
+            filters.append({"range": {"timestamp": rng}})
+        bool_q: Dict[str, Any] = {"filter": filters}
+        if substring:
+            # wildcard on the keyword subfield: byte-for-byte case-sensitive
+            # substring semantics matching SQLite's instr() arm (an analyzed
+            # match query would tokenize and diverge between backends). The
+            # user's text is escaped so *?\\ match literally — searches must
+            # not be pattern-injectable.
+            esc = (
+                substring.replace("\\", "\\\\")
+                .replace("*", "\\*").replace("?", "\\?")
+            )
+            bool_q["must"] = [
+                {"wildcard": {"log.keyword": {"value": f"*{esc}*"}}}
+            ]
+        body = json.dumps({
+            "query": {"bool": bool_q},
+            "sort": [{"timestamp": "asc"}],
+            "size": limit,
+        }).encode()
+        req = urllib.request.Request(
+            f"{self.base_url}/{self.index}/_search",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        resp = json.loads(
+            urllib.request.urlopen(req, timeout=timeout).read()
+        )
+        out = []
+        for hit in resp.get("hits", {}).get("hits", []):
+            src = hit.get("_source", {})
+            out.append({
+                "task_id": src.get("task_id", task_id),
+                "ts": src.get("timestamp"),
+                "level": src.get("level", "INFO"),
+                "rank": src.get("rank"),
+                "log": src.get("log", ""),
+            })
+        return out
 
     def _drain(self, block: bool) -> List[Dict[str, Any]]:
         docs: List[Dict[str, Any]] = []
@@ -85,7 +166,46 @@ class ElasticLogSink:
         )
         urllib.request.urlopen(req, timeout=timeout).read()
 
+    def _put_mapping(self) -> None:
+        """Create the index with an explicit mapping: dynamic mapping's
+        keyword subfield has ignore_above=256, which would silently make
+        long lines (stack traces) unsearchable on the ES backend while the
+        SQLite backend finds them. Best-effort; 400 means it exists."""
+        import urllib.error
+        import urllib.request
+
+        body = json.dumps({
+            "mappings": {
+                "properties": {
+                    "task_id": {"type": "keyword"},
+                    "level": {"type": "keyword"},
+                    "rank": {"type": "integer"},
+                    "timestamp": {"type": "double"},
+                    "log": {
+                        "type": "text",
+                        "fields": {
+                            "keyword": {
+                                "type": "keyword", "ignore_above": 32766,
+                            }
+                        },
+                    },
+                }
+            }
+        }).encode()
+        req = urllib.request.Request(
+            f"{self.base_url}/{self.index}", data=body, method="PUT",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10).read()
+        except urllib.error.HTTPError as e:
+            if e.code != 400:  # 400 = resource_already_exists
+                logger.warning("log-sink index mapping PUT failed: %s", e)
+        except Exception as e:  # noqa: BLE001 — sink may simply be down
+            logger.warning("log-sink index mapping PUT failed: %s", e)
+
     def _run(self) -> None:
+        self._put_mapping()
         while not self._stop.is_set():
             docs = self._drain(block=True)
             if not docs:

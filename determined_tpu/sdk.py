@@ -13,7 +13,7 @@ object wrappers under `common/experimental/`).
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
 from determined_tpu.common.api_session import Session
 
@@ -63,6 +63,52 @@ class Trial:
             "/api/v1/task_logs", params={"task_id": f"trial-{self.id}"}
         )["logs"]
         return [line["log"] for line in out]
+
+    def search_logs(self, **filters: Any) -> List[Dict[str, Any]]:
+        """Filtered log query (search=substring, level=, since=, until=,
+        rank=) — served from Elasticsearch on sink-backed clusters, SQLite
+        otherwise (same lines either way)."""
+        params = {"task_id": f"trial-{self.id}"}
+        params.update({k: v for k, v in filters.items() if v is not None})
+        return self._session.get(
+            "/api/v1/task_logs/search", params=params
+        )["logs"]
+
+    def stream_metrics(
+        self,
+        group: str = "training",
+        poll_interval: float = 1.0,
+    ) -> Iterator[Dict[str, Any]]:
+        """FOLLOW training metrics as they land (the reference SDK's
+        `stream_trials_training_metrics`, client.py:435): yields each
+        metric row exactly once, in report order, and returns once the
+        trial is terminal and the stream is drained."""
+        import time as _time
+
+        after = 0
+
+        def fetch():
+            nonlocal after
+            rows = self._session.get(
+                f"/api/v1/trials/{self.id}/metrics",
+                params={"group": group, "after": after},
+            )["metrics"]
+            if rows:
+                after = max(after, rows[-1]["id"])
+            return rows
+
+        while True:
+            rows = fetch()
+            yield from rows
+            if rows:
+                continue  # drain at full speed while rows are flowing
+            if self.state in ("COMPLETED", "CANCELED", "ERRORED"):
+                # One final fetch AFTER observing the terminal state: rows
+                # reported between the empty poll and the state read must
+                # not be dropped.
+                yield from fetch()
+                return
+            _time.sleep(poll_interval)
 
 
 class Experiment:
