@@ -169,15 +169,20 @@ def trial_logs(args: argparse.Namespace) -> None:
     if filtered and not args.follow:
         # One-shot filtered query through /task_logs/search (ES-backed on
         # fleets with a log sink, SQLite otherwise).
-        params = {"task_id": f"trial-{args.trial_id}"}
+        limit = getattr(args, "limit", None) or 1000
+        params = {"task_id": f"trial-{args.trial_id}", "limit": limit}
         for key in ("search", "level", "since", "until", "rank"):
             val = getattr(args, key, None)
             if val is not None and val != "":
                 params[key] = val
-        for line in session.get(
-            "/api/v1/task_logs/search", params=params
-        )["logs"]:
+        logs = session.get("/api/v1/task_logs/search", params=params)["logs"]
+        for line in logs:
             print(line["log"])
+        if len(logs) >= limit:
+            print(
+                f"(truncated at {limit} lines — raise --limit or narrow "
+                "the filters)", file=sys.stderr,
+            )
         return
 
     def keep(line: dict) -> bool:
@@ -540,6 +545,8 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("--until", type=float, default=None,
                    help="unix timestamp upper bound")
     v.add_argument("--rank", type=int, default=None, help="gang rank filter")
+    v.add_argument("--limit", type=int, default=None,
+                   help="max lines for filtered queries (default 1000)")
     v.set_defaults(fn=trial_logs)
     v = trial.add_parser("metrics")
     v.add_argument("trial_id", type=int)

@@ -902,13 +902,35 @@ class ApiServer:
                         return
                     self._proxy(method, parsed)
                     return
+                def audit_denied(who: str, status: int) -> None:
+                    # Denied mutations are what an audit trail exists for
+                    # (probing, stolen tokens, privilege testing) — record
+                    # them like the in-handler audit does, same machine-
+                    # surface exclusions.
+                    if (
+                        method in ("POST", "PATCH", "DELETE")
+                        and not TASK_TOKEN_ROUTES.match(parsed.path)
+                        and not AGENT_TOKEN_ROUTES.match(parsed.path)
+                    ):
+                        try:
+                            master.db.add_audit(
+                                who, method, parsed.path, status,
+                                self.client_address[0],
+                            )
+                        except Exception:  # noqa: BLE001
+                            logger.exception("audit write failed")
+
                 principal: Optional[str] = None
                 if master.auth.enabled and parsed.path not in self.AUTH_EXEMPT:
                     principal = master.auth.validate(token)
                     if principal is None:
+                        audit_denied(
+                            "invalid-token" if token else "anonymous", 401
+                        )
                         self._send(401, {"error": "authentication required"})
                         return
                     if not principal_allowed(principal, parsed.path):
+                        audit_denied(principal, 403)
                         self._send(403, {
                             "error": f"{principal} may not access {parsed.path}"
                         })
@@ -916,6 +938,7 @@ class ApiServer:
                     if not principal.startswith(("task:", "agent:")):
                         role = master.auth.effective_role(principal)
                         if not user_allowed(role, method, parsed.path):
+                            audit_denied(principal, 403)
                             self._send(403, {
                                 "error": f"role {role} may not {method} "
                                          f"{parsed.path}"

@@ -34,6 +34,16 @@ class ElasticLogSink:
         self._q: "queue.Queue[Dict[str, Any]]" = queue.Queue(maxsize=max_queue)
         self._flush_batch = flush_batch
         self._dropped = 0
+        self._dropped_lock = threading.Lock()
+        # Monotonic ingest sequence stamped on every doc: gives the ES
+        # backend a stable sort tiebreaker AND an `id`-shaped field, so
+        # search results match the SQLite arm's insertion order and row
+        # shape even when timestamps collide (gang ranks batch-stamped).
+        self._seq = 0
+        # Docs accepted by ship() but not yet POSTed (or dropped): the
+        # flush() barrier waits on this, not on queue emptiness — a drained
+        # batch can be mid-_bulk when the queue reads empty.
+        self._inflight = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="dtpu-log-sink", daemon=True
@@ -46,28 +56,44 @@ class ElasticLogSink:
         everything."""
         now = time.time()
         for line in lines:
+            with self._dropped_lock:
+                self._seq += 1
+                seq = self._seq
             doc = {
                 "task_id": task_id,
                 "timestamp": line.get("ts", now),
                 "level": line.get("level", "INFO"),
                 "rank": line.get("rank"),
+                "seq": seq,
                 "log": line.get("log", ""),
             }
             try:
+                with self._dropped_lock:
+                    self._inflight += 1
                 self._q.put_nowait(doc)
             except queue.Full:
-                self._dropped += 1
+                with self._dropped_lock:
+                    self._dropped += 1
+                    self._inflight -= 1
+
+    def _settle(self, n: int) -> None:
+        with self._dropped_lock:
+            self._inflight -= n
 
     def flush(self, timeout: float = 10.0) -> bool:
-        """Wait for the queue to drain (tests / read-after-ship paths)."""
+        """Wait until everything shipped before this call is POSTed or
+        dropped (tests / read-after-ship search paths). Counts in-flight
+        docs rather than polling queue emptiness — a drained batch can be
+        mid-_bulk when the queue already reads empty."""
         deadline = time.monotonic() + timeout
-        while not self._q.empty():
+        while True:
+            with self._dropped_lock:
+                settled = self._inflight == 0
+            if settled:
+                return True
             if time.monotonic() > deadline:
                 return False
             time.sleep(0.02)
-        # One more beat: the drained batch may still be mid-POST.
-        time.sleep(0.05)
-        return True
 
     def search(
         self,
@@ -115,7 +141,10 @@ class ElasticLogSink:
             ]
         body = json.dumps({
             "query": {"bool": bool_q},
-            "sort": [{"timestamp": "asc"}],
+            # seq tiebreak: gang ranks batch-stamp identical timestamps;
+            # ingest order must be stable and match the SQLite arm's
+            # ORDER BY id.
+            "sort": [{"timestamp": "asc"}, {"seq": "asc"}],
             "size": limit,
         }).encode()
         req = urllib.request.Request(
@@ -130,6 +159,11 @@ class ElasticLogSink:
         for hit in resp.get("hits", {}).get("hits", []):
             src = hit.get("_source", {})
             out.append({
+                # "id": the ingest sequence — same shape as the SQLite rows
+                # so consumers indexing line["id"] work on both backends
+                # (values differ from SQLite rowids but are monotonic in
+                # the same ingest order).
+                "id": src.get("seq"),
                 "task_id": src.get("task_id", task_id),
                 "ts": src.get("timestamp"),
                 "level": src.get("level", "INFO"),
@@ -180,6 +214,7 @@ class ElasticLogSink:
                     "task_id": {"type": "keyword"},
                     "level": {"type": "keyword"},
                     "rank": {"type": "integer"},
+                    "seq": {"type": "long"},
                     "timestamp": {"type": "double"},
                     "log": {
                         "type": "text",
@@ -213,11 +248,14 @@ class ElasticLogSink:
             try:
                 self._post_bulk(docs)
             except Exception:  # noqa: BLE001 — sink loss must not cascade
-                self._dropped += len(docs)
+                with self._dropped_lock:
+                    self._dropped += len(docs)
                 logger.warning(
                     "log sink %s unreachable; dropped %d lines "
                     "(SQLite copy retained)", self.base_url, len(docs),
                 )
+            finally:
+                self._settle(len(docs))
 
     def stop(self, drain_budget_s: float = 10.0) -> None:
         self._stop.set()
@@ -235,5 +273,7 @@ class ElasticLogSink:
                 self._post_bulk(docs, timeout=remaining)
             except Exception:  # noqa: BLE001
                 break
+            finally:
+                self._settle(len(docs))
             docs = self._drain(block=False)
         self._thread.join(timeout=5)

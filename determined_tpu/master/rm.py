@@ -195,8 +195,19 @@ class ResourcePool:
     # -- introspection --------------------------------------------------------
     def queue_snapshot(self) -> Dict[str, Any]:
         with self._lock:
+            # Pending in EFFECTIVE dispatch order — (priority, order), the
+            # key the FIFO/priority schedulers serve — not insertion order:
+            # the queue page's move-to-front must be visible in the list it
+            # reordered, or the UI looks broken even though scheduling
+            # changed (fair-share is share-driven and has no static order).
+            def key(a: str):
+                e = self._entries.get(a)
+                if e is None:
+                    return (1 << 30, 1 << 30)
+                return (e.request.priority, e.request.order)
+
             return {
-                "pending": list(self._pending),
+                "pending": sorted(self._pending, key=key),
                 "running": list(self._running),
                 "pending_slots": sum(
                     self._entries[a].request.slots

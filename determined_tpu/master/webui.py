@@ -4,9 +4,13 @@ The reference ships a 112k-LoC React SPA (`webui/react`); this is the
 platform's minimal equivalent — one self-contained HTML page (no build
 step, no external assets; it must work from an air-gapped TPU pod) that
 polls the same REST API the CLI/SDK use and renders experiments, trials,
-agents/queues, live trial logs, per-trial metric line charts, and an
-HP-search view (rung scatter + parallel coordinates — the capability of
-the reference's ExperimentDetails charts and HP visualizations,
+agents, the job queue (with clickable move-to-front reordering, the
+JobQueue page's capability), live trial logs, per-trial metric line
+charts, a Profiler tab (charts over the harness's "profiling" metric
+group — host CPU/mem, device HBM — like the reference's Profiler tab),
+workspaces/projects, the model registry, and an HP-search view (rung
+scatter + parallel coordinates — the capability of the reference's
+ExperimentDetails charts and HP visualizations,
 webui/react/src/pages/ExperimentDetails). Charts are hand-rolled SVG so
 the no-build-step constraint holds.
 """
@@ -38,13 +42,19 @@ PAGE = """<!doctype html>
 <body>
 <h1>determined_tpu <span id="cluster"></span></h1>
 <h2>Agents</h2><table id="agents"></table>
+<h2>Job queue</h2><div id="queues">(empty)</div>
 <h2>Experiments</h2><table id="exps"></table>
 <h2>Trials <span id="exp-label"></span></h2><table id="trials"></table>
 <h2>HP search <span id="hp-label"></span></h2>
 <div id="hpviz">(click an experiment's trials)</div>
 <h2>Metrics <span id="chart-label"></span></h2>
 <div id="charts">(click a trial)</div>
+<h2>Profiler <span id="prof-label"></span></h2>
+<div id="profiler">(click a trial; charts appear once the harness ships
+the "profiling" metric group)</div>
 <h2>Logs <span id="log-label"></span></h2><pre id="logs">(click a trial)</pre>
+<h2>Workspaces</h2><table id="workspaces"></table>
+<h2>Models</h2><table id="models"></table>
 <div id="login" style="display:none">
   <h2>Login</h2>
   <input id="u" placeholder="username"> <input id="p" type="password"
@@ -69,6 +79,49 @@ async function j(path) {
   const r = await fetch(path, {headers});
   if (r.status === 401) { $('login').style.display = 'block'; throw 'auth'; }
   return r.json();
+}
+
+async function post(path, body) {
+  const headers = {'Content-Type': 'application/json'};
+  const tok = localStorage.getItem('dtpu_token');
+  if (tok) headers['Authorization'] = 'Bearer ' + tok;
+  const r = await fetch(path, {method: 'POST', headers,
+                               body: JSON.stringify(body || {})});
+  if (r.status === 401) { $('login').style.display = 'block'; throw 'auth'; }
+  if (!r.ok) alert(`${path}: ${(await r.json()).error || r.status}`);
+  return r;
+}
+
+// Queue move-ahead (the JobQueue page's drag-to-reorder, as a button).
+// Pending entries are kept in a global and addressed by index so no
+// server-provided string is ever interpolated into a JS handler.
+let pendingQueue = [];
+async function queueFront(i) {
+  const [pool, alloc] = pendingQueue[i];
+  await post('/api/v1/queues/move', {alloc_id: alloc, pool: pool});
+  refresh();
+}
+
+function renderQueues(queues) {
+  pendingQueue = [];
+  const div = $('queues');
+  div.textContent = '';
+  for (const [pool, q] of Object.entries(queues || {})) {
+    const tbl = document.createElement('table');
+    let html = `<tr><th>${esc(pool)}: ${esc(q.pending_slots)} pending ` +
+               `slot(s)</th><th></th></tr>`;
+    for (const alloc of q.running)
+      html += `<tr>${cell(alloc)}<td class="COMPLETED">running</td></tr>`;
+    q.pending.forEach((alloc, i) => {
+      const idx = pendingQueue.length;
+      pendingQueue.push([pool, alloc]);
+      html += `<tr>${cell(alloc)}<td>#${i + 1} pending ` +
+        `<button onclick="queueFront(${idx})">to front</button></td></tr>`;
+    });
+    tbl.innerHTML = html;
+    div.appendChild(tbl);
+  }
+  if (!div.childNodes.length) div.textContent = '(empty)';
 }
 
 async function doLogin() {
@@ -235,19 +288,29 @@ async function drawTrialCharts(trialId) {
     }
   }
   if (!rows.length && metState.drawn) return; // nothing new: keep the DOM
-  const div = $('charts');
-  div.textContent = '';
+  const div = $('charts'), prof = $('profiler');
+  div.textContent = ''; prof.textContent = '';
   $('chart-label').textContent = `· trial ${trialId}`;
-  for (const key of Object.keys(metState.byKey).sort().slice(0, 8)) {
-    const series = Object.entries(metState.byKey[key]).map(
+  $('prof-label').textContent = `· trial ${trialId}`;
+  // The "profiling" group (host CPU/mem, device HBM — profiler.py) gets
+  // its own tab, like the reference's Profiler view; everything else is
+  // training/validation signal.
+  const isProf = (groups) => Object.keys(groups).every(g => g === 'profiling');
+  for (const key of Object.keys(metState.byKey).sort()) {
+    const groups = metState.byKey[key];
+    const target = isProf(groups) ? prof : div;
+    if (target === div && div.childNodes.length >= 8) continue;
+    if (target === prof && prof.childNodes.length >= 8) continue;
+    const series = Object.entries(groups).map(
       ([grp, byStep]) => ({name: grp, points:
         Object.entries(byStep)
           .map(([s, e]) => [Number(s), e.v])
           .sort((a, b) => a[0] - b[0])}));
-    div.appendChild(lineChart(key, series));
+    target.appendChild(lineChart(key, series));
     metState.drawn = true;
   }
   if (!div.childNodes.length) div.textContent = '(no scalar metrics yet)';
+  if (!prof.childNodes.length) prof.textContent = '(no profiler samples yet)';
 }
 
 function drawHpViz(trials) {
@@ -260,14 +323,33 @@ function drawHpViz(trials) {
 
 async function refresh() {
   try {
-    const info = await j('/api/v1/master');
+    // One round-trip's latency, not six: these polls are independent.
+    const [info, queuesR, wssR, projsR, modelsR, expsR] = await Promise.all([
+      j('/api/v1/master'), j('/api/v1/queues'), j('/api/v1/workspaces'),
+      j('/api/v1/projects'), j('/api/v1/models'), j('/api/v1/experiments'),
+    ]);
     $('cluster').textContent = `· cluster ${info.cluster_id} · v${info.version}`;
     const agents = info.agents || {};
     $('agents').innerHTML = '<tr><th>id</th><th>pool</th><th>slots</th></tr>' +
       Object.entries(agents).map(([id, a]) =>
         `<tr>${cell(id)}${cell(a.pool)}${cell(a.slots)}</tr>`).join('');
 
-    const exps = (await j('/api/v1/experiments')).experiments.slice().reverse();
+    renderQueues(queuesR.queues);
+
+    const wss = wssR.workspaces || [], projs = projsR.projects || [];
+    $('workspaces').innerHTML =
+      '<tr><th>workspace</th><th>projects</th></tr>' +
+      wss.map(ws => `<tr>${cell(ws.name)}` +
+        cell(projs.filter(p => p.workspace_id === ws.id)
+             .map(p => p.name).join(', ')) + '</tr>').join('');
+
+    const models = modelsR.models || [];
+    $('models').innerHTML =
+      '<tr><th>name</th><th>description</th></tr>' +
+      models.map(mo =>
+        `<tr>${cell(mo.name)}${cell(mo.description || '')}</tr>`).join('');
+
+    const exps = expsR.experiments.slice().reverse();
     $('exps').innerHTML =
       '<tr><th>id</th><th>state</th><th>progress</th><th>searcher</th><th></th></tr>' +
       exps.map(e => {

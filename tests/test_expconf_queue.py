@@ -217,6 +217,15 @@ class TestQueueOps:
         assert orders["a2"] < orders["a1"]
         assert orders["a0"] <= orders["a2"]
 
+    def test_snapshot_reflects_reorder(self):
+        """queue_snapshot lists pending in EFFECTIVE dispatch order — a
+        move-to-front must be visible to the queue page/CLI, not just to
+        the scheduler's internal sort."""
+        pool, _ = self._pool_with_queue()
+        assert pool.queue_snapshot()["pending"] == ["a0", "a1", "a2"]
+        pool.reorder("a2")
+        assert pool.queue_snapshot()["pending"][0] == "a2"
+
     def test_unknown_alloc_raises(self):
         pool, _ = self._pool_with_queue()
         with pytest.raises(KeyError):

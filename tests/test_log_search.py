@@ -106,8 +106,9 @@ class FakeElastic:
                         return False
             return True
 
+        assert body["sort"] == [{"timestamp": "asc"}, {"seq": "asc"}]
         hits = [d for d in docs if keep(d)]
-        hits.sort(key=lambda d: d.get("timestamp", 0))
+        hits.sort(key=lambda d: (d.get("timestamp", 0), d.get("seq", 0)))
         hits = hits[: body.get("size", 1000)]
         return {"hits": {"hits": [{"_source": d} for d in hits]}}
 
@@ -121,7 +122,8 @@ T0 = 1_700_000_000.0
 LINES = [
     {"log": "starting rendezvous", "level": "INFO", "rank": 0, "ts": T0 + 1},
     {"log": "loss=2.31 step=1", "level": "INFO", "rank": 0, "ts": T0 + 2},
-    {"log": "loss=2.31 step=1", "level": "INFO", "rank": 1, "ts": T0 + 2.5},
+    # identical ts to the rank-0 line: ingest-order (seq) tiebreak parity
+    {"log": "loss=2.31 step=1", "level": "INFO", "rank": 1, "ts": T0 + 2},
     {"log": "XLA allocation warning", "level": "WARNING", "rank": 1,
      "ts": T0 + 3},
     {"log": "loss=1.98 step=2", "level": "INFO", "rank": 0, "ts": T0 + 4},
@@ -136,7 +138,7 @@ FILTERS = [
     {"rank": 1},
     {"search": "loss=", "rank": 0},
     {"since": T0 + 2, "until": T0 + 5},
-    {"search": "step=1", "level": "INFO", "since": T0 + 2.2},
+    {"search": "step=1", "level": "INFO", "since": T0 + 2},
     # metachars in the user text match LITERALLY on both backends
     {"search": "loss=*"},
 ]
@@ -214,6 +216,8 @@ class TestLogSearchParity:
             assert es["backend"] == "elastic"
             assert [l["log"] for l in sq["logs"]] == want, flt
             assert [l["log"] for l in es["logs"]] == want, flt
+            # same row shape on both backends (consumers index line["id"])
+            assert all(l["id"] is not None for l in es["logs"])
 
     def test_substring_metacharacters_are_literal(self, sqlite_master):
         """LIKE metacharacters in the user's search string must match

@@ -1,0 +1,81 @@
+"""WebUI smoke (no browser in the image: HTTP-level checks that the page
+and every API endpoint its JS polls serve what the page consumes).
+VERDICT r2 next #9: profiler tab, workspaces/models/queue pages, clickable
+queue move-ahead."""
+import pytest
+import requests
+
+from determined_tpu.master.api_server import ApiServer
+from determined_tpu.master.core import Master
+from determined_tpu.master.scheduler import Request
+
+
+@pytest.fixture()
+def live():
+    master = Master()
+    api = ApiServer(master)
+    api.start()
+    master.external_url = api.url
+    yield master, api
+    api.stop()
+    master.shutdown()
+
+
+class TestWebUI:
+    def test_page_serves_all_sections(self, live):
+        _, api = live
+        html = requests.get(f"{api.url}/ui", timeout=10).text
+        for marker in (
+            "Job queue", "Profiler", "Workspaces", "Models",
+            "queueFront", "renderQueues", "profiling",
+        ):
+            assert marker in html, marker
+
+    def test_endpoints_the_page_polls(self, live):
+        """Every fetch the page's refresh() makes must return the shape the
+        JS destructures — a missing key is a blank section for users."""
+        master, api = live
+        eid = master.create_experiment({
+            "entrypoint": "x:y", "unmanaged": True,
+            "searcher": {"name": "single", "max_length": 5,
+                         "metric": "loss"},
+            "hyperparameters": {"lr": 0.1},
+        })
+        tid = master.db.list_trials(eid)[0]["id"]
+        master.db.add_metrics(tid, "training", 1, {"loss": 2.0})
+        master.db.add_metrics(tid, "profiling", 1, {"host_cpu_pct": 42.0})
+        master.db.add_model("m1", "desc")
+
+        def get(path):
+            r = requests.get(f"{api.url}{path}", timeout=10)
+            r.raise_for_status()
+            return r.json()
+
+        assert "cluster_id" in get("/api/v1/master")
+        assert isinstance(get("/api/v1/queues")["queues"], dict)
+        assert get("/api/v1/workspaces")["workspaces"][0]["name"]
+        assert get("/api/v1/projects")["projects"][0]["workspace_id"] == 1
+        assert get("/api/v1/models")["models"][0]["name"] == "m1"
+        rows = get(f"/api/v1/trials/{tid}/metrics?after=0")["metrics"]
+        groups = {r["grp"] for r in rows}
+        assert groups == {"training", "profiling"}  # profiler tab's feed
+
+    def test_queue_move_ahead_visible(self, live):
+        """The queue page's move-to-front button: POST /queues/move must
+        reorder the pending list the page renders."""
+        master, api = live
+        pool = master.rm.pool()
+        pool.submit(Request("big.1.0", 4), lambda *a: None, lambda *a: None)
+        pool.submit(Request("small.2.0", 2), lambda *a: None, lambda *a: None)
+        before = requests.get(
+            f"{api.url}/api/v1/queues", timeout=10
+        ).json()["queues"]["default"]["pending"]
+        assert before == ["big.1.0", "small.2.0"]
+        requests.post(
+            f"{api.url}/api/v1/queues/move",
+            json={"alloc_id": "small.2.0", "pool": "default"}, timeout=10,
+        ).raise_for_status()
+        after = requests.get(
+            f"{api.url}/api/v1/queues", timeout=10
+        ).json()["queues"]["default"]["pending"]
+        assert after == ["small.2.0", "big.1.0"]
