@@ -329,6 +329,12 @@ def shell_start(args: argparse.Namespace) -> None:
     import secrets
 
     token = secrets.token_hex(16)
+    variables = {"DTPU_SHELL_TOKEN": token}
+    if getattr(args, "eof_grace", None) is not None:
+        # Per-task override of the post-EOF PTY drain grace (exec/shell.py
+        # EOF_IDLE_GRACE_S) — config-level, no env plumbing needed on the
+        # task host.
+        variables["DTPU_SHELL_EOF_GRACE_S"] = str(args.eof_grace)
     cfg = {
         "task_type": "SHELL",
         "entrypoint": "python -m determined_tpu.exec.shell",
@@ -336,7 +342,7 @@ def shell_start(args: argparse.Namespace) -> None:
         # The shell token is this design's analog of the reference's
         # injected ssh public key: a per-task credential carried in the
         # task config (master/pkg/ssh keygen + shell_manager.go).
-        "environment": {"variables": {"DTPU_SHELL_TOKEN": token}},
+        "environment": {"variables": variables},
     }
     resp = _session(args).post("/api/v1/commands", json_body={"config": cfg})
     print(f"Started shell {resp['task_id']}")
@@ -584,6 +590,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     v = shell.add_parser("start")
     v.add_argument("--slots", type=int, default=0)
+    v.add_argument("--eof-grace", type=float, default=None,
+                   help="seconds of PTY silence after client EOF before "
+                        "the shell is reaped (default 60)")
     v.set_defaults(fn=shell_start)
     v = shell.add_parser("open")
     v.add_argument("task_id")

@@ -38,15 +38,20 @@ def connect_shell(
 
         sock = client_context().wrap_socket(sock, server_hostname=host)
     try:
-        query = f"shell_token={shell_token}"
+        query = ""
         if user_token:
             # dtpu_token, not token: the master consumes (and the proxy
             # strips) dtpu_token; `token` would be forwarded to the task
             # service, which owns that name (Jupyter).
-            query += f"&dtpu_token={user_token}"
+            query = f"?dtpu_token={user_token}"
+        # The shell token rides a HEADER, not the query string: query
+        # strings land verbatim in proxy/access logs, which would turn
+        # every log line into a credential store (same reasoning as the
+        # master's own token stripping, master/proxy.py).
         head = (
-            f"GET /proxy/{task_id}/?{query} HTTP/1.1\r\n"
+            f"GET /proxy/{task_id}/{query} HTTP/1.1\r\n"
             f"Host: {host}:{port}\r\n"
+            f"X-DTPU-Shell-Token: {shell_token}\r\n"
             "Connection: Upgrade\r\n"
             "Upgrade: websocket\r\n"
             "\r\n"

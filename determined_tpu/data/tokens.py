@@ -20,6 +20,20 @@ import numpy as np
 MAGIC_DTYPE = {2: np.uint16, 4: np.int32}
 
 
+def zigzag_batch(raw: np.ndarray, perm: np.ndarray) -> Dict[str, np.ndarray]:
+    """raw [B, S+1] contiguous rows → pre-shifted zigzag-layout batch.
+
+    Shift FIRST (targets are the next LOGICAL token), then permute both
+    sides identically into zigzag device order. The single source of the
+    contract test_zigzag_native pins — shard-backed and synthetic streams
+    must not drift apart."""
+    return {
+        "tokens": np.ascontiguousarray(raw[:, :-1][:, perm]),
+        "targets": np.ascontiguousarray(raw[:, 1:][:, perm]),
+        "positions": perm,
+    }
+
+
 def expand_shards(patterns: List[str]) -> List[str]:
     """Glob-expand shard path patterns (sorted, deduplicated)."""
     import glob as glob_mod
@@ -93,20 +107,37 @@ def lm_dataset(
     seq_len: int,
     vocab_size: int,
     seed: int = 0,
+    zigzag_ring: int = 0,
 ):
     """Shared trial-data helper: TokenDataset over glob-expanded shards when
-    configured, else an infinite synthetic stream (smoke tests/dry runs)."""
+    configured, else an infinite synthetic stream (smoke tests/dry runs).
+    zigzag_ring > 1: emit pre-shifted zigzag-layout batches (TokenDataset
+    docstring)."""
     if patterns:
-        return TokenDataset(expand_shards(patterns), batch_size, seq_len, seed=seed)
+        return TokenDataset(
+            expand_shards(patterns), batch_size, seq_len, seed=seed,
+            zigzag_ring=zigzag_ring,
+        )
     rng = np.random.default_rng(seed)
+    perm = None
+    if zigzag_ring > 1:
+        from determined_tpu.parallel.ring import zigzag_indices
+
+        perm = zigzag_indices(seq_len, zigzag_ring).astype(np.int32)
 
     def synthetic() -> Iterator[Dict[str, np.ndarray]]:
         while True:
-            yield {
-                "tokens": rng.integers(
-                    0, vocab_size, (batch_size, seq_len)
-                ).astype(np.int32)
-            }
+            if perm is None:
+                yield {
+                    "tokens": rng.integers(
+                        0, vocab_size, (batch_size, seq_len)
+                    ).astype(np.int32)
+                }
+                continue
+            raw = rng.integers(
+                0, vocab_size, (batch_size, seq_len + 1)
+            ).astype(np.int32)
+            yield zigzag_batch(raw, perm)
 
     return synthetic()
 
@@ -128,15 +159,30 @@ class TokenDataset:
         shuffle: bool = True,
         use_native: Optional[bool] = None,
         n_threads: int = 2,
+        zigzag_ring: int = 0,
     ) -> None:
+        """zigzag_ring = R > 1: emit batches NATIVELY in zigzag device order
+        for an R-way ring-attention mesh — {"tokens", "targets",
+        "positions"} pre-shifted then permuted by `zigzag_indices`, so the
+        model runs entirely in zigzag layout and the ring kernel needs no
+        per-step permute gathers (parallel/ring.py `make_ring_attention`
+        otherwise pays one each way at the jit boundary)."""
         self.batch_size, self.seq_len = batch_size, seq_len
+        self.zigzag_ring = int(zigzag_ring)
+        self._perm = None
+        # Pre-shift needs the next token past the window: read S+1 per row.
+        read_len = seq_len + 1 if self.zigzag_ring > 1 else seq_len
+        if self.zigzag_ring > 1:
+            from determined_tpu.parallel.ring import zigzag_indices
+
+            self._perm = zigzag_indices(seq_len, self.zigzag_ring).astype(np.int32)
         self._loader = None
         if use_native is not False:
             try:
                 from determined_tpu.data.native import NativeLoader
 
                 self._loader = NativeLoader(
-                    paths, token_bytes, batch_size, seq_len,
+                    paths, token_bytes, batch_size, read_len,
                     seed=seed, shuffle=shuffle, n_threads=n_threads,
                 )
                 self.native = True
@@ -145,9 +191,10 @@ class TokenDataset:
                     raise
         if self._loader is None:
             self._loader = _PythonLoader(
-                paths, token_bytes, batch_size, seq_len, seed, shuffle
+                paths, token_bytes, batch_size, read_len, seed, shuffle
             )
             self.native = False
+        self._read_len = read_len
         self.batches_consumed = 0
 
     @property
@@ -163,10 +210,12 @@ class TokenDataset:
         return self
 
     def __next__(self) -> Dict[str, np.ndarray]:
-        out = np.empty((self.batch_size, self.seq_len), np.int32)
+        out = np.empty((self.batch_size, self._read_len), np.int32)
         self._loader.next_into(out)
         self.batches_consumed += 1
-        return {"tokens": out}
+        if self._perm is None:
+            return {"tokens": out}
+        return zigzag_batch(out, self._perm)
 
     def close(self) -> None:
         self._loader.close()

@@ -14,7 +14,9 @@ identical: `dtpu shell open <task>` gets an interactive shell where the
 task runs.
 
 Protocol per connection:
-  client: GET /?shell_token=<token> HTTP/1.1 + Upgrade headers
+  client: GET / HTTP/1.1 + Upgrade headers + X-DTPU-Shell-Token header
+          (a header, NOT a query param: query strings land in proxy/access
+          logs, which must not become a credential store)
   server: HTTP/1.1 101 Switching Protocols, then raw PTY bytes both ways.
 Each connection gets a fresh shell; the server survives disconnects.
 """
@@ -28,7 +30,7 @@ import signal
 import socket
 import sys
 import threading
-from urllib.parse import parse_qs, urlparse
+
 
 logger = logging.getLogger("determined_tpu.exec.shell")
 
@@ -76,8 +78,13 @@ def _serve_connection(conn: socket.socket, token: str) -> None:
         except ValueError:
             conn.sendall(b"HTTP/1.1 400 Bad Request\r\n\r\n")
             return
-        q = parse_qs(urlparse(raw_path).query)
-        got = (q.get("shell_token") or [""])[0]
+        del raw_path  # the token rides the header, never the URL
+        got = ""
+        for line in head_text.split(b"\r\n")[1:]:
+            name, _, value = line.decode(errors="replace").partition(":")
+            if name.strip().lower() == "x-dtpu-shell-token":
+                got = value.strip()
+                break
         # compare_digest: the token is the only gate on a 0.0.0.0 port; a
         # byte-at-a-time compare would leak timing (repo convention:
         # master/auth.py does the same).

@@ -188,8 +188,14 @@ class AuthService:
                 if user in self._users and role in _ROLE_RANK:
                     self._roles[user] = role
             for name, g in state.get("groups", {}).items():
+                role = g.get("role", "viewer")
+                if role not in _ROLE_RANK:
+                    # Mirror the user-role guard above: a corrupted/hand-
+                    # edited row must degrade to viewer, not turn every
+                    # member's requests into KeyError 500s.
+                    role = "viewer"
                 self._groups[name] = {
-                    "role": g.get("role", "viewer"),
+                    "role": role,
                     "members": set(g.get("members", [])),
                 }
 

@@ -205,6 +205,16 @@ class Master:
         otlp_endpoint: Optional[str] = None,
         log_sink_url: Optional[str] = None,
     ) -> None:
+        # Validated config tier (masterconf.py, the config.go:129 analog):
+        # fail at boot with every problem named, not mid-scheduling on the
+        # first trial that trips a typo'd knob.
+        from determined_tpu.master import masterconf
+
+        masterconf.validate(
+            pools=pools_config,
+            preempt_timeout_s=preempt_timeout_s,
+            config_defaults=config_defaults,
+        )
         self.cluster_id = uuid.uuid4().hex[:8]
         self.external_url = external_url
         # Cluster-admin experiment-config defaults (the reference's

@@ -56,9 +56,12 @@ class ElasticLogSink:
         everything."""
         now = time.time()
         for line in lines:
+            # One lock round-trip per line on the hot ingest path: stamp
+            # the seq and count it in-flight together.
             with self._dropped_lock:
                 self._seq += 1
                 seq = self._seq
+                self._inflight += 1
             doc = {
                 "task_id": task_id,
                 "timestamp": line.get("ts", now),
@@ -68,8 +71,6 @@ class ElasticLogSink:
                 "log": line.get("log", ""),
             }
             try:
-                with self._dropped_lock:
-                    self._inflight += 1
                 self._q.put_nowait(doc)
             except queue.Full:
                 with self._dropped_lock:

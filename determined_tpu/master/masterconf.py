@@ -1,0 +1,95 @@
+"""Master-config validation: the cluster-config tier of the expconf story.
+
+Rebuild of the reference's validated master config
+(`master/internal/config/config.go:129-153`): scheduler/pool knobs arrive
+from `--pools` JSON (or embedding code) and were previously consumed as
+raw dicts with per-consumer ad-hoc checks — a typo'd key was silently
+ignored and a bad value surfaced as a deep stack trace mid-scheduling.
+Here the whole tree is validated at master startup with named errors;
+experiment-level config keeps its own pipeline (master/expconf.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+SCHEDULER_TYPES = ("fifo", "round_robin", "priority", "fair_share")
+POOL_TYPES = ("agent", "kubernetes")
+
+_SCHEDULER_KEYS = {"type", "preemption"}
+_POOL_KEYS = {"type", "scheduler"}
+
+
+def validate_pools(pools: Optional[Dict[str, Any]]) -> List[str]:
+    """Returns human-readable errors (empty = valid)."""
+    errors: List[str] = []
+    if pools is None:
+        return errors
+    if not isinstance(pools, dict):
+        return ["pools must be an object of {pool_name: pool_config}"]
+    if not pools:
+        errors.append("pools must define at least one pool")
+    for name, cfg in pools.items():
+        where = f"pool {name!r}"
+        if not isinstance(cfg, dict):
+            errors.append(f"{where}: config must be an object")
+            continue
+        for key in cfg:
+            if key not in _POOL_KEYS:
+                errors.append(
+                    f"{where}: unknown key {key!r} "
+                    f"(one of: {', '.join(sorted(_POOL_KEYS))})"
+                )
+        ptype = cfg.get("type", "agent")
+        if ptype not in POOL_TYPES:
+            errors.append(
+                f"{where}: type {ptype!r} (one of: {', '.join(POOL_TYPES)})"
+            )
+        sched = cfg.get("scheduler")
+        if sched is None:
+            continue
+        if not isinstance(sched, dict):
+            errors.append(f"{where}: scheduler must be an object")
+            continue
+        for key in sched:
+            if key not in _SCHEDULER_KEYS:
+                errors.append(
+                    f"{where}: unknown scheduler key {key!r} "
+                    f"(one of: {', '.join(sorted(_SCHEDULER_KEYS))})"
+                )
+        stype = sched.get("type", "priority")
+        if stype not in SCHEDULER_TYPES:
+            errors.append(
+                f"{where}: scheduler type {stype!r} "
+                f"(one of: {', '.join(SCHEDULER_TYPES)})"
+            )
+        if "preemption" in sched:
+            if not isinstance(sched["preemption"], bool):
+                errors.append(f"{where}: scheduler.preemption must be a bool")
+            if stype not in ("priority",):
+                errors.append(
+                    f"{where}: scheduler.preemption only applies to the "
+                    "priority scheduler"
+                )
+    return errors
+
+
+def validate(
+    *,
+    pools: Optional[Dict[str, Any]] = None,
+    preempt_timeout_s: float = 600.0,
+    config_defaults: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Validate the master's startup configuration; raises ValueError with
+    EVERY problem named (config.go-style: fail fast at boot, not at the
+    first trial that trips the knob)."""
+    errors = validate_pools(pools)
+    if not isinstance(preempt_timeout_s, (int, float)) or (
+        preempt_timeout_s <= 0
+    ):
+        errors.append("preempt_timeout_s must be a positive number")
+    if config_defaults is not None and not isinstance(config_defaults, dict):
+        errors.append(
+            "config_defaults must be an object of experiment-config keys"
+        )
+    if errors:
+        raise ValueError("invalid master config: " + "; ".join(errors))

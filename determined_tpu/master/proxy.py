@@ -65,8 +65,10 @@ def _strip_token_query(query: str) -> str:
     Everything else passes through untouched — notably Jupyter's own
     `token=` param, which shares a browser-friendly name with nothing of
     ours on purpose (stripping `token` would break the documented
-    `/proxy/<task>/lab?token=<jupyter-token>` flow), and the shell task's
-    `shell_token`."""
+    `/proxy/<task>/lab?token=<jupyter-token>` flow). The shell task's
+    credential rides the X-DTPU-Shell-Token HEADER (never the query:
+    query strings land in access logs) and is forwarded like any other
+    non-master header."""
     if not query:
         return query
     kept = [

@@ -39,6 +39,7 @@ def attention(
     impl: str = "auto",
     block_q: int = 512,
     block_k: int = 512,
+    layout: str = "contiguous",
 ) -> jax.Array:
     """Multi-head attention over [B, S, H, D] tensors.
 
@@ -47,6 +48,9 @@ def attention(
     block_q/block_k: flash kernel tile sizes, fitted down to divisors of the
     sequence as needed. GPTConfig tunes these (1024/1024 measured best for
     the GPT-2 bench on v5e); 512 is a neutral default for direct callers.
+    layout: "zigzag" = the sequence dim is ALREADY in zigzag device order
+    (data/tokens.py native emission) — only the ring impl understands that
+    placement, and it then runs gather-free.
     """
     if impl == "auto":
         if mesh is not None and mesh.shape.get("context", 1) > 1:
@@ -55,6 +59,13 @@ def attention(
             impl = "flash"
         else:
             impl = "dense"
+
+    if layout == "zigzag" and impl != "ring":
+        raise ValueError(
+            "layout='zigzag' requires ring attention (a sharded context "
+            f"axis); resolved impl is {impl!r} — dense/flash causal masks "
+            "assume contiguous order and would be silently wrong"
+        )
 
     if impl == "dense":
         return reference_attention(q, k, v, causal=causal)
@@ -90,13 +101,15 @@ def attention(
     if impl == "ring":
         if mesh is None:
             raise ValueError("ring attention needs a mesh")
-        # make_ring_attention handles zigzag placement (permute in, ring
-        # with balanced causal work, permute out). The gathers stay inside
-        # this jitted program; pipelines that pre-zigzag their data should
-        # call ring_attention directly in their own shard_map.
+        # Contiguous layout: make_ring_attention permutes in/out around the
+        # balanced-causal kernel (a gather each way). Zigzag layout: the
+        # data pipeline already emitted zigzag order (data/tokens.py
+        # zigzag_ring) and the kernel runs gather-free.
         from determined_tpu.parallel.ring import make_ring_attention
 
-        return make_ring_attention(mesh, causal=causal)(q, k, v)
+        return make_ring_attention(
+            mesh, causal=causal, data_layout=layout
+        )(q, k, v)
 
     if impl == "ulysses":
         # All-to-all head<->sequence swap: each device runs full-sequence

@@ -62,6 +62,11 @@ class GPTConfig:
     flash_block_q: int = 1024
     flash_block_k: int = 1024
     z_loss: float = 1e-4               # logit-norm regularizer (stability)
+    # "zigzag": batches arrive pre-shifted in zigzag device order from
+    # data/tokens.py (zigzag_ring) — {"tokens","targets","positions"} —
+    # and ring attention runs gather-free over the context axis. The
+    # contiguous default permutes inside make_ring_attention instead.
+    sequence_layout: str = "contiguous"
     # Pipeline parallelism (DeepSpeed PipelineModule analog, TPU-style:
     # stages sharded over the mesh's `pipeline` axis, microbatches advanced
     # by ppermute inside one compiled program — parallel/pipeline.py).
@@ -316,6 +321,7 @@ class GPT(Model):
             o = attn_mod.attention(
                 q, k, v, mesh=self.mesh, causal=True, impl=c.attn_impl,
                 block_q=c.flash_block_q, block_k=c.flash_block_k,
+                layout=c.sequence_layout,
             )
         o = jnp.einsum("bshk,hkd->bsd", o, blk["wo"].astype(c.dtype))
         o = o + blk["bo"].astype(c.dtype)
@@ -345,13 +351,21 @@ class GPT(Model):
         return x, aux
 
     def _embed_raw(
-        self, tok_embed: jax.Array, pos_embed: jax.Array, tokens: jax.Array
+        self,
+        tok_embed: jax.Array,
+        pos_embed: jax.Array,
+        tokens: jax.Array,
+        positions: Optional[jax.Array] = None,
     ) -> jax.Array:
         """Embedding math shared by the GSPMD path and the 1F1B stage-0
-        producer (no sharding constraints)."""
+        producer (no sharding constraints). `positions` [S]: explicit
+        logical positions for permuted (zigzag) sequence layouts."""
         c = self.config
         x = tok_embed.astype(c.dtype)[tokens]
-        return x + pos_embed.astype(c.dtype)[: tokens.shape[1]]
+        pe = pos_embed.astype(c.dtype)
+        if positions is not None:
+            return x + pe[positions]
+        return x + pe[: tokens.shape[1]]
 
     def _head_raw(
         self,
@@ -364,23 +378,29 @@ class GPT(Model):
         loss (no sharding constraints); w_out already in compute dtype."""
         return jnp.einsum("bsd,dv->bsv", _layernorm(x, lnf_scale, lnf_bias), w_out)
 
-    def _next_token_sums(
-        self, logits: jax.Array, tokens: jax.Array, mask: jax.Array
+    def _aligned_token_sums(
+        self, logits: jax.Array, targets: jax.Array, mask: jax.Array
     ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-        """Next-token objective SUMS (nll, z, correct, n) over fp32 logits —
-        shared by loss() and the per-microbatch 1F1B objective so the two
-        training paths cannot diverge formula-wise."""
-        logits = logits[:, :-1]
-        targets = tokens[:, 1:]
-        mk = mask[:, 1:]
+        """Objective SUMS (nll, z, correct, n) over fp32 logits ALIGNED with
+        targets (position i predicts targets[i]) — the elementwise core
+        shared by the classic shifted path, the 1F1B objective, and the
+        pre-shifted zigzag-layout path."""
         lse = jax.nn.logsumexp(logits, axis=-1)
         target_logit = jnp.take_along_axis(
             logits, targets[..., None], axis=-1
         ).squeeze(-1)
-        nll_sum = jnp.sum((lse - target_logit) * mk)
-        z_sum = jnp.sum(jnp.square(lse) * mk)
-        acc_sum = jnp.sum((jnp.argmax(logits, -1) == targets) * mk)
-        return nll_sum, z_sum, acc_sum, jnp.sum(mk)
+        nll_sum = jnp.sum((lse - target_logit) * mask)
+        z_sum = jnp.sum(jnp.square(lse) * mask)
+        acc_sum = jnp.sum((jnp.argmax(logits, -1) == targets) * mask)
+        return nll_sum, z_sum, acc_sum, jnp.sum(mask)
+
+    def _next_token_sums(
+        self, logits: jax.Array, tokens: jax.Array, mask: jax.Array
+    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+        """Classic in-model shift: position i predicts token i+1."""
+        return self._aligned_token_sums(
+            logits[:, :-1], tokens[:, 1:], mask[:, 1:]
+        )
 
     def _stage_scan_fn(self):
         """fp32-boundary runner over a stack [k, ...] of blocks — the
@@ -401,7 +421,12 @@ class GPT(Model):
 
         return stage_fn
 
-    def _embed(self, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
+    def _embed(
+        self,
+        params: Dict[str, Any],
+        tokens: jax.Array,
+        positions: Optional[jax.Array] = None,
+    ) -> jax.Array:
         c = self.config
         # Lay the lookup out so the gather's output sharding IS the
         # activation sharding: the indices carry the batch/seq mesh axes and
@@ -415,7 +440,7 @@ class GPT(Model):
         tokens = self._constrain(tokens, P(("data", "fsdp"), "context"))
         table = self._constrain(params["tok_embed"].astype(c.dtype), P(None, None))
         pos = self._constrain(params["pos_embed"].astype(c.dtype), P(None, None))
-        x = self._embed_raw(table, pos, tokens)
+        x = self._embed_raw(table, pos, tokens, positions)
         return self._constrain(x, P(("data", "fsdp"), "context", None))
 
     def _head(self, params: Dict[str, Any], x: jax.Array) -> jax.Array:
@@ -429,14 +454,29 @@ class GPT(Model):
         return self._constrain(logits, P(("data", "fsdp"), "context", "tensor"))
 
     def _forward(
-        self, params: Dict[str, Any], tokens: jax.Array
+        self,
+        params: Dict[str, Any],
+        tokens: jax.Array,
+        positions: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, jax.Array]:
         """→ (logits [B, S, V], moe aux loss)."""
         c = self.config
+        if c.sequence_layout == "zigzag":
+            assert positions is not None, (
+                "sequence_layout='zigzag' needs a zigzag-emitting data "
+                "pipeline (data/tokens.py zigzag_ring) supplying positions"
+            )
+            assert c.pipeline_stages == 1, (
+                "zigzag layout + pipeline parallelism not composed yet"
+            )
         if c.pipeline_stages > 1:
+            assert positions is None, (
+                "explicit positions are not plumbed through the pipelined "
+                "forward; use contiguous batches with pipeline parallelism"
+            )
             return self._apply_pipelined(params, tokens)
 
-        x = self._embed(params, tokens)
+        x = self._embed(params, tokens, positions)
         if c.remat and not c.remat_attention:
             attn_fn = functools.partial(self._attn_half, manual=False)
             mlp_fn = jax.checkpoint(
@@ -594,9 +634,14 @@ class GPT(Model):
         ).astype(c.dtype)
         return self._head(params, x), jnp.zeros((), jnp.float32)
 
-    def apply(self, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
+    def apply(
+        self,
+        params: Dict[str, Any],
+        tokens: jax.Array,
+        positions: Optional[jax.Array] = None,
+    ) -> jax.Array:
         """tokens [B, S] int32 → logits [B, S, V] (compute dtype)."""
-        return self._forward(params, tokens)[0]
+        return self._forward(params, tokens, positions)[0]
 
     # -- 1F1B training path ------------------------------------------------
     def _loss_1f1b(
@@ -626,6 +671,15 @@ class GPT(Model):
         assert self.mesh.shape["pipeline"] == n_stages
         assert c.n_layers % n_stages == 0
         assert not c.n_experts, "MoE+pipeline composition not supported yet"
+        assert c.sequence_layout == "contiguous", (
+            "zigzag layout + pipeline parallelism not composed yet"
+        )
+        assert "targets" not in batch and "positions" not in batch, (
+            "the 1F1B path applies the classic in-model shift; a "
+            "pre-shifted (zigzag) batch here would train on permuted "
+            "garbage — use sequence_layout='contiguous' data with pipeline "
+            "parallelism"
+        )
         m = c.num_microbatches or 2 * n_stages
         assert b % m == 0, f"batch {b} not divisible by {m} microbatches"
         per_stage = c.n_layers // n_stages
@@ -747,18 +801,27 @@ class GPT(Model):
         ):
             return self._loss_1f1b(params, batch)
         tokens = batch["tokens"]
-        logits, moe_aux = self._forward(params, tokens)
+        targets = batch.get("targets")
+        positions = batch.get("positions")
+        logits, moe_aux = self._forward(params, tokens, positions)
         mask = batch.get("loss_mask")
         mask = (
             jnp.ones(tokens.shape, jnp.float32)
             if mask is None
             else mask.astype(jnp.float32)
         )
-        # Next-token prediction: position i predicts token i+1 (shift and
-        # per-token sums live in _next_token_sums, shared with 1F1B).
-        nll_sum, z_sum, acc_sum, n_tok = self._next_token_sums(
-            logits.astype(jnp.float32), tokens, mask
-        )
+        if targets is not None:
+            # Pre-shifted batch (zigzag-layout pipelines, data/tokens.py):
+            # position i already predicts targets[i] — no in-model shift.
+            nll_sum, z_sum, acc_sum, n_tok = self._aligned_token_sums(
+                logits.astype(jnp.float32), targets, mask
+            )
+        else:
+            # Next-token prediction: position i predicts token i+1 (shift
+            # + per-token sums shared with 1F1B via _aligned_token_sums).
+            nll_sum, z_sum, acc_sum, n_tok = self._next_token_sums(
+                logits.astype(jnp.float32), tokens, mask
+            )
         n = jnp.maximum(n_tok, 1.0)
         loss = nll_sum / n
         if self.config.z_loss:

@@ -260,14 +260,17 @@ def make_ring_attention(
     zigzag: Optional[bool] = None,
     block_q: int = 512,
     block_k: int = 512,
+    data_layout: str = "contiguous",
 ):
     """shard_map ring_attention over the mesh, on global [B, S, H, D] arrays.
 
     With zigzag (default for causal) the global sequence is permuted into
     zigzag device order before the shard_map and the output permuted back —
     convenient for tests and ad-hoc use. Training input pipelines should
-    instead emit tokens in zigzag order (`zigzag_indices`) and keep the
-    whole model in that order; the permutation here costs a gather each way.
+    instead emit tokens in zigzag order (data/tokens.py `zigzag_ring`) and
+    keep the whole model in that order — pass data_layout="zigzag" and the
+    kernel runs with NO permute gathers (the contiguous wrapper pays one
+    each way at the jit boundary).
     """
     if zigzag is None:
         zigzag = causal
@@ -287,6 +290,16 @@ def make_ring_attention(
             fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )
+
+    if data_layout == "zigzag":
+        # The caller's arrays are ALREADY in zigzag device order (native
+        # emission); run the balanced-causal kernel directly, gather-free.
+        if not causal or ring <= 1:
+            raise ValueError(
+                "data_layout='zigzag' needs causal attention and a sharded "
+                f"context axis (ring={ring})"
+            )
+        return mapped("zigzag")
 
     if not (zigzag and causal and ring > 1):
         return mapped("contiguous")

@@ -202,18 +202,28 @@ class Trainer:
         return jax.jit(eval_step)
 
     # -- data placement ----------------------------------------------------
+    #: Batch keys that are NOT batch-dim-sharded: identical on every host
+    #: and replicated across the mesh. "positions" is the zigzag layout's
+    #: per-sequence position map ([S], no batch dim) — sharding it over
+    #: data axes would mis-inflate its global shape on multi-host runs.
+    REPLICATED_BATCH_KEYS = frozenset({"positions"})
+
     def _put_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:
         sharding = NamedSharding(self.mesh, P(batch_axes()))
+        replicated = NamedSharding(self.mesh, P())
 
-        def put(x):
+        def put_with_key(key, x):
             x = np.asarray(x)
+            if key in self.REPLICATED_BATCH_KEYS:
+                return jax.device_put(x, replicated)
             if jax.process_count() == 1:
                 return jax.device_put(x, sharding)
             # Multi-host: every process holds its local slice of the global
             # batch (the launch layer splits the stream by process index).
             return jax.make_array_from_process_local_data(sharding, x)
 
-        return jax.tree.map(put, batch)
+        return {k: jax.tree.map(lambda x: put_with_key(k, x), v)
+                for k, v in batch.items()}
 
     # -- checkpoint --------------------------------------------------------
     def _save_checkpoint(self, *, sync: bool = False) -> Optional[str]:

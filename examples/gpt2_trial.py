@@ -20,6 +20,7 @@ class GPT2PretrainTrial(JAXTrial):
         return GPTConfig(**self.hparams.get("model_config", {}))
 
     def build_model(self, mesh):
+        self._mesh = mesh
         return GPT(self._config(), mesh=mesh)
 
     def build_optimizer(self):
@@ -42,12 +43,26 @@ class GPT2PretrainTrial(JAXTrial):
         from determined_tpu.data import lm_dataset
 
         cfg = self._config()
+        # Zigzag layout: the loader emits pre-shifted zigzag-order batches
+        # so ring attention runs gather-free. The ring size is DERIVED from
+        # the mesh's context axis — a configured value could silently
+        # mismatch the mesh, and the resulting causal mask would be wrong
+        # with a perfectly finite loss.
+        ring = 0
+        if cfg.sequence_layout == "zigzag":
+            mesh = getattr(self, "_mesh", None)
+            assert mesh is not None, "build_model must run before data"
+            ring = int(mesh.shape.get("context", 1))
+            assert ring > 1, (
+                "sequence_layout='zigzag' needs a sharded context axis"
+            )
         return lm_dataset(
             self.hparams.get("token_shards", []),
             int(self.hparams.get("batch_size", 8)),
             cfg.seq_len,
             cfg.vocab_size,
             seed=seed,
+            zigzag_ring=ring,
         )
 
     def build_training_data(self):

@@ -113,10 +113,13 @@ class TestUpgradeTunnel:
             assert b"Upgrade: websocket" in seen_heads[0]
             # Master credentials must not leak into the task: neither the
             # Authorization header nor the ?token= query param — while the
-            # task's own shell_token must pass through.
+            # task's own shell token must pass through as a HEADER (never
+            # the query string: the request line lands in access logs).
             assert b"Authorization" not in seen_heads[0]
             assert b"fake-user-token" not in seen_heads[0]
-            assert b"shell_token=unused" in seen_heads[0]
+            assert b"X-DTPU-Shell-Token: unused" in seen_heads[0]
+            request_line = seen_heads[0].split(b"\r\n", 1)[0]
+            assert b"unused" not in request_line
         finally:
             srv.close()
 

@@ -1,0 +1,191 @@
+"""Native zigzag sequence layout (VERDICT r2 weak #5): the data pipeline
+emits pre-shifted batches in zigzag device order, the whole model runs in
+that order (positions-aware embedding, aligned loss), and ring attention
+consumes them gather-free — no per-step permute pair at the jit boundary."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from determined_tpu.data.tokens import TokenDataset, lm_dataset, write_token_shard
+from determined_tpu.models import GPT
+from determined_tpu.models import gpt as gpt_mod
+from determined_tpu.parallel.mesh import MeshConfig, make_mesh
+from determined_tpu.parallel.ring import inverse_permutation, zigzag_indices
+
+
+def _cfg(**over):
+    base = dataclasses.replace(gpt_mod.tiny(), dtype=jnp.float32)
+    return dataclasses.replace(base, **over)
+
+
+class TestZigzagEmission:
+    def test_dataset_emits_preshifted_zigzag(self, tmp_path):
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, 200, 4096).astype(np.uint16)
+        path = str(tmp_path / "shard.bin")
+        write_token_shard(path, toks)
+        ring = 2
+        ds = TokenDataset(
+            [path], batch_size=2, seq_len=16, seed=3, shuffle=False,
+            use_native=False, zigzag_ring=ring,
+        )
+        batch = next(ds)
+        assert set(batch) == {"tokens", "targets", "positions"}
+        perm = zigzag_indices(16, ring)
+        np.testing.assert_array_equal(batch["positions"], perm)
+        inv = inverse_permutation(perm)
+        # un-permuted targets are exactly the next token of un-permuted
+        # inputs (pre-shift happened BEFORE the permutation)
+        x = batch["tokens"][:, inv]
+        y = batch["targets"][:, inv]
+        np.testing.assert_array_equal(x[:, 1:], y[:, :-1])
+
+    def test_synthetic_stream_matches_contract(self):
+        it = lm_dataset(None, 2, 16, 100, seed=1, zigzag_ring=2)
+        batch = next(iter(it))
+        assert set(batch) == {"tokens", "targets", "positions"}
+        inv = inverse_permutation(zigzag_indices(16, 2))
+        x, y = batch["tokens"][:, inv], batch["targets"][:, inv]
+        np.testing.assert_array_equal(x[:, 1:], y[:, :-1])
+
+    def test_determinism_across_layouts(self, tmp_path):
+        """zigzag emission is the same underlying byte stream as the
+        contiguous reader — just re-laid-out (un-permute and compare)."""
+        rng = np.random.default_rng(1)
+        toks = rng.integers(0, 200, 4096).astype(np.uint16)
+        path = str(tmp_path / "s.bin")
+        write_token_shard(path, toks)
+        plain = next(TokenDataset(
+            [path], 2, 17, seed=5, shuffle=False, use_native=False,
+        ))["tokens"]
+        zz = next(TokenDataset(
+            [path], 2, 16, seed=5, shuffle=False, use_native=False,
+            zigzag_ring=2,
+        ))
+        inv = inverse_permutation(zigzag_indices(16, 2))
+        np.testing.assert_array_equal(zz["tokens"][:, inv], plain[:, :-1])
+        np.testing.assert_array_equal(zz["targets"][:, inv], plain[:, 1:])
+
+
+class TestZigzagModel:
+    def _loss(self, model, params, batch):
+        return float(jax.jit(
+            lambda p, b: model.loss(p, b, jax.random.PRNGKey(0))[0]
+        )(params, batch))
+
+    def test_zigzag_layout_loss_matches_contiguous(self, devices8):
+        """Same raw rows through (a) the classic in-model shift, (b) a
+        contiguous pre-shifted batch, and (c) the zigzag-layout model with
+        natively-emitted zigzag batches — all three losses must agree (the
+        math is a permutation away)."""
+        mesh = make_mesh(
+            MeshConfig(data=2, context=2, tensor=2), devices=devices8
+        )
+        rng = np.random.default_rng(0)
+        s = 128
+        raw = rng.integers(0, 256, (4, s + 1)).astype(np.int32)
+
+        # Classic shifted baseline runs on a context-free mesh: its odd
+        # sequence (s+1) can't split over the ring, and the loss value is
+        # mesh-independent anyway.
+        mesh_nc = make_mesh(
+            MeshConfig(data=2, fsdp=2, tensor=2), devices=devices8
+        )
+        classic = GPT(_cfg(seq_len=s + 1), mesh=mesh_nc)
+        params = classic.init(jax.random.PRNGKey(0))
+        loss_classic = self._loss(classic, params, {"tokens": raw})
+
+        pre = {
+            "tokens": raw[:, :-1],
+            "targets": raw[:, 1:],
+            "positions": np.arange(s, dtype=np.int32),
+        }
+        loss_pre = self._loss(classic, params, pre)
+        np.testing.assert_allclose(loss_classic, loss_pre, rtol=1e-6)
+
+        perm = zigzag_indices(s, 2)
+        zz_model = GPT(_cfg(seq_len=s + 1, sequence_layout="zigzag"), mesh=mesh)
+        zz = {
+            "tokens": np.ascontiguousarray(raw[:, :-1][:, perm]),
+            "targets": np.ascontiguousarray(raw[:, 1:][:, perm]),
+            "positions": perm.astype(np.int32),
+        }
+        loss_zz = self._loss(zz_model, params, zz)
+        np.testing.assert_allclose(loss_classic, loss_zz, rtol=1e-5)
+
+    def test_zigzag_requires_ring(self, devices8):
+        """Dense/flash causal masks assume contiguous order: a zigzag
+        layout without a sharded context axis must be rejected loudly."""
+        mesh = make_mesh(MeshConfig(data=8), devices=devices8)
+        model = GPT(_cfg(sequence_layout="zigzag"), mesh=mesh)
+        params = model.init(jax.random.PRNGKey(0))
+        s = 128
+        perm = zigzag_indices(s, 2)
+        batch = {
+            "tokens": np.zeros((2, s), np.int32),
+            "targets": np.zeros((2, s), np.int32),
+            "positions": perm.astype(np.int32),
+        }
+        with pytest.raises(ValueError, match="zigzag"):
+            jax.jit(
+                lambda p, b: model.loss(p, b, jax.random.PRNGKey(0))[0]
+            )(params, batch)
+
+    def test_trainer_fit_with_zigzag_pipeline(self, devices8):
+        """End to end through the Trainer: zigzag-emitting dataset +
+        zigzag-layout GPT on a context-sharded mesh trains (also pins the
+        batch-placement rule: 'positions' is replicated, not batch-dim
+        sharded)."""
+        import optax
+
+        from determined_tpu import core
+        from determined_tpu.trainer import Batch, JAXTrial, Trainer
+
+        s = 64
+
+        class _ZigTrial(JAXTrial):
+            def build_model(self, mesh):
+                return GPT(
+                    _cfg(seq_len=s, sequence_layout="zigzag", n_layers=2),
+                    mesh=mesh,
+                )
+
+            def build_optimizer(self):
+                return optax.adamw(1e-3)
+
+            def build_training_data(self):
+                return lm_dataset(None, 4, s, 256, seed=0, zigzag_ring=2)
+
+        mesh = make_mesh(
+            MeshConfig(data=2, context=2, tensor=2), devices=devices8
+        )
+        trainer = Trainer(
+            _ZigTrial(), core._context._dummy_init(), mesh=mesh
+        )
+        trainer.fit(max_length=Batch(2))
+        assert trainer.steps_completed == 2
+
+    def test_zigzag_grads_flow(self, devices8):
+        mesh = make_mesh(
+            MeshConfig(data=2, context=2, tensor=2), devices=devices8
+        )
+        rng = np.random.default_rng(2)
+        s = 128
+        raw = rng.integers(0, 256, (4, s + 1)).astype(np.int32)
+        perm = zigzag_indices(s, 2)
+        model = GPT(_cfg(sequence_layout="zigzag"), mesh=mesh)
+        params = model.init(jax.random.PRNGKey(0))
+        batch = {
+            "tokens": np.ascontiguousarray(raw[:, :-1][:, perm]),
+            "targets": np.ascontiguousarray(raw[:, 1:][:, perm]),
+            "positions": perm.astype(np.int32),
+        }
+        grads = jax.jit(jax.grad(
+            lambda p: model.loss(p, batch, jax.random.PRNGKey(0))[0]
+        ))(params)
+        flat = jax.tree.leaves(grads)
+        assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+        assert any(float(jnp.abs(g).max()) > 0 for g in flat)
