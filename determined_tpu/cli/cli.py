@@ -472,6 +472,49 @@ def queue_move(args: argparse.Namespace) -> None:
     print(f"moved {args.alloc_id}" + (f" ahead of {args.ahead_of}" if args.ahead_of else " to front"))
 
 
+# -- deploy (ref: det deploy local/gcp + helm chart) ---------------------------
+def deploy_local_up(args: argparse.Namespace) -> None:
+    from determined_tpu.deploy import local as deploy_local
+
+    state = deploy_local.up(
+        args.data_dir, port=args.port, agents=args.agents,
+        slots_per_agent=args.slots_per_agent, tls=args.tls,
+    )
+    print(f"master: {state['url']}")
+    if state.get("cert"):
+        print(f"export DTPU_MASTER_CERT={state['cert']}")
+    print(f"export DTPU_MASTER={state['url']}")
+
+
+def deploy_local_down(args: argparse.Namespace) -> None:
+    from determined_tpu.deploy import local as deploy_local
+
+    was = deploy_local.down(args.data_dir)
+    print("stopped" if was else "nothing running")
+
+
+def deploy_k8s(args: argparse.Namespace) -> None:
+    from determined_tpu.deploy import k8s as deploy_k8s_mod
+
+    print(deploy_k8s_mod.to_yaml(deploy_k8s_mod.render_manifests(
+        namespace=args.namespace, image=args.image, port=args.port,
+        tls=args.tls,
+    )), end="")
+
+
+def deploy_gcp(args: argparse.Namespace) -> None:
+    from determined_tpu.deploy import gcp as deploy_gcp_mod
+
+    result = deploy_gcp_mod.deploy(
+        project=args.project, zone=args.zone, name=args.name,
+        tls=args.tls, dry_run=args.dry_run,
+        source_ranges=args.source_ranges or "",
+    )
+    for line in result["commands"]:
+        print(line)
+    print(f"admin password: {result['admin_password']}  (login: admin)")
+
+
 # -- daemons ------------------------------------------------------------------
 def master_up(args: argparse.Namespace) -> None:
     sys.argv = ["dtpu-master"] + (args.rest or [])
@@ -644,6 +687,36 @@ def build_parser() -> argparse.ArgumentParser:
     v = master.add_parser("audit")
     v.add_argument("--username", default=None)
     v.set_defaults(fn=master_audit)
+
+    deploy = sub.add_parser("deploy").add_subparsers(dest="verb", required=True)
+    v = deploy.add_parser("local")
+    v.add_argument("action", choices=["up", "down"])
+    v.add_argument("--data-dir", default="./dtpu-deploy")
+    v.add_argument("--port", type=int, default=8080)
+    v.add_argument("--agents", type=int, default=1)
+    v.add_argument("--slots-per-agent", type=int, default=1)
+    v.add_argument("--tls", action="store_true")
+    v.set_defaults(fn=lambda a: (
+        deploy_local_up(a) if a.action == "up" else deploy_local_down(a)
+    ))
+    v = deploy.add_parser("k8s", help="print manifests for kubectl apply -f -")
+    v.add_argument("--namespace", default="default")
+    v.add_argument("--image", default="determined-tpu:latest")
+    v.add_argument("--port", type=int, default=8080)
+    v.add_argument("--tls", action="store_true")
+    v.set_defaults(fn=deploy_k8s)
+    v = deploy.add_parser("gcp")
+    v.add_argument("--project", required=True)
+    v.add_argument("--zone", required=True)
+    v.add_argument("--name", default="dtpu-master")
+    v.add_argument("--tls", action=argparse.BooleanOptionalAction,
+                   default=True, help="--no-tls to serve plain HTTP "
+                                      "(e.g. behind your own TLS LB)")
+    v.add_argument("--source-ranges", default=None,
+                   help="CIDRs allowed through the API firewall rule; "
+                        "omitted = no public rule (reach via VPC/IAP)")
+    v.add_argument("--dry-run", action="store_true")
+    v.set_defaults(fn=deploy_gcp)
 
     tpl = sub.add_parser("template").add_subparsers(dest="verb", required=True)
     v = tpl.add_parser("set")

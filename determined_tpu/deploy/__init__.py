@@ -1,0 +1,22 @@
+"""Deploy-the-master tooling: local daemons, GCP VM, Kubernetes manifests.
+
+Rebuild of the reference's deployment story — `det deploy local`
+(`harness/determined/deploy/local/`), the GCP Terraform stack
+(`deploy/gcp/terraform/main.tf`), the Helm chart
+(`helm/charts/determined/`), and the systemd packaging
+(`master/packaging/determined-master.service`) — TPU-native: the master is
+a single Python process over SQLite-WAL (no Postgres pod to orchestrate),
+agents are TPU-VM processes provisioned by the master itself
+(master/provisioner.py), so "deploy" means standing up ONE master with
+durable storage and credentials, in whichever substrate:
+
+- `deploy.local`: daemonized master (+ optional local agents) with a state
+  file — the devcluster made durable (`dtpu deploy local up/down`).
+- `deploy.gcp`: a master VM via driver-executed gcloud with a systemd unit
+  in the startup script (the Terraform analog, using the same
+  InstanceDriver discipline as the agent provisioner).
+- `deploy.k8s`: rendered manifests (ServiceAccount/RBAC for the pod-driving
+  RM, PVC, Deployment, Service) — the Helm-chart analog, consumable by
+  kubectl (JSON documents are valid YAML).
+"""
+from determined_tpu.deploy import gcp, k8s, local  # noqa: F401

@@ -1,0 +1,147 @@
+"""GCP master deployment: a VM with a systemd unit, via executed gcloud.
+
+The Terraform-stack analog (`deploy/gcp/terraform/main.tf` +
+`master/packaging/determined-master.service`): one command creates a
+master VM whose startup script installs the package, renders the systemd
+unit, and starts the master with durable disk + optional TLS bootstrap.
+Commands go through an injectable runner — the same testable-driver
+discipline as the agent provisioner (master/provisioner.py GcloudTPUDriver).
+"""
+from __future__ import annotations
+
+import shlex
+import subprocess
+from typing import Any, Callable, Dict, List, Optional
+
+SYSTEMD_UNIT = """\
+[Unit]
+Description=determined_tpu master
+After=network-online.target
+Wants=network-online.target
+
+[Service]
+Type=simple
+User=dtpu
+ExecStart=/usr/bin/python3 -m determined_tpu.master.main {args}
+Restart=always
+RestartSec=5
+LimitNOFILE=65536
+
+[Install]
+WantedBy=multi-user.target
+"""
+
+
+def startup_script(
+    *,
+    package_source: str = "pip install determined-tpu",
+    port: int = 8080,
+    tls: bool = True,
+    admin_password: str = "",
+    extra_args: str = "",
+) -> str:
+    """Cloud-init style startup script for the master VM (the
+    agentsetup/agent_setup.go analog, for the master). Auth is NOT
+    optional here: an internet-reachable master without users would let
+    anyone POST /api/v1/commands — remote code execution on the VM."""
+    if not admin_password:
+        raise ValueError(
+            "a GCP-deployed master must boot with auth enabled; pass "
+            "admin_password (deploy() generates one)"
+        )
+    users = shlex.quote(
+        '{"admin": "%s"}' % admin_password.replace('"', "")
+    )
+    args = (
+        f"--host 0.0.0.0 --port {port} --db /var/lib/dtpu/master.db "
+        f"--users {users}"
+    )
+    if tls:
+        args += " --tls"
+    if extra_args:
+        args += f" {extra_args}"
+    unit = SYSTEMD_UNIT.format(args=args)
+    return "\n".join([
+        "#!/bin/bash",
+        "set -euo pipefail",
+        "id -u dtpu &>/dev/null || useradd -r -m dtpu",
+        "mkdir -p /var/lib/dtpu && chown dtpu:dtpu /var/lib/dtpu",
+        package_source,
+        "cat > /etc/systemd/system/dtpu-master.service <<'UNIT'",
+        unit + "UNIT",
+        "systemctl daemon-reload",
+        "systemctl enable --now dtpu-master",
+    ]) + "\n"
+
+
+def master_vm_commands(
+    *,
+    project: str,
+    zone: str,
+    name: str = "dtpu-master",
+    machine_type: str = "e2-standard-4",
+    disk_gb: int = 50,
+    port: int = 8080,
+    tls: bool = True,
+    admin_password: str = "",
+    source_ranges: str = "",
+    package_source: str = "pip install determined-tpu",
+) -> List[List[str]]:
+    """The gcloud invocations that stand the master up (create + firewall).
+    Returned as argv lists so tests can assert them and `deploy` can run
+    them. source_ranges: CIDRs allowed to reach the API — empty means the
+    firewall rule is NOT created (agents inside the VPC still connect;
+    reach the API via IAP/SSH tunnel), because an implicit 0.0.0.0/0 is a
+    foot-gun."""
+    script = startup_script(
+        package_source=package_source, port=port, tls=tls,
+        admin_password=admin_password,
+    )
+    create = [
+        "gcloud", "compute", "instances", "create", name,
+        f"--project={project}", f"--zone={zone}",
+        f"--machine-type={machine_type}",
+        f"--boot-disk-size={disk_gb}GB",
+        "--image-family=debian-12", "--image-project=debian-cloud",
+        "--tags=dtpu-master",
+        f"--metadata=startup-script={script}",
+    ]
+    cmds = [create]
+    if source_ranges:
+        cmds.append([
+            "gcloud", "compute", "firewall-rules", "create", f"{name}-api",
+            f"--project={project}",
+            f"--allow=tcp:{port}",
+            f"--source-ranges={source_ranges}",
+            "--target-tags=dtpu-master",
+        ])
+    return cmds
+
+
+def deploy(
+    *,
+    project: str,
+    zone: str,
+    runner: Optional[Callable[..., Any]] = None,
+    dry_run: bool = False,
+    admin_password: str = "",
+    **kw: Any,
+) -> Dict[str, Any]:
+    """Execute (or print) the deployment. Generates the admin password if
+    not supplied; returns {"commands": [...], "admin_password": ...} so the
+    caller can hand the credential to the operator exactly once."""
+    if not admin_password:
+        import secrets
+
+        admin_password = secrets.token_urlsafe(12)
+    cmds = master_vm_commands(
+        project=project, zone=zone, admin_password=admin_password, **kw
+    )
+    lines = [shlex.join(c) for c in cmds]
+    if not dry_run:
+        run = runner or (
+            lambda argv: subprocess.run(argv, check=True)
+        )
+        for argv in cmds:
+            run(argv)
+    return {"commands": lines, "admin_password": admin_password}

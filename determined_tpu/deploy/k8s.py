@@ -1,0 +1,182 @@
+"""Kubernetes manifests for the master: the Helm-chart analog.
+
+Rebuild of `helm/charts/determined/templates/` (master-deployment,
+master-permissions, service, PVC) minus the Postgres pair — the TPU-native
+master embeds SQLite-WAL on a PVC. The rendered ServiceAccount/Role grant
+exactly what the in-cluster REST driver uses (`master/kube_rest.py`: node
+list, pod CRUD + log streaming). Documents are plain dicts; `to_yaml`
+emits one JSON document per `---` block — JSON is valid YAML, so the
+output feeds `kubectl apply -f` with no YAML library in the image.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+APP_LABELS = {"app": "determined-tpu-master"}
+
+
+def render_manifests(
+    *,
+    namespace: str = "default",
+    image: str = "determined-tpu:latest",
+    port: int = 8080,
+    tls: bool = False,
+    storage: str = "8Gi",
+    service_type: str = "ClusterIP",
+) -> List[Dict[str, Any]]:
+    """The full master stack as Kubernetes API objects, in apply order."""
+    meta = lambda name: {  # noqa: E731
+        "name": name, "namespace": namespace, "labels": dict(APP_LABELS),
+    }
+    sa = {
+        "apiVersion": "v1",
+        "kind": "ServiceAccount",
+        "metadata": meta("determined-tpu-master"),
+    }
+    role = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "Role",
+        "metadata": meta("determined-tpu-master"),
+        # Exactly the surface kube_rest.RestKubeClient calls — pod CRUD,
+        # pod log follow; nothing more (ref master-permissions.yaml).
+        "rules": [
+            {
+                "apiGroups": [""],
+                "resources": ["pods"],
+                "verbs": ["create", "delete", "get", "list", "watch"],
+            },
+            {
+                "apiGroups": [""],
+                "resources": ["pods/log"],
+                "verbs": ["get"],
+            },
+        ],
+    }
+    # Nodes are cluster-scoped: the list_nodes() inventory needs a
+    # ClusterRole.
+    cluster_role = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {
+            "name": f"determined-tpu-master-{namespace}",
+            "labels": dict(APP_LABELS),
+        },
+        "rules": [
+            {
+                "apiGroups": [""],
+                "resources": ["nodes"],
+                "verbs": ["get", "list"],
+            }
+        ],
+    }
+    binding = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "RoleBinding",
+        "metadata": meta("determined-tpu-master"),
+        "roleRef": {
+            "apiGroup": "rbac.authorization.k8s.io",
+            "kind": "Role",
+            "name": "determined-tpu-master",
+        },
+        "subjects": [{
+            "kind": "ServiceAccount",
+            "name": "determined-tpu-master",
+            "namespace": namespace,
+        }],
+    }
+    cluster_binding = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRoleBinding",
+        "metadata": {
+            "name": f"determined-tpu-master-{namespace}",
+            "labels": dict(APP_LABELS),
+        },
+        "roleRef": {
+            "apiGroup": "rbac.authorization.k8s.io",
+            "kind": "ClusterRole",
+            "name": f"determined-tpu-master-{namespace}",
+        },
+        "subjects": [{
+            "kind": "ServiceAccount",
+            "name": "determined-tpu-master",
+            "namespace": namespace,
+        }],
+    }
+    pvc = {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": meta("determined-tpu-master-db"),
+        "spec": {
+            "accessModes": ["ReadWriteOnce"],
+            "resources": {"requests": {"storage": storage}},
+        },
+    }
+    args = [
+        "--host", "0.0.0.0", "--port", str(port),
+        "--db", "/data/master.db",
+        "--pools", json.dumps({"default": {"type": "kubernetes"}}),
+    ]
+    if tls:
+        args.append("--tls")
+    deployment = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": meta("determined-tpu-master"),
+        "spec": {
+            # SQLite has one writer: exactly one master (the reference's
+            # master Deployment is replicas:1 too; HA is restart-based via
+            # restore_experiments + the PVC).
+            "replicas": 1,
+            "strategy": {"type": "Recreate"},
+            "selector": {"matchLabels": dict(APP_LABELS)},
+            "template": {
+                "metadata": {"labels": dict(APP_LABELS)},
+                "spec": {
+                    "serviceAccountName": "determined-tpu-master",
+                    "containers": [{
+                        "name": "master",
+                        "image": image,
+                        "command": [
+                            "python", "-m", "determined_tpu.master.main",
+                        ] + args,
+                        "ports": [{"containerPort": port}],
+                        "volumeMounts": [
+                            {"name": "db", "mountPath": "/data"}
+                        ],
+                        "readinessProbe": {
+                            "httpGet": {
+                                "path": "/api/v1/master",
+                                "port": port,
+                                "scheme": "HTTPS" if tls else "HTTP",
+                            },
+                            "initialDelaySeconds": 3,
+                        },
+                    }],
+                    "volumes": [{
+                        "name": "db",
+                        "persistentVolumeClaim": {
+                            "claimName": "determined-tpu-master-db",
+                        },
+                    }],
+                },
+            },
+        },
+    }
+    service = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": meta("determined-tpu-master"),
+        "spec": {
+            "type": service_type,
+            "selector": dict(APP_LABELS),
+            "ports": [{"port": port, "targetPort": port}],
+        },
+    }
+    return [sa, role, cluster_role, binding, cluster_binding, pvc,
+            deployment, service]
+
+
+def to_yaml(manifests: List[Dict[str, Any]]) -> str:
+    """kubectl-consumable multi-document stream (JSON is valid YAML)."""
+    return "\n---\n".join(json.dumps(m, indent=2) for m in manifests) + "\n"

@@ -1,0 +1,180 @@
+"""`dtpu deploy local`: a durable single-box cluster.
+
+The `det deploy local` analog (`harness/determined/deploy/local/
+cluster_utils.py` — there it drives docker-compose; here the master and
+agents are daemonized processes): master with a file-backed DB (+ optional
+TLS bootstrap), N local agents, a JSON state file for idempotent
+`up`/`down`, logs under the deploy dir.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+STATE_FILE = "deploy.json"
+
+
+def _state_path(data_dir: str) -> str:
+    return os.path.join(data_dir, STATE_FILE)
+
+
+def read_state(data_dir: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_state_path(data_dir)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _alive(pid: int) -> bool:
+    # Reap first: when up() and down() share a process (library use), the
+    # SIGTERM'd children become zombies of this process and kill(pid, 0)
+    # would report them alive for the whole grace period.
+    try:
+        os.waitpid(pid, os.WNOHANG)
+    except (ChildProcessError, OSError):
+        pass  # not our child (CLI `down` in a fresh process) — fine
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def up(
+    data_dir: str,
+    *,
+    port: int = 8080,
+    agents: int = 1,
+    slots_per_agent: int = 1,
+    tls: bool = False,
+    wait_s: float = 30.0,
+    env: Optional[Dict[str, str]] = None,
+) -> Dict[str, Any]:
+    """Start (or adopt) a local cluster; returns the deploy state.
+
+    Idempotent: a live deployment in `data_dir` is returned as-is — the
+    reference's `det deploy local --no-restart` behavior.
+    """
+    data_dir = os.path.abspath(data_dir)
+    os.makedirs(data_dir, exist_ok=True)
+    prev = read_state(data_dir)
+    if prev and _alive(prev.get("master_pid", -1)):
+        return prev
+
+    base_env = dict(os.environ)
+    base_env.update(env or {})
+    # Children must import this working tree without installation.
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    pypath = base_env.get("PYTHONPATH", "")
+    if repo_root not in pypath.split(os.pathsep):
+        base_env["PYTHONPATH"] = (
+            f"{repo_root}{os.pathsep}{pypath}" if pypath else repo_root
+        )
+
+    master_cmd = [
+        sys.executable, "-m", "determined_tpu.master.main",
+        "--host", "127.0.0.1", "--port", str(port),
+        "--db", os.path.join(data_dir, "master.db"),
+    ]
+    if tls:
+        master_cmd.append("--tls")
+    master_log = open(os.path.join(data_dir, "master.log"), "ab")
+    master = subprocess.Popen(
+        master_cmd, env=base_env, stdout=master_log, stderr=subprocess.STDOUT,
+        start_new_session=True,  # survives the CLI process; killable by pgid
+    )
+
+    scheme = "https" if tls else "http"
+    url = f"{scheme}://127.0.0.1:{port}"
+    cert = os.path.join(data_dir, "master-cert.pem") if tls else None
+    if tls:
+        base_env["DTPU_MASTER_CERT"] = cert
+
+    deadline = time.time() + wait_s
+    last_err: Optional[Exception] = None
+    while time.time() < deadline:
+        if master.poll() is not None:
+            raise RuntimeError(
+                f"master exited rc={master.returncode}; see "
+                f"{os.path.join(data_dir, 'master.log')}"
+            )
+        try:
+            import requests
+
+            from determined_tpu.common.tls import requests_verify
+
+            r = requests.get(
+                f"{url}/api/v1/master", timeout=3,
+                verify=requests_verify(cert) if tls else True,
+            )
+            if r.status_code == 200:
+                break
+        except Exception as e:  # noqa: BLE001 — still booting
+            last_err = e
+        time.sleep(0.3)
+    else:
+        master.terminate()
+        raise RuntimeError(f"master never became ready: {last_err}")
+
+    agent_pids: List[int] = []
+    for i in range(agents):
+        agent_log = open(os.path.join(data_dir, f"agent-{i}.log"), "ab")
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "determined_tpu.agent.agent",
+                "--master-url", url, "--agent-id", f"local-{i}",
+                "--slots", str(slots_per_agent),
+            ],
+            env=base_env, stdout=agent_log, stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        agent_pids.append(proc.pid)
+
+    state = {
+        "url": url,
+        "cert": cert,
+        "master_pid": master.pid,
+        "agent_pids": agent_pids,
+        "data_dir": data_dir,
+    }
+    with open(_state_path(data_dir), "w") as f:
+        json.dump(state, f, indent=2)
+    return state
+
+
+def down(data_dir: str, *, grace_s: float = 10.0) -> bool:
+    """Stop the deployment recorded in `data_dir`; returns True if anything
+    was running. The DB/certs stay — `up` again resumes the same cluster
+    (restore_experiments + the pinned TLS cert)."""
+    state = read_state(data_dir)
+    if not state:
+        return False
+    pids = [state.get("master_pid")] + list(state.get("agent_pids", []))
+    pids = [p for p in pids if p and _alive(p)]
+    for pid in pids:
+        try:
+            os.killpg(pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+    deadline = time.time() + grace_s
+    while time.time() < deadline and any(_alive(p) for p in pids):
+        time.sleep(0.2)
+    for pid in pids:
+        if _alive(pid):
+            try:
+                os.killpg(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+    try:
+        os.remove(_state_path(data_dir))
+    except OSError:
+        pass
+    return bool(pids)

@@ -49,12 +49,17 @@ def main() -> None:
              "--tls-cert/--tls-key are not given (det deploy local analog)")
     parser.add_argument("--tls-cert", default=None)
     parser.add_argument("--tls-key", default=None)
+    parser.add_argument(
+        "--users", default=None,
+        help='JSON {"username": "password", ...}: enables auth with these '
+             "accounts (first user should be the admin; roles via the API)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
     pools = json.loads(args.pools) if args.pools else None
     master = Master(
         db_path=args.db, pools_config=pools,
+        users=json.loads(args.users) if args.users else None,
         preempt_timeout_s=args.preempt_timeout,
         config_defaults=(
             json.loads(args.config_defaults) if args.config_defaults else None

@@ -202,19 +202,20 @@ class Trainer:
         return jax.jit(eval_step)
 
     # -- data placement ----------------------------------------------------
-    #: Batch keys that are NOT batch-dim-sharded: identical on every host
-    #: and replicated across the mesh. "positions" is the zigzag layout's
-    #: per-sequence position map ([S], no batch dim) — sharding it over
-    #: data axes would mis-inflate its global shape on multi-host runs.
-    REPLICATED_BATCH_KEYS = frozenset({"positions"})
-
     def _put_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:
         sharding = NamedSharding(self.mesh, P(batch_axes()))
         replicated = NamedSharding(self.mesh, P())
+        # Replication is a property of the TRIAL's batch contract, not the
+        # trainer: trials declare which keys have no batch dim (default:
+        # "positions", the zigzag layout's [S] position map — sharding it
+        # over data axes would mis-inflate its global shape multi-host).
+        replicated_keys = getattr(
+            self.trial, "replicated_batch_keys", frozenset({"positions"})
+        )
 
         def put_with_key(key, x):
             x = np.asarray(x)
-            if key in self.REPLICATED_BATCH_KEYS:
+            if key in replicated_keys:
                 return jax.device_put(x, replicated)
             if jax.process_count() == 1:
                 return jax.device_put(x, sharding)

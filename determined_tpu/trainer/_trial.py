@@ -32,6 +32,12 @@ class JAXTrial(abc.ABC):
     #: needed only when lengths/periods use Epoch units.
     batches_per_epoch: int = 0
 
+    #: Batch keys with NO leading batch dim (identical on every host):
+    #: replicated across the mesh instead of batch-sharded. Default covers
+    #: the zigzag LM pipeline's [S] "positions" map; override if your
+    #: batches use that name for a per-example array.
+    replicated_batch_keys: frozenset = frozenset({"positions"})
+
     def __init__(self, hparams: Optional[Dict[str, Any]] = None) -> None:
         self.hparams = hparams or {}
 

@@ -1,0 +1,86 @@
+"""DARTS-style HP-search benchmark: a NAS cell space driven by the searcher.
+
+The platform analog of the reference's HP-search benchmark recipes
+(`examples/hp_search_benchmarks/darts_cifar10_pytorch/` — operations.py's
+op menu + genotype search driven by adaptive searchers): each trial is one
+GENOTYPE (a categorical op choice per cell edge, sampled by the searcher),
+trained on a CIFAR-shaped stream through the dm-haiku integration. Running
+it under adaptive_asha exercises rung promotion over a combinatorial
+architecture space — the searcher-benchmark role, TPU-native.
+
+Config: examples/darts_benchmark.json.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from determined_tpu.integrations.haiku import HaikuModel, HaikuVisionTrial
+
+#: The op menu (operations.py analog), all shape-preserving [B, H, W, C].
+OPS = ("conv3", "conv5", "maxpool", "avgpool", "skip")
+
+
+def _op(kind: str, channels: int):
+    import haiku as hk
+    import jax
+
+    if kind == "conv3":
+        return lambda x: jax.nn.relu(
+            hk.Conv2D(channels, kernel_shape=3)(x)
+        )
+    if kind == "conv5":
+        return lambda x: jax.nn.relu(
+            hk.Conv2D(channels, kernel_shape=5)(x)
+        )
+    # Full unbatched window shapes ([H, W, C]): haiku infers batch dims and
+    # warns on bare ints under transforms.
+    if kind == "maxpool":
+        return lambda x: hk.MaxPool(
+            window_shape=(3, 3, 1), strides=(1, 1, 1), padding="SAME"
+        )(x)
+    if kind == "avgpool":
+        return lambda x: hk.AvgPool(
+            window_shape=(3, 3, 1), strides=(1, 1, 1), padding="SAME"
+        )(x)
+    if kind == "skip":
+        return lambda x: x
+    raise ValueError(f"unknown op {kind!r} (one of {OPS})")
+
+
+def cell_forward(genotype: Dict[str, str], channels: int, num_classes: int):
+    """A 2-node DARTS-ish cell: node1 = op0(stem); node2 = op1(stem) +
+    op2(node1); head over the mean of both nodes."""
+    import haiku as hk
+    import jax
+    import jax.numpy as jnp
+
+    def forward(x, is_training):
+        del is_training
+        stem = jax.nn.relu(hk.Conv2D(channels, kernel_shape=3)(x))
+        n1 = _op(genotype["op_0"], channels)(stem)
+        n2 = _op(genotype["op_1"], channels)(stem) + _op(
+            genotype["op_2"], channels
+        )(n1)
+        h = jnp.mean((n1 + n2) / 2.0, axis=(1, 2))
+        return hk.Linear(num_classes)(h)
+
+    return forward
+
+
+class DartsBenchmarkTrial(HaikuVisionTrial):
+    """HaikuVisionTrial with the architecture chosen by the searcher:
+    data stream, optimizer, and validation slice are inherited so the
+    benchmark and the vision trial cannot drift apart."""
+
+    def build_model(self, mesh):
+        _, size, classes = self._shapes()
+        genotype = {k: self.hparams[k] for k in ("op_0", "op_1", "op_2")}
+        return HaikuModel(
+            cell_forward(
+                genotype, int(self.hparams.get("channels", 16)), classes
+            ),
+            example_input=np.zeros((1, size, size, 3), np.float32),
+            mesh=mesh,
+        )

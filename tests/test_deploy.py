@@ -1,0 +1,142 @@
+"""Deploy-the-master tooling (VERDICT r2 missing #6): local daemonized
+cluster (det deploy local analog), k8s manifest rendering (Helm-chart
+analog), GCP VM commands (Terraform analog). Refs:
+deploy/gcp/terraform/main.tf, helm/charts/determined/,
+master/packaging/determined-master.service."""
+import json
+import shlex
+import subprocess
+import sys
+
+import pytest
+import requests
+
+from determined_tpu.deploy import gcp, k8s, local
+
+
+class TestDeployLocal:
+    def test_up_serve_down(self, tmp_path):
+        """Real e2e: up → master answers over the returned URL with an
+        agent registered → state file is idempotent → down kills it."""
+        from determined_tpu.common.ipc import free_port
+
+        port = free_port()
+        data_dir = str(tmp_path / "cluster")
+        state = local.up(data_dir, port=port, agents=1, wait_s=60)
+        try:
+            assert state["url"].endswith(str(port))
+            info = requests.get(
+                f"{state['url']}/api/v1/master", timeout=10
+            ).json()
+            assert info["cluster_id"]
+            # the deploy's agent registers
+            import time
+
+            deadline = time.time() + 30
+            agents = {}
+            while time.time() < deadline and not agents:
+                agents = requests.get(
+                    f"{state['url']}/api/v1/agents", timeout=10
+                ).json()["agents"]
+                time.sleep(0.3)
+            assert "local-0" in agents
+            # idempotent: a second up adopts the live deployment
+            again = local.up(data_dir, port=port, wait_s=10)
+            assert again["master_pid"] == state["master_pid"]
+        finally:
+            assert local.down(data_dir) is True
+        with pytest.raises(requests.ConnectionError):
+            requests.get(f"{state['url']}/api/v1/master", timeout=3)
+        assert local.read_state(data_dir) is None
+        assert local.down(data_dir) is False  # idempotent down
+
+
+class TestDeployK8s:
+    def test_manifests_cover_the_rest_driver_surface(self):
+        docs = k8s.render_manifests(namespace="ml", tls=True)
+        kinds = [d["kind"] for d in docs]
+        assert kinds == [
+            "ServiceAccount", "Role", "ClusterRole", "RoleBinding",
+            "ClusterRoleBinding", "PersistentVolumeClaim", "Deployment",
+            "Service",
+        ]
+        role = docs[1]
+        pod_rule = role["rules"][0]
+        # exactly what kube_rest.RestKubeClient calls
+        assert set(pod_rule["verbs"]) == {
+            "create", "delete", "get", "list", "watch",
+        }
+        assert role["rules"][1]["resources"] == ["pods/log"]
+        assert docs[2]["rules"][0]["resources"] == ["nodes"]
+
+        dep = docs[6]
+        spec = dep["spec"]["template"]["spec"]
+        assert dep["spec"]["replicas"] == 1  # SQLite: one writer
+        assert dep["spec"]["strategy"]["type"] == "Recreate"
+        cmd = spec["containers"][0]["command"]
+        assert "--tls" in cmd
+        pools = json.loads(cmd[cmd.index("--pools") + 1])
+        assert pools["default"]["type"] == "kubernetes"
+        assert spec["serviceAccountName"] == "determined-tpu-master"
+        probe = spec["containers"][0]["readinessProbe"]["httpGet"]
+        assert probe["scheme"] == "HTTPS"
+        for d in docs:
+            assert d["metadata"].get("namespace", "ml") == "ml" or (
+                d["kind"].startswith("Cluster")
+            )
+
+    def test_yaml_stream_parses_as_json_docs(self):
+        out = k8s.to_yaml(k8s.render_manifests())
+        docs = [json.loads(b) for b in out.split("\n---\n")]
+        assert len(docs) == 8
+
+
+class TestDeployGcp:
+    def test_commands_systemd_unit_and_auth(self):
+        ran = []
+        result = gcp.deploy(
+            project="proj", zone="us-central2-b",
+            source_ranges="10.0.0.0/8",
+            runner=lambda argv: ran.append(argv),
+        )
+        assert len(ran) == 2
+        create, firewall = ran
+        assert create[:4] == ["gcloud", "compute", "instances", "create"]
+        script = next(
+            a for a in create if a.startswith("--metadata=startup-script=")
+        )
+        assert "systemctl enable --now dtpu-master" in script
+        assert "--tls" in script              # TLS bootstrap by default
+        assert "/var/lib/dtpu/master.db" in script
+        assert "Restart=always" in script     # packaging .service parity
+        # Auth is mandatory: the generated admin password reaches both the
+        # unit args and the caller (an unauthenticated internet-reachable
+        # master would be remote code execution).
+        assert "--users" in script
+        assert result["admin_password"] in script
+        assert firewall[:4] == ["gcloud", "compute", "firewall-rules",
+                                "create"]
+        assert "--source-ranges=10.0.0.0/8" in firewall
+
+    def test_no_public_firewall_by_default(self):
+        result = gcp.deploy(
+            project="proj", zone="us-central2-b", dry_run=True,
+        )
+        assert len(result["commands"]) == 1  # create only, no 0.0.0.0/0 rule
+
+    def test_auth_cannot_be_skipped(self):
+        with pytest.raises(ValueError, match="auth"):
+            gcp.startup_script(admin_password="")
+
+    def test_cli_dry_run(self, capsys):
+        from determined_tpu.cli.cli import deploy_gcp
+
+        import argparse
+
+        deploy_gcp(argparse.Namespace(
+            project="p", zone="z", name="m1", tls=True, dry_run=True,
+            source_ranges=None,
+        ))
+        out = capsys.readouterr().out
+        assert "gcloud compute instances create m1" in out
+        assert "admin password:" in out

@@ -1,0 +1,94 @@
+"""dm-haiku integration + DARTS-style searcher benchmark (VERDICT r2
+missing #10: model_hub had only the HF adapter, and no DARTS-class
+HP-search benchmark recipe). Refs: model_hub/mmdetection/_trial.py (the
+second-adapter role), examples/hp_search_benchmarks/darts_cifar10_pytorch."""
+import json
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from determined_tpu import core
+from determined_tpu.integrations.haiku import HaikuModel, HaikuVisionTrial
+from determined_tpu.parallel.mesh import MeshConfig, make_mesh
+from determined_tpu.searcher.sample import sample
+from determined_tpu.trainer import Batch, Trainer
+
+
+class TestHaikuIntegration:
+    def test_vision_trial_trains_and_learns(self, devices8):
+        """Full Trainer drive: a haiku conv net on the class-conditioned
+        synthetic stream must beat chance accuracy after a few steps."""
+        mesh = make_mesh(MeshConfig(data=4, fsdp=2), devices=devices8)
+        trial = HaikuVisionTrial()
+        trial.hparams = {
+            "arch": "conv", "channels": 8, "depth": 2, "batch_size": 64,
+            "image_size": 16, "num_classes": 4, "lr": 3e-3,
+        }
+        trainer = Trainer(trial, core._context._dummy_init(), mesh=mesh)
+        trainer.fit(max_length=Batch(30))
+        assert trainer.steps_completed == 30
+        model = trial.build_model(mesh)
+        batch = next(iter(trial.build_validation_data()))
+        metrics = jax.jit(model.eval_metrics)(
+            trainer.state["params"], batch
+        )
+        assert float(metrics["accuracy"]) > 0.4  # chance = 0.25
+
+    def test_mlp_arch_and_fsdp_annotation(self, devices8):
+        mesh = make_mesh(MeshConfig(fsdp=8), devices=devices8)
+        trial = HaikuVisionTrial()
+        trial.hparams = {
+            "arch": "mlp", "hidden": 64, "depth": 2, "batch_size": 8,
+            "image_size": 8, "num_classes": 4,
+        }
+        model = trial.build_model(mesh)
+        params = model.init(jax.random.PRNGKey(0))
+        axes = model.logical_axes()
+        flat_axes = jax.tree.leaves(
+            axes, is_leaf=lambda x: isinstance(x, tuple)
+        )
+        # at least one 2-D weight annotated for fsdp sharding
+        assert any("embed" in a for a in flat_axes if isinstance(a, tuple))
+        loss, metrics = jax.jit(model.loss)(
+            params,
+            {"x": np.zeros((8, 8, 8, 3), np.float32),
+             "y": np.zeros((8,), np.int32)},
+            jax.random.PRNGKey(0),
+        )
+        assert np.isfinite(float(loss))
+
+
+class TestDartsBenchmark:
+    def test_space_samples_valid_genotypes(self):
+        with open("examples/darts_benchmark.json") as f:
+            cfg = json.load(f)
+        from examples.darts_benchmark_trial import OPS
+
+        rng = random.Random(0)
+        seen_ops = set()
+        for _ in range(20):
+            hp = sample(cfg["hyperparameters"], rng)
+            for k in ("op_0", "op_1", "op_2"):
+                assert hp[k] in OPS
+                seen_ops.add(hp[k])
+            assert 1e-4 <= hp["lr"] <= 1e-2
+        assert len(seen_ops) >= 4  # the space actually varies
+
+    @pytest.mark.parametrize("genotype", [
+        {"op_0": "conv3", "op_1": "skip", "op_2": "maxpool"},
+        {"op_0": "avgpool", "op_1": "conv5", "op_2": "skip"},
+    ])
+    def test_every_genotype_trains(self, devices8, genotype):
+        from examples.darts_benchmark_trial import DartsBenchmarkTrial
+
+        mesh = make_mesh(MeshConfig(data=8), devices=devices8)
+        trial = DartsBenchmarkTrial()
+        trial.hparams = {
+            **genotype, "lr": 1e-3, "channels": 8, "batch_size": 16,
+            "image_size": 16, "num_classes": 4,
+        }
+        trainer = Trainer(trial, core._context._dummy_init(), mesh=mesh)
+        trainer.fit(max_length=Batch(2))
+        assert trainer.steps_completed == 2
