@@ -494,25 +494,40 @@ def deploy_local_down(args: argparse.Namespace) -> None:
 
 
 def deploy_k8s(args: argparse.Namespace) -> None:
+    import secrets
+    import sys as sys_mod
+
     from determined_tpu.deploy import k8s as deploy_k8s_mod
 
+    password = args.admin_password or secrets.token_urlsafe(12)
     print(deploy_k8s_mod.to_yaml(deploy_k8s_mod.render_manifests(
         namespace=args.namespace, image=args.image, port=args.port,
-        tls=args.tls,
+        tls=args.tls, admin_password=password,
     )), end="")
+    # stderr so the credential never lands in the piped manifest file
+    print(
+        f"admin password: {password}  (login: admin)", file=sys_mod.stderr
+    )
 
 
 def deploy_gcp(args: argparse.Namespace) -> None:
+    import secrets
+
     from determined_tpu.deploy import gcp as deploy_gcp_mod
 
+    # Generate + surface the credential BEFORE any gcloud runs: a failure
+    # mid-deploy (e.g. firewall rule exists) must not leave a running VM
+    # whose admin password the operator never saw.
+    password = secrets.token_urlsafe(12)
+    print(f"admin password: {password}  (login: admin)")
     result = deploy_gcp_mod.deploy(
         project=args.project, zone=args.zone, name=args.name,
         tls=args.tls, dry_run=args.dry_run,
         source_ranges=args.source_ranges or "",
+        admin_password=password,
     )
     for line in result["commands"]:
         print(line)
-    print(f"admin password: {result['admin_password']}  (login: admin)")
 
 
 # -- daemons ------------------------------------------------------------------
@@ -704,6 +719,9 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("--image", default="determined-tpu:latest")
     v.add_argument("--port", type=int, default=8080)
     v.add_argument("--tls", action="store_true")
+    v.add_argument("--admin-password", default=None,
+                   help="admin credential baked into the Secret "
+                        "(generated and printed to stderr if omitted)")
     v.set_defaults(fn=deploy_k8s)
     v = deploy.add_parser("gcp")
     v.add_argument("--project", required=True)

@@ -49,9 +49,11 @@ def startup_script(
             "a GCP-deployed master must boot with auth enabled; pass "
             "admin_password (deploy() generates one)"
         )
-    users = shlex.quote(
-        '{"admin": "%s"}' % admin_password.replace('"', "")
-    )
+    import json as json_mod
+
+    # json.dumps, not string formatting: the credential baked into the VM
+    # must be byte-identical to the one returned to the operator.
+    users = shlex.quote(json_mod.dumps({"admin": admin_password}))
     args = (
         f"--host 0.0.0.0 --port {port} --db /var/lib/dtpu/master.db "
         f"--users {users}"

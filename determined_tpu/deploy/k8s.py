@@ -24,8 +24,21 @@ def render_manifests(
     tls: bool = False,
     storage: str = "8Gi",
     service_type: str = "ClusterIP",
+    admin_password: str = "",
 ) -> List[Dict[str, Any]]:
-    """The full master stack as Kubernetes API objects, in apply order."""
+    """The full master stack as Kubernetes API objects, in apply order.
+
+    admin_password is MANDATORY: this master holds pod-create RBAC and is
+    reachable from every workload via the Service — running it with auth
+    disabled would hand any pod in the cluster arbitrary pod execution
+    (the same exposure gcp.py refuses). Delivered as a Secret → env
+    (DTPU_USERS), never on the pod command line.
+    """
+    if not admin_password:
+        raise ValueError(
+            "a cluster-deployed master must boot with auth enabled; pass "
+            "admin_password (the CLI generates one)"
+        )
     meta = lambda name: {  # noqa: E731
         "name": name, "namespace": namespace, "labels": dict(APP_LABELS),
     }
@@ -103,6 +116,19 @@ def render_manifests(
             "namespace": namespace,
         }],
     }
+    import base64
+
+    secret = {
+        "apiVersion": "v1",
+        "kind": "Secret",
+        "metadata": meta("determined-tpu-master-users"),
+        "type": "Opaque",
+        "data": {
+            "users": base64.b64encode(
+                json.dumps({"admin": admin_password}).encode()
+            ).decode(),
+        },
+    }
     pvc = {
         "apiVersion": "v1",
         "kind": "PersistentVolumeClaim",
@@ -140,6 +166,13 @@ def render_manifests(
                         "command": [
                             "python", "-m", "determined_tpu.master.main",
                         ] + args,
+                        "env": [{
+                            "name": "DTPU_USERS",
+                            "valueFrom": {"secretKeyRef": {
+                                "name": "determined-tpu-master-users",
+                                "key": "users",
+                            }},
+                        }],
                         "ports": [{"containerPort": port}],
                         "volumeMounts": [
                             {"name": "db", "mountPath": "/data"}
@@ -173,7 +206,7 @@ def render_manifests(
             "ports": [{"port": port, "targetPort": port}],
         },
     }
-    return [sa, role, cluster_role, binding, cluster_binding, pvc,
+    return [sa, role, cluster_role, binding, cluster_binding, secret, pvc,
             deployment, service]
 
 

@@ -31,7 +31,11 @@ def read_state(data_dir: str) -> Optional[Dict[str, Any]]:
         return None
 
 
-def _alive(pid: int) -> bool:
+def _alive(pid: Any) -> bool:
+    if not isinstance(pid, int) or pid <= 0:
+        # Guard hard: os.kill(-1, 0)/waitpid(-1) address EVERY process —
+        # a malformed state file must read as "not alive", not "all alive".
+        return False
     # Reap first: when up() and down() share a process (library use), the
     # SIGTERM'd children become zombies of this process and kill(pid, 0)
     # would report them alive for the whole grace period.
@@ -43,6 +47,19 @@ def _alive(pid: int) -> bool:
         os.kill(pid, 0)
         return True
     except (ProcessLookupError, PermissionError):
+        return False
+
+
+def _is_ours(pid: Any) -> bool:
+    """True only if `pid` is alive AND still runs determined_tpu code —
+    state files survive reboots, PIDs get recycled, and down() must never
+    killpg an unrelated process group."""
+    if not _alive(pid):
+        return False
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return b"determined_tpu" in f.read()
+    except OSError:
         return False
 
 
@@ -64,7 +81,7 @@ def up(
     data_dir = os.path.abspath(data_dir)
     os.makedirs(data_dir, exist_ok=True)
     prev = read_state(data_dir)
-    if prev and _alive(prev.get("master_pid", -1)):
+    if prev and _is_ours(prev.get("master_pid")):
         return prev
 
     base_env = dict(os.environ)
@@ -158,7 +175,7 @@ def down(data_dir: str, *, grace_s: float = 10.0) -> bool:
     if not state:
         return False
     pids = [state.get("master_pid")] + list(state.get("agent_pids", []))
-    pids = [p for p in pids if p and _alive(p)]
+    pids = [p for p in pids if _is_ours(p)]
     for pid in pids:
         try:
             os.killpg(pid, signal.SIGTERM)

@@ -78,9 +78,13 @@ class HaikuModel(Model):
     def apply(self, params, x: jax.Array) -> jax.Array:
         return self._t.apply(params, None, x, False)
 
-    def loss(self, params, batch, rng) -> Tuple[jax.Array, Metrics]:
+    def _loss_impl(
+        self, params, batch, rng, is_training: bool
+    ) -> Tuple[jax.Array, Metrics]:
         x, y = batch["x"], batch["y"]
-        logits = self._t.apply(params, rng, x, True).astype(jnp.float32)
+        logits = self._t.apply(params, rng, x, is_training).astype(
+            jnp.float32
+        )
         mask = batch.get("loss_mask")
         mask = (
             jnp.ones(y.shape, jnp.float32) if mask is None
@@ -92,6 +96,18 @@ class HaikuModel(Model):
         loss = jnp.sum((lse - tgt) * mask) / n
         acc = jnp.sum((jnp.argmax(logits, -1) == y) * mask) / n
         return loss, {"loss": loss, "accuracy": acc}
+
+    def loss(self, params, batch, rng) -> Tuple[jax.Array, Metrics]:
+        return self._loss_impl(params, batch, rng, True)
+
+    def eval_metrics(self, params, batch) -> Metrics:
+        # is_training=False: the base default would re-run loss() in
+        # training mode, leaving dropout active during validation — rung
+        # promotions would ride noisy training-mode metrics.
+        loss, metrics = self._loss_impl(
+            params, batch, jax.random.PRNGKey(0), False
+        )
+        return dict(metrics, loss=loss)
 
 
 def _mlp_mixer_ish(hidden: int, depth: int, num_classes: int):

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import logging
 import signal
 import threading
@@ -52,8 +53,13 @@ def main() -> None:
     parser.add_argument(
         "--users", default=None,
         help='JSON {"username": "password", ...}: enables auth with these '
-             "accounts (first user should be the admin; roles via the API)")
+             "accounts (first user should be the admin; roles via the API). "
+             "Falls back to the DTPU_USERS env var — the k8s deployment "
+             "injects credentials that way (Secret → env), keeping them "
+             "out of the pod spec's command line.")
     args = parser.parse_args()
+    if args.users is None:
+        args.users = os.environ.get("DTPU_USERS") or None
     logging.basicConfig(level=logging.INFO)
 
     pools = json.loads(args.pools) if args.pools else None
@@ -75,7 +81,6 @@ def main() -> None:
         if args.tls_cert:
             tls = (args.tls_cert, args.tls_key)
         else:
-            import os
             from urllib.parse import urlparse
 
             from determined_tpu.common.tls import generate_self_signed

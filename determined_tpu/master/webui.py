@@ -92,6 +92,15 @@ async function post(path, body) {
   return r;
 }
 
+// Experiment lifecycle actions (the ExperimentDetails action bar):
+// pause/activate/cancel/kill through the same API the CLI uses. The UI is
+// no longer read-only.
+async function expAction(id, action) {
+  if (action === 'kill' && !confirm(`kill experiment ${id}?`)) return;
+  await post(`/api/v1/experiments/${id}/${action}`);
+  refresh();
+}
+
 // Queue move-ahead (the JobQueue page's drag-to-reorder, as a button).
 // Pending entries are kept in a global and addressed by index so no
 // server-provided string is ever interpolated into a JS handler.
@@ -354,10 +363,18 @@ async function refresh() {
       '<tr><th>id</th><th>state</th><th>progress</th><th>searcher</th><th></th></tr>' +
       exps.map(e => {
         const pct = Math.round((e.progress || 0) * 100);
+        const act = e.state === 'ACTIVE'
+          ? `<button onclick="expAction(${e.id},'pause')">pause</button>`
+          : (e.state === 'PAUSED'
+             ? `<button onclick="expAction(${e.id},'activate')">activate</button>`
+             : '');
+        const kill = ['COMPLETED', 'CANCELED', 'ERRORED'].includes(e.state)
+          ? '' : ` <button onclick="expAction(${e.id},'kill')">kill</button>`;
         return `<tr>${cell(e.id)}${state(e.state)}` +
           `<td><span class="bar"><div style="width:${pct}%"></div></span> ${pct}%</td>` +
           cell((e.config.searcher || {}).name || '') +
-          `<td><button onclick="selExp=${e.id};refresh()">trials</button></td></tr>`;
+          `<td><button onclick="selExp=${e.id};refresh()">trials</button> ` +
+          `${act}${kill}</td></tr>`;
       }).join('');
 
     if (selExp !== null) {

@@ -52,14 +52,27 @@ class TestDeployLocal:
 
 
 class TestDeployK8s:
+    def test_auth_cannot_be_skipped(self):
+        """A master with pod-create RBAC reachable by every workload must
+        not boot unauthenticated (same posture as the GCP path)."""
+        with pytest.raises(ValueError, match="auth"):
+            k8s.render_manifests()
+
     def test_manifests_cover_the_rest_driver_surface(self):
-        docs = k8s.render_manifests(namespace="ml", tls=True)
+        docs = k8s.render_manifests(
+            namespace="ml", tls=True, admin_password="pw-1"
+        )
         kinds = [d["kind"] for d in docs]
         assert kinds == [
             "ServiceAccount", "Role", "ClusterRole", "RoleBinding",
-            "ClusterRoleBinding", "PersistentVolumeClaim", "Deployment",
-            "Service",
+            "ClusterRoleBinding", "Secret", "PersistentVolumeClaim",
+            "Deployment", "Service",
         ]
+        import base64
+
+        secret = docs[5]
+        users = json.loads(base64.b64decode(secret["data"]["users"]))
+        assert users == {"admin": "pw-1"}
         role = docs[1]
         pod_rule = role["rules"][0]
         # exactly what kube_rest.RestKubeClient calls
@@ -69,7 +82,7 @@ class TestDeployK8s:
         assert role["rules"][1]["resources"] == ["pods/log"]
         assert docs[2]["rules"][0]["resources"] == ["nodes"]
 
-        dep = docs[6]
+        dep = docs[7]
         spec = dep["spec"]["template"]["spec"]
         assert dep["spec"]["replicas"] == 1  # SQLite: one writer
         assert dep["spec"]["strategy"]["type"] == "Recreate"
@@ -86,9 +99,9 @@ class TestDeployK8s:
             )
 
     def test_yaml_stream_parses_as_json_docs(self):
-        out = k8s.to_yaml(k8s.render_manifests())
+        out = k8s.to_yaml(k8s.render_manifests(admin_password="x"))
         docs = [json.loads(b) for b in out.split("\n---\n")]
-        assert len(docs) == 8
+        assert len(docs) == 9
 
 
 class TestDeployGcp:

@@ -27,9 +27,28 @@ class TestWebUI:
         html = requests.get(f"{api.url}/ui", timeout=10).text
         for marker in (
             "Job queue", "Profiler", "Workspaces", "Models",
-            "queueFront", "renderQueues", "profiling",
+            "queueFront", "renderQueues", "profiling", "expAction",
         ):
             assert marker in html, marker
+
+    def test_experiment_actions_the_buttons_call(self, live):
+        """The pause/activate/kill endpoints the UI's action buttons hit."""
+        master, api = live
+        eid = master.create_experiment({
+            "entrypoint": "x:y", "unmanaged": True,
+            "searcher": {"name": "single", "max_length": 5,
+                         "metric": "loss"},
+            "hyperparameters": {"lr": 0.1},
+        })
+        for action, want in (("pause", "PAUSED"), ("activate", "ACTIVE"),
+                             ("kill", "CANCELED")):
+            requests.post(
+                f"{api.url}/api/v1/experiments/{eid}/{action}", timeout=10
+            ).raise_for_status()
+            got = requests.get(
+                f"{api.url}/api/v1/experiments/{eid}", timeout=10
+            ).json()["state"]
+            assert got == want, (action, got)
 
     def test_endpoints_the_page_polls(self, live):
         """Every fetch the page's refresh() makes must return the shape the
