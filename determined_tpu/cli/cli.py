@@ -495,7 +495,6 @@ def deploy_local_down(args: argparse.Namespace) -> None:
 
 def deploy_k8s(args: argparse.Namespace) -> None:
     import secrets
-    import sys as sys_mod
 
     from determined_tpu.deploy import k8s as deploy_k8s_mod
 
@@ -505,9 +504,7 @@ def deploy_k8s(args: argparse.Namespace) -> None:
         tls=args.tls, admin_password=password,
     )), end="")
     # stderr so the credential never lands in the piped manifest file
-    print(
-        f"admin password: {password}  (login: admin)", file=sys_mod.stderr
-    )
+    print(f"admin password: {password}  (login: admin)", file=sys.stderr)
 
 
 def deploy_gcp(args: argparse.Namespace) -> None:

@@ -102,12 +102,48 @@ def _maybe_init_jax_distributed(info: _info.ClusterInfo) -> None:
     )
 
 
+def _install_debug_hooks() -> None:
+    """Live-debug probes for wedged trials (ref core/_context.py:102
+    `_install_stacktrace_on_sigusr1` + the harness debug flag):
+
+    - SIGUSR1 → dump EVERY thread's stack to stderr without killing the
+      process (`kill -USR1 <pid>` from a `dtpu shell`): shows where the
+      step loop / async checkpoint writer / IPC threads are stuck — the
+      race-detection probe for distributed hangs. faulthandler covers all
+      threads where the reference printed only the signaled frame.
+    - DTPU_DEBUG=1 → DEBUG-level logging and jax compile logging, the
+      `--debug` trace mode analog.
+    """
+    import faulthandler
+    import signal as signal_mod
+
+    if hasattr(signal_mod, "SIGUSR1"):
+        try:
+            # chain=False: SIGUSR1's DEFAULT disposition is terminate, so
+            # chaining would dump the stacks and then kill the process —
+            # the probe must leave the trial running.
+            faulthandler.register(
+                signal_mod.SIGUSR1, all_threads=True, chain=False
+            )
+        except (ValueError, RuntimeError):
+            pass  # non-main thread / exotic runtime: probe is best-effort
+    if os.environ.get("DTPU_DEBUG"):
+        logging.getLogger("determined_tpu").setLevel(logging.DEBUG)
+        try:
+            import jax
+
+            jax.config.update("jax_log_compiles", True)
+        except Exception:  # noqa: BLE001 — debug aid must never break init
+            pass
+
+
 def init(
     *,
     distributed: Optional[DistributedContext] = None,
     checkpoint_storage: Optional[str] = None,
     preempt_mode: PreemptMode = PreemptMode.ChiefOnly,
 ) -> Context:
+    _install_debug_hooks()
     info = _info.get_cluster_info()
     if info is None:
         logger.info("no cluster detected; core.init() in dummy (off-cluster) mode")

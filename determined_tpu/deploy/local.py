@@ -59,6 +59,14 @@ def _is_ours(pid: Any) -> bool:
     try:
         with open(f"/proc/{pid}/cmdline", "rb") as f:
             return b"determined_tpu" in f.read()
+    except FileNotFoundError:
+        if os.path.isdir("/proc"):
+            return False  # Linux, pid vanished between checks
+        # No /proc (macOS/BSD): fall back to the liveness check alone —
+        # refusing to signal would orphan live clusters (down() deletes
+        # the state file either way), which is worse than the recycled-PID
+        # risk the cmdline check guards against.
+        return True
     except OSError:
         return False
 

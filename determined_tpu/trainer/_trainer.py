@@ -50,6 +50,7 @@ from determined_tpu.trainer._units import Batch, TrainUnit, to_batches
 logger = logging.getLogger("determined_tpu.trainer")
 
 TRAINER_METADATA = "trainer_state.json"
+ORBAX_SUBDIR = "orbax"  # presence marks an orbax/ocdbt-format checkpoint
 
 
 class Trainer:
@@ -65,12 +66,36 @@ class Trainer:
         smaller_is_better: bool = True,
         profiling: bool = False,
         tensorboard_dir: Optional[str] = None,
+        checkpoint_format: str = "npy",
     ) -> None:
         self.trial = trial
         self.core = core_context or core_mod.init()
         self.mesh = mesh if mesh is not None else make_mesh()
         self.rules = rules
         self.seed = seed
+        # "npy": keypath-named .npy files + lazy per-device restore
+        # (trainer/_checkpoint.py — transparent, multi-host shard-upload).
+        # "orbax": orbax/ocdbt layout for JAX-ecosystem interchange (other
+        # tools can open the checkpoint); restore places directly onto the
+        # mesh via abstract ShapeDtypeStructs. Orbax's multi-host writers
+        # assume one shared directory, which the upload-per-host storage
+        # flow doesn't provide — hence single-process only.
+        if checkpoint_format not in ("npy", "orbax"):
+            # ValueError, not assert: user input must not silently fall
+            # through to the npy path under python -O.
+            raise ValueError(
+                f"checkpoint_format {checkpoint_format!r} "
+                "(one of: npy, orbax)"
+            )
+        if checkpoint_format == "orbax" and (
+            jax.process_count() > 1 or self.core.distributed.size > 1
+        ):
+            raise ValueError(
+                "checkpoint_format='orbax' is single-process only (orbax "
+                "multi-host writes need one shared dir); use 'npy' for "
+                "sharded multi-host checkpoints"
+            )
+        self.checkpoint_format = checkpoint_format
         self.searcher_metric = searcher_metric
         self.smaller_is_better = smaller_is_better
 
@@ -242,7 +267,14 @@ class Trainer:
         # copies of model+optimizer state can OOM the host.
         self._ckpt_writer.wait()
         steps = self.steps_completed
-        snapshot = ckpt_io.snapshot_pytree(self.state)
+        use_orbax = self.checkpoint_format == "orbax"
+        if use_orbax:
+            # Full host copy (nested, not keypath-flat): orbax serializes
+            # the tree itself. device_get BEFORE submit — the step loop
+            # donates the device buffers.
+            snapshot = jax.device_get(self.state)
+        else:
+            snapshot = ckpt_io.snapshot_pytree(self.state)
         sharded = jax.process_count() > 1 or self.core.distributed.size > 1
         is_chief = self.core.distributed.is_chief
         checkpoint_ctx = self.core.checkpoint
@@ -250,11 +282,21 @@ class Trainer:
 
         def work() -> str:
             with tempfile.TemporaryDirectory() as tmp:
-                written = ckpt_io.write_snapshot(snapshot, tmp)
+                if use_orbax:
+                    import orbax.checkpoint as ocp
+
+                    ckptr = ocp.StandardCheckpointer()
+                    ckptr.save(os.path.join(tmp, ORBAX_SUBDIR), snapshot)
+                    ckptr.wait_until_finished()
+                    ckptr.close()
+                    written = None  # recursive walk picks up ocdbt layout
+                else:
+                    written = ckpt_io.write_snapshot(snapshot, tmp)
                 if is_chief:
                     with open(os.path.join(tmp, TRAINER_METADATA), "w") as f:
                         json.dump({"steps_completed": steps, "seed": seed}, f)
-                    written.append(TRAINER_METADATA)
+                    if written is not None:
+                        written.append(TRAINER_METADATA)
                 storage_id = checkpoint_ctx.upload(
                     tmp,
                     metadata={"steps_completed": steps},
@@ -272,9 +314,26 @@ class Trainer:
     def _restore_checkpoint(self, storage_id: str) -> None:
         self._ckpt_writer.wait()  # never read while a save is in flight
         state = self.state  # materialize to know structure + shardings
-        shardings = jax.tree.map(lambda x: x.sharding, state)
         with self.core.checkpoint.restore_path(storage_id) as path:
-            self._state = ckpt_io.load_pytree(path, state, shardings)
+            orbax_dir = os.path.join(path, ORBAX_SUBDIR)
+            if os.path.isdir(orbax_dir):
+                # Format is a property of the CHECKPOINT, not the config:
+                # a trial restarted with a different checkpoint_format must
+                # still restore what it saved.
+                import orbax.checkpoint as ocp
+
+                abstract = jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(
+                        x.shape, x.dtype, sharding=x.sharding
+                    ),
+                    state,
+                )
+                ckptr = ocp.StandardCheckpointer()
+                self._state = ckptr.restore(orbax_dir, abstract)
+                ckptr.close()
+            else:
+                shardings = jax.tree.map(lambda x: x.sharding, state)
+                self._state = ckpt_io.load_pytree(path, state, shardings)
         logger.info(
             "restored checkpoint %s at step %d", storage_id, self.steps_completed
         )

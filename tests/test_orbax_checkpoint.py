@@ -1,0 +1,108 @@
+"""orbax/ocdbt checkpoint format (STATUS known gap): JAX-ecosystem
+interchange layout as an alternative to the native keypath-.npy format,
+restored directly onto the mesh via abstract ShapeDtypeStructs."""
+import itertools
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from determined_tpu import core
+from determined_tpu.parallel.mesh import MeshConfig, make_mesh
+from determined_tpu.trainer import Batch, JAXTrial, Trainer
+
+
+class _XorTrial(JAXTrial):
+    def build_model(self, mesh):
+        from determined_tpu.models import get_model
+
+        return get_model("mnist-mlp", mesh=mesh, hidden=8)
+
+    def build_optimizer(self):
+        return optax.adam(1e-2)
+
+    def _stream(self, seed):
+        rng = np.random.default_rng(seed)
+        while True:
+            x = rng.integers(0, 2, (16, 784)).astype(np.float32)
+            y = (x[:, 0].astype(np.int32) ^ x[:, 1].astype(np.int32))
+            yield {"image": x, "label": y}
+
+    def build_training_data(self):
+        return self._stream(0)
+
+    def build_validation_data(self):
+        return list(itertools.islice(self._stream(1), 2))
+
+
+def _ctx(tmp_path):
+    return core._context._dummy_init(checkpoint_storage=str(tmp_path))
+
+
+class TestOrbaxFormat:
+    def test_resume_exact_and_layout(self, tmp_path):
+        ctx = _ctx(tmp_path / "a")
+        t1 = Trainer(_XorTrial(), ctx, seed=7, checkpoint_format="orbax")
+        t1.fit(max_length=Batch(10))
+        sid = t1._save_checkpoint(sync=True)
+        assert sid is not None
+        # the stored checkpoint is genuinely orbax-format (other JAX tools
+        # can open it)
+        import os
+
+        stored = os.path.join(str(tmp_path / "a"), sid, "orbax")
+        assert os.path.isdir(stored)
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        raw = ckptr.restore(stored)
+        ckptr.close()
+        assert "params" in raw and int(raw["step"]) == 10
+
+        # straight-through vs save/resume parity
+        t2 = Trainer(
+            _XorTrial(), _ctx(tmp_path / "b"), seed=7,
+            checkpoint_format="orbax",
+        )
+        t2.fit(max_length=Batch(20))
+        straight = jax.device_get(t2.state["params"])
+
+        t3 = Trainer(_XorTrial(), ctx, seed=7, checkpoint_format="orbax")
+        t3.fit(max_length=Batch(20), latest_checkpoint=sid)
+        resumed = jax.device_get(t3.state["params"])
+        for a, b in zip(
+            jax.tree_util.tree_leaves(straight),
+            jax.tree_util.tree_leaves(resumed),
+        ):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    def test_restore_places_on_mesh(self, devices8, tmp_path):
+        """Restore goes straight to the live shardings (abstract targets),
+        including from an npy-config trainer reading an orbax checkpoint —
+        the format is detected from the checkpoint, not the config."""
+        mesh = make_mesh(MeshConfig(data=4, fsdp=2), devices=devices8)
+        ctx = _ctx(tmp_path)
+        t1 = Trainer(
+            _XorTrial(), ctx, seed=1, mesh=mesh, checkpoint_format="orbax"
+        )
+        t1.fit(max_length=Batch(3))
+        sid = t1._save_checkpoint(sync=True)
+
+        t2 = Trainer(_XorTrial(), ctx, seed=1, mesh=mesh)  # npy config
+        t2.fit(max_length=Batch(3), latest_checkpoint=sid)
+        for leaf in jax.tree_util.tree_leaves(t2.state["params"]):
+            assert leaf.sharding.mesh.shape["fsdp"] == 2
+
+    def test_orbax_rejected_multiprocess(self, tmp_path):
+        from determined_tpu.core._distributed import DistributedContext
+
+        class _FakeDist:
+            size = 4
+            rank = 0
+            is_chief = True
+
+        ctx = _ctx(tmp_path)
+        ctx.distributed = _FakeDist()
+        with pytest.raises(ValueError, match="single-process"):
+            Trainer(_XorTrial(), ctx, checkpoint_format="orbax")
