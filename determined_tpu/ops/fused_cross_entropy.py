@@ -1,0 +1,195 @@
+"""Chunked cross-entropy: the LM loss without materializing [T, V] logits.
+
+The GPT-2 bench's largest HBM cost is the vocab projection: logits
+[B·S, 50304] cost ~1.6GB in bf16, and the naive loss touches them several
+times (fp32 cast, logsumexp read, target gather, argmax, then a full fp32
+d_logits materialization in the backward) — ~half the step's 17GB of HBM
+traffic on a v5e chip. This op streams VOCAB CHUNKS through one lax.scan:
+
+- forward: online logsumexp (flash-attention-style running max/sum),
+  target-logit and argmax tracked per chunk — residuals are O(T), never
+  O(T·V);
+- backward (custom_vjp): recompute each chunk's logits, form
+  d_logits_chunk = coef·softmax − mask·onehot in registers, and contract
+  immediately into dx / dW — d_logits never hits HBM whole.
+
+The objective matches models/gpt.py `_aligned_token_sums` exactly:
+  obj = Σ mask·(lse − target_logit) + z_loss·Σ mask·lse²
+with aux sums (nll, z, correct, n) for metrics.
+
+MXU notes: each chunk matmul is [T, D] × [D, V/C] — still large, batched,
+bf16 (f32 accumulation via preferred_element_type). The default
+target_chunk=8192 yields C=6 chunks of 8384 at GPT-2's padded vocab
+(50304), keeping every per-chunk matmul ≥8k wide.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _chunk_count(vocab: int, target_chunk: int = 8192) -> int:
+    """Largest chunk count ≤ vocab/target that divides the vocab evenly.
+
+    Falls back to 1 when no nearby divisor exists (e.g. the UNPADDED GPT-2
+    vocab 50257 = 29·1733) — which makes the op pointless (one chunk IS
+    the dense logits, plus the backward recompute), so it warns: pad the
+    vocab to a 128-multiple (gpt.py's configs already do)."""
+    for c in range(max(1, round(vocab / target_chunk)), 1, -1):
+        if vocab % c == 0:
+            return c
+    if vocab > target_chunk:
+        import logging
+
+        logging.getLogger("determined_tpu").warning(
+            "fused cross-entropy: vocab %d has no chunk count near "
+            "%d-wide chunks; running UNCHUNKED (no memory savings, extra "
+            "backward recompute) — pad the vocab to a composite size",
+            vocab, target_chunk,
+        )
+    return 1
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def fused_ce_sums(
+    x: jax.Array,        # [T, D] compute dtype (post-final-layernorm)
+    w: jax.Array,        # [D, V] compute dtype (lm head / tied embed.T)
+    targets: jax.Array,  # [T] int32
+    mask: jax.Array,     # [T] float32
+    z_loss: float,
+    n_chunks: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """→ (objective_sum, aux [nll_sum, z_sum, acc_sum, n])."""
+    obj, aux, _ = _forward(x, w, targets, mask, z_loss, n_chunks)
+    return obj, aux
+
+
+def _forward(x, w, targets, mask, z_loss, n_chunks):
+    t = x.shape[0]
+    vocab = w.shape[1]
+    vc = vocab // n_chunks
+    neg = jnp.float32(-1e30)
+
+    def chunk(carry, c):
+        m, s, tl, best_v, best_i = carry
+        w_c = lax.dynamic_slice_in_dim(w, c * vc, vc, axis=1)
+        logits = jnp.dot(
+            x, w_c, preferred_element_type=jnp.float32
+        )  # [T, vc] f32 accumulation on the MXU
+        cmax = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, cmax)
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1
+        )
+        # target logit, if this chunk holds it
+        idx = targets - c * vc
+        in_chunk = (idx >= 0) & (idx < vc)
+        got = jnp.take_along_axis(
+            logits, jnp.clip(idx, 0, vc - 1)[:, None], axis=-1
+        )[:, 0]
+        tl = jnp.where(in_chunk, got, tl)
+        # running argmax (for the accuracy metric)
+        ci = jnp.argmax(logits, axis=-1)
+        cv = jnp.take_along_axis(logits, ci[:, None], axis=-1)[:, 0]
+        better = cv > best_v
+        best_v = jnp.where(better, cv, best_v)
+        best_i = jnp.where(better, ci + c * vc, best_i)
+        return (m_new, s, tl, best_v, best_i), None
+
+    init = (
+        jnp.full((t,), neg), jnp.zeros((t,), jnp.float32),
+        jnp.full((t,), neg), jnp.full((t,), neg),
+        jnp.zeros((t,), jnp.int32),
+    )
+    # unroll: straight-line chunks let XLA overlap the matmuls instead of
+    # pipeline-stalling the MXU on the scan's loop-carried dependency.
+    (m, s, tl, _bv, bi), _ = lax.scan(
+        chunk, init, jnp.arange(n_chunks), unroll=True
+    )
+    lse = m + jnp.log(s)
+    nll_sum = jnp.sum((lse - tl) * mask)
+    z_sum = jnp.sum(jnp.square(lse) * mask)
+    acc_sum = jnp.sum((bi == targets) * mask)
+    n = jnp.sum(mask)
+    obj = nll_sum + jnp.float32(z_loss) * z_sum
+    aux = jnp.stack([nll_sum, z_sum, acc_sum, n])
+    return obj, aux, (lse, tl)
+
+
+def _fwd(x, w, targets, mask, z_loss, n_chunks):
+    obj, aux, (lse, tl) = _forward(x, w, targets, mask, z_loss, n_chunks)
+    return (obj, aux), (x, w, targets, mask, lse)
+
+
+def _bwd(z_loss, n_chunks, res, cots):
+    x, w, targets, mask, lse = res
+    g_obj, _g_aux = cots  # aux sums are metrics; never differentiated
+    vocab = w.shape[1]
+    vc = vocab // n_chunks
+    # d obj / d logit_v = mask·(1 + 2z·lse)·softmax_v − mask·1[v = target]
+    coef = (g_obj * mask * (1.0 + 2.0 * jnp.float32(z_loss) * lse)).astype(
+        jnp.float32
+    )
+    tcoef = g_obj * mask
+
+    def chunk(carry, c):
+        dx = carry
+        w_c = lax.dynamic_slice_in_dim(w, c * vc, vc, axis=1)
+        logits = jnp.dot(x, w_c, preferred_element_type=jnp.float32)
+        p = jnp.exp(logits - lse[:, None])
+        idx = targets - c * vc
+        in_chunk = (idx >= 0) & (idx < vc)
+        onehot = (
+            jax.nn.one_hot(jnp.clip(idx, 0, vc - 1), vc, dtype=jnp.float32)
+            * in_chunk[:, None]
+        )
+        dl = (coef[:, None] * p - tcoef[:, None] * onehot).astype(x.dtype)
+        dx = dx + jnp.dot(dl, w_c.T, preferred_element_type=jnp.float32)
+        dw_c = jnp.dot(x.T, dl, preferred_element_type=jnp.float32)
+        return dx, dw_c.astype(w.dtype)
+
+    dx0 = jnp.zeros(x.shape, jnp.float32)
+    dx, dw_chunks = lax.scan(
+        chunk, dx0, jnp.arange(n_chunks), unroll=True
+    )
+    # stacked per-chunk [C, D, vc] → [D, V]
+    dw = jnp.moveaxis(dw_chunks, 0, 1).reshape(w.shape[0], vocab)
+    return (
+        dx.astype(x.dtype),
+        dw,
+        np.zeros(targets.shape, jax.dtypes.float0),  # int: no cotangent
+        jnp.zeros_like(mask),
+    )
+
+
+fused_ce_sums.defvjp(_fwd, _bwd)
+
+
+def fused_next_token_sums(
+    x: jax.Array,        # [B, S, D] hidden states AFTER final layernorm
+    w: jax.Array,        # [D, V]
+    targets: jax.Array,  # [B, S] int32 — already aligned (position i → targets[i])
+    mask: jax.Array,     # [B, S] float32
+    *,
+    z_loss: float = 0.0,
+    target_chunk: int = 8192,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """→ (obj_sum, nll_sum, z_sum, acc_sum, n) — the drop-in chunked form
+    of _aligned_token_sums ∘ _head_raw's einsum (layernorm stays with the
+    caller)."""
+    b, s, d = x.shape
+    n_chunks = _chunk_count(w.shape[1], target_chunk)
+    obj, aux = fused_ce_sums(
+        x.reshape(b * s, d),
+        w,
+        targets.reshape(-1),
+        mask.reshape(-1).astype(jnp.float32),
+        float(z_loss),
+        n_chunks,
+    )
+    return obj, aux[0], aux[1], aux[2], aux[3]
