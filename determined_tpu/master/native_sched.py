@@ -1,0 +1,186 @@
+"""ctypes wrapper for the native gang-fitting scan (native/scheduler.cpp).
+
+Same lazy-build discipline as the data loader (data/native.py): g++ the
+.so on first use into native/_build, fall back to the pure-python fit when
+no compiler is available. `scheduler.fit` dispatches here and asserts
+nothing about availability — the python implementation remains the
+semantic reference (tests assert bit-equivalence over randomized states).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(_REPO_ROOT, "native", "scheduler.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "_build")
+_SO = os.path.join(_BUILD_DIR, "libdtpu_scheduler.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+UNAVAILABLE = object()  # sentinel: caller must run the python fit
+
+
+def warm() -> None:
+    """Kick the (one-time) g++ build on a background thread so the first
+    RM tick never compiles under the scheduling lock."""
+    threading.Thread(target=load_library, name="sched-warm", daemon=True).start()
+
+
+def _build() -> Optional[str]:
+    # Every failure mode — missing source, read-only checkout, no
+    # compiler, a partial .so from a killed build — must mean "python
+    # fallback", never an exception into the RM tick.
+    try:
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        if os.path.exists(_SO) and (
+            os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+        ):
+            return _SO
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        so = _build()
+        if so is None:
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            _build_failed = True  # corrupt .so (killed build): stay python
+            return None
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.sched_fit.restype = ctypes.c_int32
+        lib.sched_fit.argtypes = [
+            ctypes.c_int32, i32p, i32p, u8p, u8p, i32p, ctypes.c_int32,
+            i32p, i32p,
+        ]
+        lib.sched_fit_batch.restype = ctypes.c_int32
+        lib.sched_fit_batch.argtypes = [
+            ctypes.c_int32, i32p, i32p, u8p, u8p, i32p,
+            ctypes.c_int32, i32p, ctypes.c_int32, i32p, i32p, i32p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def _marshal(agents: Dict[str, "object"]):
+    items = list(agents.values())
+    n = len(items)
+    ids = [a.id for a in items]
+    free = np.fromiter((a.free for a in items), np.int32, count=n)
+    slots = np.fromiter((a.slots for a in items), np.int32, count=n)
+    enabled = np.fromiter((a.enabled for a in items), np.uint8, count=n)
+    idle = np.fromiter((a.idle for a in items), np.uint8, count=n)
+    order = sorted(range(n), key=lambda i: ids[i])
+    id_rank = np.empty(n, np.int32)
+    for rank, i in enumerate(order):
+        id_rank[i] = rank
+    return ids, free, slots, enabled, idle, id_rank
+
+
+def try_fit_batch(
+    request_slots_list, agents: Dict[str, "object"], *, stop_on_fail: bool
+):
+    """Place a whole tick's pending queue in ONE native call — the unit at
+    which marshalling amortizes (per-request calls measured slower than
+    python). Returns UNAVAILABLE, or a list aligned with
+    `request_slots_list`: Assignment dict / None per request, with each
+    placement applied before the next (the schedulers' clone-and-apply
+    loop, bit-equivalent to sequential `_python_fit` + `_apply`)."""
+    lib = load_library()
+    if lib is None:
+        return UNAVAILABLE
+    n_req = len(request_slots_list)
+    if n_req == 0:
+        return []
+    items = list(agents.values())
+    n = len(items)
+    if n == 0:
+        return [None] * n_req
+    ids, free, slots, enabled, idle, id_rank = _marshal(agents)
+    req = np.asarray(request_slots_list, np.int32)
+    out = np.zeros(n_req * n, np.int32)
+    zero_agents = np.full(n_req, -1, np.int32)
+    status = np.zeros(n_req, np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.sched_fit_batch(
+        n,
+        free.ctypes.data_as(i32p),
+        slots.ctypes.data_as(i32p),
+        enabled.ctypes.data_as(u8p),
+        idle.ctypes.data_as(u8p),
+        id_rank.ctypes.data_as(i32p),
+        n_req,
+        req.ctypes.data_as(i32p),
+        1 if stop_on_fail else 0,
+        out.ctypes.data_as(i32p),
+        zero_agents.ctypes.data_as(i32p),
+        status.ctypes.data_as(i32p),
+    )
+    out = out.reshape(n_req, n)
+    results = []
+    for r in range(n_req):
+        if status[r] == 0:
+            results.append(None)
+        elif status[r] == 2:
+            results.append({ids[int(zero_agents[r])]: 0})
+        else:
+            results.append(
+                {ids[i]: int(out[r, i]) for i in np.nonzero(out[r])[0]}
+            )
+    return results
+
+
+def try_fit(request_slots: int, agents: Dict[str, "object"]):
+    """Native placement; returns UNAVAILABLE when the library can't build,
+    else the same Assignment/None the python fit produces."""
+    lib = load_library()
+    if lib is None:
+        return UNAVAILABLE
+    n = len(agents)
+    if n == 0:
+        return None
+    ids, free, slots, enabled, idle, id_rank = _marshal(agents)
+    out = np.zeros(n, np.int32)
+    zero_agent = np.zeros(1, np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    rc = lib.sched_fit(
+        n,
+        free.ctypes.data_as(i32p),
+        slots.ctypes.data_as(i32p),
+        enabled.ctypes.data_as(u8p),
+        idle.ctypes.data_as(u8p),
+        id_rank.ctypes.data_as(i32p),
+        int(request_slots),
+        out.ctypes.data_as(i32p),
+        zero_agent.ctypes.data_as(i32p),
+    )
+    if rc == -1:
+        return None
+    if rc == -2:
+        return {ids[int(zero_agent[0])]: 0}
+    return {ids[i]: int(out[i]) for i in np.nonzero(out)[0]}

@@ -74,7 +74,20 @@ class Decision:
 # Fitting (ref: fitting.go / fitting_methods.go best-fit)
 # ---------------------------------------------------------------------------
 def fit(request_slots: int, agents: Dict[str, Agent]) -> Optional[Assignment]:
-    """Place a gang of `request_slots` chips; None if it doesn't fit."""
+    """Place a gang of `request_slots` chips; None if it doesn't fit.
+
+    This python form is the semantic reference. The schedulers' per-tick
+    loops dispatch to the native BATCH scan (native/scheduler.cpp,
+    `native_sched.try_fit_batch` — the fittings.go hot-path analog) which
+    replicates this bit-for-bit; per-request native calls measured slower
+    than python because ctypes marshalling dominates, so batching per tick
+    is the unit that pays."""
+    return _python_fit(request_slots, agents)
+
+
+def _python_fit(
+    request_slots: int, agents: Dict[str, Agent]
+) -> Optional[Assignment]:
     if request_slots == 0:
         # Zero-slot (aux/CPU) tasks land on the least-loaded agent.
         candidates = [a for a in agents.values() if a.enabled]
@@ -123,14 +136,48 @@ def _clone_agents(agents: Dict[str, Agent]) -> Dict[str, Agent]:
 # ---------------------------------------------------------------------------
 # Schedulers
 # ---------------------------------------------------------------------------
+def _native_batch_starts(
+    ordered: List[Request], agents: Dict[str, Agent], *, stop_on_fail: bool
+):
+    """Shared native fast path: one whole-tick batched scan. Returns the
+    aligned per-request results (Assignment/None) or None when the native
+    library is unavailable — callers fall back to the python loop."""
+    from determined_tpu.master import native_sched
+
+    results = native_sched.try_fit_batch(
+        [r.slots for r in ordered], agents, stop_on_fail=stop_on_fail
+    )
+    if results is native_sched.UNAVAILABLE:
+        return None
+    return results
+
+
+def _warm_native() -> None:
+    from determined_tpu.master import native_sched
+
+    native_sched.warm()
+
+
 class FifoScheduler:
     """Strict arrival order; a gang that can't fit blocks everything behind
     it (predictable, the reference's round_robin analog for gangs)."""
 
+    def __init__(self) -> None:
+        _warm_native()  # build the .so off the first tick's critical path
+
     def schedule(self, pool: PoolState) -> Decision:
+        ordered = sorted(pool.pending, key=lambda r: r.order)
+        results = _native_batch_starts(ordered, pool.agents, stop_on_fail=True)
+        if results is not None:
+            to_start = [
+                (req, asg) for req, asg in zip(ordered, results)
+                if asg is not None
+            ]
+            return Decision(to_start, [])
+
         agents = _clone_agents(pool.agents)
-        to_start: List[Tuple[Request, Assignment]] = []
-        for req in sorted(pool.pending, key=lambda r: r.order):
+        to_start = []
+        for req in ordered:
             asg = fit(req.slots, agents)
             if asg is None:
                 break
@@ -149,13 +196,32 @@ class PriorityScheduler:
 
     def __init__(self, preemption: bool = True) -> None:
         self.preemption = preemption
+        _warm_native()  # build the .so off the first tick's critical path
 
     def schedule(self, pool: PoolState) -> Decision:
+        ordered = sorted(pool.pending, key=lambda r: (r.priority, r.order))
+        # Native fast path for the steady state: one batched scan for the
+        # whole queue. Preemption only matters when something DOESN'T fit,
+        # so an all-placed result (or preemption off) is the full answer;
+        # otherwise fall through to the python loop that interleaves
+        # victim selection with refits.
+        results = _native_batch_starts(
+            ordered, pool.agents, stop_on_fail=False
+        )
+        if results is not None and (
+            not self.preemption or all(a is not None for a in results)
+        ):
+            to_start = [
+                (req, asg) for req, asg in zip(ordered, results)
+                if asg is not None
+            ]
+            return Decision(to_start, [])
+
         agents = _clone_agents(pool.agents)
         to_start: List[Tuple[Request, Assignment]] = []
         to_preempt: List[str] = []
 
-        for req in sorted(pool.pending, key=lambda r: (r.priority, r.order)):
+        for req in ordered:
             asg = fit(req.slots, agents)
             if asg is None and self.preemption:
                 # Victims: preemptible, strictly less important, largest

@@ -1,0 +1,168 @@
+// Native gang-fitting hot path for the master's schedulers.
+//
+// The reference's scheduling core runs in Go inside the master
+// (internal/rm/agentrm/fittings.go BestFit/WorstFit over agent states);
+// this is the TPU-native master's equivalent native component: the
+// per-request placement scan the priority/FIFO schedulers run once per
+// pending request per tick — O(pending × agents) during an ASHA storm on a
+// large fleet, the control plane's hottest inner loop.
+//
+// Semantics are BIT-EQUIVALENT to determined_tpu/master/scheduler.py
+// _python_fit (tests assert equivalence over randomized states):
+//   request == 0  -> least-loaded enabled agent (first max on ties, in
+//                    caller-provided dict order);
+//   single host   -> best-fit: least leftover among agents with room
+//                    (first minimum on ties);
+//   multi host    -> whole idle hosts, uniform slot counts, lexicographic
+//                    agent-id order (caller passes the precomputed rank).
+//
+// Contract (all arrays length n, caller-allocated):
+//   free_[i]   free slots          slots[i]  total slots
+//   enabled[i] 0/1                 idle[i]   0/1 (no allocations)
+//   id_rank[i] position of agent i when ids are sorted ascending
+//   out[i]     assigned slots (zero-filled here)
+// Returns: -1 no fit; -2 zero-slot placement (index in *zero_agent);
+//          k > 0 number of agents assigned in out.
+#include <cstdint>
+#include <cstring>
+#include <climits>
+
+extern "C" {
+
+int32_t sched_fit(
+    int32_t n,
+    const int32_t* free_,
+    const int32_t* slots,
+    const uint8_t* enabled,
+    const uint8_t* idle,
+    const int32_t* id_rank,
+    int32_t request,
+    int32_t* out,
+    int32_t* zero_agent)
+{
+    std::memset(out, 0, sizeof(int32_t) * (size_t)n);
+
+    if (request == 0) {
+        int32_t best = -1;
+        int32_t best_free = INT32_MIN;
+        for (int32_t i = 0; i < n; i++) {
+            if (enabled[i] && free_[i] > best_free) {
+                best = i;
+                best_free = free_[i];
+            }
+        }
+        if (best < 0) return -1;
+        *zero_agent = best;
+        return -2;
+    }
+
+    // Single-host best-fit (enabled is implied by free_ <= 0 for disabled
+    // agents? No: the python side filters on free >= request only — free
+    // is computed from used regardless of enabled; match it exactly).
+    int32_t best = -1;
+    int32_t best_left = INT32_MAX;
+    for (int32_t i = 0; i < n; i++) {
+        if (free_[i] >= request) {
+            int32_t left = free_[i] - request;
+            if (left < best_left) {
+                best = i;
+                best_left = left;
+            }
+        }
+    }
+    if (best >= 0) {
+        out[best] = request;
+        return 1;
+    }
+
+    // Multi-host: whole idle hosts in id order, uniform slot geometry.
+    int32_t n_idle = 0;
+    int32_t per_host = -1;
+    for (int32_t i = 0; i < n; i++) {
+        if (idle[i]) {
+            n_idle++;
+            if (per_host < 0) per_host = slots[i];
+            else if (slots[i] != per_host) return -1;  // heterogeneous
+        }
+    }
+    if (n_idle == 0 || per_host <= 0) return -1;
+    if (request % per_host != 0) return -1;
+    int32_t n_hosts = request / per_host;
+    if (n_hosts > n_idle) return -1;
+    // The python side takes the first n_hosts of idle agents sorted by id:
+    // those are exactly the idle agents whose rank-among-idle < n_hosts.
+    // Count, for each idle agent, how many idle agents sort before it.
+    int32_t assigned = 0;
+    for (int32_t i = 0; i < n && assigned < n_hosts; i++) {
+        // pick idle agents in ascending id_rank order: O(n^2) worst case is
+        // fine at fleet sizes (n ~ 1e3); selection below is O(n_hosts * n).
+        (void)i;
+        int32_t pick = -1;
+        int32_t pick_rank = INT32_MAX;
+        for (int32_t j = 0; j < n; j++) {
+            if (idle[j] && out[j] == 0 && id_rank[j] < pick_rank) {
+                pick = j;
+                pick_rank = id_rank[j];
+            }
+        }
+        if (pick < 0) break;
+        out[pick] = per_host;
+        assigned++;
+    }
+    return assigned;
+}
+
+// Whole-tick batch: place `n_req` requests in caller order against ONE
+// marshalled fleet snapshot, applying each placement before the next (the
+// schedulers' clone-and-apply loop). Per-call ctypes marshalling is what
+// made the single-request form a wash; amortized over a tick's pending
+// queue the scan is pure C.
+//   stop_on_fail: 1 = FIFO semantics (a blocked gang blocks the queue),
+//                 0 = priority semantics (skip and keep going).
+//   status[r]: 1 placed (row r of out), 2 zero-slot (zero_agents[r]),
+//              0 not placed.
+int32_t sched_fit_batch(
+    int32_t n,
+    int32_t* free_,          // mutated: assignments are applied
+    const int32_t* slots,
+    const uint8_t* enabled,
+    uint8_t* idle,           // mutated
+    const int32_t* id_rank,
+    int32_t n_req,
+    const int32_t* req_slots,
+    int32_t stop_on_fail,
+    int32_t* out,            // [n_req * n]
+    int32_t* zero_agents,    // [n_req]
+    int32_t* status)         // [n_req]
+{
+    std::memset(out, 0, sizeof(int32_t) * (size_t)n_req * (size_t)n);
+    std::memset(status, 0, sizeof(int32_t) * (size_t)n_req);
+    int32_t placed = 0;
+    for (int32_t r = 0; r < n_req; r++) {
+        int32_t* row = out + (size_t)r * (size_t)n;
+        int32_t za = -1;
+        int32_t rc = sched_fit(
+            n, free_, slots, enabled, idle, id_rank, req_slots[r], row, &za);
+        if (rc == -1) {
+            if (stop_on_fail) return placed;
+            continue;
+        }
+        if (rc == -2) {
+            zero_agents[r] = za;
+            status[r] = 2;
+            idle[za] = 0;  // gains a used entry (of 0 slots) → not idle
+        } else {
+            status[r] = 1;
+            for (int32_t i = 0; i < n; i++) {
+                if (row[i] > 0) {
+                    free_[i] -= row[i];
+                    idle[i] = 0;
+                }
+            }
+        }
+        placed++;
+    }
+    return placed;
+}
+
+}  // extern "C"
