@@ -86,6 +86,122 @@ class HFFlaxModel(Model):
         return loss, {"loss": loss, "accuracy": acc}
 
 
+class HFFlaxClassifier(Model):
+    """Flax transformers sequence classifier as a platform Model — the
+    BERT-fine-tune rung of BASELINE.md's platform ladder (mnist → cifar →
+    **BERT fine-tune** → GPT-2 dtrain → GPT-NeoX FSDP). Config-built
+    (random init, offline) or from_pretrained where weights are local.
+
+    Batches: {"tokens": int32 [B, S], "label": int32 [B]}.
+    """
+
+    def __init__(
+        self,
+        model_type: str = "bert",
+        config_overrides: Optional[Dict[str, Any]] = None,
+        num_labels: int = 2,
+        dtype: Any = jnp.bfloat16,
+        mesh=None,
+    ) -> None:
+        from transformers import (
+            AutoConfig,
+            FlaxAutoModelForSequenceClassification,
+        )
+
+        self.config = AutoConfig.for_model(
+            model_type, num_labels=num_labels, **(config_overrides or {})
+        )
+        self._module = FlaxAutoModelForSequenceClassification.from_config(
+            self.config, dtype=dtype, _do_init=False
+        )
+        self.mesh = mesh
+
+    def init(self, rng: jax.Array):
+        shape = (1, int(getattr(self.config, "max_position_embeddings", 128)))
+        return self._module.init_weights(rng, shape)
+
+    # Same generic FSDP annotation as the causal-LM wrapper.
+    logical_axes = HFFlaxModel.logical_axes
+
+    def apply(self, params, tokens: jax.Array) -> jax.Array:
+        return self._module(
+            input_ids=tokens, params=params, train=False
+        ).logits
+
+    @staticmethod
+    def _metrics(logits: jax.Array, labels: jax.Array) -> Metrics:
+        """Shared train/eval metric math — one place to fix (masking,
+        smoothing) so the two paths can't diverge."""
+        logits = logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(
+            logits, labels[..., None], axis=-1
+        ).squeeze(-1)
+        loss = jnp.mean(lse - tgt)
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        return {"loss": loss, "accuracy": acc}
+
+    def loss(self, params, batch, rng) -> Tuple[jax.Array, Metrics]:
+        logits = self._module(
+            input_ids=batch["tokens"], params=params, dropout_rng=rng,
+            train=True,
+        ).logits
+        metrics = self._metrics(logits, batch["label"])
+        return metrics["loss"], metrics
+
+    def eval_metrics(self, params, batch) -> Metrics:
+        return self._metrics(
+            self.apply(params, batch["tokens"]), batch["label"]
+        )
+
+
+class HFClassifierTrial(JAXTrial):
+    """BERT-class fine-tuning trial (synthetic separable stream by default;
+    point `build_training_data` at your tokenized dataset for real work).
+
+    hparams: hf_model_type ("bert"), hf_config overrides, num_labels,
+    batch_size, seq_len, lr.
+    """
+
+    def build_model(self, mesh):
+        return HFFlaxClassifier(
+            model_type=self.hparams.get("hf_model_type", "bert"),
+            config_overrides=self.hparams.get("hf_config", {}),
+            num_labels=int(self.hparams.get("num_labels", 2)),
+            mesh=mesh,
+        )
+
+    def build_optimizer(self):
+        return optax.chain(
+            optax.clip_by_global_norm(1.0),
+            optax.adamw(float(self.hparams.get("lr", 5e-5))),
+        )
+
+    def _stream(self, seed: int):
+        b = int(self.hparams.get("batch_size", 8))
+        s = int(self.hparams.get("seq_len", 64))
+        vocab = int(self.hparams.get("hf_config", {}).get("vocab_size", 1024))
+        n_labels = int(self.hparams.get("num_labels", 2))
+        rng = np.random.default_rng(seed)
+
+        def gen():
+            while True:
+                label = rng.integers(0, n_labels, (b,)).astype(np.int32)
+                toks = rng.integers(2, vocab, (b, s)).astype(np.int32)
+                # learnable signal: the first token encodes the class
+                toks[:, 0] = label % min(vocab, 16)
+                yield {"tokens": toks, "label": label}
+
+        return gen()
+
+    def build_training_data(self):
+        return self._stream(seed=0)
+
+    def build_validation_data(self):
+        it = iter(self._stream(seed=1))
+        return [next(it) for _ in range(2)]
+
+
 class HFTrial(JAXTrial):
     """Plug-and-play trial for HF causal LMs on synthetic or token-shard data."""
 

@@ -46,10 +46,15 @@ def _build() -> Optional[str]:
             os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
         ):
             return _SO
+        # Compile to a private name, then atomically rename: a concurrent
+        # process (second master, pytest worker) must never dlopen a
+        # half-written .so and pin itself to the python fallback.
+        tmp = f"{_SO}.{os.getpid()}.tmp"
         cmd = [
-            "g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO,
+            "g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp,
         ]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
         return _SO
     except (OSError, subprocess.SubprocessError):
         return None
@@ -57,7 +62,12 @@ def _build() -> Optional[str]:
 
 def load_library() -> Optional[ctypes.CDLL]:
     global _lib, _build_failed
-    with _lock:
+    # Non-blocking: while another thread holds the lock (the warm()
+    # background build), callers get None and take the python fit — a
+    # scheduling tick must never wait up to the compile timeout.
+    if not _lock.acquire(blocking=False):
+        return None
+    try:
         if _lib is not None or _build_failed:
             return _lib
         so = _build()
@@ -83,6 +93,8 @@ def load_library() -> Optional[ctypes.CDLL]:
         ]
         _lib = lib
         return _lib
+    finally:
+        _lock.release()
 
 
 def _marshal(agents: Dict[str, "object"]):

@@ -52,20 +52,23 @@ class TestNativeFitEquivalence:
 
     def test_tie_breaking_matches(self, native_available):
         """Equal best-fit leftovers / equal free: python picks the FIRST in
-        dict order; the native scan must too."""
+        dict order; the NATIVE scan must too (assert against try_fit — the
+        python-only fit() would vacuously pass)."""
         agents = {
             "b": Agent("b", 8, used={"x": 4}),   # free 4
             "a": Agent("a", 8, used={"y": 4}),   # free 4 — later in dict
         }
-        assert fit(4, agents) == _python_fit(4, agents) == {"b": 4}
-        assert fit(0, agents) == {"b": 0}
+        assert _python_fit(4, agents) == {"b": 4}
+        assert native_sched.try_fit(4, agents) == {"b": 4}
+        assert native_sched.try_fit(0, agents) == {"b": 0}
 
     def test_multihost_id_order(self, native_available):
         agents = {
             "z": Agent("z", 4), "a": Agent("a", 4), "m": Agent("m", 4),
         }
         # 8 slots = 2 idle hosts, lexicographically first ids
-        assert fit(8, agents) == _python_fit(8, agents) == {"a": 4, "m": 4}
+        assert _python_fit(8, agents) == {"a": 4, "m": 4}
+        assert native_sched.try_fit(8, agents) == {"a": 4, "m": 4}
 
     @pytest.mark.parametrize("stop_on_fail", [True, False])
     def test_batch_matches_sequential_python(
