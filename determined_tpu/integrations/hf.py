@@ -189,7 +189,9 @@ class HFClassifierTrial(JAXTrial):
                 label = rng.integers(0, n_labels, (b,)).astype(np.int32)
                 toks = rng.integers(2, vocab, (b, s)).astype(np.int32)
                 # learnable signal: the first token encodes the class
-                toks[:, 0] = label % min(vocab, 16)
+                toks[:, 0] = 2 + (label % max(1, vocab - 2))  # collision-free for
+                # any num_labels < vocab-2 (body tokens start at 2 too,
+                # but position 0 deterministically encodes the class)
                 yield {"tokens": toks, "label": label}
 
         return gen()

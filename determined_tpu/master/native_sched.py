@@ -53,21 +53,32 @@ def _build() -> Optional[str]:
         cmd = [
             "g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp,
         ]
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except BaseException:
+            try:
+                os.unlink(tmp)  # don't strew partial objects per failed pid
+            except OSError:
+                pass
+            raise
         os.replace(tmp, _SO)
         return _SO
     except (OSError, subprocess.SubprocessError):
         return None
 
 
-def load_library() -> Optional[ctypes.CDLL]:
+def load_library(build: bool = True) -> Optional[ctypes.CDLL]:
+    """The compiled library, or None.
+
+    build=False is the SCHEDULING-PATH contract: return the library only
+    if it is already loaded — never compile, never wait on the lock. The
+    warm() background thread (and tests) use build=True; a tick arriving
+    before the warm build finishes simply takes the python fit.
+    """
     global _lib, _build_failed
-    # Non-blocking: while another thread holds the lock (the warm()
-    # background build), callers get None and take the python fit — a
-    # scheduling tick must never wait up to the compile timeout.
-    if not _lock.acquire(blocking=False):
-        return None
-    try:
+    if not build:
+        return _lib  # atomic read; None while the warm build is in flight
+    with _lock:
         if _lib is not None or _build_failed:
             return _lib
         so = _build()
@@ -93,8 +104,6 @@ def load_library() -> Optional[ctypes.CDLL]:
         ]
         _lib = lib
         return _lib
-    finally:
-        _lock.release()
 
 
 def _marshal(agents: Dict[str, "object"]):
@@ -121,7 +130,7 @@ def try_fit_batch(
     `request_slots_list`: Assignment dict / None per request, with each
     placement applied before the next (the schedulers' clone-and-apply
     loop, bit-equivalent to sequential `_python_fit` + `_apply`)."""
-    lib = load_library()
+    lib = load_library(build=False)
     if lib is None:
         return UNAVAILABLE
     n_req = len(request_slots_list)
@@ -169,7 +178,7 @@ def try_fit_batch(
 def try_fit(request_slots: int, agents: Dict[str, "object"]):
     """Native placement; returns UNAVAILABLE when the library can't build,
     else the same Assignment/None the python fit produces."""
-    lib = load_library()
+    lib = load_library(build=False)
     if lib is None:
         return UNAVAILABLE
     n = len(agents)
