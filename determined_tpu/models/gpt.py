@@ -326,7 +326,39 @@ class GPT(Model):
         )
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if manual:
-            o = attn_mod.attention(q, k, v, mesh=None, causal=True, impl="dense")
+            ctx = (
+                self.mesh.shape.get("context", 1)
+                if self.mesh is not None else 1
+            )
+            if ctx > 1:
+                # Pipeline × sequence parallelism: the pipeline shard_map is
+                # manual on BOTH axes, so each stage runs ring attention
+                # over its seq shard directly (the context axis rotates K/V
+                # by ppermute while pipeline ppermutes stage hand-offs —
+                # independent meshes of the same program).
+                from determined_tpu.parallel.ring import ring_attention
+
+                o = ring_attention(
+                    q, k, v, axis_name="context", causal=True,
+                    block_q=c.flash_block_q, block_k=c.flash_block_k,
+                    layout=(
+                        "zigzag" if c.sequence_layout == "zigzag"
+                        else "contiguous"
+                    ),
+                )
+            else:
+                if c.sequence_layout == "zigzag":
+                    # Same guard the attention dispatcher enforces: a dense
+                    # causal mask over zigzag-PERMUTED order is silently
+                    # wrong, and this manual path bypasses the dispatcher.
+                    raise ValueError(
+                        "sequence_layout='zigzag' inside a pipeline needs "
+                        "a sharded context axis (ring attention); dense "
+                        "causal attention assumes contiguous order"
+                    )
+                o = attn_mod.attention(
+                    q, k, v, mesh=None, causal=True, impl="dense"
+                )
         else:
             o = attn_mod.attention(
                 q, k, v, mesh=self.mesh, causal=True, impl=c.attn_impl,
@@ -471,18 +503,25 @@ class GPT(Model):
     ) -> Tuple[jax.Array, jax.Array]:
         """→ (logits [B, S, V], moe aux loss)."""
         c = self.config
-        if c.sequence_layout == "zigzag":
-            # positions presence is checked in _forward_trunk (shared with
-            # the chunked-loss path); only the composition rule lives here.
-            assert c.pipeline_stages == 1, (
-                "zigzag layout + pipeline parallelism not composed yet"
+        if c.sequence_layout == "zigzag" and c.pipeline_stages > 1:
+            # Zigzag rides the pipeline: embedding happens BEFORE the
+            # pipeline shard_map (positions-aware), and the stages run ring
+            # attention in zigzag layout over the manual context axis — a
+            # SHARDED context axis is therefore mandatory (dense attention
+            # over permuted order would be silently wrong).
+            assert positions is not None, (
+                "sequence_layout='zigzag' needs a zigzag-emitting data "
+                "pipeline (data/tokens.py zigzag_ring) supplying positions"
+            )
+            assert (
+                self.mesh is not None
+                and self.mesh.shape.get("context", 1) > 1
+            ), (
+                "sequence_layout='zigzag' + pipeline parallelism requires "
+                "a sharded context axis (ring attention in the stages)"
             )
         if c.pipeline_stages > 1:
-            assert positions is None, (
-                "explicit positions are not plumbed through the pipelined "
-                "forward; use contiguous batches with pipeline parallelism"
-            )
-            return self._apply_pipelined(params, tokens)
+            return self._apply_pipelined(params, tokens, positions)
 
         hidden = self._forward_trunk(params, tokens, positions)
         return self._head(params, hidden[0]), hidden[1]
@@ -552,14 +591,21 @@ class GPT(Model):
         return x.reshape(m, mb, *x.shape[1:]), cyclic, shards
 
     def _apply_pipelined(
-        self, params: Dict[str, Any], tokens: jax.Array
+        self,
+        params: Dict[str, Any],
+        tokens: jax.Array,
+        positions: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, jax.Array]:
-        """GPipe schedule over the mesh's `pipeline` axis (parallel/pipeline.py).
+        """GPipe/circular schedule over the mesh's `pipeline` axis
+        (parallel/pipeline.py).
 
         Embedding and LM head stay outside the pipeline (replicated across
         stages); block params reshape [L, ...] → [stages, L/stages, ...] and
-        shard over `pipeline`; other mesh axes stay under GSPMD control
-        (shard_map axis_names={'pipeline'} partial-manual mode).
+        shard over `pipeline`. When the mesh also shards `context`, the
+        shard_map goes manual on BOTH axes and each stage runs ring
+        attention over its sequence shard (pipeline ppermutes hand-offs,
+        context ppermutes K/V — independent rings of the same program);
+        remaining axes (data/fsdp/tensor) stay under GSPMD control.
         """
         from jax import shard_map
 
@@ -582,7 +628,7 @@ class GPT(Model):
         m = c.num_microbatches or 2 * n_stages
         assert b % m == 0, f"batch {b} not divisible by {m} microbatches"
 
-        x = self._embed(params, tokens)
+        x = self._embed(params, tokens, positions)
         # Carries through the pipeline's scan/ppermute stay fp32: bf16
         # loop-carried values under partial-manual shard_map trip an XLA
         # SPMD-partitioner check failure ("invalid binary instruction opcode
@@ -640,15 +686,20 @@ class GPT(Model):
             sp = jax.tree.map(lambda leaf: leaf[0], sp)  # drop S dim (=1)
             return apply_fn(blocks_scan, sp, mbs)
 
+        ctx = self.mesh.shape.get("context", 1)
+        manual_axes = {"pipeline"} | ({"context"} if ctx > 1 else set())
+        # With a sharded context axis the microbatches enter seq-sharded
+        # (dim 2) and each stage's ring attention owns that axis manually.
+        micro_spec = P(None, None, "context", None) if ctx > 1 else P()
         piped = shard_map(
             run,
             mesh=self.mesh,
             in_specs=(
                 jax.tree.map(lambda _: P("pipeline"), stage_blocks),
-                P(),
+                micro_spec,
             ),
-            out_specs=P(),
-            axis_names={"pipeline"},
+            out_specs=micro_spec,
+            axis_names=manual_axes,
             check_vma=False,
         )
         out = piped(stage_blocks, micro)  # [M, mb, S, D] fp32
@@ -700,7 +751,13 @@ class GPT(Model):
         assert c.n_layers % n_stages == 0
         assert not c.n_experts, "MoE+pipeline composition not supported yet"
         assert c.sequence_layout == "contiguous", (
-            "zigzag layout + pipeline parallelism not composed yet"
+            "zigzag layout + the 1F1B schedule not composed yet (gpipe/"
+            "circular compose; 1F1B embeds inside the pipeline and would "
+            "need per-shard position offsets)"
+        )
+        assert self.mesh.shape.get("context", 1) == 1, (
+            "sequence parallelism + the 1F1B schedule not composed yet "
+            "(gpipe/circular compose with a sharded context axis)"
         )
         assert "targets" not in batch and "positions" not in batch, (
             "the 1F1B path applies the classic in-model shift; a "
