@@ -521,6 +521,21 @@ class GPT(Model):
                 "a sharded context axis (ring attention in the stages)"
             )
         if c.pipeline_stages > 1:
+            if (
+                self.mesh is None
+                or self.mesh.shape.get("context", 1) == 1
+            ):
+                # Without a sharded context axis the stages run DENSE
+                # causal attention, whose mask assumes index order == time
+                # order — and permuted positions can't be validated at
+                # trace time. Contiguous ctx==1 pipelines therefore take
+                # positions-free batches (aligned targets are still fine).
+                assert positions is None, (
+                    "explicit positions with a context-unsharded pipeline "
+                    "would silently break the dense causal mask; drop "
+                    "'positions' (contiguous data) or shard the context "
+                    "axis (ring attention understands permuted layouts)"
+                )
             return self._apply_pipelined(params, tokens, positions)
 
         hidden = self._forward_trunk(params, tokens, positions)
@@ -743,6 +758,8 @@ class GPT(Model):
 
         c = self.config
         tokens = batch["tokens"]
+        targets = batch.get("targets")
+        positions = batch.get("positions")
         mask = batch.get("loss_mask")
         b, s = tokens.shape
         n_stages = c.pipeline_stages
@@ -750,21 +767,32 @@ class GPT(Model):
         assert self.mesh.shape["pipeline"] == n_stages
         assert c.n_layers % n_stages == 0
         assert not c.n_experts, "MoE+pipeline composition not supported yet"
-        assert c.sequence_layout == "contiguous", (
-            "zigzag layout + the 1F1B schedule not composed yet (gpipe/"
-            "circular compose; 1F1B embeds inside the pipeline and would "
-            "need per-shard position offsets)"
-        )
-        assert self.mesh.shape.get("context", 1) == 1, (
-            "sequence parallelism + the 1F1B schedule not composed yet "
-            "(gpipe/circular compose with a sharded context axis)"
-        )
-        assert "targets" not in batch and "positions" not in batch, (
-            "the 1F1B path applies the classic in-model shift; a "
-            "pre-shifted (zigzag) batch here would train on permuted "
-            "garbage — use sequence_layout='contiguous' data with pipeline "
-            "parallelism"
-        )
+        ctx = self.mesh.shape.get("context", 1)
+        aligned = targets is not None
+        if ctx > 1 or c.sequence_layout == "zigzag":
+            # The in-model shift crosses seq-shard boundaries (and zigzag
+            # order entirely): sequence-parallel / zigzag 1F1B requires
+            # PRE-SHIFTED batches from the data pipeline.
+            assert aligned, (
+                "1F1B with a sharded context axis (or zigzag layout) needs "
+                "pre-shifted batches: data/tokens.py's zigzag_ring (or an "
+                "aligned {'tokens','targets','positions'} stream)"
+            )
+        if c.sequence_layout == "zigzag":
+            assert ctx > 1, (
+                "sequence_layout='zigzag' + pipeline needs a sharded "
+                "context axis (ring attention in the stages)"
+            )
+            assert positions is not None
+        if ctx == 1:
+            # Same dense-causal-mask guard as _forward: permuted positions
+            # can't be validated at trace time, so a context-unsharded
+            # 1F1B takes positions-free batches.
+            assert positions is None, (
+                "explicit positions with a context-unsharded pipeline "
+                "would silently break the dense causal mask; drop "
+                "'positions' or shard the context axis"
+            )
         m = c.num_microbatches or 2 * n_stages
         assert b % m == 0, f"batch {b} not divisible by {m} microbatches"
         per_stage = c.n_layers // n_stages
@@ -776,29 +804,53 @@ class GPT(Model):
         )
         tok3, _, _ = self._microbatch_split(tokens, m)
         msk3, _, _ = self._microbatch_split(mask_f, m)
-        tok3 = self._constrain(tok3, P(None, ("data", "fsdp"), "context"))
-        msk3 = self._constrain(msk3, P(None, ("data", "fsdp"), "context"))
+        seq_spec = P(None, ("data", "fsdp"), "context")
+        tok3 = self._constrain(tok3, seq_spec)
+        msk3 = self._constrain(msk3, seq_spec)
+        tgt3 = None
+        if aligned:
+            tgt3, _, _ = self._microbatch_split(targets, m)
+            tgt3 = self._constrain(tgt3, seq_spec)
 
         stage_fn = self._stage_scan_fn()
 
-        def emb_fn(ep, tok):
+        def emb_fn(ep, tok, pos):
             return self._embed_raw(
-                ep["tok_embed"], ep["pos_embed"], tok
+                ep["tok_embed"], ep["pos_embed"], tok, pos
             ).astype(jnp.float32)
 
         def loss_fn(lp, y, tok, msk):
             """Per-microbatch SUM objective + [nll, z, acc, n] sums —
-            the same _head_raw/_next_token_sums math as the GSPMD path."""
+            the same _head_raw + sums math as the GSPMD path. In aligned
+            mode `tok` IS the targets (no shift); with a manual context
+            axis the sums are psum'd global so every shard seeds its
+            backward with the global objective's cotangent."""
             w_out = (
                 lp["tok_embed"].T if c.tie_embeddings else lp["head"]
             ).astype(c.dtype)
             logits = self._head_raw(
                 lp["lnf_scale"], lp["lnf_bias"], w_out, y.astype(c.dtype)
             ).astype(jnp.float32)
-            nll_sum, z_sum, acc_sum, n_tok = self._next_token_sums(
-                logits, tok, msk
-            )
+            if aligned:
+                nll_sum, z_sum, acc_sum, n_tok = self._aligned_token_sums(
+                    logits, tok, msk
+                )
+            else:
+                nll_sum, z_sum, acc_sum, n_tok = self._next_token_sums(
+                    logits, tok, msk
+                )
+            # The OBJECTIVE stays LOCAL: psum-ing it before the vjp would
+            # transpose into a psum of the unit cotangents (each shard's
+            # "global" objective re-counts every shard's terms), inflating
+            # all gradients by ctx. Local objectives seed local partial
+            # grads, and one_f_one_b_grads psums the partials over
+            # reduce_axes exactly once. Only the METRIC sums go global.
             obj = nll_sum + c.z_loss * z_sum
+            if ctx > 1:
+                nll_sum, z_sum, acc_sum, n_tok = (
+                    lax.psum(v, "context")
+                    for v in (nll_sum, z_sum, acc_sum, n_tok)
+                )
             return obj, jnp.stack([nll_sum, z_sum, acc_sum, n_tok])
 
         def fwd_impl(p):
@@ -815,21 +867,39 @@ class GPT(Model):
             else:
                 lp["head"] = p["head"]
 
-            def run(sp, tk, mk, ep_, lp_):
+            reduce_axes = ("context",) if ctx > 1 else ()
+
+            def run(sp, tk, mk, tg, pos, ep_, lp_):
                 sp = jax.tree.map(lambda leaf: leaf[0], sp)
                 return one_f_one_b_grads(
-                    stage_fn, sp, emb_fn, ep_, loss_fn, lp_, tk, mk
+                    stage_fn, sp, emb_fn, ep_, loss_fn, lp_, tk, mk,
+                    targets_mb=tg, positions=pos,
+                    reduce_axes=reduce_axes,
                 )
 
             stage_spec = jax.tree.map(lambda _: P("pipeline"), stage_blocks)
+            manual_axes = {"pipeline"} | ({"context"} if ctx > 1 else set())
+            mb_spec = P(None, None, "context") if ctx > 1 else P()
+            pos_spec = P("context") if ctx > 1 else P()
+            pos_arr = (
+                positions if positions is not None
+                else jnp.arange(s, dtype=jnp.int32)
+            )
             msums, s_g, e_g, l_g = shard_map(
                 run,
                 mesh=self.mesh,
-                in_specs=(stage_spec, P(), P(), P(), P()),
+                in_specs=(
+                    stage_spec, mb_spec, mb_spec, mb_spec, pos_spec,
+                    P(), P(),
+                ),
                 out_specs=(P(), stage_spec, P(), P()),
-                axis_names={"pipeline"},
+                axis_names=manual_axes,
                 check_vma=False,
-            )(stage_blocks, tok3, msk3, ep, lp)
+            )(
+                stage_blocks, tok3, msk3,
+                tgt3 if tgt3 is not None else tok3,  # unused when not aligned
+                pos_arr, ep, lp,
+            )
 
             n = jnp.maximum(msums[3], 1.0)
             loss = msums[0] / n + c.z_loss * msums[1] / n

@@ -186,13 +186,16 @@ def one_f_one_b_stash_size(n_micro: int, n_stages: int) -> int:
 def one_f_one_b_grads(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
     stage_params: Any,
-    emb_fn: Callable[[Any, jax.Array], jax.Array],
+    emb_fn: Callable[..., jax.Array],
     emb_params: Any,
     loss_fn: Callable[[Any, jax.Array, jax.Array, jax.Array], Any],
     loss_params: Any,
     tokens_mb: jax.Array,
     mask_mb: jax.Array,
     *,
+    targets_mb: Any = None,
+    positions: Any = None,
+    reduce_axes: tuple = (),
     axis_name: str = "pipeline",
 ):
     """1F1B schedule (memory-bounded pipelining); call inside shard_map.
@@ -218,14 +221,24 @@ def one_f_one_b_grads(
     Args:
       stage_fn: (params, x [mb, ...]) -> y, same shape. Differentiated via
         vjp per backward sub-slot, recomputing from the stashed input.
-      emb_fn: (emb_params, tokens [mb, s]) -> x — microbatch producer, run
-        on stage 0 (branchlessly everywhere; masked elsewhere).
-      loss_fn: (loss_params, y, tokens, mask) -> (objective, metric_sums)
-        run on the last stage. `objective` MUST be a per-microbatch SUM
-        (decomposable across microbatches): its unit-seeded cotangent starts
-        each microbatch's backward independently; the caller rescales the
-        returned grads afterwards (gradients are linear in the seed).
-      tokens_mb: [M, mb, s] int32; mask_mb: [M, mb, s] float32.
+      emb_fn: (emb_params, tokens [mb, s], positions) -> x — microbatch
+        producer, run on stage 0 (branchlessly everywhere; masked
+        elsewhere).
+      loss_fn: (loss_params, y, aux_tokens, mask) -> (objective,
+        metric_sums) run on the last stage; `aux_tokens` is targets_mb's
+        microbatch when given, else tokens_mb's (the loss shifts itself).
+        `objective` MUST be a per-microbatch SUM (decomposable across
+        microbatches): its unit-seeded cotangent starts each microbatch's
+        backward independently; the caller rescales the returned grads
+        afterwards (gradients are linear in the seed). When `reduce_axes`
+        names manual mesh axes (sequence parallelism), loss_fn must psum
+        its METRIC sums over them but keep the OBJECTIVE local: psum-ing
+        the objective transposes into a psum of the unit cotangents and
+        inflates every gradient by the axis size. Param grads (partials
+        per shard) are psum'd over those axes exactly once, here.
+      tokens_mb: [M, mb, s] int32; mask_mb: [M, mb, s] float32;
+      targets_mb: [M, mb, s] int32 pre-shifted targets (aligned loss);
+      positions: [s] int32 logical positions (permuted/sharded layouts).
 
     Returns (metric_sums, stage_grads, emb_grads, loss_grads): metric_sums /
     emb_grads / loss_grads psum-replicated over the pipeline axis;
@@ -248,7 +261,7 @@ def one_f_one_b_grads(
     def zeros_like_tree(tr):
         return jax.tree.map(jnp.zeros_like, tr)
 
-    zero_act = jnp.zeros_like(emb_fn(emb_params, tokens_mb[0]))
+    zero_act = jnp.zeros_like(emb_fn(emb_params, tokens_mb[0], positions))
     stash0 = jnp.zeros((cap,) + zero_act.shape, zero_act.dtype)
     # metric_sums shape comes from one abstract eval of loss_fn.
     aux_shape = jax.eval_shape(
@@ -265,12 +278,19 @@ def one_f_one_b_grads(
         mf = jnp.clip(f_idx, 0, n_micro - 1)
         tok_f = lax.dynamic_index_in_dim(tokens_mb, mf, keepdims=False)
         msk_f = lax.dynamic_index_in_dim(mask_mb, mf, keepdims=False)
+        tgt_f = (
+            tok_f if targets_mb is None
+            else lax.dynamic_index_in_dim(targets_mb, mf, keepdims=False)
+        )
         # lax.cond keeps edge-only work (embedding on stage 0, LM head on
         # the last stage) off the other devices — a real cost at vocab
         # scale. Legal under SPMD because the collectives (ppermutes) sit
-        # outside the branches.
+        # outside the branches. NOTE: ring attention inside stage_fn puts a
+        # ppermute INSIDE the stage compute, which every device runs every
+        # tick (branchless), so the context collective stays uniform too.
         x_in = lax.cond(
-            d == 0, lambda: emb_fn(emb_params, tok_f), lambda: inc_f
+            d == 0, lambda: emb_fn(emb_params, tok_f, positions),
+            lambda: inc_f,
         )
         y = stage_fn(stage_params, x_in)
         slot = mf % cap
@@ -283,7 +303,7 @@ def one_f_one_b_grads(
         # backward sub-slot for the same microbatch.
         def loss_vjp():
             obj, vjp_loss, aux = jax.vjp(
-                lambda lp, yy: loss_fn(lp, yy, tok_f, msk_f),
+                lambda lp, yy: loss_fn(lp, yy, tgt_f, msk_f),
                 loss_params, y, has_aux=True,
             )
             d_lp, dy = vjp_loss(jnp.ones_like(obj))
@@ -311,7 +331,9 @@ def one_f_one_b_grads(
         # Stage 0's input cotangent is the embedding-output cotangent.
         def emb_vjp():
             tok_b = lax.dynamic_index_in_dim(tokens_mb, mb_i, keepdims=False)
-            _, vjp_emb = jax.vjp(lambda ep: emb_fn(ep, tok_b), emb_params)
+            _, vjp_emb = jax.vjp(
+                lambda ep: emb_fn(ep, tok_b, positions), emb_params
+            )
             (d_ep,) = vjp_emb(dx)
             return d_ep
 
@@ -334,6 +356,14 @@ def one_f_one_b_grads(
     msums = lax.psum(msums, axis_name)
     e_g = lax.psum(e_g, axis_name)
     l_g = lax.psum(l_g, axis_name)
+    for ax in reduce_axes:
+        # Sequence parallelism: each context shard computed PARTIAL param
+        # grads over its local sequence; sum them. msums are already global
+        # (loss_fn psums its sums over these axes before returning), so
+        # they are NOT reduced again here.
+        e_g = lax.psum(e_g, ax)
+        l_g = lax.psum(l_g, ax)
+        s_g = lax.psum(s_g, ax)
     s_g = jax.tree.map(lambda g: g[None], s_g)
     return msums, s_g, e_g, l_g
 

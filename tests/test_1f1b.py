@@ -4,6 +4,8 @@ the reference reached through DeepSpeed's PipeEngine,
 `examples/deepspeed/pipeline_parallelism/distributed.yaml`)."""
 import dataclasses
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -91,6 +93,95 @@ class Test1F1B:
             devices8, MeshConfig(pipeline=4, data=2), _batch(b=16),
             stages=4, n_layers=4,
         )
+
+    def test_1f1b_x_sequence_parallel_aligned(self, devices8):
+        """1F1B × SP: pre-shifted (aligned) batches remove the in-model
+        shift that would cross seq shards; positions shard over the manual
+        context axis; loss/grads match the plain model."""
+
+        rng = np.random.default_rng(5)
+        s = 128
+        raw = rng.integers(0, 256, (8, s + 1)).astype(np.int32)
+        pre = {
+            "tokens": raw[:, :-1],
+            "targets": raw[:, 1:],
+            "positions": np.arange(s, dtype=np.int32),
+        }
+        plain = GPT(_cfg(seq_len=s + 1))
+        params = plain.init(jax.random.PRNGKey(0))
+        ref_loss, _, ref_grads = _value_and_grad(plain, params, pre)
+
+        mesh = make_mesh(
+            MeshConfig(data=2, pipeline=2, context=2), devices=devices8
+        )
+        piped = GPT(
+            _cfg(seq_len=s + 1, pipeline_stages=2, num_microbatches=4,
+                 pipeline_schedule="1f1b"),
+            mesh=mesh,
+        )
+        loss, _, grads = _value_and_grad(piped, params, pre)
+        np.testing.assert_allclose(float(ref_loss), float(loss), rtol=1e-4)
+        for r, g in zip(jax.tree.leaves(ref_grads), jax.tree.leaves(grads)):
+            np.testing.assert_allclose(
+                np.asarray(r), np.asarray(g), rtol=5e-3, atol=1e-5
+            )
+
+    def test_1f1b_x_zigzag(self, devices8):
+        """1F1B with natively-emitted zigzag batches over a sharded context
+        axis — the full composition."""
+        from determined_tpu.parallel.ring import zigzag_indices
+
+        rng = np.random.default_rng(6)
+        s = 128
+        raw = rng.integers(0, 256, (8, s + 1)).astype(np.int32)
+        perm = zigzag_indices(s, 2)
+        zz = {
+            "tokens": np.ascontiguousarray(raw[:, :-1][:, perm]),
+            "targets": np.ascontiguousarray(raw[:, 1:][:, perm]),
+            "positions": perm.astype(np.int32),
+        }
+        pre = {
+            "tokens": raw[:, :-1],
+            "targets": raw[:, 1:],
+            "positions": np.arange(s, dtype=np.int32),
+        }
+        plain = GPT(_cfg(seq_len=s + 1))
+        params = plain.init(jax.random.PRNGKey(0))
+        ref_loss, _, _ = _value_and_grad(plain, params, pre)
+
+        mesh = make_mesh(
+            MeshConfig(data=2, pipeline=2, context=2), devices=devices8
+        )
+        piped = GPT(
+            _cfg(seq_len=s + 1, sequence_layout="zigzag",
+                 pipeline_stages=2, num_microbatches=4,
+                 pipeline_schedule="1f1b"),
+            mesh=mesh,
+        )
+        loss, _, grads = _value_and_grad(piped, params, zz)
+        np.testing.assert_allclose(float(ref_loss), float(loss), rtol=1e-4)
+        assert all(
+            np.isfinite(np.asarray(g)).all() for g in jax.tree.leaves(grads)
+        )
+
+    def test_1f1b_sp_requires_aligned_batches(self, devices8):
+        """The classic shift crosses seq-shard boundaries: 1F1B + context
+        sharding without pre-shifted targets must be rejected."""
+        mesh = make_mesh(
+            MeshConfig(data=2, pipeline=2, context=2), devices=devices8
+        )
+        model = GPT(
+            _cfg(pipeline_stages=2, num_microbatches=4,
+                 pipeline_schedule="1f1b"),
+            mesh=mesh,
+        )
+        params = model.init(jax.random.PRNGKey(0))
+        with pytest.raises(AssertionError, match="pre-shifted"):
+            jax.jit(
+                lambda p: model.loss(
+                    p, _batch(), jax.random.PRNGKey(0)
+                )[0]
+            )(params)
 
     def test_trains_under_optimizer(self, devices8):
         """Full train loop: loss decreases over steps with adamw."""
