@@ -22,6 +22,7 @@ Wants=network-online.target
 [Service]
 Type=simple
 User=dtpu
+EnvironmentFile=/etc/dtpu/env
 ExecStart=/usr/bin/python3 -m determined_tpu.master.main {args}
 Restart=always
 RestartSec=5
@@ -51,13 +52,21 @@ def startup_script(
         )
     import json as json_mod
 
-    # json.dumps, not string formatting: the credential baked into the VM
-    # must be byte-identical to the one returned to the operator.
-    users = shlex.quote(json_mod.dumps({"admin": admin_password}))
-    args = (
-        f"--host 0.0.0.0 --port {port} --db /var/lib/dtpu/master.db "
-        f"--users {users}"
+    # The credential reaches the master via a root-written 0600
+    # EnvironmentFile (DTPU_USERS), NOT the ExecStart command line — unit
+    # files are world-readable and `ps` shows argv. json.dumps keeps the
+    # baked credential byte-identical to the one returned to the operator.
+    #
+    # RESIDUAL EXPOSURE: the startup SCRIPT itself rides instance metadata,
+    # readable by compute.viewer principals and the VM's metadata server —
+    # so the script best-effort scrubs its own metadata after provisioning
+    # (needs compute.instances.setMetadata on the VM's service account;
+    # harmless if denied) and operators should rotate the admin password
+    # via the users API after first login on shared projects.
+    users_env = shlex.quote(
+        "DTPU_USERS=" + json_mod.dumps({"admin": admin_password})
     )
+    args = f"--host 0.0.0.0 --port {port} --db /var/lib/dtpu/master.db"
     if tls:
         args += " --tls"
     if extra_args:
@@ -68,11 +77,20 @@ def startup_script(
         "set -euo pipefail",
         "id -u dtpu &>/dev/null || useradd -r -m dtpu",
         "mkdir -p /var/lib/dtpu && chown dtpu:dtpu /var/lib/dtpu",
+        "mkdir -p /etc/dtpu",
+        f"printf '%s\\n' {users_env} > /etc/dtpu/env",
+        "chown root:dtpu /etc/dtpu/env && chmod 0640 /etc/dtpu/env",
         package_source,
         "cat > /etc/systemd/system/dtpu-master.service <<'UNIT'",
         unit + "UNIT",
         "systemctl daemon-reload",
         "systemctl enable --now dtpu-master",
+        # best-effort metadata scrub (see note above)
+        "gcloud compute instances remove-metadata \"$(hostname)\" "
+        "--keys=startup-script "
+        "--zone=\"$(curl -s -H 'Metadata-Flavor: Google' "
+        "http://169.254.169.254/computeMetadata/v1/instance/zone "
+        "| awk -F/ '{print $NF}')\" || true",
     ]) + "\n"
 
 

@@ -136,6 +136,39 @@ def task_identity_violation(
     return None
 
 
+#: Denied-request audit budget: at most N rows per minute across all
+#: unauthenticated/unauthorized callers; overflow is counted and logged
+#: once per window instead of written (the ALLOWED mutations' audit is
+#: never limited). 120/min is ample for human-scale incident forensics and
+#: useless for a disk-filling attack.
+_DENIED_AUDIT_PER_MINUTE = 120
+_denied_audit_state = {"window": 0, "count": 0, "dropped": 0}
+_denied_audit_lock = threading.Lock()
+
+
+def _denied_audit_allowed() -> bool:
+    import time as _time
+
+    window = int(_time.time() // 60)
+    with _denied_audit_lock:
+        st = _denied_audit_state
+        if st["window"] != window:
+            if st["dropped"]:
+                logger.warning(
+                    "audit: suppressed %d denied-request rows last minute "
+                    "(rate limit %d/min)", st["dropped"],
+                    _DENIED_AUDIT_PER_MINUTE,
+                )
+            st["window"] = window
+            st["count"] = 0
+            st["dropped"] = 0
+        if st["count"] < _DENIED_AUDIT_PER_MINUTE:
+            st["count"] += 1
+            return True
+        st["dropped"] += 1
+        return False
+
+
 class ApiError(Exception):
     def __init__(self, status: int, message: str) -> None:
         super().__init__(message)
@@ -906,11 +939,15 @@ class ApiServer:
                     # Denied mutations are what an audit trail exists for
                     # (probing, stolen tokens, privilege testing) — record
                     # them like the in-handler audit does, same machine-
-                    # surface exclusions.
+                    # surface exclusions — BUT rate-limited: an
+                    # unauthenticated attacker hammering 401s must not be
+                    # able to grow the audit table (and fill the master's
+                    # disk) at the batched writer's full ingest speed.
                     if (
                         method in ("POST", "PATCH", "DELETE")
                         and not TASK_TOKEN_ROUTES.match(parsed.path)
                         and not AGENT_TOKEN_ROUTES.match(parsed.path)
+                        and _denied_audit_allowed()
                     ):
                         try:
                             master.db.add_audit(

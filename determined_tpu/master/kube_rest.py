@@ -102,6 +102,7 @@ class RestKubeClient(KubeClient):
         self, method: str, path: str, *, json_body: Any = None,
         params: Optional[Dict[str, str]] = None, ok_missing: bool = False,
         ok_conflict: bool = False, stream: bool = False,
+        timeout: Any = None,
     ) -> Optional[requests.Response]:
         """Call the apiserver with request_queue.go-style retries: transient
         statuses/conn errors back off and retry; 404 returns None when the
@@ -115,7 +116,8 @@ class RestKubeClient(KubeClient):
             try:
                 resp = self._http.request(
                     method, url, json=json_body, params=params,
-                    timeout=self._timeout, stream=stream,
+                    timeout=self._timeout if timeout is None else timeout,
+                    stream=stream,
                     # Explicit per request: an ambient REQUESTS_CA_BUNDLE
                     # would silently override a session-level setting.
                     verify=self._verify,
@@ -275,6 +277,11 @@ class RestKubeClient(KubeClient):
                         params={"follow": "true"},
                         stream=True,
                         ok_missing=True,
+                        # (connect, read): NO between-reads timeout — a
+                        # pod quiet for >30s (XLA compile, checkpoint
+                        # upload) must not kill the follower and silently
+                        # lose the rest of the run's stdout.
+                        timeout=(self._timeout, None),
                     )
                 except requests.HTTPError as e:
                     if (

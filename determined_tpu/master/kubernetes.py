@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import re
 import subprocess
 import sys
@@ -381,6 +382,7 @@ class LocalProcessKubeClient(KubeClient):
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             start_new_session=True,
+            bufsize=0,  # raw pipe: the drain thread selects on the fd
         )
         with self._lock:
             self._procs[spec["name"]] = proc
@@ -395,9 +397,11 @@ class LocalProcessKubeClient(KubeClient):
         return spec["name"]
 
     def _drain_logs(self, proc: subprocess.Popen, task_id: str) -> None:
+        import select as select_mod
         import time as _time
 
         assert proc.stdout is not None
+        fd = proc.stdout.fileno()
         batch: List[Dict[str, Any]] = []
         last_flush = _time.monotonic()
 
@@ -412,19 +416,39 @@ class LocalProcessKubeClient(KubeClient):
             batch = []
             last_flush = _time.monotonic()
 
+        buf = b""
         try:
             # Batch per burst (one DB txn per flush, like the agent and
-            # REST-driver shippers) instead of one insert per line.
-            for raw in proc.stdout:
-                batch.append({
-                    "log": raw.decode("utf-8", "replace").rstrip("\n"),
-                    "level": "INFO",
-                })
-                if len(batch) >= 64 or _time.monotonic() - last_flush > 1.0:
+            # REST-driver shippers) — with a TIMED flush via select: a
+            # task that prints once then computes silently must not have
+            # that line stuck in the batch until its next output
+            # (`dtpu trial logs -f` would show nothing for the quiet
+            # stretch).
+            while True:
+                r, _, _ = select_mod.select([fd], [], [], 1.0)
+                if r:
+                    chunk = os.read(fd, 65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                    *lines, buf = buf.split(b"\n")
+                    for raw in lines:
+                        batch.append({
+                            "log": raw.decode("utf-8", "replace"),
+                            "level": "INFO",
+                        })
+                if batch and (
+                    len(batch) >= 64
+                    or _time.monotonic() - last_flush > 1.0
+                ):
                     flush()
         except (OSError, ValueError):
             pass  # pipe closed at kill; routine
         finally:
+            if buf:
+                batch.append({
+                    "log": buf.decode("utf-8", "replace"), "level": "INFO",
+                })
             flush()
 
     def delete_pod(self, name: str) -> None:

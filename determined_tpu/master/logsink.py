@@ -194,8 +194,13 @@ class ElasticLogSink:
             lines.append(json.dumps({"index": {"_index": self.index}}))
             lines.append(json.dumps(doc))
         payload = ("\n".join(lines) + "\n").encode()
+        # refresh=wait_for: the search read path promises SQLite parity
+        # ("same lines either way"); without it, real ES's near-real-time
+        # refresh window (default 1s) would hide just-shipped lines from a
+        # search that flush() claimed were durable. Costs bulk latency on
+        # this background thread, not the ingest path.
         req = urllib.request.Request(
-            f"{self.base_url}/_bulk",
+            f"{self.base_url}/_bulk?refresh=wait_for",
             data=payload,
             headers={"Content-Type": "application/x-ndjson"},
         )

@@ -194,16 +194,25 @@ class ResourcePool:
 
     # -- introspection --------------------------------------------------------
     def queue_snapshot(self) -> Dict[str, Any]:
+        from determined_tpu.master.scheduler import FifoScheduler
+
+        # FIFO serves by arrival order ALONE — showing (priority, order)
+        # there would contradict actual dispatch whenever requests carry
+        # non-default priorities.
+        fifo = isinstance(self.scheduler, FifoScheduler)
         with self._lock:
-            # Pending in EFFECTIVE dispatch order — (priority, order), the
-            # key the FIFO/priority schedulers serve — not insertion order:
-            # the queue page's move-to-front must be visible in the list it
+            # Pending in EFFECTIVE dispatch order — the key this pool's
+            # scheduler actually serves — not insertion order: the queue
+            # page's move-to-front must be visible in the list it
             # reordered, or the UI looks broken even though scheduling
-            # changed (fair-share is share-driven and has no static order).
+            # changed (fair-share is share-driven and has no static order;
+            # (priority, order) is its closest static approximation).
             def key(a: str):
                 e = self._entries.get(a)
                 if e is None:
                     return (1 << 30, 1 << 30)
+                if fifo:
+                    return (0, e.request.order)
                 return (e.request.priority, e.request.order)
 
             return {

@@ -122,11 +122,15 @@ class TestDeployGcp:
         assert "--tls" in script              # TLS bootstrap by default
         assert "/var/lib/dtpu/master.db" in script
         assert "Restart=always" in script     # packaging .service parity
-        # Auth is mandatory: the generated admin password reaches both the
-        # unit args and the caller (an unauthenticated internet-reachable
-        # master would be remote code execution).
-        assert "--users" in script
+        # Auth is mandatory, and the credential travels via a root-owned
+        # EnvironmentFile (never the world-readable unit/argv); the
+        # startup script scrubs its own metadata afterwards best-effort.
+        assert "DTPU_USERS" in script
+        assert "EnvironmentFile=/etc/dtpu/env" in script
+        assert "chmod 0640 /etc/dtpu/env" in script
+        assert "remove-metadata" in script
         assert result["admin_password"] in script
+        assert f"--users" not in script  # never on the command line
         assert firewall[:4] == ["gcloud", "compute", "firewall-rules",
                                 "create"]
         assert "--source-ranges=10.0.0.0/8" in firewall

@@ -1,0 +1,154 @@
+"""Table-driven expconf cases — the analog of the reference's
+`schemas/test_cases/*.yaml` corpus (checked from both Go and Python there;
+here one validator serves every consumer, so one table pins the whole
+surface). Each case: (name, config mutation or full config, expected error
+needle or None for valid)."""
+import pytest
+
+from determined_tpu.master import expconf
+
+BASE = {
+    "entrypoint": "pkg.mod:Trial",
+    "searcher": {"name": "single", "max_length": 10, "metric": "loss"},
+    "hyperparameters": {"lr": 1e-3},
+    "resources": {"slots_per_trial": 1},
+}
+
+
+def _with(**over):
+    cfg = {k: dict(v) if isinstance(v, dict) else v for k, v in BASE.items()}
+    for k, v in over.items():
+        if v is ...:
+            cfg.pop(k, None)
+        else:
+            cfg[k] = v
+    return cfg
+
+
+CASES = [
+    # --- valid configs across the surface -------------------------------
+    ("minimal", _with(), None),
+    ("unmanaged_no_entrypoint", _with(entrypoint=..., unmanaged=True), None),
+    ("random_searcher",
+     _with(searcher={"name": "random", "max_trials": 4, "max_length": 5,
+                     "metric": "loss"}), None),
+    ("grid_searcher",
+     _with(searcher={"name": "grid", "max_length": 5, "metric": "loss"},
+           hyperparameters={"lr": {"type": "categorical",
+                                   "vals": [1e-3, 1e-2]}}), None),
+    ("asha",
+     _with(searcher={"name": "asha", "max_trials": 8, "max_length": 100,
+                     "num_rungs": 3, "metric": "loss"}), None),
+    ("adaptive_asha",
+     _with(searcher={"name": "adaptive_asha", "max_trials": 8,
+                     "max_length": 100, "metric": "loss"}), None),
+    ("custom_searcher",
+     _with(searcher={"name": "custom", "metric": "loss"}), None),
+    ("hp_types",
+     _with(hyperparameters={
+         "a": {"type": "const", "val": 3},
+         "b": {"type": "int", "minval": 1, "maxval": 5},
+         "c": {"type": "double", "minval": 0.0, "maxval": 1.0},
+         "d": {"type": "log", "minval": -4, "maxval": -1},
+         "e": {"type": "categorical", "vals": ["x", "y"]},
+         "nested": {"inner": {"type": "int", "minval": 0, "maxval": 2}},
+     }), None),
+    ("mesh_axes",
+     _with(mesh={"data": 2, "fsdp": 2, "tensor": 2, "context": 2,
+                 "pipeline": 1, "expert": 1}), None),
+    ("mesh_auto_axis", _with(mesh={"data": -1, "fsdp": 4}), None),
+    ("storage_shared_fs",
+     _with(checkpoint_storage={"type": "shared_fs", "host_path": "/x"}),
+     None),
+    ("storage_gcs",
+     _with(checkpoint_storage={"type": "gcs", "bucket": "b"}), None),
+    ("storage_s3",
+     _with(checkpoint_storage={"type": "s3", "bucket": "b"}), None),
+    ("storage_azure",
+     _with(checkpoint_storage={"type": "azure", "container": "c"}), None),
+    ("gc_policy",
+     _with(checkpoint_storage={"type": "gcs", "bucket": "b",
+                               "save_trial_best": 2,
+                               "save_trial_latest": 1}), None),
+    ("units_batches",
+     _with(min_checkpoint_period={"batches": 100},
+           min_validation_period={"epochs": 1},
+           scheduling_unit=50), None),
+    ("priority_bounds", _with(resources={"slots_per_trial": 0,
+                                         "priority": 0}), None),
+    # --- invalid configs: every error names its field --------------------
+    ("no_entrypoint", _with(entrypoint=...), "entrypoint"),
+    ("bad_searcher_name",
+     _with(searcher={"name": "bayesian", "metric": "loss"}),
+     "searcher.name"),
+    ("random_needs_max_trials",
+     _with(searcher={"name": "random", "max_length": 5, "metric": "loss"}),
+     "max_trials"),
+    ("asha_needs_max_trials",
+     _with(searcher={"name": "asha", "max_length": 5, "metric": "loss"}),
+     "max_trials"),
+    ("negative_max_length",
+     _with(searcher={"name": "single", "max_length": -1, "metric": "loss"}),
+     "max_length"),
+    ("searcher_not_object", _with(searcher="single"), "searcher"),
+    ("bad_hp_type",
+     _with(hyperparameters={"lr": {"type": "gaussian"}}), "unknown type"),
+    ("categorical_without_vals",
+     _with(hyperparameters={"o": {"type": "categorical"}}), "vals"),
+    ("range_without_bounds",
+     _with(hyperparameters={"lr": {"type": "double", "minval": 0.1}}),
+     "maxval"),
+    ("inverted_range",
+     _with(hyperparameters={"lr": {"type": "int", "minval": 5,
+                                   "maxval": 1}}), "minval > maxval"),
+    ("range_not_numbers",
+     _with(hyperparameters={"lr": {"type": "double", "minval": "a",
+                                   "maxval": "b"}}), "numbers"),
+    ("hp_not_object", _with(hyperparameters=[1, 2]), "hyperparameters"),
+    ("unknown_mesh_axis", _with(mesh={"rows": 2}), "unknown axis"),
+    ("bad_mesh_size", _with(mesh={"data": 0}), "positive int"),
+    ("mesh_not_object", _with(mesh=[2, 2]), "mesh"),
+    ("bad_storage_type",
+     _with(checkpoint_storage={"type": "ftp"}), "checkpoint_storage.type"),
+    ("shared_fs_needs_path",
+     _with(checkpoint_storage={"type": "shared_fs"}), "host_path"),
+    ("gcs_needs_bucket",
+     _with(checkpoint_storage={"type": "gcs"}), "bucket"),
+    ("azure_needs_container",
+     _with(checkpoint_storage={"type": "azure"}), "container"),
+    ("negative_gc",
+     _with(checkpoint_storage={"type": "gcs", "bucket": "b",
+                               "save_trial_best": -1}),
+     "save_trial_best"),
+    ("bad_restarts", _with(max_restarts=-2), "max_restarts"),
+    ("priority_out_of_range",
+     _with(resources={"slots_per_trial": 1, "priority": 120}), "priority"),
+    ("negative_slots",
+     _with(resources={"slots_per_trial": -1}), "slots_per_trial"),
+    ("resources_not_object", _with(resources=3), "resources"),
+    ("config_not_object", [1, 2, 3], "object"),
+]
+
+
+@pytest.mark.parametrize(
+    "name,config,needle", CASES, ids=[c[0] for c in CASES]
+)
+def test_case(name, config, needle):
+    errors = expconf.validate(config) if isinstance(config, dict) else (
+        expconf.validate(config)
+    )
+    if needle is None:
+        assert errors == [], f"{name}: unexpectedly invalid: {errors}"
+    else:
+        assert any(needle in e for e in errors), (
+            f"{name}: wanted error containing {needle!r}, got {errors}"
+        )
+
+
+def test_every_valid_case_survives_full_apply():
+    """Valid cases must also pass the full shim→merge→validate pipeline
+    (defaults must not un-validate them)."""
+    for name, config, needle in CASES:
+        if needle is None and isinstance(config, dict):
+            merged, _ = expconf.apply(config)
+            assert merged.get("max_restarts") is not None, name

@@ -217,6 +217,19 @@ class TestQueueOps:
         assert orders["a2"] < orders["a1"]
         assert orders["a0"] <= orders["a2"]
 
+    def test_fifo_snapshot_ignores_priorities(self):
+        """FIFO pools dispatch by arrival alone; the snapshot must show
+        THAT order even when requests carry priorities (a priority-sorted
+        view would contradict actual dispatch)."""
+        from determined_tpu.master.rm import ResourcePool
+
+        pool = ResourcePool("p", {"type": "fifo"})  # no agents: all pending
+        pool.submit(Request("first", 4, priority=50),
+                    lambda *a: None, lambda *a: None)
+        pool.submit(Request("second", 4, priority=10),
+                    lambda *a: None, lambda *a: None)
+        assert pool.queue_snapshot()["pending"] == ["first", "second"]
+
     def test_snapshot_reflects_reorder(self):
         """queue_snapshot lists pending in EFFECTIVE dispatch order — a
         move-to-front must be visible to the queue page/CLI, not just to

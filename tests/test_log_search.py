@@ -52,7 +52,7 @@ class FakeElastic:
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n).decode()
-                if self.path == "/_bulk":
+                if self.path.split("?")[0] == "/_bulk":
                     lines = [json.loads(l) for l in body.strip().split("\n")]
                     with outer._lock:
                         for action, doc in zip(lines[::2], lines[1::2]):

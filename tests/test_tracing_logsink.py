@@ -147,7 +147,7 @@ class TestLogSink:
                 time.sleep(0.1)
             assert _BulkCapture.captured, "sink never received a bulk"
             path, body = _BulkCapture.captured[0]
-            assert path == "/_bulk"
+            assert path == "/_bulk?refresh=wait_for"  # NRT parity for the search read path
             lines = [json.loads(l) for l in body.strip().split("\n")]
             # NDJSON action/doc pairs
             assert lines[0] == {"index": {"_index": "dtpu-task-logs"}}
