@@ -52,10 +52,19 @@ def startup_script(
         )
     import json as json_mod
 
-    # The credential reaches the master via a root-written 0600
-    # EnvironmentFile (DTPU_USERS), NOT the ExecStart command line — unit
-    # files are world-readable and `ps` shows argv. json.dumps keeps the
-    # baked credential byte-identical to the one returned to the operator.
+    # The credential reaches the master via a root-written EnvironmentFile
+    # (DTPU_USERS), NOT the ExecStart command line — unit files are
+    # world-readable and `ps` shows argv. systemd's env-file parser
+    # unescapes backslashes and quotes in values, which would corrupt the
+    # JSON between here and the master's json.loads — so passwords
+    # containing those characters are rejected up front (the generated
+    # token_urlsafe default never does).
+    if any(ch in admin_password for ch in ('"', "\\", "'", "\n")):
+        raise ValueError(
+            "admin_password must not contain quotes, backslashes, or "
+            "newlines (systemd EnvironmentFile unescaping would corrupt "
+            "the stored credential)"
+        )
     #
     # RESIDUAL EXPOSURE: the startup SCRIPT itself rides instance metadata,
     # readable by compute.viewer principals and the VM's metadata server —

@@ -397,11 +397,16 @@ class LocalProcessKubeClient(KubeClient):
         return spec["name"]
 
     def _drain_logs(self, proc: subprocess.Popen, task_id: str) -> None:
-        import select as select_mod
+        import selectors
         import time as _time
 
         assert proc.stdout is not None
         fd = proc.stdout.fileno()
+        # selectors (poll-backed), not select(): select() raises on fds >=
+        # FD_SETSIZE (1024), which a busy master with many tasks/sockets
+        # can reach — and that ValueError would silently end this drain.
+        sel = selectors.DefaultSelector()
+        sel.register(fd, selectors.EVENT_READ)
         batch: List[Dict[str, Any]] = []
         last_flush = _time.monotonic()
 
@@ -425,8 +430,8 @@ class LocalProcessKubeClient(KubeClient):
             # (`dtpu trial logs -f` would show nothing for the quiet
             # stretch).
             while True:
-                r, _, _ = select_mod.select([fd], [], [], 1.0)
-                if r:
+                ready = sel.select(timeout=1.0)
+                if ready:
                     chunk = os.read(fd, 65536)
                     if not chunk:
                         break
@@ -445,6 +450,7 @@ class LocalProcessKubeClient(KubeClient):
         except (OSError, ValueError):
             pass  # pipe closed at kill; routine
         finally:
+            sel.close()
             if buf:
                 batch.append({
                     "log": buf.decode("utf-8", "replace"), "level": "INFO",

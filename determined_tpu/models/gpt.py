@@ -332,20 +332,39 @@ class GPT(Model):
             )
             if ctx > 1:
                 # Pipeline × sequence parallelism: the pipeline shard_map is
-                # manual on BOTH axes, so each stage runs ring attention
-                # over its seq shard directly (the context axis rotates K/V
-                # by ppermute while pipeline ppermutes stage hand-offs —
-                # independent meshes of the same program).
-                from determined_tpu.parallel.ring import ring_attention
+                # manual on BOTH axes, so each stage runs sequence-parallel
+                # attention over its seq shard directly. Ring by default
+                # (and mandatory for zigzag layouts — Ulysses re-gathers
+                # the full sequence per head subset and its dense causal
+                # mask assumes contiguous order); Ulysses when configured.
+                if c.attn_impl == "ulysses":
+                    if c.sequence_layout == "zigzag":
+                        # Same error the non-pipeline dispatcher raises
+                        # (attention.py): silently overriding an explicit
+                        # impl choice hides a misconfiguration.
+                        raise ValueError(
+                            "layout='zigzag' requires ring attention; "
+                            "Ulysses re-gathers the full sequence and its "
+                            "dense causal mask assumes contiguous order"
+                        )
+                    from determined_tpu.parallel.ulysses import (
+                        ulysses_attention,
+                    )
 
-                o = ring_attention(
-                    q, k, v, axis_name="context", causal=True,
-                    block_q=c.flash_block_q, block_k=c.flash_block_k,
-                    layout=(
-                        "zigzag" if c.sequence_layout == "zigzag"
-                        else "contiguous"
-                    ),
-                )
+                    o = ulysses_attention(
+                        q, k, v, axis_name="context", causal=True
+                    )
+                else:
+                    from determined_tpu.parallel.ring import ring_attention
+
+                    o = ring_attention(
+                        q, k, v, axis_name="context", causal=True,
+                        block_q=c.flash_block_q, block_k=c.flash_block_k,
+                        layout=(
+                            "zigzag" if c.sequence_layout == "zigzag"
+                            else "contiguous"
+                        ),
+                    )
             else:
                 if c.sequence_layout == "zigzag":
                     # Same guard the attention dispatcher enforces: a dense

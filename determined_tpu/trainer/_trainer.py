@@ -244,12 +244,23 @@ class Trainer:
         def put_with_key(key, x):
             if key in replicated_keys:
                 # Cache per key+identity: these are CONSTANT across steps
-                # (the dataset yields the same position array every batch),
-                # and on multi-host a fresh device_put of a replicated
-                # array runs a cross-process equality check — a host-sync
-                # collective that must not ride the steady-state step loop.
+                # (the dataset yields the same position array object every
+                # batch), and on multi-host a fresh device_put of a
+                # replicated array runs a cross-process equality check — a
+                # host-sync collective that must not ride the steady-state
+                # step loop. CONTRACT: replicated batch arrays must not be
+                # mutated in place (yield a new array to change values —
+                # an identity miss just re-places, it never breaks). The
+                # DTPU_DEBUG mode verifies the contract each step.
                 cached = self._replicated_cache.get(key)
                 if cached is not None and cached[0] is x:
+                    if os.environ.get("DTPU_DEBUG") and not np.array_equal(
+                        np.asarray(x), np.asarray(cached[1])
+                    ):
+                        raise RuntimeError(
+                            f"replicated batch key {key!r} was mutated in "
+                            "place; yield a fresh array instead"
+                        )
                     return cached[1]
                 placed = jax.device_put(np.asarray(x), replicated)
                 self._replicated_cache[key] = (x, placed)

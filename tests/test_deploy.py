@@ -130,7 +130,7 @@ class TestDeployGcp:
         assert "chmod 0640 /etc/dtpu/env" in script
         assert "remove-metadata" in script
         assert result["admin_password"] in script
-        assert f"--users" not in script  # never on the command line
+        assert "--users" not in script  # never on the command line
         assert firewall[:4] == ["gcloud", "compute", "firewall-rules",
                                 "create"]
         assert "--source-ranges=10.0.0.0/8" in firewall

@@ -134,9 +134,7 @@ CASES = [
     "name,config,needle", CASES, ids=[c[0] for c in CASES]
 )
 def test_case(name, config, needle):
-    errors = expconf.validate(config) if isinstance(config, dict) else (
-        expconf.validate(config)
-    )
+    errors = expconf.validate(config)
     if needle is None:
         assert errors == [], f"{name}: unexpectedly invalid: {errors}"
     else:

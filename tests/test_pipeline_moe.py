@@ -63,6 +63,27 @@ class TestPipelineParallel:
         )(params, batch)
         np.testing.assert_allclose(float(ref_loss), float(loss), rtol=2e-2)
 
+    def test_pipeline_x_ulysses_matches_plain(self, devices8):
+        """PP × Ulysses SP: stages swap seq↔heads by all-to-all and run
+        full-sequence attention per head subset — same loss as plain."""
+        batch = _batch()
+        plain = GPT(_cfg())
+        params = plain.init(jax.random.PRNGKey(0))
+        ref_loss = plain.loss(params, batch, jax.random.PRNGKey(0))[0]
+
+        mesh = make_mesh(
+            MeshConfig(data=2, pipeline=2, context=2), devices=devices8
+        )
+        piped = GPT(
+            _cfg(pipeline_stages=2, num_microbatches=4,
+                 attn_impl="ulysses"),
+            mesh=mesh,
+        )
+        loss = jax.jit(
+            lambda p, b: piped.loss(p, b, jax.random.PRNGKey(0))[0]
+        )(params, batch)
+        np.testing.assert_allclose(float(ref_loss), float(loss), rtol=2e-2)
+
     def test_pp_x_sp_gradients_flow(self, devices8):
         mesh = make_mesh(
             MeshConfig(data=2, pipeline=2, context=2), devices=devices8
