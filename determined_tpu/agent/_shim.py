@@ -1,0 +1,51 @@
+"""Per-task supervisor shim: records the task's exit code to a file.
+
+The agent spawns every task through this shim so the exit code survives an
+agent-process restart: a re-adopted task is no longer the (new) agent
+process's child, so ``wait()`` is impossible for it — the shim, which IS
+the parent, persists the code to the exit file for whichever agent
+incarnation observes the death. This is the piece that makes container
+reattach work (ref: agent/internal/containers/manager.go:76 reattach +
+aproto/master_message.go:46 ContainerReattachAck — there the container
+runtime persists the exit state; here the shim does).
+
+The shim runs in the task's process group, so the agent's group-wide
+SIGTERM/SIGKILL escalation reaches it alongside the task. On SIGTERM it
+forwards a terminate to the child (a second TERM is harmless — the
+harness's preemption latch is idempotent) and still records the exit.
+A SIGKILL'd group leaves no exit file; the agent reports "exit code
+unknown" for that case.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+
+
+def main() -> int:
+    exit_file = sys.argv[1]
+    cmd = sys.argv[2:]
+    proc = subprocess.Popen(cmd)
+
+    def forward_term(signum: int, frame: object) -> None:  # noqa: ARG001
+        try:
+            proc.terminate()
+        except OSError:
+            pass
+
+    signal.signal(signal.SIGTERM, forward_term)
+    code = proc.wait()
+    tmp = exit_file + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            f.write(str(code))
+        os.replace(tmp, exit_file)
+    except OSError:
+        pass  # state dir vanished (agent cleanup); nothing left to tell
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

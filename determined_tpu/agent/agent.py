@@ -8,22 +8,42 @@ chips via libtpu). START actions spawn `determined_tpu.exec.prep_and_run`
 with the DTPU_* env; exits are reported back as events; stdout/stderr is
 shipped to the master's task-log store (replacing the ws ContainerLog path,
 aproto/master_message.go:41).
+
+Reattach (ref: containers/manager.go:76 + aproto/master_message.go:46-55):
+a running task survives both master and agent restarts. Tasks log to FILES
+in a persistent state dir (not pipes — a pipe dies with its reader), each
+task has a state file (pid + start-time + shipped-log offset) and a
+supervisor shim (_shim.py) that persists the exit code. On (re)registration
+the agent reports its live allocations; the master answers with which were
+adopted vs orphaned, and only the orphans are killed. A restarted agent
+process re-adopts live pids from the state dir and resumes log shipping at
+the recorded offset.
 """
 from __future__ import annotations
 
+import json
 import logging
 import os
 import signal
 import socket
 import subprocess
 import sys
+import tempfile
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from determined_tpu.common.api_session import Session
 
 logger = logging.getLogger("determined_tpu.agent")
+
+
+class SlotDetectionError(RuntimeError):
+    """The accelerator stack is present but broken. The host must refuse to
+    register rather than fall back to a 1-slot CPU agent — a TPU host whose
+    runtime is wedged would otherwise silently join the pool with the wrong
+    shape and poison gang fitting (ref: agent/internal/detect/detect.go:19,
+    which likewise errors out rather than guessing)."""
 
 
 def detect_slots(spec: Any = "auto") -> int:
@@ -31,25 +51,67 @@ def detect_slots(spec: Any = "auto") -> int:
 
     "auto" asks the TPU runtime via jax — only safe when the agent host's
     chips are not yet claimed by a trial; an int (or --artificial-slots dev
-    mode) skips detection.
+    mode) skips detection. No-jax hosts register as 1-slot CPU agents;
+    jax-present-but-failing hosts raise SlotDetectionError (see above).
     """
     if isinstance(spec, int):
         return spec
     if spec == "auto":
         try:
             import jax
-
-            return len(jax.local_devices())
-        except Exception:  # noqa: BLE001 - no accelerator: CPU-only agent
+        except Exception:  # noqa: BLE001 - no accelerator stack: CPU-only agent
             return 1
+        try:
+            return len(jax.local_devices())
+        except Exception as e:  # noqa: BLE001
+            raise SlotDetectionError(
+                f"accelerator runtime present but device detection failed: {e}"
+            ) from e
     return int(spec)
 
 
+def _proc_stat(pid: int) -> Optional[Tuple[int, str]]:
+    """(starttime, state-letter) from /proc/<pid>/stat, or None if gone.
+
+    starttime (field 22) disambiguates pid reuse across agent restarts;
+    state 'Z' marks a zombie — dead for our purposes even though /proc
+    still lists it."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            data = f.read().decode("ascii", "replace")
+        rest = data.rsplit(")", 1)[1].split()
+        return int(rest[19]), rest[0]
+    except (OSError, IndexError, ValueError):
+        return None
+
+
 class _Task:
-    def __init__(self, alloc_id: str, task_id: str, proc: subprocess.Popen) -> None:
+    def __init__(
+        self,
+        alloc_id: str,
+        task_id: str,
+        *,
+        pid: int,
+        slots: int,
+        log_path: str,
+        exit_file: str,
+        state_path: str,
+        proc: Optional[subprocess.Popen] = None,
+        offset: int = 0,
+        start_time: Optional[int] = None,
+    ) -> None:
         self.alloc_id = alloc_id
         self.task_id = task_id
-        self.proc = proc
+        self.pid = pid
+        self.slots = slots
+        self.log_path = log_path
+        self.exit_file = exit_file
+        self.state_path = state_path
+        self.proc = proc  # None when re-adopted (not our child)
+        self.offset = offset  # log bytes already shipped
+        self.start_time = start_time
+        self.done = threading.Event()  # process observed dead
+        self.follower: Optional[threading.Thread] = None
 
 
 class AgentDaemon:
@@ -61,6 +123,7 @@ class AgentDaemon:
         pool: str = "default",
         python_exe: Optional[str] = None,
         token: str = "",
+        state_dir: Optional[str] = None,
     ) -> None:
         self.master_url = master_url
         self.agent_id = agent_id or socket.gethostname()
@@ -68,23 +131,64 @@ class AgentDaemon:
         self.pool = pool
         self.session = Session(master_url, token=token)
         self.python_exe = python_exe or sys.executable
+        # State dir is the reattach anchor: task state files, log files and
+        # exit files live here. An ephemeral default still gives master-
+        # restart survival (same agent process); agent-restart survival
+        # needs a stable --state-dir, as on a real TPU VM.
+        self._ephemeral_state = state_dir is None
+        self.state_dir = state_dir or tempfile.mkdtemp(
+            prefix=f"dtpu-agent-{self.agent_id}-"
+        )
+        os.makedirs(self.state_dir, exist_ok=True)
         self._tasks: Dict[str, _Task] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
-        self._dead = False  # die(): suppress exit reports (abrupt loss)
+        self._dead = False       # die(): suppress exit reports (abrupt loss)
+        self._detached = False   # detach(): agent "crashed", tasks live on
+        #: exits observed while the master was unreachable (or while this
+        #: agent was down): reported after the next successful registration.
+        self._pending_exits: List[Tuple[_Task, Optional[int]]] = []
+        self._recover_tasks()
 
     # -- lifecycle -----------------------------------------------------------
-    def register(self) -> None:
-        self.session.post(
+    def register(self) -> bool:
+        """(Re)register, reporting live allocations for reattach. Returns
+        True when the master asked us to hold some allocs and retry (its
+        experiment restore hasn't caught up yet)."""
+        with self._lock:
+            running = [
+                {"alloc_id": t.alloc_id, "task_id": t.task_id, "slots": t.slots}
+                for t in self._tasks.values()
+            ]
+            # Allocs whose exit report is still pending delivery: the master
+            # must not mistake them for silently-lost work and fail them
+            # over — the real exit code is seconds away.
+            exiting = [t.alloc_id for t, _ in self._pending_exits]
+        resp = self.session.post(
             "/api/v1/agents",
             json_body={
-                "agent_id": self.agent_id, "slots": self.slots, "pool": self.pool,
+                "agent_id": self.agent_id, "slots": self.slots,
+                "pool": self.pool, "running_allocs": running,
+                "exiting_allocs": exiting,
             },
-        )
+        ) or {}
+        orphaned = set(resp.get("orphaned") or [])
+        retry = set(resp.get("retry") or [])
+        for alloc_id in orphaned:
+            with self._lock:
+                task = self._tasks.get(alloc_id)
+            if task is not None:
+                logger.info("master disowned %s; killing it", alloc_id)
+                self._kill(task)
+        adopted = set(resp.get("adopted") or [])
         logger.info(
-            "agent %s registered: %d slots in pool %s",
+            "agent %s registered: %d slots in pool %s%s",
             self.agent_id, self.slots, self.pool,
+            f" (reattach: {len(adopted)} adopted, {len(orphaned)} orphaned)"
+            if running else "",
         )
+        self._flush_pending_exits()
+        return bool(retry)
 
     def run_forever(self) -> None:
         needs_register = True
@@ -94,12 +198,19 @@ class AgentDaemon:
                 # swallowed failure here must not leave the agent invisible
                 # (the master answers polls for unknown agents too).
                 try:
-                    self.register()
-                    needs_register = False
+                    needs_register = self.register()
                 except Exception as e:  # noqa: BLE001
                     logger.warning("register failed (%s); retrying", e)
                     time.sleep(2)
                     continue
+                if needs_register:
+                    time.sleep(1)  # master restore in progress; re-offer
+                    continue
+            if self._pending_exits:
+                # Exits the master deferred (503 during its restore) or
+                # that failed mid-flight: keep offering them — they carry
+                # completed work.
+                self._flush_pending_exits()
             try:
                 resp = self.session.get(
                     f"/api/v1/agents/{self.agent_id}/actions",
@@ -110,13 +221,18 @@ class AgentDaemon:
                 time.sleep(2)
                 needs_register = True  # master may have restarted
                 continue
+            if self._stop.is_set() or self._detached:
+                # detach()/stop() landed while the long-poll was in flight:
+                # these actions belong to our successor — executing them
+                # here would create ghost tasks nobody ships logs for.
+                break
             for action in resp.get("actions", []):
                 if action.get("type") == "REREGISTER":
-                    # Master doesn't know us (restart or liveness reap). Our
-                    # allocations were failed over on the master side, so
-                    # kill the local orphans before advertising free slots —
-                    # otherwise they'd fight the restarted trial for chips.
-                    self._kill_all_tasks()
+                    # Master doesn't know us (restart or liveness reap).
+                    # Do NOT kill local tasks — re-register offering them
+                    # for reattach; the master's answer names the true
+                    # orphans (ref: the reattach redesign of aproto
+                    # ErrAgentMustReconnect, master_message.go:46-55).
                     needs_register = True
                     continue
                 try:
@@ -133,6 +249,21 @@ class AgentDaemon:
     def stop(self) -> None:
         self._stop.set()
         self._kill_all_tasks()
+        if self._ephemeral_state:
+            import shutil
+
+            # Auto-created state dirs must not accumulate under /tmp; a
+            # real deployment passes --state-dir and keeps it (reattach).
+            shutil.rmtree(self.state_dir, ignore_errors=True)
+
+    def detach(self) -> None:
+        """Simulate an agent-process crash WITHOUT killing its tasks: stop
+        polling, reporting and shipping, leave the subprocesses running
+        (they log to files, not pipes, so they don't notice). A successor
+        AgentDaemon on the same state_dir re-adopts them — the e2e shape of
+        a real agent binary restart on a TPU VM."""
+        self._detached = True
+        self._stop.set()
 
     def die(self) -> None:
         """Abrupt death (spot-reclaim simulation): kill everything and
@@ -142,6 +273,96 @@ class AgentDaemon:
         misattribute the loss as a workload crash (budget charge)."""
         self._dead = True
         self.stop()
+
+    # -- task state files ------------------------------------------------------
+    def _write_state(self, task: _Task) -> None:
+        tmp = task.state_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(
+                    {
+                        "alloc_id": task.alloc_id, "task_id": task.task_id,
+                        "pid": task.pid, "start_time": task.start_time,
+                        "slots": task.slots, "offset": task.offset,
+                    },
+                    f,
+                )
+            os.replace(tmp, task.state_path)
+        except OSError as e:
+            logger.warning("state write failed for %s: %s", task.alloc_id, e)
+
+    def _cleanup_state(self, task: _Task) -> None:
+        for path in (task.state_path, task.exit_file, task.log_path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def _recover_tasks(self) -> None:
+        """Re-adopt tasks recorded in the state dir (agent restart). Live
+        pids become tracked tasks again; dead ones are queued for exit
+        reporting after registration (their exit code comes from the shim's
+        exit file — ref containers/manager.go:76 reattach)."""
+        try:
+            names = sorted(os.listdir(self.state_dir))
+        except OSError:
+            return
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.state_dir, name)
+            try:
+                with open(path) as f:
+                    st = json.load(f)
+            except (OSError, ValueError):
+                continue
+            alloc_id = str(st.get("alloc_id", ""))
+            if not alloc_id:
+                continue
+            task = _Task(
+                alloc_id,
+                str(st.get("task_id", "")),
+                pid=int(st.get("pid", 0)),
+                slots=int(st.get("slots", 0)),
+                log_path=os.path.join(self.state_dir, f"{alloc_id}.log"),
+                exit_file=os.path.join(self.state_dir, f"{alloc_id}.exit"),
+                state_path=path,
+                proc=None,
+                offset=int(st.get("offset", 0)),
+                start_time=st.get("start_time"),
+            )
+            stat = _proc_stat(task.pid) if task.pid else None
+            alive = (
+                stat is not None
+                and stat[1] != "Z"
+                and (task.start_time is None or stat[0] == task.start_time)
+            )
+            if alive:
+                logger.info(
+                    "re-adopting running task %s (pid %d)", alloc_id, task.pid
+                )
+                with self._lock:
+                    self._tasks[alloc_id] = task
+                self._spawn_task_threads(task)
+            else:
+                logger.info(
+                    "task %s died while agent was down; will report", alloc_id
+                )
+                task.done.set()
+                self._pending_exits.append((task, self._read_exit_file(task)))
+
+    def _flush_pending_exits(self) -> None:
+        with self._lock:
+            pending, self._pending_exits = self._pending_exits, []
+        for task, code in pending:
+            try:
+                self._ship_log_tail(task)
+                self._report_exit(task, code)
+            except Exception as e:  # noqa: BLE001 - master flaked again: requeue
+                logger.warning("pending exit report failed for %s: %s",
+                               task.alloc_id, e)
+                with self._lock:
+                    self._pending_exits.append((task, code))
 
     # -- actions ---------------------------------------------------------------
     def handle(self, action: Dict[str, Any]) -> None:
@@ -160,84 +381,246 @@ class AgentDaemon:
         env = dict(os.environ)
         env.update(action["env"])
         env["DTPU_ENTRYPOINT"] = action.get("entrypoint", "")
-        proc = subprocess.Popen(
-            [self.python_exe, "-m", "determined_tpu.exec.prep_and_run"],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-            start_new_session=True,  # own process group: clean KILL semantics
+        # Line-buffered task stdout: log lines reach the file (and thus the
+        # master) as they happen, not when a 8k block fills.
+        env.setdefault("PYTHONUNBUFFERED", "1")
+        alloc_id = action["alloc_id"]
+        log_path = os.path.join(self.state_dir, f"{alloc_id}.log")
+        exit_file = os.path.join(self.state_dir, f"{alloc_id}.exit")
+        for stale in (log_path, exit_file):
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
+        logf = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                [
+                    self.python_exe, "-m", "determined_tpu.agent._shim",
+                    exit_file,
+                    self.python_exe, "-m", "determined_tpu.exec.prep_and_run",
+                ],
+                env=env,
+                stdout=logf,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,  # own process group: clean KILL semantics
+            )
+        finally:
+            logf.close()  # the child holds its own descriptor
+        task = _Task(
+            alloc_id,
+            action.get("task_id", ""),
+            pid=proc.pid,
+            slots=int(env.get("DTPU_SLOTS", "0") or 0),
+            log_path=log_path,
+            exit_file=exit_file,
+            state_path=os.path.join(self.state_dir, f"{alloc_id}.json"),
+            proc=proc,
         )
-        task = _Task(action["alloc_id"], action.get("task_id", ""), proc)
+        stat = _proc_stat(proc.pid)
+        task.start_time = stat[0] if stat else None
         with self._lock:
             self._tasks[task.alloc_id] = task
-        threading.Thread(
-            target=self._ship_logs, args=(task,), daemon=True,
+        self._write_state(task)
+        self._spawn_task_threads(task)
+        logger.info("started %s (pid %d)", task.alloc_id, proc.pid)
+
+    def _spawn_task_threads(self, task: _Task) -> None:
+        task.follower = threading.Thread(
+            target=self._follow_logs, args=(task,), daemon=True,
             name=f"logs-{task.alloc_id}",
-        ).start()
+        )
+        task.follower.start()
         threading.Thread(
             target=self._wait_exit, args=(task,), daemon=True,
             name=f"wait-{task.alloc_id}",
         ).start()
-        logger.info("started %s (pid %d)", task.alloc_id, proc.pid)
 
-    def _ship_logs(self, task: _Task) -> None:
-        """Batch stdout lines to the master (ref: tasklogger batching)."""
-        assert task.proc.stdout is not None
-        batch = []
-        last_flush = time.time()
+    # -- log shipping ----------------------------------------------------------
+    _READ_CAP = 1 << 20
 
-        def flush() -> None:
-            nonlocal batch, last_flush
-            if batch:
+    def _follow_logs(self, task: _Task) -> None:
+        """Tail the task's log FILE and ship in batches. The shipped offset
+        persists in the state file, so nothing is lost or duplicated across
+        agent restarts, and a failed ship retries instead of dropping the
+        batch (unlike a pipe, the data is still on disk)."""
+        failures_after_done = 0
+        while not self._detached:
+            chunk = b""
+            try:
+                with open(task.log_path, "rb") as f:
+                    f.seek(task.offset)
+                    chunk = f.read(self._READ_CAP)
+            except OSError:
+                pass
+            done = task.done.is_set()
+            if chunk:
+                nl = chunk.rfind(b"\n")
+                if nl >= 0:
+                    end = nl + 1
+                elif done or len(chunk) >= self._READ_CAP:
+                    # Final partial line, or a single line longer than the
+                    # read cap: ship what we have.
+                    end = len(chunk)
+                else:
+                    time.sleep(0.2)
+                    continue
                 try:
-                    self.session.post(
-                        "/api/v1/task_logs",
-                        json_body={"task_id": task.task_id, "logs": batch},
-                    )
+                    # _ship_lines advances task.offset per shipped sub-batch,
+                    # so a mid-chunk failure resumes after the delivered
+                    # lines instead of duplicating them.
+                    self._ship_lines(task, chunk[:end])
+                    continue  # immediately look for more
                 except Exception as e:  # noqa: BLE001
-                    logger.warning("log ship failed: %s", e)
-                batch = []
-            last_flush = time.time()
+                    logger.warning("log ship failed for %s: %s", task.alloc_id, e)
+                    if done:
+                        failures_after_done += 1
+                        if failures_after_done > 30:
+                            return  # master gone for good; stop retrying
+                    time.sleep(2.0)
+                    continue
+            if done:
+                return
+            time.sleep(0.2)
 
-        for line in task.proc.stdout:
-            batch.append({"ts": time.time(), "log": line.rstrip("\n")})
-            if len(batch) >= 64 or time.time() - last_flush > 2.0:
-                flush()
-        flush()
+    def _ship_lines(self, task: _Task, data: bytes) -> None:
+        """Ship `data` (bytes from task.offset) in sub-batches, advancing
+        task.offset AFTER each delivered sub-batch — a failure mid-way
+        resumes exactly after the delivered lines (no loss, no dupes).
+        Splits on raw bytes so byte accounting survives undecodable input."""
+        lines = data.split(b"\n")
+        if lines and lines[-1] == b"":
+            lines.pop()
+        base = task.offset
+        total = len(data)
+        consumed = 0
+        now = time.time()
+        for i in range(0, len(lines), 256):
+            sub = lines[i:i + 256]
+            self.session.post(
+                "/api/v1/task_logs",
+                json_body={
+                    "task_id": task.task_id,
+                    "logs": [
+                        {"ts": now, "log": ln.decode("utf-8", "replace")}
+                        for ln in sub
+                    ],
+                },
+            )
+            # +1 per newline; the final line may lack one (partial-line
+            # ship at process death) — clamp to the data we actually had.
+            consumed = min(total, consumed + sum(len(ln) + 1 for ln in sub))
+            task.offset = base + consumed
+            self._write_state(task)
+
+    def _ship_log_tail(self, task: _Task) -> None:
+        """Synchronous drain for tasks that died while the agent was away."""
+        try:
+            with open(task.log_path, "rb") as f:
+                f.seek(task.offset)
+                data = f.read()
+        except OSError:
+            return
+        if data:
+            self._ship_lines(task, data)
+
+    # -- exit handling ---------------------------------------------------------
+    def _read_exit_file(self, task: _Task) -> Optional[int]:
+        try:
+            with open(task.exit_file) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
 
     def _wait_exit(self, task: _Task) -> None:
-        code = task.proc.wait()
+        code: Optional[int] = None
+        if task.proc is not None:
+            code = task.proc.wait()
+        else:
+            code = self._poll_dead(task)
+        if self._detached:
+            return  # the successor agent owns this task now
+        task.done.set()
+        if code is None:
+            code = self._read_exit_file(task)
         with self._lock:
             self._tasks.pop(task.alloc_id, None)
         if self._dead:
             return  # abrupt death: no goodbye (see die())
+        # Let the follower drain the log tail before the master tears down
+        # the task's log routing.
+        if task.follower is not None:
+            task.follower.join(timeout=15.0)
         try:
-            self.session.post(
-                f"/api/v1/agents/{self.agent_id}/events",
-                json_body={
-                    "type": "EXITED", "alloc_id": task.alloc_id,
-                    "exit_code": code,
-                    "reason": "" if code == 0 else f"exit code {code}",
-                },
-            )
+            self._report_exit(task, code)
         except Exception as e:  # noqa: BLE001
             logger.error("failed to report exit of %s: %s", task.alloc_id, e)
+            with self._lock:
+                self._pending_exits.append((task, code))
+
+    def _poll_dead(self, task: _Task) -> Optional[int]:
+        """Wait for a re-adopted (non-child) pid to ACTUALLY die. Tries
+        waitpid anyway — in the same-process devcluster simulation the task
+        IS our child and yields a real exit code; otherwise /proc polling.
+        Keeps polling through stop() (the concurrent _kill escalates to
+        SIGKILL, so death is bounded) — returning early on _stop would
+        report a still-running process as exited and delete its reattach
+        state. Only detach() abandons the wait (successor owns the task)."""
+        while not self._detached:
+            try:
+                pid, status = os.waitpid(task.pid, os.WNOHANG)
+                if pid == task.pid:
+                    return os.waitstatus_to_exitcode(status)
+            except (ChildProcessError, OSError):
+                pass  # not our child: true cross-process re-adoption
+            stat = _proc_stat(task.pid)
+            if (
+                stat is None
+                or stat[1] == "Z"
+                or (task.start_time is not None and stat[0] != task.start_time)
+            ):
+                return None  # gone; shim's exit file may hold the code
+            time.sleep(0.3)
+        return None
+
+    def _report_exit(self, task: _Task, code: Optional[int]) -> None:
+        if code is None:
+            code, reason = 1, "process lost (exit code unknown)"
+        else:
+            reason = "" if code == 0 else f"exit code {code}"
+        self.session.post(
+            f"/api/v1/agents/{self.agent_id}/events",
+            json_body={
+                "type": "EXITED", "alloc_id": task.alloc_id,
+                "exit_code": code, "reason": reason,
+            },
+        )
+        self._cleanup_state(task)
         logger.info("%s exited with %d", task.alloc_id, code)
 
     def _kill(self, task: _Task, grace_s: float = 10.0) -> None:
-        """SIGTERM the group, escalate to SIGKILL (ref: container stop flow)."""
+        """SIGTERM the group, escalate to SIGKILL (ref: container stop flow).
+        Works for both owned (child) and re-adopted (non-child) tasks."""
         try:
-            os.killpg(os.getpgid(task.proc.pid), signal.SIGTERM)
+            pgid = os.getpgid(task.pid)
         except (ProcessLookupError, PermissionError):
             return
         try:
-            task.proc.wait(timeout=grace_s)
-        except subprocess.TimeoutExpired:
-            try:
-                os.killpg(os.getpgid(task.proc.pid), signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                pass
+            os.killpg(pgid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        deadline = time.time() + grace_s
+        while time.time() < deadline:
+            if task.done.is_set():
+                return
+            stat = _proc_stat(task.pid)
+            if stat is None or stat[1] == "Z":
+                return
+            time.sleep(0.2)
+        try:
+            os.killpg(pgid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
 
 
 def main() -> None:
@@ -249,13 +632,17 @@ def main() -> None:
     parser.add_argument("--slots", default="auto",
                         help='"auto", or an int (artificial slots)')
     parser.add_argument("--pool", default="default")
+    parser.add_argument("--state-dir", default=None,
+                        help="persistent task-state dir (enables reattach "
+                             "across agent restarts)")
     parser.add_argument("--token", default=os.environ.get("DTPU_TOKEN", ""),
                         help="auth token (when the master has users configured)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
     slots: Any = args.slots if args.slots == "auto" else int(args.slots)
     AgentDaemon(
-        args.master_url, args.agent_id, slots, args.pool, token=args.token
+        args.master_url, args.agent_id, slots, args.pool, token=args.token,
+        state_dir=args.state_dir,
     ).run_forever()
 
 

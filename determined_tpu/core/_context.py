@@ -151,7 +151,11 @@ def init(
             distributed=distributed, checkpoint_storage=checkpoint_storage
         )
 
-    session = Session(info.master_url, token=info.session_token)
+    # Generous retry budget: the task-plane session must ride out a master
+    # restart (~tens of seconds of connection errors) so a re-adopted trial
+    # keeps training instead of crashing into its restart budget
+    # (reattach; ref restore.go:59).
+    session = Session(info.master_url, token=info.session_token, max_retries=12)
 
     if distributed is None:
         rdzv = info.rendezvous

@@ -74,9 +74,12 @@ class DevCluster:
             self.start_agent(f"agent-{i}", slots_per_agent)
 
     # -- agents (start/kill for chaos tests, ref test_agent_restart.py) -------
-    def start_agent(self, agent_id: str, slots: int) -> AgentDaemon:
+    def start_agent(
+        self, agent_id: str, slots: int, state_dir: Optional[str] = None
+    ) -> AgentDaemon:
         agent = AgentDaemon(
-            self.api.url, agent_id=agent_id, slots=slots, python_exe=sys.executable
+            self.api.url, agent_id=agent_id, slots=slots,
+            python_exe=sys.executable, state_dir=state_dir,
         )
         thread = threading.Thread(
             target=agent.run_forever, daemon=True, name=f"agent-{agent_id}"
@@ -85,6 +88,23 @@ class DevCluster:
         self.agents.append(agent)
         self._agent_threads.append(thread)
         return agent
+
+    def restart_agent(self, agent: AgentDaemon) -> AgentDaemon:
+        """Simulate an agent-binary restart: the old daemon 'crashes'
+        (detach — its task subprocesses keep running against their log
+        files) and a successor on the same state dir re-adopts them
+        (ref: containers/manager.go:76 reattach)."""
+        agent.detach()
+        if agent in self.agents:
+            self.agents.remove(agent)
+        successor = self.start_agent(
+            agent.agent_id, agent.slots, state_dir=agent.state_dir
+        )
+        # Inherit ephemeralness: an auto-created /tmp state dir must still
+        # be cleaned by whoever stops LAST, or chaos tests strand one dir
+        # per restart.
+        successor._ephemeral_state = agent._ephemeral_state
+        return successor
 
     def kill_agent(self, agent: AgentDaemon) -> None:
         # Order matters for failure attribution: the master learns of the

@@ -81,8 +81,14 @@ def rendezvous(master_url: str, alloc_id: str, rank: int, num_procs: int) -> Non
 
 def _task_session(master_url: str) -> Session:
     """Session carrying the task's credential (DTPU_SESSION_TOKEN): on an
-    auth-enabled master, rendezvous/files/signals all require it."""
-    return Session(master_url, token=os.environ.get("DTPU_SESSION_TOKEN", ""))
+    auth-enabled master, rendezvous/files/signals all require it. The high
+    retry budget rides out master restarts (reattach keeps tasks alive
+    through them)."""
+    return Session(
+        master_url,
+        token=os.environ.get("DTPU_SESSION_TOKEN", ""),
+        max_retries=12,
+    )
 
 
 def prepare_context(master_url: str) -> None:

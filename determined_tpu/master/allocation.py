@@ -75,6 +75,25 @@ class AllocationService:
         with self._lock:
             return self._allocs.get(alloc_id)
 
+    def adopt(
+        self, alloc_id: str, *, task_id: str, trial_id: Optional[int],
+        num_processes: int, slots: int,
+    ) -> Allocation:
+        """Recreate a live allocation from its persisted row (master-restart
+        reattach, ref restore.go:59): the task processes already ran
+        rendezvous, so the record starts RUNNING with an empty address table
+        — num_processes still sizes any future allgather rounds."""
+        with self._cond:
+            alloc = self._allocs.get(alloc_id)
+            if alloc is None:
+                alloc = Allocation(
+                    id=alloc_id, task_id=task_id, trial_id=trial_id,
+                    num_processes=num_processes, slots=slots, state=RUNNING,
+                )
+                self._allocs[alloc_id] = alloc
+            self._cond.notify_all()
+            return alloc
+
     def complete(
         self, alloc_id: str, exit_code: int = 0, reason: str = "",
         infra: bool = False,

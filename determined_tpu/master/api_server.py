@@ -463,12 +463,15 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
 
     # -- agents ---------------------------------------------------------------
     def register_agent(r: ApiRequest):
-        agent_id = r.body["agent_id"]
-        pool = r.body.get("pool", "default")
-        slots = int(r.body.get("slots", 0))
-        m.agent_hub.register(agent_id, slots, pool)
-        m.rm.pool(pool).add_agent(agent_id, slots)
-        return {"cluster_id": m.cluster_id}
+        res = m.agent_registered(
+            r.body["agent_id"],
+            int(r.body.get("slots", 0)),
+            r.body.get("pool", "default"),
+            r.body.get("running_allocs") or [],
+            r.body.get("exiting_allocs") or [],
+        )
+        res["cluster_id"] = m.cluster_id
+        return res
 
     def agent_actions(r: ApiRequest):
         return {
@@ -478,7 +481,11 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         }
 
     def agent_events(r: ApiRequest):
-        m.agent_event(r.groups[0], r.body)
+        if m.agent_event(r.groups[0], r.body) is False:
+            # Experiment restore hasn't caught up with this exit report;
+            # 503 keeps it pending on the agent (retryable) instead of
+            # swallowing it.
+            raise ApiError(503, "restore in progress; retry")
         return {}
 
     def list_agents(r: ApiRequest):
@@ -891,11 +898,19 @@ class ApiServer:
 
             def _dispatch(self, method: str) -> None:
                 if getattr(self.server, "stopping", False):
-                    # One choke point for ALL response paths (JSON, plain,
-                    # proxy): a stopped server's lingering handler threads
-                    # must not keep serving keep-alive clients from stale
-                    # state across an in-process restart.
-                    self.close_connection = True
+                    # A stopped server's lingering keep-alive handler
+                    # threads must not serve — and above all not MUTATE —
+                    # from stale state across an in-process master restart
+                    # (a real crash resets connections at the OS level; an
+                    # op_completed absorbed by the zombie would be lost to
+                    # the successor). 503 is retryable: the client's next
+                    # attempt lands on the new master.
+                    try:
+                        self._send(503, {"error": "master stopping"},
+                                   close=True)
+                    except (BrokenPipeError, ConnectionResetError, OSError):
+                        pass
+                    return
                 parsed = urlparse(self.path)
                 is_proxy = parsed.path.startswith("/proxy/")
                 token = self._auth_token(parsed, proxy=is_proxy)

@@ -70,6 +70,13 @@ class AuthService:
         self._tokens: Dict[str, Dict] = {}   # token -> {user, expires}
         self._ttl = session_ttl_s
         self._lock = threading.Lock()
+        self._persist_lock = threading.Lock()
+        #: fired (outside the lock) whenever the token store changes; the
+        #: master persists the store to the DB so sessions AND task tokens
+        #: survive restarts — a re-adopted trial's DTPU_SESSION_TOKEN must
+        #: keep authenticating (the reference keeps user_sessions in
+        #: Postgres for the same reason).
+        self.on_change: Optional[Any] = None
 
     # -- RBAC --------------------------------------------------------------
     def effective_role(self, username: str) -> str:
@@ -208,6 +215,7 @@ class AuthService:
             self._tokens[token] = {
                 "user": username, "expires": time.time() + self._ttl,
             }
+        self._changed()
         return token
 
     #: task/agent tokens live until revoked at task exit; the 30-day ceiling
@@ -239,6 +247,7 @@ class AuthService:
                 "user": principal,
                 "expires": time.time() + self.TASK_TOKEN_TTL_S,
             }
+        self._changed()
         return token
 
     def validate(self, token: Optional[str]) -> Optional[str]:
@@ -258,22 +267,70 @@ class AuthService:
 
     def logout(self, token: str) -> None:
         with self._lock:
-            self._tokens.pop(token, None)
+            removed = self._tokens.pop(token, None) is not None
+        if removed:
+            self._changed()
 
     def revoke_for_task(self, task_id: str) -> None:
         """Drop a finished task's tokens — they must not outlive the task."""
         principal = f"task:{task_id}"
         with self._lock:
-            for tok in [
+            stale = [
                 t for t, e in self._tokens.items() if e["user"] == principal
-            ]:
+            ]
+            for tok in stale:
                 del self._tokens[tok]
+        if stale:
+            self._changed()
 
     def sweep(self) -> None:
         """Remove expired tokens (the store must not grow unboundedly)."""
         now = time.time()
         with self._lock:
-            for tok in [
+            stale = [
                 t for t, e in self._tokens.items() if now > e["expires"]
-            ]:
+            ]
+            for tok in stale:
                 del self._tokens[tok]
+        if stale:
+            self._changed()
+
+    # -- persistence (token store survives master restarts) -----------------
+    def token_state(self) -> Dict[str, Any]:
+        with self._lock:
+            return {t: dict(e) for t, e in self._tokens.items()}
+
+    def load_token_state(self, state: Optional[Dict[str, Any]]) -> None:
+        if not state:
+            return
+        now = time.time()
+        with self._lock:
+            for tok, e in state.items():
+                if not isinstance(e, dict):
+                    continue
+                try:
+                    expires = float(e.get("expires", 0))
+                except (TypeError, ValueError):
+                    continue
+                if expires > now:
+                    self._tokens.setdefault(
+                        tok, {"user": str(e.get("user", "")), "expires": expires}
+                    )
+
+    def _changed(self) -> None:
+        cb = self.on_change
+        if cb is None:
+            return
+        # _persist_lock serializes snapshot+write: two racing changes could
+        # otherwise persist out of order and drop the newer token from the
+        # kv store (a crash before the next change would then 401 a live
+        # re-adopted trial). Ordering is _persist_lock -> _lock only.
+        with self._persist_lock:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 - persistence is best-effort
+                import logging
+
+                logging.getLogger("determined_tpu.master").exception(
+                    "auth token persistence failed"
+                )

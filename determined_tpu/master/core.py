@@ -36,6 +36,16 @@ class AgentHub:
         self._cond = threading.Condition(self._lock)
         self._agents: Dict[str, Dict[str, Any]] = {}
         self._queues: Dict[str, List[Dict[str, Any]]] = {}
+        self._closed = False
+
+    def close(self) -> None:
+        """Master shutdown: release blocked long-polls immediately. Agents
+        then hit connection errors on their next poll and re-register
+        against the successor — holding them the full poll timeout would
+        delay reattach past short trials."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
 
     def register(self, agent_id: str, slots: int, pool: str) -> None:
         with self._cond:
@@ -59,6 +69,8 @@ class AgentHub:
                 # slots come back (ref: aproto ErrAgentMustReconnect).
                 return [{"type": "REREGISTER"}]
             while True:
+                if self._closed:
+                    return []
                 # Refresh liveness every wait cycle, not just at poll entry:
                 # an agent blocked in a 30s long-poll is connected and alive,
                 # and must not age past agent_timeout_s while it waits (that
@@ -101,9 +113,35 @@ class AgentHub:
             a = self._agents.get(agent_id)
             return a["pool"] if a else None
 
+    def has_pending_start(self, agent_id: str, alloc_id: str) -> bool:
+        """True if a START for this alloc is still queued, undelivered.
+        Distinguishes 'the agent never received the work' (leave it — the
+        queued action will start it) from 'the agent received and lost it'
+        (fail it over) during re-registration reconciliation."""
+        with self._lock:
+            return any(
+                a.get("type") == "START" and a.get("alloc_id") == alloc_id
+                for a in self._queues.get(agent_id, [])
+            )
+
     def list(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
             return {k: dict(v) for k, v in self._agents.items()}
+
+
+def _trial_request(exp: Experiment, alloc_id: str) -> Request:
+    """The allocation Request for a trial, derived from the experiment
+    config — single source for both the launch and the reattach-adopt
+    paths (they must never drift)."""
+    resources = exp.config.get("resources", {})
+    return Request(
+        alloc_id=alloc_id,
+        slots=int(resources.get("slots_per_trial", 1)),
+        priority=int(resources.get("priority", 50)),
+        weight=float(resources.get("weight", 1.0)),
+        group_id=str(exp.id),
+        preemptible=True,
+    )
 
 
 class RMTrialLauncher:
@@ -123,19 +161,8 @@ class RMTrialLauncher:
         slots = int(resources.get("slots_per_trial", 1))
         alloc_id = f"{experiment.id}.{rec.trial_id}.{rec.run_id}"
         task_id = f"trial-{rec.trial_id}"
-        request = Request(
-            alloc_id=alloc_id,
-            slots=slots,
-            priority=int(resources.get("priority", 50)),
-            weight=float(resources.get("weight", 1.0)),
-            group_id=str(experiment.id),
-            preemptible=True,
-        )
-        pool_name = resources.get("resource_pool") or self.m.rm.pool().name
-        with self.m._lock:
-            self.m._alloc_index[alloc_id] = (experiment, rec.trial_id)
-            self.m._trial_allocs[rec.trial_id] = alloc_id
-            self.m._alloc_pool[alloc_id] = pool_name
+        request = _trial_request(experiment, alloc_id)
+        pool_name = self.m._index_trial_alloc(alloc_id, experiment, rec.trial_id)
 
         def on_start(req: Request, assignment: Dict[str, int]) -> None:
             trial_row = self.m.db.get_trial(rec.trial_id) or {}
@@ -198,6 +225,7 @@ class Master:
         preempt_timeout_s: float = 600.0,
         agent_timeout_s: float = 120.0,
         unmanaged_timeout_s: float = 300.0,
+        reconcile_grace_s: float = 30.0,
         users: Optional[Dict[str, str]] = None,
         config_defaults: Optional[Dict[str, Any]] = None,
         kube_client: Optional[Any] = None,
@@ -257,10 +285,28 @@ class Master:
         # Role overrides + groups persist across master restarts (the
         # reference's usergroup tables; here the kv store).
         self.auth.load_rbac_state(self.db.get_kv("rbac"))
+        # Sessions + task/agent tokens persist too (the reference keeps
+        # user_sessions in Postgres): a re-adopted trial's DTPU_SESSION_TOKEN
+        # must still authenticate against the restarted master, or reattach
+        # would 401 the running trainer to death.
+        self.auth.load_token_state(self.db.get_kv("auth_tokens"))
+        self.auth.on_change = lambda: self.db.set_kv(
+            "auth_tokens", self.auth.token_state()
+        )
         self.proxy = ProxyRegistry()
         self.launcher = RMTrialLauncher(self)
         self.agent_timeout_s = agent_timeout_s
         self.unmanaged_timeout_s = unmanaged_timeout_s
+        self.reconcile_grace_s = reconcile_grace_s
+        #: restored-but-not-yet-reattached live trials: trial_id -> (exp, rec).
+        #: Agents re-registering within the grace window re-adopt them; the
+        #: reconcile sweep relaunches the rest (ref restore.go:59).
+        self._awaiting_adoption: Dict[int, tuple] = {}
+        self._reconcile_deadline: Optional[float] = None
+        #: serializes reattach adoption vs the reconcile sweep's relaunch —
+        #: without it an agent registering at deadline expiry could adopt a
+        #: trial the sweep is simultaneously relaunching (two live runs).
+        self._adopt_lock = threading.Lock()
         self._heartbeats: Dict[int, float] = {}    # trial_id -> last beat
         self.experiments: Dict[int, Experiment] = {}
         self._alloc_index: Dict[str, tuple] = {}   # alloc_id -> (exp, trial_id)
@@ -310,6 +356,22 @@ class Master:
             name = self._alloc_pool.get(alloc_id)
         return self.rm.pool(name)
 
+    def _index_trial_alloc(
+        self, alloc_id: str, exp: Experiment, trial_id: int
+    ) -> str:
+        """Record the alloc→(exp, trial)/pool maps used by exit handling;
+        shared by launch (RMTrialLauncher) and reattach adoption so the
+        bookkeeping cannot drift between the two paths. Returns the pool."""
+        pool_name = (
+            exp.config.get("resources", {}).get("resource_pool")
+            or self.rm.pool().name
+        )
+        with self._lock:
+            self._alloc_index[alloc_id] = (exp, trial_id)
+            self._trial_allocs[trial_id] = alloc_id
+            self._alloc_pool[alloc_id] = pool_name
+        return pool_name
+
     def kill_allocation(self, alloc_id: str) -> None:
         """Hard-stop a placed allocation, whatever realizes it: KILL actions
         to agents, pod deletion on a Kubernetes pool (pool hook)."""
@@ -340,7 +402,7 @@ class Master:
         )
         self.db.upsert_allocation(
             alloc_id, task_id=task_id, trial_id=trial_id,
-            state="ASSIGNED", slots=slots,
+            state="ASSIGNED", slots=slots, num_processes=len(hosts),
         )
         # Allocation lifecycle span (explicit start/end — completes in
         # _allocation_exited, the long-span pattern of the reference's otel
@@ -406,6 +468,7 @@ class Master:
                 # applies; ref agent reattach flow, containers/manager.go:76).
                 for agent_id in self.agent_hub.reap_stale(self.agent_timeout_s):
                     self.lose_agent(agent_id)
+                self._reconcile_sweep()
                 self._reap_unmanaged()
                 self._reap_idle_commands()
                 self.auth.sweep()
@@ -473,6 +536,229 @@ class Master:
                 except Exception:  # noqa: BLE001
                     logger.exception("idle kill failed for %s", c["task_id"])
 
+    # -- agent (re)registration + reattach -------------------------------------
+    def agent_registered(
+        self,
+        agent_id: str,
+        slots: int,
+        pool: str,
+        running_allocs: Optional[List[Dict[str, Any]]] = None,
+        exiting_allocs: Optional[List[str]] = None,
+    ) -> Dict[str, List[str]]:
+        """(Re)registration with container reattach (ref: restore.go:59 +
+        aproto/master_message.go:46-55 ContainerReattachAck): the agent
+        reports its live allocations; each is adopted (keeps running),
+        orphaned (agent must kill it), or deferred for a retry (this
+        master's experiment restore hasn't caught up yet). `exiting_allocs`
+        are dead tasks whose exit report is about to be delivered — they
+        must not be failed over as lost."""
+        self.agent_hub.register(agent_id, slots, pool)
+        self.rm.pool(pool).add_agent(agent_id, slots)
+        adopted: List[str] = []
+        orphaned: List[str] = []
+        retry: List[str] = []
+        for item in running_allocs or []:
+            alloc_id = str(item.get("alloc_id", ""))
+            if not alloc_id:
+                continue
+            item_slots = int(item.get("slots", 0) or 0)
+            try:
+                verdict = self._try_adopt(alloc_id, agent_id, item_slots)
+            except Exception:  # noqa: BLE001 - never kill work on a master bug
+                logger.exception("adoption check failed for %s", alloc_id)
+                verdict = "retry"
+            if verdict == "retry":
+                # Hold the chips while the verdict is pending: without a
+                # reservation the scheduler would see free slots and START
+                # new work onto a TPU the retry task's libtpu still owns.
+                self.rm.pool(pool).adopt(
+                    Request(
+                        alloc_id=alloc_id, slots=item_slots,
+                        group_id="reattach-hold", preemptible=False,
+                    ),
+                    agent_id, item_slots,
+                    lambda a: None,
+                )
+            elif verdict == "orphan":
+                # Clear any hold from an earlier retry round; the agent is
+                # about to kill the process.
+                self.rm.pool(pool).release(alloc_id)
+            {"adopt": adopted, "orphan": orphaned, "retry": retry}[
+                verdict
+            ].append(alloc_id)
+        self._reconcile_unreported(
+            agent_id, pool,
+            {str(i.get("alloc_id", "")) for i in running_allocs or []}
+            | {str(a) for a in exiting_allocs or []},
+        )
+        if adopted or orphaned or retry:
+            logger.info(
+                "agent %s reattach: adopted=%s orphaned=%s retry=%s",
+                agent_id, adopted, orphaned, retry,
+            )
+        return {"adopted": adopted, "orphaned": orphaned, "retry": retry}
+
+    def _reconcile_unreported(
+        self, agent_id: str, pool_name: str, reported: set
+    ) -> None:
+        """The other direction of the reattach diff: allocations the MASTER
+        books on this agent that the agent did NOT report are gone (its
+        host rebooted, or its state dir was lost). Preserving their slot
+        occupancy would leak capacity forever and leave the trial hanging —
+        fail them over. A START still sitting undelivered in the agent's
+        action queue is exempt: the agent never had that work."""
+        pool = self.rm.pool(pool_name)
+        booked = pool.allocs_on_agent(agent_id)
+        for alloc_id in booked:
+            if alloc_id in reported:
+                continue
+            if self.agent_hub.has_pending_start(agent_id, alloc_id):
+                continue
+            logger.warning(
+                "agent %s re-registered without allocation %s; failing it "
+                "over", agent_id, alloc_id,
+            )
+            # Surviving gang members on OTHER agents still hold chips for
+            # this alloc — kill them before the requeue (lose_agent flow).
+            assignment = pool.assignment_of(alloc_id) or {}
+            for other in assignment:
+                if other != agent_id:
+                    self.agent_hub.enqueue(
+                        other, {"type": "KILL", "alloc_id": alloc_id}
+                    )
+            if self.alloc_service.get(alloc_id) is None:
+                pool.release(alloc_id)  # occupancy with no lifecycle record
+            else:
+                self.alloc_service.complete(
+                    alloc_id, exit_code=1,
+                    reason=f"agent {agent_id} lost the allocation",
+                    infra=True,
+                )
+
+    def _try_adopt(self, alloc_id: str, agent_id: str, slots: int) -> str:
+        """One reported-running allocation → "adopt" | "orphan" | "retry"."""
+        alloc = self.alloc_service.get(alloc_id)
+        if alloc is not None:
+            if alloc.state == "TERMINATED":
+                return "orphan"
+            # Live in this master (agent-process restart): occupancy was
+            # preserved through add_agent; just make sure this agent's share
+            # is recorded (covers an agent record that was recreated).
+            with self._lock:
+                pool_name = self._alloc_pool.get(alloc_id)
+                exp_trial = self._alloc_index.get(alloc_id)
+            if exp_trial is not None:
+                request = _trial_request(exp_trial[0], alloc_id)
+            else:
+                request = Request(
+                    alloc_id=alloc_id, slots=alloc.slots,
+                    group_id=alloc.task_id, preemptible=False,
+                )
+            self.rm.pool(pool_name).adopt(
+                request, agent_id, slots or alloc.slots,
+                lambda a: self.alloc_service.signal_preempt(a),
+            )
+            return "adopt"
+        row = self.db.get_allocation(alloc_id)
+        if row is None or row.get("state") == "TERMINATED":
+            return "orphan"
+        trial_id = row.get("trial_id")
+        if trial_id is None:
+            # Generic commands/notebooks are in-memory records; a master
+            # restart loses their configs, so they cannot be re-owned.
+            # Conscious divergence: the reference reattaches those too.
+            return "orphan"
+        with self._lock:
+            exp = next(
+                (e for e in self.experiments.values() if trial_id in e.trials),
+                None,
+            )
+        if exp is None:
+            # Experiment not restored (yet). Terminal on disk → never will
+            # be; otherwise hold the task and ask the agent to re-offer.
+            t_row = self.db.get_trial(int(trial_id))
+            if t_row is None:
+                return "orphan"
+            e_row = self.db.get_experiment(int(t_row["experiment_id"]))
+            if e_row is None or e_row["state"] in db_mod.TERMINAL_STATES:
+                return "orphan"
+            return "retry"
+        rec = exp.trials.get(int(trial_id))
+        if rec is None or rec.exited:
+            return "orphan"
+        # Adopt: rebuild everything launch() + enqueue_start_actions would
+        # have built, minus the START actions — the processes already run.
+        # Under _adopt_lock so the reconcile sweep cannot relaunch this
+        # trial mid-adoption (the run_id check must be atomic with the
+        # bookkeeping).
+        with self._adopt_lock:
+            if alloc_id != f"{exp.id}.{rec.trial_id}.{rec.run_id}":
+                return "orphan"  # stale run: a newer relaunch owns the trial
+            pool_name = self._index_trial_alloc(alloc_id, exp, rec.trial_id)
+            with self._lock:
+                self._awaiting_adoption.pop(rec.trial_id, None)
+            self.rm.pool(pool_name).adopt(
+                _trial_request(exp, alloc_id),
+                agent_id, slots or int(row.get("slots") or 0),
+                lambda a: self.alloc_service.signal_preempt(a),
+            )
+            self.alloc_service.adopt(
+                alloc_id,
+                task_id=row.get("task_id") or f"trial-{trial_id}",
+                trial_id=int(trial_id),
+                num_processes=int(row.get("num_processes") or 1),
+                slots=int(row.get("slots") or 0),
+            )
+        span = self.tracer.start_span(
+            "allocation",
+            {
+                "alloc.id": alloc_id, "task.id": row.get("task_id"),
+                "task.type": "TRIAL", "slots": row.get("slots"),
+                "adopted": True,
+            },
+        )
+        with self._lock:
+            self._alloc_spans.setdefault(alloc_id, span)
+        self.db.upsert_allocation(alloc_id, state="RUNNING")
+        logger.info(
+            "re-adopted allocation %s on agent %s; trial %s continues "
+            "without a restart", alloc_id, agent_id, trial_id,
+        )
+        return "adopt"
+
+    def _reconcile_sweep(self) -> None:
+        """Relaunch restored live trials whose agents never reattached
+        within the grace window (checkpoint-resume fallback)."""
+        with self._lock:
+            if (
+                self._reconcile_deadline is None
+                or time.time() < self._reconcile_deadline
+            ):
+                return
+            pending = list(self._awaiting_adoption.values())
+            self._awaiting_adoption.clear()
+            self._reconcile_deadline = None
+        for exp, rec in pending:
+            if rec.exited:
+                continue
+            # _adopt_lock + live-alloc re-check: an agent registering at
+            # deadline expiry may have just adopted this trial; relaunching
+            # it too would put two runs on the chips.
+            with self._adopt_lock:
+                with self._lock:
+                    if rec.trial_id in self._trial_allocs:
+                        continue
+                logger.info(
+                    "trial %d not reattached within %.0fs; relaunching from "
+                    "checkpoint", rec.trial_id, self.reconcile_grace_s,
+                )
+                try:
+                    exp.relaunch_trial(rec.trial_id)
+                except Exception:  # noqa: BLE001
+                    logger.exception(
+                        "relaunch failed for trial %d", rec.trial_id
+                    )
+
     def lose_agent(self, agent_id: str) -> None:
         """Remove a dead agent and fail over everything it was running."""
         logger.warning("agent %s lost; failing over its allocations", agent_id)
@@ -522,6 +808,7 @@ class Master:
 
     def shutdown(self) -> None:
         self._stop.set()
+        self.agent_hub.close()
         self.webhooks.stop()
         self.tracer.stop()
         if self.log_sink is not None:
@@ -603,9 +890,24 @@ class Master:
         with self._lock:
             return self.experiments.get(exp_id)
 
-    def restore_experiments(self) -> int:
-        """Master-restart recovery (ref: restore.go:59 restoreExperiment)."""
+    def restore_experiments(
+        self, reconcile_grace_s: Optional[float] = None
+    ) -> int:
+        """Master-restart recovery (ref: restore.go:59 restoreExperiment).
+
+        Live trials are NOT relaunched immediately: they enter a reattach
+        grace window during which re-registering agents re-adopt their
+        still-running processes (zero restarts, zero checkpoint rollback).
+        Only trials no agent claims within the window are requeued from
+        their latest checkpoint. grace 0 forces the old requeue-everything
+        behavior."""
+        grace = (
+            self.reconcile_grace_s
+            if reconcile_grace_s is None
+            else reconcile_grace_s
+        )
         n = 0
+        awaiting = 0
         for row in self.db.list_experiments():
             if row["state"] in db_mod.TERMINAL_STATES:
                 continue
@@ -620,8 +922,22 @@ class Master:
             with self._lock:
                 self.experiments[row["id"]] = exp
             if snapshot:
-                exp.relaunch_live_trials()
+                if grace > 0 and not exp.unmanaged:
+                    with self._lock:
+                        for rec in exp.trials.values():
+                            if not rec.exited:
+                                self._awaiting_adoption[rec.trial_id] = (exp, rec)
+                                awaiting += 1
+                else:
+                    exp.relaunch_live_trials()
             n += 1
+        if awaiting:
+            with self._lock:
+                self._reconcile_deadline = time.time() + grace
+            logger.info(
+                "restore: %d live trial(s) awaiting agent reattach "
+                "(%.0fs grace)", awaiting, grace,
+            )
         return n
 
     # -- NTSC generic tasks (ref: internal/command/{command.go,ntsc.go}) --------
@@ -710,13 +1026,72 @@ class Master:
         self.kill_allocation(alloc_id)
 
     # -- agent events -----------------------------------------------------------
-    def agent_event(self, agent_id: str, event: Dict[str, Any]) -> None:
+    def agent_event(self, agent_id: str, event: Dict[str, Any]) -> bool:
+        """Returns False when the event must be retried later (the master's
+        experiment restore hasn't caught up) — the API layer answers 503 so
+        the agent's report stays pending instead of being swallowed."""
         kind = event.get("type")
         if kind == "EXITED":
-            self.alloc_service.complete(
-                event["alloc_id"],
-                exit_code=int(event.get("exit_code", 0)),
-                reason=event.get("reason", ""),
-            )
+            alloc_id = event["alloc_id"]
+            code = int(event.get("exit_code", 0))
+            reason = event.get("reason", "")
+            if self.alloc_service.get(alloc_id) is None:
+                # Exit for an allocation this master never adopted — e.g.
+                # the trial finished during the master bounce and the exit
+                # report raced ahead of the agent's re-registration.
+                # Dropping it would leave the restored trial waiting out
+                # the reconcile grace and relaunching work that is already
+                # done; route it to the trial FSM directly.
+                return self._exit_unadopted(alloc_id, code, reason)
+            self.alloc_service.complete(alloc_id, exit_code=code, reason=reason)
         else:
             logger.warning("unknown agent event %r from %s", kind, agent_id)
+        return True
+
+    def _exit_unadopted(self, alloc_id: str, code: int, reason: str) -> bool:
+        """An EXITED event for an allocation with no live record: if it is
+        the current run of a restored live trial, finish that trial's FSM
+        (reattach completion path); a stale run is ignored. Returns False
+        — "ask the agent to retry" — when the owning experiment exists on
+        disk but is not restored yet (accepting would silently discard the
+        exit and force a needless relaunch of finished work)."""
+        row = self.db.get_allocation(alloc_id)
+        if row is None or row.get("trial_id") is None:
+            return True
+        trial_id = int(row["trial_id"])
+        with self._lock:
+            exp = next(
+                (e for e in self.experiments.values() if trial_id in e.trials),
+                None,
+            )
+        if exp is None:
+            t_row = self.db.get_trial(trial_id)
+            e_row = (
+                self.db.get_experiment(int(t_row["experiment_id"]))
+                if t_row else None
+            )
+            if e_row is not None and e_row["state"] not in db_mod.TERMINAL_STATES:
+                return False  # restore in progress: have the agent re-send
+            return True
+        rec = exp.trials.get(trial_id)
+        if rec is None or rec.exited:
+            return True
+        if alloc_id != f"{exp.id}.{rec.trial_id}.{rec.run_id}":
+            return True  # a stale superseded run; the current one is live
+        with self._lock:
+            self._awaiting_adoption.pop(trial_id, None)
+        self.db.upsert_allocation(
+            alloc_id, state="TERMINATED", ended_at=time.time(),
+            exit_reason=reason,
+        )
+        # Mirror _allocation_exited's teardown: the (persisted!) task token
+        # must not outlive the task, nor its proxy routes the process.
+        task_id = row.get("task_id") or f"trial-{trial_id}"
+        self.auth.revoke_for_task(task_id)
+        self.proxy.unregister(task_id)
+        logger.info(
+            "un-adopted allocation %s exited (%d); completing trial %d "
+            "directly", alloc_id, code, trial_id,
+        )
+        exp.trial_exited(trial_id, code, reason)
+        return True

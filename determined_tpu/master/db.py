@@ -85,6 +85,7 @@ CREATE TABLE IF NOT EXISTS allocations (
     trial_id INTEGER,
     state TEXT NOT NULL,
     slots INTEGER DEFAULT 0,
+    num_processes INTEGER DEFAULT 1,
     started_at REAL, ended_at REAL, exit_reason TEXT
 );
 CREATE TABLE IF NOT EXISTS webhooks (
@@ -147,6 +148,8 @@ INSERT OR IGNORE INTO projects (id, name, workspace_id, created_at) VALUES (1, '
 MIGRATIONS = (
     "ALTER TABLE trials ADD COLUMN infra_requeues INTEGER DEFAULT 0",
     "ALTER TABLE task_logs ADD COLUMN rank INTEGER",  # log-search filter
+    # reattach: adoption must rebuild the allocation's gang size
+    "ALTER TABLE allocations ADD COLUMN num_processes INTEGER DEFAULT 1",
 )
 
 
@@ -705,10 +708,11 @@ class Database:
         if not existing:
             self._execute(
                 "INSERT INTO allocations (id, task_id, trial_id, state, slots,"
-                " started_at) VALUES (?,?,?,?,?,?)",
+                " num_processes, started_at) VALUES (?,?,?,?,?,?,?)",
                 (
                     alloc_id, fields.get("task_id"), fields.get("trial_id"),
                     fields.get("state", "PENDING"), fields.get("slots", 0),
+                    fields.get("num_processes", 1),
                     time.time(),
                 ),
             )

@@ -158,9 +158,18 @@ class Experiment:
             return
         for rec in self.trials.values():
             if not rec.exited:
-                rec.run_id += 1
-                self.db.update_trial(rec.trial_id, run_id=rec.run_id)
-                self.launcher.launch(self, rec)
+                self.relaunch_trial(rec.trial_id)
+
+    def relaunch_trial(self, trial_id: int) -> None:
+        """Requeue one live trial under a fresh run id (restore fallback
+        when no agent reattached it; reconcile sweep, core.py)."""
+        with self._cond:
+            rec = self.trials[trial_id]
+            if rec.exited:
+                return
+            rec.run_id += 1
+            self.db.update_trial(trial_id, run_id=rec.run_id)
+        self.launcher.launch(self, rec)
 
     # -- op processing (ref: experiment.go:662 processOperations) -------------
     def _process_ops(self, ops: List[Any]) -> None:

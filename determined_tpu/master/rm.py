@@ -87,8 +87,49 @@ class ResourcePool:
     # -- agents --------------------------------------------------------------
     def add_agent(self, agent_id: str, slots: int) -> None:
         with self._lock:
-            self._agents[agent_id] = Agent(agent_id, slots)
+            existing = self._agents.get(agent_id)
+            if existing is not None:
+                # Re-registration (agent-process restart, REREGISTER loop):
+                # keep the occupancy map — its allocations are still running
+                # and about to be re-offered for reattach; resetting `used`
+                # here would double-book the slots.
+                existing.slots = slots
+            else:
+                self._agents[agent_id] = Agent(agent_id, slots)
         self.tick()
+
+    def adopt(
+        self,
+        request: Request,
+        agent_id: str,
+        n_slots: int,
+        on_preempt: PreemptCb,
+    ) -> None:
+        """Re-admit a placement that is ALREADY running on an agent (master
+        restart reattach; ref restore.go:59 + agentrm restore): records the
+        entry + occupancy without scheduling and without firing on_start.
+        Called once per (alloc, agent) pair as agents re-register; a
+        multi-host gang accretes its assignment agent by agent."""
+        with self._lock:
+            prev = self._entries.get(request.alloc_id)
+            if prev is None:
+                self._order += 1
+                request.order = self._order
+            else:
+                # Re-adopt over an earlier hold/adopt: keep the queue
+                # position but take the new request's scheduling attributes
+                # (a "reattach-hold" placeholder upgrades to the trial's
+                # real priority/group once the verdict resolves).
+                request.order = prev.request.order
+            self._entries[request.alloc_id] = _Entry(
+                request, lambda r, a: None, on_preempt
+            )
+            agent = self._agents.get(agent_id)
+            if agent is None:
+                return  # caller registers the agent first; defensive
+            asg = self._running.setdefault(request.alloc_id, {})
+            asg[agent_id] = n_slots
+            agent.used[request.alloc_id] = n_slots
 
     def remove_agent(self, agent_id: str) -> List[str]:
         """Returns alloc_ids that lost resources (caller fails them over)."""
@@ -98,6 +139,12 @@ class ResourcePool:
         for alloc_id in victims:
             self.release(alloc_id)
         return victims
+
+    def allocs_on_agent(self, agent_id: str) -> List[str]:
+        """Alloc ids booking slots on this agent (reattach reconciliation)."""
+        with self._lock:
+            agent = self._agents.get(agent_id)
+            return list(agent.used) if agent else []
 
     def agents_snapshot(self) -> Dict[str, Dict]:
         with self._lock:

@@ -78,19 +78,17 @@ class TestMasterRestart:
         try:
             exp2 = m2.get_experiment(exp_id)
             assert exp2 is not None
-            # The agent's poll fails over, it REREGISTERs (killing the
-            # orphan trial process), the restored experiment's relaunched
-            # trial resumes from its checkpoint and finishes.
+            # The agent's poll fails over, it REREGISTERs offering its live
+            # allocation for reattach. Usually the new master adopts it and
+            # the ORIGINAL run finishes (runs == {0}, zero restarts —
+            # test_reattach.py pins that path deterministically); if the
+            # trial process happened to die in the bounce window, the
+            # reconcile sweep relaunches from the latest checkpoint instead.
+            # Both end COMPLETED with the full step count.
             state = exp2.wait_done(timeout=300)
             assert state == "COMPLETED"
             row = m2.db.get_trial(trial_id)
             assert row["steps_completed"] == 40
-            assert row["run_id"] >= 1  # restore bumped the run id
-            # Either outcome is a pass: the original trial process survives
-            # the restart (its API session reconnects to the new master on
-            # the same address — continuity, runs == {0}) or the relaunched
-            # run finishes the work (runs includes >= 1). Both must leave a
-            # full metric trail.
             runs = {m["trial_run_id"] for m in m2.db.get_metrics(trial_id, "training")}
             assert runs, "no training metrics recorded"
         finally:
