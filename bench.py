@@ -1,10 +1,20 @@
 """Headline benchmark: GPT-2-small pretraining step MFU on one TPU chip.
 
 Target (BASELINE.md): >= 35% MFU on the GPT-2 recipe. Prints ONE JSON line
-whose primary metric stays gpt2_mfu; the other two BASELINE.md rows ride
-as extra fields on the same line:
+whose primary metric stays gpt2_mfu; the other BASELINE.md rows ride as
+extra fields on the same line:
   {"metric": "gpt2_mfu", "value": <pct>, "unit": "%", "vs_baseline": <x/35>,
-   "tokens_per_sec_per_chip": <tok/s>, "asha_trials_per_hour": <trials/h>}
+   "tokens_per_sec_per_chip": <tok/s>, "asha_trials_per_hour": <trials/h>,
+   "neox_class_mfu": <pct>, "neox_layers_measured": <n>}
+
+neox_class_mfu is the BASELINE ladder's top rung made measurable on one
+chip: a GPT-NeoX-20B-shaped layer slice (d_model 6144 / d_ff 24576 /
+64 heads / vocab 50432 / seq 2048, remat) — layer count sized to the
+chip's HBM by arithmetic (one on a 16 GB v5e, several on a v5p) —
+through the identical jitted train step. MFU is computed against the
+sliced config's own FLOPs, so it is the honest per-chip matmul-efficiency
+number for the examples/gpt_neox_fsdp.json recipe's shapes (the full-model
+64-chip mesh is validated by dryrun_multichip's neox data x fsdp config).
 
 Runs the real flagship path: determined_tpu GPT (Pallas flash attention,
 bf16 compute, remat, scan-over-layers) + adamw, jitted with donated state.
@@ -96,6 +106,93 @@ def asha_trials_per_hour(n_trials: int = 8):
         return None
 
 
+def _measure_mfu(config, batch_size: int, inner: int, rounds: int, dev):
+    """MFU + tok/s of the standard jitted train step for one config."""
+    model = GPT(config)
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(3e-4))
+
+    @jax.jit
+    def init_fn(rng):
+        params = model.init(rng)
+        return {"params": params, "opt": tx.init(params)}
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_step(state, tokens):
+        def loss_fn(p):
+            return model.loss(p, {"tokens": tokens}, jax.random.PRNGKey(0))[0]
+
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+        updates, opt = tx.update(grads, state["opt"], state["params"])
+        return {
+            "params": optax.apply_updates(state["params"], updates),
+            "opt": opt,
+        }, loss
+
+    state = init_fn(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, config.vocab_size, (batch_size, config.seq_len)),
+        jnp.int32,
+    )
+    # Sync via a scalar fetch, not block_until_ready — on tunneled/remote
+    # backends only a host transfer actually drains the device queue.
+    state, loss = train_step(state, tokens)  # warmup + compile
+    float(jax.device_get(loss))
+
+    best_dt = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            state, loss = train_step(state, tokens)
+        float(jax.device_get(loss))
+        best_dt = min(best_dt, time.perf_counter() - t0)
+
+    tokens_per_sec = batch_size * config.seq_len * inner / best_dt
+    mfu = tokens_per_sec * config.train_flops_per_token() / peak_flops(dev)
+    return mfu, tokens_per_sec
+
+
+def neox_class_mfu(dev, on_tpu: bool):
+    """BASELINE ladder top rung: NeoX-20B-shaped slice, single chip.
+
+    Layer count is sized to the chip's HBM from arithmetic, not probing:
+    params cost 12 B each (fp32 + adam mu/nu), a NeoX layer is ~453 M
+    params (12·d_model² + 2·d_model·d_ff) and embed/unembed ~322 M, so a
+    v5e (16 GB) fits exactly one layer (~9.3 GB + activations/workspace)
+    while a v5p (95 GB) fits several. Steps are seconds long, so a small
+    inner loop amortizes the tunnel RTT fine. Returns (mfu, layers) or
+    (None, 0) on failure/OOM — the headline line must still print.
+    """
+    try:
+        if on_tpu:
+            d_model, d_ff, vocab, seq = 6144, 24576, 50432, 2048
+            layer_bytes = (12 * d_model * d_model + 2 * d_model * d_ff) * 12
+            embed_bytes = (vocab + seq) * d_model * 12
+            try:
+                hbm = int(dev.memory_stats()["bytes_limit"])
+            except Exception:  # noqa: BLE001 - backend without memory_stats
+                hbm = 16 * 1024**3
+            headroom = 4 * 1024**3  # activations + XLA workspace + logits
+            n_layers = max(1, int((hbm - headroom - embed_bytes) // layer_bytes))
+            cfg = GPTConfig(
+                vocab_size=vocab, n_layers=n_layers, n_heads=64,
+                d_model=d_model, d_ff=d_ff, seq_len=seq, remat=True,
+            )
+            mfu, _ = _measure_mfu(cfg, batch_size=2, inner=4, rounds=2, dev=dev)
+        else:
+            cfg = GPTConfig(
+                vocab_size=512, n_layers=1, n_heads=8, d_model=256,
+                d_ff=1024, seq_len=256, remat=True,
+            )
+            mfu, _ = _measure_mfu(cfg, batch_size=2, inner=1, rounds=1, dev=dev)
+        return mfu, cfg.n_layers
+    except Exception:  # noqa: BLE001 — OOM or compile failure: skip the rung
+        import traceback
+
+        traceback.print_exc()
+        return None, 0
+
+
 def main() -> None:
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
@@ -114,49 +211,11 @@ def main() -> None:
         batch_size = 4
         inner, rounds = 2, 2
 
-    model = GPT(config)
-    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(3e-4))
-
-    @jax.jit
-    def init_fn(rng):
-        params = model.init(rng)
-        return {"params": params, "opt": tx.init(params)}
-
     # Single-step program timed in rounds of `inner` dispatches; a scanned
     # multi-step variant measured SLOWER (the params-sized scan carry costs
     # more than dispatch), so this is the fast path, with best-of-rounds to
-    # shave scheduler/tunnel noise.
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def train_step(state, tokens):
-        def loss_fn(p):
-            return model.loss(p, {"tokens": tokens}, jax.random.PRNGKey(0))[0]
-
-        loss, grads = jax.value_and_grad(loss_fn)(state["params"])
-        updates, opt = tx.update(grads, state["opt"], state["params"])
-        return {"params": optax.apply_updates(state["params"], updates), "opt": opt}, loss
-
-    state = init_fn(jax.random.PRNGKey(0))
-    rng = np.random.default_rng(0)
-    tokens = jnp.asarray(
-        rng.integers(0, config.vocab_size, (batch_size, config.seq_len)), jnp.int32
-    )
-
-    # NB: sync via a scalar fetch, not block_until_ready — on tunneled/remote
-    # backends only a host transfer actually drains the device queue.
-    state, loss = train_step(state, tokens)  # warmup + compile
-    float(jax.device_get(loss))
-
-    best_dt = float("inf")
-    for _ in range(rounds):
-        t0 = time.perf_counter()
-        for _ in range(inner):
-            state, loss = train_step(state, tokens)
-        float(jax.device_get(loss))
-        best_dt = min(best_dt, time.perf_counter() - t0)
-
-    tokens_per_sec = batch_size * config.seq_len * inner / best_dt
-    flops_per_token = config.train_flops_per_token()
-    mfu = tokens_per_sec * flops_per_token / peak_flops(dev)
+    # shave scheduler/tunnel noise (_measure_mfu).
+    mfu, tokens_per_sec = _measure_mfu(config, batch_size, inner, rounds, dev)
     record = {
         "metric": "gpt2_mfu",
         "value": round(100.0 * mfu, 2),
@@ -165,6 +224,11 @@ def main() -> None:
         # BASELINE.md row 2: one jax device == one chip here.
         "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
     }
+    if not os.environ.get("DTPU_BENCH_SKIP_NEOX"):
+        neox_mfu, neox_layers = neox_class_mfu(dev, on_tpu)
+        if neox_mfu is not None:
+            record["neox_class_mfu"] = round(100.0 * neox_mfu, 2)
+            record["neox_layers_measured"] = neox_layers
     if not os.environ.get("DTPU_BENCH_SKIP_ASHA"):
         asha = asha_trials_per_hour()
         if asha is not None:
