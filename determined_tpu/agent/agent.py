@@ -601,6 +601,15 @@ class AgentDaemon:
     def _kill(self, task: _Task, grace_s: float = 10.0) -> None:
         """SIGTERM the group, escalate to SIGKILL (ref: container stop flow).
         Works for both owned (child) and re-adopted (non-child) tasks."""
+        stat = _proc_stat(task.pid)
+        if stat is None or (
+            task.start_time is not None and stat[0] != task.start_time
+        ):
+            # Already gone — or the pid was RECYCLED by an unrelated
+            # process. killpg on a recycled pid would murder a stranger's
+            # whole process group (with raw re-adopted pids this is a real
+            # hazard, unlike the old child-only Popen handles).
+            return
         try:
             pgid = os.getpgid(task.pid)
         except (ProcessLookupError, PermissionError):

@@ -68,6 +68,22 @@ def _table(rows: List[Dict[str, Any]], cols: List[str]) -> None:
 
 
 # -- experiment --------------------------------------------------------------
+def _apply_dot_overrides(config: dict, overrides) -> dict:
+    """dot.path=json override list → applied onto config (in place)."""
+    for kv in overrides or []:
+        path, _, raw = kv.partition("=")
+        try:
+            val = json.loads(raw)
+        except json.JSONDecodeError:
+            val = raw
+        d = config
+        keys = path.split(".")
+        for k in keys[:-1]:
+            d = d.setdefault(k, {})
+        d[keys[-1]] = val
+    return config
+
+
 def exp_create(args: argparse.Namespace) -> None:
     config = _load_config(args.config)
     if args.model_dir:
@@ -77,18 +93,7 @@ def exp_create(args: argparse.Namespace) -> None:
         resp = _session(args).post_bytes("/api/v1/files", data)
         config["context"] = resp["id"]
         print(f"Uploaded context {args.model_dir} ({len(data)} bytes)")
-    if args.config_override:
-        for kv in args.config_override:
-            path, _, raw = kv.partition("=")
-            try:
-                val = json.loads(raw)
-            except json.JSONDecodeError:
-                val = raw
-            d = config
-            keys = path.split(".")
-            for k in keys[:-1]:
-                d = d.setdefault(k, {})
-            d[keys[-1]] = val
+    _apply_dot_overrides(config, args.config_override)
     resp = _session(args).post("/api/v1/experiments", json_body={"config": config})
     exp_id = resp["id"]
     print(f"Created experiment {exp_id}")
@@ -112,17 +117,70 @@ def exp_wait(args: argparse.Namespace, exp_id: Optional[int] = None) -> None:
 
 
 def exp_list(args: argparse.Namespace) -> None:
-    exps = _session(args).get("/api/v1/experiments")["experiments"]
+    params = {}
+    if getattr(args, "all", False):
+        params["include_archived"] = "1"
+    if getattr(args, "limit", None):
+        params["limit"] = str(args.limit)
+        params["offset"] = str(getattr(args, "offset", 0) or 0)
+    resp = _session(args).get("/api/v1/experiments", params=params)
     _table(
         [
             {
                 "id": e["id"], "state": e["state"],
                 "progress": f"{e.get('progress') or 0:.0%}",
                 "searcher": e["config"].get("searcher", {}).get("name", ""),
+                "archived": "yes" if e.get("archived") else "",
             }
-            for e in exps
+            for e in resp["experiments"]
         ],
-        ["id", "state", "progress", "searcher"],
+        ["id", "state", "progress", "searcher", "archived"],
+    )
+
+
+def exp_fork(args: argparse.Namespace) -> None:
+    body = {}
+    if args.checkpoint:
+        body["checkpoint_uuid"] = args.checkpoint
+    if args.config_override:
+        body["config"] = _apply_dot_overrides({}, args.config_override)
+    resp = _session(args).post(
+        f"/api/v1/experiments/{args.experiment_id}/fork", json_body=body
+    )
+    print(f"Created experiment {resp['id']} (forked from "
+          f"{resp['forked_from']}"
+          + (f", warm start {resp['warm_start_checkpoint']}"
+             if resp.get("warm_start_checkpoint") else "") + ")")
+
+
+def exp_continue(args: argparse.Namespace) -> None:
+    body = {}
+    if args.max_length is not None:
+        body["max_length"] = args.max_length
+    resp = _session(args).post(
+        f"/api/v1/experiments/{args.experiment_id}/continue", json_body=body
+    )
+    print(f"Created experiment {resp['id']} continuing {resp['forked_from']} "
+          f"from checkpoint {resp.get('warm_start_checkpoint')}")
+
+
+def _exp_archive(action: str):
+    def run(args: argparse.Namespace) -> None:
+        resp = _session(args).post(
+            f"/api/v1/experiments/{args.experiment_id}/{action}"
+        )
+        print(f"experiment {args.experiment_id}: "
+              f"{'archived' if resp['archived'] else 'unarchived'}")
+
+    return run
+
+
+def rp_list(args: argparse.Namespace) -> None:
+    pools = _session(args).get("/api/v1/resource-pools")["resource_pools"]
+    _table(
+        pools,
+        ["name", "type", "agents", "slots_total", "slots_used",
+         "pending_allocs", "pending_slots", "running_allocs"],
     )
 
 
@@ -244,6 +302,27 @@ def ckpt_list(args: argparse.Namespace) -> None:
         ],
         ["uuid", "steps", "files"],
     )
+
+
+def ckpt_download(args: argparse.Namespace) -> None:
+    """Fetch a checkpoint's files locally (the WebUI checkpoint browser's
+    restore command; ref `det checkpoint download`). Resolves the owning
+    experiment's checkpoint_storage and pulls through the storage layer."""
+    session = _session(args)
+    ckpt = session.get(f"/api/v1/checkpoints/{args.uuid}")
+    trial_id = ckpt.get("trial_id")
+    if trial_id is None:
+        _die("checkpoint has no owning trial; download it via its storage")
+    trial = session.get(f"/api/v1/trials/{trial_id}")
+    exp = session.get(f"/api/v1/experiments/{trial['experiment_id']}")
+    storage_cfg = exp["config"].get("checkpoint_storage")
+    if not storage_cfg:
+        _die("experiment has no checkpoint_storage configured")
+    from determined_tpu.storage.base import from_config
+
+    dest = args.dest or args.uuid
+    from_config(storage_cfg).download(args.uuid, dest)
+    print(f"downloaded checkpoint {args.uuid} to {dest}")
 
 
 # -- commands (NTSC) -----------------------------------------------------------
@@ -581,15 +660,34 @@ def build_parser() -> argparse.ArgumentParser:
                    help="dot.path=json overrides")
     c.add_argument("--follow", "-f", action="store_true")
     c.set_defaults(fn=exp_create)
-    exp.add_parser("list").set_defaults(fn=exp_list)
+    v = exp.add_parser("list")
+    v.add_argument("--all", action="store_true",
+                   help="include archived experiments")
+    v.add_argument("--limit", type=int, default=None)
+    v.add_argument("--offset", type=int, default=0)
+    v.set_defaults(fn=exp_list)
     for verb, fn in [
         ("describe", exp_describe), ("wait", lambda a: exp_wait(a)),
         ("pause", _exp_action("pause")), ("activate", _exp_action("activate")),
         ("cancel", _exp_action("cancel")), ("kill", _exp_action("kill")),
+        ("archive", _exp_archive("archive")),
+        ("unarchive", _exp_archive("unarchive")),
     ]:
         v = exp.add_parser(verb)
         v.add_argument("experiment_id", type=int)
         v.set_defaults(fn=fn)
+    v = exp.add_parser("fork")
+    v.add_argument("experiment_id", type=int)
+    v.add_argument("--checkpoint", default=None,
+                   help='checkpoint uuid, or "best"/"latest", to warm-start')
+    v.add_argument("--config-override", "-O", action="append",
+                   help="dot.path=json overrides for the forked config")
+    v.set_defaults(fn=exp_fork)
+    v = exp.add_parser("continue")
+    v.add_argument("experiment_id", type=int)
+    v.add_argument("--max-length", type=int, default=None,
+                   help="new searcher max_length to train to")
+    v.set_defaults(fn=exp_continue)
 
     trial = sub.add_parser("trial", aliases=["t"]).add_subparsers(
         dest="verb", required=True)
@@ -619,6 +717,10 @@ def build_parser() -> argparse.ArgumentParser:
     v = ckpt.add_parser("list")
     v.add_argument("trial_id", type=int)
     v.set_defaults(fn=ckpt_list)
+    v = ckpt.add_parser("download")
+    v.add_argument("uuid")
+    v.add_argument("dest", nargs="?", default=None)
+    v.set_defaults(fn=ckpt_download)
 
     cmd = sub.add_parser("cmd", aliases=["command"]).add_subparsers(
         dest="verb", required=True)
@@ -677,6 +779,10 @@ def build_parser() -> argparse.ArgumentParser:
     v = model.add_parser("versions")
     v.add_argument("name")
     v.set_defaults(fn=model_versions)
+
+    rp = sub.add_parser("resource-pool", aliases=["rp"]).add_subparsers(
+        dest="verb", required=True)
+    rp.add_parser("list").set_defaults(fn=rp_list)
 
     agent = sub.add_parser("agent", aliases=["a"]).add_subparsers(
         dest="verb", required=True)

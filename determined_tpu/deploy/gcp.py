@@ -9,6 +9,7 @@ discipline as the agent provisioner (master/provisioner.py GcloudTPUDriver).
 """
 from __future__ import annotations
 
+import os
 import shlex
 import subprocess
 from typing import Any, Callable, Dict, List, Optional
@@ -126,6 +127,20 @@ def master_vm_commands(
         package_source=package_source, port=port, tls=tls,
         admin_password=admin_password,
     )
+    # --metadata-from-file, NOT --metadata: gcloud splits the latter's
+    # value on commas into key=value pairs, so any comma in the rendered
+    # script (a pip pin like 'pkg>=1,<2', a second DTPU_USERS entry)
+    # would silently corrupt the metadata and break the VM bootstrap.
+    # A file also dodges argv length limits.
+    import os
+    import tempfile
+
+    fd, script_path = tempfile.mkstemp(prefix="dtpu-startup-", suffix=".sh")
+    with os.fdopen(fd, "w") as f:
+        f.write(script)
+    # The script embeds the generated admin credential (DTPU_USERS):
+    # owner-only perms, and deploy() removes it after the gcloud call.
+    os.chmod(script_path, 0o600)
     create = [
         "gcloud", "compute", "instances", "create", name,
         f"--project={project}", f"--zone={zone}",
@@ -133,7 +148,7 @@ def master_vm_commands(
         f"--boot-disk-size={disk_gb}GB",
         "--image-family=debian-12", "--image-project=debian-cloud",
         "--tags=dtpu-master",
-        f"--metadata=startup-script={script}",
+        f"--metadata-from-file=startup-script={script_path}",
     ]
     cmds = [create]
     if source_ranges:
@@ -167,10 +182,28 @@ def deploy(
         project=project, zone=zone, admin_password=admin_password, **kw
     )
     lines = [shlex.join(c) for c in cmds]
+    script_files = [
+        a.split("=", 2)[2]
+        for c in cmds for a in c
+        if a.startswith("--metadata-from-file=startup-script=")
+    ]
     if not dry_run:
         run = runner or (
             lambda argv: subprocess.run(argv, check=True)
         )
-        for argv in cmds:
-            run(argv)
-    return {"commands": lines, "admin_password": admin_password}
+        try:
+            for argv in cmds:
+                run(argv)
+        finally:
+            if runner is None:
+                # The startup script embeds the admin credential; it must
+                # not linger in /tmp once gcloud has shipped it to the VM.
+                # Custom runners (tests, orchestrators) may defer execution,
+                # so they own cleanup via the returned script_files.
+                for path in script_files:
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+    return {"commands": lines, "admin_password": admin_password,
+            "script_files": script_files}

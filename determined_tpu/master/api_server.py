@@ -142,31 +142,39 @@ def task_identity_violation(
 #: never limited). 120/min is ample for human-scale incident forensics and
 #: useless for a disk-filling attack.
 _DENIED_AUDIT_PER_MINUTE = 120
-_denied_audit_state = {"window": 0, "count": 0, "dropped": 0}
-_denied_audit_lock = threading.Lock()
 
 
-def _denied_audit_allowed() -> bool:
-    import time as _time
+class _DeniedAuditLimiter:
+    """Per-ApiServer-instance rate limiter: module-level state would make
+    every master in one process (devcluster tests, embedded multi-master)
+    share a single budget — each instance's denials depleting the others'
+    and attributing suppression warnings to the wrong master."""
 
-    window = int(_time.time() // 60)
-    with _denied_audit_lock:
-        st = _denied_audit_state
-        if st["window"] != window:
-            if st["dropped"]:
-                logger.warning(
-                    "audit: suppressed %d denied-request rows last minute "
-                    "(rate limit %d/min)", st["dropped"],
-                    _DENIED_AUDIT_PER_MINUTE,
-                )
-            st["window"] = window
-            st["count"] = 0
-            st["dropped"] = 0
-        if st["count"] < _DENIED_AUDIT_PER_MINUTE:
-            st["count"] += 1
-            return True
-        st["dropped"] += 1
-        return False
+    def __init__(self) -> None:
+        self._state = {"window": 0, "count": 0, "dropped": 0}
+        self._lock = threading.Lock()
+
+    def allowed(self) -> bool:
+        import time as _time
+
+        window = int(_time.time() // 60)
+        with self._lock:
+            st = self._state
+            if st["window"] != window:
+                if st["dropped"]:
+                    logger.warning(
+                        "audit: suppressed %d denied-request rows last "
+                        "minute (rate limit %d/min)", st["dropped"],
+                        _DENIED_AUDIT_PER_MINUTE,
+                    )
+                st["window"] = window
+                st["count"] = 0
+                st["dropped"] = 0
+            if st["count"] < _DENIED_AUDIT_PER_MINUTE:
+                st["count"] += 1
+                return True
+            st["dropped"] += 1
+            return False
 
 
 class ApiError(Exception):
@@ -522,7 +530,148 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         return {"id": exp_id}
 
     def list_experiments(r: ApiRequest):
-        return {"experiments": m.db.list_experiments()}
+        """Paginated + archived-filtered (ref: GetExperiments pagination,
+        api_experiment.go). Archived experiments are hidden unless
+        ?include_archived=1 (the SDK sends it by default so scripts keep
+        seeing everything; the WebUI hides them). Omitting limit returns
+        the full (filtered) list."""
+        include_archived = r.q("include_archived", "") in ("1", "true")
+        limit = r.q("limit", "")
+        kw: Dict[str, Any] = {"include_archived": include_archived}
+        try:
+            if limit:
+                kw["limit"] = max(1, min(int(limit), 500))
+                kw["offset"] = max(0, int(r.q("offset", "0") or 0))
+                kw["newest_first"] = r.q("order", "") == "desc"
+        except ValueError:
+            raise ApiError(400, "limit/offset must be integers")
+        return {
+            "experiments": m.db.list_experiments(**kw),
+            "total": m.db.count_experiments(include_archived=include_archived),
+        }
+
+    def exp_archive(r: ApiRequest):
+        exp_id = int(r.groups[0])
+        row = m.db.get_experiment(exp_id)
+        if row is None:
+            raise ApiError(404, "no such experiment")
+        want = r.groups[1] == "archive"
+        if want:
+            live = m.get_experiment(exp_id)
+            state = live.state if live is not None else row["state"]
+            if state not in ("COMPLETED", "CANCELED", "ERRORED"):
+                # Archiving running work would hide it from every default
+                # listing while it still consumes chips (the reference
+                # archives terminal experiments only).
+                raise ApiError(400, f"cannot archive experiment in {state}")
+        m.db.set_experiment_archived(exp_id, want)
+        return {"archived": want}
+
+    def exp_fork(r: ApiRequest):
+        """New experiment from a stored config (+ overrides), optionally
+        warm-started from a checkpoint (ref: api_experiment.go fork /
+        continue flows). checkpoint_uuid="best"/"latest" resolves against
+        the source experiment's trials."""
+        from determined_tpu.master import expconf
+
+        src = m.db.get_experiment(int(r.groups[0]))
+        if src is None:
+            raise ApiError(404, "no such experiment")
+        config = dict(src["config"])
+        # The stored config is the MERGED one; drop bookkeeping keys that
+        # must be re-derived on the fork.
+        config.pop("warm_start_checkpoint", None)
+        overrides = r.body.get("config") or {}
+        if overrides:
+            config = dict(expconf.merge(overrides, config))
+        ckpt = r.body.get("checkpoint_uuid")
+        if ckpt in ("best", "latest"):
+            ckpt = _resolve_source_checkpoint(src, ckpt)
+            if ckpt is None:
+                raise ApiError(400, "source experiment has no checkpoints")
+        if ckpt:
+            row = m.db.get_checkpoint(str(ckpt))
+            if row is None:
+                raise ApiError(404, f"no such checkpoint {ckpt}")
+            if row.get("state") != "COMPLETED":
+                # GC'd/deleted: the storage files are gone; warm-starting
+                # from it would crash the fork's first trial at restore.
+                raise ApiError(400, f"checkpoint {ckpt} is {row.get('state')}")
+            config["warm_start_checkpoint"] = str(ckpt)
+        try:
+            new_id = m.create_experiment(config)
+        except ValueError as e:
+            raise ApiError(400, str(e))
+        return {"id": new_id, "forked_from": src["id"],
+                "warm_start_checkpoint": config.get("warm_start_checkpoint")}
+
+    def _resolve_source_checkpoint(src: Dict[str, Any], which: str):
+        # "best" honors searcher.smaller_is_better (default True), like
+        # best_validation and checkpoint GC — resolving with a hardcoded
+        # minimize would warm-start accuracy-metric forks from the WORST
+        # trial.
+        smaller = bool(
+            (src["config"].get("searcher") or {}).get("smaller_is_better", True)
+        )
+
+        def _live(uuid):
+            row = m.db.get_checkpoint(uuid) if uuid else None
+            return uuid if row and row.get("state") == "COMPLETED" else None
+
+        best_uuid, best_metric, latest_uuid, latest_ts = None, None, None, -1.0
+        for t in m.db.list_trials(src["id"]):
+            for c in m.db.list_checkpoints(t["id"]):  # COMPLETED-only
+                ts = float(c.get("report_time") or 0)
+                if ts > latest_ts:
+                    latest_uuid, latest_ts = c["uuid"], ts
+            metric = t.get("searcher_metric")
+            if metric is not None:
+                better = best_metric is None or (
+                    float(metric) < best_metric
+                    if smaller else float(metric) > best_metric
+                )
+                ck = _live(t.get("latest_checkpoint"))
+                if better and ck:
+                    best_uuid, best_metric = ck, float(metric)
+        return best_uuid if which == "best" and best_uuid else latest_uuid
+
+    def exp_continue(r: ApiRequest):
+        """Continue training a finished experiment: fork from its latest
+        checkpoint with a longer searcher target (ref: `det experiment
+        continue`)."""
+        src = m.db.get_experiment(int(r.groups[0]))
+        if src is None:
+            raise ApiError(404, "no such experiment")
+        body = dict(r.body or {})
+        overrides = body.get("config") or {}
+        length = body.get("max_length")
+        if length is not None:
+            overrides = dict(overrides)
+            searcher = dict(overrides.get("searcher")
+                            or src["config"].get("searcher") or {})
+            searcher["max_length"] = int(length)
+            overrides["searcher"] = searcher
+        r.body = {"config": overrides,
+                  "checkpoint_uuid": body.get("checkpoint_uuid", "latest")}
+        return exp_fork(r)
+
+    def list_resource_pools(r: ApiRequest):
+        """Cluster overview (ref: GetResourcePools, api_resourcepools)."""
+        pools = []
+        for name, pool in m.rm.pools.items():
+            agents = pool.agents_snapshot()
+            snap = pool.queue_snapshot()
+            pools.append({
+                "name": name,
+                "type": type(pool).__name__,
+                "agents": len(agents),
+                "slots_total": sum(a["slots"] for a in agents.values()),
+                "slots_used": sum(a["used"] for a in agents.values()),
+                "pending_allocs": len(snap["pending"]),
+                "pending_slots": snap["pending_slots"],
+                "running_allocs": len(snap["running"]),
+            })
+        return {"resource_pools": pools}
 
     def get_experiment(r: ApiRequest):
         row = m.db.get_experiment(int(r.groups[0]))
@@ -543,7 +692,19 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         return {"state": exp.state}
 
     def list_trials(r: ApiRequest):
-        return {"trials": m.db.list_trials(int(r.groups[0]))}
+        exp_id = int(r.groups[0])
+        limit = r.q("limit", "")
+        kw: Dict[str, Any] = {}
+        try:
+            if limit:
+                kw["limit"] = max(1, min(int(limit), 500))
+                kw["offset"] = max(0, int(r.q("offset", "0") or 0))
+        except ValueError:
+            raise ApiError(400, "limit/offset must be integers")
+        return {
+            "trials": m.db.list_trials(exp_id, **kw),
+            "total": m.db.count_trials(exp_id),
+        }
 
     def searcher_events(r: ApiRequest):
         exp = m.get_experiment(int(r.groups[0]))
@@ -830,6 +991,10 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("GET", r"/api/v1/experiments", list_experiments),
         R("GET", r"/api/v1/experiments/(\d+)", get_experiment),
         R("POST", r"/api/v1/experiments/(\d+)/(pause|activate|cancel|kill)", exp_action),
+        R("POST", r"/api/v1/experiments/(\d+)/(archive|unarchive)", exp_archive),
+        R("POST", r"/api/v1/experiments/(\d+)/fork", exp_fork),
+        R("POST", r"/api/v1/experiments/(\d+)/continue", exp_continue),
+        R("GET", r"/api/v1/resource-pools", list_resource_pools),
         R("GET", r"/api/v1/experiments/(\d+)/trials", list_trials),
         R("GET", r"/api/v1/experiments/(\d+)/searcher/events", searcher_events),
         R("POST", r"/api/v1/experiments/(\d+)/searcher/operations", post_searcher_ops),
@@ -865,6 +1030,7 @@ class ApiServer:
         tls: Optional[tuple] = None,
     ) -> None:
         routes = build_routes(master)
+        denied_limiter = _DeniedAuditLimiter()
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -962,7 +1128,7 @@ class ApiServer:
                         method in ("POST", "PATCH", "DELETE")
                         and not TASK_TOKEN_ROUTES.match(parsed.path)
                         and not AGENT_TOKEN_ROUTES.match(parsed.path)
-                        and _denied_audit_allowed()
+                        and denied_limiter.allowed()
                     ):
                         try:
                             master.db.add_audit(

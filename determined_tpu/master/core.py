@@ -166,13 +166,20 @@ class RMTrialLauncher:
 
         def on_start(req: Request, assignment: Dict[str, int]) -> None:
             trial_row = self.m.db.get_trial(rec.trial_id) or {}
+            # Fork/continue warm start: a trial with no checkpoints of its
+            # own resumes from the config's donor checkpoint instead
+            # (api_server exp_fork; ref api_experiment.go continue flow).
+            latest = (
+                trial_row.get("latest_checkpoint")
+                or cfg.get("warm_start_checkpoint")
+            )
             trial_info = _info.TrialInfo(
                 trial_id=rec.trial_id,
                 experiment_id=experiment.id,
                 trial_seed=rec.seed,
                 hparams=rec.hparams,
                 config=cfg,
-                latest_checkpoint=trial_row.get("latest_checkpoint"),
+                latest_checkpoint=latest,
                 trial_run_id=rec.run_id,
             )
             self.m.enqueue_start_actions(

@@ -27,6 +27,7 @@ CREATE TABLE IF NOT EXISTS experiments (
     searcher_snapshot TEXT,        -- crash-recovery searcher state (JSON)
     progress REAL DEFAULT 0.0,
     project_id INTEGER DEFAULT 1,
+    archived INTEGER DEFAULT 0,
     created_at REAL, updated_at REAL
 );
 CREATE TABLE IF NOT EXISTS trials (
@@ -150,6 +151,8 @@ MIGRATIONS = (
     "ALTER TABLE task_logs ADD COLUMN rank INTEGER",  # log-search filter
     # reattach: adoption must rebuild the allocation's gang size
     "ALTER TABLE allocations ADD COLUMN num_processes INTEGER DEFAULT 1",
+    # archive/unarchive (hidden-by-default listing, ref api_experiment.go)
+    "ALTER TABLE experiments ADD COLUMN archived INTEGER DEFAULT 0",
 )
 
 
@@ -380,6 +383,16 @@ class Database:
         try:
             conn.execute(sql, args)
             conn.commit()
+        except Exception:
+            # Mirror _write_batch: without the rollback a failed commit
+            # (disk full) leaves an open transaction on this THREAD-LOCAL
+            # connection, and the next unrelated _execute on the thread
+            # would silently commit the half-written durable record.
+            try:
+                conn.rollback()
+            except sqlite3.Error:
+                pass
+            raise
         finally:
             conn.execute("PRAGMA synchronous=NORMAL")
 
@@ -403,9 +416,37 @@ class Database:
         rows = self._query("SELECT * FROM experiments WHERE id=?", (exp_id,))
         return self._exp_row(rows[0]) if rows else None
 
-    def list_experiments(self) -> List[Dict[str, Any]]:
-        return [self._exp_row(r) for r in self._query(
-            "SELECT * FROM experiments ORDER BY id")]
+    def list_experiments(
+        self,
+        limit: Optional[int] = None,
+        offset: int = 0,
+        include_archived: bool = True,
+        newest_first: bool = False,
+    ) -> List[Dict[str, Any]]:
+        """Server-side pagination (ref: the reference's paginated
+        GetExperiments): the WebUI/CLI page through limit/offset rather
+        than transferring the fleet's whole history per refresh."""
+        sql = "SELECT * FROM experiments"
+        if not include_archived:
+            sql += " WHERE archived=0"
+        sql += " ORDER BY id" + (" DESC" if newest_first else "")
+        args: tuple = ()
+        if limit is not None:
+            sql += " LIMIT ? OFFSET ?"
+            args = (limit, offset)
+        return [self._exp_row(r) for r in self._query(sql, args)]
+
+    def count_experiments(self, include_archived: bool = True) -> int:
+        sql = "SELECT COUNT(*) AS n FROM experiments"
+        if not include_archived:
+            sql += " WHERE archived=0"
+        return int(self._query(sql)[0]["n"])
+
+    def set_experiment_archived(self, exp_id: int, archived: bool) -> None:
+        self._execute(
+            "UPDATE experiments SET archived=? WHERE id=?",
+            (1 if archived else 0, exp_id),
+        )
 
     @staticmethod
     def _exp_row(r: sqlite3.Row) -> Dict[str, Any]:
@@ -518,15 +559,26 @@ class Database:
         d["hparams"] = json.loads(d["hparams"])
         return d
 
-    def list_trials(self, exp_id: int) -> List[Dict[str, Any]]:
+    def list_trials(
+        self, exp_id: int, limit: Optional[int] = None, offset: int = 0
+    ) -> List[Dict[str, Any]]:
+        sql = "SELECT * FROM trials WHERE experiment_id=? ORDER BY id"
+        args: tuple = (exp_id,)
+        if limit is not None:
+            sql += " LIMIT ? OFFSET ?"
+            args += (limit, offset)
         out = []
-        for r in self._query(
-            "SELECT * FROM trials WHERE experiment_id=? ORDER BY id", (exp_id,)
-        ):
+        for r in self._query(sql, args):
             d = dict(r)
             d["hparams"] = json.loads(d["hparams"])
             out.append(d)
         return out
+
+    def count_trials(self, exp_id: int) -> int:
+        return int(self._query(
+            "SELECT COUNT(*) AS n FROM trials WHERE experiment_id=?",
+            (exp_id,),
+        )[0]["n"])
 
     def update_trial(self, trial_id: int, **fields: Any) -> None:
         allowed = {

@@ -1,18 +1,26 @@
 """WebUI: a single-file cluster dashboard served by the master.
 
 The reference ships a 112k-LoC React SPA (`webui/react`); this is the
-platform's minimal equivalent — one self-contained HTML page (no build
+platform's fleet-ready equivalent — one self-contained HTML page (no build
 step, no external assets; it must work from an air-gapped TPU pod) that
-polls the same REST API the CLI/SDK use and renders experiments, trials,
-agents, the job queue (with clickable move-to-front reordering, the
-JobQueue page's capability), live trial logs, per-trial metric line
-charts, a Profiler tab (charts over the harness's "profiling" metric
-group — host CPU/mem, device HBM — like the reference's Profiler tab),
-workspaces/projects, the model registry, and an HP-search view (rung
-scatter + parallel coordinates — the capability of the reference's
-ExperimentDetails charts and HP visualizations,
-webui/react/src/pages/ExperimentDetails). Charts are hand-rolled SVG so
-the no-build-step constraint holds.
+polls the same REST API the CLI/SDK use. Capabilities mirrored from the
+reference's pages (webui/react/src/pages/*):
+
+- experiments: SERVER-SIDE paginated table (limit/offset — a
+  1,000-experiment fleet transfers one page per refresh, not its whole
+  history), archived-hidden-by-default with a toggle, lifecycle actions
+  (pause/activate/kill), archive/unarchive, fork;
+- trials: paginated, with per-trial logs, metric charts, profiler tab,
+  checkpoint browser (uuid/steps/size + restore command + register to
+  model registry), and N-way TRIAL COMPARISON (overlaid metric charts —
+  the TrialComparison page's capability);
+- HP search viz: rung scatter + parallel coordinates;
+- job queue with clickable move-to-front; resource-pool overview;
+- admin: users + role changes, groups, templates, audit tail
+  (SettingsAccount / admin pages' capability);
+- tasks: launch a command/notebook/shell task from the UI, list + kill.
+
+Charts are hand-rolled SVG so the no-build-step constraint holds.
 """
 
 PAGE = """<!doctype html>
@@ -32,19 +40,39 @@ PAGE = """<!doctype html>
   .PAUSED { color: #8b949e; }
   button { background: #21262d; color: #c9d1d9; border: 1px solid #30363d;
            border-radius: 4px; padding: 2px 8px; cursor: pointer; }
+  input, select { background: #161b22; color: #c9d1d9;
+                  border: 1px solid #30363d; border-radius: 4px; padding: 2px 6px; }
   pre { background: #161b22; padding: 10px; max-height: 320px;
         overflow-y: auto; font-size: 0.78rem; }
   .bar { display: inline-block; width: 120px; height: 8px; background: #21262d;
          border-radius: 4px; vertical-align: middle; }
   .bar > div { height: 100%; background: #58a6ff; border-radius: 4px; }
+  .pager { color: #8b949e; font-size: 0.8rem; margin: 4px 0; }
+  .muted { color: #8b949e; }
+  code { background: #161b22; padding: 1px 5px; border-radius: 4px; }
 </style>
 </head>
 <body>
 <h1>determined_tpu <span id="cluster"></span></h1>
 <h2>Agents</h2><table id="agents"></table>
+<h2>Resource pools</h2><table id="pools"></table>
 <h2>Job queue</h2><div id="queues">(empty)</div>
-<h2>Experiments</h2><table id="exps"></table>
-<h2>Trials <span id="exp-label"></span></h2><table id="trials"></table>
+<h2>Experiments
+  <label style="font-weight:normal;font-size:0.8rem">
+    <input type="checkbox" id="show-archived" onchange="expPage=0;refresh()">
+    show archived</label>
+</h2>
+<div class="pager" id="exp-pager"></div>
+<table id="exps"></table>
+<h2>Trials <span id="exp-label"></span></h2>
+<div class="pager" id="trial-pager"></div>
+<table id="trials"></table>
+<h2>Trial comparison <span id="cmp-label" class="muted">(tick trials above,
+then compare)</span> <button onclick="drawComparison()">compare</button>
+  <button onclick="cmpTrials.clear();$('compare').textContent='';refresh()">clear</button></h2>
+<div id="compare"></div>
+<h2>Checkpoints <span id="ckpt-label"></span></h2>
+<div id="ckpts">(click a trial's ckpts button)</div>
 <h2>HP search <span id="hp-label"></span></h2>
 <div id="hpviz">(click an experiment's trials)</div>
 <h2>Metrics <span id="chart-label"></span></h2>
@@ -53,8 +81,22 @@ PAGE = """<!doctype html>
 <div id="profiler">(click a trial; charts appear once the harness ships
 the "profiling" metric group)</div>
 <h2>Logs <span id="log-label"></span></h2><pre id="logs">(click a trial)</pre>
+<h2>Tasks</h2>
+<div>
+  <select id="task-type"><option>COMMAND</option><option>NOTEBOOK</option>
+    <option>SHELL</option></select>
+  <input id="task-entry" size="40"
+         placeholder='entrypoint, e.g. python -c "print(42)"'>
+  <button onclick="launchTask()">launch</button>
+</div>
+<table id="tasks"></table>
 <h2>Workspaces</h2><table id="workspaces"></table>
 <h2>Models</h2><table id="models"></table>
+<h2>Admin</h2>
+<h2 style="font-size:0.9rem">Users</h2><table id="users"></table>
+<h2 style="font-size:0.9rem">Groups</h2><table id="groups"></table>
+<h2 style="font-size:0.9rem">Templates</h2><table id="templates"></table>
+<h2 style="font-size:0.9rem">Audit tail</h2><table id="audit"></table>
 <div id="login" style="display:none">
   <h2>Login</h2>
   <input id="u" placeholder="username"> <input id="p" type="password"
@@ -63,6 +105,9 @@ the "profiling" metric group)</div>
 </div>
 <script>
 let selExp = null, selTrial = null, logAfter = 0;
+let expPage = 0, trialPage = 0;
+const PAGE_SIZE = 50;
+const cmpTrials = new Set();
 const $ = (id) => document.getElementById(id);
 // Escape EVERYTHING interpolated into innerHTML: hparams/searcher names are
 // user-controlled strings (unescaped they'd be stored XSS able to lift the
@@ -93,11 +138,19 @@ async function post(path, body) {
 }
 
 // Experiment lifecycle actions (the ExperimentDetails action bar):
-// pause/activate/cancel/kill through the same API the CLI uses. The UI is
-// no longer read-only.
+// pause/activate/cancel/kill/archive/fork through the same API the CLI uses.
 async function expAction(id, action) {
   if (action === 'kill' && !confirm(`kill experiment ${id}?`)) return;
   await post(`/api/v1/experiments/${id}/${action}`);
+  refresh();
+}
+async function forkExp(id) {
+  const ckpt = prompt('warm-start checkpoint ("best", "latest", a uuid, ' +
+                      'or empty for none)', 'latest');
+  if (ckpt === null) return;
+  const body = ckpt ? {checkpoint_uuid: ckpt} : {};
+  const r = await post(`/api/v1/experiments/${id}/fork`, body);
+  if (r.ok) { const d = await r.json(); alert(`created experiment ${d.id}`); }
   refresh();
 }
 
@@ -142,8 +195,9 @@ async function doLogin() {
   const tok = (await r.json()).token;
   localStorage.setItem('dtpu_token', tok);
   // Cookie lets /proxy/ pages (which can't set headers) authenticate too.
-  document.cookie = 'dtpu_token=' + tok + '; path=/; SameSite=Strict';
+  document.cookie = 'dtpu_token=' + tok + '; path=/proxy/; SameSite=Strict';
   $('login').style.display = 'none';
+  adminDisabled = false; adminTick = 0;  // the new principal may be admin
   refresh();
 }
 
@@ -322,6 +376,63 @@ async function drawTrialCharts(trialId) {
   if (!prof.childNodes.length) prof.textContent = '(no profiler samples yet)';
 }
 
+// --- trial comparison (the TrialComparison page's capability) ----------
+// One chart per metric key, one series per ticked trial, drawn from each
+// trial's full (non-incremental) metric history at compare time.
+async function drawComparison() {
+  const ids = [...cmpTrials];
+  const div = $('compare');
+  div.textContent = '';
+  if (ids.length < 2) { div.textContent = '(tick at least two trials)'; return; }
+  $('cmp-label').textContent = `· trials ${ids.join(', ')}`;
+  const byKey = {};
+  for (const id of ids) {
+    const rows = (await j(`/api/v1/trials/${id}/metrics`)).metrics;
+    const best = {};  // key -> step -> {run, v}, newest run wins
+    for (const row of rows) {
+      const run = row.trial_run_id || 0;
+      if (row.grp === 'profiling') continue;
+      for (const [k, v] of Object.entries(row.body)) {
+        if (typeof v !== 'number' || !isFinite(v)) continue;
+        const byStep = (best[k] ??= {});
+        const prev = byStep[row.steps_completed];
+        if (!prev || run >= prev.run) byStep[row.steps_completed] = {run, v};
+      }
+    }
+    for (const [k, byStep] of Object.entries(best)) {
+      (byKey[k] ??= []).push({name: `trial ${id}`, points:
+        Object.entries(byStep).map(([s, e]) => [Number(s), e.v])
+          .sort((a, b) => a[0] - b[0])});
+    }
+  }
+  for (const key of Object.keys(byKey).sort().slice(0, 6))
+    div.appendChild(lineChart(key, byKey[key]));
+  if (!div.childNodes.length) div.textContent = '(no shared scalar metrics)';
+}
+
+// --- checkpoint browser (the CheckpointsList page's capability) --------
+async function showCkpts(trialId) {
+  const out = await j(`/api/v1/trials/${trialId}/checkpoints`);
+  $('ckpt-label').textContent = `· trial ${trialId}`;
+  const rows = out.checkpoints || [];
+  // resources entries are {path, size} dicts or bare path strings
+  // (shared_fs reports paths only) — show bytes when known, else count.
+  const size = (c) => {
+    const rs = c.resources || [];
+    const bytes = rs.reduce((n, f) => n + (f.size || 0), 0);
+    return bytes ? `${(bytes / 1e6).toFixed(2)} MB` : `${rs.length} file(s)`;
+  };
+  $('ckpts').innerHTML = '<table><tr><th>uuid</th><th>steps</th>' +
+    '<th>size</th><th>metadata</th><th>restore</th></tr>' +
+    rows.map(c =>
+      `<tr>${cell(c.uuid)}${cell(c.steps_completed)}` +
+      cell(size(c)) +
+      cell(JSON.stringify(c.metadata || {})) +
+      `<td><code>dtpu checkpoint download ${esc(c.uuid)}</code></td></tr>`
+    ).join('') + '</table>' +
+    (rows.length ? '' : '(no checkpoints yet)');
+}
+
 function drawHpViz(trials) {
   const div = $('hpviz');
   div.textContent = '';
@@ -330,20 +441,112 @@ function drawHpViz(trials) {
   div.appendChild(parallelCoords(trials));
 }
 
+// --- tasks (launch notebook/shell/command from the UI) -----------------
+async function launchTask() {
+  const entry = $('task-entry').value.trim();
+  if (!entry) { alert('entrypoint required'); return; }
+  await post('/api/v1/commands', {
+    config: {entrypoint: entry, task_type: $('task-type').value},
+  });
+  refresh();
+}
+async function killTask(id) {
+  await post(`/api/v1/commands/${id}/kill`);
+  refresh();
+}
+
+// --- admin -------------------------------------------------------------
+let adminUsers = [];
+async function setRole(i) {
+  const name = adminUsers[i];
+  const role = $(`role-${i}`).value;
+  await post(`/api/v1/users/${encodeURIComponent(name)}/role`, {role});
+  refresh();
+}
+
+let adminTick = 0, adminDisabled = false;
+async function refreshAdmin() {
+  // Admin data is best-effort: non-admin principals get 403s here and the
+  // sections simply stay empty (the API enforces, the page degrades).
+  // Fetched in ONE parallel batch, every 5th poll (admin tables churn
+  // slowly), and not at all once a 403 shows we're not an admin.
+  if (adminDisabled || (adminTick++ % 5) !== 0) return;
+  try {
+    const [usersR, groupsR, tplsR, auditR] = await Promise.all([
+      j('/api/v1/users'), j('/api/v1/groups'), j('/api/v1/templates'),
+      j('/api/v1/audit?limit=50'),
+    ]);
+    if (usersR.error) { adminDisabled = true; return; }
+    const users = usersR.users || [];
+    adminUsers = users.map(u => u.username);
+    $('users').innerHTML = '<tr><th>user</th><th>role</th><th>set</th></tr>' +
+      users.map((u, i) =>
+        `<tr>${cell(u.username)}${cell(u.role)}` +
+        `<td><select id="role-${i}">` +
+        ['viewer', 'editor', 'admin'].map(ro =>
+          `<option${ro === u.role ? ' selected' : ''}>${ro}</option>`).join('') +
+        `</select> <button onclick="setRole(${i})">apply</button></td></tr>`
+      ).join('');
+    const groups = groupsR.groups || {};
+    $('groups').innerHTML = '<tr><th>group</th><th>role</th><th>members</th></tr>' +
+      Object.entries(groups).map(([name, g]) =>
+        `<tr>${cell(name)}${cell(g.role)}${cell((g.members || []).join(', '))}</tr>`
+      ).join('');
+    const tpls = tplsR.templates || [];
+    $('templates').innerHTML = '<tr><th>name</th><th>config</th></tr>' +
+      tpls.map(t =>
+        `<tr>${cell(t.name)}${cell(JSON.stringify(t.config))}</tr>`).join('');
+    const audit = auditR.audit || [];
+    $('audit').innerHTML =
+      '<tr><th>when</th><th>user</th><th>call</th><th>status</th></tr>' +
+      audit.map(a =>
+        `<tr>${cell(new Date(a.ts * 1000).toISOString())}${cell(a.username)}` +
+        cell(`${a.method} ${a.path}`) + cell(a.status) + '</tr>').join('');
+  } catch (e) { /* 403 for non-admins: leave sections empty */ }
+}
+
+function pager(el, page, total, onchange) {
+  const pages = Math.max(1, Math.ceil(total / PAGE_SIZE));
+  el.innerHTML = `page ${page + 1}/${pages} · ${total} total ` +
+    `<button onclick="${onchange}=Math.max(0,${page}-1);refresh()">prev</button> ` +
+    `<button onclick="${onchange}=Math.min(${pages - 1},${page}+1);refresh()">next</button>`;
+}
+
 async function refresh() {
   try {
-    // One round-trip's latency, not six: these polls are independent.
-    const [info, queuesR, wssR, projsR, modelsR, expsR] = await Promise.all([
-      j('/api/v1/master'), j('/api/v1/queues'), j('/api/v1/workspaces'),
-      j('/api/v1/projects'), j('/api/v1/models'), j('/api/v1/experiments'),
-    ]);
+    // One round-trip's latency, not seven: these polls are independent.
+    const showArchived = $('show-archived').checked ? 1 : 0;
+    const [info, queuesR, wssR, projsR, modelsR, expsR, poolsR, tasksR] =
+      await Promise.all([
+        j('/api/v1/master'), j('/api/v1/queues'), j('/api/v1/workspaces'),
+        j('/api/v1/projects'), j('/api/v1/models'),
+        j(`/api/v1/experiments?limit=${PAGE_SIZE}&offset=${expPage * PAGE_SIZE}` +
+          `&order=desc&include_archived=${showArchived}`),
+        j('/api/v1/resource-pools'), j('/api/v1/commands'),
+      ]);
     $('cluster').textContent = `· cluster ${info.cluster_id} · v${info.version}`;
     const agents = info.agents || {};
     $('agents').innerHTML = '<tr><th>id</th><th>pool</th><th>slots</th></tr>' +
       Object.entries(agents).map(([id, a]) =>
         `<tr>${cell(id)}${cell(a.pool)}${cell(a.slots)}</tr>`).join('');
 
+    $('pools').innerHTML = '<tr><th>pool</th><th>agents</th><th>slots</th>' +
+      '<th>used</th><th>pending</th></tr>' +
+      (poolsR.resource_pools || []).map(p =>
+        `<tr>${cell(p.name)}${cell(p.agents)}${cell(p.slots_total)}` +
+        cell(p.slots_used) +
+        cell(`${p.pending_allocs} allocs / ${p.pending_slots} slots`) +
+        '</tr>').join('');
+
     renderQueues(queuesR.queues);
+
+    const tasks = tasksR.commands || [];
+    $('tasks').innerHTML = '<tr><th>task</th><th>type</th><th>state</th><th></th></tr>' +
+      tasks.map((t, i) =>
+        `<tr>${cell(t.task_id)}${cell(t.task_type)}${state(t.state)}` +
+        `<td>${t.state === 'RUNNING'
+           ? `<button onclick="killTask('${esc(t.task_id)}')">kill</button>` : ''}` +
+        '</td></tr>').join('');
 
     const wss = wssR.workspaces || [], projs = projsR.projects || [];
     $('workspaces').innerHTML =
@@ -358,7 +561,8 @@ async function refresh() {
       models.map(mo =>
         `<tr>${cell(mo.name)}${cell(mo.description || '')}</tr>`).join('');
 
-    const exps = expsR.experiments.slice().reverse();
+    const exps = expsR.experiments;  // server-side newest-first page
+    pager($('exp-pager'), expPage, expsR.total, 'expPage');
     $('exps').innerHTML =
       '<tr><th>id</th><th>state</th><th>progress</th><th>searcher</th><th></th></tr>' +
       exps.map(e => {
@@ -368,25 +572,38 @@ async function refresh() {
           : (e.state === 'PAUSED'
              ? `<button onclick="expAction(${e.id},'activate')">activate</button>`
              : '');
-        const kill = ['COMPLETED', 'CANCELED', 'ERRORED'].includes(e.state)
+        const terminal = ['COMPLETED', 'CANCELED', 'ERRORED'].includes(e.state);
+        const kill = terminal
           ? '' : ` <button onclick="expAction(${e.id},'kill')">kill</button>`;
+        const arch = terminal
+          ? (e.archived
+             ? ` <button onclick="expAction(${e.id},'unarchive')">unarchive</button>`
+             : ` <button onclick="expAction(${e.id},'archive')">archive</button>`)
+          : '';
         return `<tr>${cell(e.id)}${state(e.state)}` +
           `<td><span class="bar"><div style="width:${pct}%"></div></span> ${pct}%</td>` +
           cell((e.config.searcher || {}).name || '') +
-          `<td><button onclick="selExp=${e.id};refresh()">trials</button> ` +
-          `${act}${kill}</td></tr>`;
+          `<td><button onclick="selExp=${e.id};trialPage=0;refresh()">trials</button> ` +
+          `<button onclick="forkExp(${e.id})">fork</button>` +
+          `${act}${kill}${arch}</td></tr>`;
       }).join('');
 
     if (selExp !== null) {
       $('exp-label').textContent = `· experiment ${selExp}`;
-      const trials = (await j(`/api/v1/experiments/${selExp}/trials`)).trials;
+      const trialsR = await j(`/api/v1/experiments/${selExp}/trials` +
+        `?limit=${PAGE_SIZE}&offset=${trialPage * PAGE_SIZE}`);
+      const trials = trialsR.trials;
+      pager($('trial-pager'), trialPage, trialsR.total, 'trialPage');
       $('trials').innerHTML =
-        '<tr><th>id</th><th>state</th><th>steps</th><th>restarts</th><th>metric</th><th>hparams</th><th></th></tr>' +
+        '<tr><th>cmp</th><th>id</th><th>state</th><th>steps</th><th>restarts</th><th>metric</th><th>hparams</th><th></th></tr>' +
         trials.map(t =>
-          `<tr>${cell(t.id)}${state(t.state)}${cell(t.steps_completed)}` +
+          `<tr><td><input type="checkbox" ${cmpTrials.has(t.id) ? 'checked' : ''} ` +
+          `onchange="this.checked?cmpTrials.add(${t.id}):cmpTrials.delete(${t.id})"></td>` +
+          `${cell(t.id)}${state(t.state)}${cell(t.steps_completed)}` +
           cell(t.restarts) + cell(t.searcher_metric ?? '') +
           cell(JSON.stringify(t.hparams)) +
-          `<td><button onclick="selTrial=${t.id};logAfter=0;$('logs').textContent='';refresh()">logs</button></td></tr>`
+          `<td><button onclick="selTrial=${t.id};logAfter=0;$('logs').textContent='';refresh()">logs</button> ` +
+          `<button onclick="showCkpts(${t.id})">ckpts</button></td></tr>`
         ).join('');
       drawHpViz(trials);
     }
@@ -401,6 +618,7 @@ async function refresh() {
       }
       $('logs').scrollTop = $('logs').scrollHeight;
     }
+    await refreshAdmin();
   } catch (e) { console.error(e); }
 }
 refresh();

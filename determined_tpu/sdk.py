@@ -225,10 +225,26 @@ class Determined:
     def get_experiment(self, exp_id: int) -> Experiment:
         return Experiment(self._session, exp_id)
 
-    def list_experiments(self) -> List[Experiment]:
+    def list_experiments(
+        self,
+        include_archived: bool = True,
+        limit: Optional[int] = None,
+        offset: int = 0,
+    ) -> List[Experiment]:
+        """include_archived defaults True for script compat (cleanup /
+        reporting loops must keep seeing archived rows); the WebUI hides
+        them by default instead."""
+        params: Dict[str, str] = {}
+        if include_archived:
+            params["include_archived"] = "1"
+        if limit is not None:
+            params["limit"] = str(limit)
+            params["offset"] = str(offset)
         return [
             Experiment(self._session, e["id"])
-            for e in self._session.get("/api/v1/experiments")["experiments"]
+            for e in self._session.get(
+                "/api/v1/experiments", params=params
+            )["experiments"]
         ]
 
     def get_trial(self, trial_id: int) -> Trial:

@@ -253,7 +253,11 @@ def _read_region(
 
     rshape = tuple(e - s for s, e in region)
     out = np.empty(rshape, dtype=dtype)
-    covered = 0
+    # Exact coverage tracking: summing chunk sizes would double-count
+    # overlapping shards, letting a malformed checkpoint with overlaps AND
+    # a hole pass the completeness check and hand uninitialized np.empty
+    # bytes to the optimizer. One bool per element, freed before return.
+    seen = np.zeros(rshape, dtype=np.bool_)
     for starts, fshape, path in entry["shards"]:
         if len(starts) != len(fshape) or len(fshape) != len(shape):
             raise ValueError(
@@ -278,8 +282,9 @@ def _read_region(
         arr = np.load(path, mmap_mode="r")
         chunk = np.asarray(arr[tuple(src)]).astype(dtype, copy=False)
         out[tuple(dst)] = chunk
-        covered += chunk.size
+        seen[tuple(dst)] = True
         _bytes_materialized += chunk.nbytes
+    covered = int(seen.sum())
     if covered < out.size:
         raise ValueError(
             f"shards for {name} cover {covered} of {out.size} elements; "

@@ -115,9 +115,21 @@ class TestDeployGcp:
         assert len(ran) == 2
         create, firewall = ran
         assert create[:4] == ["gcloud", "compute", "instances", "create"]
-        script = next(
-            a for a in create if a.startswith("--metadata=startup-script=")
+        # Script travels via --metadata-from-file: a comma inside the
+        # rendered script must not be parsed by gcloud as a metadata
+        # key separator (and argv length limits don't apply).
+        path_arg = next(
+            a for a in create
+            if a.startswith("--metadata-from-file=startup-script=")
         )
+        script_path = path_arg.split("=", 2)[2]
+        import os
+        import stat
+
+        # credential-bearing file: owner-only perms
+        assert stat.S_IMODE(os.stat(script_path).st_mode) == 0o600
+        with open(script_path) as f:
+            script = f.read()
         assert "systemctl enable --now dtpu-master" in script
         assert "--tls" in script              # TLS bootstrap by default
         assert "/var/lib/dtpu/master.db" in script
@@ -133,6 +145,9 @@ class TestDeployGcp:
         assert "--users" not in script  # never on the command line
         assert firewall[:4] == ["gcloud", "compute", "firewall-rules",
                                 "create"]
+        # custom runners own script cleanup via the returned paths
+        for p in result["script_files"]:
+            os.remove(p)
         assert "--source-ranges=10.0.0.0/8" in firewall
 
     def test_no_public_firewall_by_default(self):

@@ -1,0 +1,288 @@
+"""Fleet-scale WebUI + API breadth: server-side pagination, archive,
+fork/continue, resource pools — the routes the upgraded dashboard drives
+(VERDICT r3 next #3/#7; ref capability webui/react/src/pages/* and
+api_experiment.go fork/archive, api_resourcepools)."""
+import time
+
+import pytest
+import requests
+
+from determined_tpu.master.api_server import ApiServer
+from determined_tpu.master.core import Master
+
+
+@pytest.fixture()
+def live():
+    master = Master()
+    api = ApiServer(master)
+    api.start()
+    master.external_url = api.url
+    yield master, api
+    api.stop()
+    master.shutdown()
+
+
+def _mk_exp(master, n=1, state=None):
+    ids = []
+    for _ in range(n):
+        eid = master.create_experiment({
+            "entrypoint": "x:y", "unmanaged": True,
+            "searcher": {"name": "single", "max_length": 5, "metric": "loss"},
+            "hyperparameters": {"lr": 0.1},
+        })
+        if state is not None:
+            master.get_experiment(eid).kill()
+        ids.append(eid)
+    return ids
+
+
+class TestPagination:
+    def test_experiments_page_server_side(self, live):
+        master, api = live
+        _mk_exp(master, 120)
+        r = requests.get(
+            f"{api.url}/api/v1/experiments?limit=50&offset=0&order=desc",
+            timeout=10,
+        ).json()
+        assert len(r["experiments"]) == 50
+        assert r["total"] == 120
+        # newest first: the page starts at the highest id
+        assert r["experiments"][0]["id"] == 120
+        r2 = requests.get(
+            f"{api.url}/api/v1/experiments?limit=50&offset=100", timeout=10
+        ).json()
+        assert len(r2["experiments"]) == 20
+
+    def test_fleet_page_latency(self, live):
+        """A 1,000-experiment fleet must page interactively: one page's
+        fetch stays well under the UI's 2s poll interval."""
+        master, api = live
+        _mk_exp(master, 1000)
+        t0 = time.perf_counter()
+        r = requests.get(
+            f"{api.url}/api/v1/experiments?limit=50&order=desc", timeout=10
+        ).json()
+        dt = time.perf_counter() - t0
+        assert len(r["experiments"]) == 50 and r["total"] == 1000
+        assert dt < 1.0, f"page fetch took {dt:.2f}s"
+
+    def test_trials_paginated(self, live):
+        master, api = live
+        eid = master.create_experiment({
+            "entrypoint": "x:y", "unmanaged": True,
+            "searcher": {"name": "random", "max_trials": 12, "max_length": 5,
+                         "metric": "loss"},
+            "hyperparameters": {"lr": {"type": "log", "minval": -4,
+                                       "maxval": -1}},
+        })
+        r = requests.get(
+            f"{api.url}/api/v1/experiments/{eid}/trials?limit=5&offset=10",
+            timeout=10,
+        ).json()
+        assert r["total"] == 12
+        assert len(r["trials"]) == 2
+
+
+class TestArchive:
+    def test_archive_hides_from_default_listing(self, live):
+        master, api = live
+        (eid,) = _mk_exp(master, 1, state="kill")
+        requests.post(
+            f"{api.url}/api/v1/experiments/{eid}/archive", timeout=10
+        ).raise_for_status()
+        default = requests.get(
+            f"{api.url}/api/v1/experiments", timeout=10
+        ).json()
+        assert eid not in [e["id"] for e in default["experiments"]]
+        withall = requests.get(
+            f"{api.url}/api/v1/experiments?include_archived=1", timeout=10
+        ).json()
+        row = next(e for e in withall["experiments"] if e["id"] == eid)
+        assert row["archived"]
+        requests.post(
+            f"{api.url}/api/v1/experiments/{eid}/unarchive", timeout=10
+        ).raise_for_status()
+        back = requests.get(f"{api.url}/api/v1/experiments", timeout=10).json()
+        assert eid in [e["id"] for e in back["experiments"]]
+
+    def test_archive_refuses_running(self, live):
+        master, api = live
+        (eid,) = _mk_exp(master, 1)
+        r = requests.post(
+            f"{api.url}/api/v1/experiments/{eid}/archive", timeout=10
+        )
+        assert r.status_code == 400
+
+
+class TestForkContinue:
+    def test_fork_copies_config_with_overrides(self, live):
+        master, api = live
+        (eid,) = _mk_exp(master, 1)
+        r = requests.post(
+            f"{api.url}/api/v1/experiments/{eid}/fork",
+            json={"config": {"searcher": {"max_length": 9}}}, timeout=10,
+        ).json()
+        assert r["forked_from"] == eid
+        cfg = master.db.get_experiment(r["id"])["config"]
+        assert cfg["searcher"]["max_length"] == 9
+        assert cfg["searcher"]["name"] == "single"  # inherited
+
+    def test_fork_with_latest_checkpoint_warm_starts(self, live):
+        master, api = live
+        (eid,) = _mk_exp(master, 1)
+        trial = master.db.list_trials(eid)[0]
+        master.db.add_checkpoint(
+            "aaaa-bbbb", trial_id=trial["id"], task_id=f"trial-{trial['id']}",
+            allocation_id="x", resources=[{"path": "p", "size": 10}],
+            metadata={"steps_completed": 5},
+        )
+        master.db.update_trial(trial["id"], latest_checkpoint="aaaa-bbbb")
+        r = requests.post(
+            f"{api.url}/api/v1/experiments/{eid}/fork",
+            json={"checkpoint_uuid": "latest"}, timeout=10,
+        ).json()
+        assert r["warm_start_checkpoint"] == "aaaa-bbbb"
+        cfg = master.db.get_experiment(r["id"])["config"]
+        assert cfg["warm_start_checkpoint"] == "aaaa-bbbb"
+
+    def test_fork_best_honors_smaller_is_better(self, live):
+        """checkpoint_uuid="best" must respect searcher.smaller_is_better —
+        an accuracy-style metric fork must warm-start from the HIGHEST
+        metric trial, not the lowest."""
+        master, api = live
+        eid = master.create_experiment({
+            "entrypoint": "x:y", "unmanaged": True,
+            "searcher": {"name": "random", "max_trials": 2, "max_length": 5,
+                         "metric": "acc", "smaller_is_better": False},
+            "hyperparameters": {"lr": {"type": "log", "minval": -4,
+                                       "maxval": -1}},
+        })
+        t_lo, t_hi = master.db.list_trials(eid)
+        for trial, metric, uuid in ((t_lo, 0.2, "aa00-11"),
+                                    (t_hi, 0.9, "bb00-22")):
+            master.db.add_checkpoint(
+                uuid, trial_id=trial["id"], task_id=f"trial-{trial['id']}",
+                allocation_id="x", resources=[], metadata={},
+            )
+            master.db.update_trial(
+                trial["id"], latest_checkpoint=uuid, searcher_metric=metric
+            )
+        r = requests.post(
+            f"{api.url}/api/v1/experiments/{eid}/fork",
+            json={"checkpoint_uuid": "best"}, timeout=10,
+        ).json()
+        assert r["warm_start_checkpoint"] == "bb00-22"
+
+    def test_fork_unknown_checkpoint_404(self, live):
+        master, api = live
+        (eid,) = _mk_exp(master, 1)
+        r = requests.post(
+            f"{api.url}/api/v1/experiments/{eid}/fork",
+            json={"checkpoint_uuid": "nope-nope"}, timeout=10,
+        )
+        assert r.status_code == 404
+
+    def test_continue_extends_max_length(self, live):
+        master, api = live
+        (eid,) = _mk_exp(master, 1)
+        trial = master.db.list_trials(eid)[0]
+        master.db.add_checkpoint(
+            "cccc-dddd", trial_id=trial["id"], task_id=f"trial-{trial['id']}",
+            allocation_id="x", resources=[], metadata={},
+        )
+        master.db.update_trial(trial["id"], latest_checkpoint="cccc-dddd")
+        r = requests.post(
+            f"{api.url}/api/v1/experiments/{eid}/continue",
+            json={"max_length": 50}, timeout=10,
+        ).json()
+        cfg = master.db.get_experiment(r["id"])["config"]
+        assert cfg["searcher"]["max_length"] == 50
+        assert cfg["warm_start_checkpoint"] == "cccc-dddd"
+
+
+class TestResourcePools:
+    def test_pool_overview(self, live):
+        master, api = live
+        master.agent_registered("rp-agent", 4, "default", [])
+        pools = requests.get(
+            f"{api.url}/api/v1/resource-pools", timeout=10
+        ).json()["resource_pools"]
+        (default,) = [p for p in pools if p["name"] == "default"]
+        assert default["agents"] == 1
+        assert default["slots_total"] == 4
+        assert default["slots_used"] == 0
+
+
+class TestCliVerbs:
+    def test_fork_archive_rp_download_verbs(self, live, tmp_path, capsys):
+        from determined_tpu.cli.cli import main as cli_main
+
+        master, api = live
+        (eid,) = _mk_exp(master, 1, state="kill")
+
+        def run(*argv):
+            cli_main(["--master", api.url, *argv])
+            return capsys.readouterr().out
+
+        out = run("experiment", "fork", str(eid))
+        assert "forked from" in out
+        out = run("experiment", "archive", str(eid))
+        assert "archived" in out
+        out = run("experiment", "list")
+        assert f"\\n{eid} " not in out  # hidden by default
+        out = run("experiment", "list", "--all")
+        assert "yes" in out
+        out = run("resource-pool", "list")
+        assert "default" in out
+
+        # checkpoint download through the storage layer (shared_fs)
+        live_exp = master.db.get_experiment(eid)
+        cfg = dict(live_exp["config"])
+        cfg["checkpoint_storage"] = {"type": "shared_fs",
+                                     "host_path": str(tmp_path / "ckpt")}
+        cid = master.create_experiment(cfg)
+        master.get_experiment(cid).kill()
+        trial = master.db.list_trials(cid)[0]
+        from determined_tpu.storage.base import from_config
+
+        store = from_config(cfg["checkpoint_storage"])
+        src = tmp_path / "stage"
+        src.mkdir()
+        (src / "weights.bin").write_bytes(b"hi" * 10)
+        store.upload(str(src), "ab12cd34-ef56")
+        master.db.add_checkpoint(
+            "ab12cd34-ef56", trial_id=trial["id"],
+            task_id=f"trial-{trial['id']}", allocation_id="x",
+            resources=["weights.bin"], metadata={},
+        )
+        dest = tmp_path / "out"
+        run("checkpoint", "download", "ab12cd34-ef56", str(dest))
+        assert (dest / "weights.bin").read_bytes() == b"hi" * 10
+
+
+class TestPageSections:
+    def test_page_serves_new_sections(self, live):
+        _, api = live
+        html = requests.get(f"{api.url}/ui", timeout=10).text
+        for marker in (
+            "Resource pools", "Trial comparison", "Checkpoints", "Admin",
+            "drawComparison", "showCkpts", "launchTask", "show-archived",
+            "exp-pager", "trial-pager", "forkExp", "Audit tail",
+        ):
+            assert marker in html, marker
+
+    def test_checkpoint_browser_endpoint(self, live):
+        master, api = live
+        (eid,) = _mk_exp(master, 1)
+        trial = master.db.list_trials(eid)[0]
+        master.db.add_checkpoint(
+            "eeee-ffff", trial_id=trial["id"], task_id=f"trial-{trial['id']}",
+            allocation_id="x", resources=[{"path": "w", "size": 2_000_000}],
+            metadata={"steps_completed": 3},
+        )
+        out = requests.get(
+            f"{api.url}/api/v1/trials/{trial['id']}/checkpoints", timeout=10
+        ).json()
+        (c,) = out["checkpoints"]
+        assert c["uuid"] == "eeee-ffff"
+        assert c["resources"][0]["size"] == 2_000_000
