@@ -116,6 +116,7 @@ def master_vm_commands(
     admin_password: str = "",
     source_ranges: str = "",
     package_source: str = "pip install determined-tpu",
+    write_script: bool = True,
 ) -> List[List[str]]:
     """The gcloud invocations that stand the master up (create + firewall).
     Returned as argv lists so tests can assert them and `deploy` can run
@@ -132,15 +133,21 @@ def master_vm_commands(
     # script (a pip pin like 'pkg>=1,<2', a second DTPU_USERS entry)
     # would silently corrupt the metadata and break the VM bootstrap.
     # A file also dodges argv length limits.
-    import os
-    import tempfile
+    if write_script:
+        import tempfile
 
-    fd, script_path = tempfile.mkstemp(prefix="dtpu-startup-", suffix=".sh")
-    with os.fdopen(fd, "w") as f:
-        f.write(script)
-    # The script embeds the generated admin credential (DTPU_USERS):
-    # owner-only perms, and deploy() removes it after the gcloud call.
-    os.chmod(script_path, 0o600)
+        fd, script_path = tempfile.mkstemp(
+            prefix="dtpu-startup-", suffix=".sh"
+        )
+        with os.fdopen(fd, "w") as f:
+            f.write(script)
+        # The script embeds the generated admin credential (DTPU_USERS):
+        # owner-only perms, and deploy() removes it after the gcloud call.
+        os.chmod(script_path, 0o600)
+    else:
+        # Preview (dry run): no credential file lands on disk; the caller
+        # receives the script content to save at this placeholder path.
+        script_path = "./dtpu-startup.sh"
     create = [
         "gcloud", "compute", "instances", "create", name,
         f"--project={project}", f"--zone={zone}",
@@ -173,16 +180,19 @@ def deploy(
 ) -> Dict[str, Any]:
     """Execute (or print) the deployment. Generates the admin password if
     not supplied; returns {"commands": [...], "admin_password": ...} so the
-    caller can hand the credential to the operator exactly once."""
+    caller can hand the credential to the operator exactly once. Dry runs
+    write NO credential file: the returned "startup_script" content is for
+    the operator to save at the placeholder path in the printed command."""
     if not admin_password:
         import secrets
 
         admin_password = secrets.token_urlsafe(12)
     cmds = master_vm_commands(
-        project=project, zone=zone, admin_password=admin_password, **kw
+        project=project, zone=zone, admin_password=admin_password,
+        write_script=not dry_run, **kw
     )
     lines = [shlex.join(c) for c in cmds]
-    script_files = [
+    script_files = [] if dry_run else [
         a.split("=", 2)[2]
         for c in cmds for a in c
         if a.startswith("--metadata-from-file=startup-script=")
@@ -205,5 +215,14 @@ def deploy(
                         os.remove(path)
                     except OSError:
                         pass
-    return {"commands": lines, "admin_password": admin_password,
-            "script_files": script_files}
+    result = {"commands": lines, "admin_password": admin_password,
+              "script_files": script_files}
+    if dry_run:
+        result["startup_script"] = startup_script(
+            package_source=kw.get(
+                "package_source", "pip install determined-tpu"
+            ),
+            port=kw.get("port", 8080), tls=kw.get("tls", True),
+            admin_password=admin_password,
+        )
+    return result

@@ -538,11 +538,11 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         include_archived = r.q("include_archived", "") in ("1", "true")
         limit = r.q("limit", "")
         kw: Dict[str, Any] = {"include_archived": include_archived}
+        kw["newest_first"] = r.q("order", "") == "desc"
         try:
             if limit:
                 kw["limit"] = max(1, min(int(limit), 500))
                 kw["offset"] = max(0, int(r.q("offset", "0") or 0))
-                kw["newest_first"] = r.q("order", "") == "desc"
         except ValueError:
             raise ApiError(400, "limit/offset must be integers")
         return {

@@ -2,13 +2,20 @@
 HTTP API.
 
 Rebuild of the reference's client-go layer (`master/internal/rm/
-kubernetesrm/pods.go:63` clientset construction + `request_queue.go`
-retry discipline): in-cluster config comes from the standard pod
-environment (KUBERNETES_SERVICE_HOST/PORT + the serviceaccount token/CA/
-namespace files); every mutating call retries transient failures with
-backoff; pod stdout is followed over `GET .../log?follow=true` and shipped
-into the master's task-log store (the reference streams container logs via
-fluentbit→master; here the master pulls, which needs no agent in the pod).
+kubernetesrm/pods.go:63` clientset construction + `informer.go` watch
+streams + `request_queue.go` retry discipline): in-cluster config comes
+from the standard pod environment (KUBERNETES_SERVICE_HOST/PORT + the
+serviceaccount token/CA/namespace files); every mutating call retries
+transient failures with backoff; pod stdout is followed over
+`GET .../log?follow=true` and shipped into the master's task-log store
+(the reference streams container logs via fluentbit→master; here the
+master pulls, which needs no agent in the pod).
+
+Watch streams (start_watch) replace per-tick O(pods) LIST polling: pod and
+node events arrive over `?watch=true` with resourceVersion resume and
+410-Gone re-list — the informer pattern (`kubernetesrm/informer.go`,
+`pods.go:669`). The poll fallback is retained: until the first watch sync
+(and whenever watching is off) pod_phases()/list_nodes() LIST directly.
 
 The pool-side contract (`master/kubernetes.py` KubeClient) is unchanged —
 the whole RM test matrix runs against this driver pointed at a fake
@@ -16,8 +23,10 @@ apiserver speaking the same HTTP (tests/test_kube_rest.py).
 """
 from __future__ import annotations
 
+import json
 import logging
 import os
+import re
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -96,6 +105,19 @@ class RestKubeClient(KubeClient):
         self.log_sink: Optional[LogSink] = None
         self._followers: Dict[str, threading.Thread] = {}
         self._followers_lock = threading.Lock()
+        # Watch (informer) state: pod + node caches fed by watch streams.
+        self._watch_stop: Optional[threading.Event] = None
+        self._watch_lock = threading.Lock()
+        self._watch_pods: Dict[str, Dict[str, Any]] = {}
+        self._watch_nodes: Dict[str, NodeInfo] = {}
+        self._pods_synced = False
+        self._nodes_synced = False
+        #: newest resourceVersion each kind has been OBSERVED at (watch
+        #: list, fallback list, or event). A watch LIST that started before
+        #: a pod's creation but completed after a fresher fallback LIST
+        #: must NOT seed the cache — the regression would make a live,
+        #: already-seen pod look vanished and tear down a healthy gang.
+        self._newest_rv: Dict[str, int] = {"pods": 0, "nodes": 0}
 
     # -- transport ---------------------------------------------------------
     def _request(
@@ -146,27 +168,49 @@ class RestKubeClient(KubeClient):
         raise last
 
     # -- KubeClient surface --------------------------------------------------
+    @staticmethod
+    def _node_from_item(item: Dict[str, Any]) -> Optional[NodeInfo]:
+        meta = item.get("metadata", {})
+        status = item.get("status", {})
+        spec = item.get("spec", {})
+        if spec.get("unschedulable"):
+            return None
+        alloc = status.get("allocatable", {})
+        labels = meta.get("labels", {})
+        slots = int(alloc.get(TPU_RESOURCE, labels.get(SLOTS_LABEL, 0)))
+        if slots <= 0:
+            return None  # not a TPU host; nothing we can place
+        return NodeInfo(
+            name=meta["name"], slots=slots,
+            pool=labels.get("cloud.google.com/gke-nodepool", "default"),
+        )
+
     def list_nodes(self) -> List[NodeInfo]:
+        watching = self._watch_stop is not None
+        with self._watch_lock:
+            if watching and self._nodes_synced:
+                return list(self._watch_nodes.values())
         resp = self._request("GET", "/api/v1/nodes")
         assert resp is not None
-        out: List[NodeInfo] = []
-        for item in resp.json().get("items", []):
-            meta = item.get("metadata", {})
-            status = item.get("status", {})
-            spec = item.get("spec", {})
-            if spec.get("unschedulable"):
-                continue
-            alloc = status.get("allocatable", {})
-            labels = meta.get("labels", {})
-            slots = int(alloc.get(TPU_RESOURCE, labels.get(SLOTS_LABEL, 0)))
-            if slots <= 0:
-                continue  # not a TPU host; nothing we can place
-            out.append(
-                NodeInfo(
-                    name=meta["name"], slots=slots,
-                    pool=labels.get("cloud.google.com/gke-nodepool", "default"),
-                )
+        body = resp.json()
+        if watching:
+            # Feed the fallback through the same rv-gated apply as the
+            # watch's own LIST: whichever snapshot is newest wins, and a
+            # stale watch LIST can never regress below a view this method
+            # already served (that regression made live pods look
+            # vanished).
+            self._apply_node_list(
+                body.get("items", []),
+                body.get("metadata", {}).get("resourceVersion"),
             )
+            with self._watch_lock:
+                if self._nodes_synced:
+                    return list(self._watch_nodes.values())
+        out: List[NodeInfo] = []
+        for item in body.get("items", []):
+            node = self._node_from_item(item)
+            if node is not None:
+                out.append(node)
         return out
 
     def create_pod(self, spec: Dict[str, Any]) -> str:
@@ -224,26 +268,279 @@ class RestKubeClient(KubeClient):
         )
 
     def pod_phases(self) -> Dict[str, str]:
-        resp = self._request(
-            "GET", f"/api/v1/namespaces/{self.namespace}/pods",
-            params={"labelSelector": MANAGED_LABEL},
-        )
-        assert resp is not None
+        watching = self._watch_stop is not None
+        with self._watch_lock:
+            if watching and self._pods_synced:
+                pods = {n: dict(p) for n, p in self._watch_pods.items()}
+                synced = True
+            else:
+                synced = False
+        if not synced:
+            resp = self._request(
+                "GET", f"/api/v1/namespaces/{self.namespace}/pods",
+                params={"labelSelector": MANAGED_LABEL},
+            )
+            assert resp is not None
+            body = resp.json()
+            if watching:
+                # rv-gated apply (see list_nodes): the cache must end up
+                # at least as new as THIS view, or a stale watch LIST
+                # applied later would make pods we're about to report as
+                # alive look vanished on the next sync.
+                self._apply_pod_list(
+                    body.get("items", []),
+                    body.get("metadata", {}).get("resourceVersion"),
+                )
+            pods = {}
+            for item in body.get("items", []):
+                meta = item.get("metadata", {})
+                status = item.get("status", {})
+                pods[meta.get("name", "")] = {
+                    "phase": status.get("phase", "Pending"),
+                    "reason": status.get("reason", ""),
+                    "labels": meta.get("labels", {}),
+                }
+            if watching:
+                with self._watch_lock:
+                    if self._pods_synced:
+                        # serve the (>=) cache view for consistency
+                        pods = {
+                            n: dict(p)
+                            for n, p in self._watch_pods.items()
+                        }
         phases: Dict[str, str] = {}
         reasons: Dict[str, str] = {}
-        for item in resp.json().get("items", []):
-            name = item.get("metadata", {}).get("name", "")
-            status = item.get("status", {})
-            phases[name] = status.get("phase", "Pending")
-            if status.get("reason"):
-                reasons[name] = status["reason"]
+        for name, pod in pods.items():
+            phases[name] = pod["phase"]
+            if pod.get("reason"):
+                reasons[name] = pod["reason"]
         with self._reasons_lock:
             self._reasons = reasons
+        self._ensure_followers(pods)
         return phases
+
+    def _ensure_followers(self, pods: Dict[str, Dict[str, Any]]) -> None:
+        """Restart log followers discovered missing during a phase sync —
+        a follower that died (master hiccup, exception) or was never
+        started (pod adopted via 409) must not leave the rest of the pod's
+        stdout unshipped."""
+        if self.log_sink is None:
+            return
+        for name, pod in pods.items():
+            if pod.get("phase") not in ("Running", "Pending"):
+                continue
+            task_id = (pod.get("labels") or {}).get("determined-tpu/task", "")
+            if task_id:
+                self._start_log_follower(name, task_id)
 
     def pod_status_reasons(self) -> Dict[str, str]:
         with self._reasons_lock:
             return dict(self._reasons)
+
+    # -- watch streams (informer.go / pods.go:669 pattern) -------------------
+    def start_watch(self, on_change: Optional[Callable[[], None]] = None) -> None:
+        """Start pod + node watch streams feeding the local caches;
+        `on_change` fires after every applied event (the pool uses it to
+        run an immediate sync instead of waiting for the next tick).
+        Idempotent; stop_watch() ends the threads."""
+        if self._watch_stop is not None:
+            return
+        self._watch_stop = threading.Event()
+        threading.Thread(
+            target=self._watch_loop,
+            args=(
+                "pods",
+                f"/api/v1/namespaces/{self.namespace}/pods",
+                {"labelSelector": MANAGED_LABEL},
+                self._apply_pod_list, self._apply_pod_event, on_change,
+            ),
+            name="kube-watch-pods", daemon=True,
+        ).start()
+        threading.Thread(
+            target=self._watch_loop,
+            args=(
+                "nodes", "/api/v1/nodes", {},
+                self._apply_node_list, self._apply_node_event, on_change,
+            ),
+            name="kube-watch-nodes", daemon=True,
+        ).start()
+
+    def stop_watch(self) -> None:
+        """End the watch threads and return to LIST-poll behavior: the
+        caches un-sync (a frozen snapshot must not masquerade as live
+        state) and start_watch() becomes callable again."""
+        if self._watch_stop is not None:
+            self._watch_stop.set()
+            self._watch_stop = None
+        with self._watch_lock:
+            self._pods_synced = False
+            self._nodes_synced = False
+
+    @staticmethod
+    def _rv_int(rv: Any) -> int:
+        """resourceVersion as an orderable int; 0 when unparseable (k8s
+        treats rvs as opaque, but etcd emits monotonically increasing
+        integers in practice — this is a staleness heuristic, and an
+        unparseable rv degrades to 'accept')."""
+        try:
+            return int(rv)
+        except (TypeError, ValueError):
+            return 0
+
+    def _note_rv(self, kind: str, rv: Any) -> None:
+        n = self._rv_int(rv)
+        with self._watch_lock:
+            if n > self._newest_rv[kind]:
+                self._newest_rv[kind] = n
+
+    def _apply_pod_list(
+        self, items: List[Dict[str, Any]], rv: Any = None
+    ) -> bool:
+        pods = {}
+        for item in items:
+            meta = item.get("metadata", {})
+            status = item.get("status", {})
+            pods[meta.get("name", "")] = {
+                "phase": status.get("phase", "Pending"),
+                "reason": status.get("reason", ""),
+                "labels": meta.get("labels", {}),
+            }
+        n = self._rv_int(rv)
+        with self._watch_lock:
+            if n and n < self._newest_rv["pods"]:
+                return False  # stale snapshot: re-list, don't regress
+            self._newest_rv["pods"] = max(self._newest_rv["pods"], n)
+            self._watch_pods = pods
+            self._pods_synced = True
+        return True
+
+    def _apply_pod_event(self, typ: str, obj: Dict[str, Any]) -> None:
+        meta = obj.get("metadata", {})
+        name = meta.get("name", "")
+        with self._watch_lock:
+            if typ == "DELETED":
+                self._watch_pods.pop(name, None)
+            else:
+                status = obj.get("status", {})
+                self._watch_pods[name] = {
+                    "phase": status.get("phase", "Pending"),
+                    "reason": status.get("reason", ""),
+                    "labels": meta.get("labels", {}),
+                }
+
+    def _apply_node_list(
+        self, items: List[Dict[str, Any]], rv: Any = None
+    ) -> bool:
+        nodes = {}
+        for item in items:
+            node = self._node_from_item(item)
+            if node is not None:
+                nodes[node.name] = node
+        n = self._rv_int(rv)
+        with self._watch_lock:
+            if n and n < self._newest_rv["nodes"]:
+                return False
+            self._newest_rv["nodes"] = max(self._newest_rv["nodes"], n)
+            self._watch_nodes = nodes
+            self._nodes_synced = True
+        return True
+
+    def _apply_node_event(self, typ: str, obj: Dict[str, Any]) -> None:
+        name = obj.get("metadata", {}).get("name", "")
+        with self._watch_lock:
+            if typ == "DELETED":
+                self._watch_nodes.pop(name, None)
+            else:
+                node = self._node_from_item(obj)
+                if node is None:
+                    # became unschedulable / lost its TPU allocatable
+                    self._watch_nodes.pop(name, None)
+                else:
+                    self._watch_nodes[name] = node
+
+    def _watch_loop(
+        self,
+        kind: str,
+        path: str,
+        base_params: Dict[str, str],
+        apply_list: Callable[..., bool],
+        apply_event: Callable[[str, Dict[str, Any]], None],
+        on_change: Optional[Callable[[], None]],
+    ) -> None:
+        """LIST to seed the cache + resourceVersion, then WATCH from that
+        version; a dropped stream resumes from the last seen version, a
+        410 Gone (version fell off the apiserver's window) re-lists."""
+        stop = self._watch_stop
+        assert stop is not None
+        rv: Optional[str] = None
+        while not stop.is_set():
+            try:
+                if rv is None:
+                    resp = self._request("GET", path, params=dict(base_params))
+                    assert resp is not None
+                    if stop.is_set():
+                        return  # stopped mid-list: don't re-latch the cache
+                    body = resp.json()
+                    list_rv = body.get("metadata", {}).get("resourceVersion")
+                    if not apply_list(body.get("items", []), list_rv):
+                        # Snapshot older than a view we already served
+                        # (fallback LIST raced ahead): list again.
+                        stop.wait(0.1)
+                        continue
+                    rv = str(list_rv or "")
+                    if on_change is not None:
+                        self._poke(on_change)
+                params = dict(base_params, watch="true")
+                if rv:
+                    params["resourceVersion"] = rv
+                resp = self._request(
+                    "GET", path, params=params, stream=True,
+                    # (connect, read): no between-reads timeout — a quiet
+                    # cluster produces no events for long stretches.
+                    timeout=(self._timeout, None),
+                )
+                assert resp is not None
+                for line in resp.iter_lines(decode_unicode=True):
+                    if stop.is_set():
+                        return
+                    if not line:
+                        continue
+                    try:
+                        evt = json.loads(line)
+                    except ValueError:
+                        continue
+                    if evt.get("type") == "ERROR":
+                        if (evt.get("object") or {}).get("code") == 410:
+                            rv = None  # history gone: full re-list
+                            break
+                        continue
+                    obj = evt.get("object") or {}
+                    new_rv = (obj.get("metadata") or {}).get("resourceVersion")
+                    if new_rv:
+                        rv = str(new_rv)
+                        self._note_rv(kind, new_rv)
+                    apply_event(str(evt.get("type", "")), obj)
+                    if on_change is not None:
+                        self._poke(on_change)
+                # Stream ended (apiserver timeout / transient drop):
+                # reconnect from the last resourceVersion — no re-list, no
+                # missed events.
+            except requests.HTTPError as e:
+                if e.response is not None and e.response.status_code == 410:
+                    rv = None
+                else:
+                    logger.warning("watch %s failed: %s; retrying", path, e)
+                    stop.wait(2.0)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("watch %s dropped: %s; retrying", path, e)
+                stop.wait(2.0)
+
+    @staticmethod
+    def _poke(on_change: Callable[[], None]) -> None:
+        try:
+            on_change()
+        except Exception:  # noqa: BLE001 - observer bugs must not kill watch
+            logger.exception("watch on_change callback failed")
 
     # -- log shipping --------------------------------------------------------
     def _start_log_follower(self, pod_name: str, task_id: str) -> None:
@@ -257,25 +554,67 @@ class RestKubeClient(KubeClient):
             self._followers[pod_name] = t
         t.start()
 
+    #: RFC3339 prefix of a `timestamps=true` log line.
+    _TS_RE = re.compile(r"^\d{4}-\d{2}-\d{2}T\S+")
+
+    @staticmethod
+    def _ts_key(ts: str):
+        """Orderable key for an RFC3339Nano stamp. Kubelet trims trailing
+        zeros from the fraction, so raw string compare mis-orders '.1Z'
+        vs '.123456789Z' — normalize the fraction to 9 digits. The date
+        part is fixed-width, so lexicographic compare is correct there."""
+        base, _, frac = ts.rstrip("Z").partition(".")
+        return (base, frac.ljust(9, "0"))
+
+    def _pod_finished(self, pod_name: str) -> bool:
+        """True when the pod is gone or terminal — the follower's stream
+        ending is then final, not a transient drop to resume from."""
+        try:
+            resp = self._request(
+                "GET",
+                f"/api/v1/namespaces/{self.namespace}/pods/{pod_name}",
+                ok_missing=True,
+            )
+        except Exception:  # noqa: BLE001 - apiserver flake: assume live, retry
+            return False
+        if resp is None:
+            return True
+        phase = resp.json().get("status", {}).get("phase", "")
+        return phase in ("Succeeded", "Failed")
+
     def _follow_logs(self, pod_name: str, task_id: str) -> None:
-        """Stream the pod's stdout into the task-log sink until the stream
-        ends (pod finished or deleted). Batches lines to one sink call per
-        read burst — the same batching contract as the agent shipper."""
+        """Stream the pod's stdout into the task-log sink until the pod is
+        finished. Batches lines to one sink call per read burst — the same
+        batching contract as the agent shipper.
+
+        Loss modes closed (VERDICT r3 next #9):
+        - NO Pending deadline: a pod queued behind node provisioning for
+          however long still gets followed once it runs (only pod DELETION
+          ends the wait);
+        - `timestamps=true` + `sinceTime` resume: a transiently-dropped
+          stream reconnects from the last shipped line's timestamp — the
+          gap is re-served, duplicates are skipped by timestamp compare;
+        - a stream that ends while the pod is still live is a DROP, not an
+          exit: keep following until the pod is terminal or gone.
+        """
         sink = self.log_sink
         assert sink is not None
+        last_ts = ""  # RFC3339Nano of the last shipped line
+        log_path = (
+            f"/api/v1/namespaces/{self.namespace}/pods/{pod_name}/log"
+        )
         try:
-            # A pod still ContainerCreating 400s on /log ("container is
-            # waiting to start"); poll until it starts (404 = pod gone,
-            # give up). The deadline bounds pods stuck Pending forever.
-            deadline = time.time() + 600.0
             while True:
+                # Check BEFORE the fetch: if the pod went terminal during a
+                # stream drop, this final follow still serves the tail
+                # (from sinceTime) and EOFs — checking after would skip it.
+                finished = self._pod_finished(pod_name)
+                params = {"follow": "true", "timestamps": "true"}
+                if last_ts:
+                    params["sinceTime"] = last_ts
                 try:
                     resp = self._request(
-                        "GET",
-                        f"/api/v1/namespaces/{self.namespace}/pods/"
-                        f"{pod_name}/log",
-                        params={"follow": "true"},
-                        stream=True,
+                        "GET", log_path, params=params, stream=True,
                         ok_missing=True,
                         # (connect, read): NO between-reads timeout — a
                         # pod quiet for >30s (XLA compile, checkpoint
@@ -287,24 +626,53 @@ class RestKubeClient(KubeClient):
                     if (
                         e.response is not None
                         and e.response.status_code == 400
-                        and time.time() < deadline
                     ):
+                        # ContainerCreating. No deadline: however late the
+                        # pod starts (node provisioning can take >10 min),
+                        # its stdout must ship; a DELETED pod 404s out.
                         time.sleep(2.0)
                         continue
                     raise
-                break
-            if resp is None:
-                return
-            batch: List[Dict[str, Any]] = []
-            for line in resp.iter_lines(decode_unicode=True):
-                if line is None:
-                    continue
-                batch.append({"log": str(line), "level": "INFO"})
-                if len(batch) >= 64:
+                if resp is None:
+                    return  # pod gone
+                batch: List[Dict[str, Any]] = []
+                try:
+                    for line in resp.iter_lines(decode_unicode=True):
+                        if line is None:
+                            continue
+                        text = str(line)
+                        ts, _, rest = text.partition(" ")
+                        if self._TS_RE.match(ts):
+                            # Skip only STRICTLY older than the last shipped
+                            # stamp: equal stamps must ship (consecutive
+                            # same-nanosecond lines are real data). A resume
+                            # may then duplicate a same-stamp line — the
+                            # right side of the lose-vs-duplicate tradeoff.
+                            if last_ts and self._ts_key(ts) < self._ts_key(
+                                last_ts
+                            ):
+                                continue
+                            last_ts = ts
+                            text = rest
+                        batch.append({"log": text, "level": "INFO"})
+                        if len(batch) >= 64:
+                            sink(task_id, batch)
+                            batch = []
+                except requests.RequestException as e:
+                    # Mid-stream disconnect (apiserver bounce, LB idle
+                    # reset): a DROP to resume from, not a follower death.
+                    logger.info(
+                        "log stream for %s dropped (%s); resuming from %s",
+                        pod_name, e, last_ts or "start",
+                    )
+                if batch:
                     sink(task_id, batch)
-                    batch = []
-            if batch:
-                sink(task_id, batch)
+                # Stream ended. Pod was terminal going in: that stream
+                # served the tail — done. Live pod: a transient drop —
+                # resume from last_ts, losing nothing.
+                if finished:
+                    return
+                time.sleep(1.0)
         except Exception:  # noqa: BLE001 — a dead follower must not crash RM
             logger.exception("pod log follower for %s failed", pod_name)
         finally:

@@ -130,6 +130,11 @@ class KubernetesResourcePool(ResourcePool):
     changes is realization (create_pods/kill) and failure detection (sync).
     """
 
+    #: how long a pod may be absent from the phase view before it counts
+    #: as vanished (watch-cache ADDED events are asynchronous; a poke-sync
+    #: racing pod creation must not tear down a healthy gang).
+    MISS_GRACE_S = 5.0
+
     def __init__(
         self,
         name: str = "default",
@@ -141,7 +146,29 @@ class KubernetesResourcePool(ResourcePool):
         self.client = client
         self._pods: Dict[str, List[str]] = {}     # alloc_id -> pod names
         self._pods_lock = threading.Lock()
+        #: serializes sync(): the tick loop and watch-event pokes may race.
+        self._sync_lock = threading.Lock()
+        #: pods that appeared in at least one phase view: for them, missing
+        #: means VANISHED (deleted out from under us) — immediately. A pod
+        #: never yet seen may simply not have reached the watch cache
+        #: (ADDED event in flight); those get MISS_GRACE_S from first
+        #: observed missing before they count as gone.
+        self._seen_pods: set = set()
+        self._missing_since: Dict[str, float] = {}
         self.sync()  # initial node inventory
+        # Watch-capable clients (RestKubeClient) push pod/node events: a
+        # phase change triggers an immediate sync instead of waiting out
+        # the tick period — the informer pattern (kubernetesrm/informer.go).
+        # Poll fallback stays: sync() still runs every tick regardless.
+        start_watch = getattr(client, "start_watch", None)
+        if callable(start_watch):
+            start_watch(on_change=self._watch_poke)
+
+    def _watch_poke(self) -> None:
+        try:
+            self.sync()
+        except Exception:  # noqa: BLE001 - watch thread must survive
+            logger.exception("watch-triggered sync failed")
 
     # -- realization -------------------------------------------------------
     def start(
@@ -210,6 +237,8 @@ class KubernetesResourcePool(ResourcePool):
         with self._pods_lock:
             names = self._pods.pop(alloc_id, [])
         for name in names:
+            self._seen_pods.discard(name)
+            self._missing_since.pop(name, None)
             try:
                 self.client.delete_pod(name)
             except Exception:  # noqa: BLE001
@@ -224,6 +253,10 @@ class KubernetesResourcePool(ResourcePool):
         where a KILLed process still produces an EXITED event."""
         with self._pods_lock:
             names = list(self._pods.get(alloc_id, []))
+        # We are deleting these ourselves: their absence is definitive, so
+        # the never-seen miss grace (watch-cache lag protection) must not
+        # delay the exit event.
+        self._seen_pods.update(names)
         for name in names:
             try:
                 self.client.delete_pod(name)
@@ -238,8 +271,13 @@ class KubernetesResourcePool(ResourcePool):
     def sync(self) -> None:
         """Refresh node inventory and react to pod phase changes.
 
-        Called from the master tick loop (the polling analog of the
-        reference's informer callbacks)."""
+        Called from the master tick loop AND from watch-event pokes
+        (_watch_poke); _sync_lock serializes the two so a phase change is
+        processed exactly once."""
+        with self._sync_lock:
+            self._sync_locked()
+
+    def _sync_locked(self) -> None:
         exits: List[Tuple[str, int, str, bool]] = []
 
         nodes = {n.name: n for n in self.client.list_nodes()}
@@ -263,12 +301,25 @@ class KubernetesResourcePool(ResourcePool):
             gangs = {a: list(ns) for a, ns in self._pods.items()}
         phases = self.client.pod_phases()
         reasons = self.client.pod_status_reasons()
+        import time as _time
+
+        now = _time.monotonic()
+        for name in phases:
+            self._seen_pods.add(name)
+            self._missing_since.pop(name, None)
         for alloc_id, pod_names in gangs.items():
             pod_phases = [phases.get(n) for n in pod_names]
-            bad = [
-                (n, p) for n, p in zip(pod_names, pod_phases)
-                if p == FAILED or p is None
-            ]
+            bad = []
+            for n, p in zip(pod_names, pod_phases):
+                if p == FAILED:
+                    bad.append((n, p))
+                elif p is None:
+                    if n in self._seen_pods:
+                        bad.append((n, p))  # was live, now gone: vanished
+                    else:
+                        first = self._missing_since.setdefault(n, now)
+                        if now - first >= self.MISS_GRACE_S:
+                            bad.append((n, p))
             if bad:
                 # Failure attribution (ref: the spot state machine in
                 # aws_spot.go): a pod that VANISHED (deleted out from under

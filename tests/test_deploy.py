@@ -155,6 +155,11 @@ class TestDeployGcp:
             project="proj", zone="us-central2-b", dry_run=True,
         )
         assert len(result["commands"]) == 1  # create only, no 0.0.0.0/0 rule
+        # Dry runs must not drop the credential-bearing script into /tmp;
+        # the content comes back for the operator to place themselves.
+        assert result["script_files"] == []
+        assert "DTPU_USERS" in result["startup_script"]
+        assert "./dtpu-startup.sh" in result["commands"][0]
 
     def test_auth_cannot_be_skipped(self):
         with pytest.raises(ValueError, match="auth"):

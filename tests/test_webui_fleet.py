@@ -229,7 +229,7 @@ class TestCliVerbs:
         out = run("experiment", "archive", str(eid))
         assert "archived" in out
         out = run("experiment", "list")
-        assert f"\\n{eid} " not in out  # hidden by default
+        assert f"\n{eid} " not in out  # hidden by default
         out = run("experiment", "list", "--all")
         assert "yes" in out
         out = run("resource-pool", "list")
