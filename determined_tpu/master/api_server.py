@@ -232,14 +232,29 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
     # -- harness: metrics/progress/status -----------------------------------
     def post_metrics(r: ApiRequest):
         trial_id = int(r.groups[0])
+        group = r.body.get("group", "training")
+        metrics = r.body.get("metrics", {})
         m.db.add_metrics(
             trial_id,
-            r.body.get("group", "training"),
+            group,
             int(r.body.get("steps_completed", 0)),
-            r.body.get("metrics", {}),
+            metrics,
             trial_run_id=int(r.body.get("trial_run_id", 0)),
             report_time=r.body.get("report_time"),
         )
+        if group == "profiling":
+            # Feed device HBM utilization to profiling-driven searchers
+            # (autotune's microbatch-jump heuristic; experiment.report_hbm
+            # no-ops for every other method).
+            utils = [
+                float(v) for k, v in metrics.items()
+                if k.endswith("_hbm_util") and isinstance(v, (int, float))
+            ]
+            if utils:
+                try:
+                    exp_of_trial(trial_id).report_hbm(trial_id, max(utils))
+                except (ApiError, KeyError):
+                    pass  # unmanaged/foreign trial: nothing to feed
         return {}
 
     def get_metrics(r: ApiRequest):

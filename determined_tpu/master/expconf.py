@@ -22,7 +22,9 @@ from typing import Any, Dict, List, Tuple
 
 CURRENT_VERSION = 1
 
-KNOWN_SEARCHERS = {"single", "random", "grid", "asha", "adaptive_asha", "custom"}
+KNOWN_SEARCHERS = {
+    "single", "random", "grid", "asha", "adaptive_asha", "custom", "autotune",
+}
 NEEDS_MAX_TRIALS = {"random", "asha", "adaptive_asha"}
 KNOWN_STORAGE = {"shared_fs", "gcs", "s3", "azure"}
 KNOWN_HP_TYPES = {"const", "categorical", "int", "double", "log"}
@@ -210,6 +212,32 @@ def validate(config: Dict[str, Any]) -> List[str]:
             )
         if name in NEEDS_MAX_TRIALS and not searcher.get("max_trials"):
             errors.append(f"searcher.name={name} requires searcher.max_trials")
+        if name == "autotune":
+            cands = searcher.get("mesh_candidates")
+            if not isinstance(cands, list) or not cands:
+                errors.append(
+                    "searcher.name=autotune requires a non-empty "
+                    "searcher.mesh_candidates list"
+                )
+            else:
+                for i, cand in enumerate(cands):
+                    if not isinstance(cand, dict):
+                        errors.append(
+                            f"searcher.mesh_candidates[{i}] must be an "
+                            "object of axis sizes"
+                        )
+                        continue
+                    for axis, size in cand.items():
+                        if axis not in MESH_AXES:
+                            errors.append(
+                                f"searcher.mesh_candidates[{i}].{axis}: "
+                                f"unknown axis (one of {sorted(MESH_AXES)})"
+                            )
+                        elif not isinstance(size, int) or size < 1:
+                            errors.append(
+                                f"searcher.mesh_candidates[{i}].{axis} "
+                                "must be a positive int"
+                            )
         if name != "custom":
             ml = searcher.get("max_length")
             if ml is not None and (not isinstance(ml, int) or ml <= 0):

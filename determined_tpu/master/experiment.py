@@ -319,6 +319,20 @@ class Experiment:
             self._process_ops(ops)
             self._snapshot()
 
+    def report_hbm(self, trial_id: int, util: float) -> None:
+        """Profiler feed for profiling-driven searchers (autotune): the
+        peak device HBM utilization a trial reported rides into the search
+        method, which uses the headroom to jump microbatch probes (the
+        dsat model-profile channel, _dsat_search_method.py)."""
+        method = self.searcher.method
+        on_hbm = getattr(method, "on_hbm", None)
+        if on_hbm is None:
+            return
+        with self._cond:
+            rec = self.trials.get(trial_id)
+            if rec is not None:
+                on_hbm(rec.request_id, util)
+
     def report_progress(self, trial_id: int, progress: float) -> None:
         del trial_id, progress  # experiment progress derives from the searcher
         self.db.set_experiment_progress(self.id, self.searcher.progress())

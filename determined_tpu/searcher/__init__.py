@@ -53,6 +53,16 @@ def make_method(config: Dict[str, Any]) -> SearchMethod:
         from determined_tpu.searcher.custom import CustomSearch
 
         return CustomSearch()
+    if name == "autotune":
+        from determined_tpu.searcher.autotune import AutotuneSearch
+
+        return AutotuneSearch(
+            mesh_candidates=config["mesh_candidates"],
+            max_microbatch=int(config.get("max_microbatch", 64)),
+            probe_length=int(config.get("probe_length", 10)),
+            final_length=max_length,
+            top_k=int(config.get("top_k", 2)),
+        )
     raise ValueError(f"unknown searcher {name!r}")
 
 

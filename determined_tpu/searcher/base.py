@@ -36,12 +36,20 @@ class SearchRuntime:
         self.seed = seed
         self._next_id = 1
 
-    def create(self, hparams: Optional[Dict[str, Any]] = None) -> Create:
+    def create(
+        self,
+        hparams: Optional[Dict[str, Any]] = None,
+        overrides: Optional[Dict[str, Any]] = None,
+    ) -> Create:
+        """`overrides` lay method-chosen values (autotune's mesh/microbatch)
+        over the normal deterministic sample without replacing it."""
         rid = self._next_id
         self._next_id += 1
         if hparams is None:
             rng = random.Random((self.seed << 32) + rid)
             hparams = sample_mod.sample(self.space, rng)
+        if overrides:
+            hparams = {**hparams, **overrides}
         return Create(request_id=rid, hparams=hparams, seed=(self.seed << 32) + rid)
 
     def snapshot(self) -> Dict[str, Any]:

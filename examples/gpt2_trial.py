@@ -56,9 +56,21 @@ class GPT2PretrainTrial(JAXTrial):
             assert ring > 1, (
                 "sequence_layout='zigzag' needs a sharded context axis"
             )
+        # autotune probes choose a per-device microbatch: the global batch
+        # is microbatch x the BATCH-SHARDING degree — data x fsdp, the
+        # axes _trainer shards batches over (parallel/mesh.py batch_axes),
+        # not the data axis alone (searcher "autotune", searcher/autotune.py).
+        if self.hparams.get("microbatch"):
+            from determined_tpu.parallel.mesh import data_parallel_size
+
+            mesh = getattr(self, "_mesh", None)
+            deg = data_parallel_size(mesh) if mesh is not None else 1
+            batch = int(self.hparams["microbatch"]) * deg
+        else:
+            batch = int(self.hparams.get("batch_size", 8))
         return lm_dataset(
             self.hparams.get("token_shards", []),
-            int(self.hparams.get("batch_size", 8)),
+            batch,
             cfg.seq_len,
             cfg.vocab_size,
             seed=seed,

@@ -1,0 +1,290 @@
+"""Profiling-driven autotune (VERDICT r3 next #4; ref dsat
+_dsat_search_method.py:518 binary search / :967 ASHA, reduced to the TPU
+pair: per-mesh microbatch binary search with OOM-scored probes + HBM-jump
+shortcuts, then a top-k confirmation rung)."""
+import math
+
+import pytest
+
+from determined_tpu.searcher import make_searcher
+from determined_tpu.searcher.ops import Close, Create, Shutdown, ValidateAfter
+
+MESHES = [
+    {"data": 8, "fsdp": 1},
+    {"data": 4, "fsdp": 2},
+    {"data": 2, "fsdp": 4},
+    {"data": 1, "fsdp": 8},
+]
+
+#: hidden environment: per-mesh max fitting microbatch + throughput model.
+#: fsdp shards params => more activation room => bigger microbatch fits;
+#: throughput favors data-parallel until memory binds it.
+LIMIT = {8: 4, 4: 8, 2: 16, 1: 512}          # by mesh["data"]
+EFF = {8: 1.0, 4: 0.9, 2: 0.7, 1: 0.4}
+
+
+def _throughput(mesh, mb):
+    return EFF[mesh["data"]] * mb          # batches/sec-ish, bigger better
+
+
+class Env:
+    """Drives a Searcher the way the experiment FSM would, simulating
+    probe runs against the hidden memory limits. Counts trials and
+    trial-steps so efficiency claims are measurable."""
+
+    def __init__(self, searcher, hbm=False):
+        self.s = searcher
+        self.hbm = hbm
+        self.trials = {}          # request_id -> {"hp":, "target":}
+        self.steps = 0
+        self.n_trials = 0
+        self.process(self.s.initial_operations())
+
+    def process(self, ops):
+        for op in ops:
+            if isinstance(op, Create):
+                self.trials[op.request_id] = {"hp": op.hparams, "target": None}
+                self.n_trials += 1
+                self.process(self.s.trial_created(op.request_id))
+            elif isinstance(op, ValidateAfter):
+                self.trials[op.request_id]["target"] = op.length
+            elif isinstance(op, Close):
+                self.trials[op.request_id]["closed"] = True
+                self.process(self.s.trial_closed(op.request_id))
+            elif isinstance(op, Shutdown):
+                pass
+        # run any trial with an unmet target
+        for rid, t in list(self.trials.items()):
+            if t.get("done") or t["target"] is None:
+                continue
+            t["done"] = True
+            hp = t["hp"]
+            mesh, mb = hp["mesh"], hp["microbatch"]
+            limit = LIMIT[mesh["data"]]
+            if mb > limit:
+                # OOM partway into the probe: some steps burned, then the
+                # trial dies early (max_restarts: 0 semantics).
+                self.steps += 1
+                self.process(self.s.trial_exited_early(rid, "OOM"))
+                continue
+            self.steps += t["target"]
+            if self.hbm:
+                # profiler reports peak HBM for the run (linear-ish model)
+                self.s.method.on_hbm(rid, 0.9 * mb / limit)
+            self.process(
+                self.s.validation_completed(
+                    rid, _throughput(mesh, mb), t["target"]
+                )
+            )
+
+
+def _make(hbm=False, **over):
+    cfg = {
+        "name": "autotune", "metric": "batches_per_second",
+        "smaller_is_better": False, "max_length": 50,
+        "mesh_candidates": MESHES, "max_microbatch": 1024,
+        "probe_length": 5, "top_k": 2,
+    }
+    cfg.update(over)
+    return make_searcher(cfg, {"lr": 1e-3})
+
+
+def _make_and_run():
+    s = _make()
+    Env(s)
+    return s
+
+
+class TestAutotune:
+    def test_finds_best_config(self):
+        s = _make()
+        env = Env(s)
+        assert s.shutdown
+        best = s.method.best_config()
+        # hidden optimum: throughput = EFF * min(limit, ...) maximized at
+        # data=2 (0.7 * 16 = 11.2) over data=4 (0.9*8=7.2), data=8 (4.0),
+        # data=1 (0.4*32=12.8) -> actually data=1 wins: 12.8
+        want = max(
+            ((m, LIMIT[m["data"]]) for m in MESHES),
+            key=lambda p: _throughput(p[0], p[1]),
+        )
+        assert best["mesh"] == want[0]
+        assert best["microbatch"] == want[1]
+
+    def test_oom_probes_are_scored_not_fatal(self):
+        s = _make()
+        env = Env(s)
+        # every mesh's first probe (mb=64) OOMs in this environment, yet
+        # the search completes and every candidate found its true limit
+        for cand in s.method.candidates:
+            assert cand["done"]
+            assert 2 ** cand["lo"] == LIMIT[cand["mesh"]["data"]]
+
+    def test_beats_exhaustive_sweep(self):
+        s = _make()
+        env = Env(s)
+        n_mb_options = int(math.log2(1024)) + 1  # 1..1024 in powers of two
+        exhaustive_trials = len(MESHES) * n_mb_options
+        exhaustive_steps = exhaustive_trials * 50  # grid at max_length
+        assert env.n_trials < exhaustive_trials
+        assert env.steps < exhaustive_steps / 4, (
+            f"autotune used {env.steps} steps vs {exhaustive_steps} grid"
+        )
+
+    def test_hbm_jumps_reduce_probes(self):
+        blind = Env(_make(hbm=False))
+        guided = Env(_make(hbm=True), hbm=True)
+        assert guided.s.method.best_config() == blind.s.method.best_config()
+        assert guided.n_trials < blind.n_trials, (
+            f"HBM-guided {guided.n_trials} vs blind {blind.n_trials} probes"
+        )
+
+    def test_finals_are_top_k_only(self):
+        s = _make()
+        env = Env(s)
+        finals = [
+            t for t in s.method.trials.values() if t["phase"] == "final"
+        ]
+        assert len(finals) == 2  # top_k
+        # finals ran the long confirmation length; probes stayed short
+        for rid, info in s.method.trials.items():
+            if info["phase"] == "final":
+                assert env.trials[int(rid)]["target"] == 50
+            else:
+                assert env.trials[int(rid)]["target"] in (5, None)
+
+    def test_snapshot_restore_mid_search(self):
+        """Crash mid-search: restore on a fresh Searcher and finish —
+        current_target re-derives the in-flight probe lengths (the
+        experiment restore contract)."""
+        s = _make()
+        trials = {}
+        for op in s.initial_operations():
+            if isinstance(op, Create):
+                trials[op.request_id] = op.hparams
+                s.trial_created(op.request_id)  # ValidateAfter consumed
+        snap = s.snapshot()
+        s2 = _make()
+        s2.restore(snap)
+        env = Env.__new__(Env)
+        env.s = s2
+        env.hbm = False
+        env.trials = {
+            rid: {"hp": hp, "target": s2.method.current_target(rid)}
+            for rid, hp in trials.items()
+        }
+        env.steps = 0
+        env.n_trials = len(trials)
+        env.process([])  # runs the restored in-flight probes onward
+        assert s2.shutdown
+        assert s2.method.best_config() is not None
+        assert (
+            s2.method.best_config() == _make_and_run().method.best_config()
+        )
+
+
+
+    def test_infeasible_everywhere_shuts_down(self):
+        class TinyEnv(Env):
+            pass
+
+        s = _make(mesh_candidates=[{"data": 16, "fsdp": 1}])
+
+        # environment where nothing fits: every probe OOMs
+        trials = {}
+        n = [0]
+
+        def drive(ops):
+            for op in ops:
+                if isinstance(op, Create):
+                    n[0] += 1
+                    drive(s.trial_created(op.request_id))
+                    drive(s.trial_exited_early(op.request_id, "OOM"))
+                elif isinstance(op, Shutdown):
+                    pass
+
+        drive(s.initial_operations())
+        assert s.shutdown
+        assert s.method.best_config() is None
+
+    def test_expconf_validates_autotune(self):
+        from determined_tpu.master import expconf
+
+        errs = expconf.validate({
+            "entrypoint": "x:y",
+            "searcher": {"name": "autotune", "metric": "bps",
+                         "max_length": 10},
+        })
+        assert any("mesh_candidates" in e for e in errs)
+        errs2 = expconf.validate({
+            "entrypoint": "x:y",
+            "searcher": {"name": "autotune", "metric": "bps",
+                         "max_length": 10,
+                         "mesh_candidates": [{"data": 2}]},
+        })
+        assert not any("mesh_candidates" in e for e in errs2)
+
+class TestExperimentIntegration:
+    def test_autotune_through_experiment_fsm(self):
+        """The whole master-side plumbing: Experiment drives the autotune
+        method through launches, OOM trial failures (max_restarts: 0),
+        HBM reports (report_hbm -> on_hbm), and closes, ending COMPLETED
+        with the right winner."""
+        from determined_tpu.master import db as db_mod
+        from determined_tpu.master.experiment import Experiment
+
+        class Launcher:
+            def __init__(self):
+                self.queue = []
+
+            def launch(self, exp, rec):
+                self.queue.append(rec)
+
+            def preempt(self, trial_id):
+                pass
+
+            def kill(self, trial_id):
+                pass
+
+        database = db_mod.Database()
+        launcher = Launcher()
+        config = {
+            "entrypoint": "x:y",
+            "max_restarts": 0,
+            "searcher": {
+                "name": "autotune", "metric": "batches_per_second",
+                "smaller_is_better": False, "max_length": 50,
+                "mesh_candidates": MESHES, "max_microbatch": 1024,
+                "probe_length": 5, "top_k": 2,
+            },
+            "hyperparameters": {"lr": 1e-3},
+        }
+        exp_id = database.add_experiment(config)
+        exp = Experiment(exp_id, config, database, launcher)
+        exp.start()
+
+        for _ in range(200):  # bounded drive
+            if not launcher.queue:
+                break
+            rec = launcher.queue.pop(0)
+            hp = rec.hparams
+            mesh, mb = hp["mesh"], hp["microbatch"]
+            target = exp.current_searcher_op(rec.trial_id, timeout=0.1)
+            if target["completed"]:
+                exp.trial_exited(rec.trial_id, 0)
+                continue
+            length = target["op"]["length"]
+            if mb > LIMIT[mesh["data"]]:
+                exp.trial_exited(rec.trial_id, 1, "OOM")  # budget 0: errored
+                continue
+            exp.report_hbm(rec.trial_id, 0.9 * mb / LIMIT[mesh["data"]])
+            exp.op_completed(rec.trial_id, length, _throughput(mesh, mb))
+            exp.trial_exited(rec.trial_id, 0)
+        assert exp.state == "COMPLETED"
+        best = exp.searcher.method.best_config()
+        want = max(
+            ((m, LIMIT[m["data"]]) for m in MESHES),
+            key=lambda p: _throughput(p[0], p[1]),
+        )
+        assert best == {"mesh": want[0], "microbatch": want[1]}
+        assert exp.searcher.method.hbm  # the profiler feed really landed
