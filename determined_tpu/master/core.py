@@ -256,7 +256,11 @@ class Master:
         # task_container_defaults + cluster-level checkpoint_storage in
         # master.yaml), merged under every submitted config at create time.
         self.config_defaults: Dict[str, Any] = config_defaults or {}
-        self.db = db_mod.Database(db_path)
+        # Driver selection: a postgres:// DSN (or ambient DTPU_PG_DSN)
+        # gets the multi-writer Postgres driver (db_pg.py), else SQLite.
+        from determined_tpu.master.db_pg import open_database
+
+        self.db = open_database(db_path)
         self.rm = ResourceManager(pools_config, kube_client=kube_client)
         # Backends that observe exits themselves (k8s pod phases) report
         # them here — the same endpoint the agent EXITED event reaches

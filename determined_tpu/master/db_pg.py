@@ -1,0 +1,290 @@
+"""Postgres driver behind the Database interface.
+
+Rebuild target: the reference's Postgres layer (`master/internal/db/
+postgres_*.go`, 124 migration pairs) — the multi-writer production store
+behind the same wire-compatible method surface `master/db.py` defines
+(SURVEY §2.1 "DB layer"; VERDICT r3 next #6). The whole Database method
+surface (experiments/trials/metrics/checkpoints/logs/audit/kv/...) is
+inherited unchanged; this module swaps ONLY the transport:
+
+- thread-local psycopg2 connections instead of sqlite3;
+- `?` placeholders translated to `%s`;
+- SQLite dialect rewritten mechanically (`INSERT OR IGNORE` →
+  `ON CONFLICT DO NOTHING`, `INSERT OR REPLACE` → a real upsert on the
+  table's primary key, `instr()` → `strpos()`);
+- `cur.lastrowid` realized via `RETURNING id` on the serial-id tables;
+- the schema/migrations are EXPRESSED ONCE (db.py's SQLite DDL) and
+  transformed (`AUTOINCREMENT` → `BIGSERIAL`, `BLOB` → `BYTEA`,
+  `REAL` → `DOUBLE PRECISION` — epoch timestamps don't survive float4);
+- durability knobs map PRAGMA synchronous → `SET synchronous_commit`
+  (the batched single-writer queue is kept: fewer commits is fewer
+  WAL flushes on Postgres too).
+
+Import-gated: constructing PostgresDatabase without psycopg2 raises a
+clear error; `open_database()` picks the driver from the path/DSN (also
+honoring DTPU_PG_DSN), so `--db postgres://...` is the only change an
+operator makes. The conformance suite (tests/test_db_conformance.py)
+runs every interface area against SQLite always and against Postgres
+whenever DTPU_PG_DSN points at a live server (skipped in serverless
+images). The pure-SQL translation layer is unit-tested everywhere.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import re
+import threading
+from typing import Any, List, Optional
+
+from determined_tpu.master import db as db_mod
+
+#: tables whose INSERTs use cur.lastrowid (serial id columns). NOT
+#: templates/kv (TEXT primary keys, no id column to RETURN).
+AUTO_ID_TABLES = {
+    "experiments", "trials", "metrics", "task_logs", "audit_log",
+    "webhooks", "workspaces", "projects",
+}
+
+#: primary keys for INSERT OR REPLACE upsert rewriting.
+REPLACE_PKS = {"checkpoints": "uuid", "kv": "key", "templates": "name"}
+
+_INSERT_RE = re.compile(
+    r"^\s*INSERT(\s+OR\s+(?:IGNORE|REPLACE))?\s+INTO\s+(\w+)\s*"
+    r"\(([^)]*)\)", re.IGNORECASE,
+)
+
+
+@functools.lru_cache(maxsize=512)
+def translate(sql: str) -> str:
+    """SQLite dialect → Postgres dialect, mechanically (cached: the
+    statement set is small and static, and the ingest batcher calls this
+    per drained group).
+
+    Handles exactly the constructs db.py uses — this is a dialect shim
+    for OUR statements, not a general translator."""
+    out = sql.replace("?", "%s")
+    out = re.sub(r"\binstr\(", "strpos(", out)
+    m = _INSERT_RE.match(out)
+    if m and m.group(1):
+        conflict, table, cols = m.group(1), m.group(2), m.group(3)
+        if "IGNORE" in conflict.upper():
+            out = re.sub(
+                r"INSERT\s+OR\s+IGNORE", "INSERT", out, count=1,
+                flags=re.IGNORECASE,
+            )
+            out += " ON CONFLICT DO NOTHING"
+        else:  # REPLACE
+            pk = REPLACE_PKS.get(table.lower())
+            if pk is None:
+                raise ValueError(
+                    f"INSERT OR REPLACE into {table} has no known PK"
+                )
+            sets = ", ".join(
+                f"{c.strip()}=EXCLUDED.{c.strip()}"
+                for c in cols.split(",") if c.strip() != pk
+            )
+            out = re.sub(
+                r"INSERT\s+OR\s+REPLACE", "INSERT", out, count=1,
+                flags=re.IGNORECASE,
+            )
+            out += f" ON CONFLICT ({pk}) DO UPDATE SET {sets}"
+    return out
+
+
+@functools.lru_cache(maxsize=512)
+def needs_returning_id(sql: str) -> Optional[str]:
+    """Table name if this INSERT targets a serial-id table (so the PG
+    execute can append RETURNING id to realize lastrowid)."""
+    m = _INSERT_RE.match(sql)
+    if not m or m.group(1):
+        return None
+    table = m.group(2).lower()
+    if table in AUTO_ID_TABLES and "returning" not in sql.lower():
+        return table
+    return None
+
+
+def pg_schema() -> str:
+    """db.py's SQLite DDL transformed for Postgres — the schema is
+    expressed once, both backends derive from it."""
+    ddl = db_mod.SCHEMA
+    ddl = ddl.replace(
+        "INTEGER PRIMARY KEY AUTOINCREMENT", "BIGSERIAL PRIMARY KEY"
+    )
+    ddl = re.sub(r"\bBLOB\b", "BYTEA", ddl)
+    ddl = re.sub(r"\bREAL\b", "DOUBLE PRECISION", ddl)
+    ddl = re.sub(
+        r"INSERT OR IGNORE INTO (\w+) ([^;]+);",
+        r"INSERT INTO \1 \2 ON CONFLICT DO NOTHING;",
+        ddl,
+    )
+    # Seed rows insert explicit ids; advance the sequences past them or
+    # the first real insert collides with id 1.
+    ddl += (
+        "\nSELECT setval(pg_get_serial_sequence('workspaces','id'),"
+        " GREATEST(1,(SELECT MAX(id) FROM workspaces)));"
+        "\nSELECT setval(pg_get_serial_sequence('projects','id'),"
+        " GREATEST(1,(SELECT MAX(id) FROM projects)));"
+    )
+    return ddl
+
+
+def pg_migrations() -> List[str]:
+    """db.py's ALTER-based migrations, dialect-adjusted (ADD COLUMN syntax
+    is shared; only types differ)."""
+    return [
+        re.sub(r"\bREAL\b", "DOUBLE PRECISION", stmt)
+        for stmt in db_mod.MIGRATIONS
+    ]
+
+
+class _Cursor:
+    """psycopg2 cursor + a lastrowid realized via RETURNING id."""
+
+    def __init__(self, cur: Any, lastrowid: Optional[int]) -> None:
+        self._cur = cur
+        self.lastrowid = lastrowid
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._cur, name)
+
+
+class PostgresDatabase(db_mod.Database):
+    """The Database surface over a Postgres server (multi-writer: every
+    master thread/process gets real concurrent writes — the fleet-scale
+    ceiling SQLite's single writer imposes is gone)."""
+
+    def __init__(self, dsn: str, batch_writes: bool = True) -> None:
+        try:
+            import psycopg2  # noqa: F401
+            import psycopg2.extras  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "PostgresDatabase needs psycopg2 (not present in this "
+                "image); install psycopg2-binary or use a sqlite path"
+            ) from e
+        self._psycopg2 = psycopg2
+        self._dsn = dsn
+        self._local = threading.local()
+        self._memory_conn = None  # base-class branch disabled
+        self._apply_schema()
+        self._writer = db_mod._WriteBatcher(self) if batch_writes else None
+
+    # -- transport ---------------------------------------------------------
+    def _apply_schema(self) -> None:
+        conn = self._conn()
+        with conn.cursor() as cur:
+            for stmt in pg_schema().split(";"):
+                if stmt.strip():
+                    cur.execute(stmt)
+        conn.commit()
+        # Each migration in its OWN transaction: a duplicate-column no-op
+        # must not roll back its neighbors.
+        for stmt in pg_migrations():
+            try:
+                with conn.cursor() as cur:
+                    cur.execute(stmt)
+                conn.commit()
+            except self._psycopg2.Error as e:
+                if getattr(e, "pgcode", "") != "42701":  # duplicate column
+                    raise
+                conn.rollback()
+
+    def _conn(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is None or conn.closed:
+            conn = self._psycopg2.connect(self._dsn)
+            # The PG analog of SQLite's synchronous=NORMAL: ingest commits
+            # skip the per-transaction WAL flush; records whose loss is
+            # unrecoverable opt back in via _execute_durable's SET LOCAL.
+            with conn.cursor() as cur:
+                cur.execute("SET synchronous_commit TO off")
+            conn.commit()
+            self._local.conn = conn
+        return conn
+
+    def _execute(self, sql: str, args: tuple = ()):  # type: ignore[override]
+        conn = self._conn()
+        pg_sql = translate(sql)
+        table = needs_returning_id(sql)
+        if table:
+            pg_sql += " RETURNING id"
+        try:
+            with conn.cursor() as cur:
+                cur.execute(pg_sql, args)
+                rowid = cur.fetchone()[0] if table else None
+            conn.commit()
+        except Exception:
+            conn.rollback()
+            raise
+        return _Cursor(None, rowid)
+
+    def _executemany(self, sql: str, rows: List[tuple]) -> None:
+        conn = self._conn()
+        try:
+            with conn.cursor() as cur:
+                cur.executemany(translate(sql), rows)
+            conn.commit()
+        except Exception:
+            conn.rollback()
+            raise
+
+    def _query(self, sql: str, args: tuple = ()):  # type: ignore[override]
+        conn = self._conn()
+        try:
+            with conn.cursor(
+                cursor_factory=self._psycopg2.extras.RealDictCursor
+            ) as cur:
+                cur.execute(translate(sql), args)
+                rows = cur.fetchall()
+            conn.commit()  # end the read txn: see fresh snapshots next time
+            return rows
+        except Exception:
+            conn.rollback()
+            raise
+
+    def _write_batch(self, batch: List[tuple]) -> None:
+        conn = self._conn()
+        try:
+            with conn.cursor() as cur:
+                for sql, rows in batch:
+                    cur.executemany(translate(sql), rows)
+            conn.commit()
+        except Exception:
+            conn.rollback()
+            raise
+
+    def _execute_durable(self, sql: str, args: tuple = ()) -> None:
+        """synchronous_commit=on for this transaction only — the PG analog
+        of the SQLite PRAGMA synchronous=FULL dance (everything else may
+        ride synchronous_commit=off for ingest throughput)."""
+        conn = self._conn()
+        try:
+            with conn.cursor() as cur:
+                cur.execute("SET LOCAL synchronous_commit TO on")
+                cur.execute(translate(sql), args)
+            conn.commit()
+        except Exception:
+            conn.rollback()
+            raise
+
+    def close(self) -> None:
+        super().close()  # drain the batch writer
+        conn = getattr(self._local, "conn", None)
+        if conn is not None and not conn.closed:
+            conn.close()
+
+
+def open_database(path: str, batch_writes: bool = True) -> db_mod.Database:
+    """Driver selection: a postgres:// DSN gets the Postgres driver;
+    anything else is a SQLite path. The ambient DTPU_PG_DSN applies ONLY
+    to an empty path — a caller who names ':memory:' or a file chose
+    SQLite and must not be silently redirected onto a shared server
+    (the conformance suite runs with the env var set while every other
+    test expects isolated in-memory stores)."""
+    if path.startswith(("postgres://", "postgresql://")):
+        return PostgresDatabase(path, batch_writes=batch_writes)
+    dsn = os.environ.get("DTPU_PG_DSN", "")
+    if dsn and path == "":
+        return PostgresDatabase(dsn, batch_writes=batch_writes)
+    return db_mod.Database(path or ":memory:", batch_writes=batch_writes)
