@@ -178,7 +178,9 @@ def neox_class_mfu(dev, on_tpu: bool):
                 vocab_size=vocab, n_layers=n_layers, n_heads=64,
                 d_model=d_model, d_ff=d_ff, seq_len=seq, remat=True,
             )
-            mfu, _ = _measure_mfu(cfg, batch_size=2, inner=4, rounds=2, dev=dev)
+            # batch 4 measured +6pt MFU over 2 on v5e (61.8% vs 55.7%);
+            # 8 OOMs at one layer.
+            mfu, _ = _measure_mfu(cfg, batch_size=4, inner=4, rounds=2, dev=dev)
         else:
             cfg = GPTConfig(
                 vocab_size=512, n_layers=1, n_heads=8, d_model=256,

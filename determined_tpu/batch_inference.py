@@ -8,24 +8,125 @@ allocation's workers — each rank processes batches `rank::size`, with
 periodic synchronization so preemption/restart resumes from the last
 completed sync point.
 
-    class Embedder(BatchProcessor):
-        def setup(self, core_ctx): self.params = load(...)
-        def process_batch(self, batch, batch_idx): write embeddings...
+Ergonomics matching the reference's processor context (`:123`
+TorchBatchProcessorContext):
 
-    run_batch_inference(Embedder(), dataset, core_ctx, sync_every=10)
+- `ctx.checkpoint_path(uuid)` — restore a trained model's checkpoint for
+  inference (the `prepare_model_for_inference` flow, minus torch);
+  "latest" resolves the launching trial's own warm-start checkpoint.
+- `ctx.upload_path(name)` — built-in OUTPUT storage: write files inside
+  the context, they upload to the experiment's checkpoint storage under
+  a per-rank prefix on exit (the reference's `upload_path`).
+- `ctx.report_progress(done, total)` — per-rank progress metrics into the
+  "inference" metric group (WebUI/SDK chart them like any metric).
+- automatic RESUME: each sync point records the synced-through index as a
+  tiny checkpoint; a restarted allocation skips straight past it.
+
+    class Embedder(BatchProcessor):
+        def setup(self, core_ctx):
+            # the processor context (self.ctx) is set before setup runs
+            with self.ctx.checkpoint_path("latest") as path:
+                self.params = load(path)
+        def process_batch(self, batch, batch_idx):
+            self.out.append(embed(self.params, batch))
+        def on_sync(self, batches_done):
+            with self.ctx.upload_path(f"part-{batches_done}") as d:
+                save(d / "embeddings.npy", self.out); self.out = []
+
+    run_batch_inference(Embedder(), dataset, sync_every=10)
 """
 from __future__ import annotations
 
 import abc
+import contextlib
 import logging
-from typing import Any, Iterable, Optional
+import tempfile
+from typing import Any, Iterable, Iterator, Optional
 
 from determined_tpu import core as core_mod
 
 logger = logging.getLogger("determined_tpu.batch_inference")
 
 
+class InferenceContext:
+    """What a processor needs beyond the raw core context (ref:
+    TorchBatchProcessorContext — rank info, checkpoint access, output
+    upload, progress reporting)."""
+
+    def __init__(self, core_ctx: core_mod.Context) -> None:
+        self.core = core_ctx
+        self.rank = core_ctx.distributed.rank
+        self.size = core_ctx.distributed.size
+        #: storage ids of outputs this rank uploaded via upload_path
+        self.uploaded: list = []
+        self._progress_reports = 0
+
+    @contextlib.contextmanager
+    def checkpoint_path(self, uuid: str = "latest") -> Iterator[str]:
+        """Files of a trained checkpoint, downloaded (or served in place
+        on shared_fs) for the duration. "latest" resolves the launching
+        trial's configured checkpoint (warm start / fork source)."""
+        if uuid == "latest":
+            info = getattr(self.core, "info", None)
+            trial = getattr(info, "trial", None) if info else None
+            resolved = getattr(trial, "latest_checkpoint", None)
+            if not resolved:
+                raise ValueError(
+                    'checkpoint_path("latest") needs the experiment to '
+                    "carry a checkpoint (fork with --checkpoint, or pass "
+                    "an explicit uuid)"
+                )
+            uuid = resolved
+        with self.core.checkpoint.restore_path(uuid) as path:
+            yield str(path)
+
+    @contextlib.contextmanager
+    def upload_path(self, name: str = "output") -> Iterator[str]:
+        """A scratch dir whose contents upload to the experiment's
+        checkpoint STORAGE on exit under a collision-free per-rank id.
+        Goes through the storage manager directly, NOT the checkpoint
+        report path — every rank may call it independently (the report
+        path is chief-only), and outputs must never overwrite the trial's
+        latest_checkpoint (which "latest" model resolution and training
+        resume both read). Ids are logged and appended to self.uploaded."""
+        import uuid as uuid_mod
+
+        storage = self.core.checkpoint._storage
+        storage_id = (
+            f"inference-{name}-rank{self.rank}-{uuid_mod.uuid4().hex[:8]}"
+        )
+        with tempfile.TemporaryDirectory(prefix="dtpu-infer-") as tmp:
+            yield tmp
+            storage.upload(tmp, storage_id)
+            self.uploaded.append(storage_id)
+            logger.info(
+                "rank %d uploaded inference output %s as %s",
+                self.rank, name, storage_id,
+            )
+
+    def report_progress(
+        self,
+        batches_done: int,
+        total: Optional[int] = None,
+        rank_total: Optional[int] = None,
+    ) -> None:
+        """Per-rank progress into the "inference" metric group. `total`
+        is the GLOBAL batch count; this rank's share is derived from the
+        round-robin assignment so a finished rank reads 1.0."""
+        self._progress_reports += 1
+        metrics = {f"rank{self.rank}_batches_done": batches_done}
+        share = rank_total
+        if share is None and total:
+            share = len(range(self.rank, total, self.size))
+        if share:
+            metrics[f"rank{self.rank}_progress"] = batches_done / share
+        self.core.train.report_metrics("inference", batches_done, metrics)
+
+
 class BatchProcessor(abc.ABC):
+    #: set by run_batch_inference before setup()
+    ctx: InferenceContext
+
     def setup(self, core_context: core_mod.Context) -> None:
         """Load models/outputs writers; called once before processing."""
 
@@ -40,23 +141,62 @@ class BatchProcessor(abc.ABC):
         """Called after the final batch."""
 
 
+def _resume_index(ctx: core_mod.Context) -> int:
+    """Last synced-through dataset index from a previous run (0 = fresh
+    start). The frontier rides the "inference" METRIC group — never the
+    checkpoint chain, which belongs to the model weights ("latest"
+    resolution and training resume both read latest_checkpoint, so a
+    marker there would shadow the model)."""
+    session = getattr(ctx, "_session", None)
+    info = getattr(ctx, "info", None)
+    trial = getattr(info, "trial", None) if info else None
+    if session is None or trial is None:
+        return 0
+    try:
+        rows = session.get(
+            f"/api/v1/trials/{trial.trial_id}/metrics",
+            params={"group": "inference"},
+        )["metrics"]
+    except Exception:  # noqa: BLE001 - no history: start over
+        return 0
+    best = 0
+    for r in rows:
+        try:
+            best = max(best, int(r.get("body", {}).get("synced_through", 0)))
+        except (TypeError, ValueError):
+            continue
+    return best
+
+
 def run_batch_inference(
     processor: BatchProcessor,
     dataset: Iterable[Any],
     core_context: Optional[core_mod.Context] = None,
     sync_every: int = 50,
+    total_batches: Optional[int] = None,
 ) -> int:
     """Partition `dataset` over the allocation and run the processor.
 
     Returns the number of batches this rank processed. Batches are assigned
     round-robin by index (rank i takes batches i, i+size, ...), matching the
     reference's worker sharding; `sync_every` barriers keep workers loosely
-    in step and give preemption a clean boundary.
+    in step, give preemption a clean boundary, and record a resume marker
+    so a restarted allocation skips completed work.
     """
     ctx = core_context or core_mod.init()
     dist = ctx.distributed
     rank, size = dist.rank, dist.size
+    processor.ctx = InferenceContext(ctx)
     processor.setup(ctx)
+
+    skip_through = _resume_index(ctx)
+    if skip_through and rank == 0:
+        logger.info(
+            "resuming batch inference past synced index %d", skip_through
+        )
+    # Work this rank completed before the restart still counts toward its
+    # lifetime progress numbers.
+    done_before = len(range(rank, skip_through, size))
 
     mine = 0
     preempted = False
@@ -66,12 +206,16 @@ def run_batch_inference(
     # evenly (one rank syncs inside the loop, another only at the end).
     sync_stride = max(1, sync_every) * size
     for idx, batch in enumerate(dataset):
+        if idx < skip_through:
+            continue  # completed before the restart
         if idx % size == rank:
             processor.process_batch(batch, idx)
             mine += 1
         if (idx + 1) % sync_stride == 0:
             dist.barrier()
             processor.on_sync(mine)
+            processor.ctx.report_progress(done_before + mine, total_batches)
+            _record_resume(ctx, rank, idx + 1)
             if ctx.preempt.should_preempt():
                 logger.info("batch inference preempted at batch %d", idx)
                 preempted = True
@@ -79,5 +223,19 @@ def run_batch_inference(
     if not preempted:
         dist.barrier()
         processor.on_sync(mine)
+        processor.ctx.report_progress(done_before + mine, total_batches)
     processor.teardown()
     return mine
+
+
+def _record_resume(ctx: core_mod.Context, rank: int, synced_through: int) -> None:
+    """Chief reports the sync frontier into the "inference" metric group
+    (the marker _resume_index reads on restart)."""
+    if rank != 0:
+        return
+    try:
+        ctx.train.report_metrics(
+            "inference", synced_through, {"synced_through": synced_through}
+        )
+    except Exception:  # noqa: BLE001 - marker is best-effort; work goes on
+        logger.exception("resume-marker report failed (continuing)")

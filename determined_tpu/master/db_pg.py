@@ -45,8 +45,10 @@ AUTO_ID_TABLES = {
     "webhooks", "workspaces", "projects",
 }
 
-#: primary keys for INSERT OR REPLACE upsert rewriting.
-REPLACE_PKS = {"checkpoints": "uuid", "kv": "key", "templates": "name"}
+#: primary keys for INSERT OR REPLACE upsert rewriting. Only checkpoints
+#: uses the SQLite-only OR REPLACE form today (kv/templates already write
+#: portable ON CONFLICT ... DO UPDATE directly).
+REPLACE_PKS = {"checkpoints": "uuid"}
 
 _INSERT_RE = re.compile(
     r"^\s*INSERT(\s+OR\s+(?:IGNORE|REPLACE))?\s+INTO\s+(\w+)\s*"

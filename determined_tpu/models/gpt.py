@@ -54,6 +54,10 @@ class GPTConfig:
     # whole forward kernel when rematted — saving them (~60MB/layer at the
     # bench shapes) is far cheaper than the recompute (~8ms/step).
     remat_attention: bool = False
+    #: lax.scan unroll factor over the layer stack: >1 lets XLA overlap
+    #: consecutive blocks' HBM prefetch with MXU work at the cost of a
+    #: proportionally larger program (compile time + icache).
+    scan_unroll: int = 1
     attn_impl: str = "auto"            # see models.attention
     # Flash kernel tile sizes. 1024/1024 measured best on v5e for the GPT-2
     # bench shapes (43.0% vs 41.6% MFU at 512/512; sweep in BENCH notes) —
@@ -598,7 +602,8 @@ class GPT(Model):
             return (x, aux + blk_aux), None
 
         (x, aux), _ = lax.scan(
-            body, (x, jnp.zeros((), jnp.float32)), params["blocks"]
+            body, (x, jnp.zeros((), jnp.float32)), params["blocks"],
+            unroll=c.scan_unroll,
         )
         return x, aux
 

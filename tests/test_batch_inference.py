@@ -92,3 +92,136 @@ class TestStorageGating:
 
         with pytest.raises(ValueError, match="unknown"):
             from_config({"type": "carrier-pigeon"})
+
+
+class Embedder(BatchProcessor):
+    """Exercises the processor-context ergonomics end to end."""
+
+    def __init__(self):
+        self.out = []
+        self.flushed = []
+
+    def process_batch(self, batch, batch_idx):
+        self.out.append(batch * 10)
+
+    def on_sync(self, n):
+        import os
+
+        with self.ctx.upload_path(f"part{len(self.flushed)}") as d:
+            with open(os.path.join(d, "embs.txt"), "w") as f:
+                f.write(",".join(map(str, self.out)))
+        self.flushed.append(list(self.out))
+        self.out = []
+
+
+class TestInferenceContext:
+    def test_upload_path_and_progress(self, tmp_path):
+        """Outputs written inside upload_path land in checkpoint storage
+        with per-rank metadata; progress metrics hit the train context."""
+        from determined_tpu.core._distributed import DummyDistributedContext
+
+        reports = []
+
+        class RecordingTrain(DummyTrainContext):
+            def report_metrics(self, group, steps, metrics):
+                reports.append((group, steps, metrics))
+
+        dist = DummyDistributedContext()
+        store = SharedFSStorageManager(str(tmp_path))
+        ctx = Context(
+            distributed=dist,
+            train=RecordingTrain(),
+            checkpoint=DummyCheckpointContext(dist, store),
+            preempt=DummyPreemptContext(dist),
+            searcher=DummySearcherContext(dist),
+        )
+        proc = Embedder()
+        n = run_batch_inference(
+            proc, list(range(7)), ctx, sync_every=2, total_batches=7
+        )
+        assert n == 7
+        assert proc.flushed  # on_sync flushed outputs
+        assert proc.ctx.uploaded, "upload_path must store outputs"
+        # direct storage upload (per-rank safe, never touches the trial's
+        # checkpoint chain): collision-free rank-stamped ids
+        sid = proc.ctx.uploaded[0]
+        assert sid.startswith("inference-part0-rank0-")
+        assert "embs.txt" in store.list_files(sid)
+        with store.restore_path(sid) as p:
+            import os
+
+            assert "embs.txt" in os.listdir(p)
+        assert any(g == "inference" for g, _, _ in reports)
+        last = [m for g, _, m in reports if g == "inference"][-1]
+        assert last["rank0_batches_done"] == 7
+        assert last["rank0_progress"] == 1.0
+
+    def test_checkpoint_path_restores_files(self, tmp_path):
+        from determined_tpu.core._distributed import DummyDistributedContext
+
+        dist = DummyDistributedContext()
+        store = SharedFSStorageManager(str(tmp_path))
+        ctx = Context(
+            distributed=dist,
+            train=DummyTrainContext(),
+            checkpoint=DummyCheckpointContext(dist, store),
+            preempt=DummyPreemptContext(dist),
+            searcher=DummySearcherContext(dist),
+        )
+        import os
+
+        src = tmp_path / "stage"
+        src.mkdir()
+        (src / "weights.bin").write_bytes(b"w" * 8)
+        sid = ctx.checkpoint.upload(str(src), metadata={})
+
+        from determined_tpu.batch_inference import InferenceContext
+
+        ictx = InferenceContext(ctx)
+        with ictx.checkpoint_path(sid) as p:
+            assert (os.path.join(p, "weights.bin"))
+            with open(os.path.join(p, "weights.bin"), "rb") as f:
+                assert f.read() == b"w" * 8
+
+    def test_resume_skips_synced_batches(self, tmp_path):
+        """A restart resumes past the synced frontier recorded in the
+        "inference" metric group — completed work is not reprocessed, and
+        the trial's latest_checkpoint (the MODEL) is never touched."""
+        from determined_tpu.batch_inference import _resume_index
+        from determined_tpu.core._distributed import DummyDistributedContext
+
+        class FakeSession:
+            def get(self, path, params=None):
+                assert params == {"group": "inference"}
+                return {"metrics": [
+                    {"body": {"synced_through": 2}},
+                    {"body": {"synced_through": 4}},
+                    {"body": {"rank0_batches_done": 9}},  # no frontier key
+                ]}
+
+        class FakeTrial:
+            trial_id = 7
+            latest_checkpoint = "model-weights-uuid"  # must stay the model
+
+        class FakeInfo:
+            trial = FakeTrial()
+
+        dist = DummyDistributedContext()
+        store = SharedFSStorageManager(str(tmp_path))
+        ctx = Context(
+            distributed=dist,
+            train=DummyTrainContext(),
+            checkpoint=DummyCheckpointContext(dist, store),
+            preempt=DummyPreemptContext(dist),
+            searcher=DummySearcherContext(dist),
+        )
+        ctx._session = FakeSession()
+        ctx.info = FakeInfo()
+        assert _resume_index(ctx) == 4
+
+        proc = Collector()
+        n = run_batch_inference(proc, list(range(10)), ctx, sync_every=100)
+        assert n == 6  # batches 0-3 skipped
+        assert [b for _, b in proc.batches] == [4, 5, 6, 7, 8, 9]
+        # the resume machinery never rewrote the model pointer
+        assert FakeTrial.latest_checkpoint == "model-weights-uuid"

@@ -32,6 +32,11 @@ def database(request, tmp_path):
             "templates", "audit_log", "kv", "files", "webhooks",
         ):
             d._execute(f"DELETE FROM {table}")
+        # keep the Uncategorized seed rows, drop everything else (a
+        # long-lived server must not flake on its own leftovers:
+        # workspaces.name is UNIQUE)
+        d._execute("DELETE FROM projects WHERE id != 1")
+        d._execute("DELETE FROM workspaces WHERE id != 1")
     yield d
     d.close()
 
