@@ -11,9 +11,12 @@ a torch model:
   tensor) and sequence parallelism ("sequence"→context) with zero model
   changes — this replaces the reference's DeepSpeed ZeRO/"slice"/pipeline
   config surface (pytorch/deepspeed/_mpu.py).
-- blocks are stacked along a leading `layers` axis and applied with
-  `lax.scan` → one compiled block program regardless of depth (big XLA
-  compile-time win; ASHA searches re-use the compilation cache across rungs).
+- blocks are stacked along a leading `layers` axis and applied either
+  unrolled (default up to 24 layers: XLA keeps backward residuals live
+  instead of stashing them into [L, ...] buffers — +21% tokens/s on the
+  GPT-2 bench) or with `lax.scan` (one compiled block program regardless
+  of depth; ASHA searches re-use the compilation cache across rungs) —
+  the `layer_loop` knob.
 - attention dispatches to the Pallas flash kernel or ring attention via
   determined_tpu.models.attention; matmuls run in bfloat16 with fp32 master
   params and fp32 layernorm/softmax.
@@ -58,6 +61,22 @@ class GPTConfig:
     #: consecutive blocks' HBM prefetch with MXU work at the cost of a
     #: proportionally larger program (compile time + icache).
     scan_unroll: int = 1
+    # How the (non-pipelined) trunk iterates its layer stack:
+    #   "scan"   — lax.scan over stacked [L, ...] weights: one compiled
+    #              block regardless of depth (compile-time win; the original
+    #              default), but every residual the backward needs is saved
+    #              by dynamic-update-slice into [L, ...] stacked buffers and
+    #              re-read by dynamic-slice — pure HBM traffic.
+    #   "unroll" — a Python loop over per-layer weight slices: XLA sees L
+    #              independent blocks, keeps residuals as plain live values
+    #              (no DUS stash), and fuses across block boundaries.
+    #              Measured on v5e GPT-2-small b16: 52.5% MFU vs 43.4% under
+    #              scan (+21% tokens/s); profile showed ~25 ms/step of
+    #              bitcast_dynamic-update-slice fusions gone. Program size
+    #              and compile time grow ~linearly with L.
+    #   "auto"   — "unroll" for stacks up to 24 layers, "scan" for deeper
+    #              models where compile time / program size dominate.
+    layer_loop: str = "auto"
     attn_impl: str = "auto"            # see models.attention
     # Flash kernel tile sizes. 1024/1024 measured best on v5e for the GPT-2
     # bench shapes (43.0% vs 41.6% MFU at 512/512; sweep in BENCH notes) —
@@ -595,6 +614,21 @@ class GPT(Model):
             block_fn = functools.partial(self._block, manual=False)
             if c.remat:
                 block_fn = jax.checkpoint(block_fn, policy=_remat_policy())
+
+        unroll = c.layer_loop == "unroll" or (
+            c.layer_loop == "auto" and c.n_layers <= 24
+        )
+        if unroll:
+            # Python loop over per-layer slices: no [L, ...] residual
+            # stash (see the layer_loop knob for the measured numbers).
+            aux = jnp.zeros((), jnp.float32)
+            for i in range(c.n_layers):
+                blk = jax.tree_util.tree_map(
+                    lambda a, i=i: a[i], params["blocks"]
+                )
+                x, blk_aux = block_fn(x, blk)
+                aux = aux + blk_aux
+            return x, aux
 
         def body(carry, blk):
             x, aux = carry

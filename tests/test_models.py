@@ -26,6 +26,33 @@ class TestGPT:
         assert 4.0 < float(loss) < 7.5
         assert 0.0 <= float(metrics["accuracy"]) <= 0.1
 
+    def test_layer_loop_unroll_matches_scan(self):
+        """The unrolled trunk (layer_loop="unroll") is a pure scheduling
+        change: loss AND grads must match lax.scan bit-for-bit-ish."""
+        cfg = gpt_mod.tiny()
+        batch = _token_batch(np.random.default_rng(3), 2, 128, 256)
+        outs = {}
+        for loop in ("scan", "unroll"):
+            # fp32 compute: the two loops schedule identical math, but bf16
+            # rounding differs with the fusion boundaries XLA picks.
+            model = GPT(gpt_mod.GPTConfig(
+                **{**cfg.__dict__, "layer_loop": loop,
+                   "dtype": jnp.float32}
+            ))
+            params = model.init(jax.random.PRNGKey(0))
+            loss, grads = jax.value_and_grad(
+                lambda p: model.loss(p, batch, jax.random.PRNGKey(1))[0]
+            )(params)
+            outs[loop] = (float(loss), grads)
+        assert outs["scan"][0] == pytest.approx(outs["unroll"][0], rel=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=1e-4, atol=1e-5,
+            ),
+            outs["scan"][1], outs["unroll"][1],
+        )
+
     def test_logical_axes_match_params(self):
         model = get_model("gpt-tiny")
         params = model.init(jax.random.PRNGKey(0))
