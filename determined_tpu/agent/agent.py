@@ -70,6 +70,43 @@ def detect_slots(spec: Any = "auto") -> int:
     return int(spec)
 
 
+def detect_devices(spec: Any = "auto") -> List[Dict[str, Any]]:
+    """Per-slot device descriptions (ref: agent/internal/detect/detect.go +
+    pkg/device — there nvidia-smi/rocm rows with uuid/brand; here the TPU
+    runtime's own view). Best-effort: registration never fails over this —
+    artificial/int slots report synthetic "slot" devices."""
+    if spec == "auto":
+        try:
+            import jax
+
+            return [
+                {
+                    "id": i,
+                    "kind": d.device_kind,
+                    "platform": d.platform,
+                    "coords": list(getattr(d, "coords", ()) or ()),
+                }
+                for i, d in enumerate(jax.local_devices())
+            ]
+        except Exception:  # noqa: BLE001 - detect_slots surfaces real errors
+            pass
+    n = 1
+    try:
+        n = detect_slots(spec)
+    except SlotDetectionError:
+        pass
+    return [{"id": i, "kind": "slot", "platform": "cpu"} for i in range(n)]
+
+
+def _shim_path() -> str:
+    """File path of the supervisor shim (run via `python -S <path>`: the
+    shim is pure stdlib, and skipping site processing keeps its startup at
+    ~40 ms where `-m` plus this image's sitecustomize costs seconds)."""
+    from determined_tpu.agent import _shim
+
+    return _shim.__file__
+
+
 def _proc_stat(pid: int) -> Optional[Tuple[int, str]]:
     """(starttime, state-letter) from /proc/<pid>/stat, or None if gone.
 
@@ -128,6 +165,7 @@ class AgentDaemon:
         self.master_url = master_url
         self.agent_id = agent_id or socket.gethostname()
         self.slots = detect_slots(slots)
+        self.devices = detect_devices(slots)
         self.pool = pool
         self.session = Session(master_url, token=token)
         self.python_exe = python_exe or sys.executable
@@ -169,7 +207,7 @@ class AgentDaemon:
             json_body={
                 "agent_id": self.agent_id, "slots": self.slots,
                 "pool": self.pool, "running_allocs": running,
-                "exiting_allocs": exiting,
+                "exiting_allocs": exiting, "devices": self.devices,
             },
         ) or {}
         orphaned = set(resp.get("orphaned") or [])
@@ -394,10 +432,14 @@ class AgentDaemon:
                 pass
         logf = open(log_path, "ab")
         try:
+            # The shim is pure stdlib, run by file path under -S: skipping
+            # site/sitecustomize turns its interpreter startup from ~2.9 s
+            # (this image's sitecustomize pre-registers a TPU backend) into
+            # ~40 ms — at ASHA scale that extra startup per task spawn had
+            # cost ~40% of platform trial throughput.
             proc = subprocess.Popen(
                 [
-                    self.python_exe, "-m", "determined_tpu.agent._shim",
-                    exit_file,
+                    self.python_exe, "-S", _shim_path(), exit_file,
                     self.python_exe, "-m", "determined_tpu.exec.prep_and_run",
                 ],
                 env=env,
@@ -463,7 +505,7 @@ class AgentDaemon:
                     # read cap: ship what we have.
                     end = len(chunk)
                 else:
-                    time.sleep(0.2)
+                    task.done.wait(0.2)  # wakes early on task exit
                     continue
                 try:
                     # _ship_lines advances task.offset per shipped sub-batch,
@@ -481,7 +523,7 @@ class AgentDaemon:
                     continue
             if done:
                 return
-            time.sleep(0.2)
+            task.done.wait(0.2)  # wakes early on task exit
 
     def _ship_lines(self, task: _Task, data: bytes) -> None:
         """Ship `data` (bytes from task.offset) in sub-batches, advancing

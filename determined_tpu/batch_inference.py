@@ -59,7 +59,6 @@ class InferenceContext:
         self.size = core_ctx.distributed.size
         #: storage ids of outputs this rank uploaded via upload_path
         self.uploaded: list = []
-        self._progress_reports = 0
 
     @contextlib.contextmanager
     def checkpoint_path(self, uuid: str = "latest") -> Iterator[str]:
@@ -113,7 +112,6 @@ class InferenceContext:
         """Per-rank progress into the "inference" metric group. `total`
         is the GLOBAL batch count; this rank's share is derived from the
         round-robin assignment so a finished rank reads 1.0."""
-        self._progress_reports += 1
         metrics = {f"rank{self.rank}_batches_done": batches_done}
         share = rank_total
         if share is None and total:
@@ -141,12 +139,14 @@ class BatchProcessor(abc.ABC):
         """Called after the final batch."""
 
 
-def _resume_index(ctx: core_mod.Context) -> int:
-    """Last synced-through dataset index from a previous run (0 = fresh
-    start). The frontier rides the "inference" METRIC group — never the
-    checkpoint chain, which belongs to the model weights ("latest"
-    resolution and training resume both read latest_checkpoint, so a
-    marker there would shadow the model)."""
+def _resume_index(ctx: core_mod.Context, pass_name: str = "default") -> int:
+    """Last synced-through dataset index from a previous run of THIS pass
+    (0 = fresh start). The frontier rides the "inference" METRIC group —
+    never the checkpoint chain, which belongs to the model weights
+    ("latest" resolution and training resume both read latest_checkpoint,
+    so a marker there would shadow the model). Markers are scoped by
+    `pass_name` so a trial running several inference passes doesn't let
+    one pass's frontier skip another's leading batches."""
     session = getattr(ctx, "_session", None)
     info = getattr(ctx, "info", None)
     trial = getattr(info, "trial", None) if info else None
@@ -161,8 +161,11 @@ def _resume_index(ctx: core_mod.Context) -> int:
         return 0
     best = 0
     for r in rows:
+        body = r.get("body", {})
+        if str(body.get("pass", "default")) != pass_name:
+            continue
         try:
-            best = max(best, int(r.get("body", {}).get("synced_through", 0)))
+            best = max(best, int(body.get("synced_through", 0)))
         except (TypeError, ValueError):
             continue
     return best
@@ -174,6 +177,7 @@ def run_batch_inference(
     core_context: Optional[core_mod.Context] = None,
     sync_every: int = 50,
     total_batches: Optional[int] = None,
+    pass_name: str = "default",
 ) -> int:
     """Partition `dataset` over the allocation and run the processor.
 
@@ -189,10 +193,11 @@ def run_batch_inference(
     processor.ctx = InferenceContext(ctx)
     processor.setup(ctx)
 
-    skip_through = _resume_index(ctx)
+    skip_through = _resume_index(ctx, pass_name)
     if skip_through and rank == 0:
         logger.info(
-            "resuming batch inference past synced index %d", skip_through
+            "resuming batch inference pass %r past synced index %d",
+            pass_name, skip_through,
         )
     # Work this rank completed before the restart still counts toward its
     # lifetime progress numbers.
@@ -215,7 +220,7 @@ def run_batch_inference(
             dist.barrier()
             processor.on_sync(mine)
             processor.ctx.report_progress(done_before + mine, total_batches)
-            _record_resume(ctx, rank, idx + 1)
+            _record_resume(ctx, rank, idx + 1, pass_name)
             if ctx.preempt.should_preempt():
                 logger.info("batch inference preempted at batch %d", idx)
                 preempted = True
@@ -228,14 +233,18 @@ def run_batch_inference(
     return mine
 
 
-def _record_resume(ctx: core_mod.Context, rank: int, synced_through: int) -> None:
+def _record_resume(
+    ctx: core_mod.Context, rank: int, synced_through: int,
+    pass_name: str = "default",
+) -> None:
     """Chief reports the sync frontier into the "inference" metric group
-    (the marker _resume_index reads on restart)."""
+    (the marker _resume_index reads on restart), scoped by pass name."""
     if rank != 0:
         return
     try:
         ctx.train.report_metrics(
-            "inference", synced_through, {"synced_through": synced_through}
+            "inference", synced_through,
+            {"synced_through": synced_through, "pass": pass_name},
         )
     except Exception:  # noqa: BLE001 - marker is best-effort; work goes on
         logger.exception("resume-marker report failed (continuing)")

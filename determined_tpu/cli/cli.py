@@ -123,6 +123,8 @@ def exp_list(args: argparse.Namespace) -> None:
     if getattr(args, "limit", None):
         params["limit"] = str(args.limit)
         params["offset"] = str(getattr(args, "offset", 0) or 0)
+    if getattr(args, "label", None):
+        params["label"] = args.label
     resp = _session(args).get("/api/v1/experiments", params=params)
     _table(
         [
@@ -130,12 +132,43 @@ def exp_list(args: argparse.Namespace) -> None:
                 "id": e["id"], "state": e["state"],
                 "progress": f"{e.get('progress') or 0:.0%}",
                 "searcher": e["config"].get("searcher", {}).get("name", ""),
+                "labels": ",".join(e.get("labels") or []),
                 "archived": "yes" if e.get("archived") else "",
             }
             for e in resp["experiments"]
         ],
-        ["id", "state", "progress", "searcher", "archived"],
+        ["id", "state", "progress", "searcher", "labels", "archived"],
     )
+
+
+def exp_set_meta(field: str):
+    """`dtpu e set description|notes <id> <value>` (ref cli/experiment.py
+    set_description / set_notes verbs)."""
+    def fn(args: argparse.Namespace) -> None:
+        _session(args).patch(
+            f"/api/v1/experiments/{args.experiment_id}",
+            json_body={field: args.value},
+        )
+        print(f"experiment {args.experiment_id}: {field} updated")
+    return fn
+
+
+def exp_label(args: argparse.Namespace) -> None:
+    """`dtpu e label add|remove <id> <label>` (ref cli/experiment.py
+    experiment label add/remove)."""
+    session = _session(args)
+    exp = session.get(f"/api/v1/experiments/{args.experiment_id}")
+    labels = list(exp.get("labels") or [])
+    if args.action == "add":
+        if args.label not in labels:
+            labels.append(args.label)
+    else:
+        labels = [x for x in labels if x != args.label]
+    session.patch(
+        f"/api/v1/experiments/{args.experiment_id}",
+        json_body={"labels": labels},
+    )
+    print(f"experiment {args.experiment_id}: labels = {', '.join(labels) or '(none)'}")
 
 
 def exp_fork(args: argparse.Namespace) -> None:
@@ -452,6 +485,57 @@ def shell_open(args: argparse.Namespace) -> None:
         _die(str(e))
 
 
+def shell_cp(args: argparse.Namespace) -> None:
+    """`dtpu shell cp <task>:<path> <local>` / `<local> <task>:<path>` —
+    the scp ergonomics the token-PTY redesign owes (the reference's `det
+    shell` is real ssh, so scp works there out of the box; here the same
+    authenticated upgrade tunnel streams the file — exec/shell.py
+    _serve_file)."""
+    from determined_tpu.cli.shell_client import (
+        ShellError, fetch_file, push_file,
+    )
+
+    src_task, _, src_path = args.src.partition(":")
+    dst_task, _, dst_path = args.dst.partition(":")
+    src_remote = ":" in args.src
+    dst_remote = ":" in args.dst
+    if src_remote == dst_remote:
+        _die("exactly one of SRC/DST must be <task-id>:<path>")
+    session = _session(args)
+    master = args.master or os.environ.get("DTPU_MASTER", "")
+    task_id = src_task if src_remote else dst_task
+    token = _shell_token_of(session, task_id)
+    if not token:
+        _die(f"{task_id} is not a shell task (no shell token)")
+    try:
+        if src_remote:
+            local = args.dst
+            if os.path.isdir(local):
+                local = os.path.join(local, os.path.basename(src_path))
+            # tmp + rename, like the server-side put: a dropped transfer
+            # must not leave a truncated file that looks complete.
+            tmp = local + ".dtpu-partial"
+            try:
+                with open(tmp, "wb") as f:
+                    n = fetch_file(master, task_id, token, src_path,
+                                   f.fileno(), user_token=session.token)
+                os.replace(tmp, local)
+            except BaseException:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
+            print(f"{src_path} -> {local} ({n} bytes)")
+        else:
+            with open(args.src, "rb") as f:
+                n = push_file(master, task_id, token, dst_path,
+                              f.fileno(), user_token=session.token)
+            print(f"{args.src} -> {task_id}:{dst_path} ({n} bytes)")
+    except (ShellError, OSError) as e:
+        _die(str(e))
+
+
 # -- model registry ------------------------------------------------------------
 def model_create(args: argparse.Namespace) -> None:
     _session(args).post(
@@ -518,12 +602,17 @@ def master_audit(args: argparse.Namespace) -> None:
 # -- cluster ------------------------------------------------------------------
 def agent_list(args: argparse.Namespace) -> None:
     agents = _session(args).get("/api/v1/agents")["agents"]
+    def _kinds(a):
+        kinds = sorted({d.get("kind", "") for d in a.get("devices") or []})
+        return ", ".join(k for k in kinds if k)
+
     _table(
         [
-            {"id": aid, "slots": a["slots"], "pool": a["pool"]}
+            {"id": aid, "slots": a["slots"], "pool": a["pool"],
+             "devices": _kinds(a)}
             for aid, a in agents.items()
         ],
-        ["id", "slots", "pool"],
+        ["id", "slots", "pool", "devices"],
     )
 
 
@@ -665,7 +754,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="include archived experiments")
     v.add_argument("--limit", type=int, default=None)
     v.add_argument("--offset", type=int, default=0)
+    v.add_argument("--label", default=None,
+                   help="only experiments carrying this label")
     v.set_defaults(fn=exp_list)
+    v = exp.add_parser("set")
+    set_sub = v.add_subparsers(dest="set_field", required=True)
+    for field in ("description", "notes", "name"):
+        sv = set_sub.add_parser(field)
+        sv.add_argument("experiment_id", type=int)
+        sv.add_argument("value")
+        sv.set_defaults(fn=exp_set_meta(field))
+    v = exp.add_parser("label")
+    v.add_argument("action", choices=["add", "remove"])
+    v.add_argument("experiment_id", type=int)
+    v.add_argument("label")
+    v.set_defaults(fn=exp_label)
     for verb, fn in [
         ("describe", exp_describe), ("wait", lambda a: exp_wait(a)),
         ("pause", _exp_action("pause")), ("activate", _exp_action("activate")),
@@ -754,6 +857,10 @@ def build_parser() -> argparse.ArgumentParser:
     v = shell.add_parser("open")
     v.add_argument("task_id")
     v.set_defaults(fn=shell_open)
+    v = shell.add_parser("cp")
+    v.add_argument("src", help="<task-id>:<path> or a local path")
+    v.add_argument("dst", help="local path or <task-id>:<path>")
+    v.set_defaults(fn=shell_cp)
     shell.add_parser("list").set_defaults(fn=cmd_list)
     v = shell.add_parser("kill")
     v.add_argument("task_id")

@@ -22,6 +22,7 @@ class ShellError(Exception):
 def connect_shell(
     master_url: str, task_id: str, shell_token: str,
     user_token: str = "",
+    extra_headers: "Optional[dict]" = None,
 ) -> "tuple[socket.socket, bytes]":
     """Dial the master, upgrade into the task's PTY tunnel. Returns the
     socket (handshake consumed) plus any tunnel bytes that raced the
@@ -48,10 +49,14 @@ def connect_shell(
         # strings land verbatim in proxy/access logs, which would turn
         # every log line into a credential store (same reasoning as the
         # master's own token stripping, master/proxy.py).
+        extras = "".join(
+            f"{k}: {v}\r\n" for k, v in (extra_headers or {}).items()
+        )
         head = (
             f"GET /proxy/{task_id}/{query} HTTP/1.1\r\n"
             f"Host: {host}:{port}\r\n"
             f"X-DTPU-Shell-Token: {shell_token}\r\n"
+            f"{extras}"
             "Connection: Upgrade\r\n"
             "Upgrade: websocket\r\n"
             "\r\n"
@@ -71,6 +76,102 @@ def connect_shell(
     except Exception:
         sock.close()
         raise
+
+
+def _read_status(sock: socket.socket, early: bytes) -> "tuple[str, bytes]":
+    """Read the transfer protocol's one-line b"OK ...\\n" / b"ERR ...\\n"
+    status; returns (line, leftover-bytes-after-newline)."""
+    buf = early
+    while b"\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ShellError("transfer connection closed mid-status")
+        buf += chunk
+    line, _, rest = buf.partition(b"\n")
+    return line.decode(errors="replace"), rest
+
+
+def fetch_file(
+    master_url: str, task_id: str, shell_token: str, remote_path: str,
+    out_fd: int, user_token: str = "",
+) -> int:
+    """scp-analog download over the shell tunnel (exec/shell.py
+    _serve_file); writes to out_fd, returns the byte count."""
+    import os
+
+    sock, early = connect_shell(
+        master_url, task_id, shell_token, user_token,
+        extra_headers={
+            "X-DTPU-File-Op": "get", "X-DTPU-File-Path": remote_path,
+        },
+    )
+    try:
+        status, rest = _read_status(sock, early)
+        if not status.startswith("OK "):
+            raise ShellError(status)
+        size = int(status[3:])
+        got = 0
+        for chunk in _iter_exactly(sock, rest, size):
+            os.write(out_fd, chunk)
+            got += len(chunk)
+        return got
+    finally:
+        sock.close()
+
+
+def _iter_exactly(sock: socket.socket, first: bytes, size: int):
+    remaining = size
+    if first:
+        yield first[:remaining]
+        remaining -= min(len(first), remaining)
+    while remaining > 0:
+        chunk = sock.recv(min(65536, remaining))
+        if not chunk:
+            raise ShellError(
+                f"transfer truncated ({size - remaining}/{size} bytes)"
+            )
+        yield chunk
+        remaining -= len(chunk)
+
+
+def push_file(
+    master_url: str, task_id: str, shell_token: str, remote_path: str,
+    in_fd: int, user_token: str = "",
+) -> int:
+    """scp-analog upload over the shell tunnel; streams in_fd to the task,
+    returns the byte count the task acknowledged writing."""
+    import os
+
+    sock, early = connect_shell(
+        master_url, task_id, shell_token, user_token,
+        extra_headers={
+            "X-DTPU-File-Op": "put", "X-DTPU-File-Path": remote_path,
+        },
+    )
+    try:
+        try:
+            while True:
+                chunk = os.read(in_fd, 1 << 20)
+                if not chunk:
+                    break
+                sock.sendall(chunk)
+            sock.shutdown(socket.SHUT_WR)
+        except OSError as send_err:
+            # The server aborts early (e.g. unwritable path) by sending
+            # "ERR ..." and closing; our sendall then hits EPIPE. The real
+            # error is sitting in the receive buffer — surface it instead
+            # of the broken pipe.
+            try:
+                status, _ = _read_status(sock, early)
+            except (ShellError, OSError):
+                raise ShellError(f"transfer failed: {send_err}") from send_err
+            raise ShellError(status) from send_err
+        status, _ = _read_status(sock, early)
+        if not status.startswith("OK "):
+            raise ShellError(status)
+        return int(status[3:])
+    finally:
+        sock.close()
 
 
 def run_shell(

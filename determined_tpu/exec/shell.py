@@ -61,6 +61,62 @@ def _reap(pid: int) -> None:
         pass  # already reaped
 
 
+def _serve_file(
+    conn: socket.socket, op: str, path: str, early: bytes
+) -> None:
+    """File-transfer mode (dtpu shell cp). Wire protocol after the 101:
+      get: server sends b"OK <size>\\n" then exactly <size> raw bytes.
+      put: client streams the contents and half-closes; the server writes
+           atomically (tmp + rename) and replies b"OK <bytes>\\n".
+    Errors answer b"ERR <message>\\n" instead."""
+
+    def err(msg: str) -> None:
+        conn.sendall(b"ERR " + msg.encode(errors="replace")[:500] + b"\n")
+
+    try:
+        if op == "get":
+            try:
+                size = os.path.getsize(path)
+                f = open(path, "rb")
+            except OSError as e:
+                err(str(e))
+                return
+            with f:
+                conn.sendall(f"OK {size}\n".encode())
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        break
+                    conn.sendall(chunk)
+        elif op == "put":
+            tmp = path + ".dtpu-partial"
+            n = 0
+            try:
+                with open(tmp, "wb") as f:
+                    if early:
+                        f.write(early)
+                        n += len(early)
+                    while True:
+                        chunk = conn.recv(1 << 20)
+                        if not chunk:
+                            break
+                        f.write(chunk)
+                        n += len(chunk)
+                os.replace(tmp, path)
+            except OSError as e:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                err(str(e))
+                return
+            conn.sendall(f"OK {n}\n".encode())
+        else:
+            err(f"unknown file op {op!r}")
+    except OSError:
+        pass
+
+
 def _serve_connection(conn: socket.socket, token: str) -> None:
     from determined_tpu.common.netutil import read_http_head
 
@@ -99,10 +155,28 @@ def _serve_connection(conn: socket.socket, token: str) -> None:
             # it — an unauthenticated PTY would be remote root.
             conn.sendall(b"HTTP/1.1 403 Forbidden\r\n\r\nbad shell token")
             return
+        file_op = file_path = ""
+        for line in head_text.split(b"\r\n")[1:]:
+            name, _, value = line.decode(errors="replace").partition(":")
+            lname = name.strip().lower()
+            if lname == "x-dtpu-file-op":
+                file_op = value.strip().lower()
+            elif lname == "x-dtpu-file-path":
+                file_path = value.strip()
+
         conn.sendall(
             b"HTTP/1.1 101 Switching Protocols\r\n"
             b"Upgrade: websocket\r\nConnection: Upgrade\r\n\r\n"
         )
+
+        if file_op:
+            # scp-analog file transfer over the same authenticated tunnel
+            # (the reference's `det shell` is real ssh, so scp/sftp come
+            # for free there — master/pkg/ssh; this token-PTY redesign
+            # supplies the capability explicitly). Same privilege as the
+            # PTY (the task user), so no extra exposure.
+            _serve_file(conn, file_op, file_path, early)
+            return
 
         pid, fd = pty.fork()
         if pid == 0:  # child: the user's shell

@@ -492,6 +492,7 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
             r.body.get("pool", "default"),
             r.body.get("running_allocs") or [],
             r.body.get("exiting_allocs") or [],
+            devices=r.body.get("devices") or [],
         )
         res["cluster_id"] = m.cluster_id
         return res
@@ -552,8 +553,10 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         the full (filtered) list."""
         include_archived = r.q("include_archived", "") in ("1", "true")
         limit = r.q("limit", "")
+        label = r.q("label", "") or None
         kw: Dict[str, Any] = {"include_archived": include_archived}
         kw["newest_first"] = r.q("order", "") == "desc"
+        kw["label"] = label
         try:
             if limit:
                 kw["limit"] = max(1, min(int(limit), 500))
@@ -562,8 +565,34 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
             raise ApiError(400, "limit/offset must be integers")
         return {
             "experiments": m.db.list_experiments(**kw),
-            "total": m.db.count_experiments(include_archived=include_archived),
+            "total": m.db.count_experiments(
+                include_archived=include_archived, label=label
+            ),
         }
+
+    def exp_patch(r: ApiRequest):
+        """PatchExperiment (ref: api_experiment.go PatchExperiment,
+        experiment.proto PatchExperiment): partial update of
+        name/description/labels/notes. Omitted fields are untouched."""
+        exp_id = int(r.groups[0])
+        if m.db.get_experiment(exp_id) is None:
+            raise ApiError(404, "no such experiment")
+        fields = {}
+        for key in ("name", "description", "notes"):
+            if key in r.body:
+                if not isinstance(r.body[key], str):
+                    raise ApiError(400, f"{key} must be a string")
+                fields[key] = r.body[key]
+        if "labels" in r.body:
+            labels = r.body["labels"]
+            if not isinstance(labels, list) or not all(
+                isinstance(x, str) for x in labels
+            ):
+                raise ApiError(400, "labels must be a list of strings")
+            # dedupe, order-preserving
+            fields["labels"] = list(dict.fromkeys(labels))
+        m.db.patch_experiment_meta(exp_id, **fields)
+        return {"experiment": m.db.get_experiment(exp_id)}
 
     def exp_archive(r: ApiRequest):
         exp_id = int(r.groups[0])
@@ -1005,6 +1034,7 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("POST", r"/api/v1/experiments", create_experiment),
         R("GET", r"/api/v1/experiments", list_experiments),
         R("GET", r"/api/v1/experiments/(\d+)", get_experiment),
+        R("PATCH", r"/api/v1/experiments/(\d+)", exp_patch),
         R("POST", r"/api/v1/experiments/(\d+)/(pause|activate|cancel|kill)", exp_action),
         R("POST", r"/api/v1/experiments/(\d+)/(archive|unarchive)", exp_archive),
         R("POST", r"/api/v1/experiments/(\d+)/fork", exp_fork),

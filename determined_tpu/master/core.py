@@ -47,10 +47,19 @@ class AgentHub:
             self._closed = True
             self._cond.notify_all()
 
-    def register(self, agent_id: str, slots: int, pool: str) -> None:
+    def register(
+        self,
+        agent_id: str,
+        slots: int,
+        pool: str,
+        devices: Optional[List[Dict[str, Any]]] = None,
+    ) -> None:
         with self._cond:
             self._agents[agent_id] = {
                 "slots": slots, "pool": pool, "last_seen": time.time(),
+                # per-slot device model (ref: master/pkg/device — kind/
+                # platform/coords rather than a bare count)
+                "devices": devices or [],
             }
             self._queues.setdefault(agent_id, [])
             self._cond.notify_all()
@@ -555,6 +564,7 @@ class Master:
         pool: str,
         running_allocs: Optional[List[Dict[str, Any]]] = None,
         exiting_allocs: Optional[List[str]] = None,
+        devices: Optional[List[Dict[str, Any]]] = None,
     ) -> Dict[str, List[str]]:
         """(Re)registration with container reattach (ref: restore.go:59 +
         aproto/master_message.go:46-55 ContainerReattachAck): the agent
@@ -563,7 +573,7 @@ class Master:
         master's experiment restore hasn't caught up yet). `exiting_allocs`
         are dead tasks whose exit report is about to be delivered — they
         must not be failed over as lost."""
-        self.agent_hub.register(agent_id, slots, pool)
+        self.agent_hub.register(agent_id, slots, pool, devices=devices)
         self.rm.pool(pool).add_agent(agent_id, slots)
         adopted: List[str] = []
         orphaned: List[str] = []

@@ -17,7 +17,7 @@ import os
 import sqlite3
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 SCHEMA = """
 CREATE TABLE IF NOT EXISTS experiments (
@@ -28,6 +28,9 @@ CREATE TABLE IF NOT EXISTS experiments (
     progress REAL DEFAULT 0.0,
     project_id INTEGER DEFAULT 1,
     archived INTEGER DEFAULT 0,
+    description TEXT DEFAULT '',
+    labels TEXT DEFAULT '[]',      -- JSON array of strings
+    notes TEXT DEFAULT '',
     created_at REAL, updated_at REAL
 );
 CREATE TABLE IF NOT EXISTS trials (
@@ -153,6 +156,11 @@ MIGRATIONS = (
     "ALTER TABLE allocations ADD COLUMN num_processes INTEGER DEFAULT 1",
     # archive/unarchive (hidden-by-default listing, ref api_experiment.go)
     "ALTER TABLE experiments ADD COLUMN archived INTEGER DEFAULT 0",
+    # experiment metadata (ref: experiment.proto description/labels/notes,
+    # PatchExperiment in api_experiment.go)
+    "ALTER TABLE experiments ADD COLUMN description TEXT DEFAULT ''",
+    "ALTER TABLE experiments ADD COLUMN labels TEXT DEFAULT '[]'",
+    "ALTER TABLE experiments ADD COLUMN notes TEXT DEFAULT ''",
 )
 
 
@@ -405,10 +413,18 @@ class Database:
     # -- experiments ---------------------------------------------------------
     def add_experiment(self, config: Dict[str, Any], state: str = ACTIVE) -> int:
         now = time.time()
+        # description/labels seed from the submitted config (ref expconf
+        # carries both); PATCH owns them afterwards.
+        labels = config.get("labels") or []
         cur = self._execute(
-            "INSERT INTO experiments (state, config, created_at, updated_at)"
-            " VALUES (?,?,?,?)",
-            (state, json.dumps(config), now, now),
+            "INSERT INTO experiments (state, config, description, labels,"
+            " created_at, updated_at) VALUES (?,?,?,?,?,?)",
+            (
+                state, json.dumps(config),
+                str(config.get("description", "") or ""),
+                json.dumps([str(x) for x in labels]),
+                now, now,
+            ),
         )
         return int(cur.lastrowid)
 
@@ -416,31 +432,107 @@ class Database:
         rows = self._query("SELECT * FROM experiments WHERE id=?", (exp_id,))
         return self._exp_row(rows[0]) if rows else None
 
+    @staticmethod
+    def _exp_filters(
+        include_archived: bool, label: Optional[str]
+    ) -> Tuple[str, List[Any]]:
+        """Shared WHERE clause for list/count. The label LIKE is a
+        PREFILTER (portable across SQLite and Postgres — plain LIKE, no
+        JSON1 / jsonb operators); it can false-positive when another label
+        contains an escaped quote whose tail mimics the JSON encoding
+        (e.g. label 'a"x' vs filter 'x'), so callers re-check the decoded
+        labels list exactly (list_experiments post-filters)."""
+        where, args = [], []  # type: ignore[var-annotated]
+        if not include_archived:
+            where.append("archived=0")
+        if label:
+            pat = json.dumps(str(label))  # '"x"' with JSON escaping
+            pat = pat.replace("\\", "\\\\").replace("%", r"\%").replace("_", r"\_")
+            where.append(r"labels LIKE ? ESCAPE '\'")
+            args.append(f"%{pat}%")
+        return (" WHERE " + " AND ".join(where)) if where else "", args
+
     def list_experiments(
         self,
         limit: Optional[int] = None,
         offset: int = 0,
         include_archived: bool = True,
         newest_first: bool = False,
+        label: Optional[str] = None,
     ) -> List[Dict[str, Any]]:
         """Server-side pagination (ref: the reference's paginated
         GetExperiments): the WebUI/CLI page through limit/offset rather
         than transferring the fleet's whole history per refresh."""
-        sql = "SELECT * FROM experiments"
-        if not include_archived:
-            sql += " WHERE archived=0"
+        clause, args = self._exp_filters(include_archived, label)
+        sql = "SELECT * FROM experiments" + clause
         sql += " ORDER BY id" + (" DESC" if newest_first else "")
-        args: tuple = ()
-        if limit is not None:
+        if limit is not None and label is None:
+            # With a label filter, LIMIT must apply AFTER the exact
+            # post-filter below or prefilter false-positives would eat
+            # page slots; label-filtered sets are small, so fetch-all
+            # then slice.
             sql += " LIMIT ? OFFSET ?"
-            args = (limit, offset)
-        return [self._exp_row(r) for r in self._query(sql, args)]
+            args = args + [limit, offset]
+        rows = [self._exp_row(r) for r in self._query(sql, tuple(args))]
+        if label is not None:
+            rows = [r for r in rows if label in (r.get("labels") or [])]
+            if limit is not None:
+                rows = rows[offset:offset + limit]
+        return rows
 
-    def count_experiments(self, include_archived: bool = True) -> int:
-        sql = "SELECT COUNT(*) AS n FROM experiments"
-        if not include_archived:
-            sql += " WHERE archived=0"
-        return int(self._query(sql)[0]["n"])
+    def count_experiments(
+        self, include_archived: bool = True, label: Optional[str] = None
+    ) -> int:
+        if label is not None:
+            # Exact count needs the decoded-labels re-check (see
+            # _exp_filters); the LIKE prefilter keeps the scan small.
+            return len(
+                self.list_experiments(
+                    include_archived=include_archived, label=label
+                )
+            )
+        clause, args = self._exp_filters(include_archived, label)
+        sql = "SELECT COUNT(*) AS n FROM experiments" + clause
+        return int(self._query(sql, tuple(args))[0]["n"])
+
+    def patch_experiment_meta(
+        self,
+        exp_id: int,
+        *,
+        name: Optional[str] = None,
+        description: Optional[str] = None,
+        labels: Optional[List[str]] = None,
+        notes: Optional[str] = None,
+    ) -> None:
+        """PatchExperiment analog (ref: api_experiment.go PatchExperiment,
+        experiment.proto PatchExperiment fields): None means "leave as is".
+        `name` lives inside the stored config (it is part of expconf), so
+        patching it rewrites the config JSON."""
+        sets, args = [], []  # type: ignore[var-annotated]
+        if description is not None:
+            sets.append("description=?")
+            args.append(str(description))
+        if labels is not None:
+            sets.append("labels=?")
+            args.append(json.dumps([str(x) for x in labels]))
+        if notes is not None:
+            sets.append("notes=?")
+            args.append(str(notes))
+        if name is not None:
+            row = self.get_experiment(exp_id)
+            if row is not None:
+                cfg = dict(row["config"])
+                cfg["name"] = str(name)
+                sets.append("config=?")
+                args.append(json.dumps(cfg))
+        if not sets:
+            return
+        sets.append("updated_at=?")
+        args.append(time.time())
+        self._execute(
+            f"UPDATE experiments SET {', '.join(sets)} WHERE id=?",
+            (*args, exp_id),
+        )
 
     def set_experiment_archived(self, exp_id: int, archived: bool) -> None:
         self._execute(
@@ -454,6 +546,10 @@ class Database:
         d["config"] = json.loads(d["config"])
         if d.get("searcher_snapshot"):
             d["searcher_snapshot"] = json.loads(d["searcher_snapshot"])
+        try:
+            d["labels"] = json.loads(d.get("labels") or "[]")
+        except (TypeError, ValueError):
+            d["labels"] = []
         return d
 
     # -- generic kv (small master-owned state: RBAC assignments, etc.) -------

@@ -526,9 +526,13 @@ async function refresh() {
       ]);
     $('cluster').textContent = `· cluster ${info.cluster_id} · v${info.version}`;
     const agents = info.agents || {};
-    $('agents').innerHTML = '<tr><th>id</th><th>pool</th><th>slots</th></tr>' +
-      Object.entries(agents).map(([id, a]) =>
-        `<tr>${cell(id)}${cell(a.pool)}${cell(a.slots)}</tr>`).join('');
+    $('agents').innerHTML =
+      '<tr><th>id</th><th>pool</th><th>slots</th><th>devices</th></tr>' +
+      Object.entries(agents).map(([id, a]) => {
+        const kinds = [...new Set((a.devices || []).map(d => d.kind))]
+          .filter(Boolean).join(', ');
+        return `<tr>${cell(id)}${cell(a.pool)}${cell(a.slots)}${cell(kinds)}</tr>`;
+      }).join('');
 
     $('pools').innerHTML = '<tr><th>pool</th><th>agents</th><th>slots</th>' +
       '<th>used</th><th>pending</th></tr>' +
@@ -564,7 +568,8 @@ async function refresh() {
     const exps = expsR.experiments;  // server-side newest-first page
     pager($('exp-pager'), expPage, expsR.total, 'expPage');
     $('exps').innerHTML =
-      '<tr><th>id</th><th>state</th><th>progress</th><th>searcher</th><th></th></tr>' +
+      '<tr><th>id</th><th>state</th><th>progress</th><th>searcher</th>' +
+      '<th>labels</th><th></th></tr>' +
       exps.map(e => {
         const pct = Math.round((e.progress || 0) * 100);
         const act = e.state === 'ACTIVE'
@@ -583,6 +588,7 @@ async function refresh() {
         return `<tr>${cell(e.id)}${state(e.state)}` +
           `<td><span class="bar"><div style="width:${pct}%"></div></span> ${pct}%</td>` +
           cell((e.config.searcher || {}).name || '') +
+          cell((e.labels || []).join(', ')) +
           `<td><button onclick="selExp=${e.id};trialPage=0;refresh()">trials</button> ` +
           `<button onclick="forkExp(${e.id})">fork</button>` +
           `${act}${kill}${arch}</td></tr>`;

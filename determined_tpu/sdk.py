@@ -160,6 +160,31 @@ class Experiment:
     def kill(self) -> None:
         self._session.post(f"/api/v1/experiments/{self.id}/kill")
 
+    # -- metadata (ref client.py Experiment set_description/labels) ----------
+    def patch(self, **fields: Any) -> Dict[str, Any]:
+        """Partial metadata update: name / description / labels / notes."""
+        return self._session.patch(
+            f"/api/v1/experiments/{self.id}", json_body=fields
+        )["experiment"]
+
+    def set_description(self, description: str) -> None:
+        self.patch(description=description)
+
+    def set_notes(self, notes: str) -> None:
+        self.patch(notes=notes)
+
+    @property
+    def labels(self) -> List[str]:
+        return list(self._get().get("labels") or [])
+
+    def add_label(self, label: str) -> None:
+        labels = self.labels
+        if label not in labels:
+            self.patch(labels=labels + [label])
+
+    def remove_label(self, label: str) -> None:
+        self.patch(labels=[x for x in self.labels if x != label])
+
     def best_trial(self) -> Optional[Trial]:
         scfg = self.config.get("searcher", {})
         smaller = bool(scfg.get("smaller_is_better", True))
@@ -230,6 +255,7 @@ class Determined:
         include_archived: bool = True,
         limit: Optional[int] = None,
         offset: int = 0,
+        label: Optional[str] = None,
     ) -> List[Experiment]:
         """include_archived defaults True for script compat (cleanup /
         reporting loops must keep seeing archived rows); the WebUI hides
@@ -240,6 +266,8 @@ class Determined:
         if limit is not None:
             params["limit"] = str(limit)
             params["offset"] = str(offset)
+        if label:
+            params["label"] = label
         return [
             Experiment(self._session, e["id"])
             for e in self._session.get(

@@ -64,6 +64,42 @@ class TestConformance:
         visible = database.list_experiments(include_archived=False)
         assert ids[0] not in [e["id"] for e in visible]
 
+    def test_experiment_metadata_patch_and_label_filter(self, database):
+        eid = database.add_experiment(
+            {"entrypoint": "x:y", "labels": ["a"], "description": "d0"}
+        )
+        other = database.add_experiment({"entrypoint": "x:y"})
+        row = database.get_experiment(eid)
+        assert row["labels"] == ["a"] and row["description"] == "d0"
+        database.patch_experiment_meta(
+            eid, description="d1", labels=["a", "b%_"], notes="n",
+            name="new-name",
+        )
+        row = database.get_experiment(eid)
+        assert row["description"] == "d1"
+        assert row["labels"] == ["a", "b%_"]
+        assert row["notes"] == "n"
+        assert row["config"]["name"] == "new-name"
+        # exact label match incl. LIKE metacharacters; no cross-matches
+        got = [e["id"] for e in database.list_experiments(label="b%_")]
+        assert got == [eid]
+        assert database.count_experiments(label="b%_") == 1
+        assert database.list_experiments(label="b") == []
+        # A label with an embedded quote ('a"x' → JSON ["a\"x"]) must NOT
+        # surface under filter 'x' (the LIKE prefilter alone would match;
+        # the decoded re-check rejects it).
+        quoted = database.add_experiment(
+            {"entrypoint": "x:y", "labels": ['a"x']}
+        )
+        assert database.list_experiments(label="x") == []
+        assert database.count_experiments(label="x") == 0
+        assert [e["id"] for e in database.list_experiments(label='a"x')] == [
+            quoted
+        ]
+        assert other in [
+            e["id"] for e in database.list_experiments(label=None)
+        ]
+
     def test_trials_and_metrics(self, database):
         eid = database.add_experiment({"entrypoint": "x:y"})
         tid = database.add_trial(eid, 1, {"lr": 0.1}, seed=7)

@@ -174,6 +174,58 @@ class TestWorkspaces:
         assert master.db.get_experiment(exp.id)["project_id"] == pid
 
 
+class TestExperimentMetadata:
+    """PATCH experiment name/description/labels/notes + label-filtered
+    listing (ref: api_experiment.go PatchExperiment, experiment.proto)."""
+
+    def test_patch_and_label_filter(self, live):
+        master, api = live
+        d = Determined(api.url)
+        exp = d.create_experiment({
+            "entrypoint": "x:y", "description": "from config",
+            "labels": ["nlp"],
+            "searcher": {"name": "single", "max_length": 1},
+        })
+        other = d.create_experiment({
+            "entrypoint": "x:y",
+            "searcher": {"name": "single", "max_length": 1},
+        })
+        row = master.db.get_experiment(exp.id)
+        assert row["description"] == "from config"
+        assert row["labels"] == ["nlp"]
+
+        exp.set_description("tuned gpt2")
+        exp.add_label("prod")
+        exp.add_label("prod")  # idempotent
+        exp.set_notes("## findings\nlr 3e-4 wins")
+        row = master.db.get_experiment(exp.id)
+        assert row["description"] == "tuned gpt2"
+        assert row["labels"] == ["nlp", "prod"]
+        assert row["notes"].startswith("## findings")
+
+        ids = [e.id for e in d.list_experiments(label="prod")]
+        assert ids == [exp.id]
+        assert other.id in [e.id for e in d.list_experiments()]
+
+        exp.remove_label("prod")
+        assert d.list_experiments(label="prod") == []
+        assert exp.labels == ["nlp"]
+
+    def test_patch_name_rewrites_config_and_validates(self, live):
+        master, api = live
+        d = Determined(api.url)
+        exp = d.create_experiment({
+            "entrypoint": "x:y",
+            "searcher": {"name": "single", "max_length": 1},
+        })
+        exp.patch(name="renamed")
+        assert master.db.get_experiment(exp.id)["config"]["name"] == "renamed"
+        with pytest.raises(Exception):
+            exp.patch(labels="not-a-list")
+        with pytest.raises(Exception):
+            exp.patch(description=7)
+
+
 class TestWebhooks:
     def test_fires_on_terminal_state(self, live):
         master, api = live

@@ -162,6 +162,23 @@ class TestReattachUnits:
         # Explicit counts never touch the runtime.
         assert detect_slots(4) == 4
 
+    def test_detect_devices_and_registration_model(self):
+        """Per-slot device model rides registration to the master's agent
+        registry (ref: agent detect.go + master/pkg/device)."""
+        from determined_tpu.agent.agent import detect_devices
+
+        devs = detect_devices("auto")  # CPU test host: jax cpu devices
+        assert devs and all("kind" in d and "platform" in d for d in devs)
+        synthetic = detect_devices(3)
+        assert [d["id"] for d in synthetic] == [0, 1, 2]
+        m = Master()
+        try:
+            m.agent_registered("a1", 2, "default", devices=synthetic[:2])
+            agents = m.agent_hub.list()
+            assert [d["id"] for d in agents["a1"]["devices"]] == [0, 1]
+        finally:
+            m.shutdown()
+
     def test_unknown_alloc_is_orphaned(self):
         m = Master()
         try:

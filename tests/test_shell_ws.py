@@ -204,4 +204,29 @@ class TestShellTask:
             assert not t.is_alive(), "run_shell must return when shell exits"
             assert b"pipe-42" in out, out[-500:]
 
+            # File transfer (dtpu shell cp): push a file, pull it back,
+            # error for a missing remote path — the scp-ergonomics slot of
+            # the reference's ssh-based shells (master/pkg/ssh).
+            from determined_tpu.cli.shell_client import fetch_file, push_file
+
+            payload = os.urandom(300_000)  # spans several recv chunks
+            src = tmp_path / "up.bin"
+            src.write_bytes(payload)
+            remote = str(tmp_path / "remote.bin")
+            with open(src, "rb") as f:
+                n = push_file(dc.api.url, task_id, token, remote, f.fileno())
+            assert n == len(payload)
+            assert open(remote, "rb").read() == payload
+
+            back = tmp_path / "down.bin"
+            with open(back, "wb") as f:
+                n = fetch_file(dc.api.url, task_id, token, remote, f.fileno())
+            assert n == len(payload)
+            assert back.read_bytes() == payload
+
+            with pytest.raises(ShellError, match="No such file"):
+                with open(back, "wb") as f:
+                    fetch_file(dc.api.url, task_id, token,
+                               str(tmp_path / "missing.bin"), f.fileno())
+
             dc.master.kill_command(task_id)
