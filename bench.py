@@ -178,9 +178,9 @@ def neox_class_mfu(dev, on_tpu: bool):
                 vocab_size=vocab, n_layers=n_layers, n_heads=64,
                 d_model=d_model, d_ff=d_ff, seq_len=seq, remat=True,
             )
-            # batch 4 measured +6pt MFU over 2 on v5e (61.8% vs 55.7%);
-            # 8 OOMs at one layer.
-            mfu, _ = _measure_mfu(cfg, batch_size=4, inner=4, rounds=2, dev=dev)
+            # v5e batch sweep at one layer: b2 55.7 / b4 61.8-63.6 /
+            # b5 65.4 / b6 67.5 / b7 63.1 / b8 OOM — 6 is the knee.
+            mfu, _ = _measure_mfu(cfg, batch_size=6, inner=4, rounds=2, dev=dev)
         else:
             cfg = GPTConfig(
                 vocab_size=512, n_layers=1, n_heads=8, d_model=256,
