@@ -44,6 +44,56 @@ def auth_login(args: argparse.Namespace) -> None:
     print(f"export DTPU_TOKEN={resp['token']}")
 
 
+def auth_change_password(args: argparse.Namespace) -> None:
+    """Own-account password change (ref: det user change-password)."""
+    import getpass
+
+    password = args.password or getpass.getpass("new password: ")
+    _session(args).post(
+        "/api/v1/auth/password", json_body={"password": password}
+    )
+    print("password changed")
+
+
+# -- users (ref: cli/user.py create/activate/deactivate/change-password) ------
+def user_list(args: argparse.Namespace) -> None:
+    users = _session(args).get("/api/v1/users")["users"]
+    _table(users, ["username", "role", "effective_role", "active"])
+
+
+def user_create(args: argparse.Namespace) -> None:
+    import getpass
+
+    password = args.password or getpass.getpass("password: ")
+    _session(args).post(
+        "/api/v1/users",
+        json_body={"username": args.username, "password": password,
+                   "role": args.role},
+    )
+    print(f"created user {args.username} ({args.role})")
+
+
+def user_set_password(args: argparse.Namespace) -> None:
+    import getpass
+
+    password = args.password or getpass.getpass("new password: ")
+    _session(args).post(
+        f"/api/v1/users/{args.username}/password",
+        json_body={"password": password},
+    )
+    print(f"password reset for {args.username}")
+
+
+def user_set_active(active: bool):
+    def fn(args: argparse.Namespace) -> None:
+        _session(args).patch(
+            f"/api/v1/users/{args.username}", json_body={"active": active}
+        )
+        print(f"user {args.username}: "
+              f"{'activated' if active else 'deactivated'}")
+    return fn
+
+
 def _load_config(path: str) -> Dict[str, Any]:
     with open(path) as f:
         text = f.read()
@@ -733,11 +783,35 @@ def build_parser() -> argparse.ArgumentParser:
                    help="auth token (or DTPU_TOKEN env)")
     sub = p.add_subparsers(dest="noun", required=True)
 
+    user = sub.add_parser("user", aliases=["u"]).add_subparsers(
+        dest="verb", required=True
+    )
+    user.add_parser("list").set_defaults(fn=user_list)
+    v = user.add_parser("create")
+    v.add_argument("username")
+    v.add_argument("--role", default="editor",
+                   choices=["viewer", "editor", "admin"])
+    v.add_argument("--password", default=None)
+    v.set_defaults(fn=user_create)
+    v = user.add_parser("change-password")
+    v.add_argument("username")
+    v.add_argument("--password", default=None)
+    v.set_defaults(fn=user_set_password)
+    v = user.add_parser("activate")
+    v.add_argument("username")
+    v.set_defaults(fn=user_set_active(True))
+    v = user.add_parser("deactivate")
+    v.add_argument("username")
+    v.set_defaults(fn=user_set_active(False))
+
     auth = sub.add_parser("auth").add_subparsers(dest="verb", required=True)
     v = auth.add_parser("login")
     v.add_argument("username")
     v.add_argument("--password", default=None)
     v.set_defaults(fn=auth_login)
+    v = auth.add_parser("change-password")
+    v.add_argument("--password", default=None)
+    v.set_defaults(fn=auth_change_password)
 
     exp = sub.add_parser("experiment", aliases=["e"]).add_subparsers(
         dest="verb", required=True)

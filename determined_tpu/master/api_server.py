@@ -97,7 +97,10 @@ def user_allowed(role: str, method: str, path: str) -> bool:
     users to capabilities, and the user list is reconnaissance."""
     if ADMIN_ROUTES.match(path):
         return role == "admin"
-    if method == "GET" or path == "/api/v1/auth/logout":
+    if method == "GET" or path in (
+        "/api/v1/auth/logout",
+        "/api/v1/auth/password",  # own-account change; handler re-checks
+    ):
         return True  # viewer floor
     if path == "/api/v1/agents":
         return role == "admin"  # user-driven capacity changes
@@ -886,11 +889,61 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
 
     def list_users(r: ApiRequest):
         state = m.auth.rbac_state()
+        known = m.auth.known_users()
         return {"users": [
             {"username": u, "role": role,
-             "effective_role": m.auth.effective_role(u)}
+             "effective_role": m.auth.effective_role(u),
+             "active": known.get(u, {}).get("active", True)}
             for u, role in sorted(state["roles"].items())
         ]}
+
+    def create_user(r: ApiRequest):
+        """PostUser (ref: api_user.go PostUser): runtime user creation,
+        admin-only via the /users route class."""
+        try:
+            m.auth.create_user(
+                str(r.body.get("username", "")),
+                str(r.body.get("password", "")),
+                str(r.body.get("role", "editor")),
+            )
+        except ValueError as e:
+            raise ApiError(400, str(e))
+        return {"username": r.body.get("username", "")}
+
+    def set_user_password(r: ApiRequest):
+        """Admin password reset (ref: SetUserPassword). Self-service lives
+        at /api/v1/auth/password (this whole route class is admin)."""
+        try:
+            m.auth.set_password(r.groups[0], str(r.body.get("password", "")))
+        except KeyError as e:
+            raise ApiError(404, str(e))
+        except ValueError as e:
+            raise ApiError(400, str(e))
+        return {}
+
+    def change_own_password(r: ApiRequest):
+        """Self-service password change: any authenticated user, own
+        account only (so it rides outside the admin /users class)."""
+        who = m.auth.validate(r.token) or ""
+        if not who or who == "anonymous" or ":" in who:
+            raise ApiError(403, "a logged-in user session is required")
+        try:
+            m.auth.set_password(who, str(r.body.get("password", "")))
+        except (KeyError, ValueError) as e:
+            raise ApiError(400, str(e))
+        return {}
+
+    def patch_user(r: ApiRequest):
+        """PatchUser activate/deactivate (ref: api_user.go PatchUser)."""
+        if "active" not in r.body:
+            raise ApiError(400, "body must carry {'active': bool}")
+        try:
+            m.auth.set_active(r.groups[0], bool(r.body["active"]))
+        except KeyError as e:
+            raise ApiError(404, str(e))
+        except ValueError as e:  # last-admin lockout guard
+            raise ApiError(400, str(e))
+        return {"active": bool(r.body["active"])}
 
     def set_user_role(r: ApiRequest):
         try:
@@ -1045,6 +1098,10 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("POST", r"/api/v1/experiments/(\d+)/searcher/operations", post_searcher_ops),
         R("GET", r"/api/v1/master", master_info),
         R("GET", r"/api/v1/users", list_users),
+        R("POST", r"/api/v1/users", create_user),
+        R("POST", r"/api/v1/users/([\w.@+\-]+)/password", set_user_password),
+        R("PATCH", r"/api/v1/users/([\w.@+\-]+)", patch_user),
+        R("POST", r"/api/v1/auth/password", change_own_password),
         R("POST", r"/api/v1/users/([\w.@+\-]+)/role", set_user_role),
         R("GET", r"/api/v1/groups", list_groups),
         R("POST", r"/api/v1/groups", upsert_group),

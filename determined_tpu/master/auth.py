@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import logging
 import re
 import secrets
 import threading
@@ -67,6 +68,11 @@ class AuthService:
             self._users[name] = _hash(password, self._salt)
             self._roles[name] = role
         self._groups: Dict[str, Dict[str, Any]] = {}  # name -> {role, members}
+        # Users created at runtime (ref: PostUser api_user.go). Config users
+        # hash with the per-boot salt above; dynamic users must survive
+        # restarts, so each carries its own persisted salt.
+        self._dynamic: Dict[str, Dict[str, str]] = {}  # name -> {salt, hash}
+        self._inactive: set = set()          # deactivated usernames
         self._tokens: Dict[str, Dict] = {}   # token -> {user, expires}
         self._ttl = session_ttl_s
         self._lock = threading.Lock()
@@ -77,6 +83,9 @@ class AuthService:
         #: keep authenticating (the reference keeps user_sessions in
         #: Postgres for the same reason).
         self.on_change: Optional[Any] = None
+        #: fired when runtime user mutations (create/password/active) need
+        #: persisting (kv "users" — the reference's users table).
+        self.on_users_change: Optional[Any] = None
 
     # -- RBAC --------------------------------------------------------------
     def effective_role(self, username: str) -> str:
@@ -99,19 +108,26 @@ class AuthService:
                 best = g["role"]
         return best
 
-    def _require_admin_after(self, roles=None, groups=None) -> None:
+    def _require_admin_after(
+        self, roles=None, groups=None, inactive=None
+    ) -> None:
         """Reject a mutation that would take the cluster from having an
-        EFFECTIVE admin (assigned or via group) to having none — a
-        persistent lockout of every admin route with no API recovery path.
-        Clusters configured without any admin in the first place are left
-        alone. Called with the hypothetical post-mutation state, under the
-        lock."""
+        EFFECTIVE admin (assigned or via group, on an ACTIVE account —
+        config or dynamic) to having none — a persistent lockout of every
+        admin route with no API recovery path. Clusters configured without
+        any admin in the first place are left alone. Called with the
+        hypothetical post-mutation state, under the lock."""
+        everyone = set(self._users) | set(self._dynamic)
+        inactive_now = self._inactive if inactive is None else inactive
         had = any(
-            self._effective_role_locked(u) == "admin" for u in self._users
+            self._effective_role_locked(u) == "admin"
+            for u in everyone
+            if u not in self._inactive
         )
         has = any(
             self._effective_role_locked(u, roles=roles, groups=groups) == "admin"
-            for u in self._users
+            for u in everyone
+            if u not in inactive_now
         )
         if had and not has:
             raise ValueError(
@@ -122,7 +138,7 @@ class AuthService:
     def set_user_role(self, username: str, role: str) -> None:
         if role not in _ROLE_RANK:
             raise ValueError(f"unknown role {role!r}")
-        if username not in self._users:
+        if username not in self._users and username not in self._dynamic:
             raise KeyError(f"unknown user {username!r}")
         with self._lock:
             new_roles = {**self._roles, username: role}
@@ -192,7 +208,9 @@ class AuthService:
             return
         with self._lock:
             for user, role in state.get("roles", {}).items():
-                if user in self._users and role in _ROLE_RANK:
+                # dynamic users count too — callers load user state first
+                known = user in self._users or user in self._dynamic
+                if known and role in _ROLE_RANK:
                     self._roles[user] = role
             for name, g in state.get("groups", {}).items():
                 role = g.get("role", "viewer")
@@ -206,9 +224,166 @@ class AuthService:
                     "members": set(g.get("members", [])),
                 }
 
-    def login(self, username: str, password: str) -> Optional[str]:
+    # -- user management (ref: api_user.go PostUser/SetUserPassword/
+    # PatchUser activate) ----------------------------------------------------
+    #: must mirror the /api/v1/users/<name> route character class
+    #: (api_server.py) — see create_user for why.
+    _USER_RE = re.compile(r"^[\w.@+\-]+$")
+
+    def create_user(
+        self, username: str, password: str, role: str = "editor"
+    ) -> None:
+        if not self.enabled:
+            raise ValueError(
+                "auth is disabled (no users in master config); runtime "
+                "users need an authenticated cluster"
+            )
+        if not username:
+            raise ValueError("username required")
+        # Same character class as the /users/<name> routes, for two load-
+        # bearing reasons: (1) a name the routes can't match could never be
+        # deactivated/reset/demoted via the API — a permanently
+        # unmanageable account; (2) ':' is excluded, so a user can never
+        # collide with the 'task:'/'agent:' machine-principal namespaces,
+        # which bypass user RBAC entirely in principal_allowed.
+        if not self._USER_RE.match(username):
+            raise ValueError(
+                "username must match [A-Za-z0-9_.@+-]+ (route-addressable, "
+                "no principal-namespace characters)"
+            )
+        if role not in _ROLE_RANK:
+            raise ValueError(f"unknown role {role!r}")
+        if not password:
+            raise ValueError("password must not be empty")
+        with self._lock:
+            if username in self._users or username in self._dynamic:
+                raise ValueError(f"user {username!r} already exists")
+            salt = secrets.token_hex(8)
+            self._dynamic[username] = {
+                "salt": salt, "hash": _hash(password, salt),
+            }
+            self._roles[username] = role
+        self._users_changed()
+
+    def set_password(self, username: str, new_password: str) -> None:
+        if not new_password:
+            raise ValueError("password must not be empty")
+        with self._lock:
+            if username not in self._users and username not in self._dynamic:
+                raise KeyError(f"no such user {username!r}")
+            # Config users move to the dynamic store on password change:
+            # the new credential must outlive both the process salt and
+            # the masterconf value (which keeps losing to this override).
+            salt = secrets.token_hex(8)
+            self._dynamic[username] = {
+                "salt": salt, "hash": _hash(new_password, salt),
+            }
+            self._users.pop(username, None)
+            # Revoke every live session for the account (the current one
+            # included — callers re-login): the common reason to change a
+            # password is a compromised credential, and a reset that left
+            # the attacker's bearer token validating for the rest of its
+            # TTL would be cosmetic.
+            for tok in [
+                t for t, e in self._tokens.items()
+                if e.get("user") == username
+            ]:
+                del self._tokens[tok]
+        self._users_changed()
+        self._changed()
+
+    def set_active(self, username: str, active: bool) -> None:
+        with self._lock:
+            if username not in self._users and username not in self._dynamic:
+                raise KeyError(f"no such user {username!r}")
+            if active:
+                self._inactive.discard(username)
+            else:
+                if username in self._inactive:
+                    return
+                # Deactivating the only effective admin is the same
+                # lockout as demoting them.
+                self._require_admin_after(
+                    inactive=self._inactive | {username}
+                )
+                self._inactive.add(username)
+                # A deactivated account must lose access NOW, not at its
+                # sessions' expiry (ref: user deactivation invalidates
+                # sessions).
+                for tok in [
+                    t for t, e in self._tokens.items()
+                    if e.get("user") == username
+                ]:
+                    del self._tokens[tok]
+        self._users_changed()
+        self._changed()
+
+    def known_users(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            names = set(self._users) | set(self._dynamic)
+            return {
+                n: {
+                    "active": n not in self._inactive,
+                    "dynamic": n in self._dynamic,
+                }
+                for n in sorted(names)
+            }
+
+    def user_state(self) -> Dict[str, Any]:
+        """Persistable snapshot of runtime user mutations (dynamic users'
+        salted hashes + the inactive set); config users stay in
+        masterconf."""
+        with self._lock:
+            return {
+                "dynamic": {n: dict(d) for n, d in self._dynamic.items()},
+                "inactive": sorted(self._inactive),
+                "dynamic_roles": {
+                    n: self._roles.get(n, "editor") for n in self._dynamic
+                },
+            }
+
+    def load_user_state(self, state: Optional[Dict[str, Any]]) -> None:
+        if not state:
+            return
+        with self._lock:
+            for name, d in (state.get("dynamic") or {}).items():
+                if isinstance(d, dict) and d.get("salt") and d.get("hash"):
+                    self._dynamic[name] = {
+                        "salt": str(d["salt"]), "hash": str(d["hash"]),
+                    }
+            for name in state.get("inactive") or []:
+                self._inactive.add(str(name))
+            for name, role in (state.get("dynamic_roles") or {}).items():
+                if name in self._dynamic and role in _ROLE_RANK:
+                    self._roles.setdefault(name, role)
+
+    def _users_changed(self) -> None:
+        cb = getattr(self, "on_users_change", None)
+        if cb is None:
+            return
+        with self._persist_lock:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 - keep serving; but a silent
+                # drop would make a vanished user/resurrected password
+                # after restart undiagnosable.
+                logging.exception("failed to persist user store")
+
+    def _verify_password(self, username: str, password: str) -> bool:
+        dyn = self._dynamic.get(username)
+        if dyn is not None:
+            return hmac.compare_digest(
+                dyn["hash"], _hash(password, dyn["salt"])
+            )
         want = self._users.get(username)
-        if want is None or not hmac.compare_digest(want, _hash(password, self._salt)):
+        return want is not None and hmac.compare_digest(
+            want, _hash(password, self._salt)
+        )
+
+    def login(self, username: str, password: str) -> Optional[str]:
+        if username in self._inactive:
+            return None
+        if not self._verify_password(username, password):
             return None
         token = secrets.token_urlsafe(24)
         with self._lock:
@@ -263,7 +438,13 @@ class AuthService:
             if time.time() > entry["expires"]:
                 del self._tokens[token]
                 return None
-            return entry["user"]
+            user = entry["user"]
+            if user in self._inactive:
+                # Deactivation revokes sessions; this guards tokens that
+                # slipped in via persisted state written before the revoke.
+                del self._tokens[token]
+                return None
+            return user
 
     def logout(self, token: str) -> None:
         with self._lock:

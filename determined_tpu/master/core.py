@@ -302,6 +302,14 @@ class Master:
 
             self.log_sink = ElasticLogSink(log_sink_url)
         self.auth = AuthService(users)
+        # Runtime user mutations (create / password change / deactivate)
+        # persist like the reference's users table. Loaded BEFORE rbac
+        # state: role overrides on dynamic users only stick for known
+        # accounts.
+        self.auth.load_user_state(self.db.get_kv("users"))
+        self.auth.on_users_change = lambda: self.db.set_kv(
+            "users", self.auth.user_state()
+        )
         # Role overrides + groups persist across master restarts (the
         # reference's usergroup tables; here the kv store).
         self.auth.load_rbac_state(self.db.get_kv("rbac"))

@@ -464,6 +464,17 @@ async function setRole(i) {
   refresh();
 }
 
+async function setActive(i, active) {
+  const name = adminUsers[i];
+  const headers = {'Content-Type': 'application/json'};
+  const tok = localStorage.getItem('dtpu_token');
+  if (tok) headers['Authorization'] = 'Bearer ' + tok;
+  await fetch(`/api/v1/users/${encodeURIComponent(name)}`, {
+    method: 'PATCH', headers, body: JSON.stringify({active}),
+  });
+  refresh();
+}
+
 let adminTick = 0, adminDisabled = false;
 async function refreshAdmin() {
   // Admin data is best-effort: non-admin principals get 403s here and the
@@ -479,13 +490,17 @@ async function refreshAdmin() {
     if (usersR.error) { adminDisabled = true; return; }
     const users = usersR.users || [];
     adminUsers = users.map(u => u.username);
-    $('users').innerHTML = '<tr><th>user</th><th>role</th><th>set</th></tr>' +
+    $('users').innerHTML =
+      '<tr><th>user</th><th>role</th><th>active</th><th>set</th></tr>' +
       users.map((u, i) =>
         `<tr>${cell(u.username)}${cell(u.role)}` +
+        cell(u.active === false ? 'no' : 'yes') +
         `<td><select id="role-${i}">` +
         ['viewer', 'editor', 'admin'].map(ro =>
           `<option${ro === u.role ? ' selected' : ''}>${ro}</option>`).join('') +
-        `</select> <button onclick="setRole(${i})">apply</button></td></tr>`
+        `</select> <button onclick="setRole(${i})">apply</button> ` +
+        `<button onclick="setActive(${i}, ${u.active === false})">` +
+        `${u.active === false ? 'activate' : 'deactivate'}</button></td></tr>`
       ).join('');
     const groups = groupsR.groups || {};
     $('groups').innerHTML = '<tr><th>group</th><th>role</th><th>members</th></tr>' +

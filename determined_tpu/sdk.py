@@ -283,6 +283,30 @@ class Determined:
     def master_info(self) -> Dict[str, Any]:
         return self._session.get("/api/v1/master")
 
+    # -- users (ref client.py create_user / Determined.get_users) ------------
+    def list_users(self) -> List[Dict[str, Any]]:
+        return self._session.get("/api/v1/users")["users"]
+
+    def create_user(
+        self, username: str, password: str, role: str = "editor"
+    ) -> None:
+        self._session.post(
+            "/api/v1/users",
+            json_body={"username": username, "password": password,
+                       "role": role},
+        )
+
+    def set_user_active(self, username: str, active: bool) -> None:
+        self._session.patch(
+            f"/api/v1/users/{username}", json_body={"active": active}
+        )
+
+    def change_password(self, password: str) -> None:
+        """Own-account password change for the logged-in session."""
+        self._session.post(
+            "/api/v1/auth/password", json_body={"password": password}
+        )
+
     # -- model registry ------------------------------------------------------
     def create_model(
         self, name: str, description: str = "", metadata: Optional[Dict[str, Any]] = None

@@ -252,3 +252,156 @@ class TestGroups:
             assert m2.auth.effective_role("eve") == "viewer"  # override kept
         finally:
             m2.shutdown()
+
+
+class TestUserManagement:
+    """Runtime users: create / password change / deactivate + persistence
+    (ref: api_user.go PostUser, SetUserPassword, PatchUser)."""
+
+    def test_admin_creates_user_who_can_login(self, secured):
+        master, api = secured
+        root = _login(api.url, "root", "rootpw")
+        requests.post(
+            f"{api.url}/api/v1/users",
+            json={"username": "nia", "password": "niapw", "role": "viewer"},
+            headers=root, timeout=10,
+        ).raise_for_status()
+        nia = _login(api.url, "nia", "niapw")
+        r = requests.get(f"{api.url}/api/v1/experiments",
+                         headers=nia, timeout=10)
+        assert r.status_code == 200
+        # duplicate name and non-admin creation both refused
+        assert requests.post(
+            f"{api.url}/api/v1/users",
+            json={"username": "nia", "password": "x"},
+            headers=root, timeout=10,
+        ).status_code == 400
+        assert requests.post(
+            f"{api.url}/api/v1/users",
+            json={"username": "mal", "password": "x"},
+            headers=nia, timeout=10,
+        ).status_code == 403
+        users = requests.get(f"{api.url}/api/v1/users",
+                             headers=root, timeout=10).json()["users"]
+        row = next(u for u in users if u["username"] == "nia")
+        assert row["role"] == "viewer" and row["active"] is True
+
+    def test_machine_namespace_usernames_refused(self, secured):
+        """A user named 'agent:x' or 'task:y' would be classified as a
+        machine principal by principal_allowed and skip user RBAC — the
+        username charset forbids ':' (and anything the /users/<name>
+        routes can't address)."""
+        master, api = secured
+        root = _login(api.url, "root", "rootpw")
+        for bad in ("agent:build1", "task:trial-5", "a/b", "", "x y"):
+            r = requests.post(
+                f"{api.url}/api/v1/users",
+                json={"username": bad, "password": "pw"},
+                headers=root, timeout=10,
+            )
+            assert r.status_code == 400, bad
+
+    def test_own_password_change_any_role(self, secured):
+        master, api = secured
+        vic = _login(api.url, "vic", "vicpw")  # viewer
+        requests.post(
+            f"{api.url}/api/v1/auth/password",
+            json={"password": "vicnew"}, headers=vic, timeout=10,
+        ).raise_for_status()
+        with pytest.raises(requests.HTTPError):
+            _login(api.url, "vic", "vicpw")  # old credential dead
+        # ALL pre-change sessions are revoked (compromised-credential
+        # reset must not leave the attacker's token validating).
+        assert requests.get(
+            f"{api.url}/api/v1/experiments", headers=vic, timeout=10,
+        ).status_code == 401
+        _login(api.url, "vic", "vicnew")
+
+    def test_admin_reset_and_deactivate(self, secured):
+        master, api = secured
+        root = _login(api.url, "root", "rootpw")
+        eve = _login(api.url, "eve", "evepw")
+        requests.post(
+            f"{api.url}/api/v1/users/eve/password",
+            json={"password": "evereset"}, headers=root, timeout=10,
+        ).raise_for_status()
+        # the admin reset revoked eve's pre-reset session too
+        assert requests.get(
+            f"{api.url}/api/v1/experiments", headers=eve, timeout=10,
+        ).status_code == 401
+        eve = _login(api.url, "eve", "evereset")
+        # editors cannot reset others
+        assert requests.post(
+            f"{api.url}/api/v1/users/vic/password",
+            json={"password": "x"}, headers=eve, timeout=10,
+        ).status_code == 403
+        requests.patch(
+            f"{api.url}/api/v1/users/eve", json={"active": False},
+            headers=root, timeout=10,
+        ).raise_for_status()
+        # login refused AND the pre-deactivation session is dead
+        with pytest.raises(requests.HTTPError):
+            _login(api.url, "eve", "evereset")
+        assert requests.get(
+            f"{api.url}/api/v1/experiments", headers=eve, timeout=10,
+        ).status_code == 401
+        requests.patch(
+            f"{api.url}/api/v1/users/eve", json={"active": True},
+            headers=root, timeout=10,
+        ).raise_for_status()
+        _login(api.url, "eve", "evereset")
+
+    def test_deactivating_last_admin_refused(self, secured):
+        master, api = secured
+        root = _login(api.url, "root", "rootpw")
+        r = requests.patch(
+            f"{api.url}/api/v1/users/root", json={"active": False},
+            headers=root, timeout=10,
+        )
+        assert r.status_code == 400
+        assert "admin" in r.json()["error"]
+        # another admin makes it legal
+        requests.post(
+            f"{api.url}/api/v1/users",
+            json={"username": "ada", "password": "adapw", "role": "admin"},
+            headers=root, timeout=10,
+        ).raise_for_status()
+        requests.patch(
+            f"{api.url}/api/v1/users/root", json={"active": False},
+            headers=root, timeout=10,
+        ).raise_for_status()
+        _login(api.url, "ada", "adapw")
+
+    def test_user_mutations_persist_across_restart(self, secured):
+        master, api = secured
+        root = _login(api.url, "root", "rootpw")
+        requests.post(
+            f"{api.url}/api/v1/users",
+            json={"username": "nia", "password": "niapw", "role": "editor"},
+            headers=root, timeout=10,
+        ).raise_for_status()
+        requests.post(
+            f"{api.url}/api/v1/users/vic/password",
+            json={"password": "vicreset"}, headers=root, timeout=10,
+        ).raise_for_status()
+        requests.patch(
+            f"{api.url}/api/v1/users/eve", json={"active": False},
+            headers=root, timeout=10,
+        ).raise_for_status()
+        requests.post(
+            f"{api.url}/api/v1/users/nia/role",
+            json={"role": "admin"}, headers=root, timeout=10,
+        ).raise_for_status()
+        db_path = master.db._path
+        api.stop()
+        master.shutdown()
+        m2 = Master(db_path=db_path, users=USERS)
+        try:
+            assert m2.auth.login("nia", "niapw")       # dynamic user kept
+            # post-create role change on a DYNAMIC user survives restart
+            assert m2.auth.effective_role("nia") == "admin"
+            assert m2.auth.login("vic", "vicreset")    # reset beats config
+            assert m2.auth.login("vic", "vicpw") is None
+            assert m2.auth.login("eve", "evepw") is None  # still inactive
+        finally:
+            m2.shutdown()
