@@ -203,6 +203,21 @@ def exp_set_meta(field: str):
     return fn
 
 
+def exp_move(args: argparse.Namespace) -> None:
+    """`dtpu e move <id> <project_id>` (ref: det experiment move)."""
+    _session(args).post(
+        f"/api/v1/experiments/{args.experiment_id}/move",
+        json_body={"project_id": args.project_id},
+    )
+    print(f"experiment {args.experiment_id} -> project {args.project_id}")
+
+
+def trial_kill(args: argparse.Namespace) -> None:
+    resp = _session(args).post(f"/api/v1/trials/{args.trial_id}/kill")
+    print(f"trial {args.trial_id}: "
+          f"{'killed' if resp['killed'] else 'already finished'}")
+
+
 def exp_label(args: argparse.Namespace) -> None:
     """`dtpu e label add|remove <id> <label>` (ref cli/experiment.py
     experiment label add/remove)."""
@@ -843,6 +858,10 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("experiment_id", type=int)
     v.add_argument("label")
     v.set_defaults(fn=exp_label)
+    v = exp.add_parser("move")
+    v.add_argument("experiment_id", type=int)
+    v.add_argument("project_id", type=int)
+    v.set_defaults(fn=exp_move)
     for verb, fn in [
         ("describe", exp_describe), ("wait", lambda a: exp_wait(a)),
         ("pause", _exp_action("pause")), ("activate", _exp_action("activate")),
@@ -888,6 +907,9 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("trial_id", type=int)
     v.add_argument("--group", default=None)
     v.set_defaults(fn=trial_metrics)
+    v = trial.add_parser("kill")
+    v.add_argument("trial_id", type=int)
+    v.set_defaults(fn=trial_kill)
 
     ckpt = sub.add_parser("checkpoint", aliases=["c"]).add_subparsers(
         dest="verb", required=True)

@@ -573,6 +573,37 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
             ),
         }
 
+    def exp_move(r: ApiRequest):
+        """MoveExperiment (ref: api_experiment.go MoveExperiment): re-home
+        an experiment under another project."""
+        exp_id = int(r.groups[0])
+        if m.db.get_experiment(exp_id) is None:
+            raise ApiError(404, "no such experiment")
+        try:
+            project_id = int(r.body["project_id"])
+        except (KeyError, TypeError, ValueError):
+            raise ApiError(400, "body must carry integer project_id")
+        if not any(p["id"] == project_id for p in m.db.list_projects()):
+            raise ApiError(404, f"no such project {project_id}")
+        m.db.set_experiment_project(exp_id, project_id)
+        return {"project_id": project_id}
+
+    def trial_kill(r: ApiRequest):
+        """KillTrial (ref: api_trials.go KillTrial): stop one trial; the
+        experiment's other trials keep running."""
+        trial_id = int(r.groups[0])
+        row = m.db.get_trial(trial_id)
+        if row is None:
+            raise ApiError(404, "no such trial")
+        exp = m.get_experiment(int(row["experiment_id"]))
+        if exp is None:
+            # experiment already terminal: the trial can't be running
+            return {"killed": False}
+        try:
+            return {"killed": exp.kill_trial(trial_id)}
+        except KeyError as e:
+            raise ApiError(404, str(e))
+
     def exp_patch(r: ApiRequest):
         """PatchExperiment (ref: api_experiment.go PatchExperiment,
         experiment.proto PatchExperiment): partial update of
@@ -1092,6 +1123,8 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("POST", r"/api/v1/experiments/(\d+)/(archive|unarchive)", exp_archive),
         R("POST", r"/api/v1/experiments/(\d+)/fork", exp_fork),
         R("POST", r"/api/v1/experiments/(\d+)/continue", exp_continue),
+        R("POST", r"/api/v1/experiments/(\d+)/move", exp_move),
+        R("POST", r"/api/v1/trials/(\d+)/kill", trial_kill),
         R("GET", r"/api/v1/resource-pools", list_resource_pools),
         R("GET", r"/api/v1/experiments/(\d+)/trials", list_trials),
         R("GET", r"/api/v1/experiments/(\d+)/searcher/events", searcher_events),

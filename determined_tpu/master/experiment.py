@@ -419,6 +419,46 @@ class Experiment:
                 )
             self._snapshot()
 
+    def kill_trial(self, trial_id: int) -> bool:
+        """User-initiated kill of ONE trial (ref: api_trials.go KillTrial):
+        the rest of the search keeps running. The record is marked exited
+        FIRST so the allocation's later exit report is a no-op
+        (trial_exited returns on rec.exited), then the processes are
+        killed; the searcher sees an early exit so rung/bracket logic
+        proceeds without the trial. Returns False if already exited."""
+        with self._cond:
+            rec = self.trials.get(trial_id)
+            if rec is None:
+                raise KeyError(f"no trial {trial_id} in experiment {self.id}")
+            if rec.exited:
+                return False
+            rec.exited = True
+            rec.close_requested = True
+            rec.state = db_mod.CANCELED
+            self.db.update_trial(trial_id, state=db_mod.CANCELED)
+            # _process_ops finishes with _maybe_finish + notify_all.
+            self._process_ops(
+                self.searcher.trial_exited_early(
+                    rec.request_id, "killed by user"
+                )
+            )
+            self._snapshot()
+            if self._cancel_requested and all(
+                r.exited for r in self.trials.values()
+            ):
+                # The cancel-drain completion normally lives in
+                # trial_exited's _cancel_requested branch — but that
+                # handler no-ops for this trial (rec.exited already set),
+                # so killing the LAST live trial of a cancelling
+                # experiment must finish the cancel here or the
+                # experiment hangs in STOPPING with no exit left to
+                # drive it.
+                self.state = db_mod.CANCELED
+                self._announce_state()
+                self._cond.notify_all()
+        self.launcher.kill(trial_id)
+        return True
+
     # -- user controls (ref: api_experiment.go activate/pause/cancel/kill) -----
     def pause(self) -> None:
         with self._cond:

@@ -44,6 +44,13 @@ class Trial:
     def hparams(self) -> Dict[str, Any]:
         return self._data["hparams"]
 
+    def kill(self) -> bool:
+        """Stop this one trial; the experiment keeps searching (ref:
+        KillTrial)."""
+        return bool(
+            self._session.post(f"/api/v1/trials/{self.id}/kill")["killed"]
+        )
+
     def metrics(self, group: Optional[str] = None) -> List[Dict[str, Any]]:
         return self._session.get(
             f"/api/v1/trials/{self.id}/metrics",
@@ -159,6 +166,13 @@ class Experiment:
 
     def kill(self) -> None:
         self._session.post(f"/api/v1/experiments/{self.id}/kill")
+
+    def move(self, project_id: int) -> None:
+        """Re-home under another project (ref: MoveExperiment)."""
+        self._session.post(
+            f"/api/v1/experiments/{self.id}/move",
+            json_body={"project_id": project_id},
+        )
 
     # -- metadata (ref client.py Experiment set_description/labels) ----------
     def patch(self, **fields: Any) -> Dict[str, Any]:

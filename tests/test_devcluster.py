@@ -89,6 +89,68 @@ class TestDevClusterE2E:
         # trial still finished its full length.
         assert all(t["steps_completed"] == 2 for t in trials)
 
+    def test_kill_one_trial_search_continues(self, cluster, tmp_path):
+        """Per-trial kill (ref: api_trials.go KillTrial): one long trial is
+        killed mid-run; the others complete and the EXPERIMENT completes."""
+        import requests as rq
+
+        cfg = _config(
+            tmp_path,
+            searcher={
+                "name": "grid", "metric": "loss",
+                "max_length": 40,  # long enough to catch RUNNING
+            },
+            hyperparameters={
+                "model": "mnist-mlp", "batch_size": 16,
+                "lr": {"type": "categorical", "vals": [1e-3, 2e-3]},
+            },
+        )
+        exp_id = cluster.create_experiment(cfg)
+        # wait for a running trial
+        victim = None
+        deadline = time.time() + 120
+        while time.time() < deadline and victim is None:
+            for t in cluster.master.db.list_trials(exp_id):
+                # ACTIVE + some progress = actually executing
+                if t["state"] == "ACTIVE" and t["steps_completed"] > 0:
+                    victim = t["id"]
+                    break
+            time.sleep(0.3)
+        assert victim is not None, "no trial started executing"
+        r = rq.post(
+            f"{cluster.api.url}/api/v1/trials/{victim}/kill", timeout=10
+        )
+        r.raise_for_status()
+        assert r.json()["killed"] is True
+        state = cluster.wait_experiment(exp_id, timeout=300)
+        trials = {t["id"]: t for t in cluster.master.db.list_trials(exp_id)}
+        assert trials[victim]["state"] == "CANCELED"
+        others = [t for tid, t in trials.items() if tid != victim]
+        assert others and all(t["state"] == "COMPLETED" for t in others)
+        assert state == "COMPLETED"
+        # idempotent: a second kill reports already-finished
+        r = rq.post(
+            f"{cluster.api.url}/api/v1/trials/{victim}/kill", timeout=10
+        )
+        assert r.json()["killed"] is False
+
+    def test_experiment_move_between_projects(self, cluster, tmp_path):
+        import requests as rq
+
+        wid = cluster.master.db.add_workspace("w-move")
+        pid = cluster.master.db.add_project("p-move", wid)
+        exp_id = cluster.create_experiment(_config(tmp_path))
+        cluster.wait_experiment(exp_id, timeout=180)
+        rq.post(
+            f"{cluster.api.url}/api/v1/experiments/{exp_id}/move",
+            json={"project_id": pid}, timeout=10,
+        ).raise_for_status()
+        assert cluster.master.db.get_experiment(exp_id)["project_id"] == pid
+        assert rq.post(
+            f"{cluster.api.url}/api/v1/experiments/{exp_id}/move",
+            json={"project_id": 10_000}, timeout=10,
+        ).status_code == 404
+
     def test_agent_failure_fails_over_trial(self, tmp_path):
         # Dedicated cluster: we kill one of its agents mid-trial.
         with DevCluster(n_agents=2, slots_per_agent=1) as dc:

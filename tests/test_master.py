@@ -275,6 +275,44 @@ class TestExperimentFSM:
         assert exp.state == db_mod.COMPLETED
         assert db.get_trial(rec.trial_id)["state"] == db_mod.COMPLETED
 
+    def test_kill_trial_mid_search(self):
+        """Per-trial kill: the victim cancels, siblings and the experiment
+        complete (ref: api_trials.go KillTrial)."""
+        db, launcher, exp = self._make(
+            {"searcher": {"name": "random", "max_trials": 3, "max_length": 5},
+             "hyperparameters": SPACE}
+        )
+        exp.start()
+        victim = launcher.launched[0][1]
+        assert exp.kill_trial(victim.trial_id) is True
+        assert victim.trial_id in launcher.killed
+        assert db.get_trial(victim.trial_id)["state"] == db_mod.CANCELED
+        assert exp.kill_trial(victim.trial_id) is False  # idempotent
+        # the allocation's late exit report is a no-op
+        exp.trial_exited(victim.trial_id, 137, "killed")
+        assert db.get_trial(victim.trial_id)["state"] == db_mod.CANCELED
+        for _, rec in list(launcher.launched)[1:]:
+            _drive_trial(exp, rec)
+        assert exp.state == db_mod.COMPLETED
+
+    def test_kill_last_trial_of_cancelling_experiment(self):
+        """cancel() then kill_trial on the last live trial: the cancel
+        drain must complete (STOPPING -> CANCELED), not hang — the
+        allocation exit that normally finishes it no-ops on rec.exited."""
+        db, launcher, exp = self._make(
+            {"searcher": {"name": "single", "max_length": 10},
+             "hyperparameters": SPACE}
+        )
+        exp.start()
+        rec = launcher.launched[0][1]
+        exp.cancel()
+        assert exp.state == db_mod.STOPPING
+        assert exp.kill_trial(rec.trial_id) is True
+        assert exp.state == db_mod.CANCELED
+        exp.trial_exited(rec.trial_id, 0, "")
+        assert exp.state == db_mod.CANCELED
+        assert exp.wait_done(timeout=5) == db_mod.CANCELED
+
     def test_random_search_all_trials(self):
         db, launcher, exp = self._make(
             {"searcher": {"name": "random", "max_trials": 4, "max_length": 5},
