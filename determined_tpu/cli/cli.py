@@ -685,6 +685,25 @@ def master_info(args: argparse.Namespace) -> None:
     print(json.dumps(_session(args).get("/api/v1/master"), indent=2))
 
 
+def master_logs(args: argparse.Namespace) -> None:
+    """`dtpu master logs [-f]` — the master's own log tail (ref: det
+    master logs / GetMasterLogs)."""
+    session = _session(args)
+    since = 0
+    while True:
+        resp = session.get(
+            "/api/v1/master/logs",
+            params={"limit": str(args.tail), "since_id": str(since)},
+        )
+        for e in resp["logs"]:
+            ts = time.strftime("%H:%M:%S", time.localtime(e["time"]))
+            print(f"{ts} {e['level']:<7} {e['logger']}: {e['message']}")
+            since = max(since, e["id"])
+        if not getattr(args, "follow", False):
+            return
+        time.sleep(2.0)
+
+
 # -- job queue -----------------------------------------------------------------
 def queue_list(args: argparse.Namespace) -> None:
     queues = _session(args).get("/api/v1/queues")["queues"]
@@ -1008,6 +1027,10 @@ def build_parser() -> argparse.ArgumentParser:
     v = master.add_parser("audit")
     v.add_argument("--username", default=None)
     v.set_defaults(fn=master_audit)
+    v = master.add_parser("logs")
+    v.add_argument("-f", "--follow", action="store_true")
+    v.add_argument("-n", "--tail", type=int, default=200)
+    v.set_defaults(fn=master_logs)
 
     deploy = sub.add_parser("deploy").add_subparsers(dest="verb", required=True)
     v = deploy.add_parser("local")

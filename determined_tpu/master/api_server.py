@@ -70,6 +70,7 @@ ADMIN_ROUTES = re.compile(
     r"|^/api/v1/queues/move$"
     r"|^/api/v1/webhooks(/\d+)?$"
     r"|^/api/v1/audit$"            # who-did-what is reconnaissance too
+    r"|^/api/v1/master/logs$"      # master internals likewise
     # Agent control plane: GET /actions destructively drains the agent's
     # action queue (and refreshes its liveness), POST /events forges task
     # exits. Agents authenticate with agent: tokens (class allowlist);
@@ -914,6 +915,16 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
             "agents": m.agent_hub.list(),
         }
 
+    def master_logs(r: ApiRequest):
+        """GetMasterLogs (ref: api_master.go): tail of the master's own
+        log ring; ?since_id= for follow-without-duplicates."""
+        try:
+            limit = min(int(r.q("limit", "200") or 200), 1000)
+            since_id = int(r.q("since_id", "0") or 0)
+        except ValueError:
+            raise ApiError(400, "limit/since_id must be integers")
+        return {"logs": m._log_buffer.tail(limit=limit, since_id=since_id)}
+
     # -- RBAC admin (ref internal/rbac + internal/usergroup) ----------------
     def _persist_rbac():
         m.db.set_kv("rbac", m.auth.rbac_state())
@@ -1130,6 +1141,7 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("GET", r"/api/v1/experiments/(\d+)/searcher/events", searcher_events),
         R("POST", r"/api/v1/experiments/(\d+)/searcher/operations", post_searcher_ops),
         R("GET", r"/api/v1/master", master_info),
+        R("GET", r"/api/v1/master/logs", master_logs),
         R("GET", r"/api/v1/users", list_users),
         R("POST", r"/api/v1/users", create_user),
         R("POST", r"/api/v1/users/([\w.@+\-]+)/password", set_user_password),

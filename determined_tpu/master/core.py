@@ -232,6 +232,69 @@ class RMTrialLauncher:
         self.m.kill_allocation(alloc_id)
 
 
+class _MasterLogBuffer(logging.Handler):
+    """Ring buffer of the master's own log records with stable increasing
+    ids, so clients can follow with ?since_id= and never see duplicates
+    (ref: api_master.go GetMasterLogs follow semantics).
+
+    Process-wide SINGLETON (`get()`): the package's module loggers are
+    process-global, so records can't be attributed to one Master — every
+    co-resident master (devcluster, embedded multi-master) serves the same
+    shared ring, and the handler attaches to the "determined_tpu" logger
+    exactly once (no leak when a Master is never shutdown())."""
+
+    CAPACITY = 2000
+    _instance: Optional["_MasterLogBuffer"] = None
+    _instance_lock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "_MasterLogBuffer":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+                logging.getLogger("determined_tpu").addHandler(cls._instance)
+            return cls._instance
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._buf_lock = threading.Lock()
+        self._entries: List[Dict[str, Any]] = []
+        self._next_id = 1
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:  # noqa: BLE001 - a bad %-format must not recurse
+            msg = str(record.msg)
+        entry = {
+            "id": 0,  # assigned under the lock
+            "time": record.created,
+            "level": record.levelname,
+            "logger": record.name,
+            "message": msg,
+        }
+        with self._buf_lock:
+            entry["id"] = self._next_id
+            self._next_id += 1
+            self._entries.append(entry)
+            if len(self._entries) > self.CAPACITY:
+                del self._entries[: len(self._entries) - self.CAPACITY]
+
+    def tail(
+        self, limit: int = 200, since_id: int = 0
+    ) -> List[Dict[str, Any]]:
+        limit = max(1, limit)
+        with self._buf_lock:
+            if since_id:
+                # Catch-up order: OLDEST first past the cursor, so a
+                # follower polling with since_id drains a burst bigger
+                # than one page across successive polls instead of
+                # skipping it.
+                out = [e for e in self._entries if e["id"] > since_id]
+                return out[:limit]
+            return list(self._entries)[-limit:]
+
+
 class Master:
     def __init__(
         self,
@@ -261,6 +324,11 @@ class Master:
         )
         self.cluster_id = uuid.uuid4().hex[:8]
         self.external_url = external_url
+        # Own-process log capture (ref: api_master.go GetMasterLogs — the
+        # reference tails the master's log store over the API; here the
+        # process-wide ring on the determined_tpu logger tree, served at
+        # /api/v1/master/logs and followed by `dtpu master logs -f`).
+        self._log_buffer = _MasterLogBuffer.get()
         # Cluster-admin experiment-config defaults (the reference's
         # task_container_defaults + cluster-level checkpoint_storage in
         # master.yaml), merged under every submitted config at create time.

@@ -224,9 +224,19 @@ class Experiment:
     def _maybe_finish(self) -> None:
         if self.state not in (db_mod.ACTIVE, db_mod.STOPPING):
             return
-        if not self.searcher.shutdown:
-            return
         if any(not r.exited for r in self.trials.values()):
+            return
+        if self._cancel_requested:
+            # Cancel drain completes here, BEFORE the searcher-shutdown
+            # check (a cancelled search need not have shut down) and
+            # instead of a COMPLETED verdict — else a kill_trial that
+            # drains a cancelling experiment would announce a spurious
+            # COMPLETED first.
+            self.state = db_mod.CANCELED
+            self._announce_state()
+            self._cond.notify_all()
+            return
+        if not self.searcher.shutdown:
             return
         errored = [r for r in self.trials.values() if r.state == db_mod.ERRORED]
         self.state = (
@@ -436,26 +446,16 @@ class Experiment:
             rec.close_requested = True
             rec.state = db_mod.CANCELED
             self.db.update_trial(trial_id, state=db_mod.CANCELED)
-            # _process_ops finishes with _maybe_finish + notify_all.
+            # _process_ops ends with _maybe_finish + notify_all, which
+            # also completes a cancel drain when this was the last live
+            # trial of a cancel()ing experiment (the allocation's exit
+            # report no-ops on rec.exited, so nothing else would).
             self._process_ops(
                 self.searcher.trial_exited_early(
                     rec.request_id, "killed by user"
                 )
             )
             self._snapshot()
-            if self._cancel_requested and all(
-                r.exited for r in self.trials.values()
-            ):
-                # The cancel-drain completion normally lives in
-                # trial_exited's _cancel_requested branch — but that
-                # handler no-ops for this trial (rec.exited already set),
-                # so killing the LAST live trial of a cancelling
-                # experiment must finish the cancel here or the
-                # experiment hangs in STOPPING with no exit left to
-                # drive it.
-                self.state = db_mod.CANCELED
-                self._announce_state()
-                self._cond.notify_all()
         self.launcher.kill(trial_id)
         return True
 
@@ -481,6 +481,13 @@ class Experiment:
             rec.run_id += 1
             self.db.update_trial(rec.trial_id, run_id=rec.run_id)
             self.launcher.launch(self, rec)
+        if not live:
+            # The search may have drained while PAUSED (e.g. kill_trial on
+            # the last live trial): _maybe_finish no-ops outside
+            # ACTIVE/STOPPING, so the completion check must re-run now or
+            # the experiment sits ACTIVE with nothing in flight forever.
+            with self._cond:
+                self._maybe_finish()
 
     def cancel(self) -> None:
         """Graceful stop: preempt everything, mark CANCELED when drained."""

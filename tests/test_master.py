@@ -313,6 +313,22 @@ class TestExperimentFSM:
         assert exp.state == db_mod.CANCELED
         assert exp.wait_done(timeout=5) == db_mod.CANCELED
 
+    def test_kill_last_trial_while_paused_then_activate(self):
+        """kill_trial drains the search while PAUSED; activate() must
+        notice the drain and finish instead of idling ACTIVE forever."""
+        db, launcher, exp = self._make(
+            {"searcher": {"name": "single", "max_length": 10},
+             "hyperparameters": SPACE}
+        )
+        exp.start()
+        rec = launcher.launched[0][1]
+        exp.pause()
+        assert exp.kill_trial(rec.trial_id) is True
+        assert exp.state == db_mod.PAUSED  # finish check deferred
+        exp.activate()
+        assert exp.state in (db_mod.COMPLETED, db_mod.CANCELED)
+        assert exp.wait_done(timeout=5) == exp.state
+
     def test_random_search_all_trials(self):
         db, launcher, exp = self._make(
             {"searcher": {"name": "random", "max_trials": 4, "max_length": 5},
@@ -466,3 +482,30 @@ class TestExperimentFSM:
         for _, rec in list(launcher2.launched):
             _drive_trial(exp2, rec, metric=float(rec.trial_id))
         assert exp2.state == db_mod.COMPLETED
+
+
+class TestMasterLogBuffer:
+    def test_follow_drains_bursts_oldest_first(self):
+        from determined_tpu.master.core import _MasterLogBuffer
+
+        buf = _MasterLogBuffer()  # standalone instance; not the singleton
+        import logging as _l
+
+        for i in range(30):
+            buf.emit(_l.LogRecord(
+                "determined_tpu.t", _l.INFO, __file__, 1,
+                "line %d", (i,), None,
+            ))
+        # no cursor: newest page
+        tail = buf.tail(limit=10)
+        assert [e["message"] for e in tail][-1] == "line 29"
+        # with cursor: OLDEST first so pages drain the backlog
+        page1 = buf.tail(limit=10, since_id=5)
+        assert [e["message"] for e in page1][0] == "line 5"
+        assert len(page1) == 10
+        cursor = max(e["id"] for e in page1)
+        page2 = buf.tail(limit=10, since_id=cursor)
+        assert [e["message"] for e in page2][0] == "line 15"
+        # everything is reachable across pages (nothing skipped)
+        seen = {e["message"] for e in page1} | {e["message"] for e in page2}
+        assert {"line %d" % i for i in range(5, 25)} == seen

@@ -372,6 +372,38 @@ class TestUserManagement:
         ).raise_for_status()
         _login(api.url, "ada", "adapw")
 
+    def test_master_logs_admin_tail(self, secured):
+        """GET /api/v1/master/logs (ref: GetMasterLogs): admin-only tail of
+        the master's own records with since_id follow semantics."""
+        import logging as _logging
+
+        master, api = secured
+        root = _login(api.url, "root", "rootpw")
+        vic = _login(api.url, "vic", "vicpw")
+        # warning: the test process has no basicConfig, so INFO is below
+        # the root logger's effective level (the daemon runs at INFO).
+        _logging.getLogger("determined_tpu.master").warning(
+            "master-log-probe %d", 41
+        )
+        assert requests.get(
+            f"{api.url}/api/v1/master/logs", headers=vic, timeout=10,
+        ).status_code == 403
+        logs = requests.get(
+            f"{api.url}/api/v1/master/logs", headers=root, timeout=10,
+        ).json()["logs"]
+        assert any("master-log-probe 41" in e["message"] for e in logs)
+        last = max(e["id"] for e in logs)
+        _logging.getLogger("determined_tpu.master").warning(
+            "master-log-probe %d", 42
+        )
+        newer = requests.get(
+            f"{api.url}/api/v1/master/logs",
+            params={"since_id": str(last)}, headers=root, timeout=10,
+        ).json()["logs"]
+        assert all(e["id"] > last for e in newer)
+        assert any("master-log-probe 42" in e["message"] for e in newer)
+        assert not any("master-log-probe 41" in e["message"] for e in newer)
+
     def test_user_mutations_persist_across_restart(self, secured):
         master, api = secured
         root = _login(api.url, "root", "rootpw")
