@@ -5,7 +5,8 @@ whose primary metric stays gpt2_mfu; the other BASELINE.md rows ride as
 extra fields on the same line:
   {"metric": "gpt2_mfu", "value": <pct>, "unit": "%", "vs_baseline": <x/35>,
    "tokens_per_sec_per_chip": <tok/s>, "asha_trials_per_hour": <trials/h>,
-   "neox_class_mfu": <pct>, "neox_layers_measured": <n>}
+   "neox_class_mfu": <pct>, "neox_layers_measured": <n>,
+   "long_ctx_mfu": <pct>, "long_ctx_seq_len": <S>}
 
 neox_class_mfu is the BASELINE ladder's top rung made measurable on one
 chip: a GPT-NeoX-20B-shaped layer slice (d_model 6144 / d_ff 24576 /
@@ -152,6 +153,32 @@ def _measure_mfu(config, batch_size: int, inner: int, rounds: int, dev):
     return mfu, tokens_per_sec
 
 
+def long_ctx_mfu(dev, on_tpu: bool):
+    """Long-context rung: GPT-2-small shapes at 16k sequence on one chip —
+    Pallas flash attention + remat + chunked cross-entropy (the [1, 16384,
+    50304] fp32 logits would be 3.3 GB dense; the chunked loss never
+    materializes them). The single-chip end of the long-context story whose
+    multi-chip half is ring attention over the context axis
+    (examples/long_context_128k.json, dryrun pp x sp configs). Returns
+    (mfu, seq_len) or (None, 0)."""
+    try:
+        if on_tpu:
+            cfg = GPTConfig(seq_len=16384, remat=True, fused_loss=True)
+            mfu, _ = _measure_mfu(cfg, batch_size=1, inner=2, rounds=2, dev=dev)
+        else:
+            cfg = GPTConfig(
+                vocab_size=512, n_layers=1, n_heads=4, d_model=128,
+                d_ff=512, seq_len=1024, remat=True, fused_loss=True,
+            )
+            mfu, _ = _measure_mfu(cfg, batch_size=1, inner=1, rounds=1, dev=dev)
+        return mfu, cfg.seq_len
+    except Exception:  # noqa: BLE001 — skip the rung, keep the headline
+        import traceback
+
+        traceback.print_exc()
+        return None, 0
+
+
 def neox_class_mfu(dev, on_tpu: bool):
     """BASELINE ladder top rung: NeoX-20B-shaped slice, single chip.
 
@@ -233,6 +260,11 @@ def main() -> None:
         if neox_mfu is not None:
             record["neox_class_mfu"] = round(100.0 * neox_mfu, 2)
             record["neox_layers_measured"] = neox_layers
+    if not os.environ.get("DTPU_BENCH_SKIP_LONGCTX"):
+        lc_mfu, lc_seq = long_ctx_mfu(dev, on_tpu)
+        if lc_mfu is not None:
+            record["long_ctx_mfu"] = round(100.0 * lc_mfu, 2)
+            record["long_ctx_seq_len"] = lc_seq
     if not os.environ.get("DTPU_BENCH_SKIP_ASHA"):
         # Best of 2: the number is wall-clock of a whole devcluster search
         # on a shared host, so single runs swing ±15% with box load.

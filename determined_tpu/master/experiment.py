@@ -365,9 +365,9 @@ class Experiment:
                 rec.exited = True
                 rec.state = db_mod.CANCELED
                 self.db.update_trial(trial_id, state=db_mod.CANCELED)
-                if all(r.exited for r in self.trials.values()):
-                    self.state = db_mod.CANCELED
-                    self._announce_state()
+                # _maybe_finish owns the cancel-drain completion (state is
+                # STOPPING here) — same single path kill_trial uses.
+                self._maybe_finish()
                 self._cond.notify_all()
                 return
             if clean and (rec.close_requested or self.state == db_mod.STOPPING):

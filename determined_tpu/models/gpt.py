@@ -74,8 +74,12 @@ class GPTConfig:
     #              scan (+21% tokens/s); profile showed ~25 ms/step of
     #              bitcast_dynamic-update-slice fusions gone. Program size
     #              and compile time grow ~linearly with L.
-    #   "auto"   — "unroll" for stacks up to 24 layers, "scan" for deeper
-    #              models where compile time / program size dominate.
+    #   "auto"   — "unroll" for stacks up to 24 layers at sequence lengths
+    #              up to 16k; "scan" for deeper models (compile time /
+    #              program size) and for longer sequences (a 12-layer
+    #              unrolled program at seq 32k fails TPU compilation
+    #              outright — measured on v5e — while scan +
+    #              remat_attention compiles and trains).
     layer_loop: str = "auto"
     attn_impl: str = "auto"            # see models.attention
     # Flash kernel tile sizes. 1024/1024 measured best on v5e for the GPT-2
@@ -616,7 +620,9 @@ class GPT(Model):
                 block_fn = jax.checkpoint(block_fn, policy=_remat_policy())
 
         unroll = c.layer_loop == "unroll" or (
-            c.layer_loop == "auto" and c.n_layers <= 24
+            c.layer_loop == "auto"
+            and c.n_layers <= 24
+            and c.seq_len <= 16384
         )
         if unroll:
             # Python loop over per-layer slices: no [L, ...] residual
