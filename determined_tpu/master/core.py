@@ -202,6 +202,9 @@ class RMTrialLauncher:
             self.m.alloc_service.signal_preempt(a_id)
 
         self.m.rm.pool(pool_name).submit(request, on_start, on_preempt)
+        # Single chokepoint for every trial enqueue (create, restart,
+        # activate, fork): schedule it now rather than next periodic tick.
+        self.m.kick_tick()
 
     def _live_alloc(self, trial_id: int) -> Optional[str]:
         with self.m._lock:
@@ -424,6 +427,11 @@ class Master:
         self._worker = threading.Thread(target=self._work_loop, daemon=True)
         self._worker.start()
         self.alloc_service.set_exit_hook(self._allocation_exited)
+        # Event-driven scheduling: exits / new work / agent arrivals kick
+        # the tick immediately instead of waiting out the 1 s period —
+        # measured ~1 s of pure scheduling latency per allocation
+        # transition on ASHA-style many-short-trials workloads otherwise.
+        self._tick_kick = threading.Event()
         self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
         self._ticker.start()
 
@@ -551,8 +559,17 @@ class Master:
         )
 
     # -- background pump (replaces the actor system's message loop) ----------
+    def kick_tick(self) -> None:
+        """Run a scheduler tick promptly (allocation exited, work enqueued,
+        agent arrived) rather than waiting out the period."""
+        self._tick_kick.set()
+
     def _tick_loop(self) -> None:
-        while not self._stop.wait(1.0):
+        while True:
+            self._tick_kick.wait(1.0)
+            self._tick_kick.clear()
+            if self._stop.is_set():
+                return
             try:
                 self.rm.tick_all()
                 for pool in self.rm.pools.values():
@@ -693,6 +710,7 @@ class Master:
                 "agent %s reattach: adopted=%s orphaned=%s retry=%s",
                 agent_id, adopted, orphaned, retry,
             )
+        self.kick_tick()  # fresh capacity: place pending work immediately
         return {"adopted": adopted, "orphaned": orphaned, "retry": retry}
 
     def _reconcile_unreported(
@@ -905,6 +923,7 @@ class Master:
 
     def shutdown(self) -> None:
         self._stop.set()
+        self._tick_kick.set()  # wake the ticker so it observes _stop now
         self.agent_hub.close()
         self.webhooks.stop()
         self.tracer.stop()
@@ -950,6 +969,9 @@ class Master:
                 trial_id, alloc.exit_code or 0, alloc.exit_reason or "",
                 infra=alloc.infra_failure,
             )
+        # Freed slots (and any relaunch trial_exited just enqueued) should
+        # schedule now, not at the next periodic tick.
+        self.kick_tick()
 
     # -- experiments -----------------------------------------------------------
     def create_experiment(self, config: Dict[str, Any]) -> int:
@@ -980,7 +1002,7 @@ class Master:
         exp.on_state_change = self._on_exp_state
         with self._lock:
             self.experiments[exp_id] = exp
-        exp.start()
+        exp.start()  # initial launches kick the tick via the launcher
         return exp_id
 
     def get_experiment(self, exp_id: int) -> Optional[Experiment]:

@@ -126,11 +126,11 @@ async function j(path) {
   return r.json();
 }
 
-async function post(path, body) {
+async function post(path, body, method) {
   const headers = {'Content-Type': 'application/json'};
   const tok = localStorage.getItem('dtpu_token');
   if (tok) headers['Authorization'] = 'Bearer ' + tok;
-  const r = await fetch(path, {method: 'POST', headers,
+  const r = await fetch(path, {method: method || 'POST', headers,
                                body: JSON.stringify(body || {})});
   if (r.status === 401) { $('login').style.display = 'block'; throw 'auth'; }
   if (!r.ok) alert(`${path}: ${(await r.json()).error || r.status}`);
@@ -142,6 +142,21 @@ async function post(path, body) {
 async function expAction(id, action) {
   if (action === 'kill' && !confirm(`kill experiment ${id}?`)) return;
   await post(`/api/v1/experiments/${id}/${action}`);
+  refresh();
+}
+async function killTrial(id) {
+  if (!confirm(`kill trial ${id}? (the experiment keeps searching)`)) return;
+  await post(`/api/v1/trials/${id}/kill`);
+  refresh();
+}
+let expLabels = {};  // id -> rendered label string (prompt prefill)
+async function editLabels(id) {
+  const v = prompt('labels (comma-separated)', expLabels[id] || '');
+  // Unchanged input is a no-op: the comma UI can't represent a label that
+  // itself contains a comma, so OK-without-editing must not re-split it.
+  if (v === null || v === (expLabels[id] || '')) return;
+  const labels = v.split(',').map(s => s.trim()).filter(Boolean);
+  await post(`/api/v1/experiments/${id}`, {labels}, 'PATCH');
   refresh();
 }
 async function forkExp(id) {
@@ -466,12 +481,7 @@ async function setRole(i) {
 
 async function setActive(i, active) {
   const name = adminUsers[i];
-  const headers = {'Content-Type': 'application/json'};
-  const tok = localStorage.getItem('dtpu_token');
-  if (tok) headers['Authorization'] = 'Bearer ' + tok;
-  await fetch(`/api/v1/users/${encodeURIComponent(name)}`, {
-    method: 'PATCH', headers, body: JSON.stringify({active}),
-  });
+  await post(`/api/v1/users/${encodeURIComponent(name)}`, {active}, 'PATCH');
   refresh();
 }
 
@@ -603,7 +613,9 @@ async function refresh() {
         return `<tr>${cell(e.id)}${state(e.state)}` +
           `<td><span class="bar"><div style="width:${pct}%"></div></span> ${pct}%</td>` +
           cell((e.config.searcher || {}).name || '') +
-          cell((e.labels || []).join(', ')) +
+          (expLabels[e.id] = (e.labels || []).join(', '),
+           `<td onclick="editLabels(${e.id})" style="cursor:pointer" ` +
+           `title="click to edit labels">${esc(expLabels[e.id]) || '+'}</td>`) +
           `<td><button onclick="selExp=${e.id};trialPage=0;refresh()">trials</button> ` +
           `<button onclick="forkExp(${e.id})">fork</button>` +
           `${act}${kill}${arch}</td></tr>`;
@@ -624,7 +636,9 @@ async function refresh() {
           cell(t.restarts) + cell(t.searcher_metric ?? '') +
           cell(JSON.stringify(t.hparams)) +
           `<td><button onclick="selTrial=${t.id};logAfter=0;$('logs').textContent='';refresh()">logs</button> ` +
-          `<button onclick="showCkpts(${t.id})">ckpts</button></td></tr>`
+          `<button onclick="showCkpts(${t.id})">ckpts</button>` +
+          `${['COMPLETED','CANCELED','ERRORED'].includes(t.state) ? ''
+             : ` <button onclick="killTrial(${t.id})">kill</button>`}</td></tr>`
         ).join('');
       drawHpViz(trials);
     }

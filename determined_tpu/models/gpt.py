@@ -76,10 +76,12 @@ class GPTConfig:
     #              and compile time grow ~linearly with L.
     #   "auto"   — "unroll" for stacks up to 24 layers at sequence lengths
     #              up to 16k; "scan" for deeper models (compile time /
-    #              program size) and for longer sequences (a 12-layer
-    #              unrolled program at seq 32k fails TPU compilation
-    #              outright — measured on v5e — while scan +
-    #              remat_attention compiles and trains).
+    #              program size) and for longer sequences, where it ALSO
+    #              remats attention (a 12-layer unrolled program at seq
+    #              32k fails TPU compilation outright — measured on v5e —
+    #              while scan + rematted attention compiles and trains at
+    #              37.1% MFU; the flash residuals the split-remat saves
+    #              scale with S).
     layer_loop: str = "auto"
     attn_impl: str = "auto"            # see models.attention
     # Flash kernel tile sizes. 1024/1024 measured best on v5e for the GPT-2
@@ -605,7 +607,16 @@ class GPT(Model):
                 "pipeline (data/tokens.py zigzag_ring) supplying positions"
             )
         x = self._embed(params, tokens, positions)
-        if c.remat and not c.remat_attention:
+        # Effective remat_attention: the attention-outside-remat split is
+        # the throughput winner at bench sequence lengths, but its saved
+        # flash residuals scale with S — at 32k the only configuration
+        # measured to compile AND train on v5e is scan + rematted
+        # attention, so "auto" flips this knob together with the loop
+        # style (the two halves of the same long-sequence regime).
+        remat_attn = c.remat_attention or (
+            c.layer_loop == "auto" and c.seq_len > 16384
+        )
+        if c.remat and not remat_attn:
             attn_fn = functools.partial(self._attn_half, manual=False)
             mlp_fn = jax.checkpoint(
                 functools.partial(self._mlp_half, manual=False),
