@@ -565,26 +565,40 @@ class Master:
         self._tick_kick.set()
 
     def _tick_loop(self) -> None:
+        import time as _time
+
+        last_maintenance = 0.0
         while True:
             self._tick_kick.wait(1.0)
             self._tick_kick.clear()
             if self._stop.is_set():
                 return
             try:
+                # Scheduling half: runs on every wake (kicks included) —
+                # cheap, and latency here is trial-start latency.
                 self.rm.tick_all()
-                for pool in self.rm.pools.values():
-                    pool.sync()  # backend state poll (k8s pod phases; agent no-op)
                 for alloc_id in self.alloc_service.overdue_preemptions():
                     self.kill_allocation(alloc_id)
-                # Agent failure detection: an agent silent past the timeout
-                # is gone — fail its allocations over (trial restart budget
-                # applies; ref agent reattach flow, containers/manager.go:76).
-                for agent_id in self.agent_hub.reap_stale(self.agent_timeout_s):
-                    self.lose_agent(agent_id)
-                self._reconcile_sweep()
-                self._reap_unmanaged()
-                self._reap_idle_commands()
-                self.auth.sweep()
+                # Maintenance half stays on the 1 s cadence even under a
+                # kick storm (an ASHA burst of exits): pool.sync() can be
+                # a live k8s LIST, and the sweeps are O(cluster) — kicks
+                # must not remove their rate cap.
+                now = _time.monotonic()
+                if now - last_maintenance >= 1.0:
+                    last_maintenance = now
+                    for pool in self.rm.pools.values():
+                        pool.sync()  # backend state poll (k8s; agent no-op)
+                    # Agent failure detection: an agent silent past the
+                    # timeout is gone — fail its allocations over (trial
+                    # restart budget applies; ref containers/manager.go:76).
+                    for agent_id in self.agent_hub.reap_stale(
+                        self.agent_timeout_s
+                    ):
+                        self.lose_agent(agent_id)
+                    self._reconcile_sweep()
+                    self._reap_unmanaged()
+                    self._reap_idle_commands()
+                    self.auth.sweep()
             except Exception:  # noqa: BLE001
                 logger.exception("tick loop error")
 

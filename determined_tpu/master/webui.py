@@ -149,6 +149,9 @@ async function killTrial(id) {
   await post(`/api/v1/trials/${id}/kill`);
   refresh();
 }
+// mirror of the server's db.TERMINAL_STATES — used by both tables' action
+// buttons; keep the one copy in sync with the master.
+const TERMINAL_STATES = ['COMPLETED', 'CANCELED', 'ERRORED'];
 let expLabels = {};  // id -> rendered label string (prompt prefill)
 async function editLabels(id) {
   const v = prompt('labels (comma-separated)', expLabels[id] || '');
@@ -602,7 +605,7 @@ async function refresh() {
           : (e.state === 'PAUSED'
              ? `<button onclick="expAction(${e.id},'activate')">activate</button>`
              : '');
-        const terminal = ['COMPLETED', 'CANCELED', 'ERRORED'].includes(e.state);
+        const terminal = TERMINAL_STATES.includes(e.state);
         const kill = terminal
           ? '' : ` <button onclick="expAction(${e.id},'kill')">kill</button>`;
         const arch = terminal
@@ -637,7 +640,7 @@ async function refresh() {
           cell(JSON.stringify(t.hparams)) +
           `<td><button onclick="selTrial=${t.id};logAfter=0;$('logs').textContent='';refresh()">logs</button> ` +
           `<button onclick="showCkpts(${t.id})">ckpts</button>` +
-          `${['COMPLETED','CANCELED','ERRORED'].includes(t.state) ? ''
+          `${TERMINAL_STATES.includes(t.state) ? ''
              : ` <button onclick="killTrial(${t.id})">kill</button>`}</td></tr>`
         ).join('');
       drawHpViz(trials);

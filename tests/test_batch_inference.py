@@ -225,3 +225,14 @@ class TestInferenceContext:
         assert [b for _, b in proc.batches] == [4, 5, 6, 7, 8, 9]
         # the resume machinery never rewrote the model pointer
         assert FakeTrial.latest_checkpoint == "model-weights-uuid"
+
+
+class TestExampleRecipe:
+    def test_batch_inference_example_standalone(self, capsys):
+        """examples/batch_inference_example.py end to end in dummy mode:
+        every batch scored, shards uploaded per sync."""
+        from examples.batch_inference_example import main
+
+        main()
+        out = capsys.readouterr().out
+        assert "scored 64 batches" in out
