@@ -48,9 +48,18 @@ def auth_change_password(args: argparse.Namespace) -> None:
     """Own-account password change (ref: det user change-password)."""
     import getpass
 
-    password = args.password or getpass.getpass("new password: ")
+    try:
+        current = (args.current_password
+                   or getpass.getpass("current password: "))
+        password = args.password or getpass.getpass("new password: ")
+    except EOFError:
+        raise SystemExit(
+            "no input available: pass --current-password/--password "
+            "for non-interactive use"
+        )
     _session(args).post(
-        "/api/v1/auth/password", json_body={"password": password}
+        "/api/v1/auth/password",
+        json_body={"password": password, "current_password": current},
     )
     print("password changed")
 
@@ -845,6 +854,7 @@ def build_parser() -> argparse.ArgumentParser:
     v.set_defaults(fn=auth_login)
     v = auth.add_parser("change-password")
     v.add_argument("--password", default=None)
+    v.add_argument("--current-password", default=None)
     v.set_defaults(fn=auth_change_password)
 
     exp = sub.add_parser("experiment", aliases=["e"]).add_subparsers(

@@ -126,6 +126,11 @@ def task_identity_violation(
             return "token does not own this allocation"
     if method == "GET":
         return None
+    if re.match(r"^/api/v1/experiments/\d+", path):
+        # The experiments rows in TASK_TOKEN_ROUTES exist for config echo
+        # and trial discovery only; a task token must never mutate
+        # experiment state (PATCH metadata rewrites the stored config).
+        return "task token may only read experiments"
     tm = re.match(r"^/api/v1/trials/(\d+)(/|$)", path)
     if tm and task_id != f"trial-{tm.group(1)}":
         return "task token may only write its own trial"
@@ -969,6 +974,14 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         who = m.auth.validate(r.token) or ""
         if not who or who == "anonymous" or ":" in who:
             raise ApiError(403, "a logged-in user session is required")
+        # Re-verify the current password: a bearer token alone is a
+        # TTL-bounded credential and must not mint a permanent one
+        # (r4 advisor; admin resets via /users/<name>/password don't
+        # re-verify — they're the recovery path).
+        if not m.auth.verify_password(
+            who, str(r.body.get("current_password", ""))
+        ):
+            raise ApiError(403, "current password incorrect")
         try:
             m.auth.set_password(who, str(r.body.get("password", "")))
         except (KeyError, ValueError) as e:
@@ -1235,17 +1248,21 @@ class ApiServer:
                     # not reach proxied interactive services (notebooks are
                     # a code-execution surface).
                     if master.auth.enabled:
+                        # close=True throughout: these reject before the
+                        # request body is consumed (the proxy streams it
+                        # later), so keeping the connection would desync it.
                         principal = master.auth.validate(token)
                         if principal is None:
                             self._send(
-                                401, {"error": "authentication required"}
+                                401, {"error": "authentication required"},
+                                close=True,
                             )
                             return
                         if principal.startswith(("task:", "agent:")):
                             self._send(403, {
                                 "error": "task/agent tokens may not access "
                                          "proxied services"
-                            })
+                            }, close=True)
                             return
                         # Proxied services ARE code execution (notebook
                         # kernels, PTY shells): the viewer role's read-only
@@ -1255,7 +1272,7 @@ class ApiServer:
                             self._send(403, {
                                 "error": f"role {role} may not access "
                                          "proxied services"
-                            })
+                            }, close=True)
                             return
                     connection = self.headers.get("Connection", "")
                     if "upgrade" in connection.lower():
@@ -1287,18 +1304,24 @@ class ApiServer:
 
                 principal: Optional[str] = None
                 if master.auth.enabled and parsed.path not in self.AUTH_EXEMPT:
+                    # Auth rejections happen BEFORE the body read below —
+                    # responding while the declared body sits unread would
+                    # desync this keep-alive connection (the next request
+                    # would parse body bytes as its request line), so these
+                    # _sends close like the 413 path does.
                     principal = master.auth.validate(token)
                     if principal is None:
                         audit_denied(
                             "invalid-token" if token else "anonymous", 401
                         )
-                        self._send(401, {"error": "authentication required"})
+                        self._send(401, {"error": "authentication required"},
+                                   close=True)
                         return
                     if not principal_allowed(principal, parsed.path):
                         audit_denied(principal, 403)
                         self._send(403, {
                             "error": f"{principal} may not access {parsed.path}"
-                        })
+                        }, close=True)
                         return
                     if not principal.startswith(("task:", "agent:")):
                         role = master.auth.effective_role(principal)
@@ -1307,7 +1330,7 @@ class ApiServer:
                             self._send(403, {
                                 "error": f"role {role} may not {method} "
                                          f"{parsed.path}"
-                            })
+                            }, close=True)
                             return
                 body: Dict[str, Any] = {}
                 raw: bytes = b""

@@ -369,6 +369,13 @@ class AuthService:
                 # after restart undiagnosable.
                 logging.exception("failed to persist user store")
 
+    def verify_password(self, username: str, password: str) -> bool:
+        """Constant-time credential check without side effects — the
+        re-verification step for self-service password change (a stolen
+        TTL-bounded bearer token must not convert into permanent account
+        takeover by rotating the password)."""
+        return self._verify_password(username, password)
+
     def _verify_password(self, username: str, password: str) -> bool:
         dyn = self._dynamic.get(username)
         if dyn is not None:

@@ -315,10 +315,13 @@ class Determined:
             f"/api/v1/users/{username}", json_body={"active": active}
         )
 
-    def change_password(self, password: str) -> None:
-        """Own-account password change for the logged-in session."""
+    def change_password(self, password: str, current_password: str) -> None:
+        """Own-account password change for the logged-in session; the
+        current password is re-verified server-side."""
         self._session.post(
-            "/api/v1/auth/password", json_body={"password": password}
+            "/api/v1/auth/password",
+            json_body={"password": password,
+                       "current_password": current_password},
         )
 
     # -- model registry ------------------------------------------------------

@@ -200,6 +200,57 @@ class TestRoles:
             f"{api.url}/api/v1/users", headers=h, timeout=10
         ).status_code == 403
 
+    def test_pre_body_auth_reject_closes_connection(self, secured):
+        """401/403 sent before the request body is read must close the
+        connection — otherwise the unread body desyncs the keep-alive
+        stream and the next request parses body bytes as a request line
+        (found driving the SDK against a live master)."""
+        _, api = secured
+        s = requests.Session()
+        r1 = s.post(
+            f"{api.url}/api/v1/experiments",
+            json={"config": {"entrypoint": "x"}}, timeout=10,
+        )
+        assert r1.status_code == 401
+        assert r1.headers.get("Connection") == "close"
+        # connection pool recovers: the next request is parsed cleanly
+        r2 = s.post(
+            f"{api.url}/api/v1/auth/login",
+            json={"username": "vic", "password": "vicpw"}, timeout=10,
+        )
+        assert r2.status_code == 200
+
+    def test_task_token_cannot_write_experiments(self, secured):
+        """The experiments rows in TASK_TOKEN_ROUTES are GET-only (config
+        echo, trial discovery): a task token PATCHing any experiment's
+        metadata would let arbitrary task code rewrite stored configs
+        (r4 advisor high)."""
+        master, api = secured
+        root = _login(api.url, "root", "rootpw")
+        r = requests.post(
+            f"{api.url}/api/v1/experiments",
+            json={"config": GOOD_EXP}, headers=root, timeout=10,
+        )
+        assert r.status_code == 200
+        exp_id = r.json()["id"]
+        tok = master.auth.issue_task_token("trial-1")
+        h = {"Authorization": "Bearer " + tok}
+        # reads stay open: the harness fetches its merged config this way
+        assert requests.get(
+            f"{api.url}/api/v1/experiments/{exp_id}", headers=h, timeout=10
+        ).status_code == 200
+        r = requests.patch(
+            f"{api.url}/api/v1/experiments/{exp_id}",
+            json={"name": "pwned"}, headers=h, timeout=10,
+        )
+        assert r.status_code == 403
+        assert "read" in r.json()["error"]
+        # metadata survived
+        r = requests.get(
+            f"{api.url}/api/v1/experiments/{exp_id}", headers=root, timeout=10
+        )
+        assert r.json().get("name") != "pwned"
+
 
 class TestGroups:
     def test_group_role_union_and_membership(self, secured):
@@ -304,9 +355,19 @@ class TestUserManagement:
     def test_own_password_change_any_role(self, secured):
         master, api = secured
         vic = _login(api.url, "vic", "vicpw")  # viewer
+        # a bearer token alone must not rotate the password (r4 advisor):
+        # missing or wrong current_password is refused
+        for bad in ({}, {"current_password": "wrong"}):
+            r = requests.post(
+                f"{api.url}/api/v1/auth/password",
+                json={"password": "vicnew", **bad}, headers=vic, timeout=10,
+            )
+            assert r.status_code == 403, bad
+        _login(api.url, "vic", "vicpw")  # unchanged
         requests.post(
             f"{api.url}/api/v1/auth/password",
-            json={"password": "vicnew"}, headers=vic, timeout=10,
+            json={"password": "vicnew", "current_password": "vicpw"},
+            headers=vic, timeout=10,
         ).raise_for_status()
         with pytest.raises(requests.HTTPError):
             _login(api.url, "vic", "vicpw")  # old credential dead
