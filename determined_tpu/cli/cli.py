@@ -212,6 +212,28 @@ def exp_set_meta(field: str):
     return fn
 
 
+def exp_set_resources(field: str):
+    """`dtpu e set priority|weight|max-slots <id> <value>` — live
+    scheduling update (ref: det experiment set priority,
+    cli/experiment.py:870; UpdateJobQueue). `max-slots none` clears the
+    cap."""
+    def fn(args: argparse.Namespace) -> None:
+        raw = args.value
+        value = (
+            None if field == "max_slots" and raw.lower() in ("none", "null")
+            else float(raw) if field == "weight" else int(raw)
+        )
+        res = _session(args).patch(
+            f"/api/v1/experiments/{args.experiment_id}/resources",
+            json_body={field: value},
+        )
+        print(
+            f"experiment {args.experiment_id}: {field}={value} "
+            f"({res['live_requests_updated']} live requests updated)"
+        )
+    return fn
+
+
 def exp_move(args: argparse.Namespace) -> None:
     """`dtpu e move <id> <project_id>` (ref: det experiment move)."""
     _session(args).post(
@@ -680,14 +702,56 @@ def agent_list(args: argparse.Namespace) -> None:
         kinds = sorted({d.get("kind", "") for d in a.get("devices") or []})
         return ", ".join(k for k in kinds if k)
 
+    def _state(a):
+        if not a.get("enabled", True):
+            return "draining" if a.get("draining") else "disabled"
+        return "enabled"
+
     _table(
         [
             {"id": aid, "slots": a["slots"], "pool": a["pool"],
+             "state": _state(a),
+             "disabled_slots": ",".join(
+                 str(s) for s in a.get("disabled_slot_ids", [])
+             ) or "-",
              "devices": _kinds(a)}
             for aid, a in agents.items()
         ],
-        ["id", "slots", "pool", "devices"],
+        ["id", "slots", "pool", "state", "disabled_slots", "devices"],
     )
+
+
+def agent_enable(args: argparse.Namespace) -> None:
+    res = _session(args).post(f"/api/v1/agents/{args.agent_id}/enable")
+    print(f"agent {res['id']} enabled")
+
+
+def agent_disable(args: argparse.Namespace) -> None:
+    """`dtpu agent disable [--drain]` (ref: det agent disable). --drain
+    lets running allocations finish; without it they are killed and
+    requeued on other agents."""
+    res = _session(args).post(
+        f"/api/v1/agents/{args.agent_id}/disable",
+        json_body={"drain": bool(args.drain)},
+    )
+    mode = "draining" if res.get("draining") else "disabled"
+    killed = res.get("killed_allocations") or []
+    suffix = f" (killed: {', '.join(killed)})" if killed else ""
+    print(f"agent {res['id']} {mode}{suffix}")
+
+
+def agent_slot_state(enable: bool):
+    def fn(args: argparse.Namespace) -> None:
+        verb = "enable" if enable else "disable"
+        res = _session(args).post(
+            f"/api/v1/agents/{args.agent_id}/slots/{args.slot}/{verb}"
+        )
+        disabled = res.get("disabled_slot_ids", [])
+        print(
+            f"agent {res['id']} slot {args.slot} {verb}d"
+            + (f" (disabled slots: {disabled})" if disabled else "")
+        )
+    return fn
 
 
 def master_info(args: argparse.Namespace) -> None:
@@ -882,6 +946,14 @@ def build_parser() -> argparse.ArgumentParser:
         sv.add_argument("experiment_id", type=int)
         sv.add_argument("value")
         sv.set_defaults(fn=exp_set_meta(field))
+    for verb, field in (
+        ("priority", "priority"), ("weight", "weight"),
+        ("max-slots", "max_slots"),
+    ):
+        sv = set_sub.add_parser(verb)
+        sv.add_argument("experiment_id", type=int)
+        sv.add_argument("value")
+        sv.set_defaults(fn=exp_set_resources(field))
     v = exp.add_parser("label")
     v.add_argument("action", choices=["add", "remove"])
     v.add_argument("experiment_id", type=int)
@@ -1019,6 +1091,22 @@ def build_parser() -> argparse.ArgumentParser:
     agent = sub.add_parser("agent", aliases=["a"]).add_subparsers(
         dest="verb", required=True)
     agent.add_parser("list").set_defaults(fn=agent_list)
+    v = agent.add_parser("enable")
+    v.add_argument("agent_id")
+    v.set_defaults(fn=agent_enable)
+    v = agent.add_parser("disable")
+    v.add_argument("agent_id")
+    v.add_argument("--drain", action="store_true",
+                   help="let running allocations finish; block new ones")
+    v.set_defaults(fn=agent_disable)
+    v = agent.add_parser("enable-slot")
+    v.add_argument("agent_id")
+    v.add_argument("slot", type=int)
+    v.set_defaults(fn=agent_slot_state(True))
+    v = agent.add_parser("disable-slot")
+    v.add_argument("agent_id")
+    v.add_argument("slot", type=int)
+    v.set_defaults(fn=agent_slot_state(False))
     v = agent.add_parser("run")
     v.add_argument("rest", nargs=argparse.REMAINDER)
     v.set_defaults(fn=agent_run)

@@ -76,6 +76,12 @@ ADMIN_ROUTES = re.compile(
     # exits. Agents authenticate with agent: tokens (class allowlist);
     # user sessions touching these must be cluster admins.
     r"|^/api/v1/agents/[\w.\-]+/(actions|events)$"
+    # Enable/disable/drain and slot-level variants reshape cluster
+    # capacity (and plain disable kills running work): admins only.
+    # Agent tokens can't reach these (not in AGENT_TOKEN_ROUTES) — an
+    # agent must not disable its peers.
+    r"|^/api/v1/agents/[\w.\-]+/(enable|disable)$"
+    r"|^/api/v1/agents/[\w.\-]+/slots/\d+/(enable|disable)$"
 )
 
 
@@ -524,6 +530,30 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
     def list_agents(r: ApiRequest):
         return {"agents": m.agent_hub.list()}
 
+    def agent_enable(r: ApiRequest):
+        if r.groups[0] not in m.agent_hub.list():
+            raise ApiError(404, "no such agent")
+        return m.set_agent_enabled(r.groups[0], True)
+
+    def agent_disable(r: ApiRequest):
+        """EnableAgent/DisableAgent parity (ref api_agents.go:140,149):
+        {"drain": true} lets running allocations finish; without it they
+        are killed and requeued (infra — no restart-budget charge)."""
+        if r.groups[0] not in m.agent_hub.list():
+            raise ApiError(404, "no such agent")
+        return m.set_agent_enabled(
+            r.groups[0], False, drain=bool(r.body.get("drain"))
+        )
+
+    def slot_state(r: ApiRequest):
+        agent_id, slot, verb = r.groups
+        info = m.agent_hub.list().get(agent_id)
+        if info is None:
+            raise ApiError(404, "no such agent")
+        if int(slot) >= int(info.get("slots", 0)):
+            raise ApiError(404, f"agent {agent_id} has no slot {slot}")
+        return m.set_slot_enabled(agent_id, int(slot), verb == "enable")
+
     # -- job queue --------------------------------------------------------------
     def queue_list(r: ApiRequest):
         out = {}
@@ -749,8 +779,17 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
                 "name": name,
                 "type": type(pool).__name__,
                 "agents": len(agents),
+                "agents_disabled": sum(
+                    1 for a in agents.values() if not a["enabled"]
+                ),
                 "slots_total": sum(a["slots"] for a in agents.values()),
                 "slots_used": sum(a["used"] for a in agents.values()),
+                "slots_disabled": sum(
+                    # A disabled agent's whole capacity is out of service.
+                    a["slots"] if not a["enabled"]
+                    else a.get("disabled_slots", 0)
+                    for a in agents.values()
+                ),
                 "pending_allocs": len(snap["pending"]),
                 "pending_slots": snap["pending_slots"],
                 "running_allocs": len(snap["running"]),
@@ -765,6 +804,29 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         if live is not None:
             row["state"] = live.state
         return row
+
+    def exp_resources(r: ApiRequest):
+        """Live priority/weight/max_slots update (ref: UpdateJobQueue,
+        api.proto:1110; det experiment set priority). Takes effect on the
+        next tick — the priority scheduler may preempt on a flip."""
+        body = r.body
+        kwargs: Dict[str, Any] = {}
+        if "priority" in body:
+            kwargs["priority"] = body["priority"]
+        if "weight" in body:
+            kwargs["weight"] = body["weight"]
+        if "max_slots" in body:
+            kwargs["max_slots"] = body["max_slots"]
+        if not kwargs:
+            raise ApiError(
+                400, "body must carry priority, weight, or max_slots"
+            )
+        try:
+            return m.update_experiment_resources(int(r.groups[0]), **kwargs)
+        except KeyError as e:
+            raise ApiError(404, str(e))
+        except (TypeError, ValueError) as e:
+            raise ApiError(400, str(e))
 
     def exp_action(r: ApiRequest):
         exp = m.get_experiment(int(r.groups[0]))
@@ -1119,6 +1181,10 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("POST", r"/api/v1/agents", register_agent),
         R("GET", r"/api/v1/agents/([\w.\-]+)/actions", agent_actions),
         R("POST", r"/api/v1/agents/([\w.\-]+)/events", agent_events),
+        R("POST", r"/api/v1/agents/([\w.\-]+)/enable", agent_enable),
+        R("POST", r"/api/v1/agents/([\w.\-]+)/disable", agent_disable),
+        R("POST", r"/api/v1/agents/([\w.\-]+)/slots/(\d+)/(enable|disable)",
+          slot_state),
         R("GET", r"/api/v1/agents", list_agents),
         R("GET", r"/api/v1/queues", queue_list),
         R("POST", r"/api/v1/queues/move", queue_move),
@@ -1143,6 +1209,7 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("GET", r"/api/v1/experiments", list_experiments),
         R("GET", r"/api/v1/experiments/(\d+)", get_experiment),
         R("PATCH", r"/api/v1/experiments/(\d+)", exp_patch),
+        R("PATCH", r"/api/v1/experiments/(\d+)/resources", exp_resources),
         R("POST", r"/api/v1/experiments/(\d+)/(pause|activate|cancel|kill)", exp_action),
         R("POST", r"/api/v1/experiments/(\d+)/(archive|unarchive)", exp_archive),
         R("POST", r"/api/v1/experiments/(\d+)/fork", exp_fork),

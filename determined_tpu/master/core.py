@@ -21,6 +21,7 @@ from determined_tpu import _info
 from determined_tpu.master import checkpoint_gc, db as db_mod
 from determined_tpu.master.allocation import AllocationService
 from determined_tpu.master.experiment import Experiment, TrialRecord
+from determined_tpu.master import rm as rm_mod
 from determined_tpu.master.rm import ResourceManager
 from determined_tpu.master.scheduler import Request
 from determined_tpu.master.webhooks import WebhookShipper
@@ -55,14 +56,42 @@ class AgentHub:
         devices: Optional[List[Dict[str, Any]]] = None,
     ) -> None:
         with self._cond:
+            prev = self._agents.get(agent_id, {})
             self._agents[agent_id] = {
                 "slots": slots, "pool": pool, "last_seen": time.time(),
                 # per-slot device model (ref: master/pkg/device — kind/
                 # platform/coords rather than a bare count)
                 "devices": devices or [],
+                # Admin state is MASTER-side (persisted in kv, re-applied
+                # by Master.agent_registered) — a re-registering agent
+                # must not clear its own drain/disable.
+                "enabled": prev.get("enabled", True),
+                "draining": prev.get("draining", False),
+                "disabled_slot_ids": prev.get("disabled_slot_ids", []),
             }
             self._queues.setdefault(agent_id, [])
             self._cond.notify_all()
+
+    def set_admin(
+        self,
+        agent_id: str,
+        *,
+        enabled: Optional[bool] = None,
+        draining: Optional[bool] = None,
+        disabled_slot_ids: Optional[List[int]] = None,
+    ) -> None:
+        """Record the admin enable/drain/slot state for display and
+        listing (the scheduling effect lives in the pool's Agent)."""
+        with self._cond:
+            a = self._agents.get(agent_id)
+            if a is None:
+                return
+            if enabled is not None:
+                a["enabled"] = enabled
+            if draining is not None:
+                a["draining"] = draining
+            if disabled_slot_ids is not None:
+                a["disabled_slot_ids"] = sorted(disabled_slot_ids)
 
     def enqueue(self, agent_id: str, action: Dict[str, Any]) -> None:
         with self._cond:
@@ -143,6 +172,7 @@ def _trial_request(exp: Experiment, alloc_id: str) -> Request:
     config — single source for both the launch and the reattach-adopt
     paths (they must never drift)."""
     resources = exp.config.get("resources", {})
+    max_slots = resources.get("max_slots")
     return Request(
         alloc_id=alloc_id,
         slots=int(resources.get("slots_per_trial", 1)),
@@ -150,6 +180,7 @@ def _trial_request(exp: Experiment, alloc_id: str) -> Request:
         weight=float(resources.get("weight", 1.0)),
         group_id=str(exp.id),
         preemptible=True,
+        max_slots=int(max_slots) if max_slots is not None else None,
     )
 
 
@@ -416,6 +447,9 @@ class Master:
         self._cmd_counter = 0
         self._provisioners: List[Any] = []  # ProvisionerService
         self._lock = threading.Lock()
+        # Guards read-modify-write of the persisted agent-admin kv blob
+        # (enable/disable/drain + slot states) against concurrent admins.
+        self._admin_kv_lock = threading.Lock()
         self._stop = threading.Event()
         self.webhooks = WebhookShipper(self.db)
         # Background worker for slow reactions to FSM events (checkpoint GC):
@@ -682,6 +716,7 @@ class Master:
         must not be failed over as lost."""
         self.agent_hub.register(agent_id, slots, pool, devices=devices)
         self.rm.pool(pool).add_agent(agent_id, slots)
+        self._apply_agent_admin_state(agent_id, pool)
         adopted: List[str] = []
         orphaned: List[str] = []
         retry: List[str] = []
@@ -916,6 +951,186 @@ class Master:
                     infra=True,
                 )
 
+    # -- live job scheduling updates (ref: UpdateJobQueue api.proto:1110,
+    # -- det experiment set priority/weight/max-slots) -------------------------
+    def update_experiment_resources(
+        self,
+        exp_id: int,
+        *,
+        priority: Optional[int] = None,
+        weight: Optional[float] = None,
+        max_slots: Any = rm_mod.UNSET,
+    ) -> Dict[str, Any]:
+        """Change a running experiment's scheduling knobs in place: the
+        config is updated (and persisted — a restart must not revert the
+        operator's change), every live request of the experiment's group
+        re-sorts, and the follow-up tick may preempt on a priority flip.
+        The cancel+resubmit workaround dies here."""
+        import math
+
+        # Config read-modify-write under the master lock: two concurrent
+        # PATCHes (priority + weight) must not build from the same base
+        # and silently drop each other's knob.
+        with self._lock:
+            exp = self.experiments.get(exp_id)
+            if exp is None:
+                raise KeyError(f"no such experiment {exp_id}")
+            resources = dict(exp.config.get("resources", {}))
+            if priority is not None:
+                if not 0 <= int(priority) <= 99:
+                    raise ValueError("priority must be in [0, 99]")
+                resources["priority"] = int(priority)
+            if weight is not None:
+                # isfinite: json.loads accepts NaN/Infinity, and a NaN
+                # weight poisons every fair-share wsum forever after.
+                if not math.isfinite(float(weight)) or float(weight) <= 0:
+                    raise ValueError("weight must be a finite positive number")
+                resources["weight"] = float(weight)
+            if max_slots is not rm_mod.UNSET:
+                if max_slots is None:
+                    resources.pop("max_slots", None)
+                else:
+                    spt = int(resources.get("slots_per_trial", 1))
+                    if int(max_slots) < max(1, spt):
+                        # A cap below one trial's gang can never unblock:
+                        # the experiment would pend forever with no error.
+                        raise ValueError(
+                            f"max_slots must be >= slots_per_trial ({spt})"
+                        )
+                    resources["max_slots"] = int(max_slots)
+            exp.config["resources"] = resources
+            self.db.set_experiment_config(exp_id, exp.config)
+        touched = 0
+        for pool in self.rm.pools.values():
+            touched += pool.update_group(
+                str(exp_id),
+                priority=priority,
+                weight=weight,
+                max_slots=max_slots,
+            )
+        self.kick_tick()
+        return {
+            "id": exp_id,
+            "resources": resources,
+            "live_requests_updated": touched,
+        }
+
+    # -- agent admin state (enable/disable/drain; ref api_agents.go:140,149
+    # -- + agentrm/agent.go:285-307) -------------------------------------------
+    AGENT_ADMIN_KV = "agent_admin_state"
+
+    def agent_admin_state(self, agent_id: str) -> Dict[str, Any]:
+        states = self.db.get_kv(self.AGENT_ADMIN_KV) or {}
+        return states.get(agent_id, {})
+
+    def set_agent_enabled(
+        self, agent_id: str, enabled: bool, drain: bool = False
+    ) -> Dict[str, Any]:
+        """Enable/disable an agent for scheduling. Disable blocks NEW
+        placements; with drain=True running allocations finish naturally
+        (the TPU-fleet maintenance primitive — rotate a host out without
+        killing its trials), without drain they are killed and requeued as
+        infra failures (operator action, not the trial's fault — no
+        restart-budget charge). State persists across master restarts and
+        agent re-registrations until explicitly enabled."""
+        # RMW of the shared kv blob under a lock: concurrent admin calls
+        # (drain host A while disabling a slot on host B) must not
+        # overwrite each other's persisted entry — the in-memory state
+        # would still look right, and the divergence would only surface
+        # as a silently re-enabled host at the next restart.
+        with self._admin_kv_lock:
+            states = self.db.get_kv(self.AGENT_ADMIN_KV) or {}
+            entry = states.setdefault(agent_id, {})
+            if enabled:
+                entry.pop("disabled", None)
+                entry.pop("drain", None)
+            else:
+                entry["disabled"] = True
+                entry["drain"] = bool(drain)
+            if not entry:
+                states.pop(agent_id, None)
+            self.db.set_kv(self.AGENT_ADMIN_KV, states)
+
+        self.agent_hub.set_admin(
+            agent_id, enabled=enabled, draining=(not enabled) and drain
+        )
+        occupants: List[str] = []
+        for pool in self.rm.pools.values():
+            occupants.extend(pool.set_agent_enabled(agent_id, enabled))
+        if not enabled and not drain:
+            # Plain disable: get the work off the host NOW (ref agent.go:300
+            # ForceKill when !drain). Mirror lose_agent's teardown — kill
+            # every member of each gang (a multi-host slice's survivors
+            # would fight the requeued trial for chips) — but the agent
+            # stays registered, just unschedulable.
+            for alloc_id in occupants:
+                assignment: Dict[str, int] = {}
+                for pool in self.rm.pools.values():
+                    assignment.update(pool.assignment_of(alloc_id) or {})
+                for member in assignment:
+                    self.agent_hub.enqueue(
+                        member, {"type": "KILL", "alloc_id": alloc_id}
+                    )
+                self.alloc_service.complete(
+                    alloc_id, exit_code=1,
+                    reason=f"agent {agent_id} disabled", infra=True,
+                )
+        return {
+            "id": agent_id, "enabled": enabled,
+            "draining": (not enabled) and drain,
+            "killed_allocations": [] if (enabled or drain) else occupants,
+        }
+
+    def set_slot_enabled(
+        self, agent_id: str, slot: int, enabled: bool
+    ) -> Dict[str, Any]:
+        """Slot-level enable/disable (ref api.proto EnableSlot): the chip
+        becomes invisible to new placements; running work is untouched
+        (on a TPU host per-slot force-kill would kill the whole gang —
+        use agent-level disable for that)."""
+        with self._admin_kv_lock:
+            states = self.db.get_kv(self.AGENT_ADMIN_KV) or {}
+            entry = states.setdefault(agent_id, {})
+            ids = set(entry.get("disabled_slot_ids", []))
+            if enabled:
+                ids.discard(int(slot))
+            else:
+                ids.add(int(slot))
+            if ids:
+                entry["disabled_slot_ids"] = sorted(ids)
+            else:
+                entry.pop("disabled_slot_ids", None)
+            if not entry:
+                states.pop(agent_id, None)
+            self.db.set_kv(self.AGENT_ADMIN_KV, states)
+
+        self.agent_hub.set_admin(agent_id, disabled_slot_ids=sorted(ids))
+        for pool in self.rm.pools.values():
+            pool.set_agent_disabled_slots(agent_id, len(ids))
+        return {"id": agent_id, "disabled_slot_ids": sorted(ids)}
+
+    def _apply_agent_admin_state(self, agent_id: str, pool: str) -> None:
+        """Re-apply persisted admin state at (re)registration: a drained
+        host must stay drained across master restarts and agent-process
+        restarts until an operator enables it."""
+        entry = self.agent_admin_state(agent_id)
+        if not entry:
+            return
+        disabled = bool(entry.get("disabled"))
+        slot_ids = list(entry.get("disabled_slot_ids", []))
+        self.agent_hub.set_admin(
+            agent_id,
+            enabled=not disabled,
+            draining=disabled and bool(entry.get("drain")),
+            disabled_slot_ids=slot_ids,
+        )
+        if disabled:
+            self.rm.pool(pool).set_agent_enabled(agent_id, False)
+        if slot_ids:
+            self.rm.pool(pool).set_agent_disabled_slots(
+                agent_id, len(slot_ids)
+            )
+
     def attach_provisioner(self, service: Any) -> None:
         """Autoscale a pool (master/provisioner.py ProvisionerService).
 
@@ -982,6 +1197,7 @@ class Master:
             exp.trial_exited(
                 trial_id, alloc.exit_code or 0, alloc.exit_reason or "",
                 infra=alloc.infra_failure,
+                preempted=bool(getattr(alloc, "preempt_requested", False)),
             )
         # Freed slots (and any relaunch trial_exited just enqueued) should
         # schedule now, not at the next periodic tick.

@@ -534,6 +534,16 @@ class Database:
             (*args, exp_id),
         )
 
+    def set_experiment_config(self, exp_id: int, config: Dict[str, Any]) -> None:
+        """Persist a runtime config mutation (live resources updates —
+        priority/weight/max_slots; ref UpdateJobQueue): the stored config
+        must echo what scheduling actually uses, or a master restart
+        would silently revert the operator's change."""
+        self._execute(
+            "UPDATE experiments SET config=?, updated_at=? WHERE id=?",
+            (json.dumps(config), time.time(), exp_id),
+        )
+
     def set_experiment_archived(self, exp_id: int, archived: bool) -> None:
         self._execute(
             "UPDATE experiments SET archived=? WHERE id=?",

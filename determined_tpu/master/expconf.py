@@ -251,6 +251,24 @@ def validate(config: Dict[str, Any]) -> List[str]:
         prio = resources.get("priority", 50)
         if not isinstance(prio, int) or not 0 <= prio <= 99:
             errors.append("resources.priority must be an int in [0, 99]")
+        import math
+
+        weight = resources.get("weight", 1.0)
+        # isfinite: json accepts NaN/Infinity, and a NaN weight poisons
+        # every fair-share sum it ever touches.
+        if (
+            not isinstance(weight, (int, float))
+            or not math.isfinite(weight) or weight <= 0
+        ):
+            errors.append("resources.weight must be a finite positive number")
+        max_slots = resources.get("max_slots")
+        if max_slots is not None and (
+            not isinstance(max_slots, int)
+            or max_slots < max(1, slots if isinstance(slots, int) else 1)
+        ):
+            errors.append(
+                "resources.max_slots must be an int >= slots_per_trial"
+            )
     else:
         errors.append("resources must be an object")
 

@@ -349,13 +349,18 @@ class Experiment:
 
     def trial_exited(
         self, trial_id: int, exit_code: int, reason: str = "",
-        infra: bool = False,
+        infra: bool = False, preempted: bool = False,
     ) -> None:
         """Allocation for this trial ended (ref: trial.go:458 allocationExited).
 
         `infra`: the exit was the platform's fault (node lost, spot reclaim,
         pod evicted) — requeue from the latest checkpoint WITHOUT charging
-        max_restarts, which exists to bound *workload* crash loops."""
+        max_restarts, which exists to bound *workload* crash loops.
+
+        `preempted`: the master asked this allocation to checkpoint and
+        release its slots (scheduler preemption: a priority flip, a
+        fair-share rebalance). The clean exit that follows means
+        "checkpointed, requeue me" — NOT "work finished"."""
         with self._cond:
             rec = self.trials[trial_id]
             if rec.exited:
@@ -377,6 +382,21 @@ class Experiment:
                 self._process_ops(self.searcher.trial_closed(rec.request_id))
             elif clean and self.state == db_mod.PAUSED:
                 pass  # preempted by pause; relaunched on activate
+            elif clean and preempted:
+                # Scheduler preemption while ACTIVE: the trial obeyed the
+                # checkpoint-and-release request mid-op. Requeue to resume
+                # from that checkpoint — charging nothing (the preemption
+                # was scheduling's decision, not a workload failure), and
+                # above all NOT treating the early clean exit as the trial
+                # closing (that marked a 10%-done trial COMPLETED).
+                rec.run_id += 1
+                self.db.update_trial(trial_id, run_id=rec.run_id)
+                logger.info(
+                    "trial %d preempted (%s): requeued at run %d",
+                    trial_id, reason or "scheduler", rec.run_id,
+                )
+                if self.state == db_mod.ACTIVE:
+                    self.launcher.launch(self, rec)
             elif (
                 not clean and infra and not self.unmanaged
                 and rec.infra_requeues < INFRA_REQUEUE_CAP

@@ -111,7 +111,11 @@ def _marshal(agents: Dict[str, "object"]):
     n = len(items)
     ids = [a.id for a in items]
     free = np.fromiter((a.free for a in items), np.int32, count=n)
-    slots = np.fromiter((a.slots for a in items), np.int32, count=n)
+    # capacity, not raw slots: admin-disabled chips (slot-level disable)
+    # are invisible to placement. For idle agents (the only ones the
+    # multi-host path reads) capacity == slots, so this stays
+    # bit-equivalent to the python fit.
+    slots = np.fromiter((a.capacity for a in items), np.int32, count=n)
     enabled = np.fromiter((a.enabled for a in items), np.uint8, count=n)
     idle = np.fromiter((a.idle for a in items), np.uint8, count=n)
     order = sorted(range(n), key=lambda i: ids[i])

@@ -25,6 +25,10 @@ from determined_tpu.master.scheduler import (
 
 logger = logging.getLogger("determined_tpu.master")
 
+#: "Leave this field as is" sentinel for update_group/update_experiment_
+#: resources (None is a real value there: it clears the max_slots cap).
+UNSET = object()
+
 StartCb = Callable[[Request, Assignment], None]
 PreemptCb = Callable[[str], None]
 
@@ -146,11 +150,38 @@ class ResourcePool:
             agent = self._agents.get(agent_id)
             return list(agent.used) if agent else []
 
+    def set_agent_enabled(self, agent_id: str, enabled: bool) -> List[str]:
+        """Admin enable/disable for scheduling (ref: agentrm agent.go
+        DisableAgent). Disabled agents take no NEW placements; running
+        allocations keep their slots (the caller decides their fate —
+        drain leaves them, plain disable kills them). Returns the alloc
+        ids currently occupying the agent."""
+        with self._lock:
+            agent = self._agents.get(agent_id)
+            if agent is None:
+                return []
+            agent.enabled = enabled
+            occupants = list(agent.used)
+        self.tick()  # enabling may unblock pending gangs immediately
+        return occupants
+
+    def set_agent_disabled_slots(self, agent_id: str, n: int) -> None:
+        """Slot-level disable: n chips become invisible to placement
+        (capacity shrinks); running work is untouched (drain semantics —
+        see scheduler.Agent.disabled_slots)."""
+        with self._lock:
+            agent = self._agents.get(agent_id)
+            if agent is None:
+                return
+            agent.disabled_slots = max(0, min(int(n), agent.slots))
+        self.tick()
+
     def agents_snapshot(self) -> Dict[str, Dict]:
         with self._lock:
             return {
                 a.id: {"slots": a.slots, "used": sum(a.used.values()),
-                       "enabled": a.enabled}
+                       "enabled": a.enabled,
+                       "disabled_slots": a.disabled_slots}
                 for a in self._agents.values()
             }
 
@@ -214,6 +245,37 @@ class ResourcePool:
                     entry.on_preempt(entry.request.alloc_id)
             except Exception:  # noqa: BLE001
                 logger.exception("%s callback failed for %s", kind, entry.request.alloc_id)
+
+    def update_group(
+        self,
+        group_id: str,
+        *,
+        priority: Optional[int] = None,
+        weight: Optional[float] = None,
+        max_slots: Any = UNSET,
+    ) -> int:
+        """Live scheduling-attribute update for every request of a group
+        (ref: UpdateJobQueue / job priority+weight+maxSlots patches):
+        pending requests re-sort immediately, and the follow-up tick lets
+        the priority scheduler preempt on a flip. Returns the number of
+        requests touched."""
+        touched = 0
+        with self._lock:
+            for entry in self._entries.values():
+                if entry.request.group_id != group_id:
+                    continue
+                if priority is not None:
+                    entry.request.priority = int(priority)
+                if weight is not None:
+                    entry.request.weight = float(weight)
+                if max_slots is not UNSET:
+                    entry.request.max_slots = (
+                        int(max_slots) if max_slots is not None else None
+                    )
+                touched += 1
+        if touched:
+            self.tick()
+        return touched
 
     def reorder(self, alloc_id: str, *, ahead_of: Optional[str] = None) -> None:
         """Move a PENDING request ahead of another (or to the queue front).

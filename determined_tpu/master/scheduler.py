@@ -30,14 +30,26 @@ class Agent:
     enabled: bool = True
     # alloc_id -> slots in use on this agent
     used: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # Admin-disabled chips (slot-level disable, ref api.proto EnableSlot/
+    # DisableSlot): they reduce capacity for NEW placements; running work
+    # keeps its slots (drain semantics — on a TPU host, killing one slot's
+    # share of a gang kills the whole gang, so per-slot force-kill is an
+    # agent-level operation here).
+    disabled_slots: int = 0
+
+    @property
+    def capacity(self) -> int:
+        return max(0, self.slots - self.disabled_slots)
 
     @property
     def free(self) -> int:
-        return self.slots - sum(self.used.values()) if self.enabled else 0
+        return self.capacity - sum(self.used.values()) if self.enabled else 0
 
     @property
     def idle(self) -> bool:
-        return self.enabled and not self.used
+        # Multi-host slices use every chip on each member host, so a
+        # partially-disabled host can never join one.
+        return self.enabled and not self.used and self.disabled_slots == 0
 
 
 @dataclasses.dataclass
@@ -51,6 +63,11 @@ class Request:
     group_id: str = ""          # fair-share group (experiment id)
     preemptible: bool = True
     order: int = 0              # FIFO arrival order
+    #: Group-level concurrency cap (ref: job maxSlots / UpdateJobQueue):
+    #: the group (experiment) may hold at most this many slots at once.
+    #: Cap-blocked requests are SKIPPED, never queue-blocking, and never
+    #: trigger preemption.
+    max_slots: Optional[int] = None
 
 
 Assignment = Dict[str, int]  # agent_id -> slots
@@ -106,7 +123,7 @@ def _python_fit(
     idle = sorted((a for a in agents.values() if a.idle), key=lambda a: a.id)
     if not idle:
         return None
-    per_host = idle[0].slots
+    per_host = idle[0].slots  # idle implies disabled_slots == 0 (= capacity)
     if any(a.slots != per_host for a in idle) or per_host == 0:
         return None  # heterogeneous pools can't host a slice
     if request_slots % per_host != 0:
@@ -129,13 +146,33 @@ def _release(agents: Dict[str, Agent], alloc_id: str) -> None:
 
 def _clone_agents(agents: Dict[str, Agent]) -> Dict[str, Agent]:
     return {
-        k: Agent(a.id, a.slots, a.enabled, dict(a.used)) for k, a in agents.items()
+        k: Agent(a.id, a.slots, a.enabled, dict(a.used), a.disabled_slots)
+        for k, a in agents.items()
     }
 
 
 # ---------------------------------------------------------------------------
 # Schedulers
 # ---------------------------------------------------------------------------
+def _group_usage(pool: PoolState) -> Dict[str, int]:
+    """Slots currently held per group (running allocations only)."""
+    usage: Dict[str, int] = {}
+    for r in pool.running.values():
+        usage[r.group_id] = usage.get(r.group_id, 0) + r.slots
+    return usage
+
+
+def _cap_blocked(req: Request, usage: Dict[str, int]) -> bool:
+    return (
+        req.max_slots is not None
+        and usage.get(req.group_id, 0) + req.slots > req.max_slots
+    )
+
+
+def _any_caps(pool: PoolState) -> bool:
+    return any(r.max_slots is not None for r in pool.pending)
+
+
 def _native_batch_starts(
     ordered: List[Request], agents: Dict[str, Agent], *, stop_on_fail: bool
 ):
@@ -167,21 +204,28 @@ class FifoScheduler:
 
     def schedule(self, pool: PoolState) -> Decision:
         ordered = sorted(pool.pending, key=lambda r: r.order)
-        results = _native_batch_starts(ordered, pool.agents, stop_on_fail=True)
-        if results is not None:
-            to_start = [
-                (req, asg) for req, asg in zip(ordered, results)
-                if asg is not None
-            ]
-            return Decision(to_start, [])
+        if not _any_caps(pool):
+            results = _native_batch_starts(
+                ordered, pool.agents, stop_on_fail=True
+            )
+            if results is not None:
+                to_start = [
+                    (req, asg) for req, asg in zip(ordered, results)
+                    if asg is not None
+                ]
+                return Decision(to_start, [])
 
         agents = _clone_agents(pool.agents)
+        usage = _group_usage(pool)
         to_start = []
         for req in ordered:
+            if _cap_blocked(req, usage):
+                continue  # waiting on its own group's slots, not the fleet's
             asg = fit(req.slots, agents)
             if asg is None:
                 break
             _apply(agents, req.alloc_id, asg)
+            usage[req.group_id] = usage.get(req.group_id, 0) + req.slots
             to_start.append((req, asg))
         return Decision(to_start, [])
 
@@ -204,24 +248,32 @@ class PriorityScheduler:
         # whole queue. Preemption only matters when something DOESN'T fit,
         # so an all-placed result (or preemption off) is the full answer;
         # otherwise fall through to the python loop that interleaves
-        # victim selection with refits.
-        results = _native_batch_starts(
-            ordered, pool.agents, stop_on_fail=False
-        )
-        if results is not None and (
-            not self.preemption or all(a is not None for a in results)
-        ):
-            to_start = [
-                (req, asg) for req, asg in zip(ordered, results)
-                if asg is not None
-            ]
-            return Decision(to_start, [])
+        # victim selection with refits. Group caps (max_slots) interleave
+        # with placement order, so any capped request takes the python
+        # path too.
+        if not _any_caps(pool):
+            results = _native_batch_starts(
+                ordered, pool.agents, stop_on_fail=False
+            )
+            if results is not None and (
+                not self.preemption or all(a is not None for a in results)
+            ):
+                to_start = [
+                    (req, asg) for req, asg in zip(ordered, results)
+                    if asg is not None
+                ]
+                return Decision(to_start, [])
 
         agents = _clone_agents(pool.agents)
+        usage = _group_usage(pool)
         to_start: List[Tuple[Request, Assignment]] = []
         to_preempt: List[str] = []
 
         for req in ordered:
+            if _cap_blocked(req, usage):
+                # Over its own group's cap: not schedulable and must not
+                # preempt anyone to get there.
+                continue
             asg = fit(req.slots, agents)
             if asg is None and self.preemption:
                 # Victims: preemptible, strictly less important, largest
@@ -253,10 +305,12 @@ class PriorityScheduler:
                 # now, or lower-priority requests later in this loop would
                 # grab the slots the preemption just freed.
                 _apply(agents, req.alloc_id, asg)
+                usage[req.group_id] = usage.get(req.group_id, 0) + req.slots
                 continue
             if asg is None:
                 continue
             _apply(agents, req.alloc_id, asg)
+            usage[req.group_id] = usage.get(req.group_id, 0) + req.slots
             to_start.append((req, asg))
         return Decision(to_start, to_preempt)
 
@@ -271,7 +325,7 @@ class FairShareScheduler:
     """
 
     def schedule(self, pool: PoolState) -> Decision:
-        total_slots = sum(a.slots for a in pool.agents.values() if a.enabled)
+        total_slots = sum(a.capacity for a in pool.agents.values() if a.enabled)
         groups: Dict[str, List[Request]] = {}
         for r in list(pool.running.values()) + pool.pending:
             groups.setdefault(r.group_id, []).append(r)
@@ -280,9 +334,15 @@ class FairShareScheduler:
 
         # Iterative water-filling: groups wanting less than their share cede
         # the remainder to the others.
-        demand = {
-            g: sum(r.slots for r in rs) for g, rs in groups.items()
-        }
+        def _capped_demand(rs: List[Request]) -> int:
+            d = sum(r.slots for r in rs)
+            caps = [r.max_slots for r in rs if r.max_slots is not None]
+            # Demand above the group cap never competes for share; if the
+            # cap shrank below current usage, the over-share loop below
+            # preempts the group back down to it.
+            return min([d] + caps)
+
+        demand = {g: _capped_demand(rs) for g, rs in groups.items()}
         weight = {
             g: max((r.weight for r in rs), default=1.0) for g, rs in groups.items()
         }

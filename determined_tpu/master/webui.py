@@ -473,6 +473,15 @@ async function killTask(id) {
   refresh();
 }
 
+async function agentState(id, verb, drain) {
+  if (verb === 'disable' && !drain &&
+      !confirm(`Disable ${id}? Running allocations will be killed ` +
+               '(use drain to let them finish).')) return;
+  await post(`/api/v1/agents/${encodeURIComponent(id)}/${verb}`,
+             verb === 'disable' ? {drain: !!drain} : {});
+  refresh();
+}
+
 // --- admin -------------------------------------------------------------
 let adminUsers = [];
 async function setRole(i) {
@@ -555,11 +564,21 @@ async function refresh() {
     $('cluster').textContent = `· cluster ${info.cluster_id} · v${info.version}`;
     const agents = info.agents || {};
     $('agents').innerHTML =
-      '<tr><th>id</th><th>pool</th><th>slots</th><th>devices</th></tr>' +
+      '<tr><th>id</th><th>pool</th><th>slots</th><th>state</th>' +
+      '<th>devices</th><th></th></tr>' +
       Object.entries(agents).map(([id, a]) => {
         const kinds = [...new Set((a.devices || []).map(d => d.kind))]
           .filter(Boolean).join(', ');
-        return `<tr>${cell(id)}${cell(a.pool)}${cell(a.slots)}${cell(kinds)}</tr>`;
+        const st = a.enabled === false
+          ? (a.draining ? 'draining' : 'disabled') : 'enabled';
+        const nSlots = (a.disabled_slot_ids || []).length
+          ? `${a.slots} (-${a.disabled_slot_ids.length})` : `${a.slots}`;
+        const btn = a.enabled === false
+          ? `<button onclick="agentState('${esc(id)}','enable')">enable</button>`
+          : `<button onclick="agentState('${esc(id)}','disable',true)">drain</button>` +
+            `<button onclick="agentState('${esc(id)}','disable',false)">disable</button>`;
+        return `<tr>${cell(id)}${cell(a.pool)}${cell(nSlots)}${cell(st)}` +
+          `${cell(kinds)}<td>${btn}</td></tr>`;
       }).join('');
 
     $('pools').innerHTML = '<tr><th>pool</th><th>agents</th><th>slots</th>' +

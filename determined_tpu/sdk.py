@@ -155,6 +155,27 @@ class Experiment:
             time.sleep(interval)
         raise TimeoutError(f"experiment {self.id} still {self.state}")
 
+    _UNSET = object()
+
+    def set_resources(
+        self,
+        priority: Optional[int] = None,
+        weight: Optional[float] = None,
+        max_slots: Any = _UNSET,
+    ) -> Dict[str, Any]:
+        """Live scheduling update (ref: UpdateJobQueue): changes apply to
+        pending AND running requests; pass max_slots=None to clear."""
+        body: Dict[str, Any] = {}
+        if priority is not None:
+            body["priority"] = priority
+        if weight is not None:
+            body["weight"] = weight
+        if max_slots is not self._UNSET:
+            body["max_slots"] = max_slots
+        return self._session.patch(
+            f"/api/v1/experiments/{self.id}/resources", json_body=body
+        )
+
     def pause(self) -> None:
         self._session.post(f"/api/v1/experiments/{self.id}/pause")
 
@@ -308,6 +329,24 @@ class Determined:
             "/api/v1/users",
             json_body={"username": username, "password": password,
                        "role": role},
+        )
+
+    # -- agents ---------------------------------------------------------------
+    def list_agents(self) -> Dict[str, Any]:
+        return self._session.get("/api/v1/agents")["agents"]
+
+    def enable_agent(self, agent_id: str) -> Dict[str, Any]:
+        return self._session.post(f"/api/v1/agents/{agent_id}/enable")
+
+    def disable_agent(
+        self, agent_id: str, drain: bool = False
+    ) -> Dict[str, Any]:
+        """Take an agent out of scheduling (ref: DisableAgent). With
+        drain=True running allocations finish; otherwise they are killed
+        and requeued without a restart-budget charge."""
+        return self._session.post(
+            f"/api/v1/agents/{agent_id}/disable",
+            json_body={"drain": drain},
         )
 
     def set_user_active(self, username: str, active: bool) -> None:

@@ -1,0 +1,412 @@
+"""Agent drain/disable + slot-level enable/disable (VERDICT r4 missing #1;
+ref internal/api_agents.go:140,149 EnableAgent/DisableAgent,
+internal/rm/agentrm/agent.go:285-307 drain semantics, api.proto EnableSlot).
+
+Drain = block new placements, let running allocations finish (the TPU-fleet
+maintenance primitive). Plain disable = also kill running allocations,
+requeued as infra failures (no restart-budget charge). State persists
+across master restarts and agent re-registrations.
+"""
+import time
+
+import pytest
+import requests
+
+from determined_tpu.master.core import Master
+from determined_tpu.master.scheduler import Agent, fit
+from determined_tpu.master.rm import ResourcePool
+from determined_tpu.master.api_server import ApiServer
+
+
+# ---------------------------------------------------------------------------
+# Scheduler-level semantics
+# ---------------------------------------------------------------------------
+class TestSchedulerSemantics:
+    def test_disabled_agent_takes_no_new_work(self):
+        agents = {"a1": Agent("a1", 4, enabled=False), "a2": Agent("a2", 2)}
+        asg = fit(2, agents)
+        assert asg == {"a2": 2}
+        assert fit(4, agents) is None  # only the disabled agent could host
+
+    def test_disabled_agent_keeps_running_occupancy(self):
+        a = Agent("a1", 4, enabled=False, used={"x": 3})
+        assert a.free == 0
+        assert sum(a.used.values()) == 3  # occupants untouched
+
+    def test_disabled_slots_reduce_capacity(self):
+        agents = {"a1": Agent("a1", 4, disabled_slots=2)}
+        assert fit(2, agents) == {"a1": 2}
+        assert fit(3, agents) is None
+        assert agents["a1"].capacity == 2
+
+    def test_partially_disabled_host_excluded_from_slices(self):
+        # Multi-host slices use every chip of each member: a host with a
+        # disabled chip can never join one.
+        agents = {
+            "a1": Agent("a1", 4),
+            "a2": Agent("a2", 4, disabled_slots=1),
+            "a3": Agent("a3", 4),
+        }
+        asg = fit(8, agents)
+        assert asg == {"a1": 4, "a3": 4}
+        assert fit(12, agents) is None
+
+    def test_zero_slot_task_avoids_disabled(self):
+        agents = {"a1": Agent("a1", 4, enabled=False), "a2": Agent("a2", 1)}
+        assert fit(0, agents) == {"a2": 0}
+
+
+# ---------------------------------------------------------------------------
+# Pool-level
+# ---------------------------------------------------------------------------
+class TestPool:
+    def test_disable_returns_occupants_and_blocks_placement(self):
+        pool = ResourcePool("p")
+        pool.add_agent("a1", 2)
+        started = []
+        from determined_tpu.master.scheduler import Request
+
+        pool.submit(
+            Request(alloc_id="x", slots=2),
+            lambda r, asg: started.append((r.alloc_id, dict(asg))),
+            lambda a: None,
+        )
+        assert started == [("x", {"a1": 2})]
+        occupants = pool.set_agent_enabled("a1", False)
+        assert occupants == ["x"]
+        pool.submit(
+            Request(alloc_id="y", slots=1),
+            lambda r, asg: started.append((r.alloc_id, dict(asg))),
+            lambda a: None,
+        )
+        assert len(started) == 1  # y not placed while disabled
+        pool.release("x")
+        pool.set_agent_enabled("a1", True)
+        assert ("y", {"a1": 1}) in started
+
+    def test_slot_disable_shrinks_capacity(self):
+        pool = ResourcePool("p")
+        pool.add_agent("a1", 4)
+        pool.set_agent_disabled_slots("a1", 3)
+        snap = pool.agents_snapshot()
+        assert snap["a1"]["disabled_slots"] == 3
+        from determined_tpu.master.scheduler import Request
+
+        started = []
+        pool.submit(
+            Request(alloc_id="big", slots=2),
+            lambda r, asg: started.append(r.alloc_id), lambda a: None,
+        )
+        assert started == []  # capacity is 1
+        pool.set_agent_disabled_slots("a1", 0)
+        assert started == ["big"]
+
+
+# ---------------------------------------------------------------------------
+# Master-level persistence + kill path
+# ---------------------------------------------------------------------------
+class TestMasterAdminState:
+    def test_drain_survives_reregistration_and_restart(self, tmp_path):
+        db = str(tmp_path / "m.db")
+        master = Master(db_path=db)
+        try:
+            master.agent_registered("host-1", 4, "default")
+            res = master.set_agent_enabled("host-1", False, drain=True)
+            assert res["draining"] is True and res["killed_allocations"] == []
+            assert master.agent_hub.list()["host-1"]["enabled"] is False
+            assert master.agent_hub.list()["host-1"]["draining"] is True
+
+            # agent-process restart: re-registration must not clear it
+            master.agent_registered("host-1", 4, "default")
+            assert master.agent_hub.list()["host-1"]["enabled"] is False
+            snap = master.rm.pool("default").agents_snapshot()
+            assert snap["host-1"]["enabled"] is False
+        finally:
+            master.shutdown()
+
+        # master restart on the same DB: still drained
+        master2 = Master(db_path=db)
+        try:
+            master2.agent_registered("host-1", 4, "default")
+            assert master2.agent_hub.list()["host-1"]["enabled"] is False
+            master2.set_agent_enabled("host-1", True)
+            assert master2.agent_hub.list()["host-1"]["enabled"] is True
+            assert (
+                master2.rm.pool("default").agents_snapshot()["host-1"]["enabled"]
+                is True
+            )
+        finally:
+            master2.shutdown()
+
+    def test_slot_state_persists(self, tmp_path):
+        master = Master(db_path=str(tmp_path / "m.db"))
+        try:
+            master.agent_registered("host-1", 4, "default")
+            master.set_slot_enabled("host-1", 2, False)
+            master.set_slot_enabled("host-1", 3, False)
+            assert (
+                master.agent_hub.list()["host-1"]["disabled_slot_ids"] == [2, 3]
+            )
+            snap = master.rm.pool("default").agents_snapshot()
+            assert snap["host-1"]["disabled_slots"] == 2
+
+            master.agent_registered("host-1", 4, "default")  # re-register
+            snap = master.rm.pool("default").agents_snapshot()
+            assert snap["host-1"]["disabled_slots"] == 2
+
+            master.set_slot_enabled("host-1", 2, True)
+            assert (
+                master.agent_hub.list()["host-1"]["disabled_slot_ids"] == [3]
+            )
+        finally:
+            master.shutdown()
+
+    def test_plain_disable_kills_occupants_as_infra(self, tmp_path):
+        """Plain (non-drain) disable sends KILL for every member of each
+        gang on the agent and completes the allocation as an infra
+        failure (requeue, no restart-budget charge) — the agent stays
+        registered but unschedulable."""
+        master = Master(db_path=str(tmp_path / "m.db"))
+        try:
+            master.agent_registered("host-1", 2, "default")
+            master.agent_registered("host-2", 2, "default")
+            # Place a 4-slot gang across both hosts via the pool directly.
+            from determined_tpu.master.scheduler import Request
+
+            pool = master.rm.pool("default")
+            pool.submit(
+                Request(alloc_id="gang", slots=4),
+                lambda r, asg: None, lambda a: None,
+            )
+            assert pool.assignment_of("gang") == {"host-1": 2, "host-2": 2}
+            master.alloc_service.create(
+                "gang", task_id="trial-9", trial_id=9,
+                num_processes=2, slots=4,
+            )
+
+            res = master.set_agent_enabled("host-1", False, drain=False)
+            assert res["killed_allocations"] == ["gang"]
+            # KILL went to BOTH members of the gang (survivors would fight
+            # the requeued trial for chips).
+            for host in ("host-1", "host-2"):
+                actions = master.agent_hub.poll(host, timeout=0)
+                assert {"type": "KILL", "alloc_id": "gang"} in actions, host
+            alloc = master.alloc_service.get("gang")
+            assert alloc.state == "TERMINATED" and alloc.infra_failure is True
+            # slots freed everywhere; host-1 blocked, host-2 open
+            snap = pool.agents_snapshot()
+            assert snap["host-1"]["used"] == 0 and snap["host-2"]["used"] == 0
+            assert snap["host-1"]["enabled"] is False
+        finally:
+            master.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# API surface: admin gating + slot validation
+# ---------------------------------------------------------------------------
+class TestDrainE2E:
+    """Full-path drain/disable against a live devcluster: real agents,
+    real trial subprocesses."""
+
+    @pytest.fixture(scope="class")
+    def cluster(self):
+        from determined_tpu.devcluster import DevCluster
+
+        with DevCluster(n_agents=2, slots_per_agent=1) as dc:
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if len(dc.master.agent_hub.list()) == 2:
+                    break
+                time.sleep(0.2)
+            assert len(dc.master.agent_hub.list()) == 2
+            yield dc
+
+    @staticmethod
+    def _config(tmp_path, **over):
+        cfg = {
+            "entrypoint": "determined_tpu.exec.builtin_trials:SyntheticTrial",
+            "searcher": {"name": "single", "max_length": 3, "metric": "loss"},
+            "hyperparameters": {
+                "model": "mnist-mlp", "batch_size": 16, "lr": 1e-3,
+            },
+            "resources": {"slots_per_trial": 1},
+            "scheduling_unit": 1,
+            "checkpoint_storage": {
+                "type": "shared_fs", "host_path": str(tmp_path / "ckpt"),
+            },
+            "environment": {"jax_platform": "cpu"},
+            "max_restarts": 0,
+        }
+        cfg.update(over)
+        return cfg
+
+    @staticmethod
+    def _wait_running_trial(cluster, exp_id, timeout=120):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for t in cluster.master.db.list_trials(exp_id):
+                if t["state"] == "ACTIVE" and t["steps_completed"] > 0:
+                    return t["id"]
+            time.sleep(0.3)
+        raise AssertionError("no trial started executing")
+
+    def test_drain_lets_trial_finish_blocks_new_work(self, cluster, tmp_path):
+        cfg = self._config(
+            tmp_path,
+            searcher={"name": "single", "max_length": 12, "metric": "loss"},
+            hyperparameters={
+                "model": "mnist-mlp", "batch_size": 16, "lr": 1e-3,
+                "sleep_s": 0.4,
+            },
+        )
+        exp_id = cluster.create_experiment(cfg)
+        trial_id = self._wait_running_trial(cluster, exp_id)
+        # drain BOTH hosts: running work must finish, nothing new starts
+        for aid in cluster.master.agent_hub.list():
+            r = requests.post(
+                f"{cluster.api.url}/api/v1/agents/{aid}/disable",
+                json={"drain": True}, timeout=10,
+            )
+            r.raise_for_status()
+            assert r.json()["killed_allocations"] == []
+
+        exp2 = cluster.create_experiment(self._config(tmp_path))
+        state = cluster.wait_experiment(exp_id, timeout=180)
+        assert state == "COMPLETED"
+        t = cluster.master.db.get_trial(trial_id)
+        assert t["state"] == "COMPLETED"
+        assert t["restarts"] == 0  # drained, not restarted
+
+        # exp2 must still be waiting (every host drained): trial rows are
+        # created ACTIVE by the searcher, so "not placed" is zero slots
+        # used on every agent and zero steps executed.
+        time.sleep(2.0)
+        assert cluster.master.db.get_experiment(exp2)["state"] not in (
+            "COMPLETED", "ERRORED",
+        )
+        snap = cluster.master.rm.pool().agents_snapshot()
+        assert all(a["used"] == 0 for a in snap.values()), snap
+        assert all(
+            t["steps_completed"] == 0
+            for t in cluster.master.db.list_trials(exp2)
+        )
+
+        for aid in cluster.master.agent_hub.list():
+            requests.post(
+                f"{cluster.api.url}/api/v1/agents/{aid}/enable", timeout=10
+            ).raise_for_status()
+        assert cluster.wait_experiment(exp2, timeout=180) == "COMPLETED"
+
+    def test_plain_disable_requeues_on_other_agent(self, cluster, tmp_path):
+        cfg = self._config(
+            tmp_path,
+            searcher={"name": "single", "max_length": 25, "metric": "loss"},
+            hyperparameters={
+                "model": "mnist-mlp", "batch_size": 16, "lr": 1e-3,
+                "sleep_s": 0.4,
+            },
+        )
+        exp_id = cluster.create_experiment(cfg)
+        trial_id = self._wait_running_trial(cluster, exp_id)
+        alloc_id = cluster.master._trial_allocs[trial_id]
+        assignment = cluster.master.rm.pool().assignment_of(alloc_id)
+        victim_host = next(iter(assignment))
+
+        r = requests.post(
+            f"{cluster.api.url}/api/v1/agents/{victim_host}/disable",
+            json={}, timeout=10,
+        )
+        r.raise_for_status()
+        assert alloc_id in r.json()["killed_allocations"]
+        try:
+            # max_restarts=0 yet the trial completes: the operator kill is
+            # an infra requeue, not a workload failure.
+            assert cluster.wait_experiment(exp_id, timeout=240) == "COMPLETED"
+            t = cluster.master.db.get_trial(trial_id)
+            assert t["state"] == "COMPLETED"
+            assert t["restarts"] == 0
+            assert t["infra_requeues"] >= 1
+            # and a NEW run (fresh allocation) finished the trial
+            assert t["run_id"] >= 1
+        finally:
+            requests.post(
+                f"{cluster.api.url}/api/v1/agents/{victim_host}/enable",
+                timeout=10,
+            ).raise_for_status()
+
+
+class TestApi:
+    @pytest.fixture()
+    def secured(self, tmp_path):
+        master = Master(
+            db_path=str(tmp_path / "m.db"),
+            users={
+                "root": "rootpw",
+                "eve": {"password": "evepw", "role": "editor"},
+            },
+        )
+        api = ApiServer(master)
+        api.start()
+        master.external_url = api.url
+        master.agent_registered("host-1", 4, "default")
+        yield master, api
+        api.stop()
+        master.shutdown()
+
+    @staticmethod
+    def _login(url, user, pw):
+        r = requests.post(
+            f"{url}/api/v1/auth/login",
+            json={"username": user, "password": pw}, timeout=10,
+        )
+        r.raise_for_status()
+        return {"Authorization": "Bearer " + r.json()["token"]}
+
+    def test_admin_only(self, secured):
+        master, api = secured
+        eve = self._login(api.url, "eve", "evepw")
+        root = self._login(api.url, "root", "rootpw")
+        assert requests.post(
+            f"{api.url}/api/v1/agents/host-1/disable",
+            json={"drain": True}, headers=eve, timeout=10,
+        ).status_code == 403
+        # agent tokens can't disable their peers
+        atok = master.auth.issue_agent_token("host-1")
+        assert requests.post(
+            f"{api.url}/api/v1/agents/host-1/disable",
+            json={}, headers={"Authorization": "Bearer " + atok}, timeout=10,
+        ).status_code == 403
+        r = requests.post(
+            f"{api.url}/api/v1/agents/host-1/disable",
+            json={"drain": True}, headers=root, timeout=10,
+        )
+        assert r.status_code == 200 and r.json()["draining"] is True
+        # visible in the pools API
+        pools = requests.get(
+            f"{api.url}/api/v1/resource-pools", headers=root, timeout=10
+        ).json()["resource_pools"]
+        default = next(p for p in pools if p["name"] == "default")
+        assert default["agents_disabled"] == 1
+        assert default["slots_disabled"] == 4
+        r = requests.post(
+            f"{api.url}/api/v1/agents/host-1/enable", headers=root, timeout=10
+        )
+        assert r.status_code == 200 and r.json()["enabled"] is True
+
+    def test_unknown_agent_and_slot_404(self, secured):
+        _, api = secured
+        root = self._login(api.url, "root", "rootpw")
+        assert requests.post(
+            f"{api.url}/api/v1/agents/nope/disable",
+            json={}, headers=root, timeout=10,
+        ).status_code == 404
+        assert requests.post(
+            f"{api.url}/api/v1/agents/host-1/slots/9/disable",
+            headers=root, timeout=10,
+        ).status_code == 404
+        r = requests.post(
+            f"{api.url}/api/v1/agents/host-1/slots/1/disable",
+            headers=root, timeout=10,
+        )
+        assert r.status_code == 200
+        assert r.json()["disabled_slot_ids"] == [1]

@@ -1,0 +1,294 @@
+"""Runtime job scheduling updates: live priority/weight/max_slots
+(VERDICT r4 missing #2; ref UpdateJobQueue api.proto:1110, det experiment
+set priority cli/experiment.py:870) + group-level max_slots caps.
+"""
+import time
+
+import pytest
+import requests
+
+from determined_tpu.master.scheduler import (
+    Agent,
+    FairShareScheduler,
+    FifoScheduler,
+    PoolState,
+    PriorityScheduler,
+    Request,
+)
+from determined_tpu.master.rm import ResourcePool
+
+
+def _state(agents, pending, running=(), assignments=None):
+    return PoolState(
+        agents=agents,
+        pending=list(pending),
+        running={r.alloc_id: r for r in running},
+        assignments=assignments or {},
+    )
+
+
+class TestMaxSlotsCaps:
+    def test_priority_cap_limits_group_concurrency(self):
+        agents = {"a1": Agent("a1", 4)}
+        reqs = [
+            Request(alloc_id="g1.a", slots=2, group_id="g1", max_slots=2,
+                    order=1),
+            Request(alloc_id="g1.b", slots=2, group_id="g1", max_slots=2,
+                    order=2),
+            Request(alloc_id="g2.a", slots=2, group_id="g2", order=3),
+        ]
+        d = PriorityScheduler().schedule(_state(agents, reqs))
+        started = {r.alloc_id for r, _ in d.to_start}
+        # g1 places ONE 2-slot gang (cap 2); its second request is
+        # cap-blocked but must not block g2.
+        assert started == {"g1.a", "g2.a"}
+
+    def test_cap_counts_running_slots(self):
+        agents = {"a1": Agent("a1", 4, used={"g1.run": 2})}
+        running = [
+            Request(alloc_id="g1.run", slots=2, group_id="g1", max_slots=2)
+        ]
+        pending = [
+            Request(alloc_id="g1.b", slots=2, group_id="g1", max_slots=2)
+        ]
+        d = PriorityScheduler().schedule(
+            _state(agents, pending, running, {"g1.run": {"a1": 2}})
+        )
+        assert d.to_start == [] and d.to_preempt == []
+
+    def test_cap_blocked_never_preempts(self):
+        # g1 (priority 10, cap 2, already holding 2) must not preempt the
+        # lower-priority g2 to go over its own cap.
+        agents = {"a1": Agent("a1", 4, used={"g1.run": 2, "g2.run": 2})}
+        running = [
+            Request(alloc_id="g1.run", slots=2, group_id="g1", priority=10,
+                    max_slots=2),
+            Request(alloc_id="g2.run", slots=2, group_id="g2", priority=90),
+        ]
+        pending = [
+            Request(alloc_id="g1.b", slots=2, group_id="g1", priority=10,
+                    max_slots=2),
+        ]
+        d = PriorityScheduler().schedule(
+            _state(agents, pending, running,
+                   {"g1.run": {"a1": 2}, "g2.run": {"a1": 2}})
+        )
+        assert d.to_preempt == [] and d.to_start == []
+
+    def test_fifo_skips_cap_blocked_without_blocking_queue(self):
+        agents = {"a1": Agent("a1", 2)}
+        pending = [
+            Request(alloc_id="g1.a", slots=1, group_id="g1", max_slots=1,
+                    order=1),
+            Request(alloc_id="g1.b", slots=1, group_id="g1", max_slots=1,
+                    order=2),
+            Request(alloc_id="g2.a", slots=1, group_id="g2", order=3),
+        ]
+        d = FifoScheduler().schedule(_state(agents, pending))
+        assert {r.alloc_id for r, _ in d.to_start} == {"g1.a", "g2.a"}
+
+    def test_fair_share_caps_demand(self):
+        # Two equal-weight groups on 8 slots: uncapped they'd get 4 each;
+        # g1's cap of 2 cedes the rest to g2.
+        agents = {"a1": Agent("a1", 8)}
+        pending = [
+            Request(alloc_id=f"g1.{i}", slots=1, group_id="g1", max_slots=2,
+                    order=i) for i in range(4)
+        ] + [
+            Request(alloc_id=f"g2.{i}", slots=1, group_id="g2", order=10 + i)
+            for i in range(6)
+        ]
+        d = FairShareScheduler().schedule(_state(agents, pending))
+        g1 = [r.alloc_id for r, _ in d.to_start if r.group_id == "g1"]
+        g2 = [r.alloc_id for r, _ in d.to_start if r.group_id == "g2"]
+        assert len(g1) == 2 and len(g2) == 6
+
+    def test_fair_share_preempts_down_to_shrunken_cap(self):
+        agents = {"a1": Agent("a1", 8, used={"g1.0": 2, "g1.1": 2})}
+        running = [
+            Request(alloc_id="g1.0", slots=2, group_id="g1", max_slots=2,
+                    order=1),
+            Request(alloc_id="g1.1", slots=2, group_id="g1", max_slots=2,
+                    order=2),
+        ]
+        d = FairShareScheduler().schedule(
+            _state(agents, [], running,
+                   {"g1.0": {"a1": 2}, "g1.1": {"a1": 2}})
+        )
+        # over the (shrunken) cap: newest goes
+        assert d.to_preempt == ["g1.1"]
+
+
+class TestUpdateGroup:
+    def test_update_reorders_pending_and_ticks(self):
+        pool = ResourcePool("p", {"type": "priority"})
+        pool.add_agent("a1", 1)
+        started = []
+        pool.submit(Request(alloc_id="hold", slots=1, group_id="h"),
+                    lambda r, a: started.append(r.alloc_id), lambda a: None)
+        pool.submit(Request(alloc_id="x", slots=1, group_id="gx", priority=50),
+                    lambda r, a: started.append(r.alloc_id), lambda a: None)
+        pool.submit(Request(alloc_id="y", slots=1, group_id="gy", priority=50),
+                    lambda r, a: started.append(r.alloc_id), lambda a: None)
+        assert started == ["hold"]  # x, y queued behind the held slot
+        # weight/priority update touches every entry of the group
+        assert pool.update_group("gy", priority=10) == 1
+        pool.release("hold")
+        assert started[1] == "y"  # priority flip won over arrival order
+
+    def test_update_group_returns_zero_for_unknown(self):
+        pool = ResourcePool("p")
+        assert pool.update_group("nope", priority=1) == 0
+
+
+class TestLiveUpdateE2E:
+    """Full-path live updates on a devcluster: priority flip mid-run
+    causes preemption of the running lower-priority experiment."""
+
+    @pytest.fixture(scope="class")
+    def cluster(self):
+        from determined_tpu.devcluster import DevCluster
+
+        with DevCluster(
+            n_agents=1, slots_per_agent=1,
+            scheduler={"type": "priority", "preemption": True},
+            preempt_timeout_s=60.0,
+        ) as dc:
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if len(dc.master.agent_hub.list()) == 1:
+                    break
+                time.sleep(0.2)
+            assert len(dc.master.agent_hub.list()) == 1
+            yield dc
+
+    @staticmethod
+    def _config(tmp_path, **over):
+        cfg = {
+            "entrypoint": "determined_tpu.exec.builtin_trials:SyntheticTrial",
+            "searcher": {"name": "single", "max_length": 30, "metric": "loss"},
+            "hyperparameters": {
+                "model": "mnist-mlp", "batch_size": 16, "lr": 1e-3,
+                "sleep_s": 0.5,
+            },
+            "resources": {"slots_per_trial": 1},
+            "scheduling_unit": 1,
+            "min_checkpoint_period": {"batches": 2},
+            "checkpoint_storage": {
+                "type": "shared_fs", "host_path": str(tmp_path / "ckpt"),
+            },
+            "environment": {"jax_platform": "cpu"},
+            "max_restarts": 0,
+        }
+        cfg.update(over)
+        return cfg
+
+    @staticmethod
+    def _placed_alloc(cluster, exp_id):
+        """(trial_id, alloc_id) once the experiment's trial holds slots —
+        authoritative pool state, not the db's steps_completed (which a
+        single-searcher trial only reports at its one op completion)."""
+        for t in cluster.master.db.list_trials(exp_id):
+            alloc = cluster.master._trial_allocs.get(t["id"])
+            if alloc and cluster.master.rm.pool().assignment_of(alloc):
+                return t["id"], alloc
+        return None
+
+    def _wait_placed(self, cluster, exp_id, timeout=120):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            got = self._placed_alloc(cluster, exp_id)
+            if got:
+                return got
+            time.sleep(0.3)
+        raise AssertionError(f"experiment {exp_id} never placed")
+
+    def test_priority_flip_preempts_running_experiment(
+        self, cluster, tmp_path
+    ):
+        exp1 = cluster.create_experiment(self._config(tmp_path))
+        t1, alloc1 = self._wait_placed(cluster, exp1)
+
+        # same priority: exp2 queues behind exp1 (no preemption on ties)
+        exp2 = cluster.create_experiment(self._config(
+            tmp_path,
+            searcher={"name": "single", "max_length": 3, "metric": "loss"},
+            hyperparameters={
+                "model": "mnist-mlp", "batch_size": 16, "lr": 1e-3,
+            },
+        ))
+        time.sleep(2.0)
+        assert self._placed_alloc(cluster, exp2) is None
+
+        # the live flip: demote exp1 below exp2 → preemption
+        r = requests.patch(
+            f"{cluster.api.url}/api/v1/experiments/{exp1}/resources",
+            json={"priority": 80}, timeout=10,
+        )
+        r.raise_for_status()
+        assert r.json()["resources"]["priority"] == 80
+        assert r.json()["live_requests_updated"] >= 1
+        # config echo persisted
+        cfg = cluster.master.db.get_experiment(exp1)["config"]
+        assert cfg["resources"]["priority"] == 80
+
+        # exp2 takes the slot over (the preemption in action) while exp1
+        # is still unfinished
+        self._wait_placed(cluster, exp2)
+        assert cluster.master.db.get_experiment(exp1)["state"] not in (
+            "COMPLETED",
+        )
+        assert cluster.wait_experiment(exp2, timeout=180) == "COMPLETED"
+        # exp1 was checkpoint-preempted, resumes, and still completes
+        assert cluster.wait_experiment(exp1, timeout=300) == "COMPLETED"
+        t = cluster.master.db.get_trial(t1)
+        assert t["state"] == "COMPLETED"
+        assert t["run_id"] >= 1  # a second run finished it after preemption
+
+    def test_validation_and_404(self, cluster):
+        assert requests.patch(
+            f"{cluster.api.url}/api/v1/experiments/999999/resources",
+            json={"priority": 10}, timeout=10,
+        ).status_code == 404
+        exp_any = cluster.master.db.list_experiments()
+        if exp_any:
+            eid = exp_any[0]["id"]
+            for bad in (
+                {"priority": 200}, {"weight": -1}, {"max_slots": 0}, {},
+            ):
+                assert requests.patch(
+                    f"{cluster.api.url}/api/v1/experiments/{eid}/resources",
+                    json=bad, timeout=10,
+                ).status_code == 400, bad
+            # the server's json.loads accepts NaN/Infinity (requests'
+            # own serializer refuses them — hand-craft the body); a NaN
+            # weight would poison every fair-share sum forever
+            for lit in ('{"weight": NaN}', '{"weight": Infinity}'):
+                assert requests.patch(
+                    f"{cluster.api.url}/api/v1/experiments/{eid}/resources",
+                    data=lit, headers={"Content-Type": "application/json"},
+                    timeout=10,
+                ).status_code == 400, lit
+
+    def test_max_slots_cap_on_live_experiment(self, cluster, tmp_path):
+        """A capped experiment with 2 trials on a 1-slot cluster behaves
+        (serialized) and the cap round-trips through the API."""
+        cfg = self._config(
+            tmp_path,
+            searcher={
+                "name": "grid", "metric": "loss", "max_length": 2,
+            },
+            hyperparameters={
+                "model": "mnist-mlp", "batch_size": 16,
+                "lr": {"type": "categorical", "vals": [1e-3, 2e-3]},
+            },
+        )
+        cfg["resources"]["max_slots"] = 1
+        exp = cluster.create_experiment(cfg)
+        r = requests.patch(
+            f"{cluster.api.url}/api/v1/experiments/{exp}/resources",
+            json={"max_slots": None}, timeout=10,
+        )
+        r.raise_for_status()
+        assert "max_slots" not in r.json()["resources"]
+        assert cluster.wait_experiment(exp, timeout=300) == "COMPLETED"

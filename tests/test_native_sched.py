@@ -17,10 +17,13 @@ def _random_fleet(rng, n):
         slots = rng.choice([0, 1, 4, 4, 8])
         a = Agent(f"agent-{rng.randrange(10**6):06d}-{i}", slots,
                   enabled=rng.random() > 0.1)
-        # random load
+        # occasional admin-disabled chips (slot-level disable)
+        if slots and rng.random() < 0.15:
+            a.disabled_slots = rng.randrange(1, slots + 1)
+        # random load (within remaining capacity)
         for j in range(rng.randrange(0, 3)):
             take = rng.randrange(0, max(1, slots + 1))
-            if take and sum(a.used.values()) + take <= slots:
+            if take and sum(a.used.values()) + take <= a.capacity:
                 a.used[f"a{i}.{j}"] = take
         agents[a.id] = a
     return agents
