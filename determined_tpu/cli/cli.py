@@ -219,10 +219,17 @@ def exp_set_resources(field: str):
     cap."""
     def fn(args: argparse.Namespace) -> None:
         raw = args.value
-        value = (
-            None if field == "max_slots" and raw.lower() in ("none", "null")
-            else float(raw) if field == "weight" else int(raw)
-        )
+        try:
+            value = (
+                None
+                if field == "max_slots" and raw.lower() in ("none", "null")
+                else float(raw) if field == "weight" else int(raw)
+            )
+        except ValueError:
+            raise SystemExit(
+                f"invalid {field} value {raw!r}: expected a number"
+                + (" or 'none'" if field == "max_slots" else "")
+            )
         res = _session(args).patch(
             f"/api/v1/experiments/{args.experiment_id}/resources",
             json_body={field: value},

@@ -811,12 +811,16 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         next tick — the priority scheduler may preempt on a flip."""
         body = r.body
         kwargs: Dict[str, Any] = {}
-        if "priority" in body:
-            kwargs["priority"] = body["priority"]
-        if "weight" in body:
-            kwargs["weight"] = body["weight"]
+        for field in ("priority", "weight"):
+            if field in body:
+                if body[field] is None:
+                    # None means "not provided" downstream; accepting an
+                    # explicit null would 200 as a silent no-op while
+                    # reporting live requests updated.
+                    raise ApiError(400, f"{field} must not be null")
+                kwargs[field] = body[field]
         if "max_slots" in body:
-            kwargs["max_slots"] = body["max_slots"]
+            kwargs["max_slots"] = body["max_slots"]  # null clears the cap
         if not kwargs:
             raise ApiError(
                 400, "body must carry priority, weight, or max_slots"

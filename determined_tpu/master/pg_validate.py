@@ -1,0 +1,385 @@
+"""Serverless Postgres strictness gate (VERDICT r4 next #6).
+
+No Postgres server or client library exists in CI images, so dialect
+edges in `db_pg.py`'s emitted SQL used to ship silently and surface on an
+operator's live server. This module is a vendored, strict checker for the
+statement corpus OUR driver can emit — not a general SQL parser:
+
+- the Postgres DDL (`db_pg.pg_schema()`) is parsed into a catalog
+  (tables, columns, types, primary keys, unique indexes, serial ids);
+- every DML statement is checked against Postgres rules that differ from
+  SQLite's: `%s` placeholders only (no `?` may survive translation), no
+  SQLite-isms (AUTOINCREMENT/PRAGMA/instr()/ifnull()/`INSERT OR ...`/
+  strftime/GLOB/backticks), functions restricted to a Postgres whitelist,
+  double quotes are identifier quoting (a `"string"` literal is a bug),
+  `ON CONFLICT (col)` requires a unique index on col, `RETURNING id`
+  requires a serial id column, INSERT/UPDATE column lists must exist;
+- bound parameters are checked where dialects diverge at runtime:
+  Postgres rejects negative LIMIT/OFFSET values that SQLite silently
+  treats as "no limit".
+
+The corpus comes from tests/test_db_conformance.py's recording backend,
+which drives the whole conformance suite and captures every translated
+statement (plus schema + migrations). A statement class this gate has
+never seen fails loudly rather than validating vacuously.
+
+Live validation (the gate's complement, one command, needs Docker):
+
+    docker run -d --name dtpu-pg -e POSTGRES_PASSWORD=pw -p 5432:5432 postgres:16
+    DTPU_PG_DSN=postgresql://postgres:pw@127.0.0.1:5432/postgres \
+        python -m pytest tests/test_db_conformance.py -q
+
+Ref: the reference validates against live Postgres in CI
+(`master/internal/db/migrations.go` + circleci postgres services).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+#: Types our DDL may use (exact, post-transform). BLOB/REAL appearing in a
+#: Postgres statement means pg_schema()'s rewrite missed a spot.
+PG_TYPES = {
+    "TEXT", "INTEGER", "BIGINT", "BIGSERIAL", "DOUBLE PRECISION", "BYTEA",
+}
+
+#: Functions our statements may call — everything here exists in Postgres
+#: with the argument shapes we use. (instr/ifnull/julianday etc. are
+#: SQLite-only and must have been rewritten before this gate sees them.)
+PG_FUNCTIONS = {
+    "count", "max", "min", "sum", "avg", "length", "lower", "upper",
+    "coalesce", "strpos", "greatest", "setval", "pg_get_serial_sequence",
+    "random", "abs",
+}
+
+#: SQL keywords that look like function calls after `name(`.
+_NOT_FUNCTIONS = {
+    "values", "in", "and", "or", "not", "where", "on", "exists", "select",
+    "insert", "update", "delete", "set", "into", "from", "conflict",
+    "unique", "key", "primary", "references", "check", "default",
+    "constraint", "index", "table", "if", "asc", "desc", "by", "limit",
+    "offset", "order", "group", "having", "returning", "do", "nothing",
+    "using", "as", "distinct", "between", "like", "is", "null", "all",
+}
+
+_SQLITE_ISMS = [
+    (re.compile(r"\bAUTOINCREMENT\b", re.I), "AUTOINCREMENT"),
+    (re.compile(r"\bPRAGMA\b", re.I), "PRAGMA"),
+    (re.compile(r"\binstr\s*\(", re.I), "instr()"),
+    (re.compile(r"\bifnull\s*\(", re.I), "ifnull() (use coalesce)"),
+    (re.compile(r"\bjulianday\b", re.I), "julianday"),
+    (re.compile(r"\bstrftime\b", re.I), "strftime"),
+    (re.compile(r"\bdatetime\s*\(", re.I), "datetime()"),
+    (re.compile(r"\bGLOB\b", re.I), "GLOB"),
+    (re.compile(r"\bINSERT\s+OR\s+(IGNORE|REPLACE)\b", re.I),
+     "INSERT OR IGNORE/REPLACE"),
+    (re.compile(r"`"), "backtick-quoted identifier"),
+]
+
+
+class Catalog:
+    """Tables parsed from the Postgres DDL: column names/types, primary
+    keys, unique columns, serial id columns."""
+
+    def __init__(self) -> None:
+        self.tables: Dict[str, Dict[str, str]] = {}
+        self.pk: Dict[str, Set[str]] = {}
+        self.unique: Dict[str, Set[str]] = {}
+        self.serial: Dict[str, Set[str]] = {}
+
+    def has_unique_on(self, table: str, col: str) -> bool:
+        return (
+            col in self.pk.get(table, set())
+            or col in self.unique.get(table, set())
+        )
+
+
+_CREATE_TABLE_RE = re.compile(
+    r"CREATE\s+TABLE\s+(?:IF\s+NOT\s+EXISTS\s+)?(\w+)\s*\((.*)\)\s*$",
+    re.I | re.S,
+)
+_CREATE_INDEX_RE = re.compile(
+    r"CREATE\s+(UNIQUE\s+)?INDEX\s+(?:IF\s+NOT\s+EXISTS\s+)?\w+\s+ON\s+"
+    r"(\w+)\s*\((\w+)", re.I,
+)
+
+
+def _split_top_level(body: str) -> List[str]:
+    """Split column/constraint defs on commas outside parentheses."""
+    parts, depth, cur = [], 0, []
+    for ch in body:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur))
+    return [p.strip() for p in parts if p.strip()]
+
+
+def parse_catalog(ddl: str) -> Tuple[Catalog, List[str]]:
+    """Parse the transformed DDL; returns (catalog, errors) — type errors
+    in the DDL itself are part of the gate."""
+    cat = Catalog()
+    errors: List[str] = []
+    # SQL line comments carry prose (quotes, commas) that would derail the
+    # column splitter; Postgres strips them the same way.
+    ddl = re.sub(r"--[^\n]*", "", ddl)
+    for raw in ddl.split(";"):
+        stmt = raw.strip()
+        if not stmt:
+            continue
+        stripped, strerrs = _strip_strings(stmt)
+        errors.extend(f"DDL: {e} in: {stmt[:70]}" for e in strerrs)
+        m = _CREATE_TABLE_RE.match(stripped)
+        if m:
+            table = m.group(1).lower()
+            cols: Dict[str, str] = {}
+            pk: Set[str] = set()
+            uniq: Set[str] = set()
+            serial: Set[str] = set()
+            for item in _split_top_level(m.group(2)):
+                head = item.split()[0].upper()
+                if head in ("PRIMARY", "UNIQUE", "FOREIGN", "CHECK",
+                            "CONSTRAINT"):
+                    tm = re.match(
+                        r"(PRIMARY\s+KEY|UNIQUE)\s*\(([^)]*)\)", item, re.I
+                    )
+                    if tm:
+                        names = {
+                            c.strip().lower()
+                            for c in tm.group(2).split(",")
+                        }
+                        if len(names) == 1:  # composite keys don't make a
+                            target = pk if "PRIMARY" in tm.group(1).upper() \
+                                else uniq  # single-column conflict target
+                            target.update(names)
+                    continue
+                cm = re.match(r"(\w+)\s+(.*)$", item, re.S)
+                if not cm:
+                    errors.append(f"DDL: unparsable column def: {item[:60]}")
+                    continue
+                name = cm.group(1).lower()
+                rest = " ".join(cm.group(2).split())
+                typ = None
+                for t in sorted(PG_TYPES, key=len, reverse=True):
+                    if rest.upper().startswith(t):
+                        typ = t
+                        break
+                if typ is None:
+                    errors.append(
+                        f"DDL: {table}.{name}: type not in the Postgres "
+                        f"whitelist: {rest[:40]!r}"
+                    )
+                    typ = "?"
+                cols[name] = typ
+                rest_up = rest.upper()
+                if "PRIMARY KEY" in rest_up:
+                    pk.add(name)
+                if re.search(r"\bUNIQUE\b", rest_up):
+                    uniq.add(name)
+                if typ == "BIGSERIAL":
+                    serial.add(name)
+            cat.tables[table] = cols
+            cat.pk[table] = pk
+            cat.unique[table] = uniq
+            cat.serial[table] = serial
+            continue
+        im = _CREATE_INDEX_RE.match(stripped)
+        if im:
+            if im.group(1):
+                cat.unique.setdefault(im.group(2).lower(), set()).add(
+                    im.group(3).lower()
+                )
+            continue
+        # Remaining DDL statements must be known kinds.
+        if not re.match(r"(INSERT|SELECT\s+setval)\b", stripped, re.I):
+            errors.append(f"DDL: unknown statement kind: {stmt[:60]}")
+    return cat, errors
+
+
+def _strip_strings(sql: str) -> Tuple[str, List[str]]:
+    """Remove single-quoted literals (with '' escaping); flag double
+    quotes — in Postgres those quote IDENTIFIERS, and our statements never
+    intend that (a '"..."' string literal silently becomes a column ref)."""
+    errors = []
+    if '"' in sql:
+        errors.append('double-quote in statement (PG identifier quoting)')
+    out, i, n = [], 0, len(sql)
+    while i < n:
+        ch = sql[i]
+        if ch == "'":
+            i += 1
+            while i < n:
+                if sql[i] == "'" and i + 1 < n and sql[i + 1] == "'":
+                    i += 2
+                    continue
+                if sql[i] == "'":
+                    break
+                i += 1
+            if i >= n:
+                errors.append("unterminated string literal")
+            out.append("''")
+            i += 1
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out), errors
+
+
+def _check_functions(stripped: str) -> List[str]:
+    errors = []
+    # `INTO table (cols)` / `TABLE name (defs)` look like calls; drop the
+    # keyword-prefixed forms before scanning.
+    stripped = re.sub(
+        r"\b(INTO|TABLE|EXISTS|UPDATE|FROM|JOIN)\s+\w+\s*\(", "(",
+        stripped, flags=re.I,
+    )
+    for m in re.finditer(r"\b([A-Za-z_][A-Za-z_0-9]*)\s*\(", stripped):
+        name = m.group(1).lower()
+        if name in _NOT_FUNCTIONS:
+            continue
+        if name not in PG_FUNCTIONS:
+            errors.append(f"function {name}() not in the Postgres whitelist")
+    return errors
+
+
+def _placeholder_positions(stripped: str) -> List[int]:
+    return [m.start() for m in re.finditer(r"%s", stripped)]
+
+
+def _check_limit_offset_args(
+    stripped: str, args: Optional[Sequence[Any]]
+) -> List[str]:
+    """Postgres rejects negative LIMIT/OFFSET; SQLite reads LIMIT -1 as
+    'no limit' — the classic silent divergence."""
+    errors = []
+    for kw in ("LIMIT", "OFFSET"):
+        for m in re.finditer(rf"\b{kw}\s+(-?\d+|%s)", stripped, re.I):
+            tok = m.group(1)
+            if tok != "%s":
+                if int(tok) < 0:
+                    errors.append(f"negative literal {kw}")
+                continue
+            if args is None:
+                continue
+            idx = _placeholder_positions(stripped[:m.start(1) + 2])
+            pos = len(idx) - 1
+            if pos < len(args):
+                val = args[pos]
+                if val is not None and int(val) < 0:
+                    errors.append(
+                        f"{kw} bound to negative value {val!r} "
+                        "(SQLite: no limit; Postgres: error)"
+                    )
+    return errors
+
+
+def validate_statement(
+    sql: str, args: Optional[Sequence[Any]] = None,
+    cat: Optional[Catalog] = None,
+) -> List[str]:
+    """Errors for one translated statement (+ optionally its bound args)."""
+    errors: List[str] = []
+    stripped, strerrs = _strip_strings(sql)
+    errors.extend(strerrs)
+    if "?" in stripped:
+        errors.append("untranslated '?' placeholder")
+    for rx, label in _SQLITE_ISMS:
+        if rx.search(stripped):
+            errors.append(f"SQLite-ism: {label}")
+    errors.extend(_check_functions(stripped))
+    errors.extend(_check_limit_offset_args(stripped, args))
+    if args is not None:
+        nph = len(_placeholder_positions(stripped))
+        if nph != len(args):
+            errors.append(
+                f"{nph} placeholders but {len(args)} bound args"
+            )
+    if cat is None:
+        return errors
+
+    s = stripped.strip()
+    im = re.match(
+        r"INSERT\s+INTO\s+(\w+)\s*\(([^)]*)\)", s, re.I
+    )
+    if im:
+        table = im.group(1).lower()
+        cols = [c.strip().lower() for c in im.group(2).split(",") if c.strip()]
+        if table not in cat.tables:
+            errors.append(f"INSERT into unknown table {table}")
+        else:
+            for c in cols:
+                if c not in cat.tables[table]:
+                    errors.append(f"INSERT column {table}.{c} not in schema")
+        cm = re.search(r"ON\s+CONFLICT\s*\((\w+)\)", s, re.I)
+        if cm and table in cat.tables:
+            col = cm.group(1).lower()
+            if not cat.has_unique_on(table, col):
+                errors.append(
+                    f"ON CONFLICT ({col}) on {table}: Postgres requires a "
+                    "unique index on the conflict target"
+                )
+        if re.search(r"RETURNING\s+id\b", s, re.I) and table in cat.tables:
+            if "id" not in cat.serial.get(table, set()):
+                errors.append(
+                    f"RETURNING id on {table}: no serial id column"
+                )
+    um = re.match(r"UPDATE\s+(\w+)\s+SET\s+(.*?)(\s+WHERE\s+|$)", s,
+                  re.I | re.S)
+    if um:
+        table = um.group(1).lower()
+        if table not in cat.tables:
+            errors.append(f"UPDATE of unknown table {table}")
+        else:
+            for assign in _split_top_level(um.group(2)):
+                am = re.match(r"(\w+)\s*=", assign)
+                if am and am.group(1).lower() not in cat.tables[table]:
+                    errors.append(
+                        f"UPDATE column {table}.{am.group(1)} not in schema"
+                    )
+    for dm in re.finditer(r"(?:DELETE\s+FROM|FROM)\s+(\w+)", s, re.I):
+        table = dm.group(1).lower()
+        if table not in cat.tables and table != "sqlite_master":
+            errors.append(f"reference to unknown table {table}")
+    return errors
+
+
+def validate_corpus(
+    corpus: Sequence[Tuple[str, Optional[Sequence[Any]]]],
+    ddl: Optional[str] = None,
+    migrations: Optional[Sequence[str]] = None,
+) -> List[str]:
+    """Validate an entire recorded corpus (+DDL+migrations); returns the
+    flat error list, each entry prefixed with the offending statement."""
+    errors: List[str] = []
+    cat: Optional[Catalog] = None
+    if ddl is not None:
+        cat, ddl_errors = parse_catalog(ddl)
+        errors.extend(ddl_errors)
+    for stmt in migrations or []:
+        am = re.match(
+            r"ALTER\s+TABLE\s+(\w+)\s+ADD\s+COLUMN\s+\w+\s+(\w+(?:\s+\w+)?)",
+            stmt.strip(), re.I,
+        )
+        if not am:
+            errors.append(f"migration not ALTER..ADD COLUMN: {stmt[:60]}")
+            continue
+        if cat is not None and am.group(1).lower() not in cat.tables:
+            errors.append(f"migration alters unknown table: {stmt[:60]}")
+        typ = am.group(2).upper()
+        if not any(typ.startswith(t) for t in PG_TYPES):
+            errors.append(f"migration column type not whitelisted: {stmt[:60]}")
+    seen: Set[str] = set()
+    for sql, args in corpus:
+        for e in validate_statement(sql, args, cat):
+            key = f"{e} :: {sql[:90]}"
+            if key not in seen:
+                seen.add(key)
+                errors.append(key)
+    return errors

@@ -250,6 +250,19 @@ class TestTranslation:
         with pytest.raises(RuntimeError, match="psycopg2"):
             db_pg.PostgresDatabase("postgresql://nope/nope")
 
+    def test_live_recipe_documented(self):
+        """The serverless gate's complement — the one-command live recipe —
+        must stay discoverable next to the gate itself."""
+        import inspect
+
+        from determined_tpu.master import pg_validate
+
+        doc = inspect.getdoc(pg_validate) or ""
+        assert "docker run" in doc and "DTPU_PG_DSN=" in doc
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ops = open(os.path.join(repo, "docs", "operations.md")).read()
+        assert "DTPU_PG_DSN" in ops
+
     def test_open_database_selects_driver(self, tmp_path, monkeypatch):
         monkeypatch.delenv("DTPU_PG_DSN", raising=False)
         d = db_pg.open_database(str(tmp_path / "x.db"))
@@ -263,3 +276,119 @@ class TestTranslation:
         if self._no_psycopg2():
             with pytest.raises(RuntimeError, match="psycopg2"):
                 db_pg.open_database("postgres://u@h/db")
+
+
+class RecordingDatabase(db_mod.Database):
+    """SQLite for behavior, but every statement is captured in its
+    TRANSLATED (Postgres) form with its bound args — exactly what
+    db_pg.PostgresDatabase would put on the wire. Driving the conformance
+    suite through this backend yields the full emission corpus for the
+    serverless strictness gate."""
+
+    def __init__(self, path: str) -> None:
+        self.corpus = []
+        super().__init__(path)
+
+    def _record(self, sql, args=None, returning=False):
+        pg = db_pg.translate(sql)
+        if returning and db_pg.needs_returning_id(sql):
+            pg += " RETURNING id"
+        self.corpus.append((pg, tuple(args) if args is not None else None))
+
+    def _execute(self, sql, args=()):
+        self._record(sql, args, returning=True)
+        return super()._execute(sql, args)
+
+    def _executemany(self, sql, rows):
+        self._record(sql, rows[0] if rows else None)
+        return super()._executemany(sql, rows)
+
+    def _query(self, sql, args=()):
+        self._record(sql, args)
+        return super()._query(sql, args)
+
+    def _execute_durable(self, sql, args=()):
+        self._record(sql, args, returning=True)
+        return super()._execute_durable(sql, args)
+
+    def _write_batch(self, batch):
+        for sql, rows in batch:
+            self._record(sql, rows[0] if rows else None)
+        return super()._write_batch(batch)
+
+
+class TestServerlessStrictnessGate:
+    """VERDICT r4 next #6: every SQL statement the Postgres driver can
+    emit is collected (by replaying the WHOLE conformance suite through
+    the recording backend) and validated against the Postgres dialect
+    in-tree — dialect edges fail here, not on an operator's live server."""
+
+    def _build_corpus(self, tmp_path):
+        rec = RecordingDatabase(str(tmp_path / "rec.db"))
+        suite = TestConformance()
+        for name in sorted(dir(suite)):
+            if name.startswith("test_"):
+                getattr(suite, name)(rec)
+        rec.close()
+        return rec.corpus
+
+    def test_corpus_is_postgres_clean(self, tmp_path):
+        from determined_tpu.master import pg_validate
+
+        corpus = self._build_corpus(tmp_path)
+        # the replay must have produced a real corpus, not validated air
+        assert len({sql for sql, _ in corpus}) > 40, len(corpus)
+        errors = pg_validate.validate_corpus(
+            corpus, ddl=db_pg.pg_schema(), migrations=db_pg.pg_migrations()
+        )
+        assert errors == [], "\n".join(errors)
+
+    def test_gate_catches_dialect_edges(self):
+        """The gate itself must detect the classes of bug it exists for —
+        a validator that passes everything is worse than none."""
+        from determined_tpu.master import pg_validate
+
+        cat, ddl_errors = pg_validate.parse_catalog(db_pg.pg_schema())
+        assert ddl_errors == []
+        cases = [
+            ("SELECT * FROM trials WHERE id=?", None, "untranslated"),
+            ("SELECT instr(log, %s) FROM task_logs", None, "SQLite-ism"),
+            ("INSERT OR IGNORE INTO kv (key) VALUES (%s)", None,
+             "SQLite-ism"),
+            ("SELECT ifnull(a, 0) FROM trials", None, "ifnull"),
+            ("SELECT * FROM task_logs LIMIT %s", (-1,), "negative"),
+            ("SELECT * FROM task_logs LIMIT -1", None, "negative"),
+            ('SELECT * FROM trials WHERE state="ACTIVE"', None,
+             "double-quote"),
+            ("INSERT INTO trials (nope_col) VALUES (%s)", ("x",),
+             "not in schema"),
+            ("INSERT INTO metrics (trial_id) VALUES (%s) "
+             "ON CONFLICT (trial_id) DO NOTHING", ("1",), "unique index"),
+            ("INSERT INTO kv (key) VALUES (%s) RETURNING id", ("a",),
+             "serial"),
+            ("UPDATE trials SET bogus=%s", ("v",), "not in schema"),
+            ("SELECT * FROM no_such_table", None, "unknown table"),
+            ("SELECT julianday(ts) FROM task_logs", None, "SQLite-ism"),
+            ("SELECT a FROM trials WHERE x = %s", ("v", "extra"),
+             "placeholders but"),
+        ]
+        for sql, args, want in cases:
+            errors = pg_validate.validate_statement(sql, args, cat)
+            assert any(want in e for e in errors), (sql, want, errors)
+
+    def test_catalog_parses_every_table(self):
+        from determined_tpu.master import pg_validate
+
+        cat, errors = pg_validate.parse_catalog(db_pg.pg_schema())
+        assert errors == []
+        for table in (
+            "experiments", "trials", "metrics", "checkpoints", "task_logs",
+            "allocations", "kv", "templates", "audit_log", "files",
+            "webhooks", "workspaces", "projects", "models",
+            "model_versions",
+        ):
+            assert table in cat.tables, table
+        # the dialect-edge classes the gate guards hinge on these facts
+        assert "uuid" in cat.pk["checkpoints"]          # ON CONFLICT target
+        assert "id" in cat.serial["experiments"]        # RETURNING id
+        assert "id" not in cat.serial.get("kv", set())

@@ -56,10 +56,17 @@ def test_flash_bad_block():
 
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("block", [16, 32])
-def test_flash_pallas_bwd_interpret_matches(causal, block):
+@pytest.mark.parametrize("fused", [True, False])
+def test_flash_pallas_bwd_interpret_matches(causal, block, fused):
     """The Pallas backward kernels (the TPU path) against the blockwise
     reference backward, in interpret mode. Block 16 at s=64 exercises all
     three causal regimes (skip / masked diagonal / unmasked below)."""
+    import importlib
+
+    # `determined_tpu.ops.__init__` re-exports the flash_attention FUNCTION
+    # under the same name, so `import ... as fa` would bind that instead
+    # of the module.
+    fa = importlib.import_module("determined_tpu.ops.flash_attention")
     from determined_tpu.ops.flash_attention import (
         _blockwise_bwd_ref,
         _blockwise_fwd_ref,
@@ -75,11 +82,21 @@ def test_flash_pallas_bwd_interpret_matches(causal, block):
     scale = 1.0 / d ** 0.5
     o, lse = _blockwise_fwd_ref(qf, kf, vf, scale=scale, causal=causal,
                                 block_k=block)
+    # Nonzero dlse: ring attention feeds a real lse cotangent through
+    # whichever blocked path is active — it must be covered in both.
+    dlse = jax.random.normal(jax.random.PRNGKey(6), lse.shape)
     want = _blockwise_bwd_ref(qf, kf, vf, o, lse, do, scale=scale,
-                              causal=causal, block_k=block)
-    got = _flash_bwd_pallas(qf, kf, vf, o, lse, do, scale=scale,
-                            causal=causal, block_q=block, block_k=block,
-                            interpret=True)
+                              causal=causal, block_k=block, dlse=dlse)
+    # fused=True: the one-pass blocked kernel (dq via fp32 partials);
+    # fused=False: the two-pass dq + dkv split (the >cap fallback).
+    prev_cap = fa._FUSED_BWD_PARTIALS_CAP
+    fa._FUSED_BWD_PARTIALS_CAP = prev_cap if fused else 0
+    try:
+        got = _flash_bwd_pallas(qf, kf, vf, o, lse, do, scale=scale,
+                                causal=causal, block_q=block, block_k=block,
+                                interpret=True, dlse=dlse)
+    finally:
+        fa._FUSED_BWD_PARTIALS_CAP = prev_cap
     for name, a, b_ in zip(("dq", "dk", "dv"), got, want):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-5,
@@ -104,3 +121,93 @@ def test_flash_pallas_interpret_matches():
         want = reference_attention(q, k, v, causal=causal)
         wf = want.transpose(0, 2, 1, 3).reshape(b * h, s, d)
         np.testing.assert_allclose(np.asarray(o), np.asarray(wf), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_pallas_monolithic_interpret_matches(causal):
+    """The monolithic single-block kernels (block == seq, the GPT-2-class
+    fast path: plain softmax forward + fused single-pass backward) against
+    the blockwise reference — including the lse output and the dlse
+    cotangent path that ring attention feeds."""
+    from determined_tpu.ops.flash_attention import (
+        _blockwise_bwd_ref,
+        _blockwise_fwd_ref,
+        _flash_bwd_pallas,
+        _flash_fwd_pallas,
+        _mono_ok,
+    )
+
+    b, s, h, d = 1, 64, 2, 16
+    assert _mono_ok(s, s, s, s)
+    q, k, v = _rand_qkv(jax.random.PRNGKey(7), b, s, h, d)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    scale = 1.0 / d ** 0.5
+
+    o, lse = _flash_fwd_pallas(
+        qf, kf, vf, scale=scale, causal=causal,
+        block_q=s, block_k=s, interpret=True,
+    )
+    o_want, lse_want = _blockwise_fwd_ref(
+        qf, kf, vf, scale=scale, causal=causal, block_k=16
+    )
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_want),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_want),
+                               atol=2e-5, rtol=2e-5)
+
+    do = jax.random.normal(jax.random.PRNGKey(8), qf.shape)
+    dlse = jax.random.normal(jax.random.PRNGKey(9), lse.shape)
+    want = _blockwise_bwd_ref(qf, kf, vf, o_want, lse_want, do, scale=scale,
+                              causal=causal, block_k=16, dlse=dlse)
+    got = _flash_bwd_pallas(qf, kf, vf, o_want, lse_want, do, scale=scale,
+                            causal=causal, block_q=s, block_k=s,
+                            interpret=True, dlse=dlse)
+    for name, a, b_ in zip(("dq", "dk", "dv"), got, want):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-5,
+            err_msg=name,
+        )
+
+
+def test_flash_pallas_monolithic_causal_s256_matches():
+    """A second monolithic size (s=256, causal): forward, lse, and the
+    fused backward against the blockwise reference."""
+    from determined_tpu.ops.flash_attention import (
+        _blockwise_bwd_ref,
+        _blockwise_fwd_ref,
+        _flash_bwd_pallas,
+        _flash_fwd_pallas,
+    )
+
+    b, s, h, d = 1, 256, 2, 16
+    q, k, v = _rand_qkv(jax.random.PRNGKey(11), b, s, h, d)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    scale = 1.0 / d ** 0.5
+
+    o, lse = _flash_fwd_pallas(
+        qf, kf, vf, scale=scale, causal=True,
+        block_q=s, block_k=s, interpret=True,
+    )
+    o_want, lse_want = _blockwise_fwd_ref(
+        qf, kf, vf, scale=scale, causal=True, block_k=64
+    )
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_want),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_want),
+                               atol=2e-5, rtol=2e-5)
+
+    do = jax.random.normal(jax.random.PRNGKey(12), qf.shape)
+    want = _blockwise_bwd_ref(qf, kf, vf, o_want, lse_want, do, scale=scale,
+                              causal=True, block_k=64)
+    got = _flash_bwd_pallas(qf, kf, vf, o_want, lse_want, do, scale=scale,
+                            causal=True, block_q=s, block_k=s,
+                            interpret=True)
+    for name, a, b_ in zip(("dq", "dk", "dv"), got, want):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-5,
+            err_msg=name,
+        )
