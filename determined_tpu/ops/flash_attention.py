@@ -25,7 +25,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.experimental import pallas as pl
+
+
+class _LazyPallas:
+    """Deferred `jax.experimental.pallas` import: every `pl.` reference in
+    this module is inside a function body, and importing pallas eagerly
+    costs ~1 s per process (it drags the mosaic-gpu interpret machinery
+    in) — pure waste for CPU-only trial processes that never call a
+    kernel. First attribute access swaps the real module into place."""
+
+    def __getattr__(self, name):
+        from jax.experimental import pallas
+
+        globals()["pl"] = pallas
+        return getattr(pallas, name)
+
+
+pl = _LazyPallas()
 
 NEG_INF = float(-1e30)  # finite mask value; true -inf breaks m-subtraction
 
