@@ -153,9 +153,11 @@ def exp_create(args: argparse.Namespace) -> None:
         config["context"] = resp["id"]
         print(f"Uploaded context {args.model_dir} ({len(data)} bytes)")
     _apply_dot_overrides(config, args.config_override)
-    resp = _session(args).post("/api/v1/experiments", json_body={"config": config})
+    session = _session(args)
+    resp = session.post("/api/v1/experiments", json_body={"config": config})
     exp_id = resp["id"]
     print(f"Created experiment {exp_id}")
+    print(f"  {session.master_url}/#/experiments/{exp_id}")
     if args.follow:
         exp_wait(args, exp_id)
 

@@ -15,6 +15,7 @@ import json
 import logging
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -208,6 +209,19 @@ class _PlainText(Exception):
         self.content_type = content_type
 
 
+class _EventStream(Exception):
+    """Control-flow: handler responds with a Server-Sent-Events stream.
+
+    `gen` yields JSON strings (sent as `data:` events) or None
+    (keepalive comment — holds proxies/browsers open through quiet
+    periods). The dispatcher owns the socket/headers; the generator owns
+    WHAT to stream and when to stop (master shutdown, follow budget)."""
+
+    def __init__(self, gen) -> None:
+        super().__init__("event stream")
+        self.gen = gen
+
+
 class ApiRequest:
     def __init__(
         self,
@@ -217,6 +231,7 @@ class ApiRequest:
         token: Optional[str] = None,
         client_ip: str = "",
         raw: bytes = b"",
+        headers: Optional[Dict[str, str]] = None,
     ):
         self.groups = groups
         self.body = body
@@ -224,6 +239,7 @@ class ApiRequest:
         self.token = token  # Bearer token from the Authorization header
         self.client_ip = client_ip
         self.raw = raw      # non-JSON request body (file uploads)
+        self.headers = headers or {}  # SSE resume (Last-Event-ID)
 
     def q(self, name: str, default: Optional[str] = None) -> Optional[str]:
         vals = self.query.get(name)
@@ -498,6 +514,79 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
                 r.q("task_id", ""), int(r.q("after", "0") or 0)
             )
         }
+
+    #: SSE follow streams poll the indexed cursor server-side at this
+    #: cadence and push rows down ONE connection — the client holds no
+    #: timer and re-requests nothing (the WebUI's log/metric panes).
+    SSE_POLL_S = 0.3
+    #: Idle keepalive comment cadence: browsers/proxies only need a few
+    #: per minute to hold the connection; the cursor still polls at
+    #: SSE_POLL_S so rows flow promptly.
+    SSE_KEEPALIVE_S = 10.0
+    SSE_MAX_S = 6 * 3600.0
+
+    def _sse_start(r: ApiRequest, param: str = "after") -> int:
+        """Stream resume cursor: EventSource reconnects carry the last
+        `id:` we sent as Last-Event-ID — honoring it means a reconnect
+        continues instead of replaying (and duplicating) the history."""
+        last = r.headers.get("Last-Event-ID", "")
+        if last.isdigit():
+            return int(last)
+        return int(r.q(param, "0") or 0)
+
+    def _sse_follow(fetch):
+        """Generator: stream `fetch(cursor) -> rows` as (id, json) events
+        until master shutdown or the follow budget."""
+        def gen():
+            import json as _json
+
+            deadline = time.time() + SSE_MAX_S
+            cursor = None
+            last_write = time.time()
+            while not m._stop.is_set() and time.time() < deadline:
+                rows, cursor = fetch(cursor)
+                if rows:
+                    for row in rows:
+                        yield row["id"], _json.dumps(row)
+                    last_write = time.time()
+                else:
+                    if time.time() - last_write >= SSE_KEEPALIVE_S:
+                        yield None  # keepalive comment
+                        last_write = time.time()
+                    time.sleep(SSE_POLL_S)
+        return gen()
+
+    def stream_task_logs(r: ApiRequest):
+        """GET /api/v1/task_logs/stream?task_id=X&after=N — SSE follow of
+        a task's log lines (the WebUI's live log pane; replaces 1 s
+        polling with one held connection)."""
+        task_id = r.q("task_id", "")
+        start = _sse_start(r)
+
+        def fetch(cursor):
+            cursor = start if cursor is None else cursor
+            rows = m.db.get_task_logs(task_id, after_id=cursor, limit=500)
+            if rows:
+                cursor = rows[-1]["id"]
+            return rows, cursor
+
+        raise _EventStream(_sse_follow(fetch))
+
+    def stream_trial_metrics(r: ApiRequest):
+        """GET /api/v1/trials/{id}/metrics/stream?after=N — SSE follow of
+        a trial's metric rows (same cursor contract as the incremental
+        /metrics endpoint)."""
+        trial_id = int(r.groups[0])
+        start = _sse_start(r)
+
+        def fetch(cursor):
+            cursor = start if cursor is None else cursor
+            rows = m.db.get_metrics(trial_id, after_id=cursor)
+            if rows:
+                cursor = rows[-1]["id"]
+            return rows, cursor
+
+        raise _EventStream(_sse_follow(fetch))
 
     # -- agents ---------------------------------------------------------------
     def register_agent(r: ApiRequest):
@@ -1156,6 +1245,8 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
     return [
         R("POST", r"/api/v1/trials/(\d+)/metrics", post_metrics),
         R("GET", r"/api/v1/trials/(\d+)/metrics", get_metrics),
+        R("GET", r"/api/v1/trials/(\d+)/metrics/stream",
+          stream_trial_metrics),
         R("POST", r"/api/v1/trials/(\d+)/progress", post_progress),
         R("POST", r"/api/v1/trials/(\d+)/status", post_status),
         R("GET", r"/api/v1/trials/(\d+)/best_validation", best_validation),
@@ -1176,6 +1267,7 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("POST", r"/api/v1/allocations/([\w.\-]+)/allgather", allgather),
         R("POST", r"/api/v1/task_logs", post_task_logs),
         R("GET", r"/api/v1/task_logs", get_task_logs),
+        R("GET", r"/api/v1/task_logs/stream", stream_task_logs),
         R("GET", r"/api/v1/task_logs/search", search_task_logs),
         R("POST", r"/api/v1/templates", set_template),
         R("GET", r"/api/v1/templates", list_templates),
@@ -1450,6 +1542,7 @@ class ApiServer:
                                     parse_qs(parsed.query), token=token,
                                     client_ip=self.client_address[0],
                                     raw=raw,
+                                    headers=dict(self.headers.items()),
                                 )
                             )
                             span.set_attribute("http.status_code", 200)
@@ -1465,6 +1558,39 @@ class ApiServer:
                             self.send_header("Content-Length", str(len(data)))
                             self.end_headers()
                             self.wfile.write(data)
+                        except _EventStream as es:
+                            # SSE: one response, chunk per event, connection
+                            # closed at generator exhaustion (no keep-alive
+                            # reuse — the stream owns the socket).
+                            self.send_response(200)
+                            self.send_header(
+                                "Content-Type", "text/event-stream"
+                            )
+                            self.send_header("Cache-Control", "no-cache")
+                            self.send_header("Connection", "close")
+                            self.close_connection = True
+                            self.end_headers()
+                            try:
+                                for item in es.gen:
+                                    if getattr(self.server, "stopping", False):
+                                        break
+                                    if item is None:
+                                        self.wfile.write(b": keepalive\n\n")
+                                    else:
+                                        ev_id, payload = item
+                                        # id: → Last-Event-ID on reconnect,
+                                        # so a dropped stream resumes at
+                                        # its cursor instead of replaying.
+                                        self.wfile.write(
+                                            f"id: {ev_id}\ndata: "
+                                            f"{payload}\n\n".encode()
+                                        )
+                                    self.wfile.flush()
+                            except (BrokenPipeError, ConnectionResetError,
+                                    OSError):
+                                pass  # viewer closed the tab
+                            finally:
+                                es.gen.close()
                         except (BrokenPipeError, ConnectionResetError):
                             # Long-poll client went away (e.g. task exited
                             # mid-response); nothing to answer.

@@ -357,7 +357,7 @@ class Master:
             config_defaults=config_defaults,
         )
         self.cluster_id = uuid.uuid4().hex[:8]
-        self.external_url = external_url
+        self._external_url = external_url
         # Own-process log capture (ref: api_master.go GetMasterLogs — the
         # reference tails the master's log store over the API; here the
         # process-wide ring on the determined_tpu logger tree, served at
@@ -452,6 +452,10 @@ class Master:
         self._admin_kv_lock = threading.Lock()
         self._stop = threading.Event()
         self.webhooks = WebhookShipper(self.db)
+        # The ctor arg bypasses the property setter (webhooks didn't exist
+        # yet at assignment); propagate now so payload deep links work
+        # even when external_url is never reassigned post-start.
+        self.webhooks.ui_base_url = self._external_url.rstrip("/")
         # Background worker for slow reactions to FSM events (checkpoint GC):
         # the state-change hook fires under the experiment lock and must not
         # do storage IO inline.
@@ -591,6 +595,18 @@ class Master:
             alloc_id=alloc_id, task_id=task_id, entrypoint=entrypoint,
             rank_envs=rank_envs, agent_hub=self.agent_hub,
         )
+
+    @property
+    def external_url(self) -> str:
+        return self._external_url
+
+    @external_url.setter
+    def external_url(self, value: str) -> None:
+        """Callers assign this once the API server knows its real address;
+        propagated to the webhook shipper so payloads carry WebUI deep
+        links (#/experiments/<id>)."""
+        self._external_url = value
+        self.webhooks.ui_base_url = value.rstrip("/")
 
     # -- background pump (replaces the actor system's message loop) ----------
     def kick_tick(self) -> None:

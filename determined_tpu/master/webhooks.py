@@ -24,6 +24,11 @@ logger = logging.getLogger("determined_tpu.master")
 class WebhookShipper:
     def __init__(self, database: db_mod.Database, max_retries: int = 3) -> None:
         self.db = database
+        #: Master.external_url once the API server is up — lets payloads
+        #: carry a deep link (#/experiments/<id>) into the WebUI's routed
+        #: detail page, so a Slack/webhook message is one click from the
+        #: experiment.
+        self.ui_base_url: str = ""
         self._queue: "queue.Queue[Dict[str, Any]]" = queue.Queue()
         self._max_retries = max_retries
         self._stop = threading.Event()
@@ -45,6 +50,9 @@ class WebhookShipper:
                             "state": state,
                             "searcher": config.get("searcher", {}).get("name"),
                             "timestamp": time.time(),
+                            **({"url":
+                                f"{self.ui_base_url}/#/experiments/{exp_id}"}
+                               if self.ui_base_url else {}),
                         },
                     }
                 )

@@ -23,7 +23,7 @@ reference's pages (webui/react/src/pages/*):
 Charts are hand-rolled SVG so the no-build-step constraint holds.
 """
 
-PAGE = """<!doctype html>
+PAGE = r"""<!doctype html>
 <html>
 <head>
 <meta charset="utf-8">
@@ -53,7 +53,36 @@ PAGE = """<!doctype html>
 </style>
 </head>
 <body>
-<h1>determined_tpu <span id="cluster"></span></h1>
+<h1><a href="#/" style="color:inherit;text-decoration:none">determined_tpu</a>
+  <span id="cluster"></span> <span id="crumb" class="muted"></span></h1>
+
+<div id="view-exp" style="display:none">
+  <h2 id="xd-title"></h2>
+  <div id="xd-meta"></div>
+  <div id="xd-actions" style="margin:8px 0"></div>
+  <h2>Merged config <span class="muted">(expconf echo: cluster + template +
+    builtin defaults applied)</span></h2>
+  <pre id="xd-config" style="max-height:420px"></pre>
+  <h2>Trials</h2>
+  <div class="pager" id="xd-trial-pager"></div>
+  <table id="xd-trials"></table>
+  <h2>HP search</h2><div id="xd-hpviz"></div>
+</div>
+
+<div id="view-trial" style="display:none">
+  <h2 id="td-title"></h2>
+  <div id="td-meta"></div>
+  <div id="td-actions" style="margin:8px 0"></div>
+  <h2>Hyperparameters</h2><pre id="td-hparams"></pre>
+  <h2>Metrics <span class="muted" id="td-met-live"></span></h2>
+  <div id="td-charts">(waiting for metrics)</div>
+  <h2>Profiler</h2><div id="td-prof">(no profiler samples)</div>
+  <h2>Checkpoints</h2><div id="td-ckpts"></div>
+  <h2>Logs <span class="muted" id="td-log-live"></span></h2>
+  <pre id="td-logs" style="max-height:480px"></pre>
+</div>
+
+<div id="view-main">
 <h2>Agents</h2><table id="agents"></table>
 <h2>Resource pools</h2><table id="pools"></table>
 <h2>Job queue</h2><div id="queues">(empty)</div>
@@ -97,6 +126,7 @@ the "profiling" metric group)</div>
 <h2 style="font-size:0.9rem">Groups</h2><table id="groups"></table>
 <h2 style="font-size:0.9rem">Templates</h2><table id="templates"></table>
 <h2 style="font-size:0.9rem">Audit tail</h2><table id="audit"></table>
+</div>
 <div id="login" style="display:none">
   <h2>Login</h2>
   <input id="u" placeholder="username"> <input id="p" type="password"
@@ -353,6 +383,30 @@ function parallelCoords(trials, w = 470, h = 190) {
 // run's (otherwise the polylines backtrack).
 let metState = {trial: null, after: 0, byKey: {}, drawn: false};
 
+// Newest-run-wins metric accumulation + series build — ONE copy shared
+// by the main charts, the trial-comparison view, and the trial-detail
+// SSE stream (a restarted trial re-reports steps from its checkpoint;
+// the newer run's values must replace the superseded run's).
+function applyMetricRow(byKey, row) {
+  const run = row.trial_run_id || 0;
+  for (const [k, v] of Object.entries(row.body)) {
+    if (typeof v !== 'number' || !isFinite(v)) continue;
+    const byStep = ((byKey[k] ??= {})[row.grp] ??= {});
+    const prev = byStep[row.steps_completed];
+    if (!prev || run >= prev.run) byStep[row.steps_completed] = {run, v};
+  }
+}
+function buildSeries(groups, rename) {
+  return Object.entries(groups).map(([grp, byStep]) => ({
+    name: rename ? rename(grp) : grp,
+    points: Object.entries(byStep).map(([s, e]) => [Number(s), e.v])
+      .sort((a, b) => a[0] - b[0])}));
+}
+// The "profiling" group (host CPU/mem, device HBM — profiler.py) gets its
+// own pane, like the reference's Profiler view.
+const isProfGroups = (groups) =>
+  Object.keys(groups).every(g => g === 'profiling');
+
 async function drawTrialCharts(trialId) {
   if (metState.trial !== trialId)
     metState = {trial: trialId, after: 0, byKey: {}, drawn: false};
@@ -360,34 +414,18 @@ async function drawTrialCharts(trialId) {
     `/api/v1/trials/${trialId}/metrics?after=${metState.after}`)).metrics;
   for (const row of rows) {
     metState.after = Math.max(metState.after, row.id);
-    const run = row.trial_run_id || 0;
-    for (const [k, v] of Object.entries(row.body)) {
-      if (typeof v !== 'number' || !isFinite(v)) continue;
-      const byStep = ((metState.byKey[k] ??= {})[row.grp] ??= {});
-      const prev = byStep[row.steps_completed];
-      if (!prev || run >= prev.run) byStep[row.steps_completed] = {run, v};
-    }
+    applyMetricRow(metState.byKey, row);
   }
   if (!rows.length && metState.drawn) return; // nothing new: keep the DOM
   const div = $('charts'), prof = $('profiler');
   div.textContent = ''; prof.textContent = '';
   $('chart-label').textContent = `· trial ${trialId}`;
   $('prof-label').textContent = `· trial ${trialId}`;
-  // The "profiling" group (host CPU/mem, device HBM — profiler.py) gets
-  // its own tab, like the reference's Profiler view; everything else is
-  // training/validation signal.
-  const isProf = (groups) => Object.keys(groups).every(g => g === 'profiling');
   for (const key of Object.keys(metState.byKey).sort()) {
     const groups = metState.byKey[key];
-    const target = isProf(groups) ? prof : div;
-    if (target === div && div.childNodes.length >= 8) continue;
-    if (target === prof && prof.childNodes.length >= 8) continue;
-    const series = Object.entries(groups).map(
-      ([grp, byStep]) => ({name: grp, points:
-        Object.entries(byStep)
-          .map(([s, e]) => [Number(s), e.v])
-          .sort((a, b) => a[0] - b[0])}));
-    target.appendChild(lineChart(key, series));
+    const target = isProfGroups(groups) ? prof : div;
+    if (target.childNodes.length >= 8) continue;
+    target.appendChild(lineChart(key, buildSeries(groups)));
     metState.drawn = true;
   }
   if (!div.childNodes.length) div.textContent = '(no scalar metrics yet)';
@@ -406,21 +444,14 @@ async function drawComparison() {
   const byKey = {};
   for (const id of ids) {
     const rows = (await j(`/api/v1/trials/${id}/metrics`)).metrics;
-    const best = {};  // key -> step -> {run, v}, newest run wins
+    const best = {};  // key -> {'_': step -> {run, v}}, newest run wins
     for (const row of rows) {
-      const run = row.trial_run_id || 0;
       if (row.grp === 'profiling') continue;
-      for (const [k, v] of Object.entries(row.body)) {
-        if (typeof v !== 'number' || !isFinite(v)) continue;
-        const byStep = (best[k] ??= {});
-        const prev = byStep[row.steps_completed];
-        if (!prev || run >= prev.run) byStep[row.steps_completed] = {run, v};
-      }
+      // groups collapse for comparison (one series per trial per key)
+      applyMetricRow(best, {...row, grp: '_'});
     }
-    for (const [k, byStep] of Object.entries(best)) {
-      (byKey[k] ??= []).push({name: `trial ${id}`, points:
-        Object.entries(byStep).map(([s, e]) => [Number(s), e.v])
-          .sort((a, b) => a[0] - b[0])});
+    for (const [k, groups] of Object.entries(best)) {
+      (byKey[k] ??= []).push(buildSeries(groups, () => `trial ${id}`)[0]);
     }
   }
   for (const key of Object.keys(byKey).sort().slice(0, 6))
@@ -542,14 +573,15 @@ async function refreshAdmin() {
   } catch (e) { /* 403 for non-admins: leave sections empty */ }
 }
 
-function pager(el, page, total, onchange) {
+function pager(el, page, total, onchange, redraw = 'refresh') {
   const pages = Math.max(1, Math.ceil(total / PAGE_SIZE));
   el.innerHTML = `page ${page + 1}/${pages} · ${total} total ` +
-    `<button onclick="${onchange}=Math.max(0,${page}-1);refresh()">prev</button> ` +
-    `<button onclick="${onchange}=Math.min(${pages - 1},${page}+1);refresh()">next</button>`;
+    `<button onclick="${onchange}=Math.max(0,${page}-1);${redraw}()">prev</button> ` +
+    `<button onclick="${onchange}=Math.min(${pages - 1},${page}+1);${redraw}()">next</button>`;
 }
 
 async function refresh() {
+  if (currentView !== 'main') return;  // detail views own their refresh
   try {
     // One round-trip's latency, not seven: these polls are independent.
     const showArchived = $('show-archived').checked ? 1 : 0;
@@ -632,7 +664,8 @@ async function refresh() {
              ? ` <button onclick="expAction(${e.id},'unarchive')">unarchive</button>`
              : ` <button onclick="expAction(${e.id},'archive')">archive</button>`)
           : '';
-        return `<tr>${cell(e.id)}${state(e.state)}` +
+        return `<tr><td><a href="#/experiments/${e.id}">${e.id}</a></td>` +
+          `${state(e.state)}` +
           `<td><span class="bar"><div style="width:${pct}%"></div></span> ${pct}%</td>` +
           cell((e.config.searcher || {}).name || '') +
           (expLabels[e.id] = (e.labels || []).join(', '),
@@ -654,7 +687,8 @@ async function refresh() {
         trials.map(t =>
           `<tr><td><input type="checkbox" ${cmpTrials.has(t.id) ? 'checked' : ''} ` +
           `onchange="this.checked?cmpTrials.add(${t.id}):cmpTrials.delete(${t.id})"></td>` +
-          `${cell(t.id)}${state(t.state)}${cell(t.steps_completed)}` +
+          `<td><a href="#/trials/${t.id}">${t.id}</a></td>` +
+          `${state(t.state)}${cell(t.steps_completed)}` +
           cell(t.restarts) + cell(t.searcher_metric ?? '') +
           cell(JSON.stringify(t.hparams)) +
           `<td><button onclick="selTrial=${t.id};logAfter=0;$('logs').textContent='';refresh()">logs</button> ` +
@@ -670,7 +704,7 @@ async function refresh() {
       $('log-label').textContent = `· trial ${selTrial}`;
       const out = await j(`/api/v1/task_logs?task_id=trial-${selTrial}&after=${logAfter}`);
       for (const line of out.logs) {
-        $('logs').textContent += line.log + '\\n';
+        $('logs').textContent += line.log + '\n';
         logAfter = line.id;
       }
       $('logs').scrollTop = $('logs').scrollHeight;
@@ -678,7 +712,182 @@ async function refresh() {
     await refreshAdmin();
   } catch (e) { console.error(e); }
 }
-refresh();
+// --- hash router (#/experiments/<id>, #/trials/<id>) -------------------
+// URL-addressable detail pages (the ExperimentDetails / TrialDetails
+// routed views): a webhook or CLI line can deep-link straight to one.
+let currentView = 'main';
+let detailTimer = null, esLogs = null, esMetrics = null;
+
+function stopStreams() {
+  if (esLogs) { esLogs.close(); esLogs = null; }
+  if (esMetrics) { esMetrics.close(); esMetrics = null; }
+  if (detailTimer) { clearInterval(detailTimer); detailTimer = null; }
+}
+
+function show(view) {
+  currentView = view;
+  for (const v of ['main', 'exp', 'trial'])
+    $('view-' + v).style.display = (v === view) ? '' : 'none';
+}
+
+// EventSource can't set headers; the API accepts ?token= on GETs.
+function sseUrl(path) {
+  const tok = localStorage.getItem('dtpu_token');
+  if (!tok) return path;
+  return path + (path.includes('?') ? '&' : '?') +
+    'token=' + encodeURIComponent(tok);
+}
+
+async function route() {
+  stopStreams();
+  let m;
+  const h = location.hash;
+  try {
+    if ((m = h.match(/^#\/experiments\/(\d+)/))) {
+      show('exp');
+      await renderExpDetail(+m[1]);
+      detailTimer = setInterval(() => renderExpDetail(+m[1]), 3000);
+    } else if ((m = h.match(/^#\/trials\/(\d+)/))) {
+      show('trial');
+      await renderTrialDetail(+m[1], true);
+      detailTimer = setInterval(() => renderTrialDetail(+m[1], false), 3000);
+    } else {
+      show('main');
+      $('crumb').textContent = '';
+      refresh();
+    }
+  } catch (e) { console.error(e); }
+}
+
+// --- experiment detail --------------------------------------------------
+let xdExpId = null, xdTrialPage = 0;
+async function xdAction(id, action) {
+  if (action === 'kill' && !confirm(`kill experiment ${id}?`)) return;
+  await post(`/api/v1/experiments/${id}/${action}`);
+  renderExpDetail(id);
+}
+async function renderExpDetail(id) {
+  if (xdExpId !== id) xdTrialPage = 0;
+  xdExpId = id;
+  $('crumb').innerHTML = `· <a href="#/experiments/${id}">experiment ${id}</a>`;
+  const e = await j(`/api/v1/experiments/${id}`);
+  if (e.error) { $('xd-title').textContent = e.error; return; }
+  $('xd-title').textContent =
+    `Experiment ${id}` + (e.config.name ? ` — ${e.config.name}` : '');
+  const pct = Math.round((e.progress || 0) * 100);
+  $('xd-meta').innerHTML = '<table>' +
+    `<tr><th>state</th>${state(e.state)}</tr>` +
+    `<tr><th>progress</th><td><span class="bar"><div style="width:${pct}%">` +
+    `</div></span> ${pct}%</td></tr>` +
+    `<tr><th>searcher</th>${cell((e.config.searcher || {}).name || '')}</tr>` +
+    `<tr><th>labels</th>${cell((e.labels || []).join(', '))}</tr>` +
+    `<tr><th>description</th>${cell(e.description || '')}</tr>` +
+    `<tr><th>notes</th>${cell(e.notes || '')}</tr>` +
+    `<tr><th>project</th>${cell(e.project_id ?? '')}</tr></table>`;
+  const terminal = TERMINAL_STATES.includes(e.state);
+  $('xd-actions').innerHTML =
+    (e.state === 'ACTIVE'
+      ? `<button onclick="xdAction(${id},'pause')">pause</button> ` : '') +
+    (e.state === 'PAUSED'
+      ? `<button onclick="xdAction(${id},'activate')">activate</button> ` : '') +
+    (terminal ? '' : `<button onclick="xdAction(${id},'kill')">kill</button> `) +
+    `<button onclick="forkExp(${id})">fork</button>`;
+  $('xd-config').textContent = JSON.stringify(e.config, null, 2);
+  const trialsR = await j(`/api/v1/experiments/${id}/trials` +
+    `?limit=${PAGE_SIZE}&offset=${xdTrialPage * PAGE_SIZE}`);
+  const trials = trialsR.trials || [];
+  pager($('xd-trial-pager'), xdTrialPage, trialsR.total || trials.length,
+        'xdTrialPage', 'route');
+  $('xd-trials').innerHTML =
+    '<tr><th>id</th><th>state</th><th>steps</th><th>restarts</th>' +
+    '<th>metric</th><th>hparams</th></tr>' +
+    trials.map(t =>
+      `<tr><td><a href="#/trials/${t.id}">${t.id}</a></td>${state(t.state)}` +
+      `${cell(t.steps_completed)}${cell(t.restarts)}` +
+      cell(t.searcher_metric ?? '') + cell(JSON.stringify(t.hparams)) +
+      '</tr>').join('');
+  const viz = $('xd-hpviz');
+  viz.textContent = '';
+  viz.appendChild(rungScatter(trials));
+  viz.appendChild(parallelCoords(trials));
+}
+
+// --- trial detail -------------------------------------------------------
+// Logs and metrics FOLLOW over SSE (one held connection each, pushed by
+// the master) instead of re-polling; state/checkpoints poll gently.
+let tdTrialId = null, tdMet = null;
+async function tdKill(id) {
+  if (!confirm(`kill trial ${id}?`)) return;
+  await post(`/api/v1/trials/${id}/kill`);
+  renderTrialDetail(id, false);
+}
+function tdRedraw() {
+  const div = $('td-charts'), prof = $('td-prof');
+  div.textContent = ''; prof.textContent = '';
+  for (const key of Object.keys(tdMet.byKey).sort()) {
+    const groups = tdMet.byKey[key];
+    const target = isProfGroups(groups) ? prof : div;
+    if (target.childNodes.length >= 10) continue;
+    target.appendChild(lineChart(key, buildSeries(groups)));
+  }
+  if (!div.childNodes.length) div.textContent = '(no scalar metrics yet)';
+  if (!prof.childNodes.length) prof.textContent = '(no profiler samples)';
+}
+async function renderTrialDetail(id, fresh) {
+  $('crumb').innerHTML = `· <a href="#/trials/${id}">trial ${id}</a>`;
+  const t = await j(`/api/v1/trials/${id}`);
+  if (t.error) { $('td-title').textContent = t.error; return; }
+  $('td-title').textContent = `Trial ${id}`;
+  $('td-meta').innerHTML = '<table>' +
+    `<tr><th>experiment</th><td><a href="#/experiments/${t.experiment_id}">` +
+    `${t.experiment_id}</a></td></tr>` +
+    `<tr><th>state</th>${state(t.state)}</tr>` +
+    `<tr><th>steps</th>${cell(t.steps_completed)}</tr>` +
+    `<tr><th>restarts</th>${cell(t.restarts)} </tr>` +
+    `<tr><th>runs</th>${cell((t.run_id || 0) + 1)}</tr>` +
+    `<tr><th>metric</th>${cell(t.searcher_metric ?? '')}</tr></table>`;
+  $('td-actions').innerHTML = TERMINAL_STATES.includes(t.state)
+    ? '' : `<button onclick="tdKill(${id})">kill</button>`;
+  $('td-hparams').textContent = JSON.stringify(t.hparams || {}, null, 2);
+  const ck = await j(`/api/v1/trials/${id}/checkpoints`);
+  const rows = ck.checkpoints || [];
+  $('td-ckpts').innerHTML = '<table><tr><th>uuid</th><th>steps</th>' +
+    '<th>files</th><th>restore</th></tr>' +
+    rows.map(c =>
+      `<tr>${cell(c.uuid)}${cell(c.steps_completed)}` +
+      cell((c.resources || []).length) +
+      `<td><code>dtpu checkpoint download ${esc(c.uuid)}</code></td></tr>`
+    ).join('') + '</table>' + (rows.length ? '' : '(none yet)');
+
+  if (!fresh) return;  // streams already attached by the first render
+  tdTrialId = id;
+  tdMet = {byKey: {}};
+  $('td-logs').textContent = '';
+  let redrawQueued = false;
+  esMetrics = new EventSource(
+    sseUrl(`/api/v1/trials/${id}/metrics/stream?after=0`));
+  $('td-met-live').textContent = '(live)';
+  esMetrics.onmessage = (ev) => {
+    applyMetricRow(tdMet.byKey, JSON.parse(ev.data));
+    if (!redrawQueued) {  // coalesce bursts into one draw per frame-ish
+      redrawQueued = true;
+      setTimeout(() => { redrawQueued = false; tdRedraw(); }, 250);
+    }
+  };
+  esLogs = new EventSource(
+    sseUrl(`/api/v1/task_logs/stream?task_id=trial-${id}&after=0`));
+  $('td-log-live').textContent = '(live)';
+  esLogs.onmessage = (ev) => {
+    const row = JSON.parse(ev.data);
+    const pre = $('td-logs');
+    const follow = pre.scrollTop + pre.clientHeight >= pre.scrollHeight - 8;
+    pre.textContent += row.log + '\n';
+    if (follow) pre.scrollTop = pre.scrollHeight;
+  };
+}
+
+window.addEventListener('hashchange', route);
+route();
 setInterval(refresh, 2000);
 </script>
 </body>

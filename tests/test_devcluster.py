@@ -98,25 +98,35 @@ class TestDevClusterE2E:
             tmp_path,
             searcher={
                 "name": "grid", "metric": "loss",
-                "max_length": 40,  # long enough to catch RUNNING
+                "max_length": 40,
             },
             hyperparameters={
                 "model": "mnist-mlp", "batch_size": 16,
                 "lr": {"type": "categorical", "vals": [1e-3, 2e-3]},
+                # keep the victim alive well past the kill: steps_completed
+                # only lands at op completion, so a fast trial would race
+                # the kill with its own natural exit (killed: false)
+                "sleep_s": 0.3,
             },
         )
         exp_id = cluster.create_experiment(cfg)
-        # wait for a running trial
+        # wait for a trial that HOLDS slots (authoritative pool state —
+        # not the db's steps_completed, which a one-op searcher only
+        # reports at the end)
         victim = None
         deadline = time.time() + 120
         while time.time() < deadline and victim is None:
             for t in cluster.master.db.list_trials(exp_id):
-                # ACTIVE + some progress = actually executing
-                if t["state"] == "ACTIVE" and t["steps_completed"] > 0:
+                alloc = cluster.master._trial_allocs.get(t["id"])
+                if (
+                    t["state"] == "ACTIVE" and alloc
+                    and cluster.master.rm.pool().assignment_of(alloc)
+                ):
                     victim = t["id"]
                     break
             time.sleep(0.3)
         assert victim is not None, "no trial started executing"
+        time.sleep(2.0)  # let the harness come up so the kill is mid-RUN
         r = rq.post(
             f"{cluster.api.url}/api/v1/trials/{victim}/kill", timeout=10
         )

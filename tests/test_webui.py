@@ -98,3 +98,147 @@ class TestWebUI:
             f"{api.url}/api/v1/queues", timeout=10
         ).json()["queues"]["default"]["pending"]
         assert after == ["small.2.0", "big.1.0"]
+
+
+class TestRoutedDetailViews:
+    """Hash-routed detail pages + SSE streaming (VERDICT r4 next #4):
+    #/experiments/<id> and #/trials/<id> are URL-addressable, and the
+    log/metric panes follow over Server-Sent-Events instead of polling.
+    No browser in the image: HTTP-level checks of the page markers, the
+    detail APIs the views render from, and real SSE event delivery."""
+
+    def test_page_carries_router_and_views(self, live):
+        _, api = live
+        html = requests.get(f"{api.url}/", timeout=10).text
+        for marker in (
+            'id="view-exp"', 'id="view-trial"', "hashchange",
+            "renderExpDetail", "renderTrialDetail", "EventSource",
+            "/metrics/stream", "/task_logs/stream", "xd-config",
+        ):
+            assert marker in html, marker
+
+    def test_sse_task_log_follow(self, live):
+        import json as json_mod
+        import threading
+        import time as time_mod
+
+        master, api = live
+        master.db.add_task_logs(
+            "t-sse", [{"ts": 1.0, "log": "first", "level": "INFO", "rank": 0}]
+        )
+        master.db._read_barrier()
+        got = []
+
+        def consume():
+            with requests.get(
+                f"{api.url}/api/v1/task_logs/stream?task_id=t-sse",
+                stream=True, timeout=30,
+            ) as r:
+                assert r.headers["Content-Type"].startswith(
+                    "text/event-stream"
+                )
+                for line in r.iter_lines(chunk_size=1):
+                    if line.startswith(b"data: "):
+                        got.append(json_mod.loads(line[6:]))
+                        if len(got) >= 2:
+                            return
+
+        th = threading.Thread(target=consume, daemon=True)
+        th.start()
+        time_mod.sleep(0.8)  # stream must deliver rows appended AFTER open
+        master.db.add_task_logs(
+            "t-sse", [{"ts": 2.0, "log": "second", "level": "INFO", "rank": 0}]
+        )
+        th.join(timeout=15)
+        assert [r["log"] for r in got] == ["first", "second"]
+
+    def test_sse_metric_follow_and_detail_fields(self, live):
+        import json as json_mod
+        import threading
+        import time as time_mod
+
+        master, api = live
+        eid = master.db.add_experiment({"entrypoint": "x:y"})
+        tid = master.db.add_trial(eid, 1, {"lr": 0.5}, seed=0)
+        master.db.add_metrics(tid, "training", 1, {"loss": 2.0},
+                              trial_run_id=0)
+        master.db._read_barrier()
+        got = []
+
+        def consume():
+            with requests.get(
+                f"{api.url}/api/v1/trials/{tid}/metrics/stream",
+                stream=True, timeout=30,
+            ) as r:
+                for line in r.iter_lines(chunk_size=1):
+                    if line.startswith(b"data: "):
+                        got.append(json_mod.loads(line[6:]))
+                        if len(got) >= 2:
+                            return
+
+        th = threading.Thread(target=consume, daemon=True)
+        th.start()
+        time_mod.sleep(0.8)
+        master.db.add_metrics(tid, "training", 2, {"loss": 1.0},
+                              trial_run_id=0)
+        th.join(timeout=15)
+        assert [(m["steps_completed"], m["body"]["loss"]) for m in got] == [
+            (1, 2.0), (2, 1.0),
+        ]
+        # the fields the trial detail view renders from
+        t = requests.get(f"{api.url}/api/v1/trials/{tid}", timeout=10).json()
+        for field in ("experiment_id", "state", "steps_completed",
+                      "restarts", "run_id", "hparams"):
+            assert field in t, field
+        assert t["experiment_id"] == eid
+
+    def test_webhook_payload_carries_deep_link(self, live):
+        master, api = live
+        # Stop the live shipper worker FIRST: otherwise it races this
+        # test for the queued item (it polls _queue.get(timeout=1)).
+        master.webhooks.stop()
+        master.db.add_webhook("http://sink.invalid/x", ["COMPLETED"])
+        master.webhooks.notify(7, "COMPLETED", {"searcher": {"name": "s"}})
+        item = master.webhooks._queue.get(timeout=5)
+        assert item["payload"]["url"] == f"{api.url}/#/experiments/7"
+
+    def test_sse_reconnect_resumes_via_last_event_id(self, live):
+        """EventSource reconnects carry Last-Event-ID; the stream must
+        resume at that cursor instead of replaying (and duplicating) the
+        whole history."""
+        import json as json_mod
+
+        master, api = live
+        master.db.add_task_logs("t-resume", [
+            {"ts": 1.0, "log": "a", "level": "INFO", "rank": 0},
+            {"ts": 2.0, "log": "b", "level": "INFO", "rank": 0},
+        ])
+        master.db._read_barrier()
+        # first connection: note the id: fields
+        ids = []
+        with requests.get(
+            f"{api.url}/api/v1/task_logs/stream?task_id=t-resume",
+            stream=True, timeout=30,
+        ) as r:
+            for line in r.iter_lines(chunk_size=1):
+                if line.startswith(b"id: "):
+                    ids.append(int(line[4:]))
+                if len(ids) >= 2:
+                    break
+        master.db.add_task_logs("t-resume", [
+            {"ts": 3.0, "log": "c", "level": "INFO", "rank": 0},
+        ])
+        master.db._read_barrier()
+        # reconnect as a browser would: after=0 in the URL, cursor in the
+        # Last-Event-ID header — only "c" may arrive
+        got = []
+        with requests.get(
+            f"{api.url}/api/v1/task_logs/stream?task_id=t-resume&after=0",
+            stream=True, timeout=30,
+            headers={"Last-Event-ID": str(ids[-1])},
+        ) as r:
+            for line in r.iter_lines(chunk_size=1):
+                if line.startswith(b"data: "):
+                    got.append(json_mod.loads(line[6:])["log"])
+                    break
+        assert got == ["c"]
