@@ -590,6 +590,29 @@ def shell_open(args: argparse.Namespace) -> None:
         _die(str(e))
 
 
+def tunnel_cmd(args: argparse.Namespace) -> None:
+    """`dtpu tunnel <task> <local_port> [--port N]` — forward arbitrary
+    TCP (ssh, DB clients, anything) to the task's registered service over
+    the authenticated upgrade connection (ref: proxy/tcp.go +
+    cli/tunnel.py). --port picks among the task's REGISTERED proxy ports;
+    default is its primary one."""
+    from determined_tpu.cli.shell_client import serve_tunnel
+
+    session = _session(args)
+    print(
+        f"tunneling 127.0.0.1:{args.local_port} -> {args.task_id}"
+        + (f":{args.port}" if args.port else "")
+        + "  (ctrl-c to stop)"
+    )
+    try:
+        serve_tunnel(
+            session.master_url, args.task_id, args.local_port,
+            user_token=session.token, remote_port=args.port,
+        )
+    except KeyboardInterrupt:
+        pass
+
+
 def shell_cp(args: argparse.Namespace) -> None:
     """`dtpu shell cp <task>:<path> <local>` / `<local> <task>:<path>` —
     the scp ergonomics the token-PTY redesign owes (the reference's `det
@@ -1050,6 +1073,13 @@ def build_parser() -> argparse.ArgumentParser:
     v = tb.add_parser("start")
     v.add_argument("experiment_ids", type=int, nargs="+")
     v.set_defaults(fn=tb_start)
+
+    v = sub.add_parser("tunnel")
+    v.add_argument("task_id")
+    v.add_argument("local_port", type=int)
+    v.add_argument("--port", type=int, default=None,
+                   help="remote port (must be a registered proxy port)")
+    v.set_defaults(fn=tunnel_cmd)
 
     shell = sub.add_parser("shell", aliases=["sh"]).add_subparsers(
         dest="verb", required=True

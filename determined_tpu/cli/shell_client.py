@@ -235,3 +235,150 @@ def run_shell(
 
             termios.tcsetattr(restore[0], termios.TCSADRAIN, restore[1])
         sock.close()
+
+
+# --- raw-TCP tunnel (ref: harness/determined/cli/tunnel.py + the master's
+# --- proxy/tcp.go analog) ---------------------------------------------------
+def connect_raw_tcp(
+    master_url: str, task_id: str, user_token: str = "",
+    remote_port: "Optional[int]" = None,
+) -> "tuple[socket.socket, bytes]":
+    """Dial the master and upgrade into a raw byte tunnel to the task's
+    registered TCP service (no HTTP is relayed to the backend — ssh, DB
+    clients, anything). Returns (socket, early-bytes)."""
+    parsed = urlparse(master_url)
+    host = parsed.hostname or "127.0.0.1"
+    port = parsed.port or (443 if parsed.scheme == "https" else 80)
+    sock = socket.create_connection((host, port), timeout=30)
+    if parsed.scheme == "https":
+        from determined_tpu.common.tls import client_context
+
+        sock = client_context().wrap_socket(sock, server_hostname=host)
+    try:
+        query = f"?dtpu_token={user_token}" if user_token else ""
+        port_hdr = (
+            f"X-DTPU-Tunnel-Port: {int(remote_port)}\r\n" if remote_port
+            else ""
+        )
+        sock.sendall((
+            f"GET /proxy/{task_id}/{query} HTTP/1.1\r\n"
+            f"Host: {host}:{port}\r\n"
+            f"{port_hdr}"
+            "Connection: Upgrade\r\n"
+            "Upgrade: raw-tcp\r\n"
+            "\r\n"
+        ).encode())
+        from determined_tpu.common.netutil import read_http_head
+
+        try:
+            head_text, early = read_http_head(sock)
+        except (ConnectionError, ValueError) as e:
+            raise ShellError(f"tunnel handshake failed: {e}") from e
+        status_line = head_text.split(b"\r\n", 1)[0].decode(errors="replace")
+        if " 101 " not in status_line + " ":
+            # Non-101 responses carry the reason in a JSON body (e.g.
+            # "port N is not a registered proxy port") — read what the
+            # server sends (it closes the connection after) and surface it.
+            body = early
+            try:
+                sock.settimeout(2.0)
+                while len(body) < 65536:
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        break
+                    body += chunk
+            except OSError:
+                pass
+            detail = body.decode(errors="replace").strip()
+            raise ShellError(
+                f"tunnel handshake failed: {status_line}"
+                + (f" — {detail}" if detail else "")
+            )
+        sock.settimeout(None)
+        return sock, early
+    except Exception:
+        sock.close()
+        raise
+
+
+def _splice(a: socket.socket, b: socket.socket) -> None:
+    """Pipe bytes both ways until either side closes."""
+    import threading
+
+    def pump(src, dst):
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            try:
+                dst.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+
+    t = threading.Thread(target=pump, args=(a, b), daemon=True)
+    t.start()
+    pump(b, a)
+    t.join(timeout=5.0)
+
+
+def serve_tunnel(
+    master_url: str, task_id: str, local_port: int,
+    user_token: str = "", remote_port: "Optional[int]" = None,
+    ready: "Optional[object]" = None, stop: "Optional[object]" = None,
+) -> int:
+    """`dtpu tunnel` body: listen on 127.0.0.1:<local_port>; each accepted
+    connection gets its own authenticated upgrade tunnel to the task's
+    TCP service. Returns the bound port (0 picks a free one — tests).
+    `ready` (threading.Event) fires once listening; `stop` ends the loop.
+    """
+    import threading
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", local_port))
+    srv.listen(16)
+    bound = srv.getsockname()[1]
+    if ready is not None:
+        ready.port = bound  # type: ignore[attr-defined]
+        ready.set()
+
+    def handle(client: socket.socket) -> None:
+        tun = None
+        try:
+            # OSError too: a dead master raises before the ShellError
+            # wrapper — the local app must get a reset, not a half-open
+            # socket it hangs on.
+            try:
+                tun, early = connect_raw_tcp(
+                    master_url, task_id, user_token=user_token,
+                    remote_port=remote_port,
+                )
+            except (ShellError, OSError) as e:
+                sys.stderr.write(f"tunnel: {e}\n")
+                return
+            if early:
+                client.sendall(early)
+            _splice(client, tun)
+        finally:
+            client.close()
+            if tun is not None:
+                tun.close()
+
+    srv.settimeout(0.5)
+    try:
+        while stop is None or not stop.is_set():
+            try:
+                client, _ = srv.accept()
+            except socket.timeout:
+                continue
+            threading.Thread(
+                target=handle, args=(client,), daemon=True
+            ).start()
+    finally:
+        srv.close()
+    return bound

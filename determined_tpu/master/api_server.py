@@ -239,7 +239,11 @@ class ApiRequest:
         self.token = token  # Bearer token from the Authorization header
         self.client_ip = client_ip
         self.raw = raw      # non-JSON request body (file uploads)
-        self.headers = headers or {}  # SSE resume (Last-Event-ID)
+        # Lowercased keys: header names are case-insensitive on the wire
+        # and HTTP/2-terminating proxies lowercase them.
+        self.headers = {
+            k.lower(): v for k, v in (headers or {}).items()
+        }  # SSE resume (Last-Event-ID)
 
     def q(self, name: str, default: Optional[str] = None) -> Optional[str]:
         vals = self.query.get(name)
@@ -529,7 +533,7 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         """Stream resume cursor: EventSource reconnects carry the last
         `id:` we sent as Last-Event-ID — honoring it means a reconnect
         continues instead of replaying (and duplicating) the history."""
-        last = r.headers.get("Last-Event-ID", "")
+        last = r.headers.get("last-event-id", "")
         if last.isdigit():
             return int(last)
         return int(r.q(param, "0") or 0)

@@ -82,6 +82,7 @@ class ProxyRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._targets: Dict[str, Tuple[str, int]] = {}  # task_id -> (host, port)
+        self._ports: Dict[str, set] = {}  # task_id -> every registered port
         # Last proxied-request time per task — the signal the master's idle
         # watcher uses to reap abandoned notebooks (ref: the reference's
         # idle-timeout detection watches proxy activity the same way).
@@ -90,13 +91,23 @@ class ProxyRegistry:
     def register(self, task_id: str, host: str, port: int) -> None:
         with self._lock:
             self._targets[task_id] = (host, port)
+            # Every port a task ever registered stays tunnel-reachable:
+            # the raw-TCP tunnel may only target REGISTERED ports (the
+            # reference's TCP proxy likewise serves declared proxy ports,
+            # proxy/tcp.go) — never arbitrary ports on the task host.
+            self._ports.setdefault(task_id, set()).add(int(port))
             self._activity[task_id] = time.time()
         logger.info("proxy: %s -> %s:%d", task_id, host, port)
 
     def unregister(self, task_id: str) -> None:
         with self._lock:
             self._targets.pop(task_id, None)
+            self._ports.pop(task_id, None)
             self._activity.pop(task_id, None)
+
+    def port_allowed(self, task_id: str, port: int) -> bool:
+        with self._lock:
+            return int(port) in self._ports.get(task_id, set())
 
     def touch(self, task_id: str) -> None:
         with self._lock:
@@ -166,14 +177,29 @@ class ProxyRegistry:
         if target is None:
             return "no proxy target for task"
         host, port = target
-        query = _strip_token_query(query)
-        url = path + (f"?{query}" if query else "")
-        head_lines = [f"{method} {url} HTTP/1.1", f"Host: {host}:{port}"]
-        for k, v in _strip_master_credentials(headers).items():
-            if k.lower() in ("host", "content-length"):
-                continue
-            head_lines.append(f"{k}: {v}")
-        head = ("\r\n".join(head_lines) + "\r\n\r\n").encode()
+        # Raw-TCP mode (ref: proxy/tcp.go): the backend speaks no HTTP —
+        # the MASTER answers the 101 and splices pure bytes (ssh, DB
+        # clients, anything). An explicit port may be named, but only
+        # ports the task REGISTERED are reachable.
+        raw_tcp = headers.get("Upgrade", "").lower() == "raw-tcp"
+        if raw_tcp:
+            want = headers.get("X-DTPU-Tunnel-Port", "")
+            if want:
+                if not want.isdigit() or not self.port_allowed(
+                    task_id, int(want)
+                ):
+                    return f"port {want} is not a registered proxy port"
+                port = int(want)
+            head = b""
+        else:
+            query = _strip_token_query(query)
+            url = path + (f"?{query}" if query else "")
+            head_lines = [f"{method} {url} HTTP/1.1", f"Host: {host}:{port}"]
+            for k, v in _strip_master_credentials(headers).items():
+                if k.lower() in ("host", "content-length"):
+                    continue
+                head_lines.append(f"{k}: {v}")
+            head = ("\r\n".join(head_lines) + "\r\n\r\n").encode()
 
         try:
             backend = socket.create_connection((host, port), timeout=30)
@@ -181,7 +207,16 @@ class ProxyRegistry:
             return f"connect to task service failed: {e}"
         try:
             backend.settimeout(None)
-            backend.sendall(head)
+            if raw_tcp:
+                # No backend handshake to relay: confirm the upgrade to
+                # the client ourselves, then it's bytes all the way down.
+                client_sock.sendall(
+                    b"HTTP/1.1 101 Switching Protocols\r\n"
+                    b"Connection: Upgrade\r\n"
+                    b"Upgrade: raw-tcp\r\n\r\n"
+                )
+            else:
+                backend.sendall(head)
 
             def pump_client_to_backend() -> None:
                 # Read via the handler's buffered rfile: frames the client

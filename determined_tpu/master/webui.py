@@ -717,6 +717,11 @@ async function refresh() {
 // routed views): a webhook or CLI line can deep-link straight to one.
 let currentView = 'main';
 let detailTimer = null, esLogs = null, esMetrics = null;
+// Route epoch: a render that resumes from an await AFTER the user
+// navigated away must not attach streams the new route's stopStreams()
+// already ran too early to close (they'd leak for SSE_MAX_S and keep
+// appending the OLD trial's lines into the new view's panes).
+let routeEpoch = 0;
 
 function stopStreams() {
   if (esLogs) { esLogs.close(); esLogs = null; }
@@ -740,6 +745,7 @@ function sseUrl(path) {
 
 async function route() {
   stopStreams();
+  routeEpoch++;
   let m;
   const h = location.hash;
   try {
@@ -834,8 +840,10 @@ function tdRedraw() {
   if (!prof.childNodes.length) prof.textContent = '(no profiler samples)';
 }
 async function renderTrialDetail(id, fresh) {
+  const epoch = routeEpoch;
   $('crumb').innerHTML = `· <a href="#/trials/${id}">trial ${id}</a>`;
   const t = await j(`/api/v1/trials/${id}`);
+  if (epoch !== routeEpoch) return;  // user navigated away mid-await
   if (t.error) { $('td-title').textContent = t.error; return; }
   $('td-title').textContent = `Trial ${id}`;
   $('td-meta').innerHTML = '<table>' +
@@ -850,6 +858,7 @@ async function renderTrialDetail(id, fresh) {
     ? '' : `<button onclick="tdKill(${id})">kill</button>`;
   $('td-hparams').textContent = JSON.stringify(t.hparams || {}, null, 2);
   const ck = await j(`/api/v1/trials/${id}/checkpoints`);
+  if (epoch !== routeEpoch) return;  // navigated away: don't attach streams
   const rows = ck.checkpoints || [];
   $('td-ckpts').innerHTML = '<table><tr><th>uuid</th><th>steps</th>' +
     '<th>files</th><th>restore</th></tr>' +
