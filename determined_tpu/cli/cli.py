@@ -611,6 +611,8 @@ def tunnel_cmd(args: argparse.Namespace) -> None:
         )
     except KeyboardInterrupt:
         pass
+    except OSError as e:
+        _die(f"cannot listen on 127.0.0.1:{args.local_port}: {e}")
 
 
 def shell_cp(args: argparse.Namespace) -> None:

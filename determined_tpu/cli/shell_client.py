@@ -19,14 +19,16 @@ class ShellError(Exception):
     pass
 
 
-def connect_shell(
-    master_url: str, task_id: str, shell_token: str,
-    user_token: str = "",
-    extra_headers: "Optional[dict]" = None,
+def _upgrade_dial(
+    master_url: str, task_id: str, upgrade: str,
+    headers: "Optional[dict]" = None, user_token: str = "",
 ) -> "tuple[socket.socket, bytes]":
-    """Dial the master, upgrade into the task's PTY tunnel. Returns the
-    socket (handshake consumed) plus any tunnel bytes that raced the
-    handshake (e.g. the shell prompt)."""
+    """Dial the master and upgrade the connection into a byte tunnel —
+    the one copy of the dial/TLS/handshake logic under connect_shell
+    (PTY/file transfers) and connect_raw_tcp (arbitrary TCP).
+
+    Returns (socket, early-bytes). Raises ShellError on a non-101,
+    including the server's JSON reason when it sends one."""
     parsed = urlparse(master_url)
     host = parsed.hostname or "127.0.0.1"
     port = parsed.port or (443 if parsed.scheme == "https" else 80)
@@ -39,43 +41,73 @@ def connect_shell(
 
         sock = client_context().wrap_socket(sock, server_hostname=host)
     try:
-        query = ""
-        if user_token:
-            # dtpu_token, not token: the master consumes (and the proxy
-            # strips) dtpu_token; `token` would be forwarded to the task
-            # service, which owns that name (Jupyter).
-            query = f"?dtpu_token={user_token}"
-        # The shell token rides a HEADER, not the query string: query
-        # strings land verbatim in proxy/access logs, which would turn
-        # every log line into a credential store (same reasoning as the
-        # master's own token stripping, master/proxy.py).
+        # dtpu_token, not token: the master consumes (and the proxy
+        # strips) dtpu_token; `token` would be forwarded to the task
+        # service, which owns that name (Jupyter).
+        query = f"?dtpu_token={user_token}" if user_token else ""
         extras = "".join(
-            f"{k}: {v}\r\n" for k, v in (extra_headers or {}).items()
+            f"{k}: {v}\r\n" for k, v in (headers or {}).items()
         )
-        head = (
+        sock.sendall((
             f"GET /proxy/{task_id}/{query} HTTP/1.1\r\n"
             f"Host: {host}:{port}\r\n"
-            f"X-DTPU-Shell-Token: {shell_token}\r\n"
             f"{extras}"
             "Connection: Upgrade\r\n"
-            "Upgrade: websocket\r\n"
+            f"Upgrade: {upgrade}\r\n"
             "\r\n"
-        ).encode()
-        sock.sendall(head)
+        ).encode())
         from determined_tpu.common.netutil import read_http_head
 
         try:
             head_text, early = read_http_head(sock)
         except (ConnectionError, ValueError) as e:
-            raise ShellError(f"shell handshake failed: {e}") from e
+            raise ShellError(f"tunnel handshake failed: {e}") from e
         status_line = head_text.split(b"\r\n", 1)[0].decode(errors="replace")
         if " 101 " not in status_line + " ":
-            raise ShellError(f"shell handshake failed: {status_line}")
+            # Non-101 responses carry the reason in a JSON body (e.g.
+            # "port N is not a registered proxy port") — read what the
+            # server sends (it closes the connection after), surface it.
+            body = early
+            try:
+                sock.settimeout(2.0)
+                while len(body) < 65536:
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        break
+                    body += chunk
+            except OSError:
+                pass
+            detail = body.decode(errors="replace").strip()
+            raise ShellError(
+                f"tunnel handshake failed: {status_line}"
+                + (f" — {detail}" if detail else "")
+            )
         sock.settimeout(None)
         return sock, early
     except Exception:
         sock.close()
         raise
+
+
+def connect_shell(
+    master_url: str, task_id: str, shell_token: str,
+    user_token: str = "",
+    extra_headers: "Optional[dict]" = None,
+) -> "tuple[socket.socket, bytes]":
+    """Dial the master, upgrade into the task's PTY tunnel. Returns the
+    socket (handshake consumed) plus any tunnel bytes that raced the
+    handshake (e.g. the shell prompt).
+
+    The shell token rides a HEADER, not the query string: query strings
+    land verbatim in proxy/access logs, which would turn every log line
+    into a credential store (same reasoning as the master's own token
+    stripping, master/proxy.py)."""
+    headers = {"X-DTPU-Shell-Token": shell_token}
+    headers.update(extra_headers or {})
+    return _upgrade_dial(
+        master_url, task_id, "websocket",
+        headers=headers, user_token=user_token,
+    )
 
 
 def _read_status(sock: socket.socket, early: bytes) -> "tuple[str, bytes]":
@@ -246,59 +278,13 @@ def connect_raw_tcp(
     """Dial the master and upgrade into a raw byte tunnel to the task's
     registered TCP service (no HTTP is relayed to the backend — ssh, DB
     clients, anything). Returns (socket, early-bytes)."""
-    parsed = urlparse(master_url)
-    host = parsed.hostname or "127.0.0.1"
-    port = parsed.port or (443 if parsed.scheme == "https" else 80)
-    sock = socket.create_connection((host, port), timeout=30)
-    if parsed.scheme == "https":
-        from determined_tpu.common.tls import client_context
-
-        sock = client_context().wrap_socket(sock, server_hostname=host)
-    try:
-        query = f"?dtpu_token={user_token}" if user_token else ""
-        port_hdr = (
-            f"X-DTPU-Tunnel-Port: {int(remote_port)}\r\n" if remote_port
-            else ""
-        )
-        sock.sendall((
-            f"GET /proxy/{task_id}/{query} HTTP/1.1\r\n"
-            f"Host: {host}:{port}\r\n"
-            f"{port_hdr}"
-            "Connection: Upgrade\r\n"
-            "Upgrade: raw-tcp\r\n"
-            "\r\n"
-        ).encode())
-        from determined_tpu.common.netutil import read_http_head
-
-        try:
-            head_text, early = read_http_head(sock)
-        except (ConnectionError, ValueError) as e:
-            raise ShellError(f"tunnel handshake failed: {e}") from e
-        status_line = head_text.split(b"\r\n", 1)[0].decode(errors="replace")
-        if " 101 " not in status_line + " ":
-            # Non-101 responses carry the reason in a JSON body (e.g.
-            # "port N is not a registered proxy port") — read what the
-            # server sends (it closes the connection after) and surface it.
-            body = early
-            try:
-                sock.settimeout(2.0)
-                while len(body) < 65536:
-                    chunk = sock.recv(65536)
-                    if not chunk:
-                        break
-                    body += chunk
-            except OSError:
-                pass
-            detail = body.decode(errors="replace").strip()
-            raise ShellError(
-                f"tunnel handshake failed: {status_line}"
-                + (f" — {detail}" if detail else "")
-            )
-        sock.settimeout(None)
-        return sock, early
-    except Exception:
-        sock.close()
-        raise
+    headers = (
+        {"X-DTPU-Tunnel-Port": str(int(remote_port))} if remote_port else {}
+    )
+    return _upgrade_dial(
+        master_url, task_id, "raw-tcp",
+        headers=headers, user_token=user_token,
+    )
 
 
 def _splice(a: socket.socket, b: socket.socket) -> None:

@@ -91,11 +91,13 @@ class ProxyRegistry:
     def register(self, task_id: str, host: str, port: int) -> None:
         with self._lock:
             self._targets[task_id] = (host, port)
-            # Every port a task ever registered stays tunnel-reachable:
-            # the raw-TCP tunnel may only target REGISTERED ports (the
-            # reference's TCP proxy likewise serves declared proxy ports,
-            # proxy/tcp.go) — never arbitrary ports on the task host.
-            self._ports.setdefault(task_id, set()).add(int(port))
+            # Every (host, port) a task ever registered stays
+            # tunnel-reachable: the raw-TCP tunnel may only target
+            # REGISTERED endpoints (the reference's TCP proxy likewise
+            # serves declared proxy ports, proxy/tcp.go) — never arbitrary
+            # ports, and a port registered on host A must not be dialed
+            # on host B.
+            self._ports.setdefault(task_id, set()).add((host, int(port)))
             self._activity[task_id] = time.time()
         logger.info("proxy: %s -> %s:%d", task_id, host, port)
 
@@ -105,9 +107,16 @@ class ProxyRegistry:
             self._ports.pop(task_id, None)
             self._activity.pop(task_id, None)
 
-    def port_allowed(self, task_id: str, port: int) -> bool:
+    def endpoint_for_port(
+        self, task_id: str, port: int
+    ) -> Optional[Tuple[str, int]]:
+        """The registered (host, port) endpoint matching `port`, or None
+        if the task never registered that port."""
         with self._lock:
-            return int(port) in self._ports.get(task_id, set())
+            for host, p in self._ports.get(task_id, set()):
+                if p == int(port):
+                    return (host, p)
+        return None
 
     def touch(self, task_id: str) -> None:
         with self._lock:
@@ -180,16 +189,20 @@ class ProxyRegistry:
         # Raw-TCP mode (ref: proxy/tcp.go): the backend speaks no HTTP —
         # the MASTER answers the 101 and splices pure bytes (ssh, DB
         # clients, anything). An explicit port may be named, but only
-        # ports the task REGISTERED are reachable.
-        raw_tcp = headers.get("Upgrade", "").lower() == "raw-tcp"
+        # (host, port) endpoints the task REGISTERED are reachable.
+        # Lowercased lookups: intermediaries normalize header case.
+        lheaders = {k.lower(): v for k, v in headers.items()}
+        raw_tcp = lheaders.get("upgrade", "").lower() == "raw-tcp"
         if raw_tcp:
-            want = headers.get("X-DTPU-Tunnel-Port", "")
+            want = lheaders.get("x-dtpu-tunnel-port", "")
             if want:
-                if not want.isdigit() or not self.port_allowed(
-                    task_id, int(want)
-                ):
+                endpoint = (
+                    self.endpoint_for_port(task_id, int(want))
+                    if want.isdigit() else None
+                )
+                if endpoint is None:
                     return f"port {want} is not a registered proxy port"
-                port = int(want)
+                host, port = endpoint
             head = b""
         else:
             query = _strip_token_query(query)

@@ -773,10 +773,12 @@ async function xdAction(id, action) {
   renderExpDetail(id);
 }
 async function renderExpDetail(id) {
+  const epoch = routeEpoch;
   if (xdExpId !== id) xdTrialPage = 0;
   xdExpId = id;
   $('crumb').innerHTML = `· <a href="#/experiments/${id}">experiment ${id}</a>`;
   const e = await j(`/api/v1/experiments/${id}`);
+  if (epoch !== routeEpoch) return;  // user navigated away mid-await
   if (e.error) { $('xd-title').textContent = e.error; return; }
   $('xd-title').textContent =
     `Experiment ${id}` + (e.config.name ? ` — ${e.config.name}` : '');
@@ -801,6 +803,7 @@ async function renderExpDetail(id) {
   $('xd-config').textContent = JSON.stringify(e.config, null, 2);
   const trialsR = await j(`/api/v1/experiments/${id}/trials` +
     `?limit=${PAGE_SIZE}&offset=${xdTrialPage * PAGE_SIZE}`);
+  if (epoch !== routeEpoch) return;
   const trials = trialsR.trials || [];
   pager($('xd-trial-pager'), xdTrialPage, trialsR.total || trials.length,
         'xdTrialPage', 'route');
