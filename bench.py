@@ -187,8 +187,16 @@ def long_ctx_mfu(dev, on_tpu: bool):
     (mfu, seq_len) or (None, 0)."""
     try:
         if on_tpu:
+            # At exactly 16k the auto layer loop still UNROLLS
+            # (gpt.py: seq_len <= 16384), so scan_unroll has no effect
+            # here — an apparent unroll gain in the r5 sweep was
+            # run-order variance (review caught it). The real r5 levers:
+            # inner=3/rounds=3 tames the 16k rung's noise, and running
+            # this rung BEFORE the NeoX rungs (see main) avoids their
+            # HBM fragmentation (~2-3 MFU points). b2 regresses (46.4
+            # vs ~49 at b1).
             cfg = GPTConfig(seq_len=16384, remat=True, fused_loss=True)
-            mfu, _ = _measure_mfu(cfg, batch_size=1, inner=2, rounds=2, dev=dev)
+            mfu, _ = _measure_mfu(cfg, batch_size=1, inner=3, rounds=3, dev=dev)
         else:
             cfg = GPTConfig(
                 vocab_size=512, n_layers=1, n_heads=4, d_model=128,
@@ -285,9 +293,10 @@ def main() -> None:
     on_tpu = dev.platform == "tpu"
     if on_tpu:
         config = small()  # GPT-2 small, seq 1024, unrolled layer loop
-        # batch 16 measured best on v5e with the unrolled trunk (52.5% MFU
-        # vs 41.4% @ b8 / 45.0% @ b24; b32 exceeds HBM). Sweep r4.
-        batch_size = 16
+        # r5 re-sweep with the fused attention backward: b24 55.8% / b16
+        # 55.3% / b28 51.9% / b32 fails compile — the cheaper backward
+        # moved the knee up from r4's b16 (52.5% vs 45.0% @ b24 then).
+        batch_size = 24
         # inner=32: the tunneled backend adds ~90ms fixed RPC latency per
         # timed round (dispatch+fetch); 32 back-to-back steps amortize it so
         # the number reflects sustained device throughput, not tunnel RTT.
@@ -313,6 +322,14 @@ def main() -> None:
         # BASELINE.md row 2: one jax device == one chip here.
         "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
     }
+    # Long-ctx runs BEFORE the NeoX rungs: those allocate ~12 GB of fp32
+    # optimizer state, and the 16k program compiled into the fragmented
+    # HBM that leaves behind measured 2-3 MFU points lower (r5).
+    if not os.environ.get("DTPU_BENCH_SKIP_LONGCTX"):
+        lc_mfu, lc_seq = long_ctx_mfu(dev, on_tpu)
+        if lc_mfu is not None:
+            record["long_ctx_mfu"] = round(100.0 * lc_mfu, 2)
+            record["long_ctx_seq_len"] = lc_seq
     if not os.environ.get("DTPU_BENCH_SKIP_NEOX"):
         neox_mfu, neox_layers = neox_class_mfu(dev, on_tpu)
         if neox_mfu is not None:
@@ -321,11 +338,6 @@ def main() -> None:
         mfu2 = neox_2layer_crosscheck(dev, on_tpu)
         if mfu2 is not None:
             record["neox_2layer_sgd_mfu"] = round(100.0 * mfu2, 2)
-    if not os.environ.get("DTPU_BENCH_SKIP_LONGCTX"):
-        lc_mfu, lc_seq = long_ctx_mfu(dev, on_tpu)
-        if lc_mfu is not None:
-            record["long_ctx_mfu"] = round(100.0 * lc_mfu, 2)
-            record["long_ctx_seq_len"] = lc_seq
     if not os.environ.get("DTPU_BENCH_SKIP_ASHA"):
         # MEDIAN of 2 runs, all raw values recorded (best-of-N
         # systematically inflated vs single-run history — r4 advisor).
