@@ -38,7 +38,7 @@ import numpy as np
 import optax
 
 from determined_tpu.models import GPT
-from determined_tpu.models.gpt import GPTConfig, small
+from determined_tpu.models.gpt import GPTConfig
 
 # Per-JAX-device peak bf16 FLOP/s (device == chip on v4+, core on v2/v3).
 PEAK_FLOPS = {
@@ -292,10 +292,13 @@ def main() -> None:
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     if on_tpu:
-        config = small()  # GPT-2 small, seq 1024, unrolled layer loop
-        # r5 re-sweep with the fused attention backward: b24 55.8% / b16
-        # 55.3% / b28 51.9% / b32 fails compile — the cheaper backward
-        # moved the knee up from r4's b16 (52.5% vs 45.0% @ b24 then).
+        # GPT-2 small, seq 1024, unrolled layer loop, NO remat: at 1k
+        # sequence the activations fit alongside batch 24, so paying the
+        # recompute buys nothing. r5 sweep with the fused attention
+        # backward: b24 remat-off 56.0% / b24 remat 55.8% / b16 55.3% /
+        # b28 51.9% / b32 fails compile — the cheaper backward moved the
+        # knee up from r4's b16 (52.5% vs 45.0% @ b24 then).
+        config = GPTConfig(remat=False)
         batch_size = 24
         # inner=32: the tunneled backend adds ~90ms fixed RPC latency per
         # timed round (dispatch+fetch); 32 back-to-back steps amortize it so

@@ -243,6 +243,28 @@ def exp_set_resources(field: str):
     return fn
 
 
+def exp_delete(args: argparse.Namespace) -> None:
+    """`dtpu e delete <id>` (ref: det experiment delete): terminal
+    experiments only; checkpoints are removed from storage."""
+    if not args.yes:
+        try:
+            got = input(
+                f"delete experiment {args.experiment_id} and its "
+                "checkpoints? [y/N] "
+            )
+        except EOFError:  # non-interactive without --yes: abort cleanly
+            got = ""
+        if got.strip().lower() not in ("y", "yes"):
+            raise SystemExit("aborted")
+    _session(args).delete(f"/api/v1/experiments/{args.experiment_id}")
+    print(f"experiment {args.experiment_id}: deleting")
+
+
+def ckpt_delete(args: argparse.Namespace) -> None:
+    _session(args).delete(f"/api/v1/checkpoints/{args.uuid}")
+    print(f"checkpoint {args.uuid} deleted")
+
+
 def exp_move(args: argparse.Namespace) -> None:
     """`dtpu e move <id> <project_id>` (ref: det experiment move)."""
     _session(args).post(
@@ -993,6 +1015,10 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("experiment_id", type=int)
     v.add_argument("label")
     v.set_defaults(fn=exp_label)
+    v = exp.add_parser("delete")
+    v.add_argument("experiment_id", type=int)
+    v.add_argument("--yes", "-y", action="store_true")
+    v.set_defaults(fn=exp_delete)
     v = exp.add_parser("move")
     v.add_argument("experiment_id", type=int)
     v.add_argument("project_id", type=int)
@@ -1055,6 +1081,9 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("uuid")
     v.add_argument("dest", nargs="?", default=None)
     v.set_defaults(fn=ckpt_download)
+    v = ckpt.add_parser("delete")
+    v.add_argument("uuid")
+    v.set_defaults(fn=ckpt_delete)
 
     cmd = sub.add_parser("cmd", aliases=["command"]).add_subparsers(
         dest="verb", required=True)

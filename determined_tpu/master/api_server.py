@@ -925,6 +925,30 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         except (TypeError, ValueError) as e:
             raise ApiError(400, str(e))
 
+    def exp_delete(r: ApiRequest):
+        """DeleteExperiment (ref api_experiment.go:365): terminal
+        experiments only; checkpoint files then rows, async on the
+        master's background worker (state DELETING → gone, or
+        DELETE_FAILED with rows intact)."""
+        try:
+            m.delete_experiment(int(r.groups[0]))
+        except KeyError as e:
+            raise ApiError(404, str(e))
+        except ValueError as e:
+            raise ApiError(400, str(e))
+        return {"state": "DELETING"}
+
+    def ckpt_delete(r: ApiRequest):
+        """DeleteCheckpoints (ref api_checkpoint.go:375): files removed,
+        row marked DELETED; registry-referenced checkpoints refuse."""
+        try:
+            m.delete_checkpoint(r.groups[0])
+        except KeyError as e:
+            raise ApiError(404, str(e))
+        except ValueError as e:
+            raise ApiError(400, str(e))
+        return {}
+
     def exp_action(r: ApiRequest):
         exp = m.get_experiment(int(r.groups[0]))
         if exp is None:
@@ -1261,6 +1285,7 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("GET", r"/api/v1/trials/(\d+)", get_trial),
         R("POST", r"/api/v1/checkpoints", post_checkpoint),
         R("GET", r"/api/v1/checkpoints/([0-9a-f-]+)", get_checkpoint),
+        R("DELETE", r"/api/v1/checkpoints/([0-9a-f-]+)", ckpt_delete),
         R("GET", r"/api/v1/allocations/([\w.\-]+)/signals/preemption", preemption_signal),
         R("POST", r"/api/v1/allocations/([\w.\-]+)/signals/ack_preemption", ack_preemption),
         R("POST", r"/api/v1/allocations/([\w.\-]+)/signals/preemption_from_task", preempt_from_task),
@@ -1310,6 +1335,7 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("GET", r"/api/v1/experiments/(\d+)", get_experiment),
         R("PATCH", r"/api/v1/experiments/(\d+)", exp_patch),
         R("PATCH", r"/api/v1/experiments/(\d+)/resources", exp_resources),
+        R("DELETE", r"/api/v1/experiments/(\d+)", exp_delete),
         R("POST", r"/api/v1/experiments/(\d+)/(pause|activate|cancel|kill)", exp_action),
         R("POST", r"/api/v1/experiments/(\d+)/(archive|unarchive)", exp_archive),
         R("POST", r"/api/v1/experiments/(\d+)/fork", exp_fork),

@@ -967,6 +967,120 @@ class Master:
                     infra=True,
                 )
 
+    # -- deletion (ref: api_experiment.go:365 DeleteExperiment,
+    # -- api_checkpoint.go:375 DeleteCheckpoints) ------------------------------
+    def delete_experiment(self, exp_id: int) -> None:
+        """Delete a TERMINAL experiment: checkpoint files first (storage
+        IO on the background worker — GCS deletes are slow), then every
+        DB row. State walks DELETING → gone, or DELETE_FAILED with the
+        rows intact (rerunnable). Registry-referenced checkpoints block
+        the delete up front: a registered model version must stay
+        downloadable (same registry/GC interaction the retention policy
+        honors)."""
+        row = self.db.get_experiment(exp_id)
+        if row is None:
+            raise KeyError(f"no such experiment {exp_id}")
+        with self._lock:
+            live = self.experiments.get(exp_id)
+        state = live.state if live is not None else row["state"]
+        if state not in db_mod.TERMINAL_STATES and state != "DELETE_FAILED":
+            raise ValueError(
+                f"experiment {exp_id} is {state}; only terminal "
+                "experiments can be deleted (kill or cancel it first)"
+            )
+        referenced = set(self.db.referenced_checkpoint_uuids())
+        pinned = []
+        for trial in self.db.list_trials(exp_id):
+            for c in self.db.list_checkpoints(trial["id"]):
+                if c["uuid"] in referenced:
+                    pinned.append(c["uuid"])
+        if pinned:
+            raise ValueError(
+                "checkpoints registered in the model registry block "
+                f"deletion: {', '.join(pinned[:5])}"
+                + (" …" if len(pinned) > 5 else "")
+            )
+        self.db.set_experiment_state(exp_id, "DELETING")
+        config = row["config"]
+
+        def job() -> None:
+            from determined_tpu.storage import (
+                from_config as storage_from_config,
+            )
+
+            try:
+                # from_config(None) falls back to the default shared_fs
+                # location — the same resolution the TRIAL used to write,
+                # so configs without a checkpoint_storage block don't
+                # leak their files on delete.
+                storage = storage_from_config(
+                    config.get("checkpoint_storage")
+                )
+                # Re-check registry pins HERE: a model version registered
+                # between the synchronous guard and this job running must
+                # still block (the guard's TOCTOU window can be minutes
+                # behind slow GCS deletes).
+                referenced = set(self.db.referenced_checkpoint_uuids())
+                for trial in self.db.list_trials(exp_id):
+                    for c in self.db.list_checkpoints(trial["id"]):
+                        if c["uuid"] in referenced:
+                            raise RuntimeError(
+                                f"checkpoint {c['uuid']} became "
+                                "registry-referenced; aborting delete"
+                            )
+                for trial in self.db.list_trials(exp_id):
+                    for c in self.db.list_checkpoints(trial["id"]):
+                        if c.get("state") == "DELETED":
+                            continue
+                        try:
+                            storage.delete(c["uuid"])
+                        except FileNotFoundError:
+                            pass
+                    # Synced tfevents live under tensorboard/<task> in
+                    # the same storage (the reference's delete passes
+                    # deleteTensorboards, checkpoint_gc.go:42).
+                    try:
+                        storage.delete(f"tensorboard/trial-{trial['id']}")
+                    except FileNotFoundError:
+                        pass
+                self.db.delete_experiment_rows(exp_id)
+                with self._lock:
+                    self.experiments.pop(exp_id, None)
+                logger.info("experiment %d deleted", exp_id)
+            except Exception:  # noqa: BLE001
+                logger.exception("deleting experiment %d failed", exp_id)
+                # rows intact: the delete can be retried
+                self.db.set_experiment_state(exp_id, "DELETE_FAILED")
+
+        self._work.put(job)
+
+    def delete_checkpoint(self, uuid: str) -> None:
+        """Remove one checkpoint's files and mark the row DELETED (the
+        row stays for lineage, matching the reference's partial-delete
+        accounting)."""
+        c = self.db.get_checkpoint(uuid)
+        if c is None:
+            raise KeyError(f"no such checkpoint {uuid}")
+        if uuid in set(self.db.referenced_checkpoint_uuids()):
+            raise ValueError(
+                f"checkpoint {uuid} is registered in the model registry"
+            )
+        trial = self.db.get_trial(c["trial_id"]) if c.get("trial_id") else None
+        config = {}
+        if trial is not None:
+            row = self.db.get_experiment(trial["experiment_id"])
+            config = row["config"] if row else {}
+        from determined_tpu.storage import from_config as storage_from_config
+
+        # from_config(None) → the default shared_fs location (where a
+        # config without the block actually wrote) — never skip the file
+        # removal, or the DELETED row would lie about storage.
+        try:
+            storage_from_config(config.get("checkpoint_storage")).delete(uuid)
+        except FileNotFoundError:
+            pass
+        self.db.mark_checkpoint_deleted(uuid)
+
     # -- live job scheduling updates (ref: UpdateJobQueue api.proto:1110,
     # -- det experiment set priority/weight/max-slots) -------------------------
     def update_experiment_resources(
@@ -1274,7 +1388,15 @@ class Master:
         n = 0
         awaiting = 0
         for row in self.db.list_experiments():
-            if row["state"] in db_mod.TERMINAL_STATES:
+            if row["state"] == "DELETING":
+                # A delete interrupted by the restart: rows are intact
+                # (deletion removes them last) — surface as retryable.
+                self.db.set_experiment_state(row["id"], "DELETE_FAILED")
+                continue
+            if (
+                row["state"] in db_mod.TERMINAL_STATES
+                or row["state"] == "DELETE_FAILED"
+            ):
                 continue
             exp = Experiment(row["id"], row["config"], self.db, self.launcher)
             exp.on_state_change = self._on_exp_state

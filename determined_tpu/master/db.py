@@ -544,6 +544,30 @@ class Database:
             (json.dumps(config), time.time(), exp_id),
         )
 
+    def delete_experiment_rows(self, exp_id: int) -> None:
+        """Remove an experiment and everything hanging off it (trials,
+        metrics, checkpoints rows, task logs, allocations) — the final
+        step of DeleteExperiment, AFTER checkpoint files are gone from
+        storage (ref api_experiment.go:365 deleteExperiments). The audit
+        trail is intentionally untouched."""
+        self._read_barrier()
+        trial_ids = [
+            r["id"] for r in self._query(
+                "SELECT id FROM trials WHERE experiment_id=?", (exp_id,)
+            )
+        ]
+        for tid in trial_ids:
+            self._execute("DELETE FROM metrics WHERE trial_id=?", (tid,))
+            self._execute("DELETE FROM checkpoints WHERE trial_id=?", (tid,))
+            self._execute(
+                "DELETE FROM task_logs WHERE task_id=?", (f"trial-{tid}",)
+            )
+            self._execute(
+                "DELETE FROM allocations WHERE trial_id=?", (tid,)
+            )
+        self._execute("DELETE FROM trials WHERE experiment_id=?", (exp_id,))
+        self._execute("DELETE FROM experiments WHERE id=?", (exp_id,))
+
     def set_experiment_archived(self, exp_id: int, archived: bool) -> None:
         self._execute(
             "UPDATE experiments SET archived=? WHERE id=?",

@@ -179,9 +179,11 @@ async function killTrial(id) {
   await post(`/api/v1/trials/${id}/kill`);
   refresh();
 }
-// mirror of the server's db.TERMINAL_STATES — used by both tables' action
-// buttons; keep the one copy in sync with the master.
-const TERMINAL_STATES = ['COMPLETED', 'CANCELED', 'ERRORED'];
+// The server's db.TERMINAL_STATES plus DELETE_FAILED: a failed delete is
+// settled for ACTION purposes (no pause/kill — the retry is the delete
+// button itself); keep in sync with the master.
+const TERMINAL_STATES = ['COMPLETED', 'CANCELED', 'ERRORED',
+                         'DELETE_FAILED'];
 let expLabels = {};  // id -> rendered label string (prompt prefill)
 async function editLabels(id) {
   const v = prompt('labels (comma-separated)', expLabels[id] || '');
@@ -772,6 +774,12 @@ async function xdAction(id, action) {
   await post(`/api/v1/experiments/${id}/${action}`);
   renderExpDetail(id);
 }
+async function xdDelete(id) {
+  if (!confirm(`DELETE experiment ${id} and its checkpoints? ` +
+               'This cannot be undone.')) return;
+  const r = await post(`/api/v1/experiments/${id}`, null, 'DELETE');
+  if (r.ok) location.hash = '#/';  // refused (e.g. registry pin): stay
+}
 async function renderExpDetail(id) {
   const epoch = routeEpoch;
   if (xdExpId !== id) xdTrialPage = 0;
@@ -799,7 +807,9 @@ async function renderExpDetail(id) {
     (e.state === 'PAUSED'
       ? `<button onclick="xdAction(${id},'activate')">activate</button> ` : '') +
     (terminal ? '' : `<button onclick="xdAction(${id},'kill')">kill</button> `) +
-    `<button onclick="forkExp(${id})">fork</button>`;
+    `<button onclick="forkExp(${id})">fork</button>` +
+    (terminal
+      ? ` <button onclick="xdDelete(${id})">delete</button>` : '');
   $('xd-config').textContent = JSON.stringify(e.config, null, 2);
   const trialsR = await j(`/api/v1/experiments/${id}/trials` +
     `?limit=${PAGE_SIZE}&offset=${xdTrialPage * PAGE_SIZE}`);

@@ -176,6 +176,12 @@ class Experiment:
             f"/api/v1/experiments/{self.id}/resources", json_body=body
         )
 
+    def delete(self) -> None:
+        """Delete this (terminal) experiment and its checkpoints
+        (ref: DeleteExperiment). Asynchronous: state walks DELETING →
+        gone, or DELETE_FAILED with everything intact."""
+        self._session.delete(f"/api/v1/experiments/{self.id}")
+
     def pause(self) -> None:
         self._session.post(f"/api/v1/experiments/{self.id}/pause")
 

@@ -1,0 +1,213 @@
+"""User-initiated deletion (ref api_experiment.go:365 DeleteExperiment,
+api_checkpoint.go:375 DeleteCheckpoints): terminal experiments delete
+their checkpoint files then every DB row; single checkpoints delete
+files and keep a DELETED row; the model registry pins both."""
+import os
+import time
+
+import pytest
+import requests
+
+from determined_tpu.master.api_server import ApiServer
+from determined_tpu.master.core import Master
+
+
+def _make_exp(master, tmp_path, state="COMPLETED", n_ckpts=2):
+    eid = master.db.add_experiment({
+        "entrypoint": "x:y",
+        "checkpoint_storage": {
+            "type": "shared_fs", "host_path": str(tmp_path / "ckpt"),
+        },
+    }, state=state)
+    tid = master.db.add_trial(eid, 1, {}, seed=0)
+    master.db.add_metrics(tid, "training", 1, {"loss": 1.0})
+    master.db.add_task_logs(f"trial-{tid}", [
+        {"ts": 1.0, "log": "hi", "level": "INFO", "rank": 0},
+    ])
+    # synced tfevents (deleted with the experiment, ref checkpoint_gc.go:42)
+    tb = tmp_path / "ckpt" / "tensorboard" / f"trial-{tid}"
+    tb.mkdir(parents=True)
+    (tb / "events.out.tfevents.1").write_bytes(b"tb")
+    uuids = []
+    for i in range(n_ckpts):
+        uuid = f"aaaa-{eid}-{i}"
+        d = tmp_path / "ckpt" / uuid
+        d.mkdir(parents=True)
+        (d / "w.bin").write_bytes(b"x" * 16)
+        master.db.add_checkpoint(
+            uuid, trial_id=tid, task_id=f"trial-{tid}", allocation_id="a",
+            resources=["w.bin"], metadata={"steps_completed": i},
+        )
+        uuids.append(uuid)
+    master.db._read_barrier()
+    return eid, tid, uuids
+
+
+def _wait_deleted(master, eid, timeout=15):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if master.db.get_experiment(eid) is None:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+class TestDeleteExperiment:
+    def test_deletes_files_and_rows(self, tmp_path):
+        master = Master(db_path=str(tmp_path / "m.db"))
+        try:
+            eid, tid, uuids = _make_exp(master, tmp_path)
+            master.delete_experiment(eid)
+            assert _wait_deleted(master, eid)
+            for uuid in uuids:
+                assert not (tmp_path / "ckpt" / uuid).exists()
+            assert not (
+                tmp_path / "ckpt" / "tensorboard" / f"trial-{tid}"
+            ).exists()
+            master.db._read_barrier()
+            assert master.db.get_trial(tid) is None
+            assert master.db.get_metrics(tid, "training") == []
+            assert master.db.get_task_logs(f"trial-{tid}") == []
+            assert master.db.get_checkpoint(uuids[0]) is None
+        finally:
+            master.shutdown()
+
+    def test_non_terminal_refused(self, tmp_path):
+        master = Master(db_path=str(tmp_path / "m.db"))
+        try:
+            eid, _, _ = _make_exp(master, tmp_path, state="ACTIVE")
+            with pytest.raises(ValueError, match="terminal"):
+                master.delete_experiment(eid)
+        finally:
+            master.shutdown()
+
+    def test_registry_pin_blocks(self, tmp_path):
+        master = Master(db_path=str(tmp_path / "m.db"))
+        try:
+            eid, _, uuids = _make_exp(master, tmp_path)
+            master.db.add_model("keeper", "d", {})
+            master.db.add_model_version("keeper", uuids[0])
+            master.db._read_barrier()
+            with pytest.raises(ValueError, match="registry"):
+                master.delete_experiment(eid)
+            assert master.db.get_experiment(eid) is not None
+        finally:
+            master.shutdown()
+
+    def test_pin_added_after_enqueue_aborts_job(self, tmp_path):
+        """TOCTOU guard: a model version registered between the
+        synchronous pin check and the background job running must still
+        block — the job re-checks and fails the delete instead of
+        breaking the registry's reference."""
+        master = Master(db_path=str(tmp_path / "m.db"))
+        try:
+            eid, _, uuids = _make_exp(master, tmp_path)
+            gate = __import__("threading").Event()
+            master._work.put(lambda: gate.wait(10))  # hold the worker
+            master.delete_experiment(eid)
+            master.db.add_model("late", "d", {})
+            master.db.add_model_version("late", uuids[0])
+            master.db._read_barrier()
+            gate.set()
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                row = master.db.get_experiment(eid)
+                if row and row["state"] == "DELETE_FAILED":
+                    break
+                time.sleep(0.1)
+            row = master.db.get_experiment(eid)
+            assert row is not None and row["state"] == "DELETE_FAILED"
+            assert (tmp_path / "ckpt" / uuids[0]).exists()  # files intact
+        finally:
+            master.shutdown()
+
+    def test_interrupted_delete_becomes_retryable(self, tmp_path):
+        db_path = str(tmp_path / "m.db")
+        master = Master(db_path=db_path)
+        try:
+            eid, _, _ = _make_exp(master, tmp_path)
+            # simulate a crash mid-delete: state persisted as DELETING,
+            # the background job never ran
+            master.db.set_experiment_state(eid, "DELETING")
+            master.db._read_barrier()
+        finally:
+            master.shutdown()
+        m2 = Master(db_path=db_path)
+        try:
+            m2.restore_experiments(reconcile_grace_s=0)
+            m2.db._read_barrier()
+            row = m2.db.get_experiment(eid)
+            assert row["state"] == "DELETE_FAILED"
+            # and the retry completes
+            m2.delete_experiment(eid)
+            assert _wait_deleted(m2, eid)
+        finally:
+            m2.shutdown()
+
+
+class TestDeleteCheckpoint:
+    def test_delete_marks_row_and_removes_files(self, tmp_path):
+        master = Master(db_path=str(tmp_path / "m.db"))
+        try:
+            eid, tid, uuids = _make_exp(master, tmp_path)
+            master.delete_checkpoint(uuids[0])
+            master.db._read_barrier()
+            assert not (tmp_path / "ckpt" / uuids[0]).exists()
+            c = master.db.get_checkpoint(uuids[0])
+            assert c is not None and c["state"] == "DELETED"
+            # sibling untouched
+            assert (tmp_path / "ckpt" / uuids[1]).exists()
+        finally:
+            master.shutdown()
+
+    def test_pinned_checkpoint_refused(self, tmp_path):
+        master = Master(db_path=str(tmp_path / "m.db"))
+        try:
+            _, _, uuids = _make_exp(master, tmp_path)
+            master.db.add_model("keeper", "d", {})
+            master.db.add_model_version("keeper", uuids[1])
+            master.db._read_barrier()
+            with pytest.raises(ValueError, match="registry"):
+                master.delete_checkpoint(uuids[1])
+            assert (tmp_path / "ckpt" / uuids[1]).exists()
+        finally:
+            master.shutdown()
+
+
+class TestDeleteApi:
+    def test_routes_and_auth(self, tmp_path):
+        master = Master(
+            db_path=str(tmp_path / "m.db"),
+            users={"root": "rootpw"},
+        )
+        api = ApiServer(master)
+        api.start()
+        master.external_url = api.url
+        try:
+            eid, _, uuids = _make_exp(master, tmp_path)
+            r = requests.post(
+                f"{api.url}/api/v1/auth/login",
+                json={"username": "root", "password": "rootpw"}, timeout=10,
+            )
+            h = {"Authorization": "Bearer " + r.json()["token"]}
+            # task tokens must not delete experiments (read-only surface)
+            ttok = master.auth.issue_task_token("trial-1")
+            assert requests.delete(
+                f"{api.url}/api/v1/experiments/{eid}",
+                headers={"Authorization": "Bearer " + ttok}, timeout=10,
+            ).status_code == 403
+            assert requests.delete(
+                f"{api.url}/api/v1/experiments/999999", headers=h, timeout=10
+            ).status_code == 404
+            assert requests.delete(
+                f"{api.url}/api/v1/checkpoints/{uuids[1]}",
+                headers=h, timeout=10,
+            ).status_code == 200
+            r = requests.delete(
+                f"{api.url}/api/v1/experiments/{eid}", headers=h, timeout=10
+            )
+            assert r.status_code == 200 and r.json()["state"] == "DELETING"
+            assert _wait_deleted(master, eid)
+        finally:
+            api.stop()
+            master.shutdown()
