@@ -243,6 +243,53 @@ def exp_set_resources(field: str):
     return fn
 
 
+def preview_search(args: argparse.Namespace) -> None:
+    """`dtpu preview-search <config>` (ref: det preview-search /
+    PreviewHPSearch): validate the config, drive the searcher to
+    completion against a synthetic metric, and show the trial/workload
+    plan — how many trials, how long each trains, what ASHA's rungs
+    promote — before spending any chips. Runs entirely client-side."""
+    import collections
+    import random as random_mod
+
+    from determined_tpu.master import expconf
+    from determined_tpu.searcher import make_searcher, simulate
+
+    config = _load_config(args.config)
+    _apply_dot_overrides(config, args.config_override)
+    try:
+        merged, notes = expconf.apply(config)
+    except ValueError as e:
+        _die(str(e))
+    for note in notes:
+        print(f"note: {note}")
+    searcher_cfg = merged.get("searcher", {})
+    if searcher_cfg.get("name") == "custom":
+        _die("custom searchers decide at runtime; preview cannot simulate")
+    searcher = make_searcher(
+        searcher_cfg, merged.get("hyperparameters", {}),
+        seed=int(args.seed),
+    )
+    rng = random_mod.Random(int(args.seed))
+    # Synthetic metric: random per trial, refined with length — enough to
+    # exercise promotion decisions without pretending to know the model.
+    per_trial: dict = {}
+    def metric(hparams, length):
+        base = per_trial.setdefault(id(hparams), rng.random())
+        return base / (1 + 0.01 * length)
+    res = simulate(searcher, metric)
+    print(
+        f"searcher {searcher_cfg.get('name', 'single')}: "
+        f"{res.n_trials} trial(s), {res.total_units} total training units"
+    )
+    by_len = collections.Counter(res.lengths())
+    for length in sorted(by_len):
+        print(f"  {by_len[length]:4d} trial(s) train to {length} units")
+    if args.show_hparams:
+        for t in list(res.trials.values())[: args.show_hparams]:
+            print(f"  trial {t.request_id}: len={t.length} {t.hparams}")
+
+
 def exp_delete(args: argparse.Namespace) -> None:
     """`dtpu e delete <id>` (ref: det experiment delete): terminal
     experiments only; checkpoints are removed from storage."""
@@ -715,6 +762,20 @@ def model_versions(args: argparse.Namespace) -> None:
     _table(versions, ["version", "checkpoint_uuid"])
 
 
+def model_delete(args: argparse.Namespace) -> None:
+    """`dtpu model delete <name> [--version N]` (ref: DeleteModel /
+    DeleteModelVersion): removes the registry entry; the checkpoints it
+    pinned become GC/delete-eligible."""
+    if args.version is not None:
+        _session(args).delete(
+            f"/api/v1/models/{args.name}/versions/{args.version}"
+        )
+        print(f"deleted {args.name} v{args.version}")
+    else:
+        _session(args).delete(f"/api/v1/models/{args.name}")
+        print(f"deleted model {args.name}")
+
+
 # -- config templates (ref: cli template set/describe/list) -------------------
 def template_set(args: argparse.Namespace) -> None:
     with open(args.config_file) as f:
@@ -1105,6 +1166,14 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("experiment_ids", type=int, nargs="+")
     v.set_defaults(fn=tb_start)
 
+    v = sub.add_parser("preview-search")
+    v.add_argument("config")
+    v.add_argument("--config-override", "-O", action="append")
+    v.add_argument("--seed", type=int, default=0)
+    v.add_argument("--show-hparams", type=int, default=0, metavar="N",
+                   help="also print the first N trials' sampled hparams")
+    v.set_defaults(fn=preview_search)
+
     v = sub.add_parser("tunnel")
     v.add_argument("task_id")
     v.add_argument("local_port", type=int)
@@ -1153,6 +1222,10 @@ def build_parser() -> argparse.ArgumentParser:
     v = model.add_parser("versions")
     v.add_argument("name")
     v.set_defaults(fn=model_versions)
+    v = model.add_parser("delete")
+    v.add_argument("name")
+    v.add_argument("--version", type=int, default=None)
+    v.set_defaults(fn=model_delete)
 
     rp = sub.add_parser("resource-pool", aliases=["rp"]).add_subparsers(
         dest="verb", required=True)

@@ -1032,6 +1032,22 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
             raise ApiError(404, "no such model")
         return model
 
+    def delete_model(r: ApiRequest):
+        """DeleteModel (ref api_model.go:525): removes the model and its
+        versions — the checkpoints they pinned become GC/delete-eligible."""
+        try:
+            m.db.delete_model(r.groups[0])
+        except KeyError as e:
+            raise ApiError(404, str(e))
+        return {}
+
+    def delete_model_version(r: ApiRequest):
+        try:
+            m.db.delete_model_version(r.groups[0], int(r.groups[1]))
+        except KeyError as e:
+            raise ApiError(404, str(e))
+        return {}
+
     def create_model_version(r: ApiRequest):
         name = r.groups[0]
         if m.db.get_model(name) is None:
@@ -1323,6 +1339,9 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("GET", r"/api/v1/models/([\w.\-]+)/versions", list_model_versions),
         R("POST", r"/api/v1/models/([\w.\-]+)/versions", create_model_version),
         R("GET", r"/api/v1/models/([\w.\-]+)", get_model),
+        R("DELETE", r"/api/v1/models/([\w.\-]+)/versions/(\d+)",
+          delete_model_version),
+        R("DELETE", r"/api/v1/models/([\w.\-]+)", delete_model),
         R("POST", r"/api/v1/workspaces", create_workspace),
         R("GET", r"/api/v1/workspaces", list_workspaces),
         R("POST", r"/api/v1/projects", create_project),

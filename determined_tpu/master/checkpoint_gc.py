@@ -83,22 +83,27 @@ def plan_gc(
     return [c for c in all_ckpts if c["uuid"] not in keep]
 
 
+def delete_one(db: db_mod.Database, storage: Any, uuid: str) -> bool:
+    """Remove one checkpoint's files then mark its row DELETED — the ONE
+    copy of the sequence, shared by policy GC and user-initiated
+    deletion. Returns False (row untouched) when storage refuses."""
+    try:
+        storage.delete(uuid)
+    except FileNotFoundError:
+        pass  # already gone; still mark deleted
+    except Exception:  # noqa: BLE001 - caller decides whether to continue
+        logger.exception("failed to delete checkpoint %s", uuid)
+        return False
+    db.mark_checkpoint_deleted(uuid)
+    return True
+
+
 def run_gc(db: db_mod.Database, exp_id: int, config: Dict[str, Any]) -> int:
     """Delete non-retained checkpoints; returns how many were removed."""
     victims = plan_gc(db, exp_id, config)
     if not victims:
         return 0
     storage = storage_from_config(config.get("checkpoint_storage"))
-    n = 0
-    for c in victims:
-        try:
-            storage.delete(c["uuid"])
-        except FileNotFoundError:
-            pass  # already gone; still mark deleted
-        except Exception:  # noqa: BLE001 - one bad delete must not stop GC
-            logger.exception("failed to delete checkpoint %s", c["uuid"])
-            continue
-        db.mark_checkpoint_deleted(c["uuid"])
-        n += 1
+    n = sum(1 for c in victims if delete_one(db, storage, c["uuid"]))
     logger.info("experiment %d GC: deleted %d checkpoint(s)", exp_id, n)
     return n

@@ -1001,6 +1001,11 @@ class Master:
                 + (" …" if len(pinned) > 5 else "")
             )
         self.db.set_experiment_state(exp_id, "DELETING")
+        # Drop the live object NOW: GET /experiments/<id> overrides the DB
+        # row with live.state, which would mask DELETING/DELETE_FAILED
+        # behind the stale COMPLETED for the rest of the session.
+        with self._lock:
+            self.experiments.pop(exp_id, None)
         config = row["config"]
 
         def job() -> None:
@@ -1057,7 +1062,9 @@ class Master:
     def delete_checkpoint(self, uuid: str) -> None:
         """Remove one checkpoint's files and mark the row DELETED (the
         row stays for lineage, matching the reference's partial-delete
-        accounting)."""
+        accounting). Storage IO runs on the background worker — a large
+        GCS checkpoint deletes one blob per HTTP call and must not hold
+        an API request thread (same reasoning as delete_experiment)."""
         c = self.db.get_checkpoint(uuid)
         if c is None:
             raise KeyError(f"no such checkpoint {uuid}")
@@ -1070,16 +1077,20 @@ class Master:
         if trial is not None:
             row = self.db.get_experiment(trial["experiment_id"])
             config = row["config"] if row else {}
-        from determined_tpu.storage import from_config as storage_from_config
 
-        # from_config(None) → the default shared_fs location (where a
-        # config without the block actually wrote) — never skip the file
-        # removal, or the DELETED row would lie about storage.
-        try:
-            storage_from_config(config.get("checkpoint_storage")).delete(uuid)
-        except FileNotFoundError:
-            pass
-        self.db.mark_checkpoint_deleted(uuid)
+        def job() -> None:
+            from determined_tpu.master import checkpoint_gc
+            from determined_tpu.storage import (
+                from_config as storage_from_config,
+            )
+
+            # from_config(None) → the default shared_fs location (where a
+            # config without the block actually wrote) — never skip the
+            # file removal, or the DELETED row would lie about storage.
+            storage = storage_from_config(config.get("checkpoint_storage"))
+            checkpoint_gc.delete_one(self.db, storage, uuid)
+
+        self._work.put(job)
 
     # -- live job scheduling updates (ref: UpdateJobQueue api.proto:1110,
     # -- det experiment set priority/weight/max-slots) -------------------------

@@ -995,6 +995,33 @@ class Database:
         )
         return version
 
+    def delete_model(self, name: str) -> None:
+        """Remove a model and all its versions (ref api_model.go:525
+        DeleteModel). Versions are pins, not data: the checkpoints they
+        referenced become eligible for GC/deletion, nothing else
+        changes."""
+        if self.get_model(name) is None:
+            raise KeyError(f"no such model {name!r}")
+        self._execute(
+            "DELETE FROM model_versions WHERE model_name=?", (name,)
+        )
+        self._execute("DELETE FROM models WHERE name=?", (name,))
+
+    def delete_model_version(self, name: str, version: int) -> None:
+        """Remove one version (ref DeleteModelVersion), releasing its
+        checkpoint pin."""
+        self._read_barrier()
+        rows = self._query(
+            "SELECT 1 FROM model_versions WHERE model_name=? AND version=?",
+            (name, version),
+        )
+        if not rows:
+            raise KeyError(f"no version {version} of model {name!r}")
+        self._execute(
+            "DELETE FROM model_versions WHERE model_name=? AND version=?",
+            (name, version),
+        )
+
     def referenced_checkpoint_uuids(self) -> List[str]:
         """Checkpoints pinned by model-registry versions (GC must keep them)."""
         return [

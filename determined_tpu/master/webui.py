@@ -179,11 +179,12 @@ async function killTrial(id) {
   await post(`/api/v1/trials/${id}/kill`);
   refresh();
 }
-// The server's db.TERMINAL_STATES plus DELETE_FAILED: a failed delete is
-// settled for ACTION purposes (no pause/kill — the retry is the delete
-// button itself); keep in sync with the master.
+// The server's db.TERMINAL_STATES plus the deletion states: both are
+// settled for ACTION purposes (no pause/kill; DELETE_FAILED's retry is
+// the delete button itself, DELETING needs nothing). Keep in sync with
+// the master.
 const TERMINAL_STATES = ['COMPLETED', 'CANCELED', 'ERRORED',
-                         'DELETE_FAILED'];
+                         'DELETE_FAILED', 'DELETING'];
 let expLabels = {};  // id -> rendered label string (prompt prefill)
 async function editLabels(id) {
   const v = prompt('labels (comma-separated)', expLabels[id] || '');
@@ -808,7 +809,7 @@ async function renderExpDetail(id) {
       ? `<button onclick="xdAction(${id},'activate')">activate</button> ` : '') +
     (terminal ? '' : `<button onclick="xdAction(${id},'kill')">kill</button> `) +
     `<button onclick="forkExp(${id})">fork</button>` +
-    (terminal
+    (terminal && e.state !== 'DELETING'
       ? ` <button onclick="xdDelete(${id})">delete</button>` : '');
   $('xd-config').textContent = JSON.stringify(e.config, null, 2);
   const trialsR = await j(`/api/v1/experiments/${id}/trials` +

@@ -145,16 +145,25 @@ class TestDeleteExperiment:
             m2.shutdown()
 
 
+def _wait_ckpt_deleted(master, uuid, timeout=10):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        master.db._read_barrier()
+        c = master.db.get_checkpoint(uuid)
+        if c is not None and c["state"] == "DELETED":
+            return True
+        time.sleep(0.1)
+    return False
+
+
 class TestDeleteCheckpoint:
     def test_delete_marks_row_and_removes_files(self, tmp_path):
         master = Master(db_path=str(tmp_path / "m.db"))
         try:
             eid, tid, uuids = _make_exp(master, tmp_path)
-            master.delete_checkpoint(uuids[0])
-            master.db._read_barrier()
+            master.delete_checkpoint(uuids[0])  # async: storage IO on the
+            assert _wait_ckpt_deleted(master, uuids[0])  # background worker
             assert not (tmp_path / "ckpt" / uuids[0]).exists()
-            c = master.db.get_checkpoint(uuids[0])
-            assert c is not None and c["state"] == "DELETED"
             # sibling untouched
             assert (tmp_path / "ckpt" / uuids[1]).exists()
         finally:
@@ -211,3 +220,96 @@ class TestDeleteApi:
         finally:
             api.stop()
             master.shutdown()
+
+
+class TestModelRegistryDelete:
+    """DeleteModel / DeleteModelVersion (ref api_model.go:525): registry
+    entries are pins, not data — deleting them releases checkpoints for
+    GC/deletion."""
+
+    def test_delete_version_releases_pin(self, tmp_path):
+        master = Master(db_path=str(tmp_path / "m.db"))
+        api = ApiServer(master)
+        api.start()
+        master.external_url = api.url
+        try:
+            eid, _, uuids = _make_exp(master, tmp_path)
+            master.db.add_model("m", "d", {})
+            v = master.db.add_model_version("m", uuids[0])
+            master.db._read_barrier()
+            with pytest.raises(ValueError, match="registry"):
+                master.delete_checkpoint(uuids[0])
+            r = requests.delete(
+                f"{api.url}/api/v1/models/m/versions/{v}", timeout=10
+            )
+            assert r.status_code == 200
+            master.db._read_barrier()
+            master.delete_checkpoint(uuids[0])  # pin released
+            assert _wait_ckpt_deleted(master, uuids[0])
+            assert requests.delete(
+                f"{api.url}/api/v1/models/m/versions/99", timeout=10
+            ).status_code == 404
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_delete_model_removes_versions(self, tmp_path):
+        master = Master(db_path=str(tmp_path / "m.db"))
+        api = ApiServer(master)
+        api.start()
+        master.external_url = api.url
+        try:
+            _, _, uuids = _make_exp(master, tmp_path)
+            master.db.add_model("gone", "d", {})
+            master.db.add_model_version("gone", uuids[0])
+            master.db._read_barrier()
+            assert requests.delete(
+                f"{api.url}/api/v1/models/gone", timeout=10
+            ).status_code == 200
+            master.db._read_barrier()
+            assert master.db.get_model("gone") is None
+            assert master.db.referenced_checkpoint_uuids() == []
+            assert requests.delete(
+                f"{api.url}/api/v1/models/gone", timeout=10
+            ).status_code == 404
+        finally:
+            api.stop()
+            master.shutdown()
+
+
+class TestPreviewSearch:
+    def test_preview_asha_plan(self, tmp_path, capsys):
+        """dtpu preview-search (ref: det preview-search): shows the trial
+        plan for a config without a master or any chips."""
+        import json as json_mod
+
+        from determined_tpu.cli import cli as cli_mod
+
+        cfg = {
+            "entrypoint": "x:y",
+            "searcher": {"name": "asha", "metric": "loss",
+                         "max_trials": 8, "max_length": 16, "num_rungs": 2},
+            "hyperparameters": {
+                "lr": {"type": "log", "minval": -3, "maxval": -1},
+            },
+        }
+        path = tmp_path / "cfg.json"
+        path.write_text(json_mod.dumps(cfg))
+        cli_mod.main(["preview-search", str(path), "--show-hparams", "2"])
+        out = capsys.readouterr().out
+        assert "8 trial(s)" in out
+        assert "train to 16 units" in out  # someone reaches the top rung
+        assert "'lr':" in out
+
+    def test_preview_rejects_bad_config(self, tmp_path):
+        import json as json_mod
+
+        from determined_tpu.cli import cli as cli_mod
+
+        path = tmp_path / "bad.json"
+        path.write_text(json_mod.dumps({
+            "entrypoint": "x:y",
+            "searcher": {"name": "nope"},
+        }))
+        with pytest.raises(SystemExit):
+            cli_mod.main(["preview-search", str(path)])
