@@ -290,6 +290,30 @@ def preview_search(args: argparse.Namespace) -> None:
             print(f"  trial {t.request_id}: len={t.length} {t.hparams}")
 
 
+def exp_download_code(args: argparse.Namespace) -> None:
+    """`dtpu e download-code <id> [dest]` (ref: GetModelDef /
+    api_experiment.go — the reproducibility verb): fetch the context
+    directory the experiment was submitted with and unpack it."""
+    from determined_tpu.common.context_dir import extract
+
+    session = _session(args)
+    exp = session.get(f"/api/v1/experiments/{args.experiment_id}")
+    ctx_id = (exp.get("config") or {}).get("context")
+    if not ctx_id:
+        _die(
+            f"experiment {args.experiment_id} was submitted without a "
+            "context directory"
+        )
+    data = session.get_bytes(f"/api/v1/files/{ctx_id}")
+    dest = args.dest or f"experiment-{args.experiment_id}-code"
+    if os.path.isdir(dest) and os.listdir(dest):
+        # Extracting over an existing tree would clobber local edits
+        # (the reference's download-model-def refuses likewise).
+        _die(f"destination {dest!r} exists and is not empty")
+    names = extract(data, dest)
+    print(f"extracted {len(names)} file(s) to {dest}/")
+
+
 def exp_delete(args: argparse.Namespace) -> None:
     """`dtpu e delete <id>` (ref: det experiment delete): terminal
     experiments only; checkpoints are removed from storage."""
@@ -309,7 +333,10 @@ def exp_delete(args: argparse.Namespace) -> None:
 
 def ckpt_delete(args: argparse.Namespace) -> None:
     _session(args).delete(f"/api/v1/checkpoints/{args.uuid}")
-    print(f"checkpoint {args.uuid} deleted")
+    print(
+        f"checkpoint {args.uuid}: deleting (async; state shows in "
+        "`dtpu checkpoint list`)"
+    )
 
 
 def exp_move(args: argparse.Namespace) -> None:
@@ -1080,6 +1107,10 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("experiment_id", type=int)
     v.add_argument("--yes", "-y", action="store_true")
     v.set_defaults(fn=exp_delete)
+    v = exp.add_parser("download-code")
+    v.add_argument("experiment_id", type=int)
+    v.add_argument("dest", nargs="?", default=None)
+    v.set_defaults(fn=exp_download_code)
     v = exp.add_parser("move")
     v.add_argument("experiment_id", type=int)
     v.add_argument("project_id", type=int)

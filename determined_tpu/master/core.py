@@ -1084,11 +1084,28 @@ class Master:
                 from_config as storage_from_config,
             )
 
-            # from_config(None) → the default shared_fs location (where a
-            # config without the block actually wrote) — never skip the
-            # file removal, or the DELETED row would lie about storage.
-            storage = storage_from_config(config.get("checkpoint_storage"))
-            checkpoint_gc.delete_one(self.db, storage, uuid)
+            try:
+                # Same TOCTOU re-check as the experiment-delete job: a pin
+                # registered while this waited behind slow deletes still
+                # blocks.
+                if uuid in set(self.db.referenced_checkpoint_uuids()):
+                    raise RuntimeError(
+                        f"checkpoint {uuid} became registry-referenced"
+                    )
+                # from_config(None) → the default shared_fs location
+                # (where a config without the block actually wrote) —
+                # never skip the file removal, or the DELETED row would
+                # lie about storage.
+                storage = storage_from_config(
+                    config.get("checkpoint_storage")
+                )
+                if not checkpoint_gc.delete_one(self.db, storage, uuid):
+                    raise RuntimeError("storage delete failed")
+            except Exception:  # noqa: BLE001
+                # The API already answered 200 (async): the row must
+                # carry the failure, not just a server log line.
+                logger.exception("deleting checkpoint %s failed", uuid)
+                self.db.set_checkpoint_state(uuid, "DELETE_FAILED")
 
         self._work.put(job)
 

@@ -818,6 +818,11 @@ class Database:
     def mark_checkpoint_deleted(self, uuid: str) -> None:
         self._execute("UPDATE checkpoints SET state='DELETED' WHERE uuid=?", (uuid,))
 
+    def set_checkpoint_state(self, uuid: str, state: str) -> None:
+        self._execute(
+            "UPDATE checkpoints SET state=? WHERE uuid=?", (state, uuid)
+        )
+
     # -- task logs -------------------------------------------------------------
     def add_task_logs(self, task_id: str, lines: List[Dict[str, Any]]) -> None:
         now = time.time()

@@ -111,3 +111,35 @@ class TestCLI:
         self._run(api, "master", "info")
         out = capsys.readouterr().out
         assert master.cluster_id in out
+
+
+class TestDownloadCode:
+    def test_download_code_roundtrip(self, live_master, tmp_path, capsys):
+        """`dtpu e download-code` (ref GetModelDef): the context directory
+        an experiment was submitted with comes back byte-identical."""
+        master, api = live_master
+        src = tmp_path / "model"
+        (src / "pkg").mkdir(parents=True)
+        (src / "train.py").write_text("print('v1')\n")
+        (src / "pkg" / "net.py").write_text("W = [1, 2]\n")
+        cfg_path = tmp_path / "config.json"
+        cfg_path.write_text(json.dumps(CONFIG))
+        cli_main(["--master", api.url, "experiment", "create",
+                  str(cfg_path), str(src)])
+        out = capsys.readouterr().out
+        assert "Uploaded context" in out
+        dest = tmp_path / "restored"
+        cli_main(["--master", api.url, "experiment", "download-code", "1",
+                  str(dest)])
+        assert (dest / "train.py").read_text() == "print('v1')\n"
+        assert (dest / "pkg" / "net.py").read_text() == "W = [1, 2]\n"
+
+    def test_download_code_without_context_dies(self, live_master, tmp_path):
+        master, api = live_master
+        cfg_path = tmp_path / "config.json"
+        cfg_path.write_text(json.dumps(CONFIG))
+        cli_main(["--master", api.url, "experiment", "create",
+                  str(cfg_path)])
+        with pytest.raises(SystemExit):
+            cli_main(["--master", api.url, "experiment", "download-code",
+                      "1"])

@@ -313,3 +313,31 @@ class TestPreviewSearch:
         }))
         with pytest.raises(SystemExit):
             cli_mod.main(["preview-search", str(path)])
+
+
+class TestCheckpointDeleteFailure:
+    def test_late_pin_marks_delete_failed(self, tmp_path):
+        """The checkpoint-delete job re-checks registry pins (TOCTOU) and
+        surfaces failure in the ROW state — the API already said 200."""
+        master = Master(db_path=str(tmp_path / "m.db"))
+        try:
+            _, _, uuids = _make_exp(master, tmp_path)
+            gate = __import__("threading").Event()
+            master._work.put(lambda: gate.wait(10))  # hold the worker
+            master.delete_checkpoint(uuids[0])
+            master.db.add_model("late", "d", {})
+            master.db.add_model_version("late", uuids[0])
+            master.db._read_barrier()
+            gate.set()
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                master.db._read_barrier()
+                c = master.db.get_checkpoint(uuids[0])
+                if c["state"] == "DELETE_FAILED":
+                    break
+                time.sleep(0.1)
+            assert master.db.get_checkpoint(uuids[0])["state"] == \
+                "DELETE_FAILED"
+            assert (tmp_path / "ckpt" / uuids[0]).exists()  # files intact
+        finally:
+            master.shutdown()
