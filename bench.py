@@ -177,6 +177,24 @@ def _measure_mfu(config, batch_size: int, inner: int, rounds: int, dev,
     return mfu, tokens_per_sec
 
 
+def long_ctx_mfu_at(dev, seq_len: int, inner: int, rounds: int):
+    """One long-context measurement (remat + chunked CE at GPT-2-small
+    shapes); layer_loop='auto' picks unroll ≤16k and scan+rematted
+    attention beyond. Returns MFU or None (with a traceback — a silent
+    None hides compile bugs)."""
+    try:
+        cfg = GPTConfig(seq_len=seq_len, remat=True, fused_loss=True)
+        mfu, _ = _measure_mfu(
+            cfg, batch_size=1, inner=inner, rounds=rounds, dev=dev
+        )
+        return mfu
+    except Exception:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc()
+        return None
+
+
 def long_ctx_mfu(dev, on_tpu: bool):
     """Long-context rung: GPT-2-small shapes at 16k sequence on one chip —
     Pallas flash attention + remat + chunked cross-entropy (the [1, 16384,
@@ -187,22 +205,18 @@ def long_ctx_mfu(dev, on_tpu: bool):
     (mfu, seq_len) or (None, 0)."""
     try:
         if on_tpu:
-            # At exactly 16k the auto layer loop still UNROLLS
-            # (gpt.py: seq_len <= 16384), so scan_unroll has no effect
-            # here — an apparent unroll gain in the r5 sweep was
-            # run-order variance (review caught it). The real r5 levers:
-            # inner=3/rounds=3 tames the 16k rung's noise, and running
-            # this rung BEFORE the NeoX rungs (see main) avoids their
-            # HBM fragmentation (~2-3 MFU points). b2 regresses (46.4
-            # vs ~49 at b1).
-            cfg = GPTConfig(seq_len=16384, remat=True, fused_loss=True)
-            mfu, _ = _measure_mfu(cfg, batch_size=1, inner=3, rounds=3, dev=dev)
-        else:
-            cfg = GPTConfig(
-                vocab_size=512, n_layers=1, n_heads=4, d_model=128,
-                d_ff=512, seq_len=1024, remat=True, fused_loss=True,
-            )
-            mfu, _ = _measure_mfu(cfg, batch_size=1, inner=1, rounds=1, dev=dev)
+            # inner=3/rounds=3 tames the 16k rung's run-to-run noise, and
+            # running this rung BEFORE the NeoX rungs (see main) avoids
+            # their HBM fragmentation (~2-3 MFU points). b2 regresses
+            # (46.4 vs ~49 at b1); an apparent scan_unroll gain in the r5
+            # sweep was run-order variance (review caught it — at exactly
+            # 16k the auto layer loop unrolls and the knob is dead).
+            return long_ctx_mfu_at(dev, 16384, inner=3, rounds=3), 16384
+        cfg = GPTConfig(
+            vocab_size=512, n_layers=1, n_heads=4, d_model=128,
+            d_ff=512, seq_len=1024, remat=True, fused_loss=True,
+        )
+        mfu, _ = _measure_mfu(cfg, batch_size=1, inner=1, rounds=1, dev=dev)
         return mfu, cfg.seq_len
     except Exception:  # noqa: BLE001 — skip the rung, keep the headline
         import traceback
@@ -333,6 +347,13 @@ def main() -> None:
         if lc_mfu is not None:
             record["long_ctx_mfu"] = round(100.0 * lc_mfu, 2)
             record["long_ctx_seq_len"] = lc_seq
+        if on_tpu:
+            # Informational 32k point (the layer_loop="auto" scan +
+            # rematted-attention regime): bounds how the single-chip
+            # story degrades past the unrolled-trunk boundary.
+            mfu32 = long_ctx_mfu_at(dev, 32768, inner=2, rounds=2)
+            if mfu32 is not None:
+                record["long_ctx_32k_mfu"] = round(100.0 * mfu32, 2)
     if not os.environ.get("DTPU_BENCH_SKIP_NEOX"):
         neox_mfu, neox_layers = neox_class_mfu(dev, on_tpu)
         if neox_mfu is not None:
