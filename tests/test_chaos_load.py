@@ -58,7 +58,11 @@ class TestChaos:
             assert exp is not None
 
             churns = 0
-            deadline = time.time() + 600
+            # 900s: the image has ONE cpu core, so under a full-suite run
+            # every churned trial's respawn (python + jax import + CPU
+            # compile) serializes behind whatever else is running — 600s
+            # flaked at suite tail while the test passes alone in ~30s.
+            deadline = time.time() + 900
             replacement = 0
             while exp.state not in ("COMPLETED", "ERRORED", "CANCELED"):
                 assert time.time() < deadline, f"stuck in {exp.state}"
