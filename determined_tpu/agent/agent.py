@@ -33,7 +33,9 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from determined_tpu.common import faults
 from determined_tpu.common.api_session import Session
+from determined_tpu.common.resilience import AGENT_RETRY
 
 logger = logging.getLogger("determined_tpu.agent")
 
@@ -202,6 +204,7 @@ class AgentDaemon:
             # must not mistake them for silently-lost work and fail them
             # over — the real exit code is seconds away.
             exiting = [t.alloc_id for t, _ in self._pending_exits]
+        faults.inject("agent.register")
         resp = self.session.post(
             "/api/v1/agents",
             json_body={
@@ -230,6 +233,12 @@ class AgentDaemon:
 
     def run_forever(self) -> None:
         needs_register = True
+        # Supervision loops never give up; they back off (resilience
+        # Backoff, deterministic jitter) while the master is away and
+        # reset the moment it answers — replacing the old fixed
+        # time.sleep(2) retry loops.
+        reg_backoff = AGENT_RETRY.backoff(f"agent.register:{self.agent_id}")
+        poll_backoff = AGENT_RETRY.backoff(f"agent.poll:{self.agent_id}")
         while not self._stop.is_set():
             if needs_register:
                 # Retry registration until the master accepts it — a single
@@ -239,10 +248,11 @@ class AgentDaemon:
                     needs_register = self.register()
                 except Exception as e:  # noqa: BLE001
                     logger.warning("register failed (%s); retrying", e)
-                    time.sleep(2)
+                    self._stop.wait(reg_backoff.next_delay())
                     continue
+                reg_backoff.reset()
                 if needs_register:
-                    time.sleep(1)  # master restore in progress; re-offer
+                    self._stop.wait(1)  # master restore in progress; re-offer
                     continue
             if self._pending_exits:
                 # Exits the master deferred (503 during its restore) or
@@ -250,13 +260,15 @@ class AgentDaemon:
                 # completed work.
                 self._flush_pending_exits()
             try:
+                faults.inject("agent.poll")
                 resp = self.session.get(
                     f"/api/v1/agents/{self.agent_id}/actions",
                     params={"timeout_seconds": 30}, timeout=40,
                 )
+                poll_backoff.reset()
             except Exception as e:  # noqa: BLE001
                 logger.warning("poll failed (%s); retrying", e)
-                time.sleep(2)
+                self._stop.wait(poll_backoff.next_delay())
                 needs_register = True  # master may have restarted
                 continue
             if self._stop.is_set() or self._detached:
@@ -493,7 +505,12 @@ class AgentDaemon:
         persists in the state file, so nothing is lost or duplicated across
         agent restarts, and a failed ship retries instead of dropping the
         batch (unlike a pipe, the data is still on disk)."""
-        failures_after_done = 0
+        #: Once the task is DONE, keep retrying the tail for at most this
+        #: long — the master is gone for good past that, and lingering
+        #: ship threads would stall agent shutdown.
+        done_retry_window_s = 60.0
+        give_up_at: Optional[float] = None
+        ship_backoff = AGENT_RETRY.backoff(f"agent.ship:{task.alloc_id}")
         while not self._detached:
             chunk = b""
             try:
@@ -519,14 +536,19 @@ class AgentDaemon:
                     # so a mid-chunk failure resumes after the delivered
                     # lines instead of duplicating them.
                     self._ship_lines(task, chunk[:end])
+                    ship_backoff.reset()
                     continue  # immediately look for more
                 except Exception as e:  # noqa: BLE001
                     logger.warning("log ship failed for %s: %s", task.alloc_id, e)
+                    delay = ship_backoff.next_delay()
                     if done:
-                        failures_after_done += 1
-                        if failures_after_done > 30:
+                        if give_up_at is None:
+                            give_up_at = time.time() + done_retry_window_s
+                        if time.time() + delay > give_up_at:
                             return  # master gone for good; stop retrying
-                    time.sleep(2.0)
+                        time.sleep(delay)  # done already set: wait() no-ops
+                    else:
+                        task.done.wait(delay)  # wakes early on task exit
                     continue
             if done:
                 return

@@ -3,15 +3,47 @@
 Mirrors the reference's `harness/determined/common/api/_session.py:10`
 (requests.Session wrapper with auth + retries). The API contract is
 JSON-over-REST; routes live in determined_tpu/master/api_server.py.
+
+Resilience (common/resilience.py): every request — including the
+checkpoint-shard `post_bytes` path — runs under a `RetryPolicy`
+(exponential backoff, deterministic jitter) behind a per-endpoint
+`CircuitBreaker`, so a wedged route fails fast instead of serially burning
+connect timeouts while healthy routes keep flowing. Mutating requests
+carry an `X-Request-Id` idempotency key: a POST retried after a timeout
+that actually landed is deduped by the master instead of double-applied.
+Fault sites: `api.get` / `api.post` / `api.patch` / `api.delete`
+(common/faults.py) inject failures per attempt for chaos drills.
 """
 from __future__ import annotations
 
-import time
+import re
+import uuid
 from typing import Any, Dict, Optional
 
 import requests
 
+from determined_tpu.common import faults
+from determined_tpu.common.resilience import (
+    API_RETRY,
+    CircuitBreakerRegistry,
+    CircuitOpenError,
+    RetryPolicy,
+)
+
 RETRY_STATUSES = (502, 503, 504)
+
+#: Methods that carry the idempotency header (GET is naturally idempotent).
+MUTATING_METHODS = ("POST", "PATCH", "DELETE")
+
+
+def _endpoint_key(method: str, path: str) -> str:
+    """Breaker key: the route shape, not the instance — `/trials/7/metrics`,
+    `/checkpoints/<uuid>` and `/allocations/trial-7.0/...` collapse to one
+    endpoint each. Any digit-bearing segment is an id, except version
+    segments like `v1` — ids are what keep the registry bounded and let
+    failures on one route accumulate into its shared breaker."""
+    shape = re.sub(r"/(?!v\d+(?:/|$))[^/]*\d[^/]*", "/{id}", path)
+    return f"{method} {shape}"
 
 
 class Session:
@@ -22,11 +54,18 @@ class Session:
         max_retries: int = 5,
         timeout: float = 60.0,
         cert: Optional[str] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+        breakers: Optional[CircuitBreakerRegistry] = None,
     ) -> None:
         self.master_url = master_url.rstrip("/")
         self._token = token
-        self._max_retries = max_retries
         self._timeout = timeout
+        self._policy = retry_policy or RetryPolicy(
+            max_attempts=max_retries + 1,
+            base_delay=API_RETRY.base_delay,
+            max_delay=API_RETRY.max_delay,
+        )
+        self._breakers = breakers or CircuitBreakerRegistry()
         self._http = requests.Session()
         self._verify: Any = None
         if self.master_url.startswith("https:"):
@@ -61,34 +100,71 @@ class Session:
         params: Optional[Dict[str, Any]] = None,
         timeout: Optional[float] = None,
         stream: bool = False,
+        data: Optional[bytes] = None,
+        headers: Optional[Dict[str, str]] = None,
     ) -> requests.Response:
         url = f"{self.master_url}{path}"
-        last_exc: Optional[Exception] = None
-        for attempt in range(self._max_retries + 1):
-            try:
+        site = f"api.{method.lower()}"
+        breaker = self._breakers.get(_endpoint_key(method, path))
+        req_headers = dict(headers or {})
+        if method in MUTATING_METHODS:
+            # One id per LOGICAL request, shared by all its retries: the
+            # master dedupes replays of a mutation whose first attempt
+            # landed but whose response was lost to a timeout.
+            req_headers.setdefault("X-Request-Id", uuid.uuid4().hex)
+
+        def attempt() -> requests.Response:
+            def wire() -> requests.Response:
+                faults.inject(site)
                 resp = self._http.request(
                     method,
                     url,
                     json=json_body,
                     params=params,
+                    data=data,
+                    headers=req_headers or None,
                     timeout=timeout or self._timeout,
                     stream=stream,
                     **({} if self._verify is None else {"verify": self._verify}),
                 )
                 if resp.status_code in RETRY_STATUSES:
-                    raise requests.HTTPError(f"retryable status {resp.status_code}")
+                    raise requests.HTTPError(
+                        f"retryable status {resp.status_code}", response=resp
+                    )
                 resp.raise_for_status()
                 return resp
-            except (requests.ConnectionError, requests.Timeout, requests.HTTPError) as e:
-                last_exc = e
-                if attempt == self._max_retries:
-                    break
-                if isinstance(e, requests.HTTPError) and e.response is not None:
-                    if e.response.status_code not in RETRY_STATUSES:
-                        raise
-                time.sleep(min(2.0 ** attempt * 0.1, 5.0))
-        assert last_exc is not None
-        raise last_exc
+
+            # The breaker sees transport failures and retryable statuses;
+            # a non-retryable 4xx is a HEALTHY endpoint refusing the
+            # request — it must not open the circuit.
+            if not breaker.allow():
+                raise CircuitOpenError(breaker.key, breaker.open_until())
+            try:
+                result = wire()
+            except requests.HTTPError as e:
+                if (
+                    e.response is not None
+                    and e.response.status_code not in RETRY_STATUSES
+                ):
+                    breaker.record_success()
+                else:
+                    breaker.record_failure()
+                raise
+            except Exception:
+                breaker.record_failure()
+                raise
+            breaker.record_success()
+            return result
+
+        def retryable(e: BaseException) -> bool:
+            if isinstance(e, requests.HTTPError):
+                return (
+                    e.response is None
+                    or e.response.status_code in RETRY_STATUSES
+                )
+            return self._policy.should_retry(e)
+
+        return self._policy.call(attempt, key=site, retry_if=retryable)
 
     def get(self, path: str, **kw: Any) -> Any:
         return self._request("GET", path, **kw).json()
@@ -97,14 +173,13 @@ class Session:
         return self._request("GET", path, **kw).content
 
     def post_bytes(self, path: str, data: bytes, **kw: Any) -> Any:
-        url = f"{self.master_url}{path}"
-        resp = self._http.post(
-            url, data=data,
-            headers={"Content-Type": "application/octet-stream"},
-            timeout=kw.get("timeout", self._timeout),
-            **({} if self._verify is None else {"verify": self._verify}),
+        # Through _request like everything else: checkpoint-shard uploads
+        # must survive a master blip (retries + RETRY_STATUSES) — this was
+        # the one path that bypassed them.
+        resp = self._request(
+            "POST", path, data=data,
+            headers={"Content-Type": "application/octet-stream"}, **kw,
         )
-        resp.raise_for_status()
         return resp.json()
 
     def post(self, path: str, json_body: Optional[Dict[str, Any]] = None, **kw: Any) -> Any:

@@ -1,0 +1,66 @@
+"""JAX version compatibility shims.
+
+The platform targets the modern jax surface (top-level `jax.shard_map`
+with `axis_names=` / `check_vma=`); CI images pin older jax (0.4.x) where
+shard_map lives in `jax.experimental.shard_map` and the equivalent knobs
+are spelled `auto=` / `check_rep=`. One shim here keeps every call site on
+the modern spelling (dependency gating per repo policy: adapt, don't
+pin-require).
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Optional
+
+from jax import lax as _lax
+
+try:  # modern jax: top-level export
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # older jax (this image: 0.4.x)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_MODERN = "axis_names" in inspect.signature(_shard_map).parameters
+
+
+def shard_map(
+    f: Any,
+    *,
+    mesh: Any,
+    in_specs: Any,
+    out_specs: Any,
+    axis_names: Optional[Any] = None,
+    check_vma: Optional[bool] = None,
+    **kw: Any,
+):
+    """`jax.shard_map` with the modern keyword surface on any jax.
+
+    On legacy jax: `check_vma` maps to `check_rep`, and `axis_names`
+    (the axes to go manual over) maps to its complement `auto=` (the axes
+    left under GSPMD control).
+    """
+    if _MODERN:
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kw["auto"] = auto
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )
+
+
+def axis_size(axis_name: Any):
+    """`lax.axis_size` (modern) with the classic `psum(1, axis)` fallback
+    — XLA constant-folds the latter, so inside shard_map/pmapped code the
+    two compile identically."""
+    if hasattr(_lax, "axis_size"):
+        return _lax.axis_size(axis_name)
+    return _lax.psum(1, axis_name)

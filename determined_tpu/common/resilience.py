@@ -1,0 +1,320 @@
+"""Unified resilience layer: retry policies and circuit breakers.
+
+Every retry loop in the platform rides this module (enforced by
+tests/test_no_adhoc_retries.py — a bare ``time.sleep`` retry loop anywhere
+else fails CI). Three primitives:
+
+- `RetryPolicy`: exponential backoff with **deterministic jitter** (a
+  sha256 of ``(key, attempt)`` — reproducible timing in tests, decorrelated
+  timing across a fleet of agents hammering a restarted master), attempt
+  and deadline caps, and a retryable-exception predicate. `call()` runs a
+  function under the policy; `backoff()` hands long-running loops (agent
+  poll, log shipping) an incremental delay sequence that `reset()`s on
+  success.
+- `CircuitBreaker`: per-endpoint closed → open → half-open. After
+  `failure_threshold` *consecutive* failures the circuit opens and calls
+  fail fast with `CircuitOpenError` (no connect timeouts burned against a
+  dead endpoint); after `reset_timeout` one half-open probe is let through
+  — success closes the circuit, failure re-opens it.
+- `CircuitBreakerRegistry`: thread-safe per-key breaker map (the Session
+  keys by normalized route, so one wedged long-poll route doesn't open the
+  circuit for checkpoint reports).
+
+Sleeps and clocks are injectable so unit tests run in microseconds with no
+real sleeping (tests/test_resilience.py).
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Tuple, Type
+
+from determined_tpu.common.faults import InjectedFault
+
+# Transient-infrastructure default: connection resets, timeouts, filesystem
+# hiccups, and injected faults. requests exceptions subclass OSError via
+# IOError, so HTTP transports are covered without importing requests here.
+DEFAULT_RETRYABLE: Tuple[Type[BaseException], ...] = (
+    ConnectionError,
+    TimeoutError,
+    OSError,
+    InjectedFault,
+)
+
+# Deterministic OS failures a retry cannot heal: a missing file stays
+# missing, EACCES stays denied, a full disk stays full for the next 5 s.
+# Excluded from the OSError umbrella above so they propagate immediately
+# (a GC'd-mid-download checkpoint must not burn 8 backoff attempts).
+NON_RETRYABLE_OS: Tuple[Type[BaseException], ...] = (
+    FileNotFoundError,
+    PermissionError,
+    IsADirectoryError,
+    NotADirectoryError,
+)
+
+
+class RetryError(Exception):
+    """All attempts exhausted; `__cause__` is the last underlying failure."""
+
+
+class CircuitOpenError(ConnectionError):
+    """Fail-fast: the endpoint's circuit is open (recent consecutive
+    failures); retrying immediately would only burn connect timeouts.
+    Subclasses ConnectionError so existing transport-failure handlers
+    (agent poll loops, harness except paths) treat it as the transient
+    outage it signals."""
+
+    def __init__(self, key: str, retry_at: float) -> None:
+        super().__init__(f"circuit open for {key}")
+        self.key = key
+        self.retry_at = retry_at
+
+
+def _jitter_fraction(key: str, attempt: int) -> float:
+    """Deterministic uniform-ish [0, 1) from (key, attempt)."""
+    digest = hashlib.sha256(f"{key}:{attempt}".encode()).digest()
+    return int.from_bytes(digest[:8], "big") / 2**64
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with deterministic jitter and caps.
+
+    ``max_attempts`` counts total tries (1 = no retry). ``deadline_s``
+    bounds the policy's *own* sleeping: a retry whose backoff would cross
+    the deadline is not taken. ``jitter`` spreads each delay over
+    ``[delay * (1 - jitter), delay]`` using the deterministic fraction —
+    zero for exact-timing tests.
+    """
+
+    max_attempts: int = 5
+    base_delay: float = 0.1
+    multiplier: float = 2.0
+    max_delay: float = 5.0
+    deadline_s: Optional[float] = None
+    jitter: float = 0.5
+    retryable: Tuple[Type[BaseException], ...] = DEFAULT_RETRYABLE
+
+    def delay(self, attempt: int, key: str = "") -> float:
+        """Backoff before retry number `attempt` (0-based)."""
+        try:
+            raw = min(self.base_delay * (self.multiplier ** attempt),
+                      self.max_delay)
+        except OverflowError:
+            # multiplier**attempt exceeds float range (a never-give-up
+            # Backoff ~3 h into an outage reaches 2.0**1024): the clamp
+            # would have won anyway.
+            raw = self.max_delay
+        if self.jitter > 0:
+            raw *= 1.0 - self.jitter * _jitter_fraction(key, attempt)
+        return raw
+
+    def should_retry(self, exc: BaseException) -> bool:
+        if isinstance(exc, CircuitOpenError):
+            return False  # fail fast: that's the breaker's entire point
+        if isinstance(exc, NON_RETRYABLE_OS) and not isinstance(
+            exc, InjectedFault
+        ):
+            return False
+        return isinstance(exc, self.retryable)
+
+    def call(
+        self,
+        fn: Callable[[], Any],
+        *,
+        key: str = "",
+        retry_if: Optional[Callable[[BaseException], bool]] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> Any:
+        """Run `fn` under this policy.
+
+        `retry_if` overrides the exception-class predicate (the Session
+        uses it for status-code-dependent HTTP retryability). The final
+        failure propagates as-is — callers keep their exception types.
+        """
+        predicate = retry_if or self.should_retry
+        start = clock()
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except BaseException as e:  # noqa: BLE001 — predicate filters
+                if not predicate(e):
+                    raise
+                if attempt + 1 >= self.max_attempts:
+                    raise
+                pause = self.delay(attempt, key=key)
+                if (
+                    self.deadline_s is not None
+                    and clock() - start + pause > self.deadline_s
+                ):
+                    raise
+                sleep(pause)
+                attempt += 1
+
+    def backoff(self, key: str = "") -> "Backoff":
+        return Backoff(self, key=key)
+
+
+class Backoff:
+    """Incremental delay sequence for long-running loops.
+
+    ``next_delay()`` returns the policy's delay for the current failure
+    streak (capped at max_delay; the attempt cap does NOT apply — a
+    supervision loop never gives up, it just stops backing off further);
+    ``reset()`` on success starts the next streak from the base delay.
+    """
+
+    def __init__(self, policy: RetryPolicy, key: str = "") -> None:
+        self._policy = policy
+        self._key = key
+        self._streak = 0
+
+    @property
+    def streak(self) -> int:
+        return self._streak
+
+    def next_delay(self) -> float:
+        d = self._policy.delay(self._streak, key=self._key)
+        self._streak += 1
+        return d
+
+    def reset(self) -> None:
+        self._streak = 0
+
+
+@dataclass
+class _BreakerState:
+    failures: int = 0
+    state: str = "closed"         # closed | open | half-open
+    opened_at: float = 0.0
+    probing: bool = False
+
+
+class CircuitBreaker:
+    """Closed/open/half-open breaker over consecutive failures.
+
+    Count only *transport-level* failures (the caller decides what those
+    are): an HTTP 404 is a healthy endpoint giving an unwelcome answer.
+    """
+
+    def __init__(
+        self,
+        key: str = "",
+        failure_threshold: int = 8,
+        reset_timeout: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.key = key
+        self.failure_threshold = failure_threshold
+        self.reset_timeout = reset_timeout
+        self._clock = clock
+        self._s = _BreakerState()
+        self._lock = threading.Lock()
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open()
+            return self._s.state
+
+    def _maybe_half_open(self) -> None:
+        if (
+            self._s.state == "open"
+            and self._clock() - self._s.opened_at >= self.reset_timeout
+        ):
+            self._s.state = "half-open"
+            self._s.probing = False
+
+    def open_until(self) -> float:
+        """Clock time when the next half-open probe is admitted (0.0 when
+        the circuit is closed) — what CircuitOpenError.retry_at carries."""
+        with self._lock:
+            if self._s.state == "closed":
+                return 0.0
+            return self._s.opened_at + self.reset_timeout
+
+    def allow(self) -> bool:
+        """May a call proceed right now? In half-open exactly one probe is
+        admitted until its outcome is recorded."""
+        with self._lock:
+            self._maybe_half_open()
+            if self._s.state == "closed":
+                return True
+            if self._s.state == "half-open" and not self._s.probing:
+                self._s.probing = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._s = _BreakerState()  # closed, streak cleared
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._s.failures += 1
+            self._s.probing = False
+            if self._s.state == "half-open" or (
+                self._s.state == "closed"
+                and self._s.failures >= self.failure_threshold
+            ):
+                self._s.state = "open"
+                self._s.opened_at = self._clock()
+
+    def call(self, fn: Callable[[], Any]) -> Any:
+        """Run `fn` through the breaker: CircuitOpenError when open;
+        records success/failure from the call's outcome."""
+        if not self.allow():
+            raise CircuitOpenError(self.key, self.open_until())
+        try:
+            result = fn()
+        except BaseException:
+            self.record_failure()
+            raise
+        self.record_success()
+        return result
+
+
+class CircuitBreakerRegistry:
+    """Thread-safe per-key breaker map (one breaker per endpoint)."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 8,
+        reset_timeout: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self._kw = dict(
+            failure_threshold=failure_threshold,
+            reset_timeout=reset_timeout,
+            clock=clock,
+        )
+        self._breakers: dict = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: str) -> CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(key)
+            if b is None:
+                b = CircuitBreaker(key, **self._kw)  # type: ignore[arg-type]
+                self._breakers[key] = b
+            return b
+
+
+# -- shared defaults ----------------------------------------------------------
+#: Control-plane HTTP (Session): quick first retry, bounded tail.
+API_RETRY = RetryPolicy(max_attempts=6, base_delay=0.1, max_delay=5.0)
+
+#: Object-store transfers: per-file retries; uploads are large and the
+#: caller (checkpoint writer) runs on a background thread, so a longer
+#: tail is affordable.
+STORAGE_RETRY = RetryPolicy(max_attempts=8, base_delay=0.05, max_delay=2.0,
+                            deadline_s=120.0)
+
+#: Agent supervision loops (register/poll/log-ship): never give up, back
+#: off to 10 s while the master is away.
+AGENT_RETRY = RetryPolicy(max_attempts=1_000_000, base_delay=0.5,
+                          multiplier=2.0, max_delay=10.0)

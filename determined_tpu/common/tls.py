@@ -20,6 +20,7 @@ from __future__ import annotations
 import datetime
 import ipaddress
 import os
+import re
 import socket
 import ssl
 from typing import Optional, Sequence, Tuple
@@ -44,10 +45,18 @@ def generate_self_signed(
     cert_path = os.path.join(directory, "master-cert.pem")
     key_path = os.path.join(directory, "master-key.pem")
 
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.x509.oid import NameOID
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+    except ImportError:
+        # Dependency gating: TPU CI images often ship without the
+        # cryptography wheel; the openssl CLI is everywhere. Same cert
+        # shape (EC P-256, CA:TRUE, SAN-covered), same idempotency.
+        return _generate_self_signed_openssl(
+            directory, cert_path, key_path, hosts, common_name, days
+        )
 
     if os.path.exists(cert_path) and os.path.exists(key_path):
         # Reuse only while the existing cert still serves: not expired (or
@@ -115,6 +124,77 @@ def generate_self_signed(
         )
     with open(cert_path, "wb") as f:
         f.write(cert.public_bytes(serialization.Encoding.PEM))
+    return cert_path, key_path
+
+
+def _san_entries(hosts: Sequence[str]) -> Sequence[str]:
+    """`DNS:`/`IP:`-prefixed SAN entries for localhost/this host/`hosts`."""
+    names = {"localhost", socket.gethostname(), *hosts}
+    entries = []
+    for h in sorted(names):
+        try:
+            ipaddress.ip_address(h)
+            entries.append(f"IP:{h}")
+        except ValueError:
+            entries.append(f"DNS:{h}")
+    entries.append("IP:127.0.0.1")
+    return entries
+
+
+def _generate_self_signed_openssl(
+    directory: str,
+    cert_path: str,
+    key_path: str,
+    hosts: Sequence[str],
+    common_name: str,
+    days: int,
+) -> Tuple[str, str]:
+    """`generate_self_signed` via the openssl CLI (no cryptography wheel).
+
+    Same reuse contract: an existing cert is kept only while it is neither
+    near expiry nor missing a requested SAN.
+    """
+    import subprocess
+
+    if os.path.exists(cert_path) and os.path.exists(key_path):
+        try:
+            ok = subprocess.run(
+                ["openssl", "x509", "-in", cert_path, "-noout",
+                 "-checkend", "86400"],
+                capture_output=True,
+            ).returncode == 0
+            text = subprocess.run(
+                ["openssl", "x509", "-in", cert_path, "-noout", "-text"],
+                capture_output=True, text=True, check=True,
+            ).stdout
+            covered = {
+                m.strip().split(":", 1)[1]
+                for m in re.findall(r"(?:DNS|IP Address):[^,\s]+", text)
+            }
+            if ok and set(hosts) <= covered:
+                return cert_path, key_path
+        except Exception:  # noqa: BLE001 — unreadable/garbage cert: replace
+            pass
+
+    os.makedirs(directory, exist_ok=True)
+    san = ",".join(_san_entries(hosts))
+    # 0600 BEFORE openssl writes the key bytes: no world-readable window.
+    fd = os.open(key_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    os.close(fd)
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "ec",
+            "-pkeyopt", "ec_paramgen_curve:prime256v1",
+            "-keyout", key_path, "-out", cert_path,
+            "-days", str(days), "-nodes",
+            "-subj", f"/CN={common_name}",
+            "-addext", f"subjectAltName={san}",
+            # No explicit basicConstraints: `req -x509` already emits
+            # CA:TRUE, and a duplicate extension breaks chain validation.
+        ],
+        capture_output=True, check=True,
+    )
+    os.chmod(key_path, 0o600)
     return cert_path, key_path
 
 

@@ -7,6 +7,7 @@ from determined_tpu.core._checkpoint import (
     DummyCheckpointContext,
     merge_metadata,
 )
+from determined_tpu.storage.base import CorruptCheckpointError
 from determined_tpu.core._context import Context, init, _dummy_init
 from determined_tpu.core._distributed import DistributedContext, DummyDistributedContext
 from determined_tpu.core._preempt import DummyPreemptContext, PreemptContext, PreemptMode
@@ -32,5 +33,6 @@ __all__ = [
     "DummyPreemptContext",
     "DummySearcherContext",
     "DummyTrainContext",
+    "CorruptCheckpointError",
     "merge_metadata",
 ]

@@ -26,7 +26,11 @@ from typing import Any, Callable, Dict, Iterator, List, Optional
 
 from determined_tpu.common.api_session import Session
 from determined_tpu.core._distributed import DistributedContext
-from determined_tpu.storage.base import StorageManager
+from determined_tpu.storage.base import (
+    MANIFEST_FILE,
+    CorruptCheckpointError,
+    StorageManager,
+)
 
 logger = logging.getLogger("determined_tpu.core")
 
@@ -106,14 +110,24 @@ class CheckpointContext:
             storage_id = str(uuid.uuid4())
 
         my_files = paths if paths is not None else StorageManager._list_dir(ckpt_dir)
-        my_files = [f for f in my_files if f != METADATA_FILE]
-        self._storage.upload(ckpt_dir, storage_id, paths=my_files)
+        my_files = [f for f in my_files if f not in (METADATA_FILE, MANIFEST_FILE)]
+        # manifest=False: data shards only. The chief commits ONE merged
+        # manifest below, strictly after every rank's files — the manifest
+        # is the checkpoint's commit point (storage/base.py), so a crash
+        # anywhere before it leaves an uncommitted directory, never a torn
+        # checkpoint a restore would load.
+        my_digests = self._storage.upload(
+            ckpt_dir, storage_id, paths=my_files, manifest=False,
+            want_digests=True,
+        )
 
         if shard and self._dist.size > 1:
             gathered_files = self._dist.gather(my_files, channel=CKPT_CHANNEL)
             gathered_md = self._dist.gather(metadata, channel=CKPT_CHANNEL)
+            gathered_digests = self._dist.gather(my_digests, channel=CKPT_CHANNEL)
         else:
             gathered_files, gathered_md = [my_files], [metadata]
+            gathered_digests = [my_digests]
 
         chief_err: Optional[BaseException] = None
         if self._dist.is_chief:
@@ -133,8 +147,22 @@ class CheckpointContext:
                     md_path = os.path.join(tmp, METADATA_FILE)
                     with open(md_path, "w") as f:
                         json.dump(merged_md, f)
-                    self._storage.upload(tmp, storage_id, paths=[METADATA_FILE])
-                self._report(storage_id, resources + [METADATA_FILE], merged_md)
+                    md_digest = self._storage.upload(
+                        tmp, storage_id, paths=[METADATA_FILE],
+                        manifest=False, want_digests=True,
+                    )
+                merged_digests: Dict[str, Any] = {}
+                for d in (gathered_digests or []):
+                    merged_digests.update(d or {})
+                merged_digests.update(md_digest)
+                # Commit point: manifest last, report after — the master
+                # only ever hears of fully-committed checkpoints.
+                self._storage.commit_manifest(storage_id, merged_digests)
+                self._report(
+                    storage_id,
+                    resources + [METADATA_FILE, MANIFEST_FILE],
+                    merged_md,
+                )
             except BaseException as e:  # noqa: BLE001 - re-raised after barrier
                 chief_err = e
         if shard and self._dist.size > 1:
@@ -164,8 +192,38 @@ class CheckpointContext:
     def restore_path(
         self, storage_id: str, selector: Optional[Callable[[str], bool]] = None
     ) -> Iterator[str]:
+        """Verified restore: the storage layer checks every file against
+        the checkpoint manifest and raises CorruptCheckpointError on a torn
+        or tampered checkpoint (storage/base.py)."""
         with self._storage.restore_path(storage_id, selector=selector) as path:
             yield path
+
+    def restore_candidates(self, storage_id: Optional[str]) -> List[str]:
+        """Restore order for this trial: `storage_id` first, then earlier
+        COMPLETED checkpoints newest-first — the fallback chain when the
+        newest checkpoint turns out corrupt (torn object-store write that
+        slipped past upload, bit rot, manual tampering).
+
+        Off-cluster (no session/trial) there is nothing to fall back to:
+        just the requested id.
+        """
+        candidates = [storage_id] if storage_id else []
+        if self._session is None or self._trial_id is None:
+            return candidates
+        try:
+            rows = self._session.get(
+                f"/api/v1/trials/{self._trial_id}/checkpoints"
+            ).get("checkpoints", [])
+        except Exception as e:  # noqa: BLE001 — fallback discovery is best-effort
+            logger.warning("could not list fallback checkpoints: %s", e)
+            return candidates
+        rows = [r for r in rows if r.get("state", "COMPLETED") == "COMPLETED"]
+        rows.sort(key=lambda r: float(r.get("report_time") or 0), reverse=True)
+        for row in rows:
+            uuid_ = row.get("uuid")
+            if uuid_ and uuid_ not in candidates:
+                candidates.append(uuid_)
+        return candidates
 
     def download(
         self, storage_id: str, dst: str, selector: Optional[Callable[[str], bool]] = None

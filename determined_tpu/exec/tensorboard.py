@@ -34,7 +34,10 @@ def _sync_loop(storage_cfg: Dict, task_ids: List[str], logdir: str, stop) -> Non
         for task_id in task_ids:
             dest = os.path.join(logdir, task_id)
             try:
-                storage.download(f"tensorboard/{task_id}", dest)
+                # verify=False: this is the append-only tfevents mirror
+                # (uploaded manifest-less), not a checkpoint — verification
+                # would only warn 'UNVERIFIED' every poll tick.
+                storage.download(f"tensorboard/{task_id}", dest, verify=False)
             except FileNotFoundError:
                 pass
             except Exception as e:  # noqa: BLE001
@@ -149,6 +152,11 @@ def main() -> None:
     parser.add_argument("--tasks", required=True,
                         help="comma-separated task ids (trial-<id>, ...)")
     parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--builtin", action="store_true",
+                        help="serve the zero-dependency scalar viewer even "
+                             "when a real tensorboard binary is installed "
+                             "(deterministic data.json contract; also what "
+                             "tests drive, image contents regardless)")
     args = parser.parse_args()
 
     storage_cfg = json.loads(os.environ.get("DTPU_CHECKPOINT_STORAGE", "{}"))
@@ -166,7 +174,7 @@ def main() -> None:
     port = args.port or free_port()
     _register_proxy(port)
 
-    tb = shutil.which("tensorboard")
+    tb = None if args.builtin else shutil.which("tensorboard")
     if tb:
         os.makedirs(logdir, exist_ok=True)
         # No --path_prefix: the master proxy strips /proxy/{task_id} before

@@ -193,6 +193,41 @@ class _DeniedAuditLimiter:
             return False
 
 
+class _IdempotencyCache:
+    """Recent mutation results keyed by X-Request-Id (common/api_session.py
+    stamps one id per logical POST/PATCH/DELETE and reuses it across
+    retries): a retry whose first attempt landed — but whose response was
+    lost to a timeout — replays the stored response instead of
+    double-applying the mutation (double-created experiment, double-counted
+    searcher op completion).
+
+    Only 200s are stored: a failed attempt (including 503 restore-pending)
+    must re-execute on retry. Bounded LRU; per-ApiServer instance for the
+    same reason as _DeniedAuditLimiter."""
+
+    MAX_ENTRIES = 4096
+
+    def __init__(self) -> None:
+        from collections import OrderedDict
+
+        self._entries: "OrderedDict[str, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, request_id: str) -> Optional[Any]:
+        with self._lock:
+            if request_id not in self._entries:
+                return None
+            self._entries.move_to_end(request_id)
+            return self._entries[request_id]
+
+    def put(self, request_id: str, payload: Any) -> None:
+        with self._lock:
+            self._entries[request_id] = payload
+            self._entries.move_to_end(request_id)
+            while len(self._entries) > self.MAX_ENTRIES:
+                self._entries.popitem(last=False)
+
+
 class ApiError(Exception):
     def __init__(self, status: int, message: str) -> None:
         super().__init__(message)
@@ -1403,6 +1438,7 @@ class ApiServer:
     ) -> None:
         routes = build_routes(master)
         denied_limiter = _DeniedAuditLimiter()
+        idempotency = _IdempotencyCache()
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -1570,6 +1606,33 @@ class ApiServer:
                     if err:
                         self._send(403, {"error": err})
                         return
+                # Idempotency replay (after auth: a replayed response must
+                # never leak a mutation result past the token checks that
+                # guarded the original). The cache key binds the client id
+                # to (method, path, principal): a reused tracing id on a
+                # DIFFERENT mutation — or another principal replaying a
+                # leaked id — must execute, not replay someone else's
+                # cached response.
+                rid = (
+                    self.headers.get("X-Request-Id")
+                    if method in ("POST", "PATCH", "DELETE")
+                    else None
+                )
+                if rid:
+                    import hashlib
+
+                    body_tag = hashlib.sha256(raw).hexdigest()[:16]
+                    idem_key = (
+                        f"{rid}|{method}|{parsed.path}|{principal or ''}"
+                        f"|{body_tag}"
+                    )
+                else:
+                    idem_key = None
+                if idem_key:
+                    cached = idempotency.get(idem_key)
+                    if cached is not None:
+                        self._send(200, cached)
+                        return
                 for m_, pat, handler in routes:
                     if m_ != method:
                         continue
@@ -1595,6 +1658,11 @@ class ApiServer:
                                 )
                             )
                             span.set_attribute("http.status_code", 200)
+                            if idem_key:
+                                idempotency.put(
+                                    idem_key,
+                                    result if result is not None else {},
+                                )
                             self._send(200, result if result is not None else {})
                         except _PlainText as pt:
                             data = (
@@ -1787,7 +1855,8 @@ class ApiServer:
             def handle_error(self, request, client_address):  # noqa: ANN001
                 import sys
 
-                exc = sys.exception()
+                # sys.exception() is 3.11+; exc_info works everywhere.
+                exc = sys.exc_info()[1]
                 if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
                     return  # client hung up mid-request (task exit); routine
                 import ssl as ssl_mod

@@ -33,6 +33,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import requests
 
+from determined_tpu.common.resilience import RetryPolicy
 from determined_tpu.master.kubernetes import KubeClient, NodeInfo
 
 logger = logging.getLogger("determined_tpu.master")
@@ -133,39 +134,38 @@ class RestKubeClient(KubeClient):
         create whose response was lost and retried — request_queue.go's
         errDeletionPending/already-exists handling)."""
         url = f"{self.base_url}{path}"
-        last: Optional[Exception] = None
-        for attempt in range(self._max_retries + 1):
-            try:
-                resp = self._http.request(
-                    method, url, json=json_body, params=params,
-                    timeout=self._timeout if timeout is None else timeout,
-                    stream=stream,
-                    # Explicit per request: an ambient REQUESTS_CA_BUNDLE
-                    # would silently override a session-level setting.
-                    verify=self._verify,
+        transient = (429, 500, 502, 503, 504)
+
+        def attempt() -> Optional[requests.Response]:
+            resp = self._http.request(
+                method, url, json=json_body, params=params,
+                timeout=self._timeout if timeout is None else timeout,
+                stream=stream,
+                # Explicit per request: an ambient REQUESTS_CA_BUNDLE
+                # would silently override a session-level setting.
+                verify=self._verify,
+            )
+            if ok_missing and resp.status_code == 404:
+                return None
+            if ok_conflict and resp.status_code == 409:
+                return None
+            if resp.status_code in transient:
+                raise requests.HTTPError(
+                    f"retryable apiserver status {resp.status_code}",
+                    response=resp,
                 )
-                if ok_missing and resp.status_code == 404:
-                    return None
-                if ok_conflict and resp.status_code == 409:
-                    return None
-                if resp.status_code in (429, 500, 502, 503, 504):
-                    raise requests.HTTPError(
-                        f"retryable apiserver status {resp.status_code}"
-                    )
-                resp.raise_for_status()
-                return resp
-            except (
-                requests.ConnectionError, requests.Timeout, requests.HTTPError
-            ) as e:
-                last = e
-                if isinstance(e, requests.HTTPError) and e.response is not None:
-                    if e.response.status_code not in (429, 500, 502, 503, 504):
-                        raise
-                if attempt == self._max_retries:
-                    break
-                time.sleep(min(2.0 ** attempt * 0.1, 5.0))
-        assert last is not None
-        raise last
+            resp.raise_for_status()
+            return resp
+
+        def retryable(e: BaseException) -> bool:
+            if isinstance(e, requests.HTTPError):
+                return e.response is None or e.response.status_code in transient
+            return isinstance(e, (requests.ConnectionError, requests.Timeout))
+
+        policy = RetryPolicy(
+            max_attempts=self._max_retries + 1, base_delay=0.1, max_delay=5.0
+        )
+        return policy.call(attempt, key=f"kube:{method}", retry_if=retryable)
 
     # -- KubeClient surface --------------------------------------------------
     @staticmethod
@@ -603,6 +603,12 @@ class RestKubeClient(KubeClient):
         log_path = (
             f"/api/v1/namespaces/{self.namespace}/pods/{pod_name}/log"
         )
+        # Constant-interval poll while the container is creating (no
+        # deadline — see below); through resilience so the cadence is
+        # policy, not a bare sleep-retry.
+        creating_poll = RetryPolicy(
+            base_delay=2.0, multiplier=1.0, max_delay=2.0, jitter=0.0
+        ).backoff(f"kube-log:{pod_name}")
         try:
             while True:
                 # Check BEFORE the fetch: if the pod went terminal during a
@@ -630,7 +636,7 @@ class RestKubeClient(KubeClient):
                         # ContainerCreating. No deadline: however late the
                         # pod starts (node provisioning can take >10 min),
                         # its stdout must ship; a DELETED pod 404s out.
-                        time.sleep(2.0)
+                        time.sleep(creating_poll.next_delay())
                         continue
                     raise
                 if resp is None:

@@ -16,6 +16,7 @@ from typing import Any, Dict
 
 import requests
 
+from determined_tpu.common.resilience import RetryPolicy
 from determined_tpu.master import db as db_mod
 
 logger = logging.getLogger("determined_tpu.master")
@@ -58,21 +59,29 @@ class WebhookShipper:
                 )
 
     def _run(self) -> None:
+        policy = RetryPolicy(
+            max_attempts=self._max_retries, base_delay=1.0, max_delay=10.0,
+            retryable=(requests.RequestException,),
+        )
         while not self._stop.is_set():
             try:
                 item = self._queue.get(timeout=1.0)
             except queue.Empty:
                 continue
-            for attempt in range(self._max_retries):
-                try:
-                    requests.post(item["url"], json=item["payload"], timeout=10)
-                    break
-                except requests.RequestException as e:
-                    logger.warning(
-                        "webhook delivery to %s failed (%d/%d): %s",
-                        item["url"], attempt + 1, self._max_retries, e,
-                    )
-                    time.sleep(min(2.0 ** attempt, 10.0))
+            try:
+                policy.call(
+                    lambda: requests.post(
+                        item["url"], json=item["payload"], timeout=10
+                    ),
+                    key=f"webhook:{item['url']}",
+                    sleep=self._stop.wait,
+                )
+            except requests.RequestException as e:
+                # At-most-a-few-tries shipper semantics: drop, don't wedge.
+                logger.warning(
+                    "webhook delivery to %s dropped after %d tries: %s",
+                    item["url"], self._max_retries, e,
+                )
 
     def stop(self) -> None:
         self._stop.set()

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from determined_tpu.common import jaxcompat
+
 
 def pipeline_apply(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
@@ -49,7 +51,7 @@ def pipeline_apply(
 
     Returns [M, mb, ...]: final-stage outputs, replicated across the axis.
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = jaxcompat.axis_size(axis_name)
     stage_idx = lax.axis_index(axis_name)
     n_micro = microbatches.shape[0]
     ticks = n_micro + n_stages - 1
@@ -115,7 +117,7 @@ def circular_pipeline_apply(
 
     Returns [M, mb, ...] final outputs, replicated across the axis.
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = jaxcompat.axis_size(axis_name)
     d = lax.axis_index(axis_name)
     v_stages = jax.tree.leaves(stage_params)[0].shape[0]
     n_micro = microbatches.shape[0]
@@ -245,7 +247,7 @@ def one_f_one_b_grads(
     stage_grads per-device with a leading stacking axis of 1 (use out_spec
     P(axis_name)).
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = jaxcompat.axis_size(axis_name)
     d = lax.axis_index(axis_name)
     n_micro = tokens_mb.shape[0]
     cap = one_f_one_b_stash_size(n_micro, n_stages)

@@ -34,7 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from determined_tpu.common import jaxcompat
+from determined_tpu.common.jaxcompat import shard_map
 
 from determined_tpu.ops.flash_attention import fit_block, flash_attention_lse
 
@@ -119,7 +120,7 @@ def ring_attention(
     would be silently wrong; `make_ring_attention` applies the permutation
     for global arrays, data loaders should emit it directly.
     """
-    ring_size = lax.axis_size(axis_name)
+    ring_size = jaxcompat.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     scale = scale if scale is not None else 1.0 / (d ** 0.5)

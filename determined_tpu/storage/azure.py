@@ -4,12 +4,12 @@ azure.py:12 + azure_client.py).
 Same contract as the GCS/S3 managers. The azure-storage-blob client is
 imported lazily and gated; `container_client` can be injected (tests use an
 in-memory fake, the reference's strategy for its azure unit tests) so the
-manager's logic is exercised without the SDK or network.
+manager's logic — including the base class's retry/manifest/verification
+layer — is exercised without the SDK or network.
 """
 from __future__ import annotations
 
-import os
-from typing import Any, Callable, List, Optional
+from typing import Any, List, Optional
 
 from determined_tpu.storage.base import StorageManager
 
@@ -53,47 +53,41 @@ class AzureStorageManager(StorageManager):
                 )
             self._container = svc.get_container_client(container)
         self._prefix = prefix.strip("/")
+        try:
+            from azure.core import exceptions as aexc  # type: ignore
+
+            # Transport failures are transient by class; HttpResponseError
+            # needs a status check — see _transient_sdk_error. Guarded:
+            # injected fake clients run without the SDK installed.
+            self._sdk_retryable = (
+                aexc.ServiceRequestError, aexc.ServiceResponseError,
+            )
+            self._http_response_error = aexc.HttpResponseError
+        except ImportError:
+            self._http_response_error = ()
+
+    _http_response_error: Any = ()
+
+    def _transient_sdk_error(self, exc: BaseException) -> bool:
+        if not isinstance(exc, self._http_response_error):
+            return False
+        status = getattr(exc, "status_code", 0) or 0
+        return status >= 500 or status == 429
 
     def _key(self, storage_id: str, rel: str = "") -> str:
         parts = [p for p in (self._prefix, storage_id, rel) if p]
         return "/".join(parts)
 
-    def upload(
-        self, src: str, storage_id: str, paths: Optional[List[str]] = None
-    ) -> None:
-        rels = paths if paths is not None else self._list_dir(src)
-        for rel in rels:
-            with open(os.path.join(src, rel), "rb") as f:
-                self._container.upload_blob(
-                    self._key(storage_id, rel), f, overwrite=True
-                )
-
-    def download(
-        self,
-        storage_id: str,
-        dst: str,
-        selector: Optional[Callable[[str], bool]] = None,
-    ) -> None:
-        prefix = self._key(storage_id) + "/"
-        exists = False
-        for name in self._blob_names(prefix):
-            rel = name[len(prefix):]
-            if not rel:
-                continue
-            exists = True
-            if selector is not None and not selector(rel):
-                continue
-            target = os.path.join(dst, rel)
-            os.makedirs(os.path.dirname(target), exist_ok=True)
-            stream = self._container.download_blob(name)
-            with open(target, "wb") as f:
-                f.write(stream.readall())
-        # Missing checkpoint is an error; a selector matching nothing in an
-        # existing checkpoint is not (mirrors SharedFSStorageManager).
-        if not exists:
-            raise FileNotFoundError(
-                f"checkpoint {storage_id} not found at azure://{prefix}"
+    def _upload_file(self, local_path: str, storage_id: str, rel: str) -> None:
+        with open(local_path, "rb") as f:
+            self._container.upload_blob(
+                self._key(storage_id, rel), f, overwrite=True
             )
+
+    def _download_file(self, storage_id: str, rel: str, target: str) -> None:
+        stream = self._container.download_blob(self._key(storage_id, rel))
+        with open(target, "wb") as f:
+            f.write(stream.readall())
 
     def delete(
         self, storage_id: str, paths: Optional[List[str]] = None
@@ -106,6 +100,8 @@ class AzureStorageManager(StorageManager):
                 continue
             self._container.delete_blob(name)
             deleted.append(rel)
+        if paths is not None:
+            self._prune_manifest(storage_id, deleted)
         return deleted
 
     def list_files(self, storage_id: str) -> List[str]:

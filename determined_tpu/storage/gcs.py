@@ -3,12 +3,12 @@
 GCS is the first-class cloud backend for TPU fleets. The google-cloud-storage
 client is imported lazily and gated: in environments without it (like CI
 images), constructing the manager raises a clear error, and everything else
-in the platform still works with shared_fs.
+in the platform still works with shared_fs. Directory-level logic, retries,
+and manifest verification live in base.StorageManager.
 """
 from __future__ import annotations
 
-import os
-from typing import Callable, List, Optional
+from typing import List, Optional
 
 from determined_tpu.storage.base import StorageManager
 
@@ -26,39 +26,31 @@ class GCSStorageManager(StorageManager):
         self._client = gcs.Client()
         self._bucket = self._client.bucket(bucket)
         self._prefix = prefix.strip("/")
+        try:
+            from google.api_core import exceptions as gexc  # type: ignore
+
+            # 5xx + 429 + transport resets: what google's own retry
+            # predicate treats as transient. Plain-Exception subclasses,
+            # so the base OSError predicate can't see them.
+            self._sdk_retryable = (
+                gexc.ServerError,        # 500/502/503/504
+                gexc.TooManyRequests,    # 429
+                gexc.RetryError,
+            )
+        except ImportError:
+            pass
 
     def _key(self, storage_id: str, rel: str = "") -> str:
         parts = [p for p in (self._prefix, storage_id, rel) if p]
         return "/".join(parts)
 
-    def upload(self, src: str, storage_id: str, paths: Optional[List[str]] = None) -> None:
-        rels = paths if paths is not None else self._list_dir(src)
-        for rel in rels:
-            blob = self._bucket.blob(self._key(storage_id, rel))
-            blob.upload_from_filename(os.path.join(src, rel))
+    def _upload_file(self, local_path: str, storage_id: str, rel: str) -> None:
+        blob = self._bucket.blob(self._key(storage_id, rel))
+        blob.upload_from_filename(local_path)
 
-    def download(
-        self,
-        storage_id: str,
-        dst: str,
-        selector: Optional[Callable[[str], bool]] = None,
-    ) -> None:
-        prefix = self._key(storage_id) + "/"
-        exists = False
-        for blob in self._client.list_blobs(self._bucket, prefix=prefix):
-            rel = blob.name[len(prefix):]
-            if not rel:
-                continue
-            exists = True
-            if selector is not None and not selector(rel):
-                continue
-            target = os.path.join(dst, rel)
-            os.makedirs(os.path.dirname(target), exist_ok=True)
-            blob.download_to_filename(target)
-        # Missing checkpoint is an error; a selector matching nothing in an
-        # existing checkpoint is not (mirrors SharedFSStorageManager).
-        if not exists:
-            raise FileNotFoundError(f"checkpoint {storage_id} not found at gs://{prefix}")
+    def _download_file(self, storage_id: str, rel: str, target: str) -> None:
+        blob = self._bucket.blob(self._key(storage_id, rel))
+        blob.download_to_filename(target)
 
     def delete(self, storage_id: str, paths: Optional[List[str]] = None) -> List[str]:
         prefix = self._key(storage_id) + "/"
@@ -69,6 +61,8 @@ class GCSStorageManager(StorageManager):
                 continue
             blob.delete()
             deleted.append(rel)
+        if paths is not None:
+            self._prune_manifest(storage_id, deleted)
         return deleted
 
     def list_files(self, storage_id: str) -> List[str]:

@@ -2,12 +2,12 @@
 
 Gated on boto3: TPU-focused images usually ship without AWS SDKs, so the
 import happens at construction with a clear error. The object layout is
-identical to GCS: `{prefix}{storage_id}/{relative_path}`.
+identical to GCS: `{prefix}{storage_id}/{relative_path}`. Directory-level
+logic, retries, and manifest verification live in base.StorageManager.
 """
 from __future__ import annotations
 
-import os
-from typing import Callable, List, Optional
+from typing import List, Optional
 
 from determined_tpu.storage.base import StorageManager
 
@@ -27,16 +27,41 @@ class S3StorageManager(StorageManager):
         self.prefix = prefix.strip("/")
         if self.prefix:
             self.prefix += "/"
+        try:
+            import botocore.exceptions as bexc  # type: ignore
+
+            # Transport-level botocore errors (connections, reads,
+            # endpoint timeouts) are all transient by class; ClientError
+            # needs status inspection — see _transient_sdk_error.
+            self._sdk_retryable = (bexc.ConnectionError, bexc.ReadTimeoutError)
+            self._client_error = bexc.ClientError
+        except ImportError:
+            self._client_error = ()
+
+    _THROTTLE_CODES = (
+        "Throttling", "ThrottlingException", "SlowDown",
+        "RequestTimeout", "ServiceUnavailable", "InternalError",
+    )
+
+    def _transient_sdk_error(self, exc: BaseException) -> bool:
+        if not isinstance(exc, self._client_error):
+            return False
+        err = getattr(exc, "response", {}).get("Error", {})
+        status = getattr(exc, "response", {}).get(
+            "ResponseMetadata", {}
+        ).get("HTTPStatusCode", 0)
+        return status >= 500 or status == 429 or (
+            err.get("Code") in self._THROTTLE_CODES
+        )
 
     def _key(self, storage_id: str, rel: str = "") -> str:
         return f"{self.prefix}{storage_id}/{rel}" if rel else f"{self.prefix}{storage_id}/"
 
-    def upload(self, src: str, storage_id: str, paths: Optional[List[str]] = None) -> None:
-        rels = paths if paths is not None else self._list_dir(src)
-        for rel in rels:
-            self._client.upload_file(
-                os.path.join(src, rel), self.bucket, self._key(storage_id, rel)
-            )
+    def _upload_file(self, local_path: str, storage_id: str, rel: str) -> None:
+        self._client.upload_file(local_path, self.bucket, self._key(storage_id, rel))
+
+    def _download_file(self, storage_id: str, rel: str, target: str) -> None:
+        self._client.download_file(self.bucket, self._key(storage_id, rel), target)
 
     def list_files(self, storage_id: str) -> List[str]:
         out: List[str] = []
@@ -54,19 +79,6 @@ class S3StorageManager(StorageManager):
                 return sorted(out)
             token = resp.get("NextContinuationToken")
 
-    def download(
-        self, storage_id: str, dst: str,
-        selector: Optional[Callable[[str], bool]] = None,
-    ) -> None:
-        for rel in self.list_files(storage_id):
-            if selector is not None and not selector(rel):
-                continue
-            target = os.path.join(dst, rel)
-            os.makedirs(os.path.dirname(target) or dst, exist_ok=True)
-            self._client.download_file(
-                self.bucket, self._key(storage_id, rel), target
-            )
-
     def delete(self, storage_id: str, paths: Optional[List[str]] = None) -> List[str]:
         rels = list(paths if paths is not None else self.list_files(storage_id))
         # DeleteObjects hard-caps at 1000 keys per request.
@@ -80,4 +92,6 @@ class S3StorageManager(StorageManager):
                     ]
                 },
             )
+        if paths is not None:
+            self._prune_manifest(storage_id, rels)
         return rels

@@ -1,7 +1,9 @@
 """Shared-filesystem storage (ref: harness/determined/common/storage/shared.py:120).
 
 On TPU pods this backs NFS/Filestore mounts; it is also the default local
-backend for off-cluster runs and tests.
+backend for off-cluster runs and tests. Directory-level logic, retries,
+manifest commit/verify all live in base.StorageManager; this class is just
+the per-file copy primitives.
 """
 from __future__ import annotations
 
@@ -10,37 +12,25 @@ import os
 import shutil
 from typing import Callable, Iterator, List, Optional
 
-from determined_tpu.storage.base import StorageManager
+from determined_tpu.storage.base import StorageManager, verify_checkpoint_dir
 
 
 class SharedFSStorageManager(StorageManager):
     def _dir(self, storage_id: str) -> str:
         return os.path.join(self.base_path, storage_id)
 
-    def upload(self, src: str, storage_id: str, paths: Optional[List[str]] = None) -> None:
-        dst = self._dir(storage_id)
-        os.makedirs(dst, exist_ok=True)
-        rels = paths if paths is not None else self._list_dir(src)
-        for rel in rels:
-            target = os.path.join(dst, rel)
-            os.makedirs(os.path.dirname(target), exist_ok=True)
-            shutil.copy2(os.path.join(src, rel), target)
+    def _upload_file(self, local_path: str, storage_id: str, rel: str) -> None:
+        target = os.path.join(self._dir(storage_id), rel)
+        os.makedirs(os.path.dirname(target), exist_ok=True)
+        shutil.copy2(local_path, target)
 
-    def download(
-        self,
-        storage_id: str,
-        dst: str,
-        selector: Optional[Callable[[str], bool]] = None,
-    ) -> None:
-        src = self._dir(storage_id)
-        if not os.path.isdir(src):
-            raise FileNotFoundError(f"checkpoint {storage_id} not found under {self.base_path}")
-        for rel in self._list_dir(src):
-            if selector is not None and not selector(rel):
-                continue
-            target = os.path.join(dst, rel)
-            os.makedirs(os.path.dirname(target), exist_ok=True)
-            shutil.copy2(os.path.join(src, rel), target)
+    def _download_file(self, storage_id: str, rel: str, target: str) -> None:
+        src = os.path.join(self._dir(storage_id), rel)
+        if not os.path.exists(src):
+            raise FileNotFoundError(
+                f"checkpoint {storage_id} has no file {rel} under {self.base_path}"
+            )
+        shutil.copy2(src, target)
 
     def delete(self, storage_id: str, paths: Optional[List[str]] = None) -> List[str]:
         root = self._dir(storage_id)
@@ -53,6 +43,7 @@ class SharedFSStorageManager(StorageManager):
         for rel in paths:
             with contextlib.suppress(FileNotFoundError):
                 os.remove(os.path.join(root, rel))
+        self._prune_manifest(storage_id, list(paths))
         return list(paths)
 
     def list_files(self, storage_id: str) -> List[str]:
@@ -65,8 +56,11 @@ class SharedFSStorageManager(StorageManager):
     def restore_path(
         self, storage_id: str, selector: Optional[Callable[[str], bool]] = None
     ) -> Iterator[str]:
-        # Shared fs: serve in place, no copy (ref: shared.py restore_path).
+        # Shared fs: serve in place, no copy (ref: shared.py restore_path) —
+        # verified against the manifest right here, since no download pass
+        # will see the files.
         root = self._dir(storage_id)
         if not os.path.isdir(root):
             raise FileNotFoundError(f"checkpoint {storage_id} not found under {self.base_path}")
+        verify_checkpoint_dir(root, selector=selector)
         yield root

@@ -224,6 +224,7 @@ class TensorboardManager:
         uploaded = []
         if not os.path.isdir(self.logdir):
             return uploaded
+        sizes: Dict[str, int] = {}
         for root, _, files in os.walk(self.logdir):
             for fname in files:
                 full = os.path.join(root, fname)
@@ -231,9 +232,16 @@ class TensorboardManager:
                 size = os.path.getsize(full)
                 if self._synced_bytes.get(rel) == size:
                     continue
-                self.storage.upload(
-                    self.logdir, f"tensorboard/{self.task_id}", paths=[rel]
-                )
-                self._synced_bytes[rel] = size
+                sizes[rel] = size
                 uploaded.append(rel)
+        if uploaded:
+            # One batched call per tick; manifest=False — tfevents syncs
+            # are an append-only mirror on a hot loop, not a checkpoint
+            # commit, so the manifest read-modify-write would only add
+            # object-store round trips.
+            self.storage.upload(
+                self.logdir, f"tensorboard/{self.task_id}", paths=uploaded,
+                manifest=False,
+            )
+            self._synced_bytes.update(sizes)
         return uploaded

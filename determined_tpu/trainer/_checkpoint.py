@@ -23,6 +23,8 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import numpy as np
 
+from determined_tpu.storage.base import CorruptCheckpointError
+
 MANIFEST = "tree.json"
 
 
@@ -240,7 +242,10 @@ def _read_region(
     if "file" in entry:
         arr = np.load(entry["file"], mmap_mode="r")
         if tuple(arr.shape) != shape:
-            raise ValueError(
+            # CorruptCheckpointError (a ValueError): the trainer's restore
+            # fallback treats pytree-level drift like storage-level
+            # corruption and walks back to the last verified checkpoint.
+            raise CorruptCheckpointError(
                 f"checkpoint leaf {name} has shape {tuple(arr.shape)}, "
                 f"expected {shape} — refusing a silently-cropped restore"
             )
@@ -260,12 +265,12 @@ def _read_region(
     seen = np.zeros(rshape, dtype=np.bool_)
     for starts, fshape, path in entry["shards"]:
         if len(starts) != len(fshape) or len(fshape) != len(shape):
-            raise ValueError(
+            raise CorruptCheckpointError(
                 f"malformed shard filename {path} for shape {shape}"
             )
         for fs, fdim, dim in zip(starts, fshape, shape):
             if fs + fdim > dim:
-                raise ValueError(
+                raise CorruptCheckpointError(
                     f"shard {path} extends to {fs + fdim} past the leaf "
                     f"extent {dim} for {name} — checkpoint shape drift"
                 )
@@ -286,7 +291,7 @@ def _read_region(
         _bytes_materialized += chunk.nbytes
     covered = int(seen.sum())
     if covered < out.size:
-        raise ValueError(
+        raise CorruptCheckpointError(
             f"shards for {name} cover {covered} of {out.size} elements; "
             "checkpoint is incomplete"
         )

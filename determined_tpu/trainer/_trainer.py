@@ -335,6 +335,83 @@ class Trainer:
             return self._ckpt_writer.wait()
         return None
 
+    def _restore_with_fallback(self, storage_id: str) -> None:
+        """Restore `storage_id`; on CorruptCheckpointError (torn write,
+        checksum mismatch, incomplete shards) walk back to the newest
+        earlier checkpoint that verifies, rather than dying on state the
+        platform can route around. Off-cluster there is no checkpoint
+        registry — the corruption propagates.
+
+        On a multi-process gang this is a COLLECTIVE: the chief's
+        candidate list is broadcast (divergent per-rank listings under a
+        flaky master must not send ranks down different chains), and after
+        each attempt the ranks agree — all restored, or everyone moves to
+        the next candidate together. A rank must never train on state its
+        peers rejected."""
+        from determined_tpu.storage.base import CorruptCheckpointError
+
+        dist = self.core.distributed
+        gang = dist.size > 1
+        if gang:
+            candidates = dist.broadcast(
+                self.core.checkpoint.restore_candidates(storage_id)
+                if dist.is_chief else None
+            )
+        else:
+            candidates = self.core.checkpoint.restore_candidates(storage_id)
+        last_err: Optional[Exception] = None
+        for uuid_ in candidates:
+            my_err: Optional[Exception] = None
+            # Everything is caught here so a failing rank still reaches
+            # the gather below — an uncaught exception on one rank would
+            # strand its peers in the unbounded collective recv. Only
+            # corruption and storage-level failures are fallback-able;
+            # anything else aborts the WHOLE gang after the agreement
+            # round (no rank may train on state its peers rejected).
+            try:
+                self._restore_checkpoint(uuid_)
+                status = "ok"
+            except (CorruptCheckpointError, OSError) as e:
+                my_err, status = e, "fallback"
+            except Exception as e:  # noqa: BLE001 — re-raised post-gather
+                my_err, status = e, "fatal"
+            if gang:
+                statuses = dist.gather(status)
+                decision = dist.broadcast(
+                    (
+                        "fatal" if "fatal" in statuses
+                        else "ok" if all(s == "ok" for s in statuses)
+                        else "fallback"
+                    )
+                    if dist.is_chief else None
+                )
+            else:
+                decision = status
+            if decision == "ok":
+                if uuid_ != storage_id:
+                    logger.warning(
+                        "resumed from older verified checkpoint %s (newest "
+                        "%s was corrupt)", uuid_, storage_id,
+                    )
+                return
+            if decision == "fatal":
+                if my_err is not None and status == "fatal":
+                    raise my_err
+                raise RuntimeError(
+                    f"a peer rank failed restoring checkpoint {uuid_} with "
+                    "a non-recoverable error"
+                )
+            last_err = my_err or CorruptCheckpointError(
+                f"a peer rank failed verification of checkpoint {uuid_}"
+            )
+            logger.error(
+                "checkpoint %s failed verification (%s); %s", uuid_, last_err,
+                "trying the previous verified checkpoint"
+                if uuid_ != candidates[-1] else "no older checkpoint left",
+            )
+        assert last_err is not None
+        raise last_err
+
     def _restore_checkpoint(self, storage_id: str) -> None:
         self._ckpt_writer.wait()  # never read while a save is in flight
         state = self.state  # materialize to know structure + shardings
@@ -413,7 +490,7 @@ class Trainer:
         ):
             latest_checkpoint = self.core.info.trial.latest_checkpoint
         if latest_checkpoint:
-            self._restore_checkpoint(latest_checkpoint)
+            self._restore_with_fallback(latest_checkpoint)
 
         if self._step_fn is None:
             self._step_fn = self._build_step_fn()

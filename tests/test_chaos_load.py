@@ -1,9 +1,16 @@
 """Chaos e2e (the reference's adaptive_chaos.yaml story) + API load gate.
 
-Chaos: agent churn during an adaptive-ASHA search with restart budgets,
-kill while checkpoints are flying, kill during the rendezvous window of a
-multi-process gang (ref fixture: e2e_tests/tests/fixtures/no_op/
-adaptive_chaos.yaml — trials keep completing through failure).
+Chaos comes in two layers now:
+
+- PROCESS churn (TestChaos): agents killed and replaced mid-search — real
+  subprocess death, reattach, restart budgets. faults.py cannot model a
+  dying process, so the hand-rolled kill/replace churn stays.
+- NETWORK/IO churn (TestFaultPlanDrill): what the old tests hand-rolled
+  with flaky masters is now one `DTPU_FAULT_PLAN` env line
+  (common/faults.py) — deterministic, reproducible failure rates injected
+  into the API and storage paths of the in-process agents AND the real
+  trial subprocesses (they inherit the env), with torn-write coverage the
+  hand-rolled churn never had.
 
 Load: the reference gates API latency at p95 < 1s with < 1% errors
 (performance/src/api_performance_tests.ts:29-42); the same thresholds are
@@ -11,10 +18,12 @@ asserted here against a master serving a populated DB under concurrent
 clients.
 """
 import concurrent.futures
+import json
 import time
 
 import pytest
 
+from determined_tpu.common import faults
 from determined_tpu.devcluster import DevCluster
 
 ENTRY = "determined_tpu.exec.builtin_trials:SyntheticTrial"
@@ -119,6 +128,46 @@ class TestChaos:
             trial = dc.master.db.list_trials(exp_id)[0]
             assert trial["run_id"] >= 1  # infra requeue, budget untouched
             assert trial["steps_completed"] == 3
+
+
+class TestFaultPlanDrill:
+    def test_experiment_completes_under_api_and_storage_faults(
+        self, tmp_path, monkeypatch
+    ):
+        """One env line turns a devcluster run into a failure drill: ≥30%
+        injected failures on API posts and storage uploads (plus a torn
+        write and agent-poll flake) across master↔agent↔trial. The
+        resilience layer must carry a full train→checkpoint→restore-able
+        experiment to COMPLETED, and the committed checkpoint must verify."""
+        monkeypatch.setenv(faults.ENV_VAR, json.dumps({
+            "seed": 5,
+            "api.post": {"error_rate": 0.3, "max_failures": 40},
+            "storage.upload": {"error_rate": 0.3, "torn_writes": 1,
+                               "max_failures": 40},
+            "agent.poll": {"error_rate": 0.2, "max_failures": 10},
+        }))
+        faults.clear()  # in-process master/agents re-read the env plan
+        try:
+            with DevCluster(n_agents=1, slots_per_agent=1) as dc:
+                exp_id = dc.create_experiment(_config(tmp_path))
+                state = dc.wait_experiment(exp_id, timeout=600)
+                trials = dc.master.db.list_trials(exp_id)
+                logs = dc.master.db.get_task_logs(f"trial-{trials[0]['id']}")
+                assert state == "COMPLETED", [l["log"] for l in logs][-20:]
+                trial = trials[0]
+                assert trial["state"] == "COMPLETED"
+                # The run really checkpointed, and what it committed
+                # verifies cleanly against its manifest.
+                sid = trial["latest_checkpoint"]
+                assert sid
+                from determined_tpu.storage.base import verify_checkpoint_dir
+                from determined_tpu.storage.shared import SharedFSStorageManager
+
+                mgr = SharedFSStorageManager(str(tmp_path / "ckpt"))
+                with mgr.restore_path(sid) as path:
+                    assert verify_checkpoint_dir(path)
+        finally:
+            faults.clear()
 
 
 class TestDbIngestScale:

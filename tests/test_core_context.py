@@ -66,7 +66,9 @@ def test_sharded_checkpoint_collective(tmp_path):
     assert len(set(sids)) == 1
     storage = SharedFSStorageManager(storage_root)
     files = storage.list_files(sids[0])
-    assert sorted(files) == ["metadata.json"] + [f"shard-{r}.bin" for r in range(4)]
+    assert sorted(files) == ["manifest.json", "metadata.json"] + [
+        f"shard-{r}.bin" for r in range(4)
+    ]
 
 
 def test_merge_metadata_conflict():

@@ -1,0 +1,396 @@
+"""The reproducible failure matrix: checkpoint save→restore cycles under
+injected storage/API faults (DTPU_FAULT_PLAN), torn-write crash safety, and
+fallback to the last verified checkpoint.
+
+Acceptance shape (ISSUE 1): with ≥30% failure rate plus a torn write on
+`storage.upload`/`api.post`, a full checkpoint→restore cycle completes via
+retries; a deliberately truncated checkpoint raises CorruptCheckpointError
+and the trainer falls back to the last verified checkpoint.
+"""
+import json
+import os
+
+import pytest
+
+from determined_tpu.common import faults
+from determined_tpu.common.faults import FaultPlan, FaultSpec, InjectedFault
+from determined_tpu.common.resilience import RetryPolicy
+from determined_tpu.storage.base import (
+    MANIFEST_FILE,
+    CorruptCheckpointError,
+    verify_checkpoint_dir,
+)
+from determined_tpu.storage.shared import SharedFSStorageManager
+
+#: Fast retries for fault drills: plenty of attempts, microscopic sleeps.
+FAST_RETRY = RetryPolicy(max_attempts=10, base_delay=0.002, max_delay=0.01,
+                         jitter=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _write_tree(root, files):
+    for rel, content in files.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(content)
+
+
+CKPT_FILES = {
+    "w0.npy": b"A" * 256,
+    "w1.npy": b"B" * 1024,
+    "nested/opt.bin": b"C" * 64,
+    "metadata.json": b'{"steps_completed": 3}',
+}
+
+
+class TestStorageFaultMatrix:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    @pytest.mark.parametrize("rate", [0.3, 0.5])
+    def test_roundtrip_survives_error_rate_and_torn_write(
+        self, tmp_path, seed, rate
+    ):
+        """≥30% injected failures + one torn write on storage.upload, 30%
+        on storage.download: the cycle must complete byte-exact via
+        retries, and the committed checkpoint must verify."""
+        plan = FaultPlan({
+            "storage.upload": FaultSpec(error_rate=rate, torn_writes=1,
+                                        torn_fraction=0.5),
+            "storage.download": FaultSpec(error_rate=0.3),
+        }, seed=seed)
+        mgr = SharedFSStorageManager(str(tmp_path / "store"),
+                                     retry_policy=FAST_RETRY)
+        src = tmp_path / "src"
+        _write_tree(str(src), CKPT_FILES)
+        with faults.plan_active(plan):
+            mgr.upload(str(src), "ck")
+            dst = tmp_path / "dst"
+            mgr.download("ck", str(dst))
+        stats = plan.stats()
+        assert stats["storage.upload"]["torn"] == 1
+        # All 5 files (incl. manifest) made it through injection; the torn
+        # attempt itself tears before the injection draw, so `calls` counts
+        # the non-torn attempts only.
+        assert stats["storage.upload"]["calls"] >= 5
+        for rel, content in CKPT_FILES.items():
+            assert (dst / rel).read_bytes() == content
+        assert (dst / MANIFEST_FILE).exists()
+
+    @pytest.mark.parametrize("latency_s", [0.01])
+    def test_latency_injection_slows_but_completes(self, tmp_path, latency_s):
+        import time as _time
+
+        plan = FaultPlan({"storage.upload": FaultSpec(latency_s=latency_s)})
+        mgr = SharedFSStorageManager(str(tmp_path / "store"),
+                                     retry_policy=FAST_RETRY)
+        src = tmp_path / "src"
+        _write_tree(str(src), {"a.bin": b"x"})
+        with faults.plan_active(plan):
+            t0 = _time.monotonic()
+            mgr.upload(str(src), "ck")
+            elapsed = _time.monotonic() - t0
+        assert elapsed >= 2 * latency_s  # data file + manifest, both delayed
+
+    def test_crash_mid_upload_never_commits(self, tmp_path):
+        """An upload that dies (fault budget outlasts the retry budget)
+        must leave NO manifest — the checkpoint stays uncommitted and the
+        master never hears of it (manifest-last commit point)."""
+        plan = FaultPlan({
+            # Fail every upload attempt of the 2nd file onward: the first
+            # file lands, then the process "crashes".
+            "storage.upload": FaultSpec(failures=10_000),
+        })
+        mgr = SharedFSStorageManager(
+            str(tmp_path / "store"),
+            retry_policy=RetryPolicy(max_attempts=3, base_delay=0.001,
+                                     jitter=0.0),
+        )
+        src = tmp_path / "src"
+        _write_tree(str(src), CKPT_FILES)
+        with faults.plan_active(plan), pytest.raises(InjectedFault):
+            mgr.upload(str(src), "ck")
+        assert MANIFEST_FILE not in mgr.list_files("ck")
+
+    def test_torn_checkpoint_is_never_restored(self, tmp_path):
+        """Deliberate truncation of a committed file → every read path
+        refuses with CorruptCheckpointError."""
+        mgr = SharedFSStorageManager(str(tmp_path / "store"),
+                                     retry_policy=FAST_RETRY)
+        src = tmp_path / "src"
+        _write_tree(str(src), CKPT_FILES)
+        mgr.upload(str(src), "ck")
+        # tear w1.npy in place (post-commit corruption)
+        torn = tmp_path / "store" / "ck" / "w1.npy"
+        torn.write_bytes(torn.read_bytes()[:100])
+
+        with pytest.raises(CorruptCheckpointError, match="torn write"):
+            mgr.download("ck", str(tmp_path / "dst"))
+        with pytest.raises(CorruptCheckpointError):
+            with mgr.restore_path("ck"):
+                pass
+        with pytest.raises(CorruptCheckpointError):
+            verify_checkpoint_dir(str(tmp_path / "store" / "ck"))
+
+    def test_content_tamper_same_size_detected(self, tmp_path):
+        mgr = SharedFSStorageManager(str(tmp_path / "store"),
+                                     retry_policy=FAST_RETRY)
+        src = tmp_path / "src"
+        _write_tree(str(src), {"w.bin": b"Y" * 128})
+        mgr.upload(str(src), "ck")
+        (tmp_path / "store" / "ck" / "w.bin").write_bytes(b"Z" * 128)
+        with pytest.raises(CorruptCheckpointError, match="sha256"):
+            mgr.download("ck", str(tmp_path / "dst"))
+
+    def test_manifest_listed_file_missing_detected(self, tmp_path):
+        mgr = SharedFSStorageManager(str(tmp_path / "store"),
+                                     retry_policy=FAST_RETRY)
+        src = tmp_path / "src"
+        _write_tree(str(src), CKPT_FILES)
+        mgr.upload(str(src), "ck")
+        os.remove(tmp_path / "store" / "ck" / "w0.npy")
+        with pytest.raises(CorruptCheckpointError, match="missing"):
+            mgr.download("ck", str(tmp_path / "dst"))
+
+    def test_partial_delete_prunes_manifest(self, tmp_path):
+        """A deliberate partial delete (checkpoint GC keeping metadata,
+        dropping shards) must prune the manifest, not leave stale entries
+        that make every later restore refuse the checkpoint."""
+        mgr = SharedFSStorageManager(str(tmp_path / "store"),
+                                     retry_policy=FAST_RETRY)
+        src = tmp_path / "src"
+        _write_tree(str(src), CKPT_FILES)
+        mgr.upload(str(src), "ck")
+        mgr.delete("ck", paths=["w1.npy", "nested/opt.bin"])
+        dst = tmp_path / "dst"
+        mgr.download("ck", str(dst))  # must NOT raise 'missing' corruption
+        assert (dst / "w0.npy").exists()
+        assert not (dst / "w1.npy").exists()
+        with mgr.restore_path("ck") as p:
+            assert verify_checkpoint_dir(p)
+
+    def test_missing_file_raises_without_retry_burn(self, tmp_path):
+        """A manifest-listed file that is GONE (not torn) is deterministic:
+        FileNotFoundError must not burn the retry budget before surfacing
+        as corruption."""
+        import time as _time
+
+        mgr = SharedFSStorageManager(
+            str(tmp_path / "store"),
+            retry_policy=RetryPolicy(max_attempts=8, base_delay=0.3,
+                                     jitter=0.0),
+        )
+        src = tmp_path / "src"
+        _write_tree(str(src), CKPT_FILES)
+        mgr.upload(str(src), "ck")
+        os.remove(tmp_path / "store" / "ck" / "w0.npy")
+        t0 = _time.monotonic()
+        with pytest.raises(CorruptCheckpointError):
+            mgr.download("ck", str(tmp_path / "dst"))
+        # 8 attempts at 0.3s base would be >2s of sleeping; immediate
+        # propagation stays well under.
+        assert _time.monotonic() - t0 < 1.0
+
+    def test_legacy_checkpoint_without_manifest_still_loads(self, tmp_path):
+        """Pre-manifest checkpoints (and hand-built test dirs) load
+        unverified with a warning — no flag day."""
+        root = tmp_path / "store" / "ck"
+        _write_tree(str(root), {"w.bin": b"legacy"})
+        mgr = SharedFSStorageManager(str(tmp_path / "store"),
+                                     retry_policy=FAST_RETRY)
+        dst = tmp_path / "dst"
+        mgr.download("ck", str(dst))
+        assert (dst / "w.bin").read_bytes() == b"legacy"
+        with mgr.restore_path("ck") as p:
+            assert os.path.exists(os.path.join(p, "w.bin"))
+
+
+def _live_master():
+    from determined_tpu.master.api_server import ApiServer
+    from determined_tpu.master.core import Master
+
+    master = Master()
+    api = ApiServer(master)
+    api.start()
+    return master, api
+
+
+class TestCheckpointContextUnderFaults:
+    def test_env_plan_full_cycle_with_api_and_storage_faults(
+        self, tmp_path, monkeypatch
+    ):
+        """The acceptance drill, through the env-var path the CI matrix
+        uses: ≥30% failures + one torn write on storage.upload, ≥30%
+        failures on api.post — upload (including the master report) and a
+        verified restore both complete."""
+        from determined_tpu import core
+        from determined_tpu.common.api_session import Session
+
+        master, api = _live_master()
+        try:
+            eid = master.db.add_experiment({"searcher": {"name": "single"}})
+            tid = master.db.add_trial(eid, 0, {})
+            monkeypatch.setenv(faults.ENV_VAR, json.dumps({
+                "seed": 11,
+                "storage.upload": {"error_rate": 0.3, "torn_writes": 1},
+                "api.post": {"error_rate": 0.3, "max_failures": 6},
+            }))
+            faults.clear()  # drop any programmatic plan; re-read the env
+
+            dist = core.DummyDistributedContext()
+            storage = SharedFSStorageManager(str(tmp_path / "store"),
+                                             retry_policy=FAST_RETRY)
+            session = Session(api.url, retry_policy=RetryPolicy(
+                max_attempts=10, base_delay=0.002, max_delay=0.01, jitter=0.0,
+            ))
+            ctx = core.CheckpointContext(
+                dist, storage, session=session, task_id=f"trial-{tid}",
+                allocation_id="a.1", trial_id=tid,
+            )
+            src = tmp_path / "src"
+            _write_tree(str(src), {"w0.npy": b"W" * 512})
+            sid = ctx.upload(str(src), metadata={"steps_completed": 3})
+
+            plan = faults.active()
+            assert plan is not None
+            assert plan.stats()["storage.upload"]["torn"] == 1
+
+            # Committed + reported: the master knows it, the files verify.
+            assert master.db.get_checkpoint(sid)["state"] == "COMPLETED"
+            assert master.db.get_trial(tid)["latest_checkpoint"] == sid
+            with ctx.restore_path(sid) as p:
+                assert open(os.path.join(p, "w0.npy"), "rb").read() == b"W" * 512
+                md = json.load(open(os.path.join(p, "metadata.json")))
+                assert md == {"steps_completed": 3}
+        finally:
+            faults.clear()
+            api.stop()
+            master.shutdown()
+
+    def test_restore_candidates_orders_newest_first(self, tmp_path):
+        from determined_tpu import core
+        from determined_tpu.common.api_session import Session
+
+        master, api = _live_master()
+        try:
+            eid = master.db.add_experiment({"searcher": {"name": "single"}})
+            tid = master.db.add_trial(eid, 0, {})
+            dist = core.DummyDistributedContext()
+            storage = SharedFSStorageManager(str(tmp_path / "store"),
+                                             retry_policy=FAST_RETRY)
+            session = Session(api.url)
+            ctx = core.CheckpointContext(
+                dist, storage, session=session, task_id=f"trial-{tid}",
+                allocation_id="a.1", trial_id=tid,
+            )
+            src = tmp_path / "src"
+            _write_tree(str(src), {"w.bin": b"v1"})
+            sid1 = ctx.upload(str(src), metadata={"steps_completed": 1})
+            _write_tree(str(src), {"w.bin": b"v2"})
+            sid2 = ctx.upload(str(src), metadata={"steps_completed": 2})
+
+            cands = ctx.restore_candidates(sid2)
+            assert cands[0] == sid2
+            assert sid1 in cands
+            # Off-cluster (no session): nothing to fall back to.
+            dummy = core.DummyCheckpointContext(dist, storage)
+            assert dummy.restore_candidates(sid2) == [sid2]
+        finally:
+            api.stop()
+            master.shutdown()
+
+
+class TestTrainerFallback:
+    def test_corrupt_latest_falls_back_to_last_verified(self, tmp_path):
+        """Trainer-level: newest checkpoint torn → restore falls back to
+        the previous verified checkpoint and training continues from its
+        step, rather than dying (or silently loading torn state)."""
+        import optax
+
+        from determined_tpu import core
+        from determined_tpu.common.api_session import Session
+        from determined_tpu.models import MnistMLP
+        from determined_tpu.models.vision import MLPConfig
+        from determined_tpu.trainer import Batch, JAXTrial, Trainer
+
+        import numpy as np
+
+        class _TinyTrial(JAXTrial):
+            def build_model(self, mesh):
+                return MnistMLP(
+                    MLPConfig(in_dim=4, hidden=8, n_classes=2), mesh=mesh
+                )
+
+            def build_optimizer(self):
+                return optax.sgd(1e-2)
+
+            def _stream(self):
+                rng = np.random.default_rng(0)
+                while True:
+                    x = rng.normal(size=(8, 4)).astype(np.float32)
+                    yield {"image": x,
+                           "label": (x.sum(-1) > 0).astype(np.int32)}
+
+            def build_training_data(self):
+                return self._stream()
+
+            def build_validation_data(self):
+                import itertools
+
+                return list(itertools.islice(self._stream(), 2))
+
+        master, api = _live_master()
+        try:
+            eid = master.db.add_experiment({"searcher": {"name": "single"}})
+            tid = master.db.add_trial(eid, 0, {})
+            dist = core.DummyDistributedContext()
+            storage = SharedFSStorageManager(str(tmp_path / "store"))
+            session = Session(api.url)
+            ckpt_ctx = core.CheckpointContext(
+                dist, storage, session=session, task_id=f"trial-{tid}",
+                allocation_id="a.1", trial_id=tid,
+            )
+            ctx = core.Context(
+                distributed=dist,
+                train=core.DummyTrainContext(),
+                checkpoint=ckpt_ctx,
+                preempt=core.DummyPreemptContext(dist),
+                searcher=core.DummySearcherContext(dist, length=1),
+            )
+            t1 = Trainer(_TinyTrial(), ctx, seed=3)
+            t1.fit(max_length=Batch(2))
+            sid1 = t1._save_checkpoint(sync=True)
+            t2 = Trainer(_TinyTrial(), ctx, seed=3)
+            t2.fit(max_length=Batch(4), latest_checkpoint=sid1)
+            sid2 = t2._save_checkpoint(sync=True)
+            assert sid1 != sid2
+
+            # Tear the newest checkpoint's weights post-commit.
+            ck2 = tmp_path / "store" / sid2
+            npys = [f for f in os.listdir(ck2) if f.endswith(".npy")]
+            victim = ck2 / sorted(npys)[0]
+            victim.write_bytes(victim.read_bytes()[:32])
+
+            t3 = Trainer(_TinyTrial(), ctx, seed=3)
+            t3.fit(max_length=Batch(6), latest_checkpoint=sid2)
+            # Fell back to sid1 (step 2) and trained 4 more — NOT resumed
+            # from the torn sid2.
+            assert t3.steps_completed == 6
+
+            # With no fallback left, corruption is a hard, typed error.
+            ck1 = tmp_path / "store" / sid1
+            for f in os.listdir(ck1):
+                if f.endswith(".npy"):
+                    p = ck1 / f
+                    p.write_bytes(p.read_bytes()[:16])
+            t4 = Trainer(_TinyTrial(), ctx, seed=3)
+            with pytest.raises(CorruptCheckpointError):
+                t4.fit(max_length=Batch(8), latest_checkpoint=sid2)
+        finally:
+            api.stop()
+            master.shutdown()

@@ -1,0 +1,130 @@
+"""Lint gate: no ad-hoc sleep-retry loops outside common/resilience.py.
+
+The unified resilience layer (common/resilience.py) owns backoff. A "bare
+retry loop" — a loop that catches an exception and then `time.sleep(<literal
+constant>)`s before looping — reintroduces exactly the fixed-interval,
+jitterless retries this repo migrated away from (agent/agent.py's old
+`time.sleep(2)`, api_session's hand-rolled backoff), so this test fails the
+build on any new one.
+
+What counts as a violation: inside any `for`/`while` body, an `except`
+handler (or `else` of a try whose purpose is retry) containing a call to
+`time.sleep`/`sleep` whose argument is a NUMERIC LITERAL. Policy-driven
+delays (`time.sleep(backoff.next_delay())`, `self._stop.wait(delay)`) pass
+by construction. A deliberate exception can carry a trailing
+`# resilience-ok: <reason>` comment on the sleep line.
+"""
+import ast
+import os
+
+import pytest
+
+PKG_ROOT = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                        "determined_tpu")
+
+#: The one module allowed to sleep inside retry machinery.
+ALLOWED = {os.path.join("common", "resilience.py")}
+
+WAIVER = "# resilience-ok:"
+
+
+def _is_constant_sleep(call: ast.Call) -> bool:
+    fn = call.func
+    named_sleep = (
+        (isinstance(fn, ast.Attribute) and fn.attr == "sleep")
+        or (isinstance(fn, ast.Name) and fn.id == "sleep")
+    )
+    if not named_sleep or not call.args:
+        return False
+    return isinstance(call.args[0], ast.Constant)
+
+
+def _sleeps_in(node):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and _is_constant_sleep(sub):
+            yield sub
+
+
+def _violations_in_file(path: str):
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=path)
+    out = []
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+            continue
+        for sub in ast.walk(loop):
+            if not isinstance(sub, ast.Try):
+                continue
+            for handler in sub.handlers:
+                for call in _sleeps_in(handler):
+                    line = lines[call.lineno - 1]
+                    if WAIVER in line:
+                        continue
+                    out.append(f"{path}:{call.lineno}: {line.strip()}")
+    return out
+
+
+def _py_files():
+    for dirpath, _, filenames in os.walk(PKG_ROOT):
+        for name in filenames:
+            if not name.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, PKG_ROOT)
+            if rel in ALLOWED:
+                continue
+            yield full
+
+
+def test_no_bare_sleep_retry_loops():
+    violations = []
+    for path in _py_files():
+        violations.extend(_violations_in_file(path))
+    assert not violations, (
+        "bare time.sleep(<constant>) retry loops found — use "
+        "common/resilience.py (RetryPolicy.call or .backoff()) instead, or "
+        f"annotate a deliberate exception with '{WAIVER} <reason>':\n"
+        + "\n".join(violations)
+    )
+
+
+def test_lint_actually_detects_a_violation(tmp_path):
+    """The linter itself must not rot: a textbook bare retry loop is
+    flagged, a policy-driven one is not."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\n"
+        "def f(op):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return op()\n"
+        "        except Exception:\n"
+        "            time.sleep(2)\n"
+    )
+    assert len(_violations_in_file(str(bad))) == 1
+
+    good = tmp_path / "good.py"
+    good.write_text(
+        "import time\n"
+        "def f(op, backoff):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return op()\n"
+        "        except Exception:\n"
+        "            time.sleep(backoff.next_delay())\n"
+    )
+    assert _violations_in_file(str(good)) == []
+
+    waived = tmp_path / "waived.py"
+    waived.write_text(
+        "import time\n"
+        "def f(op):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return op()\n"
+        "        except Exception:\n"
+        "            time.sleep(2)  # resilience-ok: fixed cadence poll\n"
+    )
+    assert _violations_in_file(str(waived)) == []

@@ -1,0 +1,433 @@
+"""Unified resilience layer: RetryPolicy/CircuitBreaker timing (fake
+sleeps/clocks — no real sleeping in these units), FaultPlan determinism,
+and the Session wiring — post_bytes retries, per-endpoint breakers, and
+the X-Request-Id idempotency path end-to-end against a live master."""
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+import requests
+
+from determined_tpu.common import faults
+from determined_tpu.common.api_session import Session
+from determined_tpu.common.resilience import (
+    Backoff,
+    CircuitBreaker,
+    CircuitBreakerRegistry,
+    CircuitOpenError,
+    RetryPolicy,
+)
+from determined_tpu.common.faults import FaultPlan, FaultSpec, InjectedFault
+
+
+class _FakeClock:
+    def __init__(self) -> None:
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def sleep(self, s: float) -> None:
+        self.now += s
+
+
+class TestRetryPolicy:
+    def test_deterministic_jitter(self):
+        p = RetryPolicy(base_delay=1.0, multiplier=2.0, max_delay=100.0, jitter=0.5)
+        # Reproducible: same (key, attempt) -> same delay, every time.
+        assert p.delay(3, key="a") == p.delay(3, key="a")
+        # Decorrelated: different keys land on different points.
+        assert p.delay(3, key="a") != p.delay(3, key="b")
+        # Bounded: within [delay*(1-jitter), delay].
+        for attempt in range(5):
+            raw = min(1.0 * 2.0 ** attempt, 100.0)
+            d = p.delay(attempt, key="x")
+            assert raw * 0.5 <= d <= raw
+
+    def test_exponential_backoff_no_real_sleep(self):
+        clock = _FakeClock()
+        slept = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 4:
+                raise ConnectionError("down")
+            return "up"
+
+        p = RetryPolicy(max_attempts=5, base_delay=0.1, multiplier=2.0,
+                        max_delay=10.0, jitter=0.0)
+        t0 = time.monotonic()
+        out = p.call(flaky, sleep=slept.append, clock=clock)
+        assert out == "up"
+        assert calls["n"] == 4
+        assert slept == [0.1, 0.2, 0.4]
+        assert time.monotonic() - t0 < 0.5  # nothing actually slept
+
+    def test_attempt_cap_raises_last_error(self):
+        p = RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0)
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise TimeoutError("never")
+
+        with pytest.raises(TimeoutError):
+            p.call(always, sleep=lambda s: None)
+        assert calls["n"] == 3
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = {"n": 0}
+
+        def boom():
+            calls["n"] += 1
+            raise ValueError("logic bug")
+
+        p = RetryPolicy(max_attempts=5, base_delay=0.0)
+        with pytest.raises(ValueError):
+            p.call(boom, sleep=lambda s: None)
+        assert calls["n"] == 1
+
+    def test_deadline_cuts_retries(self):
+        clock = _FakeClock()
+        p = RetryPolicy(max_attempts=100, base_delay=1.0, multiplier=1.0,
+                        jitter=0.0, deadline_s=2.5)
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            p.call(always, sleep=clock.sleep, clock=clock)
+        # 1s + 1s slept, the third pause would cross 2.5s -> stop.
+        assert calls["n"] == 3
+
+    def test_retry_if_override(self):
+        p = RetryPolicy(max_attempts=3, base_delay=0.0)
+        calls = {"n": 0}
+
+        def boom():
+            calls["n"] += 1
+            raise ValueError("retry me anyway")
+
+        with pytest.raises(ValueError):
+            p.call(boom, retry_if=lambda e: isinstance(e, ValueError),
+                   sleep=lambda s: None)
+        assert calls["n"] == 3
+
+    def test_injected_fault_is_retryable_by_default(self):
+        p = RetryPolicy(max_attempts=2, base_delay=0.0)
+        seen = []
+
+        def once():
+            if not seen:
+                seen.append(1)
+                raise InjectedFault("storage.upload")
+            return "ok"
+
+        assert p.call(once, sleep=lambda s: None) == "ok"
+
+    def test_huge_streak_never_overflows(self):
+        """A never-give-up supervision loop hours into an outage: the
+        exponent blows past float range and must clamp, not crash the
+        agent (2.0**1024 raises OverflowError)."""
+        p = RetryPolicy(base_delay=0.5, multiplier=2.0, max_delay=10.0,
+                        jitter=0.0)
+        for attempt in (1023, 1024, 5000, 10**6):
+            assert p.delay(attempt) == 10.0
+
+    def test_backoff_streak_and_reset(self):
+        p = RetryPolicy(base_delay=0.5, multiplier=2.0, max_delay=4.0, jitter=0.0)
+        b = p.backoff()
+        assert isinstance(b, Backoff)
+        assert [b.next_delay() for _ in range(4)] == [0.5, 1.0, 2.0, 4.0]
+        assert b.next_delay() == 4.0  # capped, never gives up
+        b.reset()
+        assert b.next_delay() == 0.5
+
+
+class TestCircuitBreaker:
+    def test_open_after_threshold_and_half_open_probe(self):
+        clock = _FakeClock()
+        cb = CircuitBreaker("ep", failure_threshold=3, reset_timeout=5.0,
+                            clock=clock)
+        assert cb.state == "closed"
+        for _ in range(3):
+            assert cb.allow()
+            cb.record_failure()
+        assert cb.state == "open"
+        assert not cb.allow()
+        clock.now += 5.0
+        assert cb.state == "half-open"
+        assert cb.allow()        # the single probe
+        assert not cb.allow()    # concurrent calls held back
+        cb.record_success()
+        assert cb.state == "closed"
+
+    def test_half_open_failure_reopens(self):
+        clock = _FakeClock()
+        cb = CircuitBreaker("ep", failure_threshold=1, reset_timeout=2.0,
+                            clock=clock)
+        cb.record_failure()
+        assert cb.state == "open"
+        clock.now += 2.0
+        assert cb.allow()
+        cb.record_failure()
+        assert cb.state == "open"
+        assert not cb.allow()          # fresh open window
+        clock.now += 2.0
+        assert cb.allow()              # next probe window
+
+    def test_success_resets_consecutive_count(self):
+        cb = CircuitBreaker("ep", failure_threshold=2)
+        cb.record_failure()
+        cb.record_success()
+        cb.record_failure()
+        assert cb.state == "closed"  # never 2 consecutive
+
+    def test_call_raises_circuit_open(self):
+        clock = _FakeClock()
+        cb = CircuitBreaker("ep", failure_threshold=1, reset_timeout=9.0,
+                            clock=clock)
+
+        def boom():
+            raise ConnectionError("x")
+
+        with pytest.raises(ConnectionError):
+            cb.call(boom)
+        with pytest.raises(CircuitOpenError):
+            cb.call(lambda: "never runs")
+
+    def test_registry_is_per_key(self):
+        reg = CircuitBreakerRegistry(failure_threshold=1)
+        reg.get("a").record_failure()
+        assert reg.get("a").state == "open"
+        assert reg.get("b").state == "closed"
+        assert reg.get("a") is reg.get("a")
+
+
+class TestFaultPlan:
+    def setup_method(self):
+        faults.clear()
+
+    def teardown_method(self):
+        faults.clear()
+
+    def test_failures_counter_deterministic(self):
+        plan = FaultPlan({"api.post": FaultSpec(failures=2)})
+        with faults.plan_active(plan):
+            for _ in range(2):
+                with pytest.raises(InjectedFault):
+                    faults.inject("api.post")
+            faults.inject("api.post")  # healed
+        assert plan.stats()["api.post"] == {"calls": 3, "injected": 2, "torn": 0}
+
+    def test_error_rate_reproducible_across_plans(self):
+        def run(seed):
+            plan = FaultPlan({"storage.upload": FaultSpec(error_rate=0.5)},
+                             seed=seed)
+            outcomes = []
+            with faults.plan_active(plan):
+                for _ in range(50):
+                    try:
+                        faults.inject("storage.upload")
+                        outcomes.append(0)
+                    except InjectedFault:
+                        outcomes.append(1)
+            return outcomes
+
+        assert run(7) == run(7)          # same seed: identical failure tape
+        assert run(7) != run(8)          # different seed: different tape
+        assert 10 < sum(run(7)) < 40     # rate is actually ~0.5
+
+    def test_glob_site_matching(self):
+        plan = FaultPlan({"storage.*": FaultSpec(failures=1)})
+        with faults.plan_active(plan):
+            with pytest.raises(InjectedFault):
+                faults.inject("storage.download")
+            faults.inject("api.post")  # unmatched: clean
+
+    def test_env_plan_parsing(self, monkeypatch):
+        monkeypatch.setenv(
+            faults.ENV_VAR,
+            json.dumps({"seed": 3, "api.post": {"error_rate": 1.0,
+                                                "max_failures": 1}}),
+        )
+        faults.clear()  # force env re-read
+        with pytest.raises(InjectedFault):
+            faults.inject("api.post")
+        faults.inject("api.post")  # max_failures budget spent
+        faults.clear()
+
+    def test_bad_env_plan_raises(self, monkeypatch):
+        monkeypatch.setenv(faults.ENV_VAR, "{not json")
+        faults.clear()
+        with pytest.raises(ValueError, match="DTPU_FAULT_PLAN"):
+            faults.inject("api.post")
+        faults.clear()
+
+    def test_unknown_spec_key_rejected(self):
+        with pytest.raises(ValueError, match="unknown FaultSpec"):
+            FaultPlan.from_json(json.dumps({"api.post": {"rate": 0.5}}))
+
+    def test_torn_budget(self):
+        plan = FaultPlan({"storage.upload": FaultSpec(torn_writes=1,
+                                                      torn_fraction=0.25)})
+        with faults.plan_active(plan):
+            assert faults.torn_write("storage.upload") == 0.25
+            assert faults.torn_write("storage.upload") is None
+
+
+class _FlakyHandler(BaseHTTPRequestHandler):
+    """Fails the first `fail_first` requests with 503, then answers 200;
+    records every request's method/path/headers for assertions."""
+
+    requests_seen = []
+    fail_first = 0
+
+    def _handle(self):
+        cls = type(self)
+        n = len(cls.requests_seen)
+        body_len = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(body_len) if body_len else b""
+        cls.requests_seen.append({
+            "method": self.command,
+            "path": self.path,
+            "request_id": self.headers.get("X-Request-Id"),
+            "body": body,
+        })
+        status = 503 if n < cls.fail_first else 200
+        payload = json.dumps({"ok": True, "n": n}).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    do_GET = do_POST = do_PATCH = do_DELETE = _handle
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def flaky_server():
+    class Handler(_FlakyHandler):
+        requests_seen = []
+        fail_first = 0
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_address[1]}", Handler
+    finally:
+        srv.shutdown()
+
+
+def _fast_session(url, attempts=6):
+    return Session(url, retry_policy=RetryPolicy(
+        max_attempts=attempts, base_delay=0.01, max_delay=0.05, jitter=0.0,
+    ))
+
+
+class TestSessionResilience:
+    def test_post_bytes_retries_through_master_blip(self, flaky_server):
+        """The checkpoint-shard upload path survives 503s (it used to
+        bypass every retry)."""
+        url, handler = flaky_server
+        handler.fail_first = 2
+        out = _fast_session(url).post_bytes("/api/v1/files", b"shard-bytes")
+        assert out["ok"] is True
+        assert len(handler.requests_seen) == 3
+        assert all(r["body"] == b"shard-bytes" for r in handler.requests_seen)
+
+    def test_request_id_stable_across_retries(self, flaky_server):
+        url, handler = flaky_server
+        handler.fail_first = 2
+        _fast_session(url).post("/api/v1/things", json_body={"a": 1})
+        ids = [r["request_id"] for r in handler.requests_seen]
+        assert len(ids) == 3
+        assert ids[0] and len(set(ids)) == 1  # one id, reused verbatim
+
+    def test_distinct_logical_posts_get_distinct_ids(self, flaky_server):
+        url, handler = flaky_server
+        s = _fast_session(url)
+        s.post("/api/v1/things", json_body={})
+        s.post("/api/v1/things", json_body={})
+        ids = {r["request_id"] for r in handler.requests_seen}
+        assert len(ids) == 2
+
+    def test_get_carries_no_request_id(self, flaky_server):
+        url, handler = flaky_server
+        _fast_session(url).get("/api/v1/things")
+        assert handler.requests_seen[0]["request_id"] is None
+
+    def test_circuit_opens_after_consecutive_failures(self):
+        # Nothing listens on this port: every attempt is a fast connect
+        # refusal. Breaker threshold is 8 consecutive — the third call
+        # must fail FAST with CircuitOpenError, not burn more connects.
+        s = _fast_session("http://127.0.0.1:9", attempts=4)
+        for _ in range(2):
+            with pytest.raises(requests.ConnectionError):
+                s.get("/api/v1/x")
+        t0 = time.monotonic()
+        with pytest.raises(CircuitOpenError):
+            s.get("/api/v1/x")
+        assert time.monotonic() - t0 < 0.5
+
+    def test_breakers_are_per_endpoint(self):
+        s = _fast_session("http://127.0.0.1:9", attempts=8)
+        with pytest.raises(requests.ConnectionError):
+            s.get("/api/v1/a")  # 8 consecutive failures: /a's breaker opens
+        with pytest.raises(CircuitOpenError):
+            s.get("/api/v1/a")  # /a now fails fast
+        # A different endpoint still gets real attempts (ConnectionError,
+        # not CircuitOpenError).
+        with pytest.raises(requests.ConnectionError):
+            s.get("/api/v1/b")
+
+
+class TestMasterIdempotency:
+    def test_duplicate_request_id_replays_not_reapplies(self):
+        from determined_tpu.master.api_server import ApiServer
+        from determined_tpu.master.core import Master
+
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            url = f"{api.url}/api/v1/workspaces"
+            headers = {"X-Request-Id": "fixed-id-123"}
+            r1 = requests.post(url, json={"name": "ws-a"}, headers=headers,
+                               timeout=10)
+            r2 = requests.post(url, json={"name": "ws-a"}, headers=headers,
+                               timeout=10)
+            assert r1.status_code == r2.status_code == 200
+            assert r1.json() == r2.json()  # replayed, same id
+            names = [w["name"] for w in master.db.list_workspaces()]
+            assert names.count("ws-a") == 1  # applied exactly once
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_distinct_ids_apply_twice(self):
+        from determined_tpu.master.api_server import ApiServer
+        from determined_tpu.master.core import Master
+
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            url = f"{api.url}/api/v1/workspaces"
+            requests.post(url, json={"name": "ws-b1"},
+                          headers={"X-Request-Id": "id-1"}, timeout=10)
+            requests.post(url, json={"name": "ws-b2"},
+                          headers={"X-Request-Id": "id-2"}, timeout=10)
+            names = {w["name"] for w in master.db.list_workspaces()}
+            assert {"ws-b1", "ws-b2"} <= names
+        finally:
+            api.stop()
+            master.shutdown()

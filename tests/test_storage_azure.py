@@ -56,7 +56,8 @@ class TestAzureStorage:
         src = tmp_path / "src"
         _write_tree(str(src), {"a.npy": b"AAA", "sub/b.npy": b"BBB"})
         mgr.upload(str(src), "ck-1")
-        assert mgr.list_files("ck-1") == ["a.npy", "sub/b.npy"]
+        # Every committed checkpoint carries its integrity manifest.
+        assert mgr.list_files("ck-1") == ["a.npy", "manifest.json", "sub/b.npy"]
 
         dst = tmp_path / "dst"
         mgr.download("ck-1", str(dst))
@@ -71,25 +72,41 @@ class TestAzureStorage:
         with mgr.restore_path(
             "ck-2", selector=lambda p: p != "rank1.npy"
         ) as path:
-            assert sorted(os.listdir(path)) == ["metadata.json", "rank0.npy"]
+            assert sorted(os.listdir(path)) == [
+                "manifest.json", "metadata.json", "rank0.npy"
+            ]
 
     def test_partial_upload_paths(self, mgr, tmp_path):
         src = tmp_path / "src"
         _write_tree(str(src), {"x": b"x", "y": b"y"})
         mgr.upload(str(src), "ck-3", paths=["x"])
-        assert mgr.list_files("ck-3") == ["x"]
+        assert mgr.list_files("ck-3") == ["manifest.json", "x"]
 
     def test_delete(self, mgr, tmp_path):
         src = tmp_path / "src"
         _write_tree(str(src), {"x": b"x", "y": b"y"})
         mgr.upload(str(src), "ck-4")
         assert sorted(mgr.delete("ck-4", paths=["x"])) == ["x"]
-        assert mgr.list_files("ck-4") == ["y"]
-        assert sorted(mgr.delete("ck-4")) == ["y"]
+        assert mgr.list_files("ck-4") == ["manifest.json", "y"]
+        assert sorted(mgr.delete("ck-4")) == ["manifest.json", "y"]
 
     def test_missing_checkpoint_raises(self, mgr, tmp_path):
         with pytest.raises(FileNotFoundError):
             mgr.download("nope", str(tmp_path))
+
+    def test_corrupt_blob_refuses_restore(self, mgr, tmp_path):
+        """A committed checkpoint whose blob is later truncated must raise
+        CorruptCheckpointError at download — the base layer's manifest
+        verification runs through every backend, fakes included."""
+        from determined_tpu.storage.base import CorruptCheckpointError
+
+        src = tmp_path / "src"
+        _write_tree(str(src), {"w.bin": b"weights-weights"})
+        mgr.upload(str(src), "ck-5")
+        key = mgr._key("ck-5", "w.bin")
+        mgr._container.blobs[key] = mgr._container.blobs[key][:4]  # torn
+        with pytest.raises(CorruptCheckpointError, match="torn write"):
+            mgr.download("ck-5", str(tmp_path / "out"))
 
     def test_prefix_isolation(self, tmp_path):
         client = _FakeContainerClient()

@@ -35,9 +35,12 @@ class TestTensorboardTask:
                 "/api/v1/commands",
                 json_body={"config": {
                     "task_type": "TENSORBOARD",
+                    # --builtin: the data.json/scalar-page contract below
+                    # is the zero-dep viewer's; a real tensorboard binary
+                    # on the image would serve its own app instead.
                     "entrypoint": (
                         "python -m determined_tpu.exec.tensorboard "
-                        f"--tasks trial-{trial_id}"
+                        f"--builtin --tasks trial-{trial_id}"
                     ),
                     "resources": {"slots": 0},
                     "checkpoint_storage": {"type": "shared_fs",
