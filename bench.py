@@ -26,6 +26,7 @@ model math; skip with DTPU_BENCH_SKIP_ASHA=1.
 """
 from __future__ import annotations
 
+import dataclasses
 import functools
 import json
 import os
@@ -177,17 +178,30 @@ def _measure_mfu(config, batch_size: int, inner: int, rounds: int, dev,
     return mfu, tokens_per_sec
 
 
-def long_ctx_mfu_at(dev, seq_len: int, inner: int, rounds: int):
+def long_ctx_mfu_at(dev, seq_len: int, inner: int, rounds: int,
+                    autotune: bool = False):
     """One long-context measurement (remat + chunked CE at GPT-2-small
     shapes); layer_loop='auto' picks unroll ≤16k and scan+rematted
-    attention beyond. Returns MFU or None (with a traceback — a silent
-    None hides compile bugs)."""
+    attention beyond. With `autotune` the flash block sizes come from the
+    timed probe (ops/flash_autotune.py; disk-cached, so only the first
+    bench round on a box pays). Returns (mfu, tokens_per_sec,
+    (block_q, block_k)) or None (with a traceback — a silent None hides
+    compile bugs)."""
     try:
-        cfg = GPTConfig(seq_len=seq_len, remat=True, fused_loss=True)
-        mfu, _ = _measure_mfu(
+        cfg = GPTConfig(
+            seq_len=seq_len, remat=True, fused_loss=True,
+            flash_autotune=autotune,
+        )
+        model = GPT(cfg)
+        blocks = model._flash_blocks()  # resolve (and cache) pre-measurement
+        cfg = dataclasses.replace(
+            cfg, flash_block_q=blocks[0], flash_block_k=blocks[1],
+            flash_autotune=False,
+        )
+        mfu, toks = _measure_mfu(
             cfg, batch_size=1, inner=inner, rounds=rounds, dev=dev
         )
-        return mfu
+        return mfu, toks, blocks
     except Exception:  # noqa: BLE001
         import traceback
 
@@ -211,7 +225,8 @@ def long_ctx_mfu(dev, on_tpu: bool):
             # (46.4 vs ~49 at b1); an apparent scan_unroll gain in the r5
             # sweep was run-order variance (review caught it — at exactly
             # 16k the auto layer loop unrolls and the knob is dead).
-            return long_ctx_mfu_at(dev, 16384, inner=3, rounds=3), 16384
+            r = long_ctx_mfu_at(dev, 16384, inner=3, rounds=3, autotune=True)
+            return (r[0] if r else None), 16384
         cfg = GPTConfig(
             vocab_size=512, n_layers=1, n_heads=4, d_model=128,
             d_ff=512, seq_len=1024, remat=True, fused_loss=True,
@@ -331,6 +346,18 @@ def main() -> None:
     # more than dispatch), so this is the fast path, with best-of-rounds to
     # shave scheduler/tunnel noise (_measure_mfu).
     mfu, tokens_per_sec = _measure_mfu(config, batch_size, inner, rounds, dev)
+    # Kernel-shape provenance for the perf trajectory: the flash blocks the
+    # headline config actually runs (fitted to its sequence) and the
+    # fraction of forward-grid blocks the causal skip keeps live (1.0 =
+    # monolithic single-block path; see docs/perf.md).
+    from determined_tpu.ops.flash_attention import block_skip_stats, fit_block
+
+    hb_q = fit_block(config.seq_len, config.flash_block_q)
+    hb_k = fit_block(config.seq_len, config.flash_block_k)
+    live, total = block_skip_stats(
+        config.seq_len, config.seq_len, hb_q, hb_k, causal=True,
+        window=config.attn_window,
+    )
     record = {
         "metric": "gpt2_mfu",
         "value": round(100.0 * mfu, 2),
@@ -338,6 +365,9 @@ def main() -> None:
         "vs_baseline": round(mfu / 0.35, 3),
         # BASELINE.md row 2: one jax device == one chip here.
         "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "flash_block_q": hb_q,
+        "flash_block_k": hb_k,
+        "causal_skip_ratio": round(live / total, 4),
     }
     # Long-ctx runs BEFORE the NeoX rungs: those allocate ~12 GB of fp32
     # optimizer state, and the 16k program compiled into the fragmented
@@ -350,10 +380,24 @@ def main() -> None:
         if on_tpu:
             # Informational 32k point (the layer_loop="auto" scan +
             # rematted-attention regime): bounds how the single-chip
-            # story degrades past the unrolled-trunk boundary.
-            mfu32 = long_ctx_mfu_at(dev, 32768, inner=2, rounds=2)
-            if mfu32 is not None:
+            # story degrades past the unrolled-trunk boundary. Autotuned
+            # blocks + the blocked kernels' causal skip are the levers
+            # this rung measures; the chosen blocks and the live-block
+            # ratio ride the record so the trajectory explains itself.
+            r32 = long_ctx_mfu_at(dev, 32768, inner=2, rounds=2,
+                                  autotune=True)
+            if r32 is not None:
+                mfu32, toks32, (b32q, b32k) = r32
                 record["long_ctx_32k_mfu"] = round(100.0 * mfu32, 2)
+                record["long_ctx_32k_tokens_per_sec"] = round(toks32, 1)
+                record["long_ctx_32k_block_q"] = b32q
+                record["long_ctx_32k_block_k"] = b32k
+                live32, total32 = block_skip_stats(
+                    32768, 32768, b32q, b32k, causal=True
+                )
+                record["long_ctx_32k_skip_ratio"] = round(
+                    live32 / total32, 4
+                )
     if not os.environ.get("DTPU_BENCH_SKIP_NEOX"):
         neox_mfu, neox_layers = neox_class_mfu(dev, on_tpu)
         if neox_mfu is not None:

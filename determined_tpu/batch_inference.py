@@ -41,11 +41,77 @@ import abc
 import contextlib
 import logging
 import tempfile
-from typing import Any, Iterable, Iterator, Optional
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
 
 from determined_tpu import core as core_mod
 
 logger = logging.getLogger("determined_tpu.batch_inference")
+
+
+def pack_sequences(
+    docs: Iterable[Sequence[int]],
+    seq_len: int,
+    batch_size: int,
+    *,
+    pad_id: int = 0,
+    drop_remainder: bool = False,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Pack variable-length documents into fixed [B, S] batches for the
+    flash kernels' segment-id masking (models take the emitted
+    "segment_ids" straight through attention — see ops/flash_attention.py).
+
+    Greedy first-fit: each doc (truncated to seq_len) goes into the first
+    open row with room, rows close when full. Emitted batches carry
+
+    - "tokens"       int32 [B, S] — docs back to back, pad_id after;
+    - "segment_ids"  int32 [B, S] — 1, 2, ... per doc within a row, 0 on
+      padding (so pads attend only pads and score nothing);
+    - "loss_mask"    fp32 [B, S] — 1.0 on real tokens, 0.0 on padding.
+      GPT.loss additionally masks cross-document boundary predictions from
+      the segment ids, so a packed batch scores each doc independently.
+
+    A short final batch is padded with empty rows (all pad_id / segment 0)
+    unless drop_remainder.
+    """
+    if seq_len < 1 or batch_size < 1:
+        raise ValueError("seq_len and batch_size must be >= 1")
+
+    def emit(rows, segs) -> Dict[str, np.ndarray]:
+        tokens = np.full((batch_size, seq_len), pad_id, np.int32)
+        segment = np.zeros((batch_size, seq_len), np.int32)
+        mask = np.zeros((batch_size, seq_len), np.float32)
+        for r, (toks, ids) in enumerate(zip(rows, segs)):
+            tokens[r, : len(toks)] = toks
+            segment[r, : len(ids)] = ids
+            mask[r, : len(ids)] = 1.0
+        return {"tokens": tokens, "segment_ids": segment, "loss_mask": mask}
+
+    rows: List[List[int]] = []   # open token buffers, ≤ batch_size of them
+    segs: List[List[int]] = []   # per-row segment-id buffers
+    counts: List[int] = []       # docs packed per row (last id used)
+    for doc in docs:
+        toks = list(doc)[:seq_len]
+        if not toks:
+            continue
+        placed = False
+        for r in range(len(rows)):
+            if len(rows[r]) + len(toks) <= seq_len:
+                counts[r] += 1
+                segs[r].extend([counts[r]] * len(toks))
+                rows[r].extend(toks)
+                placed = True
+                break
+        if not placed:
+            if len(rows) == batch_size:
+                yield emit(rows, segs)
+                rows, segs, counts = [], [], []
+            rows.append(list(toks))
+            segs.append([1] * len(toks))
+            counts.append(1)
+    if rows and not drop_remainder:
+        yield emit(rows, segs)
 
 
 class InferenceContext:

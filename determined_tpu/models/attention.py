@@ -12,7 +12,10 @@ kernels); here the model calls one entry point and the layout decides:
 - otherwise (CPU tests, tiny shapes): plain einsum softmax attention, which
   XLA partitions on its own.
 
-All paths take/return [B, S, H, D] and are numerically exact (no windowing).
+All paths take/return [B, S, H, D] and are numerically exact. Masking
+(causal, sliding `window`, packed-sequence `segment_ids`) is one model
+shared by dense/flash/ring — see ops/flash_attention.py; ulysses re-gathers
+the full sequence per head subset and supports the causal mask only.
 """
 from __future__ import annotations
 
@@ -40,6 +43,8 @@ def attention(
     block_q: int = 512,
     block_k: int = 512,
     layout: str = "contiguous",
+    window: Optional[int] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Multi-head attention over [B, S, H, D] tensors.
 
@@ -47,10 +52,16 @@ def attention(
     mesh's context axis is sharded, flash on TPU, dense elsewhere.
     block_q/block_k: flash kernel tile sizes, fitted down to divisors of the
     sequence as needed. GPTConfig tunes these (1024/1024 measured best for
-    the GPT-2 bench on v5e); 512 is a neutral default for direct callers.
+    the GPT-2 bench on v5e, or the autotuner's probed winner with
+    flash_autotune on); 512 is a neutral default for direct callers.
     layout: "zigzag" = the sequence dim is ALREADY in zigzag device order
     (data/tokens.py native emission) — only the ring impl understands that
     placement, and it then runs gather-free.
+    window: sliding-window size (causal only) — the kernels skip blocks
+    (compute + DMA) outside the band, and the ring stops rotating K/V past
+    the window's reach.
+    segment_ids: [B, S] int ids for packed sequences; attention only
+    within equal ids.
     """
     if impl == "auto":
         if mesh is not None and mesh.shape.get("context", 1) > 1:
@@ -68,7 +79,9 @@ def attention(
         )
 
     if impl == "dense":
-        return reference_attention(q, k, v, causal=causal)
+        return reference_attention(
+            q, k, v, causal=causal, window=window, segment_ids=segment_ids
+        )
 
     if impl == "flash":
         # Fit the tuned block sizes to this sequence (block | seq is a hard
@@ -78,20 +91,30 @@ def attention(
         block_k = fit_block(k.shape[1], block_k)
         if mesh is None:
             out = flash_attention(
-                q, k, v, causal=causal, block_q=block_q, block_k=block_k
+                q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+                window=window, segment_ids=segment_ids,
             )
         else:
             spec = P(BATCH_AXES, None, "tensor", None)
+            seg_spec = P(BATCH_AXES, None)
 
-            def local(q_, k_, v_):
+            def local(q_, k_, v_, seg_=None):
                 return flash_attention(
-                    q_, k_, v_, causal=causal, block_q=block_q, block_k=block_k
+                    q_, k_, v_, causal=causal, block_q=block_q,
+                    block_k=block_k, window=window, segment_ids=seg_,
                 )
 
-            out = shard_map(
-                local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-                check_vma=False,
-            )(q, k, v)
+            if segment_ids is not None:
+                out = shard_map(
+                    local, mesh=mesh,
+                    in_specs=(spec, spec, spec, seg_spec), out_specs=spec,
+                    check_vma=False,
+                )(q, k, v, segment_ids)
+            else:
+                out = shard_map(
+                    local, mesh=mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec, check_vma=False,
+                )(q, k, v)
         # Remat boundary marker: "dots saveable" policies don't recognize a
         # pallas_call as a dot, so without this name the whole flash forward
         # re-runs inside the backward (models/gpt.py combines the dots
@@ -104,12 +127,14 @@ def attention(
         # Contiguous layout: make_ring_attention permutes in/out around the
         # balanced-causal kernel (a gather each way). Zigzag layout: the
         # data pipeline already emitted zigzag order (data/tokens.py
-        # zigzag_ring) and the kernel runs gather-free.
+        # zigzag_ring) and the kernel runs gather-free. Tuned blocks and
+        # window/segment args ride into every per-hop flash call.
         from determined_tpu.parallel.ring import make_ring_attention
 
         return make_ring_attention(
-            mesh, causal=causal, data_layout=layout
-        )(q, k, v)
+            mesh, causal=causal, data_layout=layout,
+            block_q=block_q, block_k=block_k, window=window,
+        )(q, k, v, segment_ids)
 
     if impl == "ulysses":
         # All-to-all head<->sequence swap: each device runs full-sequence
@@ -117,6 +142,11 @@ def attention(
         # (determined_tpu.parallel.ulysses). Heads stay sharded over tensor
         # like the other impls — omitting it would silently replicate
         # activations across the tensor axis.
+        if window is not None or segment_ids is not None:
+            raise ValueError(
+                "window/segment_ids are not supported with ulysses "
+                "attention; use ring (sharded context) or flash/dense"
+            )
         if mesh is None:
             raise ValueError("ulysses attention needs a mesh")
         ctx = mesh.shape.get("context", 1)

@@ -90,6 +90,18 @@ class GPTConfig:
     # the kernel clamps to the sequence when shorter.
     flash_block_q: int = 1024
     flash_block_k: int = 1024
+    # Replace the constants above with a measurement: probe a small
+    # candidate set (ops/flash_autotune.py) at this config's exact
+    # attention shapes ONCE at model-build time (outside jit; the winner is
+    # cached on disk per device kind / jax version / shape / mask mode).
+    # Off by default so the measured-best bench constants stay the bench
+    # constants; long-context recipes turn it on. Off-TPU this is a no-op.
+    flash_autotune: bool = False
+    # Sliding-window attention: position p attends (p − attn_window, p].
+    # None = full causal. The flash kernels skip out-of-band blocks
+    # (compute AND DMA) and ring attention stops rotating K/V past the
+    # window's reach — O(S·W) attention instead of O(S²).
+    attn_window: Optional[int] = None
     z_loss: float = 1e-4               # logit-norm regularizer (stability)
     # Chunked cross-entropy (ops/fused_cross_entropy.py): stream vocab
     # chunks through one unrolled scan instead of materializing [B, S, V]
@@ -189,6 +201,45 @@ class GPT(Model):
     def __init__(self, config: GPTConfig, mesh: Optional[Mesh] = None) -> None:
         self.config = config
         self.mesh = mesh
+        # (block_q, block_k): the config values, or the autotuner's probed
+        # winner (flash_autotune). Resolved EAGERLY here because the probe
+        # runs real device work, which must not happen mid-trace when the
+        # train step first calls into attention — model build
+        # (trial.build_model / bench setup) is always outside jit.
+        self._resolved_flash_blocks: Optional[Tuple[int, int]] = None
+        if config.flash_autotune:
+            self._flash_blocks()
+
+    def _flash_blocks(self) -> Tuple[int, int]:
+        if self._resolved_flash_blocks is None:
+            c = self.config
+            if c.flash_autotune:
+                from determined_tpu.ops.flash_autotune import (
+                    tune_flash_blocks,
+                )
+
+                ctx = tp = 1
+                if self.mesh is not None:
+                    ctx = self.mesh.shape.get("context", 1)
+                    tp = self.mesh.shape.get("tensor", 1)
+                # Probe the PER-DEVICE kernel shapes: a sharded context
+                # axis gives each hop the LOCAL chunk (or half-chunk),
+                # and a sharded tensor axis gives each device
+                # n_heads/tensor heads — timing the full-head grid would
+                # rank candidates on a 'tp'-times-larger problem than the
+                # kernel that actually runs.
+                s_local = max(c.seq_len // max(ctx, 1), 1)
+                h_local = max(c.n_heads // max(tp, 1), 1)
+                self._resolved_flash_blocks = tune_flash_blocks(
+                    s_q=s_local, n_heads=h_local, head_dim=c.head_dim,
+                    dtype=c.dtype, causal=True, window=c.attn_window,
+                    want_q=c.flash_block_q, want_k=c.flash_block_k,
+                )
+            else:
+                self._resolved_flash_blocks = (
+                    c.flash_block_q, c.flash_block_k
+                )
+        return self._resolved_flash_blocks
 
     # -- params ------------------------------------------------------------
     def init(self, rng: jax.Array) -> Dict[str, Any]:
@@ -334,18 +385,21 @@ class GPT(Model):
         return y.reshape(b, s, d), aux
 
     def _block(
-        self, x: jax.Array, blk: Dict[str, jax.Array], *, manual: bool = False
+        self, x: jax.Array, blk: Dict[str, jax.Array], *, manual: bool = False,
+        segment_ids: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, jax.Array]:
         """One transformer block → (x, moe_aux). `manual` = running inside a
         shard_map manual region (pipeline stage): no sharding constraints, no
         nested shard_map (dense attention)."""
-        x = self._attn_half(x, blk, manual=manual)
+        x = self._attn_half(x, blk, manual=manual, segment_ids=segment_ids)
         return self._mlp_half(x, blk, manual=manual)
 
     def _attn_half(
-        self, x: jax.Array, blk: Dict[str, jax.Array], *, manual: bool = False
+        self, x: jax.Array, blk: Dict[str, jax.Array], *, manual: bool = False,
+        segment_ids: Optional[jax.Array] = None,
     ) -> jax.Array:
         c = self.config
+        block_q, block_k = self._flash_blocks()
         act_spec = P(("data", "fsdp"), "context", None)
 
         h = _layernorm(x, blk["ln1_scale"], blk["ln1_bias"])
@@ -376,6 +430,14 @@ class GPT(Model):
                             "Ulysses re-gathers the full sequence and its "
                             "dense causal mask assumes contiguous order"
                         )
+                    if c.attn_window is not None:
+                        # Same guard the dispatcher enforces: ulysses has
+                        # no window support, and this manual path bypasses
+                        # the dispatcher.
+                        raise ValueError(
+                            "attn_window is not supported with ulysses "
+                            "attention"
+                        )
                     from determined_tpu.parallel.ulysses import (
                         ulysses_attention,
                     )
@@ -388,7 +450,8 @@ class GPT(Model):
 
                     o = ring_attention(
                         q, k, v, axis_name="context", causal=True,
-                        block_q=c.flash_block_q, block_k=c.flash_block_k,
+                        block_q=block_q, block_k=block_k,
+                        window=c.attn_window,
                         layout=(
                             "zigzag" if c.sequence_layout == "zigzag"
                             else "contiguous"
@@ -405,13 +468,15 @@ class GPT(Model):
                         "causal attention assumes contiguous order"
                     )
                 o = attn_mod.attention(
-                    q, k, v, mesh=None, causal=True, impl="dense"
+                    q, k, v, mesh=None, causal=True, impl="dense",
+                    window=c.attn_window,
                 )
         else:
             o = attn_mod.attention(
                 q, k, v, mesh=self.mesh, causal=True, impl=c.attn_impl,
-                block_q=c.flash_block_q, block_k=c.flash_block_k,
-                layout=c.sequence_layout,
+                block_q=block_q, block_k=block_k,
+                layout=c.sequence_layout, window=c.attn_window,
+                segment_ids=segment_ids,
             )
         o = jnp.einsum("bshk,hkd->bsd", o, blk["wo"].astype(c.dtype))
         o = o + blk["bo"].astype(c.dtype)
@@ -548,9 +613,15 @@ class GPT(Model):
         params: Dict[str, Any],
         tokens: jax.Array,
         positions: Optional[jax.Array] = None,
+        segment_ids: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, jax.Array]:
         """→ (logits [B, S, V], moe aux loss)."""
         c = self.config
+        if segment_ids is not None and c.pipeline_stages > 1:
+            raise ValueError(
+                "segment_ids (packed sequences) are not supported with "
+                "pipeline parallelism yet"
+            )
         if c.sequence_layout == "zigzag" and c.pipeline_stages > 1:
             # Zigzag rides the pipeline: embedding happens BEFORE the
             # pipeline shard_map (positions-aware), and the stages run ring
@@ -586,7 +657,7 @@ class GPT(Model):
                 )
             return self._apply_pipelined(params, tokens, positions)
 
-        hidden = self._forward_trunk(params, tokens, positions)
+        hidden = self._forward_trunk(params, tokens, positions, segment_ids)
         return self._head(params, hidden[0]), hidden[1]
 
     def _forward_trunk(
@@ -594,6 +665,7 @@ class GPT(Model):
         params: Dict[str, Any],
         tokens: jax.Array,
         positions: Optional[jax.Array] = None,
+        segment_ids: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, jax.Array]:
         """Embed + blocks → (pre-final-layernorm [B, S, D] compute dtype,
         moe_aux). Consumers apply lnf themselves: _head via _head_raw, the
@@ -617,7 +689,9 @@ class GPT(Model):
             c.layer_loop == "auto" and c.seq_len > 16384
         )
         if c.remat and not remat_attn:
-            attn_fn = functools.partial(self._attn_half, manual=False)
+            attn_fn = functools.partial(
+                self._attn_half, manual=False, segment_ids=segment_ids
+            )
             mlp_fn = jax.checkpoint(
                 functools.partial(self._mlp_half, manual=False),
                 policy=_remat_policy(),
@@ -626,7 +700,9 @@ class GPT(Model):
             def block_fn(x, blk):
                 return mlp_fn(attn_fn(x, blk), blk)
         else:
-            block_fn = functools.partial(self._block, manual=False)
+            block_fn = functools.partial(
+                self._block, manual=False, segment_ids=segment_ids
+            )
             if c.remat:
                 block_fn = jax.checkpoint(block_fn, policy=_remat_policy())
 
@@ -808,9 +884,10 @@ class GPT(Model):
         params: Dict[str, Any],
         tokens: jax.Array,
         positions: Optional[jax.Array] = None,
+        segment_ids: Optional[jax.Array] = None,
     ) -> jax.Array:
         """tokens [B, S] int32 → logits [B, S, V] (compute dtype)."""
-        return self._forward(params, tokens, positions)[0]
+        return self._forward(params, tokens, positions, segment_ids)[0]
 
     # -- 1F1B training path ------------------------------------------------
     def _loss_1f1b(
@@ -832,6 +909,13 @@ class GPT(Model):
         from determined_tpu.parallel.pipeline import one_f_one_b_grads
 
         c = self.config
+        if batch.get("segment_ids") is not None:
+            # Same error (and -O-proof raise) as _forward: silently
+            # ignoring the ids would attend across packed documents.
+            raise ValueError(
+                "segment_ids (packed sequences) are not supported with "
+                "pipeline parallelism yet"
+            )
         tokens = batch["tokens"]
         targets = batch.get("targets")
         positions = batch.get("positions")
@@ -1033,12 +1117,33 @@ class GPT(Model):
         tokens = batch["tokens"]
         targets = batch.get("targets")
         positions = batch.get("positions")
+        segment_ids = batch.get("segment_ids")
         mask = batch.get("loss_mask")
         mask = (
             jnp.ones(tokens.shape, jnp.float32)
             if mask is None
             else mask.astype(jnp.float32)
         )
+        if segment_ids is not None and targets is None:
+            # Packed sequences with the in-model shift: position i−1
+            # predicting token i crosses a document boundary wherever the
+            # segment id changes at i — mask those predictions out, and
+            # drop padding (segment id 0, the pack_sequences convention:
+            # pad→pad has equal ids, so the boundary mask alone would
+            # score pad predictions). An explicit loss_mask (e.g. from
+            # pack_sequences itself) composes multiplicatively.
+            # Pre-shifted batches (targets given) carry their own mask
+            # from the data pipeline.
+            boundary = jnp.concatenate(
+                [
+                    jnp.ones_like(mask[:, :1]),
+                    (segment_ids[:, 1:] == segment_ids[:, :-1]).astype(
+                        jnp.float32
+                    ),
+                ],
+                axis=1,
+            )
+            mask = mask * boundary * (segment_ids != 0)
         c = self.config
         use_fused = (
             c.fused_loss
@@ -1050,8 +1155,10 @@ class GPT(Model):
             )
         )
         if use_fused:
-            return self._loss_fused(params, tokens, targets, positions, mask)
-        logits, moe_aux = self._forward(params, tokens, positions)
+            return self._loss_fused(
+                params, tokens, targets, positions, mask, segment_ids
+            )
+        logits, moe_aux = self._forward(params, tokens, positions, segment_ids)
         if targets is not None:
             # Pre-shifted batch (zigzag-layout pipelines, data/tokens.py):
             # position i already predicts targets[i] — no in-model shift.
@@ -1076,7 +1183,7 @@ class GPT(Model):
         return loss, {"loss": loss, "accuracy": acc, "tokens": n_tok}
 
     def _loss_fused(
-        self, params, tokens, targets, positions, mask
+        self, params, tokens, targets, positions, mask, segment_ids=None
     ) -> Tuple[jax.Array, Metrics]:
         """Loss via the chunked cross-entropy (ops/fused_cross_entropy.py):
         identical math to the dense path, ~half the HBM traffic (the [B, S,
@@ -1086,7 +1193,9 @@ class GPT(Model):
         )
 
         c = self.config
-        x, _moe_aux = self._forward_trunk(params, tokens, positions)
+        x, _moe_aux = self._forward_trunk(
+            params, tokens, positions, segment_ids
+        )
         hidden = _layernorm(x, params["lnf_scale"], params["lnf_bias"])
         w_out = (
             params["tok_embed"].T if c.tie_embeddings else params["head"]

@@ -1,4 +1,14 @@
 """Pallas TPU kernels + blockwise reference paths for the hot ops."""
-from determined_tpu.ops.flash_attention import flash_attention
+from determined_tpu.ops.flash_attention import (
+    block_skip_stats,
+    fit_block,
+    flash_attention,
+    flash_attention_lse,
+)
 
-__all__ = ["flash_attention"]
+__all__ = [
+    "block_skip_stats",
+    "fit_block",
+    "flash_attention",
+    "flash_attention_lse",
+]

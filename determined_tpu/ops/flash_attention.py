@@ -12,6 +12,33 @@ kernels). Two implementations behind one differentiable entry point:
   for CPU tests/interpret mode and as the autodiff backward (recompute
   per-block scores from the saved LSE — O(S·block) memory, never O(S²)).
 
+Masking is a single band+segment model shared by every kernel:
+
+- ``causal``: row r attends cols ≤ r;
+- ``window=W``: row r additionally attends only cols > r − W (sliding
+  window; requires causal);
+- ``kv_offset``: q positions are globally offset by +kv_offset relative to
+  k positions — this is what lets ring attention express a cross-device hop
+  ("my queries sit s·L tokens after this kv chunk") as a plain kernel call,
+  and what a kv-cache decode layout needs;
+- ``segment_ids``: attention only within equal ids (packed sequences).
+
+Block-sparse causal execution (the long-context win): blocks that the
+band proves fully dead are skipped at BOTH levels —
+
+- compute: the @pl.when dispatch in `_mask_dispatch` never runs the MXU
+  work for a dead (qi, ki) block;
+- DMA: the K/V (resp. Q-side, in the dk/dv grids) BlockSpec index_maps
+  remap dead iterations onto a block that is already resident — Pallas
+  elides the HBM copy when consecutive grid steps map the same block (the
+  jax-ml TPU flash-attention technique). Dead iterations past a row's live
+  range map to the NEXT row's first live block, so its DMA overlaps the
+  dead tail instead of stalling the row start.
+
+At 32k causal that removes ~half the grid's HBM traffic; with a sliding
+window it removes all blocks outside the band. `block_skip_stats` mirrors
+the predicate for bench reporting.
+
 The custom VJP follows the flash-attention backward equations:
   p  = exp(s - lse);  dv = pᵀ·do;  dp = do·vᵀ
   ds = p ∘ (dp - rowsum(do ∘ o));  dq = ds·k;  dk = dsᵀ·q
@@ -23,7 +50,6 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
 
@@ -62,48 +88,157 @@ def fit_block(seq: int, want: int) -> int:
     return b
 
 
-def _causal_mask(q_offset: jax.Array, k_offset: jax.Array, bq: int, bk: int) -> jax.Array:
-    rows = q_offset + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    cols = k_offset + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return rows >= cols
+# ---------------------------------------------------------------------------
+# Masking model: band (causal/window/kv_offset) + segments
+# ---------------------------------------------------------------------------
+def _band_mask(qi, ki, bq: int, bk: int, *, causal: bool,
+               window: Optional[int], kv_offset: int) -> jax.Array:
+    """Elementwise [bq, bk] mask for one block: q position (global) is
+    qi·bq + r + kv_offset, k position is ki·bk + c."""
+    rows = qi * bq + kv_offset + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = None
+    if causal:
+        mask = rows >= cols
+    if window is not None:
+        wm = rows - cols < window
+        mask = wm if mask is None else mask & wm
+    assert mask is not None
+    return mask
 
 
-def _causal_dispatch(qi, ki, block_q, block_k, causal, compute, on_skip=None):
-    """Run `compute(masked)` for one (qi, ki) block in the right causal
-    regime — shared by all the kernels so the boundary logic lives once:
+def _score_mask(qi, ki, *, block_q: int, block_k: int, causal: bool,
+                window: Optional[int], kv_offset: int, band_masked: bool,
+                qseg, kseg):
+    """Combined [bq, bk] bool mask, or None when nothing masks this block.
+    `qseg`/`kseg` are the (block_q, 1) / (1, block_k) fp32 segment-id
+    values (or None) — fp32 equality is exact for ids < 2^24 and keeps the
+    arrays out of the custom_vjp's integer-cotangent corner."""
+    mask = None
+    if band_masked:
+        mask = _band_mask(
+            qi, ki, block_q, block_k,
+            causal=causal, window=window, kv_offset=kv_offset,
+        )
+    if qseg is not None:
+        sm = qseg == kseg  # broadcasts to [bq, bk]
+        mask = sm if mask is None else mask & sm
+    return mask
 
-    - block fully above the diagonal: contributes nothing, skip all work
-      (`on_skip`, when given, still runs — a kernel whose output block is
+
+def _mask_dispatch(qi, ki, *, block_q, block_k, causal, window, kv_offset,
+                   compute, on_skip=None):
+    """Run `compute(band_masked)` for one (qi, ki) block in the right band
+    regime — shared by all the blocked kernels so the boundary logic lives
+    once:
+
+    - block fully outside the band (above the diagonal, or entirely past
+      the sliding window): contributes nothing, skip all work (`on_skip`,
+      when given, still runs — a kernel whose output block is
       unconditionally mapped must zero it);
-    - block straddling the diagonal: compute with the element mask;
-    - block fully below: compute without the iota/where VPU work.
+    - block straddling a band edge: compute with the element mask;
+    - block fully inside: compute without the iota/where VPU work
+      (segment masking, when active, is applied inside `compute` either
+      way — segment boundaries aren't derivable from block indices).
     """
-    if not causal:
-        compute(masked=False)
+    if not causal and window is None:
+        compute(band_masked=False)
         return
-    first_q, last_q = qi * block_q, qi * block_q + (block_q - 1)
-    first_k, last_k = ki * block_k, ki * block_k + (block_k - 1)
-    on_diag = (last_k > first_q) & (first_k <= last_q)
-    below = last_k <= first_q
+    first_q = qi * block_q + kv_offset
+    last_q = first_q + block_q - 1
+    first_k = ki * block_k
+    last_k = first_k + block_k - 1
+    live = None
+    inside = None
 
-    @pl.when(on_diag)
-    def _():
-        compute(masked=True)
+    def _and(a, b):
+        return b if a is None else a & b
 
-    @pl.when(below)
+    if causal:
+        live = _and(live, first_k <= last_q)
+        inside = _and(inside, last_k <= first_q)
+    if window is not None:
+        live = _and(live, last_k >= first_q - (window - 1))
+        inside = _and(inside, first_k >= last_q - (window - 1))
+    # `inside` ⊆ `live` componentwise, so these three cover the grid.
+    on_edge = live & jnp.logical_not(inside)
+
+    @pl.when(on_edge)
     def _():
-        compute(masked=False)
+        compute(band_masked=True)
+
+    @pl.when(inside)
+    def _():
+        compute(band_masked=False)
 
     if on_skip is not None:
-        @pl.when(jnp.logical_not(on_diag | below))
+        @pl.when(jnp.logical_not(live))
         def _():
             on_skip()
 
 
 # ---------------------------------------------------------------------------
+# Dead-block DMA elision: BlockSpec index_map remapping
+# ---------------------------------------------------------------------------
+def _remap_k_index(i, j, *, block_q, block_k, causal, window, kv_offset, nk):
+    """K-side block index for grid step (qi=i, ki=j) in a k-innermost grid.
+
+    Live ki range for row i is [kmin(i), kmax(i)]; dead iterations below
+    map to kmin(i) (prefetching the row's first live block) and dead
+    iterations above map to kmin(i+1) (prefetching the NEXT row's first
+    live block — for plain causal that is block 0, the jax-ml trick).
+    Pallas elides the copy whenever consecutive steps map the same block,
+    so dead iterations cost no HBM traffic."""
+    if not causal and window is None:
+        return j
+    last_q = i * block_q + block_q - 1 + kv_offset
+    kmax = jnp.minimum(last_q // block_k, nk - 1) if causal else nk - 1
+    if window is not None:
+        first_q = i * block_q + kv_offset
+        kmin = jnp.maximum(first_q - (window - 1), 0) // block_k
+        first_q2 = first_q + block_q
+        kmin_next = jnp.maximum(first_q2 - (window - 1), 0) // block_k
+    else:
+        kmin = 0
+        kmin_next = 0
+    j_eff = jnp.where(j > kmax, kmin_next, jnp.clip(j, kmin, kmax))
+    return jnp.clip(j_eff, 0, nk - 1)
+
+
+def _remap_q_index(j, i, *, block_q, block_k, causal, window, kv_offset, nq):
+    """Q-side block index for grid step (ki=j, qi=i) in a q-innermost grid
+    (the dk/dv kernels). Mirror of `_remap_k_index`: live qi range for
+    column j is [imin(j), imax(j)]."""
+    if not causal and window is None:
+        return i
+    first_k = j * block_k
+    # smallest i with i·bq + bq − 1 + off ≥ first_k, i.e.
+    # ceil((first_k − off − bq + 1)/bq) = floor((first_k − off)/bq);
+    # jnp's // floors (lax.div would truncate negatives toward zero).
+    imin = jnp.maximum((first_k - kv_offset) // block_q, 0)
+    if window is not None:
+        last_k = first_k + block_k - 1
+        imax = jnp.minimum(
+            (last_k + window - 1 - kv_offset) // block_q, nq - 1
+        )
+        imin_next = jnp.maximum(
+            (first_k + block_k - kv_offset) // block_q, 0)
+    else:
+        imax = nq - 1
+        imin_next = imin  # no dead-above iterations without a window
+    i_eff = jnp.where(i > imax, imin_next, jnp.clip(i, imin, imax))
+    return jnp.clip(i_eff, 0, nq - 1)
+
+
+# ---------------------------------------------------------------------------
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k, num_k_blocks):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, window, kv_offset,
+                has_segments, block_q, block_k, num_k_blocks):
+    if has_segments:
+        qseg_ref, kseg_ref = rest[0], rest[1]
+        rest = rest[2:]
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -113,7 +248,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    def _compute(masked):
+    def _compute(band_masked):
         # MXU dots take the native (bf16) inputs and accumulate in fp32 via
         # preferred_element_type — casting inputs to fp32 first would run
         # the MXU at a fraction of its bf16 rate.
@@ -123,14 +258,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk] fp32
-        if masked:
-            mask = _causal_mask(qi * block_q, ki * block_k, block_q, block_k)
+        mask = _score_mask(
+            qi, ki, block_q=block_q, block_k=block_k, causal=causal,
+            window=window, kv_offset=kv_offset, band_masked=band_masked,
+            qseg=qseg_ref[0].reshape(block_q, 1) if has_segments else None,
+            kseg=kseg_ref[0].reshape(1, block_k) if has_segments else None,
+        )
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         # m/l live in lane-padded (block_q, 128) scratch; column 0 is real.
         m_prev = m_scr[:, 0:1]  # [bq, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
-        if masked:
+        if mask is not None:
             p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m_prev - m_new)
         l_scr[:, 0:1] = l_scr[:, 0:1] * corr + jnp.sum(p, axis=1, keepdims=True)
@@ -140,7 +280,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
         )
         m_scr[:, 0:1] = m_new
 
-    _causal_dispatch(qi, ki, block_q, block_k, causal, _compute)
+    _mask_dispatch(
+        qi, ki, block_q=block_q, block_k=block_k, causal=causal,
+        window=window, kv_offset=kv_offset, compute=_compute,
+    )
 
     @pl.when(ki == num_k_blocks - 1)
     def _epilogue():
@@ -177,18 +320,32 @@ def _mono_fwd_call(q, k, v, *, scale, causal, interpret):
     return o, lse.reshape(bh, s_q)
 
 
+def _seg3(segs, s_q, s_k):
+    """([BH, Sq], [BH, Sk]) fp32 segment ids → the [BH, 1, S] layout the
+    kernels' (1, 1, block) BlockSpecs want (same TPU-tiling trick as lse)."""
+    qseg, kseg = segs
+    bh = qseg.shape[0]
+    return qseg.reshape(bh, 1, s_q), kseg.reshape(bh, 1, s_k)
+
+
 def _flash_fwd_pallas(
-    q: jax.Array, k: jax.Array, v: jax.Array, *, scale, causal, block_q, block_k, interpret
+    q: jax.Array, k: jax.Array, v: jax.Array, *, scale, causal, block_q,
+    block_k, interpret, window=None, kv_offset=0, segs=None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """q/k/v: [BH, S, D] → (o [BH, S, D], lse [BH, S])."""
+    """q/k/v: [BH, S, D] (+ optional segs ([BH, Sq], [BH, Sk]) fp32)
+    → (o [BH, S, D], lse [BH, S])."""
     bh, s_q, d = q.shape
     s_k = k.shape[1]
-    if _mono_ok(s_q, s_k, block_q, block_k):
+    if _mono_ok(s_q, s_k, block_q, block_k, window=window,
+                has_segments=segs is not None, kv_offset=kv_offset):
         # Causal-split band schedules (skipping the never-attended upper
         # quarter of the score matrix) were tried both as two pallas calls
         # and as a 2-band grid with resident K/V — the XLA glue
         # (slice/concat/pad) respectively the band dispatch cost more than
-        # the quarter saved at these sizes. Plain monolithic wins.
+        # the quarter saved at these sizes. Plain monolithic wins. The
+        # blocked kernels' dead-block skipping doesn't change that choice
+        # here: the autotuner probes the mono candidate against blocked
+        # ones and keeps whichever times best.
         return _mono_fwd_call(
             q, k, v, scale=scale, causal=causal, interpret=interpret,
         )
@@ -198,20 +355,36 @@ def _flash_fwd_pallas(
         _fwd_kernel,
         scale=scale,
         causal=causal,
+        window=window,
+        kv_offset=kv_offset,
+        has_segments=segs is not None,
         block_q=block_q,
         block_k=block_k,
         num_k_blocks=nk,
     )
     from jax.experimental.pallas import tpu as pltpu
 
+    kmap = functools.partial(
+        _remap_k_index, block_q=block_q, block_k=block_k, causal=causal,
+        window=window, kv_offset=kv_offset, nk=nk,
+    )
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, kmap(i, j), 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, kmap(i, j), 0)),
+    ]
+    inputs = [q, k, v]
+    if segs is not None:
+        qseg3, kseg3 = _seg3(segs, s_q, s_k)
+        in_specs.append(pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)))
+        in_specs.append(
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, kmap(i, j)))
+        )
+        inputs.extend([qseg3, kseg3])
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             # lse as [BH, 1, S]: block (1, 1, block_q) satisfies TPU tiling
@@ -228,7 +401,7 @@ def _flash_fwd_pallas(
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return o, lse.reshape(bh, s_q)
 
 
@@ -248,10 +421,17 @@ def _flash_fwd_pallas(
 _MONO_MAX_SCORES = 2 ** 21
 
 
-def _mono_ok(s_q, s_k, block_q, block_k) -> bool:
+def _mono_ok(s_q, s_k, block_q, block_k, *, window=None, has_segments=False,
+             kv_offset=0) -> bool:
+    """Mono engages only for the plain (no window/segments/offset) shapes
+    it was written for; windowed/segmented/offset calls take the blocked
+    kernels, whose band dispatch handles them. The mono-vs-blocked choice
+    itself is empirical: the autotuner includes the (s_q, s_k) mono
+    candidate in its probe set when it fits."""
     return (
         block_q == s_q and block_k == s_k
         and s_q * s_k <= _MONO_MAX_SCORES
+        and window is None and not has_segments and kv_offset == 0
     )
 
 
@@ -263,7 +443,8 @@ def _fwd_kernel_mono(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal):
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
     if causal:
-        mask = _causal_mask(0, 0, q.shape[0], k.shape[0])
+        mask = _band_mask(0, 0, q.shape[0], k.shape[0], causal=True,
+                          window=None, kv_offset=0)
         s = jnp.where(mask, s, NEG_INF)
     m = jnp.max(s, axis=1, keepdims=True)
     p = jnp.exp(s - m)  # masked entries underflow to exactly 0
@@ -293,7 +474,8 @@ def _bwd_kernel_mono(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
     if causal:
-        mask = _causal_mask(0, 0, s_q, k.shape[0])
+        mask = _band_mask(0, 0, s_q, k.shape[0], causal=True,
+                          window=None, kv_offset=0)
         s = jnp.where(mask, s, NEG_INF)
     p = jnp.exp(s - lse)                # [s_q, s_k] fp32; masked → 0
     pt = p.astype(do.dtype)
@@ -315,8 +497,8 @@ def _bwd_kernel_mono(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_fused_blocked_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
-                              delta_ref, dlse_ref, dqp_ref, dk_ref, dv_ref,
-                              dk_scr, dv_scr, *, scale, causal, block_q,
+                              delta_ref, dlse_ref, *rest, scale, causal,
+                              window, kv_offset, has_segments, block_q,
                               block_k, num_q_blocks):
     """Fused blocked backward: ONE pass over (j, i) blocks computes s and
     p once and feeds all three gradients — the two-pass split recomputes
@@ -325,6 +507,10 @@ def _bwd_fused_blocked_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
     accumulate in VMEM scratch over the inner q dimension; dq cannot
     (it accumulates over the OUTER dimension), so each (j, i) writes an
     fp32 partial and XLA sums the nk partials after the call."""
+    if has_segments:
+        qseg_ref, kseg_ref = rest[0], rest[1]
+        rest = rest[2:]
+    dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
     ji = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -333,7 +519,7 @@ def _bwd_fused_blocked_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    def _compute(masked):
+    def _compute(band_masked):
         q = q_ref[0]    # [bq, d] bf16
         k = k_ref[0]    # [bk, d]
         v = v_ref[0]
@@ -344,10 +530,19 @@ def _bwd_fused_blocked_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if masked:
-            mask = _causal_mask(qi * block_q, ji * block_k, block_q, block_k)
+        mask = _score_mask(
+            qi, ji, block_q=block_q, block_k=block_k, causal=causal,
+            window=window, kv_offset=kv_offset, band_masked=band_masked,
+            qseg=qseg_ref[0].reshape(block_q, 1) if has_segments else None,
+            kseg=kseg_ref[0].reshape(1, block_k) if has_segments else None,
+        )
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)                    # [bq, bk] fp32
+        if mask is not None:
+            # Rows with NO live keys carry lse ≈ NEG_INF; exp(s − lse)
+            # would resurrect masked entries as 1 there.
+            p = jnp.where(mask, p, 0.0)
         pt = p.astype(do.dtype)
         dv_scr[:] += jax.lax.dot_general(
             pt, do, (((0,), (0,)), ((), ())),
@@ -371,8 +566,9 @@ def _bwd_fused_blocked_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         # it, or the XLA partial-sum reads garbage.
         dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
 
-    _causal_dispatch(
-        qi, ji, block_q, block_k, causal, _compute, on_skip=_skip
+    _mask_dispatch(
+        qi, ji, block_q=block_q, block_k=block_k, causal=causal,
+        window=window, kv_offset=kv_offset, compute=_compute, on_skip=_skip,
     )
 
     @pl.when(qi == num_q_blocks - 1)
@@ -396,8 +592,12 @@ _FUSED_BWD_PARTIALS_CAP = 1 << 30
 # MXU dots take bf16 inputs with fp32 accumulation.
 # ---------------------------------------------------------------------------
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
-                   dq_ref, dq_scr, *, scale, causal, block_q, block_k,
-                   num_k_blocks):
+                   *rest, scale, causal, window, kv_offset, has_segments,
+                   block_q, block_k, num_k_blocks):
+    if has_segments:
+        qseg_ref, kseg_ref = rest[0], rest[1]
+        rest = rest[2:]
+    dq_ref, dq_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -405,7 +605,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    def _compute(masked):
+    def _compute(band_masked):
         q = q_ref[0]    # [bq, d] bf16
         k = k_ref[0]    # [bk, d]
         v = v_ref[0]
@@ -416,10 +616,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if masked:
-            mask = _causal_mask(qi * block_q, ki * block_k, block_q, block_k)
+        mask = _score_mask(
+            qi, ki, block_q=block_q, block_k=block_k, causal=causal,
+            window=window, kv_offset=kv_offset, band_masked=band_masked,
+            qseg=qseg_ref[0].reshape(block_q, 1) if has_segments else None,
+            kseg=kseg_ref[0].reshape(1, block_k) if has_segments else None,
+        )
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)  # [bq, bk] fp32
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)  # all-masked rows: lse ≈ NEG_INF
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -431,7 +638,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    _causal_dispatch(qi, ki, block_q, block_k, causal, _compute)
+    _mask_dispatch(
+        qi, ki, block_q=block_q, block_k=block_k, causal=causal,
+        window=window, kv_offset=kv_offset, compute=_compute,
+    )
 
     @pl.when(ki == num_k_blocks - 1)
     def _epilogue():
@@ -439,8 +649,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *,
-                    scale, causal, block_q, block_k, num_q_blocks):
+                    *rest, scale, causal, window, kv_offset, has_segments,
+                    block_q, block_k, num_q_blocks):
+    if has_segments:
+        qseg_ref, kseg_ref = rest[0], rest[1]
+        rest = rest[2:]
+    dk_ref, dv_ref, dk_scr, dv_scr = rest
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -449,7 +663,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    def _compute(masked):
+    def _compute(band_masked):
         q = q_ref[0]    # [bq, d]
         k = k_ref[0]    # [bk, d]
         v = v_ref[0]
@@ -460,10 +674,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if masked:
-            mask = _causal_mask(qi * block_q, ki * block_k, block_q, block_k)
+        mask = _score_mask(
+            qi, ki, block_q=block_q, block_k=block_k, causal=causal,
+            window=window, kv_offset=kv_offset, band_masked=band_masked,
+            qseg=qseg_ref[0].reshape(block_q, 1) if has_segments else None,
+            kseg=kseg_ref[0].reshape(1, block_k) if has_segments else None,
+        )
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)                    # [bq, bk] fp32
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)  # all-masked rows: lse ≈ NEG_INF
         pt = p.astype(do.dtype)
         dv_scr[:] += jax.lax.dot_general(
             pt, do, (((0,), (0,)), ((), ())),   # pᵀ·do → [bk, d]
@@ -478,7 +699,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
             preferred_element_type=jnp.float32,
         )
 
-    _causal_dispatch(qi, ki, block_q, block_k, causal, _compute)
+    _mask_dispatch(
+        qi, ki, block_q=block_q, block_k=block_k, causal=causal,
+        window=window, kv_offset=kv_offset, compute=_compute,
+    )
 
     @pl.when(qi == num_q_blocks - 1)
     def _epilogue():
@@ -510,7 +734,8 @@ def _mono_bwd_call(q, k, v, do, lse3, delta3, dlse3, *, scale, causal,
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
-                      interpret=False, dlse=None):
+                      interpret=False, dlse=None, window=None, kv_offset=0,
+                      segs=None):
     """q/k/v/o/do: [BH, S, D], lse (+optional dlse): [BH, S] fp32 →
     (dq, dk, dv)."""
     from jax.experimental.pallas import tpu as pltpu
@@ -527,28 +752,52 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
     lse3 = lse.reshape(bh, 1, s_q)
     delta3 = delta.reshape(bh, 1, s_q)
     dlse3 = dlse.astype(jnp.float32).reshape(bh, 1, s_q)
+    has_segments = segs is not None
 
-    if _mono_ok(s_q, s_k, block_q, block_k):
+    if _mono_ok(s_q, s_k, block_q, block_k, window=window,
+                has_segments=has_segments, kv_offset=kv_offset):
         return _mono_bwd_call(
             q, k, v, do, lse3, delta3, dlse3,
             scale=scale, causal=causal, interpret=interpret,
         )
 
-    if bh * nk * s_q * d * 4 <= _FUSED_BWD_PARTIALS_CAP:
-        from jax.experimental.pallas import tpu as pltpu
+    qmap = functools.partial(
+        _remap_q_index, block_q=block_q, block_k=block_k, causal=causal,
+        window=window, kv_offset=kv_offset, nq=nq,
+    )
+    kmap = functools.partial(
+        _remap_k_index, block_q=block_q, block_k=block_k, causal=causal,
+        window=window, kv_offset=kv_offset, nk=nk,
+    )
+    if segs is not None:
+        qseg3, kseg3 = _seg3(segs, s_q, s_k)
 
+    if bh * nk * s_q * d * 4 <= _FUSED_BWD_PARTIALS_CAP:
+        # q-innermost grid: q-side blocks remap dead iterations for DMA
+        # elision; the k/v blocks are fixed per outer step.
         fused_specs = [
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # q
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, qmap(j, i), 0)),   # q
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # k
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # v
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # do
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # lse
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # delta
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # dlse
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, qmap(j, i), 0)),   # do
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, qmap(j, i))),   # lse
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, qmap(j, i))),   # delta
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, qmap(j, i))),   # dlse
         ]
+        inputs = [q, k, v, do, lse3, delta3, dlse3]
+        if has_segments:
+            fused_specs.append(
+                pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, qmap(j, i)))
+            )
+            fused_specs.append(
+                pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b, 0, j))
+            )
+            inputs.extend([qseg3, kseg3])
         dqp, dk, dv = pl.pallas_call(
             functools.partial(
                 _bwd_fused_blocked_kernel, scale=scale, causal=causal,
+                window=window, kv_offset=kv_offset,
+                has_segments=has_segments,
                 block_q=block_q, block_k=block_k, num_q_blocks=nq,
             ),
             grid=(bh, nk, nq),
@@ -570,22 +819,30 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
                 pltpu.VMEM((block_k, d), jnp.float32),
             ],
             interpret=interpret,
-        )(q, k, v, do, lse3, delta3, dlse3)
+        )(*inputs)
         dq = jnp.sum(dqp, axis=1).astype(q.dtype)
         return dq, dk, dv
 
     row_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # q
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # k
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # v
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, kmap(i, j), 0)),   # k
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, kmap(i, j), 0)),   # v
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # do
         pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),   # lse
         pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),   # delta
         pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),   # dlse
     ]
+    dq_inputs = [q, k, v, do, lse3, delta3, dlse3]
+    if has_segments:
+        row_specs.append(pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)))
+        row_specs.append(
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, kmap(i, j)))
+        )
+        dq_inputs.extend([qseg3, kseg3])
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal,
+            window=window, kv_offset=kv_offset, has_segments=has_segments,
             block_q=block_q, block_k=block_k, num_k_blocks=nk,
         ),
         grid=(bh, nq, nk),
@@ -594,20 +851,28 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
         out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse3, delta3, dlse3)
+    )(*dq_inputs)
 
     col_specs = [
-        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # q
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, qmap(j, i), 0)),   # q
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # k
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # v
-        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # do
-        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # lse
-        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # delta
-        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # dlse
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, qmap(j, i), 0)),   # do
+        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, qmap(j, i))),   # lse
+        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, qmap(j, i))),   # delta
+        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, qmap(j, i))),   # dlse
     ]
+    dkv_inputs = [q, k, v, do, lse3, delta3, dlse3]
+    if has_segments:
+        col_specs.append(
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, qmap(j, i)))
+        )
+        col_specs.append(pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b, 0, j)))
+        dkv_inputs.extend([qseg3, kseg3])
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal,
+            window=window, kv_offset=kv_offset, has_segments=has_segments,
             block_q=block_q, block_k=block_k, num_q_blocks=nq,
         ),
         grid=(bh, nk, nq),
@@ -625,14 +890,34 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse3, delta3, dlse3)
+    )(*dkv_inputs)
     return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
 # Blockwise scan reference (CPU path + backward recompute)
 # ---------------------------------------------------------------------------
-def _blockwise_fwd_ref(q, k, v, *, scale, causal, block_k):
+def _ref_block_mask(rows, cols, *, causal, window, kv_offset, qseg, kseg_j):
+    """[.., s_q, bk] bool mask (or None) for the scan reference. `rows` is
+    [s_q] LOCAL q indices, `cols` [bk] global k indices; `qseg` [BH, s_q]
+    and `kseg_j` [BH, bk] fp32 ids."""
+    grows = rows + kv_offset
+    mask = None
+    if causal:
+        mask = grows[:, None] >= cols[None, :]
+    if window is not None:
+        wm = grows[:, None] - cols[None, :] < window
+        mask = wm if mask is None else mask & wm
+    if mask is not None:
+        mask = mask[None]  # broadcast over BH
+    if qseg is not None:
+        sm = qseg[:, :, None] == kseg_j[:, None, :]
+        mask = sm if mask is None else mask & sm
+    return mask
+
+
+def _blockwise_fwd_ref(q, k, v, *, scale, causal, block_k, window=None,
+                       kv_offset=0, segs=None):
     """Same math as the kernel, expressed as lax.scan over K/V blocks."""
     bh, s_q, d = q.shape
     s_k = k.shape[1]
@@ -640,24 +925,34 @@ def _blockwise_fwd_ref(q, k, v, *, scale, causal, block_k):
     kb = k.reshape(bh, nk, block_k, d).transpose(1, 0, 2, 3)
     vb = v.reshape(bh, nk, block_k, d).transpose(1, 0, 2, 3)
     rows = jnp.arange(s_q)
+    qseg = None
+    ksegb = jnp.zeros((nk, bh, block_k), jnp.float32)  # placeholder xs slot
+    if segs is not None:
+        qseg, kseg = segs
+        ksegb = kseg.reshape(bh, nk, block_k).transpose(1, 0, 2)
+    masked = causal or window is not None or segs is not None
 
     def step(carry, blk):
         m, l, acc = carry
-        k_j, v_j, j = blk
+        k_j, v_j, kseg_j, j = blk
         # fp32 accumulation in the score matmul (matches the Pallas forward,
         # which casts to fp32 before the MXU dot): bf16-rounded scores here
         # would bias the backward's recomputed softmax.
         s = jnp.einsum(
             "bqd,bkd->bqk", q, k_j, preferred_element_type=jnp.float32
         ) * scale
-        if causal:
+        if masked:
             cols = j * block_k + jnp.arange(block_k)
-            mask = rows[:, None] >= cols[None, :]
-            s = jnp.where(mask[None], s, NEG_INF)
+            mask = _ref_block_mask(
+                rows, cols, causal=causal, window=window,
+                kv_offset=kv_offset, qseg=qseg,
+                kseg_j=kseg_j if segs is not None else None,
+            )
+            s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[..., None])
-        if causal:
-            p = jnp.where(mask[None], p, 0.0)
+        if masked:
+            p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1)
         acc = acc * corr[..., None] + jnp.einsum("bqk,bkd->bqd", p, v_j.astype(jnp.float32))
@@ -666,7 +961,9 @@ def _blockwise_fwd_ref(q, k, v, *, scale, causal, block_k):
     m0 = jnp.full((bh, s_q), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bh, s_q), jnp.float32)
     acc0 = jnp.zeros((bh, s_q, d), jnp.float32)
-    (m, l, acc), _ = lax.scan(step, (m0, l0, acc0), (kb, vb, jnp.arange(nk)))
+    (m, l, acc), _ = lax.scan(
+        step, (m0, l0, acc0), (kb, vb, ksegb, jnp.arange(nk))
+    )
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o = (acc / l_safe[..., None]).astype(q.dtype)
     lse = m + jnp.log(l_safe)
@@ -674,7 +971,7 @@ def _blockwise_fwd_ref(q, k, v, *, scale, causal, block_k):
 
 
 def _blockwise_bwd_ref(q, k, v, o, lse, do, *, scale, causal, block_k,
-                       dlse=None):
+                       dlse=None, window=None, kv_offset=0, segs=None):
     """Flash backward: recompute per-block p from lse; O(S·block) memory."""
     bh, s_q, d = q.shape
     s_k = k.shape[1]
@@ -688,17 +985,31 @@ def _blockwise_bwd_ref(q, k, v, o, lse, do, *, scale, causal, block_k,
         # lse-cotangent folds into the same p∘(·) term as delta (see the
         # Pallas dq kernel); keeping them combined avoids a second pass.
         delta = delta - dlse.astype(jnp.float32)
+    qseg = None
+    ksegb = jnp.zeros((nk, bh, block_k), jnp.float32)
+    if segs is not None:
+        qseg, kseg = segs
+        ksegb = kseg.reshape(bh, nk, block_k).transpose(1, 0, 2)
+    masked = causal or window is not None or segs is not None
 
     def step(dq_acc, blk):
-        k_j, v_j, j = blk
+        k_j, v_j, kseg_j, j = blk
         s = jnp.einsum(
             "bqd,bkd->bqk", q, k_j, preferred_element_type=jnp.float32
         ) * scale
-        if causal:
+        if masked:
             cols = j * block_k + jnp.arange(block_k)
-            mask = rows[:, None] >= cols[None, :]
-            s = jnp.where(mask[None], s, NEG_INF)
+            mask = _ref_block_mask(
+                rows, cols, causal=causal, window=window,
+                kv_offset=kv_offset, qseg=qseg,
+                kseg_j=kseg_j if segs is not None else None,
+            )
+            s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse[..., None])  # [BH, Sq, bk]
+        if masked:
+            # all-masked rows carry lse ≈ NEG_INF: exp(s − lse) would
+            # resurrect their masked entries as 1.
+            p = jnp.where(mask, p, 0.0)
         dv_j = jnp.einsum("bqk,bqd->bkd", p, do32)
         dp = jnp.einsum("bqd,bkd->bqk", do32, v_j.astype(jnp.float32))
         ds = p * (dp - delta[..., None]) * scale
@@ -707,10 +1018,46 @@ def _blockwise_bwd_ref(q, k, v, o, lse, do, *, scale, causal, block_k,
         return dq_acc, (dk_j, dv_j)
 
     dq0 = jnp.zeros((bh, s_q, d), jnp.float32)
-    dq, (dk_blocks, dv_blocks) = lax.scan(step, dq0, (kb, vb, jnp.arange(nk)))
+    dq, (dk_blocks, dv_blocks) = lax.scan(
+        step, dq0, (kb, vb, ksegb, jnp.arange(nk))
+    )
     dk = dk_blocks.transpose(1, 0, 2, 3).reshape(bh, s_k, d)
     dv = dv_blocks.transpose(1, 0, 2, 3).reshape(bh, s_k, d)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Skip accounting (bench/reporting)
+# ---------------------------------------------------------------------------
+def block_skip_stats(s_q: int, s_k: int, block_q: int, block_k: int, *,
+                     causal: bool = True, window: Optional[int] = None,
+                     kv_offset: int = 0) -> Tuple[int, int]:
+    """(live_blocks, total_blocks) of the blocked forward grid — the pure
+    numpy mirror of `_mask_dispatch`'s liveness predicate, so the bench can
+    report the causal-skip ratio without running a kernel. The mono path
+    is a single fully-live block by construction."""
+    block_q = fit_block(s_q, block_q)
+    block_k = fit_block(s_k, block_k)
+    if _mono_ok(s_q, s_k, block_q, block_k, window=window, kv_offset=kv_offset):
+        return 1, 1
+    nq = -(-s_q // block_q)
+    nk = -(-s_k // block_k)
+    if not causal and window is None:
+        return nq * nk, nq * nk
+    live = 0
+    for i in range(nq):
+        first_q = i * block_q + kv_offset
+        last_q = first_q + block_q - 1
+        for j in range(nk):
+            first_k = j * block_k
+            last_k = first_k + block_k - 1
+            ok = True
+            if causal:
+                ok = ok and first_k <= last_q
+            if window is not None:
+                ok = ok and last_k >= first_q - (window - 1)
+            live += int(ok)
+    return live, nq * nk
 
 
 # ---------------------------------------------------------------------------
@@ -720,40 +1067,55 @@ def _use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_lse(q, k, v, scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_lse(q, k, v, segs, scale, causal, block_q, block_k, window,
+               kv_offset):
     """Differentiable (o, lse): the lse cotangent feeds the ds term in the
     backward (ring attention differentiates through its partial-softmax
-    merge, which weights partials by exp(lse_i − lse_total))."""
-    return _flash_core(q, k, v, scale, causal, block_q, block_k)
+    merge, which weights partials by exp(lse_i − lse_total)). `segs` is
+    None or an ([BH, Sq], [BH, Sk]) fp32 pair; its cotangent is zero."""
+    return _flash_core(q, k, v, segs, scale, causal, block_q, block_k,
+                       window, kv_offset)
 
 
-def _flash_core(q, k, v, scale, causal, block_q, block_k):
+def _flash_core(q, k, v, segs, scale, causal, block_q, block_k, window,
+                kv_offset):
     if _use_pallas():
         return _flash_fwd_pallas(
-            q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            q, k, v, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, window=window, kv_offset=kv_offset, segs=segs,
             interpret=False,
         )
-    return _blockwise_fwd_ref(q, k, v, scale=scale, causal=causal, block_k=block_k)
+    return _blockwise_fwd_ref(
+        q, k, v, scale=scale, causal=causal, block_k=block_k, window=window,
+        kv_offset=kv_offset, segs=segs,
+    )
 
 
-def _flash_lse_fwd(q, k, v, scale, causal, block_q, block_k):
-    o, lse = _flash_core(q, k, v, scale, causal, block_q, block_k)
-    return (o, lse), (q, k, v, o, lse)
+def _flash_lse_fwd(q, k, v, segs, scale, causal, block_q, block_k, window,
+                   kv_offset):
+    o, lse = _flash_core(q, k, v, segs, scale, causal, block_q, block_k,
+                         window, kv_offset)
+    return (o, lse), (q, k, v, segs, o, lse)
 
 
-def _flash_lse_bwd(scale, causal, block_q, block_k, res, cts):
-    q, k, v, o, lse = res
+def _flash_lse_bwd(scale, causal, block_q, block_k, window, kv_offset, res,
+                   cts):
+    q, k, v, segs, o, lse = res
     do, dlse = cts
     if _use_pallas():
-        return _flash_bwd_pallas(
+        dq, dk, dv = _flash_bwd_pallas(
             q, k, v, o, lse, do, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, dlse=dlse,
+            block_q=block_q, block_k=block_k, dlse=dlse, window=window,
+            kv_offset=kv_offset, segs=segs,
         )
-    return _blockwise_bwd_ref(
-        q, k, v, o, lse, do, scale=scale, causal=causal, block_k=block_k,
-        dlse=dlse,
-    )
+    else:
+        dq, dk, dv = _blockwise_bwd_ref(
+            q, k, v, o, lse, do, scale=scale, causal=causal, block_k=block_k,
+            dlse=dlse, window=window, kv_offset=kv_offset, segs=segs,
+        )
+    dsegs = None if segs is None else jax.tree.map(jnp.zeros_like, segs)
+    return dq, dk, dv, dsegs
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -768,6 +1130,10 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = 512,
     block_k: int = 512,
+    window: Optional[int] = None,
+    segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    kv_offset: int = 0,
 ) -> jax.Array:
     """Fused attention; q/k/v: [B, S, H, D] (same layout as ring/ulysses).
 
@@ -776,7 +1142,9 @@ def flash_attention(
     (one shape contract); XLA drops the unused lse output.
     """
     o, _ = flash_attention_lse(
-        q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k
+        q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        window=window, segment_ids=segment_ids, kv_segment_ids=kv_segment_ids,
+        kv_offset=kv_offset,
     )
     return o
 
@@ -790,21 +1158,54 @@ def flash_attention_lse(
     scale: Optional[float] = None,
     block_q: int = 512,
     block_k: int = 512,
+    window: Optional[int] = None,
+    segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    kv_offset: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     """flash_attention that also returns the log-sum-exp per query.
 
     q/k/v: [B, S, H, D] → (o [B, Sq, H, D], lse [B, Sq, H] fp32). Both
     outputs are differentiable — this is the inner kernel for ring
     attention, whose cross-device merge needs (o, lse) partials.
+
+    window: sliding-window size W (requires causal) — query position p
+    attends key positions in (p − W, p]. Blocks fully outside the band
+    are skipped (compute AND DMA).
+    segment_ids / kv_segment_ids: [B, Sq] / [B, Sk] int ids; attention
+    only within equal ids (packed sequences). kv_segment_ids defaults to
+    segment_ids (requires s_q == s_k). A query row whose segment matches
+    no key gets o = 0 and lse ≈ −1e30.
+    kv_offset: global offset of q positions relative to k positions —
+    query row r sits at absolute position kv_offset + r in the key frame.
+    Ring attention uses this to express cross-device hops; a kv-cache
+    decode layout uses it to causal-mask a short q against a long k.
     """
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
-    if causal and s_q != s_k:
+    if kv_offset < 0:
+        raise ValueError(f"kv_offset must be >= 0, got {kv_offset}")
+    if window is not None:
+        if not causal:
+            raise ValueError("window (sliding-window) requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+    if causal and kv_offset == 0 and s_q != s_k:
         # The causal mask top-left aligns sequences (row i sees keys <= i at
         # absolute offset 0), which silently drops the K/V tail in decode /
-        # kv-cache layouts; those need an explicit offset, not this kernel.
+        # kv-cache layouts; those pass the explicit kv_offset instead.
         raise ValueError(
             f"causal flash attention requires s_q == s_k, got ({s_q}, {s_k})"
+            " — pass kv_offset for bottom-aligned decode layouts"
+        )
+    if kv_segment_ids is None and segment_ids is not None and s_q != s_k:
+        raise ValueError(
+            "segment_ids with s_q != s_k needs explicit kv_segment_ids"
+        )
+    if kv_segment_ids is not None and segment_ids is None:
+        raise ValueError(
+            "kv_segment_ids without segment_ids would be silently ignored; "
+            "pass both (q-side ids are required to build the mask)"
         )
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     block_q = min(block_q, s_q)
@@ -818,7 +1219,24 @@ def flash_attention_lse(
     def fold(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
-    o, lse = _flash_lse(fold(q), fold(k), fold(v), scale, causal, block_q, block_k)
+    def fold_seg(seg, s):
+        if seg.shape != (b, s):
+            raise ValueError(
+                f"segment ids must be [batch, seq] = ({b}, {s}), "
+                f"got {seg.shape}"
+            )
+        seg = seg.astype(jnp.float32)
+        return jnp.broadcast_to(seg[:, None, :], (b, h, s)).reshape(b * h, s)
+
+    segs = None
+    if segment_ids is not None:
+        kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
+        segs = (fold_seg(segment_ids, s_q), fold_seg(kv_seg, s_k))
+
+    o, lse = _flash_lse(
+        fold(q), fold(k), fold(v), segs, scale, causal, block_q, block_k,
+        window, kv_offset,
+    )
     o = o.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
     lse = lse.reshape(b, h, s_q).transpose(0, 2, 1)
     return o, lse

@@ -1,0 +1,255 @@
+"""Flash-attention block-size autotuner.
+
+`GPTConfig.flash_block_q/k = 1024` was measured best for the GPT-2 bench on
+a v5e — but one pair of constants cannot be right across v5e/v5p (different
+VMEM/HBM ratios), sequence lengths (the 32k regime wants different tiles
+than 1k) and masking modes (a sliding window changes the live-block
+geometry). This module replaces the constant with a measurement: time the
+real kernels (fwd + bwd, jitted) over a small candidate set at the exact
+shapes/dtype the model will run, pick the fastest, and remember the answer
+in a persistent on-disk cache so every later process (and every later bench
+round) pays nothing.
+
+Probing executes real device work, so it MUST run outside jit — callers
+resolve block sizes at model-build time (see GPT._flash_blocks) and pass
+plain ints into the traced code.
+
+Cache: one JSON object at `DTPU_FLASH_TUNE_CACHE` (default
+`~/.cache/determined_tpu/flash_blocks.json`), keyed by cache-format
+version, device kind, jax version, folded shape, dtype and masking mode —
+any of those changing invalidates the entry by construction; delete the
+file to force a re-probe. Writes are atomic (tempfile + rename) and
+best-effort: a read-only filesystem degrades to probing once per process.
+
+Off-TPU (CPU tests, trial processes on the master) no probe ever runs: the
+tuner returns the caller's wanted blocks fitted to the sequence, which is
+exactly the pre-autotuner behavior. `DTPU_FLASH_AUTOTUNE=0` forces that
+everywhere.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import time
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from determined_tpu.ops.flash_attention import (
+    _MONO_MAX_SCORES,
+    fit_block,
+    flash_attention,
+)
+
+logger = logging.getLogger("determined_tpu.ops.flash_autotune")
+
+#: Bump when the key schema or probe methodology changes incompatibly.
+CACHE_VERSION = 1
+
+#: (block_q, block_k) seeds; each is fitted to the actual sequence lengths
+#: and deduped, and the monolithic single-block candidate joins the set
+#: when it fits VMEM — so "mono vs blocked" is decided by the same timing
+#: probe as the tile size, not by a separate hand-tuned threshold.
+_CANDIDATE_SEEDS: Tuple[Tuple[int, int], ...] = (
+    (256, 256),
+    (512, 512),
+    (1024, 1024),
+    (2048, 1024),
+    (1024, 512),
+    (512, 1024),
+)
+
+#: Probe cost guardrails: per-candidate timed steps.
+_PROBE_WARMUP = 1
+_PROBE_STEPS = 3
+
+
+def cache_path() -> str:
+    return os.environ.get(
+        "DTPU_FLASH_TUNE_CACHE",
+        os.path.join(
+            os.path.expanduser("~"), ".cache", "determined_tpu",
+            "flash_blocks.json",
+        ),
+    )
+
+
+def _load_cache(path: str) -> dict:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else {}
+    except Exception:  # noqa: BLE001 - missing/corrupt cache: re-probe
+        return {}
+
+
+def _store_cache(path: str, data: dict) -> None:
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(path), prefix=".flash_blocks."
+        )
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=0, sort_keys=True)
+        os.replace(tmp, path)  # atomic: readers never see a torn file
+    except Exception:  # noqa: BLE001 - cache is an optimization only
+        logger.debug("flash autotune cache write failed", exc_info=True)
+
+
+def _cache_key(device_kind: str, s_q: int, s_k: int, n_heads: int,
+               head_dim: int, batch: int, dtype, causal: bool,
+               window: Optional[int], segments: bool) -> str:
+    return "|".join([
+        f"v{CACHE_VERSION}",
+        device_kind,
+        f"jax{jax.__version__}",
+        f"b{batch}h{n_heads}q{s_q}k{s_k}d{head_dim}",
+        jnp.dtype(dtype).name,
+        f"causal{int(causal)}",
+        f"win{window if window is not None else 0}",
+        f"seg{int(segments)}",
+    ])
+
+
+def candidate_blocks(s_q: int, s_k: int,
+                     want_q: int = 1024, want_k: int = 1024
+                     ) -> List[Tuple[int, int]]:
+    """Fitted, deduped candidate list for one shape. The caller's wanted
+    pair goes first (it wins ties and is the no-probe fallback); the
+    (s_q, s_k) single-block candidate joins when the score matrix fits
+    the mono VMEM budget. Which kernel a candidate times is decided by
+    the probe's mask mode — under `segments` the single-block candidate
+    exercises the BLOCKED kernel at block == seq (mono declines segment
+    masking), which is faithfully what that configuration runs."""
+    out: List[Tuple[int, int]] = []
+    seeds = ((want_q, want_k),) + _CANDIDATE_SEEDS
+    for bq, bk in seeds:
+        cand = (fit_block(s_q, bq), fit_block(s_k, bk))
+        if cand not in out:
+            out.append(cand)
+    if s_q * s_k <= _MONO_MAX_SCORES and (s_q, s_k) not in out:
+        out.append((s_q, s_k))
+    return out
+
+
+def _probe_ms(bq: int, bk: int, *, s_q: int, s_k: int, n_heads: int,
+              head_dim: int, batch: int, dtype, causal: bool,
+              window: Optional[int], segments: bool = False) -> float:
+    """Best-of-N wall ms of one jitted fwd+bwd step at (bq, bk); inf on
+    compile/OOM failure so the candidate simply loses."""
+    try:
+        keys = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(keys[0], (batch, s_q, n_heads, head_dim), dtype)
+        k = jax.random.normal(keys[1], (batch, s_k, n_heads, head_dim), dtype)
+        v = jax.random.normal(keys[2], (batch, s_k, n_heads, head_dim), dtype)
+        seg = kv_seg = None
+        if segments:
+            # Representative packed pattern: a few contiguous docs per
+            # row. The mask VALUES barely matter for timing; the extra
+            # operands and the segment-compare VPU work do.
+            def runs(s):
+                return jnp.cumsum(
+                    (jnp.arange(s) % max(s // 4, 1) == 0).astype(jnp.int32)
+                )[None, :].repeat(batch, axis=0)
+
+            seg, kv_seg = runs(s_q), runs(s_k)
+
+        def loss(q, k, v):
+            o = flash_attention(
+                q, k, v, causal=causal, window=window, segment_ids=seg,
+                kv_segment_ids=kv_seg, block_q=bq, block_k=bk,
+            )
+            return jnp.sum(o.astype(jnp.float32))
+
+        # All three gradients: grad-wrt-q alone would let XLA dead-code the
+        # dk/dv pass out of the two-pass backward split and rank candidates
+        # on a backward real training never runs.
+        step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        for _ in range(_PROBE_WARMUP):
+            jax.block_until_ready(step(q, k, v))
+        best = float("inf")
+        for _ in range(_PROBE_STEPS):
+            t0 = time.perf_counter()
+            jax.block_until_ready(step(q, k, v))
+            best = min(best, time.perf_counter() - t0)
+        return best * 1e3
+    except Exception:  # noqa: BLE001 - losing candidate, not an error
+        logger.debug("flash probe (%d, %d) failed", bq, bk, exc_info=True)
+        return float("inf")
+
+
+def tune_flash_blocks(
+    *,
+    s_q: int,
+    s_k: Optional[int] = None,
+    n_heads: int,
+    head_dim: int,
+    batch: int = 1,
+    dtype=jnp.bfloat16,
+    causal: bool = True,
+    window: Optional[int] = None,
+    segments: bool = False,
+    want_q: int = 1024,
+    want_k: int = 1024,
+    cache_file: Optional[str] = None,
+) -> Tuple[int, int]:
+    """Resolve (block_q, block_k) for one attention shape.
+
+    Call OUTSIDE jit (this may execute probe steps on the device). Returns
+    the fitted wanted blocks immediately off-TPU or when disabled via
+    DTPU_FLASH_AUTOTUNE=0; otherwise returns the cached winner, probing
+    once per (device kind, jax version, shape, dtype, mask mode).
+
+    `segments`: tune for packed-sequence batches — the probe carries
+    segment ids (so every candidate times the kernel that configuration
+    actually runs; mono declines segments and its block==seq candidate
+    falls through to the blocked kernel, in probe and production alike)
+    and the cached entry is keyed separately from the segment-free one.
+    """
+    s_k = s_q if s_k is None else s_k
+    fallback = (fit_block(s_q, want_q), fit_block(s_k, want_k))
+    if os.environ.get("DTPU_FLASH_AUTOTUNE", "1") == "0":
+        return fallback
+    if jax.default_backend() != "tpu":
+        return fallback
+
+    path = cache_file or cache_path()
+    key = _cache_key(
+        jax.devices()[0].device_kind, s_q, s_k, n_heads, head_dim, batch,
+        dtype, causal, window, segments,
+    )
+    cache = _load_cache(path)
+    hit = cache.get(key)
+    if isinstance(hit, (list, tuple)) and len(hit) == 2:
+        return int(hit[0]), int(hit[1])
+
+    cands = candidate_blocks(s_q, s_k, want_q, want_k)
+    timings = {}
+    for bq, bk in cands:
+        timings[(bq, bk)] = _probe_ms(
+            bq, bk, s_q=s_q, s_k=s_k, n_heads=n_heads, head_dim=head_dim,
+            batch=batch, dtype=dtype, causal=causal, window=window,
+            segments=segments,
+        )
+    best = min(timings, key=timings.get)
+    if timings[best] == float("inf"):
+        # Every candidate failed (transient device trouble, fragmented
+        # HBM): return the fallback for THIS process but do NOT cache it —
+        # a written entry would pin the untuned blocks on this box forever
+        # while the condition that caused it was temporary.
+        logger.warning(
+            "flash autotune %s: all %d probes failed; using fallback %s "
+            "(not cached)", key, len(cands), fallback,
+        )
+        return fallback
+    logger.info(
+        "flash autotune %s -> blocks %s (%.2f ms; %d candidates)",
+        key, best, timings[best], len(cands),
+    )
+    cache = _load_cache(path)  # re-read: another process may have written
+    cache[key] = list(best)
+    _store_cache(path, cache)
+    return best

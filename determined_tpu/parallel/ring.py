@@ -20,6 +20,18 @@ Three properties matter for TPU throughput:
 - steps (or step-parts) that cannot contribute are skipped via `lax.switch`
   on the kv chunk's origin, not computed-and-masked.
 
+Masking composes with the kernel's band/segment model:
+
+- `segment_ids` (packed sequences) ride the ring: the kv chunk's ids
+  rotate alongside K/V and every per-hop flash call masks q-ids against
+  the received kv-ids;
+- `window` (sliding window, causal, contiguous layout): each cross-device
+  hop is a plain kernel call with `kv_offset = hop·S_local` (the static
+  global offset between the q chunk and the received kv chunk), and hops
+  whose whole chunk lies outside the window are not emitted at all — a
+  W-token window stops rotating K/V after ceil-ish (W+L−1)/L hops, so
+  communication scales with the window, not the sequence.
+
 Communication rides ICI neighbor links (ppermute), overlapping with the
 per-step attention compute; peak memory is O(S_local·block) per step instead
 of O(S²) — this is what makes million-token contexts feasible on a pod.
@@ -27,7 +39,7 @@ of O(S²) — this is what makes million-token contexts feasible on a pod.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -85,6 +97,8 @@ def _merge(acc, lse_run, o_p, lse_p):
     lse_new = jnp.logaddexp(lse_run, lse_p)
     # Slots nothing has touched yet have lse_run = lse_new = −inf; the
     # subtraction would be NaN. They contribute weight 0 either way.
+    # (Fully-masked rows from the kernel come back at ≈ −1e30, which is
+    # finite — exp(−1e30 − safe) underflows to the same weight 0.)
     safe = jnp.where(jnp.isneginf(lse_new), 0.0, lse_new)
     w_old = jnp.where(jnp.isneginf(lse_run), 0.0, jnp.exp(lse_run - safe))
     w_new = jnp.where(jnp.isneginf(lse_p), 0.0, jnp.exp(lse_p - safe))
@@ -106,72 +120,153 @@ def ring_attention(
     block_q: int = 512,
     block_k: int = 512,
     layout: str = "contiguous",
+    window: Optional[int] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Exact attention with Q/K/V sequence-sharded over `axis_name`.
 
-    Call inside shard_map. Shapes per device: [B, S_local, H, D].
+    Call inside shard_map. Shapes per device: [B, S_local, H, D];
+    `segment_ids` (optional) is the per-shard [B, S_local] id slice.
 
     layout="contiguous" (default): devices hold consecutive chunks in
     axis-index order — the safe contract for arbitrary callers; causal work
-    is imbalanced across ranks.
+    is imbalanced across ranks. `window` (sliding window) is supported on
+    this layout only, and prunes both compute and K/V rotation to the hops
+    the window can reach.
     layout="zigzag" (causal only): each device holds global chunks
     (i, 2R−1−i) — see `zigzag_indices` — which balances causal work
     exactly. Opt-in because feeding contiguous data to the zigzag math
     would be silently wrong; `make_ring_attention` applies the permutation
-    for global arrays, data loaders should emit it directly.
+    for global arrays, data loaders should emit it directly. Window
+    masking is not expressible with static offsets in this interleaved
+    placement — windowed zigzag raises.
     """
     ring_size = jaxcompat.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown ring layout {layout!r}")
+    if window is not None:
+        if not causal:
+            raise ValueError("window (sliding-window) requires causal=True")
+        if layout == "zigzag":
+            raise ValueError(
+                "window is supported with layout='contiguous' only: zigzag "
+                "interleaves two global chunks per device, so a hop's "
+                "q↔kv offset isn't a single static kv_offset"
+            )
+    has_segs = segment_ids is not None
+    qseg = segment_ids
 
-    def flash(q_, k_, v_, *, causal):
+    def flash(q_, k_, v_, *, causal, window=None, kv_offset=0, qseg=None,
+              kseg=None):
         # Flash requires block | seq; shrink to the largest divisor so any
         # (even) local length works — the einsum ring this replaced had no
         # length constraint, and per-call lengths here include half-chunks.
         bq = fit_block(q_.shape[1], block_q)
         bk = fit_block(k_.shape[1], block_k)
         return flash_attention_lse(
-            q_, k_, v_, causal=causal, scale=scale, block_q=bq, block_k=bk
+            q_, k_, v_, causal=causal, scale=scale, block_q=bq, block_k=bk,
+            window=window, kv_offset=kv_offset,
+            segment_ids=qseg, kv_segment_ids=kseg,
         )
 
     if ring_size == 1:
-        o, _ = flash(q, k, v, causal=causal)
+        o, _ = flash(
+            q, k, v, causal=causal, window=window, qseg=qseg, kseg=qseg
+        )
         return o
 
     acc0 = jnp.zeros(q.shape, jnp.float32)
     lse0 = jnp.full((b, s_local, h), -jnp.inf, jnp.float32)
     perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
 
+    def rotate(x):
+        return lax.ppermute(x, axis_name, perm)
+
     if not causal:
         # Every step attends the full received chunk; layout is irrelevant.
         def step(carry, _):
-            k_cur, v_cur, acc, lse_run = carry
-            o_p, lse_p = flash(q, k_cur, v_cur, causal=False)
+            if has_segs:
+                k_cur, v_cur, kseg_cur, acc, lse_run = carry
+            else:
+                k_cur, v_cur, acc, lse_run = carry
+                kseg_cur = None
+            o_p, lse_p = flash(
+                q, k_cur, v_cur, causal=False, qseg=qseg, kseg=kseg_cur
+            )
             acc, lse_run = _merge(acc, lse_run, o_p, lse_p)
-            k_nxt = lax.ppermute(k_cur, axis_name, perm)
-            v_nxt = lax.ppermute(v_cur, axis_name, perm)
-            return (k_nxt, v_nxt, acc, lse_run), None
+            nxt = (rotate(k_cur), rotate(v_cur))
+            if has_segs:
+                nxt += (rotate(kseg_cur),)
+            return nxt + (acc, lse_run), None
 
-        (_, _, acc, lse_run), _ = lax.scan(
-            step, (k, v, acc0, lse0), None, length=ring_size
-        )
+        init = (k, v, qseg, acc0, lse0) if has_segs else (k, v, acc0, lse0)
+        carry, _ = lax.scan(step, init, None, length=ring_size)
+        acc = carry[-2]
+        return acc.astype(q.dtype)
+
+    if causal and window is not None:
+        # Sliding window, contiguous layout: hop s attends the kv chunk
+        # sitting s·L tokens behind — a static kv_offset, so each hop is a
+        # plain kernel call and the band machinery skips dead blocks
+        # inside it. Hops with s·L ≥ W + L − 1 can't reach the window for
+        # ANY row and are not emitted: K/V stop rotating after the last
+        # reachable hop (communication scales with W, not S).
+        hops = min(ring_size, (window + s_local - 2) // s_local + 1)
+        acc, lse_run = acc0, lse0
+        k_cur, v_cur, kseg_cur = k, v, qseg
+        for s_hop in range(hops):
+            if s_hop == 0:
+                o_p, lse_p = flash(
+                    q, k_cur, v_cur, causal=True, window=window,
+                    qseg=qseg, kseg=kseg_cur,
+                )
+                acc, lse_run = _merge(acc, lse_run, o_p, lse_p)
+            else:
+                def attend(acc_, lse_, k_=k_cur, v_=v_cur, kseg_=kseg_cur,
+                           off=s_hop * s_local):
+                    o_p, lse_p = flash(
+                        q, k_, v_, causal=True, window=window,
+                        kv_offset=off, qseg=qseg, kseg=kseg_,
+                    )
+                    return _merge(acc_, lse_, o_p, lse_p)
+
+                # Ranks with fewer than s_hop predecessors received a
+                # wrapped (future) chunk: skip it.
+                acc, lse_run = lax.cond(
+                    s_hop <= my_idx, attend, lambda a, l: (a, l),
+                    acc, lse_run,
+                )
+            if s_hop + 1 < hops:
+                k_cur, v_cur = rotate(k_cur), rotate(v_cur)
+                if has_segs:
+                    kseg_cur = rotate(kseg_cur)
         return acc.astype(q.dtype)
 
     if layout == "zigzag":
         if s_local % 2:
             raise ValueError("zigzag layout needs an even local sequence")
         c = s_local // 2
+        qseg1 = qseg[:, :c] if has_segs else None
+        qseg2 = qseg[:, c:] if has_segs else None
 
-        def diag(k_cur, v_cur, acc, lse_run):
+        def kseg_halves(kseg_cur):
+            if not has_segs:
+                return None, None
+            return kseg_cur[:, :c], kseg_cur[:, c:]
+
+        def diag(k_cur, v_cur, kseg_cur, acc, lse_run):
             # Own chunks (i, 2R−1−i): q1·k1 and q2·k2 are causal triangles,
             # q2·k1 is a full block (chunk 2R−1−i is strictly after chunk i).
             q1, q2 = q[:, :c], q[:, c:]
             k1, k2 = k_cur[:, :c], k_cur[:, c:]
             v1, v2 = v_cur[:, :c], v_cur[:, c:]
-            o11, l11 = flash(q1, k1, v1, causal=True)
-            o21, l21 = flash(q2, k1, v1, causal=False)
-            o22, l22 = flash(q2, k2, v2, causal=True)
+            kseg1, kseg2 = kseg_halves(kseg_cur)
+            o11, l11 = flash(q1, k1, v1, causal=True, qseg=qseg1, kseg=kseg1)
+            o21, l21 = flash(q2, k1, v1, causal=False, qseg=qseg2, kseg=kseg1)
+            o22, l22 = flash(q2, k2, v2, causal=True, qseg=qseg2, kseg=kseg2)
             acc1, lse1 = _merge(acc[:, :c], lse_run[:, :c], o11, l11)
             acc2, lse2 = _merge(acc[:, c:], lse_run[:, c:], o21, l21)
             acc2, lse2 = _merge(acc2, lse2, o22, l22)
@@ -180,17 +275,24 @@ def ring_attention(
                 jnp.concatenate([lse1, lse2], axis=1),
             )
 
-        def kv_before(k_cur, v_cur, acc, lse_run):
+        def kv_before(k_cur, v_cur, kseg_cur, acc, lse_run):
             # kv from rank j < i: its first chunk (j) precedes both of ours
             # → full attend; its second (2R−1−j) follows both → skip.
-            o_p, lse_p = flash(q, k_cur[:, :c], v_cur[:, :c], causal=False)
+            kseg1, _ = kseg_halves(kseg_cur)
+            o_p, lse_p = flash(
+                q, k_cur[:, :c], v_cur[:, :c], causal=False,
+                qseg=qseg, kseg=kseg1,
+            )
             return _merge(acc, lse_run, o_p, lse_p)
 
-        def kv_after(k_cur, v_cur, acc, lse_run):
+        def kv_after(k_cur, v_cur, kseg_cur, acc, lse_run):
             # kv from rank j > i: both its chunks precede our second chunk
             # (j < 2R−1−i and 2R−1−j < 2R−1−i) and follow our first → only
             # q2 attends, against the whole received kv.
-            o_p, lse_p = flash(q[:, c:], k_cur, v_cur, causal=False)
+            o_p, lse_p = flash(
+                q[:, c:], k_cur, v_cur, causal=False,
+                qseg=qseg2, kseg=kseg_cur if has_segs else None,
+            )
             acc2, lse2 = _merge(acc[:, c:], lse_run[:, c:], o_p, lse_p)
             return (
                 jnp.concatenate([acc[:, :c], acc2], axis=1),
@@ -200,52 +302,67 @@ def ring_attention(
         branches = (diag, kv_before, kv_after)
 
         def step(carry, step_idx):
-            k_cur, v_cur, acc, lse_run = carry
+            if has_segs:
+                k_cur, v_cur, kseg_cur, acc, lse_run = carry
+            else:
+                k_cur, v_cur, acc, lse_run = carry
+                kseg_cur = None
             kv_idx = (my_idx - step_idx) % ring_size
             case = jnp.where(kv_idx == my_idx, 0, jnp.where(kv_idx < my_idx, 1, 2))
-            acc, lse_run = lax.switch(case, branches, k_cur, v_cur, acc, lse_run)
-            k_nxt = lax.ppermute(k_cur, axis_name, perm)
-            v_nxt = lax.ppermute(v_cur, axis_name, perm)
-            return (k_nxt, v_nxt, acc, lse_run), None
+            acc, lse_run = lax.switch(
+                case, branches, k_cur, v_cur, kseg_cur, acc, lse_run
+            )
+            nxt = (rotate(k_cur), rotate(v_cur))
+            if has_segs:
+                nxt += (rotate(kseg_cur),)
+            return nxt + (acc, lse_run), None
 
-        (_, _, acc, lse_run), _ = lax.scan(
-            step, (k, v, acc0, lse0), jnp.arange(ring_size)
-        )
-        return acc.astype(q.dtype)
-
-    if layout != "contiguous":
-        raise ValueError(f"unknown ring layout {layout!r}")
+        init = (k, v, qseg, acc0, lse0) if has_segs else (k, v, acc0, lse0)
+        carry, _ = lax.scan(step, init, jnp.arange(ring_size))
+        return carry[-2].astype(q.dtype)
 
     # Contiguous causal: chunk j contributes fully when j < i, triangularly
     # when j == i, never when j > i (skipped — the pre-r2 code computed and
     # discarded those steps). Load stays imbalanced across ranks; prefer
     # zigzag when the data layout allows.
-    def c_diag(k_cur, v_cur, acc, lse_run):
-        o_p, lse_p = flash(q, k_cur, v_cur, causal=True)
+    def c_diag(k_cur, v_cur, kseg_cur, acc, lse_run):
+        o_p, lse_p = flash(
+            q, k_cur, v_cur, causal=True, qseg=qseg,
+            kseg=kseg_cur if has_segs else None,
+        )
         return _merge(acc, lse_run, o_p, lse_p)
 
-    def c_before(k_cur, v_cur, acc, lse_run):
-        o_p, lse_p = flash(q, k_cur, v_cur, causal=False)
+    def c_before(k_cur, v_cur, kseg_cur, acc, lse_run):
+        o_p, lse_p = flash(
+            q, k_cur, v_cur, causal=False, qseg=qseg,
+            kseg=kseg_cur if has_segs else None,
+        )
         return _merge(acc, lse_run, o_p, lse_p)
 
-    def c_skip(k_cur, v_cur, acc, lse_run):
+    def c_skip(k_cur, v_cur, kseg_cur, acc, lse_run):
         return acc, lse_run
 
     branches = (c_diag, c_before, c_skip)
 
     def step(carry, step_idx):
-        k_cur, v_cur, acc, lse_run = carry
+        if has_segs:
+            k_cur, v_cur, kseg_cur, acc, lse_run = carry
+        else:
+            k_cur, v_cur, acc, lse_run = carry
+            kseg_cur = None
         kv_idx = (my_idx - step_idx) % ring_size
         case = jnp.where(kv_idx == my_idx, 0, jnp.where(kv_idx < my_idx, 1, 2))
-        acc, lse_run = lax.switch(case, branches, k_cur, v_cur, acc, lse_run)
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, acc, lse_run), None
+        acc, lse_run = lax.switch(
+            case, branches, k_cur, v_cur, kseg_cur, acc, lse_run
+        )
+        nxt = (rotate(k_cur), rotate(v_cur))
+        if has_segs:
+            nxt += (rotate(kseg_cur),)
+        return nxt + (acc, lse_run), None
 
-    (_, _, acc, lse_run), _ = lax.scan(
-        step, (k, v, acc0, lse0), jnp.arange(ring_size)
-    )
-    return acc.astype(q.dtype)
+    init = (k, v, qseg, acc0, lse0) if has_segs else (k, v, acc0, lse0)
+    carry, _ = lax.scan(step, init, jnp.arange(ring_size))
+    return carry[-2].astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -262,23 +379,42 @@ def make_ring_attention(
     block_q: int = 512,
     block_k: int = 512,
     data_layout: str = "contiguous",
+    window: Optional[int] = None,
 ):
     """shard_map ring_attention over the mesh, on global [B, S, H, D] arrays.
 
-    With zigzag (default for causal) the global sequence is permuted into
-    zigzag device order before the shard_map and the output permuted back —
-    convenient for tests and ad-hoc use. Training input pipelines should
-    instead emit tokens in zigzag order (data/tokens.py `zigzag_ring`) and
-    keep the whole model in that order — pass data_layout="zigzag" and the
-    kernel runs with NO permute gathers (the contiguous wrapper pays one
-    each way at the jit boundary).
+    Returns a callable `(q, k, v, segment_ids=None) -> o`; `segment_ids`
+    is the global [B, S] id array for packed sequences.
+
+    With zigzag (default for causal, unless a window forces contiguous)
+    the global sequence is permuted into zigzag device order before the
+    shard_map and the output permuted back — convenient for tests and
+    ad-hoc use. Training input pipelines should instead emit tokens in
+    zigzag order (data/tokens.py `zigzag_ring`) and keep the whole model
+    in that order — pass data_layout="zigzag" and the kernel runs with NO
+    permute gathers (the contiguous wrapper pays one each way at the jit
+    boundary).
     """
     if zigzag is None:
-        zigzag = causal
+        # Zigzag balances causal work, but window masking needs the
+        # contiguous placement's static offsets.
+        zigzag = causal and window is None
     ring = mesh.shape.get(seq_axis, 1)
     spec = P(batch_axes, seq_axis, heads_axis, None)
+    seg_spec = P(batch_axes, seq_axis)
 
-    def mapped(layout):
+    _mapped_cache = {}
+
+    def mapped(layout, with_segs):
+        # Built once per (layout, with_segs) for the RETURNED callable, so
+        # a caller that holds it (tests, a captured closure) reuses one
+        # shard_map object across eager invocations. The models/attention
+        # dispatcher constructs a fresh make_ring_attention per call — its
+        # real path runs under the caller's jit, where tracing happens
+        # once at that boundary regardless.
+        key = (layout, with_segs)
+        if key in _mapped_cache:
+            return _mapped_cache[key]
         fn = functools.partial(
             ring_attention,
             axis_name=seq_axis,
@@ -286,11 +422,29 @@ def make_ring_attention(
             block_q=block_q,
             block_k=block_k,
             layout=layout,
+            window=window,
         )
-        return shard_map(
-            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False,
-        )
+        if with_segs:
+            def with_seg_fn(q, k, v, seg):
+                return fn(q, k, v, segment_ids=seg)
+
+            out = shard_map(
+                with_seg_fn, mesh=mesh,
+                in_specs=(spec, spec, spec, seg_spec), out_specs=spec,
+                check_vma=False,
+            )
+        else:
+            out = shard_map(
+                fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False,
+            )
+        _mapped_cache[key] = out
+        return out
+
+    def call(layout, q, k, v, segment_ids=None):
+        if segment_ids is not None:
+            return mapped(layout, True)(q, k, v, segment_ids)
+        return mapped(layout, False)(q, k, v)
 
     if data_layout == "zigzag":
         # The caller's arrays are ALREADY in zigzag device order (native
@@ -300,34 +454,71 @@ def make_ring_attention(
                 "data_layout='zigzag' needs causal attention and a sharded "
                 f"context axis (ring={ring})"
             )
-        return mapped("zigzag")
+        if window is not None:
+            raise ValueError(
+                "window needs the contiguous ring layout (static per-hop "
+                "offsets); emit contiguous data or drop the window"
+            )
+        return functools.partial(call, "zigzag")
 
     if not (zigzag and causal and ring > 1):
-        return mapped("contiguous")
+        return functools.partial(call, "contiguous")
 
-    def wrapper(q, k, v):
+    def wrapper(q, k, v, segment_ids=None):
         s = q.shape[1]
         if s % (2 * ring):
             # Sequence won't split into 2R chunks — contiguous ring still
             # computes the exact result, just with imbalanced causal work.
-            return mapped("contiguous")(q, k, v)
+            return call("contiguous", q, k, v, segment_ids)
         perm = zigzag_indices(s, ring)
         inv = inverse_permutation(perm)
         qz, kz, vz = (jnp.take(x, perm, axis=1) for x in (q, k, v))
-        out = mapped("zigzag")(qz, kz, vz)
+        segz = (
+            None if segment_ids is None
+            else jnp.take(segment_ids, perm, axis=1)
+        )
+        out = call("zigzag", qz, kz, vz, segz)
         return jnp.take(out, inv, axis=1)
 
     return wrapper
 
 
-def reference_attention(q, k, v, *, causal: bool = True, scale=None):
-    """Unsharded reference for tests: plain softmax attention."""
+def reference_attention(q, k, v, *, causal: bool = True, scale=None,
+                        window: Optional[int] = None,
+                        segment_ids: Optional[jax.Array] = None):
+    """Unsharded reference for tests (and the dense dispatch path): plain
+    softmax attention with the same band/segment mask model as the flash
+    kernel. `segment_ids`: [B, S] ids, attention only within equal ids."""
+    if window is not None and not causal:
+        # Same contract as the flash kernels: without causality the band
+        # would still admit every FUTURE key, which is not a "window" in
+        # any useful sense — better the same ValueError on every backend
+        # than a CPU-only silent semantic.
+        raise ValueError("window (sliding-window) requires causal=True")
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s_q, s_k = scores.shape[-2:]
+    mask = None
     if causal:
-        s_q, s_k = scores.shape[-2:]
-        mask = jnp.tril(jnp.ones((s_q, s_k), bool))
-        scores = jnp.where(mask[None, None], scores, -jnp.inf)
-    p = jax.nn.softmax(scores, axis=-1)
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool))[None, None]
+    if window is not None:
+        wm = (
+            jnp.arange(s_q)[:, None] - jnp.arange(s_k)[None, :] < window
+        )[None, None]
+        mask = wm if mask is None else mask & wm
+    if segment_ids is not None:
+        sm = (segment_ids[:, :, None] == segment_ids[:, None, :])[:, None]
+        mask = sm if mask is None else mask & sm
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+        p = jax.nn.softmax(scores, axis=-1)
+        # Rows with no live key (a segment matching nothing) softmax
+        # all-(-inf) to NaN; they are defined as zero output (the kernel's
+        # l == 0 guard). Scrub ONLY those rows — a blanket NaN scrub would
+        # swallow genuine numerical divergence on this production path.
+        empty = jnp.logical_not(jnp.any(mask, axis=-1, keepdims=True))
+        p = jnp.where(empty, 0.0, p)
+    else:
+        p = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)

@@ -27,7 +27,7 @@ import logging
 import os
 import tempfile
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -109,6 +109,14 @@ class Trainer:
         # key -> (source array identity, placed device array): see
         # _put_batch's replicated-key caching.
         self._replicated_cache: Dict[str, Any] = {}
+        # _put_batch host-overhead caches, filled on first batch:
+        # NamedSharding construction walks the mesh and P() every call,
+        # and the steady-state step loop calls _put_batch per key per
+        # step — pure python overhead on the hot path. The mesh and the
+        # trial's replicated-key contract never change after __init__, so
+        # both resolve once and every later batch is dict/set lookups.
+        self._batch_shardings: Optional[Tuple[Any, Any]] = None
+        self._replicated_keys: Optional[frozenset] = None
 
         # Observability (chief-only): system/device metrics to the master
         # (ref ProfilerAgent) + tfevents scalars for TensorBoard.
@@ -231,15 +239,27 @@ class Trainer:
 
     # -- data placement ----------------------------------------------------
     def _put_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:
-        sharding = NamedSharding(self.mesh, P(batch_axes()))
-        replicated = NamedSharding(self.mesh, P())
+        # Shardings are resolved ONCE and reused across steps: building a
+        # NamedSharding per key per step was measurable python overhead on
+        # the steady-state loop, and both inputs (the mesh, the trial's
+        # replicated-key contract) are fixed after __init__.
+        if self._batch_shardings is None:
+            self._batch_shardings = (
+                NamedSharding(self.mesh, P(batch_axes())),
+                NamedSharding(self.mesh, P()),
+            )
+        sharding, replicated = self._batch_shardings
         # Replication is a property of the TRIAL's batch contract, not the
         # trainer: trials declare which keys have no batch dim (default:
         # "positions", the zigzag layout's [S] position map — sharding it
         # over data axes would mis-inflate its global shape multi-host).
-        replicated_keys = getattr(
-            self.trial, "replicated_batch_keys", frozenset({"positions"})
-        )
+        # Read ONCE, like the shardings: the contract is fixed for the
+        # trial's lifetime.
+        if self._replicated_keys is None:
+            self._replicated_keys = frozenset(getattr(
+                self.trial, "replicated_batch_keys", frozenset({"positions"})
+            ))
+        replicated_keys = self._replicated_keys
 
         def put_with_key(key, x):
             if key in replicated_keys:

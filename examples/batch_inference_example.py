@@ -49,15 +49,17 @@ class PerplexityProcessor(batch_inference.BatchProcessor):
         except Exception:  # noqa: BLE001 - no checkpoint configured
             pass
         self.loss = jax.jit(
-            lambda p, toks: self.model.loss(
-                p, {"tokens": toks}, jax.random.PRNGKey(0)
-            )[0]
+            lambda p, b: self.model.loss(p, b, jax.random.PRNGKey(0))[0]
         )
         self.rows = []
 
     def process_batch(self, batch, idx: int) -> None:
-        tokens = jnp.asarray(batch, jnp.int32)
-        nll = float(self.loss(self.params, tokens))
+        # Packed batches (batch_inference.pack_sequences): segment_ids
+        # keep the docs attention-isolated inside each row, loss_mask
+        # drops the padding, and GPT.loss masks the doc boundaries — one
+        # forward scores many variable-length docs.
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        nll = float(self.loss(self.params, batch))
         self.rows.append({"batch": idx, "ppl": float(np.exp(nll))})
 
     def on_sync(self, batches_done: int) -> None:
@@ -76,7 +78,14 @@ class PerplexityProcessor(batch_inference.BatchProcessor):
 
 def main() -> None:
     rng = np.random.default_rng(0)
-    dataset = [rng.integers(0, 512, (4, 128)) for _ in range(64)]
+    # Variable-length documents, packed into fixed [4, 128] batches with
+    # segment-id isolation instead of one-doc-per-row padding waste.
+    docs = [
+        rng.integers(0, 512, rng.integers(16, 128)) for _ in range(256)
+    ]
+    dataset = list(
+        batch_inference.pack_sequences(docs, seq_len=128, batch_size=4)
+    )
     n = batch_inference.run_batch_inference(
         PerplexityProcessor(), dataset, sync_every=16,
         total_batches=len(dataset), pass_name="ppl-sweep",

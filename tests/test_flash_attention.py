@@ -211,3 +211,250 @@ def test_flash_pallas_monolithic_causal_s256_matches():
             np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-5,
             err_msg=name,
         )
+
+
+# ---------------------------------------------------------------------------
+# Band (window/kv_offset) + segment masking parity
+# ---------------------------------------------------------------------------
+def _packed_segments(key, b, s, n_docs):
+    """[B, S] int32 ids: contiguous runs 1..n_docs with random boundaries
+    (deterministic per key), mimicking pack_sequences output."""
+    lens = np.asarray(
+        jax.random.dirichlet(key, jnp.ones(n_docs) * 2.0, (b,)) * s
+    ).astype(int)
+    ids = np.zeros((b, s), np.int32)
+    for r in range(b):
+        pos = 0
+        for d in range(n_docs):
+            n = max(1, int(lens[r, d])) if d < n_docs - 1 else s - pos
+            ids[r, pos: pos + max(0, n)] = d + 1
+            pos = min(s, pos + n)
+            if pos >= s:
+                break
+        ids[r, pos:] = n_docs  # tail joins the last doc
+    return jnp.asarray(ids)
+
+
+def _masked_parity_case(s, block, causal, window, with_segs, *, b=2, h=2,
+                        d=16, check_grads=True):
+    """One parity case: public flash_attention (CPU blockwise path) AND the
+    Pallas kernels in interpret mode vs the dense reference — forward,
+    lse, and input grads."""
+    from determined_tpu.ops.flash_attention import (
+        _flash_bwd_pallas,
+        _flash_fwd_pallas,
+        _blockwise_fwd_ref,
+        fit_block,
+        flash_attention_lse,
+    )
+
+    q, k, v = _rand_qkv(jax.random.PRNGKey(s * 7 + block), b, s, h, d)
+    seg = (
+        _packed_segments(jax.random.PRNGKey(s + 3), b, s, 3)
+        if with_segs else None
+    )
+    # Ragged seq % block != 0 degrades via fit_block (the dispatcher's
+    # contract); the kernel itself requires block | seq.
+    bf = fit_block(s, block)
+
+    def flash_fn(q, k, v):
+        o, lse = flash_attention_lse(
+            q, k, v, causal=causal, window=window, segment_ids=seg,
+            block_q=bf, block_k=bf,
+        )
+        return o, lse
+
+    got, lse = jax.jit(flash_fn)(q, k, v)
+    want = reference_attention(
+        q, k, v, causal=causal, window=window, segment_ids=seg
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+    # The Pallas kernels (interpret mode) against the same oracle: fold to
+    # [BH, S, D] and drive fwd directly; bwd vs the blockwise reference.
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    segs = None
+    if seg is not None:
+        segf = jnp.broadcast_to(
+            seg[:, None, :].astype(jnp.float32), (b, h, s)
+        ).reshape(b * h, s)
+        segs = (segf, segf)
+    scale = 1.0 / d ** 0.5
+    o_pl, lse_pl = _flash_fwd_pallas(
+        qf, kf, vf, scale=scale, causal=causal, window=window, segs=segs,
+        block_q=bf, block_k=bf, interpret=True,
+    )
+    wf = want.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    np.testing.assert_allclose(
+        np.asarray(o_pl), np.asarray(wf), atol=2e-5, rtol=2e-5
+    )
+    lse_w = lse.transpose(0, 2, 1).reshape(b * h, s)
+    np.testing.assert_allclose(
+        np.asarray(lse_pl), np.asarray(lse_w), atol=2e-5, rtol=2e-5
+    )
+
+    if not check_grads:
+        return
+
+    def loss_flash(q, k, v):
+        o, lse = flash_fn(q, k, v)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        o = reference_attention(
+            q, k, v, causal=causal, window=window, segment_ids=seg
+        )
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    g_flash = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+    for name, a, b_ in zip("qkv", g_flash, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-5,
+            err_msg=f"d{name}",
+        )
+
+    # Pallas backward kernels in interpret mode vs the blockwise backward.
+    o_ref, lse_ref2 = _blockwise_fwd_ref(
+        qf, kf, vf, scale=scale, causal=causal, window=window, segs=segs,
+        block_k=bf,
+    )
+    do = jax.random.normal(jax.random.PRNGKey(9), qf.shape)
+    dlse = jax.random.normal(jax.random.PRNGKey(10), lse_ref2.shape)
+    from determined_tpu.ops.flash_attention import _blockwise_bwd_ref
+
+    want_g = _blockwise_bwd_ref(
+        qf, kf, vf, o_ref, lse_ref2, do, scale=scale, causal=causal,
+        window=window, segs=segs, block_k=bf, dlse=dlse,
+    )
+    got_g = _flash_bwd_pallas(
+        qf, kf, vf, o_ref, lse_ref2, do, scale=scale, causal=causal,
+        window=window, segs=segs, block_q=bf, block_k=bf, interpret=True,
+        dlse=dlse,
+    )
+    for name, a, b_ in zip(("dq", "dk", "dv"), got_g, want_g):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-5,
+            err_msg=name,
+        )
+
+
+@pytest.mark.parametrize("window", [1, 17, 64])
+def test_flash_window_matches_dense(window):
+    """Tier-1: sliding-window causal — CPU path, Pallas interpret, grads."""
+    _masked_parity_case(64, 16, causal=True, window=window, with_segs=False)
+
+
+def test_flash_segments_match_dense():
+    """Tier-1: packed-sequence segment masking, causal."""
+    _masked_parity_case(64, 16, causal=True, window=None, with_segs=True)
+
+
+def test_flash_window_plus_segments_match_dense():
+    """Tier-1: window AND segments composed."""
+    _masked_parity_case(64, 16, causal=True, window=23, with_segs=True)
+
+
+def test_flash_segments_noncausal_matches_dense():
+    _masked_parity_case(64, 16, causal=False, window=None, with_segs=True)
+
+
+def test_flash_ragged_fit_block_window():
+    """Tier-1: seq % wanted-block != 0 — fit_block degrades the tile and
+    the masked kernels stay correct."""
+    _masked_parity_case(96, 64, causal=True, window=31, with_segs=True)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("window", [None, 1, 9, 33, 128])
+@pytest.mark.parametrize("with_segs", [False, True])
+@pytest.mark.parametrize("s,block", [(64, 16), (128, 64), (96, 32), (80, 32)])
+def test_flash_masked_parity_sweep(causal, window, with_segs, s, block):
+    """Full parity sweep (slow): causal × window × segments × ragged."""
+    if window is not None and not causal:
+        pytest.skip("window requires causal")
+    _masked_parity_case(s, block, causal=causal, window=window,
+                        with_segs=with_segs)
+
+
+def test_flash_kv_offset_decode_layout():
+    """causal + kv_offset: a short q block bottom-aligned against a longer
+    k (the decode/kv-cache geometry, and ring attention's hop geometry)."""
+    from determined_tpu.ops.flash_attention import (
+        _flash_fwd_pallas,
+        flash_attention,
+    )
+
+    b, s_k, h, d = 2, 64, 2, 16
+    s_q, off = 16, 48
+    q, k, v = _rand_qkv(jax.random.PRNGKey(3), b, s_k, h, d)
+    q1 = q[:, :s_q]
+    got = flash_attention(
+        q1, k, v, causal=True, kv_offset=off, block_q=16, block_k=16
+    )
+    scale = 1.0 / d ** 0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q1, k) * scale
+    mask = (jnp.arange(s_q)[:, None] + off) >= jnp.arange(s_k)[None, :]
+    scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    want = jnp.einsum(
+        "bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1), v
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+    # Pallas interpret path too (different kernel from the CPU blockwise).
+    qf = q1.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s_k, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s_k, d)
+    o_pl, _ = _flash_fwd_pallas(
+        qf, kf, vf, scale=scale, causal=True, kv_offset=off,
+        block_q=16, block_k=16, interpret=True,
+    )
+    wf = want.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
+    np.testing.assert_allclose(
+        np.asarray(o_pl), np.asarray(wf), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_flash_window_validation():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0), 1, 64, 1, 8)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, causal=False, window=8)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, window=0)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, kv_offset=-1)
+
+
+def test_block_skip_stats_counts():
+    """The bench-reporting mirror matches a brute-force element mask: a
+    block is live iff it contains at least one unmasked element."""
+    from determined_tpu.ops.flash_attention import block_skip_stats
+
+    for s, bq, bk, window, off in [
+        (64, 16, 16, None, 0),
+        (64, 16, 32, 20, 0),
+        (128, 32, 32, 48, 0),
+        (64, 16, 16, None, 64),
+        (96, 32, 32, 7, 0),
+    ]:
+        rows = np.arange(s)[:, None] + off
+        cols = np.arange(s)[None, :]
+        m = rows >= cols
+        if window is not None:
+            m &= rows - cols < window
+        nq, nk = s // bq, s // bk
+        brute = sum(
+            bool(m[i * bq: (i + 1) * bq, j * bk: (j + 1) * bk].any())
+            for i in range(nq) for j in range(nk)
+        )
+        live, total = block_skip_stats(
+            s, s, bq, bk, causal=True, window=window, kv_offset=off
+        )
+        assert total == nq * nk
+        assert live == brute, (s, bq, bk, window, off, live, brute)

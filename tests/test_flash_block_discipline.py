@@ -1,0 +1,134 @@
+"""Lint gate: no attention call site hard-codes flash block constants.
+
+Flash tile sizes are owned by `fit_block` + the autotuner
+(ops/flash_autotune.py) fed from config (GPTConfig.flash_block_q/k or a
+caller's tuned values). A call like `flash_attention(..., block_q=512)`
+with a NUMERIC LITERAL freezes a tile that was measured on one device
+generation into code that runs on all of them — exactly the
+one-size-fits-all constant the autotuner exists to replace — so this test
+fails the build on any new one.
+
+What counts as a violation: inside `determined_tpu/`, a call to any of
+the attention entry points (`flash_attention`, `flash_attention_lse`,
+`ring_attention`, `make_ring_attention`, `attention`) passing `block_q=`
+or `block_k=` as a numeric literal. Defaults in function SIGNATURES are
+fine (they are the documented neutral fallback, still fitted at the call
+site); variables, attributes and `fit_block(...)` results pass by
+construction. Tests are not scanned. A deliberate exception carries a
+trailing `# flash-block-ok: <reason>` comment on the call's first line.
+"""
+import ast
+import os
+
+PKG_ROOT = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                        "determined_tpu")
+
+ATTENTION_CALLEES = {
+    "flash_attention",
+    "flash_attention_lse",
+    "ring_attention",
+    "make_ring_attention",
+    "attention",
+}
+
+WAIVER = "# flash-block-ok:"
+
+
+def _callee_name(call: ast.Call):
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _is_literal_number(node) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float)) and not isinstance(
+            node.value, bool
+        )
+    # -512 parses as UnaryOp(USub, Constant)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.operand, ast.Constant):
+        return isinstance(node.operand.value, (int, float))
+    return False
+
+
+def _violations_in_file(path: str):
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=path)
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _callee_name(node) not in ATTENTION_CALLEES:
+            continue
+        for kw in node.keywords:
+            if kw.arg in ("block_q", "block_k") and _is_literal_number(
+                kw.value
+            ):
+                line = lines[node.lineno - 1]
+                if WAIVER in line:
+                    continue
+                out.append(
+                    f"{path}:{node.lineno}: {line.strip()}"
+                )
+                break
+    return out
+
+
+def _py_files():
+    for dirpath, _, filenames in os.walk(PKG_ROOT):
+        for name in filenames:
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def test_no_hardcoded_flash_blocks():
+    violations = []
+    for path in _py_files():
+        violations.extend(_violations_in_file(path))
+    assert not violations, (
+        "attention call sites with literal block_q/block_k found — route "
+        "tile sizes through config + fit_block (or the autotuner, "
+        "ops/flash_autotune.py), or annotate a deliberate exception with "
+        f"'{WAIVER} <reason>':\n" + "\n".join(violations)
+    )
+
+
+def test_lint_actually_detects_a_violation(tmp_path):
+    """The linter itself must not rot: a literal-block call is flagged;
+    config-fed, fit_block-fed and waived calls are not."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def f(q, k, v):\n"
+        "    return flash_attention(q, k, v, block_q=512, block_k=512)\n"
+    )
+    assert len(_violations_in_file(str(bad))) == 1
+
+    good = tmp_path / "good.py"
+    good.write_text(
+        "def f(q, k, v, cfg):\n"
+        "    bq = fit_block(q.shape[1], cfg.flash_block_q)\n"
+        "    return flash_attention(q, k, v, block_q=bq,\n"
+        "                           block_k=cfg.flash_block_k)\n"
+    )
+    assert _violations_in_file(str(good)) == []
+
+    # signature defaults are not calls — must pass
+    sig = tmp_path / "sig.py"
+    sig.write_text(
+        "def attention(q, k, v, block_q=512, block_k=512):\n"
+        "    return q\n"
+    )
+    assert _violations_in_file(str(sig)) == []
+
+    waived = tmp_path / "waived.py"
+    waived.write_text(
+        "def f(q, k, v):\n"
+        "    return flash_attention(  # flash-block-ok: probe harness\n"
+        "        q, k, v, block_q=256, block_k=256)\n"
+    )
+    assert _violations_in_file(str(waived)) == []
