@@ -131,28 +131,51 @@ def asha_trials_per_hour(n_trials: int = 8):
 
 
 def _measure_mfu(config, batch_size: int, inner: int, rounds: int, dev,
-                 tx=None):
-    """MFU + tok/s of the standard jitted train step for one config."""
+                 tx=None, guard: bool = False):
+    """MFU + tok/s of the standard jitted train step for one config.
+
+    guard=True folds in the training health sentinel's in-graph pieces
+    (finiteness guard + consecutive-skip counter, exactly as
+    trainer/_trainer.py builds them) — ONE timing harness measures both,
+    so the plain-vs-guarded delta is methodology-proof. The guarded
+    variant additionally runs a 4-step drill with 3 injected-NaN batches
+    (proving the guard is live in the measured program) and returns
+    (mfu, tokens_per_sec, drill_skips) instead of (mfu, tokens_per_sec).
+    """
     model = GPT(config)
     if tx is None:
         tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(3e-4))
+    if guard:
+        from determined_tpu.trainer._sentinel import guarded_update
+        from determined_tpu.trainer._trainer import optax_global_norm
 
     @jax.jit
     def init_fn(rng):
         params = model.init(rng)
-        return {"params": params, "opt": tx.init(params)}
+        state = {"params": params, "opt": tx.init(params)}
+        if guard:
+            state["step"] = jnp.zeros((), jnp.int32)
+        return state
 
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def train_step(state, tokens):
+    def train_step(state, tokens, poison=None, skips=None):
         def loss_fn(p):
-            return model.loss(p, {"tokens": tokens}, jax.random.PRNGKey(0))[0]
+            loss = model.loss(p, {"tokens": tokens}, jax.random.PRNGKey(0))[0]
+            return loss * poison if guard else loss
 
         loss, grads = jax.value_and_grad(loss_fn)(state["params"])
         updates, opt = tx.update(grads, state["opt"], state["params"])
-        return {
+        new_state = {
             "params": optax.apply_updates(state["params"], updates),
             "opt": opt,
-        }, loss
+        }
+        if not guard:
+            return new_state, loss, None, None
+        new_state["step"] = state["step"] + 1
+        new_state, ok, skips_out = guarded_update(
+            state, new_state, loss, optax_global_norm(grads), skips
+        )
+        return new_state, loss, ok, skips_out
 
     state = init_fn(jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
@@ -160,22 +183,87 @@ def _measure_mfu(config, batch_size: int, inner: int, rounds: int, dev,
         rng.integers(0, config.vocab_size, (batch_size, config.seq_len)),
         jnp.int32,
     )
+    one = np.float32(1.0)
+    skips = jnp.zeros((), jnp.int32) if guard else None
     # Sync via a scalar fetch, not block_until_ready — on tunneled/remote
     # backends only a host transfer actually drains the device queue.
-    state, loss = train_step(state, tokens)  # warmup + compile
+    state, loss, _, skips = train_step(state, tokens, one, skips)  # warmup
     float(jax.device_get(loss))
 
     best_dt = float("inf")
     for _ in range(rounds):
         t0 = time.perf_counter()
         for _ in range(inner):
-            state, loss = train_step(state, tokens)
+            state, loss, _, skips = train_step(state, tokens, one, skips)
         float(jax.device_get(loss))
         best_dt = min(best_dt, time.perf_counter() - t0)
 
     tokens_per_sec = batch_size * config.seq_len * inner / best_dt
     mfu = tokens_per_sec * config.train_flops_per_token() / peak_flops(dev)
-    return mfu, tokens_per_sec
+    if not guard:
+        return mfu, tokens_per_sec
+    # Liveness drill: nan, nan, healthy, nan — the guard must skip 3.
+    skipped = 0
+    for poison in (np.float32(np.nan), np.float32(np.nan), one,
+                   np.float32(np.nan)):
+        state, _, ok, skips = train_step(state, tokens, poison, skips)
+        skipped += int(not bool(jax.device_get(ok)))
+    return mfu, tokens_per_sec, skipped
+
+
+def _sentinel_drill():
+    """End-to-end rollback-and-skip drill on CPU-sized shapes through the
+    REAL Trainer: checkpoint, inject 2 consecutive NaN batches
+    (train.nonfinite fault site), hit max_consecutive_skips, roll back to
+    the verified checkpoint and fast-forward the data stream. Returns
+    (steps_skipped, rollbacks) — the robustness-tax counters the perf
+    trajectory records — or None."""
+    try:
+        import tempfile
+
+        from determined_tpu import core as core_mod
+        from determined_tpu.common.faults import (
+            FaultPlan,
+            FaultSpec,
+            plan_active,
+        )
+        from determined_tpu.models import MnistMLP
+        from determined_tpu.models.vision import MLPConfig
+        from determined_tpu.trainer import Batch, JAXTrial, Trainer
+
+        class _DrillTrial(JAXTrial):
+            def build_model(self, mesh):
+                return MnistMLP(
+                    MLPConfig(in_dim=8, hidden=16, n_classes=4), mesh=mesh
+                )
+
+            def build_optimizer(self):
+                return optax.adam(1e-2)
+
+            def build_training_data(self):
+                rng = np.random.default_rng(0)
+                while True:
+                    yield {
+                        "image": rng.normal(size=(16, 8)).astype(np.float32),
+                        "label": (np.arange(16) % 4).astype(np.int32),
+                    }
+
+        with tempfile.TemporaryDirectory() as tmp:
+            ctx = core_mod._context._dummy_init(checkpoint_storage=tmp)
+            trainer = Trainer(
+                _DrillTrial(), ctx, health={"max_consecutive_skips": 2}
+            )
+            trainer.fit(max_length=Batch(3), report_period=Batch(1))
+            trainer._save_checkpoint(sync=True)
+            plan = FaultPlan({"train.nonfinite": FaultSpec(failures=2)})
+            with plan_active(plan):
+                trainer.fit(max_length=Batch(8), report_period=Batch(1))
+            return trainer.steps_skipped, trainer.rollbacks
+    except Exception:  # noqa: BLE001 — skip the rung, keep the headline
+        import traceback
+
+        traceback.print_exc()
+        return None
 
 
 def long_ctx_mfu_at(dev, seq_len: int, inner: int, rounds: int,
@@ -398,6 +486,27 @@ def main() -> None:
                 record["long_ctx_32k_skip_ratio"] = round(
                     live32 / total32, 4
                 )
+    if not os.environ.get("DTPU_BENCH_SKIP_SENTINEL"):
+        # Robustness tax of the training health sentinel: the guarded
+        # step's MFU delta (acceptance: < 1%) plus the drill counters, so
+        # the perf trajectory records what the safety costs.
+        try:
+            sent_mfu, _, guard_skips = _measure_mfu(
+                config, batch_size, inner, rounds, dev, guard=True
+            )
+        except Exception:  # noqa: BLE001 — skip the rung, keep the headline
+            import traceback
+
+            traceback.print_exc()
+        else:
+            record["sentinel_mfu"] = round(100.0 * sent_mfu, 2)
+            record["sentinel_overhead_pct"] = round(
+                100.0 * (1.0 - sent_mfu / mfu), 2
+            ) if mfu > 0 else 0.0
+            record["sentinel_guard_drill_skips"] = guard_skips
+        drill = _sentinel_drill()
+        if drill is not None:
+            record["steps_skipped"], record["rollbacks"] = drill
     if not os.environ.get("DTPU_BENCH_SKIP_NEOX"):
         neox_mfu, neox_layers = neox_class_mfu(dev, on_tpu)
         if neox_mfu is not None:

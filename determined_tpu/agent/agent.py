@@ -651,7 +651,7 @@ class AgentDaemon:
                 or (task.start_time is not None and stat[0] != task.start_time)
             ):
                 return None  # gone; shim's exit file may hold the code
-            time.sleep(0.3)
+            time.sleep(0.3)  # resilience-ok: /proc poll; non-child pids have no waitable handle
         return None
 
     def _report_exit(self, task: _Task, code: Optional[int]) -> None:
@@ -691,12 +691,15 @@ class AgentDaemon:
             return
         deadline = time.time() + grace_s
         while time.time() < deadline:
-            if task.done.is_set():
+            # done.wait doubles as the poll interval AND wakes early the
+            # moment the waiter thread reaps the exit (condition-driven,
+            # not a bare sleep poll); _proc_stat still covers re-adopted
+            # non-child pids the waiter can't reap.
+            if task.done.wait(0.2):
                 return
             stat = _proc_stat(task.pid)
             if stat is None or stat[1] == "Z":
                 return
-            time.sleep(0.2)
         try:
             os.killpg(pgid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):

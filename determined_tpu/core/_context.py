@@ -178,7 +178,11 @@ def init(
 
     return Context(
         distributed=distributed,
-        train=TrainContext(session, trial_id, run_id),
+        train=TrainContext(
+            session, trial_id, run_id,
+            allocation_id=info.allocation_id,
+            rank=distributed.rank,
+        ),
         checkpoint=CheckpointContext(
             distributed,
             storage,

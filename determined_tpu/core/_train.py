@@ -17,10 +17,20 @@ logger = logging.getLogger("determined_tpu.core")
 
 
 class TrainContext:
-    def __init__(self, session: Session, trial_id: int, run_id: int = 0) -> None:
+    def __init__(
+        self,
+        session: Session,
+        trial_id: int,
+        run_id: int = 0,
+        allocation_id: str = "",
+        rank: int = 0,
+    ) -> None:
         self._session = session
         self._trial_id = trial_id
         self._run_id = run_id
+        self._allocation_id = allocation_id
+        self._rank = rank
+        self._heartbeat_warned = False
 
     def _report(self, group: str, steps_completed: int, metrics: Dict[str, Any]) -> None:
         self._session.post(
@@ -49,6 +59,31 @@ class TrainContext:
             json_body={"progress": float(progress)},
         )
 
+    def heartbeat_step(self, steps_completed: int) -> None:
+        """Gang-progress beat: EVERY rank posts its last-completed step to
+        the allocation (→ master stall watchdog, which kills a gang whose
+        counter stops advancing within `health.stall_timeout_s`). Advisory
+        by design — a failed beat must never crash the step loop; the
+        watchdog tolerates gaps up to its timeout."""
+        if not self._allocation_id:
+            return
+        try:
+            self._session.post(
+                f"/api/v1/allocations/{self._allocation_id}/progress",
+                json_body={
+                    "rank": int(self._rank),
+                    "step": int(steps_completed),
+                },
+            )
+            self._heartbeat_warned = False
+        except Exception as e:  # noqa: BLE001 — advisory beat, never fatal
+            if not self._heartbeat_warned:
+                self._heartbeat_warned = True
+                logger.warning(
+                    "progress heartbeat failed at step %d: %s (suppressing "
+                    "until one succeeds)", steps_completed, e,
+                )
+
     def set_status(self, status: str) -> None:
         self._session.post(
             f"/api/v1/trials/{self._trial_id}/status", json_body={"status": status}
@@ -64,6 +99,7 @@ class DummyTrainContext(TrainContext):
 
     def __init__(self) -> None:  # noqa: super not called on purpose
         self._reported: list = []
+        self._heartbeats: list = []
 
     def _report(self, group: str, steps_completed: int, metrics: Dict[str, Any]) -> None:
         self._reported.append((group, steps_completed, metrics))
@@ -71,6 +107,9 @@ class DummyTrainContext(TrainContext):
 
     def report_progress(self, progress: float) -> None:
         logger.info("[dummy] progress: %.3f", progress)
+
+    def heartbeat_step(self, steps_completed: int) -> None:
+        self._heartbeats.append(int(steps_completed))
 
     def set_status(self, status: str) -> None:
         logger.info("[dummy] status: %s", status)

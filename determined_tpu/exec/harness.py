@@ -97,6 +97,7 @@ def run(entrypoint: str) -> int:
                 smaller_is_better=bool(scfg.get("smaller_is_better", True)),
                 profiling=bool(cfg.get("profiling", {}).get("enabled", False)),
                 tensorboard_dir=tb_dir,
+                health=cfg.get("health"),
             )
             trainer.fit(
                 validation_period=parse_unit(cfg.get("min_validation_period")),

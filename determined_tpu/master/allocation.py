@@ -38,6 +38,15 @@ class Allocation:
     # allgather (keyed by round counter so reuse is safe)
     ag_data: Dict[int, Dict[int, Any]] = dataclasses.field(default_factory=dict)
     ag_round: int = 0
+    # gang progress beats (stall watchdog): rank -> {"step", "time"}.
+    # `progress_advanced_at` moves when any rank's step CHANGES (forward
+    # progress — or a sentinel rollback's legitimate regression); a gang
+    # stuck in a collective cannot reach a report boundary and stops
+    # beating entirely, and the watchdog measures exactly that.
+    progress: Dict[int, Dict[str, float]] = dataclasses.field(default_factory=dict)
+    progress_max_step: int = -1
+    progress_advanced_at: Optional[float] = None
+    progress_last_beat: Optional[float] = None
     # exit
     exit_code: Optional[int] = None
     exit_reason: Optional[str] = None
@@ -124,6 +133,45 @@ class AllocationService:
                 if remaining is not None and remaining <= 0:
                     return None
                 self._cond.wait(timeout=remaining)
+
+    # -- gang progress (stall watchdog feed) -----------------------------------
+    def record_progress(self, alloc_id: str, rank: int, step: int) -> None:
+        """One rank's last-completed-step beat (harness report boundary).
+        Unknown allocations are dropped silently — a beat racing its own
+        allocation's teardown is normal during preemption/kill."""
+        now = time.time()
+        with self._cond:
+            alloc = self._allocs.get(alloc_id)
+            if alloc is None or alloc.state == TERMINATED:
+                return
+            prev = alloc.progress.get(int(rank))
+            alloc.progress[int(rank)] = {"step": int(step), "time": now}
+            alloc.progress_last_beat = now
+            # Progress = this rank's step CHANGED. A sentinel rollback
+            # legitimately regresses the counter while the gang re-trains
+            # the window — comparing against the all-time max would let
+            # that healthy gang age into a stall-kill (and mislabel every
+            # rank a straggler), so regression also recomputes the max.
+            if prev is None or int(step) != int(prev["step"]):
+                alloc.progress_advanced_at = now
+            if int(step) > alloc.progress_max_step:
+                alloc.progress_max_step = int(step)
+            elif prev is not None and int(step) < int(prev["step"]):
+                alloc.progress_max_step = max(
+                    int(b["step"]) for b in alloc.progress.values()
+                )
+
+    def progress_snapshot(self, alloc_id: str):
+        """(rank -> beat, max_step) copies for the stall sweep — beats
+        keep landing from request threads while the sweep reads."""
+        with self._lock:
+            alloc = self._allocs.get(alloc_id)
+            if alloc is None:
+                return {}, -1
+            return (
+                {r: dict(b) for r, b in alloc.progress.items()},
+                alloc.progress_max_step,
+            )
 
     # -- rendezvous (ref: rendezvous.go try/ready/push) ------------------------
     def rendezvous_arrive(self, alloc_id: str, rank: int, addr: str) -> None:

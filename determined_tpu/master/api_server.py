@@ -442,6 +442,17 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
             }
         }
 
+    def alloc_progress(r: ApiRequest):
+        # Gang-progress beat (stall watchdog): every rank posts its
+        # last-completed step; the master tick kills the gang when the
+        # counter stops advancing within health.stall_timeout_s.
+        m.alloc_service.record_progress(
+            r.groups[0],
+            int(r.body.get("rank", 0)),
+            int(r.body.get("step", 0)),
+        )
+        return {}
+
     def rendezvous_arrive(r: ApiRequest):
         m.alloc_service.rendezvous_arrive(
             r.groups[0], int(r.body["rank"]), r.body["addr"]
@@ -1342,6 +1353,7 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("POST", r"/api/v1/allocations/([\w.\-]+)/signals/preemption_from_task", preempt_from_task),
         R("POST", r"/api/v1/allocations/([\w.\-]+)/proxy", register_proxy),
         R("GET", r"/api/v1/proxies", list_proxies),
+        R("POST", r"/api/v1/allocations/([\w.\-]+)/progress", alloc_progress),
         R("POST", r"/api/v1/allocations/([\w.\-]+)/rendezvous", rendezvous_arrive),
         R("GET", r"/api/v1/allocations/([\w.\-]+)/rendezvous", rendezvous_info),
         R("POST", r"/api/v1/allocations/([\w.\-]+)/allgather", allgather),

@@ -648,6 +648,8 @@ class Master:
                     self._reconcile_sweep()
                     self._reap_unmanaged()
                     self._reap_idle_commands()
+                    self._stall_sweep()
+                    self._prune_heartbeats()
                     self.auth.sweep()
             except Exception:  # noqa: BLE001
                 logger.exception("tick loop error")
@@ -655,6 +657,112 @@ class Master:
     def record_heartbeat(self, trial_id: int) -> None:
         with self._lock:
             self._heartbeats[trial_id] = time.time()
+
+    def _prune_heartbeats(self) -> None:
+        """Drop heartbeat entries for trials in a terminal state (or gone
+        entirely): they were never pruned before, so a long-lived master
+        serving many unmanaged trials leaked one entry per trial forever.
+        A live trial pruned by a momentary registry gap re-adds itself on
+        its next beat — the grace clock in _reap_unmanaged restarts."""
+        with self._lock:
+            live = {
+                rec.trial_id
+                for e in self.experiments.values()
+                for rec in e.trials.values()
+                if not rec.exited
+            }
+            for trial_id in [t for t in self._heartbeats if t not in live]:
+                del self._heartbeats[trial_id]
+
+    def _stall_sweep(self) -> None:
+        """Gang stall watchdog: kill a RUNNING trial allocation whose
+        last-completed-step counter has not advanced within the trial's
+        `health.stall_timeout_s`. A hung XLA collective (dead peer, wedged
+        rank) otherwise blocks the gang forever with nobody watching —
+        the per-step progress heartbeat turns that into a bounded-time,
+        attributable kill (the MegaScale reliability pattern). A stall
+        with a vanished/straggling peer is charged as infra (no
+        restart-budget hit) — the requeue-from-checkpoint is the
+        platform's job, not the trial's fault; a uniform stall (every
+        rank at the same step: a workload deadlock) charges the budget so
+        a deterministic hang still terminates. Attribution is
+        best-effort: beats are advisory (a rank whose last POST was
+        dropped can read as a straggler), so a misclassified deadlock at
+        worst burns free infra requeues until INFRA_REQUEUE_CAP routes it
+        back through the budget."""
+        now = time.time()
+        with self._lock:
+            index = {
+                a: (exp, trial_id)
+                for a, (exp, trial_id) in self._alloc_index.items()
+            }
+        for alloc_id, (exp, trial_id) in index.items():
+            timeout = (exp.config.get("health") or {}).get("stall_timeout_s")
+            try:
+                timeout = float(timeout) if timeout else 0.0
+            except (TypeError, ValueError):
+                continue  # validated at create; belt-and-braces for old rows
+            if timeout <= 0:
+                continue
+            alloc = self.alloc_service.get(alloc_id)
+            if alloc is None or alloc.state != "RUNNING":
+                continue
+            # Basis: the newest of step-advance and raw beat time. Beats
+            # only flow when steps complete (boundaries), so this still
+            # measures "counter stopped advancing" — while giving long
+            # validation/checkpoint passes the FULL timeout from their
+            # preceding boundary beat rather than from an older advance.
+            basis = max(
+                alloc.progress_advanced_at or 0.0,
+                alloc.progress_last_beat or 0.0,
+            )
+            if not basis:
+                # Watch arms at the first beat: rendezvous/compile hangs
+                # are the rendezvous timeout's jurisdiction, and sizing
+                # stall_timeout_s to also cover first-compile time would
+                # blunt it for the steady state.
+                continue
+            if now - basis <= timeout:
+                continue
+            ranks, max_step = self.alloc_service.progress_snapshot(alloc_id)
+            suspects = [
+                rank for rank, beat in ranks.items()
+                if beat["step"] < max_step
+            ]
+            missing = sorted(
+                set(range(alloc.num_processes)) - set(ranks)
+            )
+            vanished = suspects + missing
+            infra = bool(vanished)
+            named = ", ".join(
+                f"rank {r}"
+                + (f" ({alloc.addrs[r]})" if r in alloc.addrs else "")
+                + (" [no beats]" if r in missing else
+                   f" [stuck at step {ranks[r]['step']}]")
+                for r in vanished
+            )
+            reason = (
+                f"gang stalled: no step progress in {now - basis:.0f}s "
+                f"(stall_timeout_s={timeout:g}, last step "
+                f"{max_step if max_step >= 0 else 'none'})"
+                + (f"; vanished peer(s): {named}" if vanished
+                   else "; all ranks at the same step (workload hang)")
+            )
+            logger.warning(
+                "stall watchdog killing allocation %s (trial %s): %s",
+                alloc_id, trial_id, reason,
+            )
+            # Mirror lose_agent: kill the processes, then complete with
+            # OUR attribution — the agents' later EXITED reports find the
+            # record TERMINATED and no-op, so the infra flag sticks and
+            # the trial requeues from its latest checkpoint.
+            try:
+                self.kill_allocation(alloc_id)
+            except Exception:  # noqa: BLE001 — attribution must still land
+                logger.exception("stall kill failed for %s", alloc_id)
+            self.alloc_service.complete(
+                alloc_id, exit_code=1, reason=reason, infra=infra
+            )
 
     def _reap_unmanaged(self) -> None:
         """Unmanaged-trial liveness: a silent driver means the trial errored

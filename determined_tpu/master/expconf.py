@@ -29,6 +29,17 @@ NEEDS_MAX_TRIALS = {"random", "asha", "adaptive_asha"}
 KNOWN_STORAGE = {"shared_fs", "gcs", "s3", "azure"}
 KNOWN_HP_TYPES = {"const", "categorical", "int", "double", "log"}
 MESH_AXES = {"data", "fsdp", "tensor", "pipeline", "context", "expert"}
+#: training health sentinel knobs (trainer/_sentinel.py + the master's
+#: stall watchdog). Typo'd keys get masterconf-style named errors — a
+#: silently-ignored `stall_timeout` leaves a gang unwatched.
+KNOWN_HEALTH_KEYS = {
+    "stall_timeout_s",
+    "max_consecutive_skips",
+    "spike_zscore",
+    "spike_window",
+    "spike_min_history",
+    "divergence_check_period",
+}
 
 
 def _check_unit(spec: Any, field: str, errors: List[str]) -> None:
@@ -307,6 +318,47 @@ def validate(config: Dict[str, Any]) -> List[str]:
                 if v is not None and (not isinstance(v, int) or v < 0):
                     errors.append(f"checkpoint_storage.{key} must be an int >= 0")
 
+    health = config.get("health")
+    if health is not None:
+        if not isinstance(health, dict):
+            errors.append("health must be an object")
+        else:
+            for key in health:
+                if key not in KNOWN_HEALTH_KEYS:
+                    errors.append(
+                        f"health: unknown key {key!r} "
+                        f"(one of: {', '.join(sorted(KNOWN_HEALTH_KEYS))})"
+                    )
+            import math
+
+            st = health.get("stall_timeout_s")
+            if st is not None and (
+                not isinstance(st, (int, float)) or isinstance(st, bool)
+                or not math.isfinite(st) or st < 0
+            ):
+                errors.append(
+                    "health.stall_timeout_s must be a finite number >= 0 "
+                    "(0 disables the stall watchdog)"
+                )
+            for key in (
+                "max_consecutive_skips",
+                "spike_window",
+                "spike_min_history",
+                "divergence_check_period",
+            ):
+                v = health.get(key)
+                if v is not None and (not isinstance(v, int) or v < 0):
+                    errors.append(f"health.{key} must be an int >= 0")
+            z = health.get("spike_zscore")
+            if z is not None and (
+                not isinstance(z, (int, float)) or isinstance(z, bool)
+                or not math.isfinite(z) or z < 0
+            ):
+                errors.append(
+                    "health.spike_zscore must be a finite number >= 0 "
+                    "(0 disables the loss-spike detector)"
+                )
+
     _check_unit(config.get("min_validation_period"), "min_validation_period", errors)
     _check_unit(config.get("min_checkpoint_period"), "min_checkpoint_period", errors)
     _check_unit(config.get("scheduling_unit"), "scheduling_unit", errors)
@@ -430,6 +482,35 @@ FIELDS: List[Tuple[str, str, str, str]] = [
      "Workload-failure restart budget per trial (infra failures — lost "
      "hosts, spot reclaims, agent disable — requeue WITHOUT charging "
      "it)."),
+    ("health.stall_timeout_s", "finite number >= 0", "0 (off)",
+     "Gang stall watchdog: the master kills (and requeues from "
+     "checkpoint) an allocation whose last-completed-step counter has "
+     "not advanced within this many seconds. A stall with a vanished/"
+     "straggling peer is charged as infra (no restart-budget hit). Size "
+     "it above anything that legitimately pauses step progress: the "
+     "slowest step, AND a full validation or synchronous checkpoint "
+     "pass (no beats flow during either). The watch arms at the first "
+     "beat, so first-step compile time is exempt. See "
+     "docs/robustness.md."),
+    ("health.max_consecutive_skips", "int >= 0", "3",
+     "After this many consecutive non-finite steps (each already "
+     "skipped in-graph by the finiteness guard), the trainer restores "
+     "the last verified checkpoint and fast-forwards the data stream "
+     "past the poisoned window. 0 = guard only, never roll back."),
+    ("health.spike_zscore", "finite number >= 0", "0 (off)",
+     "Robust z-score (median/MAD over a rolling loss window) above "
+     "which a finite loss counts as a spike and triggers the same "
+     "rollback-and-skip. PaLM-style mitigation for loss spikes the "
+     "finiteness guard cannot see."),
+    ("health.spike_window", "int >= 0", "64",
+     "Losses kept in the spike detector's rolling baseline window."),
+    ("health.spike_min_history", "int >= 0", "16",
+     "Observations required before the spike detector may fire."),
+    ("health.divergence_check_period", "int >= 0", "0 (off)",
+     "Batches between replica-divergence audits: a deterministic "
+     "checksum of every param shard, compared across all data-parallel "
+     "replicas of the same region. A mismatch errors the trial naming "
+     "the offending host/device (silent data corruption)."),
     ("environment.variables", "object", "{}",
      "Extra environment variables for the task process."),
     ("environment.jax_platform", "string", "",

@@ -609,6 +609,11 @@ class RestKubeClient(KubeClient):
         creating_poll = RetryPolicy(
             base_delay=2.0, multiplier=1.0, max_delay=2.0, jitter=0.0
         ).backoff(f"kube-log:{pod_name}")
+        # Stream-drop resume cadence (live pod whose log follow EOF'd):
+        # policy-driven like creating_poll, not a bare sleep-retry.
+        resume_poll = RetryPolicy(
+            base_delay=1.0, multiplier=1.0, max_delay=1.0, jitter=0.0
+        ).backoff(f"kube-log-resume:{pod_name}")
         try:
             while True:
                 # Check BEFORE the fetch: if the pod went terminal during a
@@ -678,7 +683,7 @@ class RestKubeClient(KubeClient):
                 # resume from last_ts, losing nothing.
                 if finished:
                     return
-                time.sleep(1.0)
+                time.sleep(resume_poll.next_delay())
         except Exception:  # noqa: BLE001 — a dead follower must not crash RM
             logger.exception("pod log follower for %s failed", pod_name)
         finally:

@@ -35,6 +35,11 @@ class ElasticLogSink:
         self._flush_batch = flush_batch
         self._dropped = 0
         self._dropped_lock = threading.Lock()
+        # flush() blocks on this instead of sleep-polling the in-flight
+        # count (the lint gate in tests/test_no_adhoc_retries.py rejects
+        # literal-interval polling loops in master/); notified whenever
+        # the count reaches zero.
+        self._settled_cond = threading.Condition(self._dropped_lock)
         # Monotonic ingest sequence stamped on every doc: gives the ES
         # backend a stable sort tiebreaker AND an `id`-shaped field, so
         # search results match the SQLite arm's insertion order and row
@@ -76,25 +81,29 @@ class ElasticLogSink:
                 with self._dropped_lock:
                     self._dropped += 1
                     self._inflight -= 1
+                    if self._inflight == 0:
+                        self._settled_cond.notify_all()
 
     def _settle(self, n: int) -> None:
         with self._dropped_lock:
             self._inflight -= n
+            if self._inflight == 0:
+                self._settled_cond.notify_all()
 
     def flush(self, timeout: float = 10.0) -> bool:
         """Wait until everything shipped before this call is POSTed or
         dropped (tests / read-after-ship search paths). Counts in-flight
         docs rather than polling queue emptiness — a drained batch can be
-        mid-_bulk when the queue already reads empty."""
+        mid-_bulk when the queue already reads empty. Condition-waited,
+        not sleep-polled: settles the moment the count hits zero."""
         deadline = time.monotonic() + timeout
-        while True:
-            with self._dropped_lock:
-                settled = self._inflight == 0
-            if settled:
-                return True
-            if time.monotonic() > deadline:
-                return False
-            time.sleep(0.02)
+        with self._settled_cond:
+            while self._inflight != 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._settled_cond.wait(timeout=remaining)
+            return True
 
     def search(
         self,

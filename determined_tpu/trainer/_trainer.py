@@ -44,6 +44,7 @@ from determined_tpu.parallel.sharding import (
     spec_for_pytree,
 )
 from determined_tpu.trainer import _checkpoint as ckpt_io
+from determined_tpu.trainer import _sentinel
 from determined_tpu.trainer._trial import JAXTrial
 from determined_tpu.trainer._units import Batch, TrainUnit, to_batches
 
@@ -67,6 +68,7 @@ class Trainer:
         profiling: bool = False,
         tensorboard_dir: Optional[str] = None,
         checkpoint_format: str = "npy",
+        health: Optional[Dict[str, Any]] = None,
     ) -> None:
         self.trial = trial
         self.core = core_context or core_mod.init()
@@ -98,6 +100,29 @@ class Trainer:
         self.checkpoint_format = checkpoint_format
         self.searcher_metric = searcher_metric
         self.smaller_is_better = smaller_is_better
+
+        # Training health sentinel (trainer/_sentinel.py): the `health:`
+        # section of the experiment config when on-cluster, the `health`
+        # kwarg off-cluster (tests/notebooks).
+        if (
+            health is None
+            and self.core.info is not None
+            and self.core.info.trial is not None
+        ):
+            health = (self.core.info.trial.config or {}).get("health")
+        self.sentinel = _sentinel.SentinelConfig.from_config(health)
+        self._spike = _sentinel.SpikeDetector(self.sentinel)
+        self._steps_skipped = 0     # lifetime non-finite skips (host view)
+        self._rollbacks = 0         # sentinel rollback-and-skip count
+        self._skips = None          # device consecutive-skip scalar (fit)
+        #: last checkpoint this process saved or restored — the rollback
+        #: target. Collectively agreed: saves broadcast the storage_id.
+        self._last_ckpt_id: Optional[str] = None
+        #: batches the data stream is ahead of the step counter — the
+        #: poisoned windows rollbacks skipped. Persisted in the trainer
+        #: metadata so a process restart fast-forwards identically.
+        self._data_offset = 0
+        self._data_consumed = 0     # absolute batch cursor (fit-local)
 
         self.model: Model = trial.build_model(self.mesh)
         self._tx = trial.build_optimizer()
@@ -196,19 +221,37 @@ class Trainer:
     def steps_completed(self) -> int:
         return int(jax.device_get(self.state["step"]))
 
+    @property
+    def steps_skipped(self) -> int:
+        """Optimizer updates the non-finite guard skipped (host view;
+        updated at report boundaries)."""
+        return self._steps_skipped
+
+    @property
+    def rollbacks(self) -> int:
+        """Sentinel rollback-and-skip events (consecutive-skip cap or
+        loss spike)."""
+        return self._rollbacks
+
     # -- compiled step -----------------------------------------------------
     def _build_step_fn(self):
         param_shardings = self._param_shardings()
         base_rng = self._rng
 
-        def train_step(state, batch):
+        def train_step(state, batch, poison, skips):
             rng = jax.random.fold_in(base_rng, state["step"])
 
             def loss_fn(params):
                 loss, metrics = self.model.loss(params, batch, rng)
-                return loss, metrics
+                # poison is 1.0 outside fault drills; a NaN or spike
+                # factor rides the loss so the grads inherit it — the
+                # wire shape of a poisoned batch (_sentinel fault sites).
+                loss = loss * poison
+                return loss, dict(metrics, loss=loss)
 
-            grads, metrics = jax.grad(loss_fn, has_aux=True)(state["params"])
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state["params"])
             updates, new_opt = self._tx.update(
                 grads, state["opt_state"], state["params"]
             )
@@ -219,15 +262,25 @@ class Trainer:
                 new_params, param_shardings
             )
             gnorm = optax_global_norm(grads)
-            metrics = dict(metrics, grad_norm=gnorm)
-            return (
-                {
-                    "step": state["step"] + 1,
-                    "params": new_params,
-                    "opt_state": new_opt,
-                },
-                metrics,
+            new_state = {
+                "step": state["step"] + 1,
+                "params": new_params,
+                "opt_state": new_opt,
+            }
+            # Non-finite guard, in-graph: a NaN/inf loss or grad norm
+            # keeps the old params/optimizer (only the step advances) and
+            # bumps the consecutive-skip counter. The counters ride the
+            # device-resident metrics buffer — no host sync here.
+            new_state, ok, skips_out = _sentinel.guarded_update(
+                state, new_state, loss, gnorm, skips
             )
+            metrics = dict(
+                metrics,
+                grad_norm=gnorm,
+                sentinel_skipped=(~ok).astype(jnp.int32),
+                sentinel_skips=skips_out,
+            )
+            return new_state, metrics, skips_out
 
         return jax.jit(train_step, donate_argnums=(0,))
 
@@ -323,6 +376,7 @@ class Trainer:
         is_chief = self.core.distributed.is_chief
         checkpoint_ctx = self.core.checkpoint
         seed = self.seed
+        data_offset = self._data_offset
 
         def work() -> str:
             with tempfile.TemporaryDirectory() as tmp:
@@ -338,7 +392,18 @@ class Trainer:
                     written = ckpt_io.write_snapshot(snapshot, tmp)
                 if is_chief:
                     with open(os.path.join(tmp, TRAINER_METADATA), "w") as f:
-                        json.dump({"steps_completed": steps, "seed": seed}, f)
+                        json.dump(
+                            {
+                                "steps_completed": steps,
+                                "seed": seed,
+                                # Sentinel rollbacks leave the data stream
+                                # ahead of the step counter (poisoned
+                                # windows skipped); a restart must fast-
+                                # forward the same distance (fit()).
+                                "data_offset": data_offset,
+                            },
+                            f,
+                        )
                     if written is not None:
                         written.append(TRAINER_METADATA)
                 storage_id = checkpoint_ctx.upload(
@@ -348,6 +413,9 @@ class Trainer:
                     paths=written,
                 )
             logger.info("saved checkpoint %s at step %d", storage_id, steps)
+            # The rollback target: collectively agreed (the sharded
+            # upload broadcasts one storage_id to every rank).
+            self._last_ckpt_id = storage_id
             return storage_id
 
         self._ckpt_writer.submit(work)
@@ -455,6 +523,20 @@ class Trainer:
             else:
                 shardings = jax.tree.map(lambda x: x.sharding, state)
                 self._state = ckpt_io.load_pytree(path, state, shardings)
+            md_path = os.path.join(path, TRAINER_METADATA)
+            self._data_offset = 0
+            if os.path.exists(md_path):
+                try:
+                    with open(md_path) as f:
+                        self._data_offset = int(
+                            json.load(f).get("data_offset", 0) or 0
+                        )
+                except (ValueError, OSError):
+                    logger.warning(
+                        "unreadable trainer metadata in %s; assuming no "
+                        "data offset", storage_id,
+                    )
+        self._last_ckpt_id = storage_id  # verified by the restore above
         logger.info(
             "restored checkpoint %s at step %d", storage_id, self.steps_completed
         )
@@ -474,6 +556,109 @@ class Trainer:
         if n == 0:
             return {}
         return {k: v / n for k, v in totals.items()}
+
+    # -- training health sentinel (trainer/_sentinel.py) -------------------
+    def _sentinel_check(self, pending: List[Any]) -> Optional[str]:
+        """Flush-time sentinel pass over the window's device metrics.
+        Materializes ONLY the per-step loss and skip counters (the full
+        metrics flush is chief-only), accumulates the skip total,
+        and returns a rollback reason when the consecutive-skip cap or
+        the loss-spike z-score trips — None otherwise. Every rank runs
+        this on identical replicated scalars, so the gang reaches the
+        same verdict with no extra collective."""
+        if not pending:
+            return None
+        cfg = self.sentinel
+        keys = ("loss", "sentinel_skipped", "sentinel_skips")
+        sent = jax.device_get(
+            [{k: m[k] for k in keys if k in m} for m in pending]
+        )
+        window_skips = sum(int(m.get("sentinel_skipped", 0)) for m in sent)
+        if window_skips:
+            self._steps_skipped += window_skips
+            logger.warning(
+                "non-finite guard skipped %d step(s) this window "
+                "(%d total)", window_skips, self._steps_skipped,
+            )
+        consecutive = int(sent[-1].get("sentinel_skips", 0))
+        if cfg.max_consecutive_skips and consecutive >= cfg.max_consecutive_skips:
+            return (
+                f"{consecutive} consecutive non-finite steps "
+                f"(max_consecutive_skips={cfg.max_consecutive_skips})"
+            )
+        if self._spike.enabled:
+            for m in sent:
+                if "loss" in m and self._spike.observe(float(m["loss"])):
+                    return (
+                        f"loss spike {float(m['loss']):.4g} beyond "
+                        f"robust z-score {cfg.spike_zscore}"
+                    )
+        return None
+
+    def _sentinel_rollback(self, reason: str, at_step: int) -> Optional[int]:
+        """PaLM-style rollback-and-skip: restore the last verified
+        checkpoint (PR 1's manifest-verified fallback chain) and leave
+        the data stream where it is — the batches between the restored
+        step and `at_step` ARE the poisoned window, skipped forever via
+        the recorded data offset. Returns the restored step, or None when
+        no checkpoint exists yet (the in-graph guard already kept the
+        params clean; training continues in place with counters reset)."""
+        try:
+            self._ckpt_writer.wait()  # a save in flight may be the target
+        except BaseException:  # noqa: BLE001 — rollback must still proceed
+            logger.exception("in-flight checkpoint failed before rollback")
+        target = self._last_ckpt_id
+        if target is None:
+            logger.error(
+                "sentinel wants a rollback (%s) but no checkpoint exists "
+                "yet; continuing with guarded params only", reason,
+            )
+            self._skips = jnp.zeros((), jnp.int32)
+            self._spike.reset()
+            return None
+        logger.warning(
+            "sentinel rollback at step %d: %s — restoring %s and skipping "
+            "the poisoned data window", at_step, reason, target,
+        )
+        self._restore_with_fallback(target)
+        self._rollbacks += 1
+        restored = self.steps_completed
+        # The stream is NOT rewound: everything consumed past the restored
+        # step stays consumed, which is exactly "skip the offending
+        # batches". Recorded so checkpoints replay the same decision.
+        self._data_offset = self._data_consumed - restored
+        self._skips = jnp.zeros((), jnp.int32)
+        self._spike.reset()
+        logger.warning(
+            "sentinel rollback done: step %d, data stream fast-forwarded "
+            "%d batch(es) ahead (rollback #%d)",
+            restored, self._data_offset, self._rollbacks,
+        )
+        return restored
+
+    def _divergence_audit(self) -> None:
+        """Replica-divergence audit: deterministic per-shard checksums of
+        the params, compared across every holder of the same logical
+        region (data-parallel replicas, local and cross-host). A mismatch
+        is silent data corruption — error the trial naming the offending
+        rank/device rather than train on (or checkpoint) corrupt state."""
+        dist = self.core.distributed
+        sums = _sentinel.local_shard_checksums(self.state["params"])
+        if _sentinel.divergence_fault(dist.rank):
+            # Deterministic drill (DTPU_FAULT_PLAN train.divergence.rank<r>):
+            # corrupt ONE device's checksum on this rank — the audit must
+            # flag exactly this holder.
+            key = next(iter(sums), None)
+            if key is not None and sums[key]:
+                device, (a, b) = sums[key][-1]
+                sums[key] = sums[key][:-1] + [(device, (a + 1.0, b))]
+        gathered = dist.gather((dist.rank, sums))
+        verdict = dist.broadcast(
+            _sentinel.compare_checksums(gathered)
+            if dist.is_chief else None
+        )
+        if verdict:
+            raise _sentinel.ReplicaDivergenceError(verdict)
 
     # -- the loop ----------------------------------------------------------
     def fit(
@@ -522,21 +707,27 @@ class Trainer:
         # loader) fast-forward in O(1); otherwise assemble-and-discard.
         train_data = self.trial.build_training_data()
         resume_steps = self.steps_completed
+        # Fast-forward distance = steps trained + the data offset from any
+        # sentinel rollbacks before the checkpoint (poisoned windows the
+        # stream skipped past): batch i depends only on (seed, i), so the
+        # resumed stream is identical to the uninterrupted one.
+        fast_forward = resume_steps + self._data_offset
         skipped = False
-        if resume_steps and hasattr(train_data, "skip"):
+        if fast_forward and hasattr(train_data, "skip"):
             # In-place contract: skip() mutates and returns None (our
             # datasets) or self (fluent style) — both count as skipped.
             # A skip() returning a NEW object (e.g. tf.data's, which is
             # non-mutating and counts elements rather than batches) falls
             # back to discard; the probe was a no-op on the original, so
             # the fallback never double-skips.
-            result = train_data.skip(resume_steps)
+            result = train_data.skip(fast_forward)
             if result is None or result is train_data:
                 skipped = True
         train_iter = iter(train_data)
         if not skipped:
-            for _ in range(resume_steps):
+            for _ in range(fast_forward):
                 next(train_iter)
+        self._data_consumed = fast_forward
         pending: List[Any] = []  # on-device metrics since last report
         last_val: Dict[str, float] = {}
         t_report = time.time()
@@ -544,18 +735,42 @@ class Trainer:
 
         def flush_report() -> None:
             nonlocal pending, t_report
+            # Sentinel sees EVERY window before it is dropped — flushes
+            # also happen at checkpoint/preemption/op-end boundaries that
+            # are not report boundaries, and a spike (or skip count) in
+            # such a window must not vanish unchecked. The verdict is
+            # latched and consumed at the next boundary's rollback gate.
+            if pending:
+                reason = self._sentinel_check(pending)
+                if reason and self._sentinel_reason is None:
+                    self._sentinel_reason = reason
             if not pending or not self.core.distributed.is_chief:
                 pending = []
                 return
             host = [jax.device_get(m) for m in pending]
-            agg = {
-                k: float(np.mean([h[k] for h in host]))
-                for k in host[0]
-                if np.ndim(host[0][k]) == 0
-            }
+            # Aggregate over FINITE values only: a guarded (skipped) step
+            # leaves NaN in loss/grad_norm, and a NaN mean would both
+            # poison the metric history and break the metrics POST (NaN
+            # is not valid JSON — the master 500s, the circuit breaker
+            # opens, and the trial dies reporting). A window with no
+            # finite values drops the key; sentinel_skipped still tells
+            # the story.
+            agg = {}
+            for k in host[0]:
+                if np.ndim(host[0][k]) != 0:
+                    continue
+                vals = np.asarray([float(h[k]) for h in host], np.float64)
+                finite = vals[np.isfinite(vals)]
+                if finite.size:
+                    agg[k] = float(finite.mean())
             dt = time.time() - t_report
             agg["batches_per_second"] = len(host) / dt if dt > 0 else 0.0
             self._last_throughput = agg["batches_per_second"]
+            # Robustness tax, cumulative: how many updates the guard
+            # dropped and how often the sentinel rolled back (bench.py
+            # and the metrics history both read these).
+            agg["steps_skipped"] = float(self._steps_skipped)
+            agg["rollbacks"] = float(self._rollbacks)
             steps_now = self.steps_completed
             self.core.train.report_training_metrics(steps_now, agg)
             self._tb_scalars(steps_now, agg)
@@ -569,6 +784,12 @@ class Trainer:
         # and kill host/device overlap.
         step = self.steps_completed
         last_ckpt_step = -1
+        self._skips = jnp.zeros((), jnp.int32)
+        self._sentinel_reason: Optional[str] = None
+        last_div_audit = step
+        # First progress beat (every rank): arms the master's gang stall
+        # watchdog with this rank's identity before the first boundary.
+        self.core.train.heartbeat_step(step)
         if self._profiler is not None:
             self._profiler.start()
 
@@ -583,15 +804,49 @@ class Trainer:
                 target = to_batches(op.length, bpe)
                 while step < target:
                     batch = self._put_batch(next(train_iter))
-                    self._state, metrics = self._step_fn(self.state, batch)
+                    self._data_consumed += 1
+                    # poison: 1.0 outside fault drills (one None check);
+                    # np scalar, not python float, so jit sees a stable
+                    # weak-typed operand either way.
+                    poison = np.float32(_sentinel.poison_factor())
+                    self._state, metrics, self._skips = self._step_fn(
+                        self.state, batch, poison, self._skips
+                    )
                     pending.append(metrics)
                     step += 1
 
                     boundary = step % rep_period == 0 or step == target
                     if boundary:
+                        # flush_report runs the sentinel pass over the
+                        # window (same verdict on every rank — the inputs
+                        # are replicated outputs of the SPMD step, so no
+                        # extra collective); the latched verdict gates
+                        # the rollback below.
                         flush_report()
+                        rollback_reason = self._sentinel_reason
+                        self._sentinel_reason = None
+                        # Progress beat from EVERY rank: the master's
+                        # stall watchdog kills the gang when this counter
+                        # stops advancing (hung collective → bounded-time
+                        # recovery instead of forever-stuck).
+                        self.core.train.heartbeat_step(step)
                         if self.core.distributed.is_chief:
                             op.report_progress(float(step))
+                        if rollback_reason is not None:
+                            restored = self._sentinel_rollback(
+                                rollback_reason, step
+                            )
+                            if restored is not None:
+                                step = restored
+                                last_div_audit = min(last_div_audit, step)
+                                continue
+                        if (
+                            self.sentinel.divergence_check_period
+                            and step - last_div_audit
+                            >= self.sentinel.divergence_check_period
+                        ):
+                            last_div_audit = step
+                            self._divergence_audit()
                     if val_period and step % val_period == 0 and step < target:
                         last_val = self._validate()
                         if last_val and self.core.distributed.is_chief:

@@ -49,6 +49,30 @@ class TestShardReassembly:
         with pytest.raises(ValueError, match="incomplete"):
             _assemble_shards(str(tmp_path), "a", jnp.zeros(8, jnp.float32))
 
+    def test_incomplete_shards_raise_typed_through_load_pytree(self, tmp_path):
+        """The trainer's rollback path keys on the TYPED error: an
+        incomplete shard set surfacing from load_pytree must be a
+        CorruptCheckpointError (so _restore_with_fallback walks back to
+        an older verified checkpoint) — not a bare ValueError or, worse,
+        uninitialized np.empty bytes handed to the optimizer."""
+        from determined_tpu.storage.base import CorruptCheckpointError
+
+        np.save(tmp_path / "a.shard0.npy", np.zeros(4, np.float32))
+        like = {"a": jnp.zeros(8, jnp.float32)}
+        with pytest.raises(CorruptCheckpointError, match="incomplete"):
+            load_pytree(str(tmp_path), like)
+
+    def test_overlapping_shards_with_hole_raise_typed(self, tmp_path):
+        """Overlap + hole: summed chunk sizes would look complete; the
+        element-coverage check must still flag the hole, typed."""
+        from determined_tpu.storage.base import CorruptCheckpointError
+
+        np.save(tmp_path / "a.shard0.npy", np.zeros(4, np.float32))
+        np.save(tmp_path / "a.shard2.npy", np.zeros(2, np.float32))
+        like = {"a": jnp.zeros(8, jnp.float32)}
+        with pytest.raises(CorruptCheckpointError, match="incomplete"):
+            load_pytree(str(tmp_path), like)
+
 
 class TestLazyShardedRestore:
     """VERDICT r2 weak #3 / next #3: restore must read ≈ the requesting

@@ -71,6 +71,27 @@ class TestLoader:
         np.testing.assert_array_equal(next(a)["tokens"], next(b)["tokens"])
         b.close()
 
+    @pytest.mark.parametrize("use_native", [True, False])
+    def test_skip_determinism_across_rollback(self, shards, use_native):
+        """Sentinel rollback contract: batch i depends only on (seed, i),
+        so "restore + fast-forward past the poisoned window" lands on the
+        IDENTICAL batch the in-process rollback continued with. Modeled
+        exactly as the trainer drives it: consume through a poisoned
+        window, keep going (in-process rollback never rewinds the
+        stream); a restarted process skip(steps + offset)s and must see
+        the same bytes."""
+        inproc = TokenDataset(shards, 2, 32, seed=9, use_native=use_native)
+        for _ in range(6):   # 3 clean steps + 3-batch poisoned window
+            next(inproc)
+        after_rollback = [next(inproc)["tokens"].copy() for _ in range(4)]
+        inproc.close()
+
+        resumed = TokenDataset(shards, 2, 32, seed=9, use_native=use_native)
+        resumed.skip(6)      # steps_completed(3) + data_offset(3)
+        for want in after_rollback:
+            np.testing.assert_array_equal(next(resumed)["tokens"], want)
+        resumed.close()
+
     def test_sequential_mode(self, shards):
         ds = TokenDataset(shards, 2, 16, shuffle=False, use_native=True)
         t0 = next(ds)["tokens"]

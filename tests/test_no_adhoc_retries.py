@@ -90,6 +90,89 @@ def test_no_bare_sleep_retry_loops():
     )
 
 
+# ---------------------------------------------------------------------------
+# Stricter tier for the control plane: master/ and agent/ must not
+# sleep-POLL either. A loop that `time.sleep(<literal>)`s anywhere in its
+# body (not just in a retry handler) is a polling loop reinventing the
+# tick/condition services — the master has kick_tick + Condition-based
+# long-polls, the agent has per-task done Events and policy backoffs.
+# Fixed-cadence waits are fine when policy-driven
+# (`sleep(backoff.next_delay())`) or event-based (`done.wait(0.2)`), both
+# of which pass by construction; a deliberate exception carries the same
+# `# resilience-ok: <reason>` waiver.
+# ---------------------------------------------------------------------------
+NO_POLL_SUBTREES = ("master", "agent")
+
+
+def _poll_violations_in_file(path: str):
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=path)
+    out = []
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+            continue
+        for call in _sleeps_in(loop):
+            line = lines[call.lineno - 1]
+            if WAIVER in line:
+                continue
+            out.append(f"{path}:{call.lineno}: {line.strip()}")
+    return sorted(set(out))
+
+
+def test_no_sleep_polling_loops_in_master_agent():
+    violations = []
+    for sub in NO_POLL_SUBTREES:
+        root = os.path.join(PKG_ROOT, sub)
+        for dirpath, _, filenames in os.walk(root):
+            for name in filenames:
+                if name.endswith(".py"):
+                    violations.extend(
+                        _poll_violations_in_file(os.path.join(dirpath, name))
+                    )
+    assert not violations, (
+        "time.sleep(<constant>) polling loops found in master//agent/ — "
+        "use the tick/condition services (kick_tick, Condition.wait, "
+        "Event.wait, RetryPolicy backoffs), or annotate a deliberate "
+        f"exception with '{WAIVER} <reason>':\n" + "\n".join(violations)
+    )
+
+
+def test_poll_lint_actually_detects_a_violation(tmp_path):
+    """The stricter linter must not rot either: a sleep-polling loop with
+    no try/except (invisible to the retry-loop check) is flagged; event-
+    and policy-driven waits are not."""
+    bad = tmp_path / "bad_poll.py"
+    bad.write_text(
+        "import time\n"
+        "def f(q):\n"
+        "    while not q:\n"
+        "        time.sleep(0.5)\n"
+    )
+    assert len(_poll_violations_in_file(str(bad))) == 1
+    assert _violations_in_file(str(bad)) == []  # retry check misses it
+
+    good = tmp_path / "good_poll.py"
+    good.write_text(
+        "def f(q, done, backoff):\n"
+        "    import time\n"
+        "    while not q:\n"
+        "        done.wait(0.5)\n"
+        "        time.sleep(backoff.next_delay())\n"
+    )
+    assert _poll_violations_in_file(str(good)) == []
+
+    waived = tmp_path / "waived_poll.py"
+    waived.write_text(
+        "import time\n"
+        "def f(q):\n"
+        "    while not q:\n"
+        "        time.sleep(0.5)  # resilience-ok: external /proc poll\n"
+    )
+    assert _poll_violations_in_file(str(waived)) == []
+
+
 def test_lint_actually_detects_a_violation(tmp_path):
     """The linter itself must not rot: a textbook bare retry loop is
     flagged, a policy-driven one is not."""

@@ -1,0 +1,266 @@
+"""Gang stall watchdog + heartbeat hygiene tests.
+
+The master's tick loop must turn a hung collective (gang whose
+last-completed-step counter stopped advancing) into a bounded-time kill:
+infra-attributed (no restart-budget charge) when a peer vanished or
+straggled, budget-charged when every rank froze at the same step (a
+workload deadlock must still terminate). Plus the `_heartbeats` leak fix:
+entries prune when trials reach a terminal state.
+"""
+import time
+
+from determined_tpu.master.allocation import AllocationService
+from determined_tpu.master.core import Master
+
+
+def _wait(predicate, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return predicate()
+
+
+def _gang_config(slots_per_trial, stall_timeout_s=5.0):
+    return {
+        "entrypoint": "pkg.mod:Trial",
+        "searcher": {"name": "single", "max_length": 10, "metric": "loss"},
+        "resources": {"slots_per_trial": slots_per_trial},
+        "health": {"stall_timeout_s": stall_timeout_s},
+    }
+
+
+def _running_alloc(master, n_agents=1, slots_per_trial=1, stall_timeout_s=5.0):
+    """Register agents, create a gang experiment, drive the allocation to
+    RUNNING via rendezvous; returns (exp, trial_id, alloc_id)."""
+    for i in range(n_agents):
+        master.agent_registered(f"agent-{i}", 1, "default")
+    exp_id = master.create_experiment(
+        _gang_config(slots_per_trial, stall_timeout_s)
+    )
+    exp = master.get_experiment(exp_id)
+    assert _wait(lambda: master._trial_allocs), "trial never placed"
+    trial_id, alloc_id = next(iter(master._trial_allocs.items()))
+    alloc = master.alloc_service.get(alloc_id)
+    assert alloc is not None
+    for rank in range(alloc.num_processes):
+        master.alloc_service.rendezvous_arrive(
+            alloc_id, rank, f"10.0.0.{rank}:42071"
+        )
+    assert master.alloc_service.get(alloc_id).state == "RUNNING"
+    return exp, trial_id, alloc_id
+
+
+class TestProgressBeats:
+    def test_record_progress_tracks_advance(self):
+        svc = AllocationService()
+        svc.create("a.1.0", task_id="t", trial_id=1, num_processes=2, slots=2)
+        svc.record_progress("a.1.0", 0, 5)
+        svc.record_progress("a.1.0", 1, 3)
+        ranks, max_step = svc.progress_snapshot("a.1.0")
+        assert max_step == 5
+        assert ranks[0]["step"] == 5 and ranks[1]["step"] == 3
+        advanced = svc.get("a.1.0").progress_advanced_at
+        assert advanced is not None
+        # a rank re-beating its OWN unchanged step is not progress
+        svc.record_progress("a.1.0", 0, 5)
+        assert svc.get("a.1.0").progress_advanced_at == advanced
+        # any rank's step changing is
+        svc.record_progress("a.1.0", 1, 5)
+        assert svc.get("a.1.0").progress_advanced_at >= advanced
+        svc.record_progress("a.1.0", 0, 6)
+        assert svc.get("a.1.0").progress_max_step == 6
+
+    def test_rollback_regression_counts_as_progress(self):
+        """A sentinel rollback regresses the step counter while the gang
+        legitimately re-trains the window; those beats must refresh the
+        advance clock (comparing against the all-time max would age a
+        healthy gang into a stall-kill) and recompute the max so the
+        re-training rank isn't flagged a straggler forever."""
+        svc = AllocationService()
+        svc.create("a.1.0", task_id="t", trial_id=1, num_processes=1, slots=1)
+        svc.record_progress("a.1.0", 0, 100)
+        alloc = svc.get("a.1.0")
+        alloc.progress_advanced_at -= 999
+        alloc.progress_last_beat -= 999
+        svc.record_progress("a.1.0", 0, 40)  # post-rollback beat
+        assert time.time() - alloc.progress_advanced_at < 5
+        _, max_step = svc.progress_snapshot("a.1.0")
+        assert max_step == 40
+
+    def test_unknown_allocation_beat_is_dropped(self):
+        svc = AllocationService()
+        svc.record_progress("ghost", 0, 1)  # must not raise
+        assert svc.progress_snapshot("ghost") == ({}, -1)
+
+
+class TestStallSweep:
+    def test_uniform_stall_kills_and_charges_budget(self):
+        master = Master()
+        try:
+            exp, trial_id, alloc_id = _running_alloc(master)
+            alloc = master.alloc_service.get(alloc_id)
+            master.alloc_service.record_progress(alloc_id, 0, 5)
+            alloc.progress_advanced_at = time.time() - 999  # stalled long ago
+            alloc.progress_last_beat = alloc.progress_advanced_at
+            master._stall_sweep()
+            assert alloc.state == "TERMINATED"
+            assert alloc.infra_failure is False
+            assert "gang stalled" in alloc.exit_reason
+            assert "workload hang" in alloc.exit_reason
+            rec = exp.trials[trial_id]
+            assert rec.restarts == 1 and rec.infra_requeues == 0
+        finally:
+            master.shutdown()
+
+    def test_vanished_peer_is_infra_and_named(self):
+        master = Master()
+        try:
+            exp, trial_id, alloc_id = _running_alloc(
+                master, n_agents=2, slots_per_trial=2
+            )
+            alloc = master.alloc_service.get(alloc_id)
+            # rank 0 finished step 5; rank 1 died/wedged back at step 3 —
+            # the gang froze waiting on it.
+            master.alloc_service.record_progress(alloc_id, 0, 5)
+            master.alloc_service.record_progress(alloc_id, 1, 3)
+            alloc.progress_advanced_at = time.time() - 999
+            alloc.progress_last_beat = alloc.progress_advanced_at
+            master._stall_sweep()
+            assert alloc.state == "TERMINATED"
+            assert alloc.infra_failure is True
+            assert "vanished peer" in alloc.exit_reason
+            assert "rank 1" in alloc.exit_reason
+            assert "10.0.0.1:42071" in alloc.exit_reason
+            rec = exp.trials[trial_id]
+            # infra: requeued WITHOUT touching the restart budget
+            assert rec.restarts == 0 and rec.infra_requeues == 1
+        finally:
+            master.shutdown()
+
+    def test_silent_rank_counts_as_vanished(self):
+        master = Master()
+        try:
+            exp, trial_id, alloc_id = _running_alloc(
+                master, n_agents=2, slots_per_trial=2
+            )
+            alloc = master.alloc_service.get(alloc_id)
+            master.alloc_service.record_progress(alloc_id, 0, 5)
+            # rank 1 never beat at all
+            alloc.progress_advanced_at = time.time() - 999
+            alloc.progress_last_beat = alloc.progress_advanced_at
+            master._stall_sweep()
+            assert alloc.infra_failure is True
+            assert "rank 1" in alloc.exit_reason
+            assert "no beats" in alloc.exit_reason
+        finally:
+            master.shutdown()
+
+    def test_advancing_gang_is_left_alone(self):
+        master = Master()
+        try:
+            _, _, alloc_id = _running_alloc(master, stall_timeout_s=5.0)
+            master.alloc_service.record_progress(alloc_id, 0, 5)
+            master._stall_sweep()
+            assert master.alloc_service.get(alloc_id).state == "RUNNING"
+        finally:
+            master.shutdown()
+
+    def test_watch_arms_only_after_first_beat(self):
+        """No beats yet (rendezvous done, first step compiling): the
+        sweep must not kill — compile time is not a stall."""
+        master = Master()
+        try:
+            _, _, alloc_id = _running_alloc(master, stall_timeout_s=0.01)
+            time.sleep(0.05)
+            master._stall_sweep()
+            assert master.alloc_service.get(alloc_id).state == "RUNNING"
+        finally:
+            master.shutdown()
+
+    def test_no_timeout_configured_never_kills(self):
+        master = Master()
+        try:
+            _, _, alloc_id = _running_alloc(master, stall_timeout_s=0)
+            alloc = master.alloc_service.get(alloc_id)
+            master.alloc_service.record_progress(alloc_id, 0, 1)
+            alloc.progress_advanced_at = time.time() - 999
+            alloc.progress_last_beat = alloc.progress_advanced_at
+            master._stall_sweep()
+            assert alloc.state == "RUNNING"
+        finally:
+            master.shutdown()
+
+
+class TestHeartbeatPrune:
+    def test_terminal_trial_heartbeats_are_pruned(self):
+        """Satellite fix: _heartbeats entries were never removed when a
+        trial reached a terminal state — one leaked entry per trial for
+        the master's lifetime."""
+        master = Master()
+        try:
+            exp_id = master.create_experiment({
+                "unmanaged": True,
+                "searcher": {
+                    "name": "single", "max_length": 2, "metric": "loss",
+                },
+            })
+            exp = master.get_experiment(exp_id)
+            trial_id = next(iter(exp.trials))
+            master.record_heartbeat(trial_id)
+            assert trial_id in master._heartbeats
+            # live trial: prune keeps it
+            master._prune_heartbeats()
+            assert trial_id in master._heartbeats
+            # drive it to completion (Close on reaching max_length)
+            exp.op_completed(trial_id, 2, 0.5)
+            assert exp.trials[trial_id].exited
+            master._prune_heartbeats()
+            assert trial_id not in master._heartbeats
+        finally:
+            master.shutdown()
+
+    def test_unknown_trial_heartbeats_are_pruned(self):
+        master = Master()
+        try:
+            master.record_heartbeat(424242)
+            master._prune_heartbeats()
+            assert 424242 not in master._heartbeats
+        finally:
+            master.shutdown()
+
+
+class TestTrainerEmitsBeats:
+    def test_fit_heartbeats_at_boundaries(self, tmp_path):
+        """The harness side of the watchdog: every report boundary posts
+        the last-completed step (dummy context records them)."""
+        import optax
+        import numpy as np
+
+        from determined_tpu import core
+        from determined_tpu.models import MnistMLP
+        from determined_tpu.models.vision import MLPConfig
+        from determined_tpu.trainer import Batch, JAXTrial, Trainer
+
+        class _T(JAXTrial):
+            def build_model(self, mesh):
+                return MnistMLP(
+                    MLPConfig(in_dim=4, hidden=8, n_classes=2), mesh=mesh
+                )
+
+            def build_optimizer(self):
+                return optax.sgd(1e-2)
+
+            def build_training_data(self):
+                rng = np.random.default_rng(0)
+                while True:
+                    yield {
+                        "image": rng.normal(size=(8, 4)).astype(np.float32),
+                        "label": (np.arange(8) % 2).astype(np.int32),
+                    }
+
+        ctx = core._context._dummy_init(checkpoint_storage=str(tmp_path))
+        Trainer(_T(), ctx).fit(max_length=Batch(6), report_period=Batch(2))
+        # initial beat at step 0 + one per boundary (2, 4, 6)
+        assert ctx.train._heartbeats == [0, 2, 4, 6]
