@@ -34,10 +34,79 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from determined_tpu.common import faults
+from determined_tpu.common import trace as trace_mod
 from determined_tpu.common.api_session import Session
+from determined_tpu.common.metrics import REGISTRY as METRICS
 from determined_tpu.common.resilience import AGENT_RETRY
 
 logger = logging.getLogger("determined_tpu.agent")
+
+# Agent-side observability (common/metrics.py): the same process-global
+# registry the master uses — on a real TPU VM this process is alone and
+# the health port serves agent series; in-process devclusters co-resident
+# with a master simply share one exposition.
+# Labeled by agent id: set() on an unlabeled gauge would have co-resident
+# AgentDaemons (devcluster) clobbering one another's value; per-agent
+# series compose under sum() instead.
+AGENT_TASKS_RUNNING = METRICS.gauge(
+    "dtpu_agent_tasks_running", "Task processes currently supervised.",
+    labels=("agent",),
+)
+AGENT_TASKS_STARTED = METRICS.counter(
+    "dtpu_agent_tasks_started_total", "Task processes spawned.",
+)
+AGENT_TASK_EXITS = METRICS.counter(
+    "dtpu_agent_task_exits_total",
+    "Task exits reported to the master, by outcome.",
+    labels=("outcome",),
+)
+AGENT_LOG_LINES_SHIPPED = METRICS.counter(
+    "dtpu_agent_log_lines_shipped_total",
+    "Task log lines delivered to the master.",
+)
+
+
+class AgentMetricsServer:
+    """`/metrics` (+ `/healthz`) on the agent's health port: the scrape
+    surface for per-host series — Prometheus discovers TPU hosts the same
+    way it discovers the master (docs/operations.md Observability)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt: str, *args: Any) -> None:
+                logger.debug("metrics http: " + fmt, *args)
+
+            def do_GET(self) -> None:  # noqa: N802
+                if self.path.split("?")[0] == "/metrics":
+                    body = METRICS.render().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path.split("?")[0] == "/healthz":
+                    body, ctype = b"ok\n", "text/plain"
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="agent-metrics",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
 
 
 class SlotDetectionError(RuntimeError):
@@ -163,6 +232,7 @@ class AgentDaemon:
         python_exe: Optional[str] = None,
         token: str = "",
         state_dir: Optional[str] = None,
+        metrics_port: Optional[int] = None,
     ) -> None:
         self.master_url = master_url
         self.agent_id = agent_id or socket.gethostname()
@@ -188,6 +258,11 @@ class AgentDaemon:
         #: exits observed while the master was unreachable (or while this
         #: agent was down): reported after the next successful registration.
         self._pending_exits: List[Tuple[_Task, Optional[int]]] = []
+        #: health-port scrape surface (None = disabled; 0 = ephemeral port,
+        #: the bound port lands in .metrics.port).
+        self.metrics: Optional[AgentMetricsServer] = None
+        if metrics_port is not None:
+            self.metrics = AgentMetricsServer(port=metrics_port)
         self._recover_tasks()
 
     # -- lifecycle -----------------------------------------------------------
@@ -299,6 +374,9 @@ class AgentDaemon:
     def stop(self) -> None:
         self._stop.set()
         self._kill_all_tasks()
+        if self.metrics is not None:
+            self.metrics.stop()
+            self.metrics = None
         if self._ephemeral_state:
             import shutil
 
@@ -393,6 +471,10 @@ class AgentDaemon:
                 )
                 with self._lock:
                     self._tasks[alloc_id] = task
+                    # Re-adoption is a supervision-load change too: without
+                    # this, a restarted agent scrapes tasks_running=0 while
+                    # its re-adopted tasks keep training.
+                    AGENT_TASKS_RUNNING.labels(self.agent_id).set(len(self._tasks))
                 self._spawn_task_threads(task)
             else:
                 logger.info(
@@ -431,6 +513,29 @@ class AgentDaemon:
         env = dict(os.environ)
         env.update(action["env"])
         env["DTPU_ENTRYPOINT"] = action.get("entrypoint", "")
+        # Trace propagation (common/trace.py): the master stamped the
+        # allocation's trace context into the action env; the launch span
+        # parents under it and the TASK inherits the launch span's context
+        # — submit → schedule → launch → trial chain, one trace id.
+        launch_parent = trace_mod.parse_traceparent(
+            env.get(trace_mod.TRACEPARENT_ENV)
+        )
+        with trace_mod.span(
+            "agent.task_launch",
+            {
+                "agent.id": self.agent_id,
+                "alloc.id": action["alloc_id"],
+                "task.id": action.get("task_id", ""),
+            },
+            parent=launch_parent,
+        ) as launch_ctx:
+            if launch_parent is not None:
+                env[trace_mod.TRACEPARENT_ENV] = (
+                    trace_mod.format_traceparent(*launch_ctx)
+                )
+            self._spawn(action, env)
+
+    def _spawn(self, action: Dict[str, Any], env: Dict[str, str]) -> None:
         # Line-buffered task stdout: log lines reach the file (and thus the
         # master) as they happen, not when a 8k block fills.
         env.setdefault("PYTHONUNBUFFERED", "1")
@@ -482,6 +587,8 @@ class AgentDaemon:
         task.start_time = stat[0] if stat else None
         with self._lock:
             self._tasks[task.alloc_id] = task
+            AGENT_TASKS_RUNNING.labels(self.agent_id).set(len(self._tasks))
+        AGENT_TASKS_STARTED.inc()
         self._write_state(task)
         self._spawn_task_threads(task)
         logger.info("started %s (pid %d)", task.alloc_id, proc.pid)
@@ -578,6 +685,7 @@ class AgentDaemon:
                     ],
                 },
             )
+            AGENT_LOG_LINES_SHIPPED.inc(len(sub))
             # +1 per newline; the final line may lack one (partial-line
             # ship at process death) — clamp to the data we actually had.
             consumed = min(total, consumed + sum(len(ln) + 1 for ln in sub))
@@ -616,6 +724,7 @@ class AgentDaemon:
             code = self._read_exit_file(task)
         with self._lock:
             self._tasks.pop(task.alloc_id, None)
+            AGENT_TASKS_RUNNING.labels(self.agent_id).set(len(self._tasks))
         if self._dead:
             return  # abrupt death: no goodbye (see die())
         # Let the follower drain the log tail before the master tears down
@@ -656,9 +765,10 @@ class AgentDaemon:
 
     def _report_exit(self, task: _Task, code: Optional[int]) -> None:
         if code is None:
-            code, reason = 1, "process lost (exit code unknown)"
+            code, reason, outcome = 1, "process lost (exit code unknown)", "lost"
         else:
             reason = "" if code == 0 else f"exit code {code}"
+            outcome = "clean" if code == 0 else "error"
         self.session.post(
             f"/api/v1/agents/{self.agent_id}/events",
             json_body={
@@ -666,6 +776,10 @@ class AgentDaemon:
                 "exit_code": code, "reason": reason,
             },
         )
+        # Counted AFTER the POST lands: a failed report requeues through
+        # _pending_exits and retries through here — counting first would
+        # inflate the series by one per retry during a master outage.
+        AGENT_TASK_EXITS.labels(outcome).inc()
         self._cleanup_state(task)
         logger.info("%s exited with %d", task.alloc_id, code)
 
@@ -720,12 +834,15 @@ def main() -> None:
                              "across agent restarts)")
     parser.add_argument("--token", default=os.environ.get("DTPU_TOKEN", ""),
                         help="auth token (when the master has users configured)")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="serve /metrics (+ /healthz) on this port "
+                             "(0 = ephemeral; omit to disable)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
     slots: Any = args.slots if args.slots == "auto" else int(args.slots)
     AgentDaemon(
         args.master_url, args.agent_id, slots, args.pool, token=args.token,
-        state_dir=args.state_dir,
+        state_dir=args.state_dir, metrics_port=args.metrics_port,
     ).run_forever()
 
 

@@ -23,6 +23,7 @@ from typing import Any, Dict, Optional
 import requests
 
 from determined_tpu.common import faults
+from determined_tpu.common import trace as trace_mod
 from determined_tpu.common.resilience import (
     API_RETRY,
     CircuitBreakerRegistry,
@@ -66,6 +67,11 @@ class Session:
             max_delay=API_RETRY.max_delay,
         )
         self._breakers = breakers or CircuitBreakerRegistry()
+        # Trace root: with no ambient span (bare CLI/SDK use), every call
+        # this Session makes still shares ONE trace — `det experiment
+        # create` and the polls that follow it reassemble into a single
+        # submit trace on the master side.
+        self._trace_root = (trace_mod.new_trace_id(), trace_mod.new_span_id())
         self._http = requests.Session()
         self._verify: Any = None
         if self.master_url.startswith("https:"):
@@ -107,6 +113,15 @@ class Session:
         site = f"api.{method.lower()}"
         breaker = self._breakers.get(_endpoint_key(method, path))
         req_headers = dict(headers or {})
+        # W3C trace propagation: the ambient span context (an active
+        # common.trace.span block, or the DTPU_TRACEPARENT a launched task
+        # inherited), else this Session's own root — the master extracts
+        # it and parents its request span, so one trace id follows the
+        # work across processes.
+        ctx = trace_mod.current() or self._trace_root
+        req_headers.setdefault(
+            "traceparent", trace_mod.format_traceparent(*ctx)
+        )
         if method in MUTATING_METHODS:
             # One id per LOGICAL request, shared by all its retries: the
             # master dedupes replays of a mutation whose first attempt

@@ -1,0 +1,560 @@
+"""Metrics registry: Counter/Gauge/Histogram with Prometheus exposition.
+
+Rebuild of the reference's Prometheus surface (`internal/prom/
+det_state_metrics.go:91` exports cluster-state gauges; the Go runtime
+brings counters/histograms via client_golang). The client_prometheus wheel
+isn't baked into this image, so the primitives are implemented directly
+with the same contract:
+
+- `Counter` (monotone, `inc`), `Gauge` (`set`/`inc`/`dec`), `Histogram`
+  (cumulative `le` buckets + `_sum`/`_count`), all with label support;
+- a process-global `REGISTRY` shared by every component living in the
+  process (master, agent, devcluster co-residents) — get-or-create
+  semantics so import order doesn't matter, with a hard error on a
+  name re-registered as a different type/label set (two components
+  fighting over one name is a bug, not a merge);
+- text exposition per the Prometheus 0.0.4 format: `# HELP`/`# TYPE`
+  lines, label escaping (backslash, quote, newline), NO `{}` on
+  label-less samples — the exact bugs the old hand-rolled
+  `prometheus_metrics` handler had (`dtpu_x{} 1`, no TYPE lines,
+  injection via unescaped label values);
+- `parse_exposition`: a STRICT text-format parser used by the tests as
+  the acceptance gate — anything `render()` emits must round-trip.
+
+Everything here is stdlib-only and cheap enough for hot paths: a counter
+inc is one lock + one float add.
+"""
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+#: Default latency buckets (seconds): control-plane requests live in the
+#: 1 ms – 10 s band; the +Inf bucket is implicit.
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _labels_text(pairs: Sequence[Tuple[str, str]]) -> str:
+    """`{a="x",b="y"}` — or the EMPTY string for no labels (a bare `{}`
+    is invalid under a strict parser)."""
+    if not pairs:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label_value(str(v))}"' for k, v in pairs
+    )
+    return "{" + inner + "}"
+
+
+class _Child:
+    """One labeled series of a family (or the single series of a
+    label-less family)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class _CounterChild(_Child):
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += amount
+
+
+class _GaugeChild(_Child):
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+
+class _HistogramChild:
+    __slots__ = ("_lock", "_buckets", "_counts", "_sum", "_count")
+
+    def __init__(self, buckets: Tuple[float, ...]) -> None:
+        self._lock = threading.Lock()
+        self._buckets = buckets
+        self._counts = [0] * len(buckets)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            # Per-bucket tally; render() emits the cumulative `le` series.
+            for i, b in enumerate(self._buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    break
+
+    def snapshot(self) -> Tuple[List[int], float, int]:
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
+
+class _Family:
+    """A named metric family: help text, type, label names, children."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Tuple[str, ...]):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        for ln in labelnames:
+            if not _LABEL_RE.match(ln):
+                raise ValueError(f"invalid label name {ln!r} on {name}")
+        self.name = name
+        self.help = help
+        self.labelnames = labelnames
+        self._children: Dict[Tuple[str, ...], Any] = {}
+        self._lock = threading.Lock()
+        if not labelnames:
+            # Label-less families expose their single series immediately
+            # (a counter that has never fired scrapes as 0, not absent —
+            # absence would read as "not instrumented").
+            self._children[()] = self._new_child()
+
+    def _new_child(self) -> Any:
+        raise NotImplementedError
+
+    def labels(self, *values: Any, **kv: Any) -> Any:
+        if kv:
+            if values:
+                raise ValueError("pass label values positionally OR by name")
+            try:
+                values = tuple(kv[ln] for ln in self.labelnames)
+            except KeyError as e:
+                raise ValueError(f"missing label {e} for {self.name}")
+            if len(kv) != len(self.labelnames):
+                raise ValueError(f"unexpected labels for {self.name}: {kv}")
+        vals = tuple(str(v) for v in values)
+        if len(vals) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name} expects labels {self.labelnames}, got {vals}"
+            )
+        with self._lock:
+            child = self._children.get(vals)
+            if child is None:
+                child = self._new_child()
+                self._children[vals] = child
+            return child
+
+    def clear(self) -> None:
+        """Drop every labeled series — for snapshot-style gauges whose
+        label sets shrink (an experiment state that no longer exists must
+        not linger at its last value)."""
+        with self._lock:
+            self._children.clear()
+
+    def remove(self, *labelvalues: Any) -> None:
+        """Drop one labeled series (e.g. a per-experiment gauge when the
+        experiment reaches a terminal state) — label sets keyed on live
+        entities must not grow without bound on a long-lived process."""
+        key = tuple(str(v) for v in labelvalues)
+        with self._lock:
+            self._children.pop(key, None)
+
+    def replace(self, series: Dict[Tuple[str, ...], float]) -> None:
+        """Atomically swap the whole family to `series` ({label-values
+        tuple: value}) — the snapshot-gauge refresh. clear()-then-set
+        would let a concurrent render of the shared registry observe the
+        family half-populated; the swap is one assignment under the lock."""
+        fresh: Dict[Tuple[str, ...], Any] = {}
+        for vals, value in series.items():
+            key = tuple(str(v) for v in vals)
+            if len(key) != len(self.labelnames):
+                raise ValueError(
+                    f"{self.name} expects labels {self.labelnames}, got {key}"
+                )
+            child = self._new_child()
+            child.set(value)  # type: ignore[attr-defined]
+            fresh[key] = child
+        with self._lock:
+            self._children = fresh
+
+    def _default_child(self) -> Any:
+        return self.labels()
+
+    def _iter_children(self) -> List[Tuple[Tuple[str, ...], Any]]:
+        with self._lock:
+            return list(self._children.items())
+
+    def render(self) -> List[str]:
+        lines = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        for vals, child in sorted(self._iter_children()):
+            pairs = list(zip(self.labelnames, vals))
+            lines.append(
+                f"{self.name}{_labels_text(pairs)} {_fmt_value(child.value)}"
+            )
+        return lines
+
+
+class Counter(_Family):
+    kind = "counter"
+
+    def _new_child(self) -> _CounterChild:
+        return _CounterChild()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default_child().inc(amount)
+
+    @property
+    def value(self) -> float:
+        return self._default_child().value
+
+
+class Gauge(_Family):
+    kind = "gauge"
+
+    def _new_child(self) -> _GaugeChild:
+        return _GaugeChild()
+
+    def set(self, value: float) -> None:
+        self._default_child().set(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default_child().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default_child().dec(amount)
+
+    @property
+    def value(self) -> float:
+        return self._default_child().value
+
+
+class Histogram(_Family):
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labelnames: Tuple[str, ...],
+        buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+    ) -> None:
+        b = tuple(sorted(float(x) for x in buckets))
+        if not b or any(
+            b[i] >= b[i + 1] for i in range(len(b) - 1)
+        ):
+            raise ValueError(f"buckets must be strictly increasing on {name}")
+        self.buckets = b
+        super().__init__(name, help, labelnames)
+
+    def _new_child(self) -> _HistogramChild:
+        return _HistogramChild(self.buckets)
+
+    def observe(self, value: float) -> None:
+        self._default_child().observe(value)
+
+    def render(self) -> List[str]:
+        lines = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        for vals, child in sorted(self._iter_children()):
+            pairs = list(zip(self.labelnames, vals))
+            counts, total, count = child.snapshot()
+            cum = 0
+            for b, c in zip(self.buckets, counts):
+                cum += c
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{_labels_text(pairs + [('le', _fmt_value(b))])} {cum}"
+                )
+            lines.append(
+                f"{self.name}_bucket"
+                f"{_labels_text(pairs + [('le', '+Inf')])} {count}"
+            )
+            lines.append(
+                f"{self.name}_sum{_labels_text(pairs)} {_fmt_value(total)}"
+            )
+            lines.append(f"{self.name}_count{_labels_text(pairs)} {count}")
+        return lines
+
+
+class MetricsRegistry:
+    """Name → family map with get-or-create registration.
+
+    Re-registering an existing name with the SAME kind/labels returns the
+    existing family (import-order independence for the process-global
+    registry); a mismatch raises — each name is defined exactly once."""
+
+    def __init__(self) -> None:
+        self._families: Dict[str, _Family] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(
+        self, cls: type, name: str, help: str,
+        labels: Sequence[str], **kw: Any,
+    ) -> Any:
+        labelnames = tuple(labels)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if (
+                    type(fam) is not cls
+                    or fam.labelnames != labelnames
+                    # Buckets are part of a histogram's contract too: a
+                    # second registrant with different buckets would
+                    # silently observe into the first one's layout.
+                    or (
+                        "buckets" in kw
+                        and tuple(sorted(float(b) for b in kw["buckets"]))
+                        != getattr(fam, "buckets", None)
+                    )
+                ):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(fam).__name__}{fam.labelnames} — each name "
+                        "is defined exactly once (same kind, labels, and "
+                        "buckets)"
+                    )
+                return fam
+            fam = cls(name, help, labelnames, **kw)
+            self._families[name] = fam
+            return fam
+
+    def counter(
+        self, name: str, help: str, labels: Sequence[str] = ()
+    ) -> Counter:
+        return self._get_or_create(Counter, name, help, labels)
+
+    def gauge(
+        self, name: str, help: str, labels: Sequence[str] = ()
+    ) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels)
+
+    def histogram(
+        self,
+        name: str,
+        help: str,
+        labels: Sequence[str] = (),
+        buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, help, labels, buckets=buckets
+        )
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._families)
+
+    def get(self, name: str) -> Optional[_Family]:
+        with self._lock:
+            return self._families.get(name)
+
+    def render(self) -> str:
+        with self._lock:
+            fams = [self._families[n] for n in sorted(self._families)]
+        out: List[str] = []
+        for fam in fams:
+            out.extend(fam.render())
+        return "\n".join(out) + "\n"
+
+
+#: The process-global registry: master, agent and any co-resident
+#: components register here; each serves it from its own /metrics.
+REGISTRY = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# Strict text-format parser — the acceptance gate for render() output.
+# ---------------------------------------------------------------------------
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # metric name
+    r"(?:\{(.*)\})?"                        # optional label block (non-empty)
+    r" (\S+)$"                              # value
+)
+_LABEL_PAIR_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"'
+)
+_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _unescape_label_value(v: str) -> str:
+    out, i = [], 0
+    while i < len(v):
+        ch = v[i]
+        if ch == "\\":
+            if i + 1 >= len(v):
+                raise ValueError("dangling backslash in label value")
+            nxt = v[i + 1]
+            if nxt == "n":
+                out.append("\n")
+            elif nxt in ('"', "\\"):
+                out.append(nxt)
+            else:
+                raise ValueError(f"bad escape \\{nxt} in label value")
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+def _parse_value(s: str) -> float:
+    if s == "+Inf":
+        return math.inf
+    if s == "-Inf":
+        return -math.inf
+    if s == "NaN":
+        return math.nan
+    return float(s)  # raises ValueError on garbage
+
+
+def parse_exposition(
+    text: str,
+) -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float]:
+    """STRICT Prometheus text-format (0.0.4) parse.
+
+    Enforces what lenient scrapers forgive: every sample's family must
+    have `# TYPE` (and `# HELP`) declared before it, label blocks must be
+    non-empty and well-escaped, no duplicate series, histogram suffixes
+    must belong to a histogram-typed family. Returns
+    {(sample_name, sorted label tuple): value}. Raises ValueError with
+    the offending line on any violation.
+    """
+    types: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    samples: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            if len(parts) < 3 or not _NAME_RE.match(parts[2]):
+                raise ValueError(f"line {lineno}: malformed HELP: {line!r}")
+            helps[parts[2]] = parts[3] if len(parts) > 3 else ""
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) != 4 or parts[3] not in (
+                "counter", "gauge", "histogram", "summary", "untyped"
+            ):
+                raise ValueError(f"line {lineno}: malformed TYPE: {line!r}")
+            if parts[2] in types:
+                raise ValueError(
+                    f"line {lineno}: duplicate TYPE for {parts[2]}"
+                )
+            types[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue  # comment
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: malformed sample: {line!r}")
+        name, labelblock, rawvalue = m.groups()
+        if labelblock is not None and labelblock == "":
+            raise ValueError(
+                f"line {lineno}: empty label block {{}} on {name}"
+            )
+        family = name
+        for suffix in _SUFFIXES:
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                family = name[: -len(suffix)]
+                if types[family] != "histogram":
+                    raise ValueError(
+                        f"line {lineno}: {name} uses histogram suffix but "
+                        f"{family} is a {types[family]}"
+                    )
+                break
+        if family not in types:
+            raise ValueError(
+                f"line {lineno}: sample {name} has no # TYPE declaration"
+            )
+        if family not in helps:
+            raise ValueError(
+                f"line {lineno}: sample {name} has no # HELP declaration"
+            )
+        labels: List[Tuple[str, str]] = []
+        if labelblock:
+            # Anchored sequential scan: every byte of the block must be a
+            # well-formed pair or a separating comma — finditer-style
+            # scanning would silently skip garbage between pairs, which is
+            # exactly what a STRICT parser must reject.
+            pos = 0
+            while pos < len(labelblock):
+                pm = _LABEL_PAIR_RE.match(labelblock, pos)
+                if pm is None:
+                    raise ValueError(
+                        f"line {lineno}: malformed label block: {line!r}"
+                    )
+                labels.append(
+                    (pm.group(1), _unescape_label_value(pm.group(2)))
+                )
+                pos = pm.end()
+                if pos < len(labelblock):
+                    if labelblock[pos] != ",":
+                        raise ValueError(
+                            f"line {lineno}: malformed label block: {line!r}"
+                        )
+                    pos += 1
+                    if pos == len(labelblock):
+                        raise ValueError(
+                            f"line {lineno}: trailing comma in label "
+                            f"block: {line!r}"
+                        )
+        try:
+            value = _parse_value(rawvalue)
+        except ValueError:
+            raise ValueError(f"line {lineno}: bad value {rawvalue!r}")
+        key = (name, tuple(sorted(labels)))
+        if key in samples:
+            raise ValueError(f"line {lineno}: duplicate series {key}")
+        samples[key] = value
+    return samples
+
+
+def sample_value(
+    samples: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float],
+    name: str,
+    **labels: str,
+) -> Optional[float]:
+    """Test helper: look up one series from parse_exposition output."""
+    return samples.get((name, tuple(sorted(labels.items()))))

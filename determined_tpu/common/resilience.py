@@ -32,6 +32,28 @@ from dataclasses import dataclass
 from typing import Any, Callable, Optional, Tuple, Type
 
 from determined_tpu.common.faults import InjectedFault
+from determined_tpu.common.metrics import REGISTRY
+
+# Observability (common/metrics.py): retries and breaker behavior are
+# exactly the events that were invisible before — a cluster quietly
+# riding its retry budget looks healthy until it falls over. Keys are
+# bounded by construction (fault-site names / normalized route shapes).
+RETRIES = REGISTRY.counter(
+    "dtpu_retries_total",
+    "Retry attempts taken by RetryPolicy.call, by policy key.",
+    labels=("key",),
+)
+CIRCUIT_STATE = REGISTRY.gauge(
+    "dtpu_circuit_state",
+    "Circuit-breaker state per endpoint: 0 closed, 1 half-open, 2 open.",
+    labels=("endpoint",),
+)
+CIRCUIT_OPENS = REGISTRY.counter(
+    "dtpu_circuit_opens_total",
+    "Circuit-breaker transitions into the open state, by endpoint.",
+    labels=("endpoint",),
+)
+_STATE_CODE = {"closed": 0.0, "half-open": 1.0, "open": 2.0}
 
 # Transient-infrastructure default: connection resets, timeouts, filesystem
 # hiccups, and injected faults. requests exceptions subclass OSError via
@@ -152,6 +174,7 @@ class RetryPolicy:
                     and clock() - start + pause > self.deadline_s
                 ):
                     raise
+                RETRIES.labels(key or "unkeyed").inc()
                 sleep(pause)
                 attempt += 1
 
@@ -228,6 +251,11 @@ class CircuitBreaker:
         ):
             self._s.state = "half-open"
             self._s.probing = False
+            self._set_state_gauge()
+
+    def _set_state_gauge(self) -> None:
+        if self.key:
+            CIRCUIT_STATE.labels(self.key).set(_STATE_CODE[self._s.state])
 
     def open_until(self) -> float:
         """Clock time when the next half-open probe is admitted (0.0 when
@@ -251,7 +279,12 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            was_open = self._s.state != "closed"
             self._s = _BreakerState()  # closed, streak cleared
+            if was_open:
+                # Gauge write only on a transition, not per request: the
+                # steady-state success path stays one lock + one assign.
+                self._set_state_gauge()
 
     def record_failure(self) -> None:
         with self._lock:
@@ -261,8 +294,13 @@ class CircuitBreaker:
                 self._s.state == "closed"
                 and self._s.failures >= self.failure_threshold
             ):
+                # Reaching here means state was half-open or closed, so
+                # this is always a genuine transition INTO open.
                 self._s.state = "open"
                 self._s.opened_at = self._clock()
+                self._set_state_gauge()
+                if self.key:
+                    CIRCUIT_OPENS.labels(self.key).inc()
 
     def call(self, fn: Callable[[], Any]) -> Any:
         """Run `fn` through the breaker: CircuitOpenError when open;

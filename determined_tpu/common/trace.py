@@ -1,0 +1,171 @@
+"""W3C trace-context propagation for the task/client plane.
+
+The master's span pipeline lives in `master/tracing.py` (OTLP-shaped
+exporters); this module is the THIN half every other process shares —
+CLI, SDK, agent, trial harness:
+
+- `parse_traceparent` / `format_traceparent`: the W3C `traceparent`
+  header (`00-<trace_id:32hex>-<span_id:16hex>-01`), the same contract
+  the reference gets from otelgin's propagators;
+- an ambient trace context: a contextvar seeded (lazily) from the
+  `DTPU_TRACEPARENT` env var — the launch layer injects it into every
+  task env, so a trial process is born INSIDE the trace that submitted
+  its experiment;
+- `span()`: a lightweight client-side span that derives a child context
+  (new span id, inherited trace id) and makes it ambient for the block.
+  When `DTPU_TRACE_FILE` is set the finished span is appended as one
+  OTLP-shaped JSON line (the same wire shape as the master's
+  JsonlExporter, so one `cat */spans.jsonl | sort` reassembles the whole
+  distributed trace); without it the span exists only as propagated ids
+  — zero I/O on the hot path.
+
+`Session` (common/api_session.py) stamps `traceparent` from the ambient
+context on every outgoing request, which is what parents the master's
+request spans back to the caller.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import logging
+import os
+import re
+import secrets
+import time
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+logger = logging.getLogger("determined_tpu.common")
+
+TRACEPARENT_ENV = "DTPU_TRACEPARENT"
+TRACE_FILE_ENV = "DTPU_TRACE_FILE"
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+#: (trace_id, span_id) of the current context, or None.
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "dtpu_trace_context", default=None
+)
+
+Context = Tuple[str, str]
+
+
+def new_trace_id() -> str:
+    return secrets.token_hex(16)
+
+
+def new_span_id() -> str:
+    return secrets.token_hex(8)
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[Context]:
+    """(trace_id, span_id) from a `traceparent` header, or None when the
+    header is absent/malformed (a bad header must be ignored, never 400 —
+    the W3C contract, and tracing must never break an API call)."""
+    if not value:
+        return None
+    m = _TRACEPARENT_RE.match(value.strip().lower())
+    if m is None:
+        return None
+    version, trace_id, span_id, _flags = m.groups()
+    if version == "ff":  # forbidden by the spec
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+def current() -> Optional[Context]:
+    """The ambient context: an active span() block, else the process's
+    inherited DTPU_TRACEPARENT (how a launched task parents its first
+    span back to the launch chain)."""
+    ctx = _current.get()
+    if ctx is not None:
+        return ctx
+    return parse_traceparent(os.environ.get(TRACEPARENT_ENV))
+
+
+def traceparent() -> Optional[str]:
+    ctx = current()
+    return format_traceparent(*ctx) if ctx is not None else None
+
+
+def _export(
+    name: str,
+    trace_id: str,
+    span_id: str,
+    parent_span_id: Optional[str],
+    start: float,
+    end: float,
+    attributes: Dict[str, Any],
+    error: bool,
+) -> None:
+    path = os.environ.get(TRACE_FILE_ENV)
+    if not path:
+        return
+    span = {
+        "traceId": trace_id,
+        "spanId": span_id,
+        **({"parentSpanId": parent_span_id} if parent_span_id else {}),
+        "name": name,
+        "startTimeUnixNano": int(start * 1e9),
+        "endTimeUnixNano": int(end * 1e9),
+        "attributes": [
+            {"key": k, "value": _attr_value(v)}
+            for k, v in attributes.items()
+        ],
+        "status": {"code": 2 if error else 1},
+    }
+    try:
+        # Whole-line appends are atomic at this size on POSIX, so agent
+        # and trial processes may share one file.
+        with open(path, "a") as f:
+            f.write(json.dumps(span) + "\n")
+    except OSError:  # tracing must never break the workload
+        logger.debug("trace export to %s failed", path, exc_info=True)
+
+
+def _attr_value(v: Any) -> Dict[str, Any]:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+@contextlib.contextmanager
+def span(
+    name: str,
+    attributes: Optional[Dict[str, Any]] = None,
+    parent: Optional[Context] = None,
+) -> Iterator[Context]:
+    """Client-side span: child of `parent` (explicit) or the ambient
+    context, root of a fresh trace otherwise. Yields (trace_id, span_id)
+    — ambient for the duration, so nested spans and Session requests
+    inherit it."""
+    ctx = parent if parent is not None else current()
+    trace_id = ctx[0] if ctx else new_trace_id()
+    parent_span_id = ctx[1] if ctx else None
+    span_id = new_span_id()
+    token = _current.set((trace_id, span_id))
+    start = time.time()
+    error = False
+    try:
+        yield trace_id, span_id
+    except BaseException:
+        error = True
+        raise
+    finally:
+        _current.reset(token)
+        _export(
+            name, trace_id, span_id, parent_span_id, start, time.time(),
+            dict(attributes or {}), error,
+        )

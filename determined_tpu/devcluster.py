@@ -29,6 +29,7 @@ class DevCluster:
         scheduler: Optional[Dict[str, Any]] = None,
         preempt_timeout_s: float = 120.0,
         tls: bool = False,
+        trace_file: Optional[str] = None,
     ) -> None:
         # Trial subprocesses must import determined_tpu without installation.
         repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -42,6 +43,7 @@ class DevCluster:
             db_path=db_path,
             pools_config={"default": {"scheduler": scheduler or {"type": "priority"}}},
             preempt_timeout_s=preempt_timeout_s,
+            trace_file=trace_file,
         )
         self._cert_env_prev: Optional[str] = None
         self._tls_dir: Optional[str] = None

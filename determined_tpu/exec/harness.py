@@ -15,6 +15,7 @@ import sys
 from typing import Any, Dict, Optional
 
 from determined_tpu import core
+from determined_tpu.common import trace
 from determined_tpu.parallel.mesh import MeshConfig, make_mesh
 from determined_tpu.trainer import Batch, Epoch, Trainer
 from determined_tpu.trainer._units import TrainUnit
@@ -80,7 +81,15 @@ def run(entrypoint: str) -> int:
 
     scfg = cfg.get("searcher", {})
     try:
-        with core.init() as ctx:
+        # Trial lifecycle span: child of the DTPU_TRACEPARENT the launch
+        # chain injected (master allocation span → agent launch span), and
+        # the ambient parent of every Session call the trial makes — the
+        # master's request spans for metric reports land in the SAME trace
+        # as the `det experiment create` that submitted this work.
+        with trace.span(
+            "trial.run",
+            {"trial.id": info.trial.trial_id, "task.id": info.task_id},
+        ), core.init() as ctx:
             tb_dir = None
             if cfg.get("tensorboard", True):
                 import tempfile

@@ -20,11 +20,62 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
-from determined_tpu.master.core import Master
+from determined_tpu.common import trace as trace_mod
+from determined_tpu.common.metrics import REGISTRY as METRICS
+from determined_tpu.master.core import EXPERIMENT_GOODPUT, Master
+from determined_tpu.master.db import TERMINAL_STATES
 
 logger = logging.getLogger("determined_tpu.master")
 
 Handler = Callable[["ApiRequest"], Any]
+
+# -- observability plane (common/metrics.py; ref internal/prom) --------------
+# Request metrics live on the ONE dispatch path every route flows through,
+# so coverage is structural: a new route is instrumented by existing
+# (tests/test_metrics_discipline.py asserts it stays that way). The route
+# label is the route PATTERN, not the raw path — bounded cardinality, the
+# same rule the request spans follow.
+API_REQUESTS = METRICS.counter(
+    "dtpu_api_requests_total",
+    "API requests by method, route pattern, and response status.",
+    labels=("method", "route", "status"),
+)
+API_LATENCY = METRICS.histogram(
+    "dtpu_api_request_duration_seconds",
+    "API request latency by method and route pattern (SSE streams are "
+    "observed at stream start — their open-ended duration is not latency).",
+    labels=("method", "route"),
+)
+# Cluster-state gauges (ref internal/prom/det_state_metrics.go:91),
+# refreshed from pool snapshots at scrape time.
+POOL_AGENTS = METRICS.gauge(
+    "dtpu_agents", "Registered agents per pool.", labels=("pool",))
+POOL_SLOTS_TOTAL = METRICS.gauge(
+    "dtpu_slots_total", "Total slots per pool.", labels=("pool",))
+POOL_SLOTS_USED = METRICS.gauge(
+    "dtpu_slots_used", "Slots in use per pool.", labels=("pool",))
+POOL_ALLOCS_PENDING = METRICS.gauge(
+    "dtpu_allocations_pending", "Queued allocations per pool.",
+    labels=("pool",))
+POOL_ALLOCS_RUNNING = METRICS.gauge(
+    "dtpu_allocations_running", "Running allocations per pool.",
+    labels=("pool",))
+EXPERIMENTS_BY_STATE = METRICS.gauge(
+    "dtpu_experiments", "Experiments by state.", labels=("state",))
+# Sentinel events (PR 3) as they reach the control plane: the trainer
+# reports cumulative steps_skipped/rollbacks in its training metrics;
+# the master folds the per-trial deltas into cluster counters.
+SENTINEL_STEPS_SKIPPED = METRICS.counter(
+    "dtpu_sentinel_steps_skipped_total",
+    "Optimizer updates skipped by the non-finite guard, cluster-wide.",
+)
+SENTINEL_ROLLBACKS = METRICS.counter(
+    "dtpu_sentinel_rollbacks_total",
+    "Sentinel rollback-and-skip events, cluster-wide.",
+)
+# dtpu_experiment_goodput_pct lives in master/core.py (EXPERIMENT_GOODPUT):
+# the terminal-state hook there prunes an experiment's series when it ends,
+# keeping the per-experiment label set bounded on a long-lived master.
 
 #: hard cap on any request body (context uploads are the largest legitimate
 #: payload; their own cap is slightly smaller so the error is specific).
@@ -299,6 +350,69 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
             raise ApiError(404, f"experiment {row['experiment_id']} not loaded")
         return exp
 
+    # Per-trial last-seen cumulative sentinel counters, for delta-folding
+    # into the cluster counters (trainers report lifetime totals; a
+    # counter must only ever go up by the increment). True LRU: overflow
+    # evicts the least-recently-reporting trial (usually finished) — a
+    # wholesale clear would re-count every live trial's full history on
+    # its next report.
+    from collections import OrderedDict as _OrderedDict
+
+    sentinel_seen: "_OrderedDict[int, Tuple[float, float]]" = _OrderedDict()
+    sentinel_lock = threading.Lock()
+    SENTINEL_SEEN_CAP = 8192
+
+    def _ingest_sentinel(trial_id: int, metrics: Dict[str, Any]) -> None:
+        skips = metrics.get("steps_skipped")
+        rollbacks = metrics.get("rollbacks")
+        if not isinstance(skips, (int, float)) and not isinstance(
+            rollbacks, (int, float)
+        ):
+            return
+        def delta(cur: float, prev: float) -> float:
+            # Standard counter-reset handling: trainer counters are
+            # process-lifetime (not persisted), so a restarted trial
+            # reports from 0 again under the same trial id — a drop means
+            # reset, and the whole new value is fresh increment.
+            if cur >= prev:
+                return cur - prev
+            return cur
+
+        with sentinel_lock:
+            prev_s, prev_r = sentinel_seen.get(trial_id, (0.0, 0.0))
+            s = float(skips) if isinstance(skips, (int, float)) else prev_s
+            rb = (
+                float(rollbacks)
+                if isinstance(rollbacks, (int, float)) else prev_r
+            )
+            d_s, d_r = delta(s, prev_s), delta(rb, prev_r)
+            sentinel_seen[trial_id] = (s, rb)
+            sentinel_seen.move_to_end(trial_id)
+            while len(sentinel_seen) > SENTINEL_SEEN_CAP:
+                sentinel_seen.popitem(last=False)
+        if d_s > 0:
+            SENTINEL_STEPS_SKIPPED.inc(d_s)
+        if d_r > 0:
+            SENTINEL_ROLLBACKS.inc(d_r)
+
+    # trial -> experiment resolution cache for the goodput gauge: the
+    # mapping is immutable for a trial's lifetime, and a DB lookup per
+    # profiling report would ride the hot metrics-ingest path otherwise.
+    goodput_exp_cache: Dict[int, str] = {}
+
+    def _experiment_of(trial_id: int) -> Optional[str]:
+        exp = goodput_exp_cache.get(trial_id)
+        if exp is None:
+            row = m.db.get_trial(trial_id)
+            if row is None:
+                return None
+            exp = str(row["experiment_id"])
+            with sentinel_lock:
+                if len(goodput_exp_cache) > SENTINEL_SEEN_CAP:
+                    goodput_exp_cache.clear()  # id map: cheap to rebuild
+                goodput_exp_cache[trial_id] = exp
+        return exp
+
     # -- harness: metrics/progress/status -----------------------------------
     def post_metrics(r: ApiRequest):
         trial_id = int(r.groups[0])
@@ -312,7 +426,31 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
             trial_run_id=int(r.body.get("trial_run_id", 0)),
             report_time=r.body.get("report_time"),
         )
+        if group == "training":
+            _ingest_sentinel(trial_id, metrics)
         if group == "profiling":
+            # Surface the trainer timeline's goodput per experiment on the
+            # master's own /metrics (the ledger travels as a profiling
+            # metric; the gauge shows the experiment's latest report).
+            gp = metrics.get("goodput_pct")
+            if isinstance(gp, (int, float)):
+                exp_label = _experiment_of(trial_id)
+                # Live experiments only: a report in flight across the
+                # terminal transition (or a resilience-layer replay) must
+                # not resurrect the series the terminal-state hook pruned
+                # — that would leak one labeled series per race, forever.
+                live = (
+                    m.get_experiment(int(exp_label))
+                    if exp_label is not None else None
+                )
+                if live is not None and live.state not in TERMINAL_STATES:
+                    EXPERIMENT_GOODPUT.labels(exp_label).set(float(gp))
+                    if live.state in TERMINAL_STATES:
+                        # The experiment went terminal between the check
+                        # and the set — the prune hook may have already
+                        # fired, so undo our own write (check-then-set
+                        # alone would leak the series forever).
+                        EXPERIMENT_GOODPUT.remove(exp_label)
             # Feed device HBM utilization to profiling-driven searchers
             # (autotune's microbatch-jump heuristic; experiment.report_hbm
             # no-ops for every other method).
@@ -716,9 +854,19 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         return {}
 
     # -- experiments (user/CLI) -------------------------------------------------
+    def _submit_trace(r: ApiRequest):
+        """The submitting request's trace context: passed INTO experiment
+        creation so allocation spans and launched-task env
+        (DTPU_TRACEPARENT) parent back to it — recorded before the first
+        scheduler tick can launch anything, so one trace id spans submit →
+        schedule → launch → first trial step with no race."""
+        return trace_mod.parse_traceparent(r.headers.get("traceparent"))
+
     def create_experiment(r: ApiRequest):
         try:
-            exp_id = m.create_experiment(r.body["config"])
+            exp_id = m.create_experiment(
+                r.body["config"], traceparent=_submit_trace(r)
+            )
         except ValueError as e:
             raise ApiError(400, str(e))
         return {"id": exp_id}
@@ -852,7 +1000,9 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
                 raise ApiError(400, f"checkpoint {ckpt} is {row.get('state')}")
             config["warm_start_checkpoint"] = str(ckpt)
         try:
-            new_id = m.create_experiment(config)
+            new_id = m.create_experiment(
+                config, traceparent=_submit_trace(r)
+            )
         except ValueError as e:
             raise ApiError(400, str(e))
         return {"id": new_id, "forked_from": src["id"],
@@ -1307,29 +1457,35 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         raise _PlainText(PAGE, content_type="text/html; charset=utf-8")
 
     def prometheus_metrics(r: ApiRequest):
-        # Cluster-state gauges in Prometheus text format (ref:
-        # internal/prom/det_state_metrics.go:91 — allocation/slot gauges).
-        lines = []
-
-        def gauge(name: str, value: float, labels: str = "") -> None:
-            lines.append(f"dtpu_{name}{{{labels}}} {value}")
-
+        # The process-global registry (common/metrics.py) in strict
+        # Prometheus text format — counters/histograms accrue continuously
+        # from the instrumented paths; the cluster-state gauges below
+        # (ref: internal/prom/det_state_metrics.go:91) are refreshed from
+        # pool snapshots at scrape time. This replaces the hand-rolled
+        # exposition whose output (`dtpu_x{} 1`, no HELP/TYPE, unescaped
+        # labels) a strict parser rejected.
         for pool_name, pool in m.rm.pools.items():
             agents = pool.agents_snapshot()
-            gauge("agents", len(agents), f'pool="{pool_name}"')
-            gauge("slots_total", sum(a["slots"] for a in agents.values()),
-                  f'pool="{pool_name}"')
-            gauge("slots_used", sum(a["used"] for a in agents.values()),
-                  f'pool="{pool_name}"')
+            POOL_AGENTS.labels(pool_name).set(len(agents))
+            POOL_SLOTS_TOTAL.labels(pool_name).set(
+                sum(a["slots"] for a in agents.values())
+            )
+            POOL_SLOTS_USED.labels(pool_name).set(
+                sum(a["used"] for a in agents.values())
+            )
             q = pool.queue_snapshot()
-            gauge("allocations_pending", len(q["pending"]), f'pool="{pool_name}"')
-            gauge("allocations_running", len(q["running"]), f'pool="{pool_name}"')
+            POOL_ALLOCS_PENDING.labels(pool_name).set(len(q["pending"]))
+            POOL_ALLOCS_RUNNING.labels(pool_name).set(len(q["running"]))
         by_state: Dict[str, int] = {}
         for e in m.db.list_experiments():
             by_state[e["state"]] = by_state.get(e["state"], 0) + 1
-        for state, n in sorted(by_state.items()):
-            gauge("experiments", n, f'state="{state}"')
-        raise _PlainText("\n".join(lines) + "\n")
+        # Atomic swap: a state that emptied out must drop from the
+        # exposition, and a CONCURRENT render (second scrape, co-resident
+        # agent metrics server) must never observe the family mid-rebuild.
+        EXPERIMENTS_BY_STATE.replace(
+            {(state,): float(n) for state, n in by_state.items()}
+        )
+        raise _PlainText(METRICS.render())
 
     R = lambda method, pat, h: (method, re.compile(f"^{pat}$"), h)  # noqa: E731
     return [
@@ -1653,23 +1809,54 @@ class ApiServer:
                         # One span per API request (the gin-middleware
                         # analog of the reference's otel wiring); the route
                         # PATTERN names the span, not the raw path —
-                        # bounded-cardinality names are the OTel norm.
+                        # bounded-cardinality names are the OTel norm. An
+                        # incoming W3C `traceparent` (harness Session, CLI,
+                        # agent) becomes the span's remote parent, so the
+                        # caller's trace continues through the master.
                         span = master.tracer.start_span(
                             f"http {method} {pat.pattern}",
                             {"http.method": method, "http.target": parsed.path},
+                            parent=trace_mod.parse_traceparent(
+                                self.headers.get("traceparent")
+                            ),
                         )
+                        t_start = time.monotonic()
+                        finished = False
+
+                        def finish(status: int) -> None:
+                            # ONE latency/status observation + span end per
+                            # request, wherever it completes (success, error
+                            # branch, or SSE stream start). Lives on the
+                            # shared dispatch path, so every route is
+                            # observed by construction
+                            # (tests/test_metrics_discipline.py).
+                            nonlocal finished
+                            if finished:
+                                return
+                            finished = True
+                            span.set_attribute("http.status_code", status)
+                            master.tracer.end_span(span)
+                            API_LATENCY.labels(method, pat.pattern).observe(
+                                time.monotonic() - t_start
+                            )
+                            API_REQUESTS.labels(
+                                method, pat.pattern, str(status)
+                            ).inc()
+
                         status_code = 200
                         try:
-                            result = handler(
-                                ApiRequest(
-                                    match.groups(), body,
-                                    parse_qs(parsed.query), token=token,
-                                    client_ip=self.client_address[0],
-                                    raw=raw,
-                                    headers=dict(self.headers.items()),
+                            # activate(): master-internal spans started by
+                            # the handler parent under the request span.
+                            with master.tracer.activate(span):
+                                result = handler(
+                                    ApiRequest(
+                                        match.groups(), body,
+                                        parse_qs(parsed.query), token=token,
+                                        client_ip=self.client_address[0],
+                                        raw=raw,
+                                        headers=dict(self.headers.items()),
+                                    )
                                 )
-                            )
-                            span.set_attribute("http.status_code", 200)
                             if idem_key:
                                 idempotency.put(
                                     idem_key,
@@ -1690,7 +1877,12 @@ class ApiServer:
                         except _EventStream as es:
                             # SSE: one response, chunk per event, connection
                             # closed at generator exhaustion (no keep-alive
-                            # reuse — the stream owns the socket).
+                            # reuse — the stream owns the socket). Observed
+                            # at stream START: a follow stream's lifetime is
+                            # client-chosen and unbounded — recording it as
+                            # "latency" would poison the histogram.
+                            span.set_attribute("http.stream", True)
+                            finish(200)
                             self.send_response(200)
                             self.send_header(
                                 "Content-Type", "text/event-stream"
@@ -1726,22 +1918,19 @@ class ApiServer:
                             status_code = 0
                         except ApiError as e:
                             status_code = e.status
-                            span.set_attribute("http.status_code", e.status)
                             if e.status >= 500:
                                 span.status = "ERROR"
                             self._send(e.status, {"error": str(e)})
                         except KeyError as e:
                             status_code = 404
-                            span.set_attribute("http.status_code", 404)
                             self._send(404, {"error": f"not found: {e}"})
                         except Exception as e:  # noqa: BLE001
                             status_code = 500
                             span.status = "ERROR"
-                            span.set_attribute("http.status_code", 500)
                             logger.exception("handler error %s %s", method, parsed.path)
                             self._send(500, {"error": str(e)})
                         finally:
-                            master.tracer.end_span(span)
+                            finish(status_code)
                             # Append-only audit of every mutating API call
                             # (ref internal/audit.go): who, what, outcome.
                             # Machine traffic is churn, not user action —

@@ -18,6 +18,8 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from determined_tpu import _info
+from determined_tpu.common import trace as trace_mod
+from determined_tpu.common.metrics import REGISTRY as METRICS
 from determined_tpu.master import checkpoint_gc, db as db_mod
 from determined_tpu.master.allocation import AllocationService
 from determined_tpu.master.experiment import Experiment, TrialRecord
@@ -27,6 +29,23 @@ from determined_tpu.master.scheduler import Request
 from determined_tpu.master.webhooks import WebhookShipper
 
 logger = logging.getLogger("determined_tpu.master")
+
+#: Stall-watchdog kills by attribution (common/metrics.py): "infra" =
+#: vanished/straggling peer (requeue, no restart-budget charge), "budget" =
+#: uniform stall (workload hang, budget-charged).
+STALL_KILLS = METRICS.counter(
+    "dtpu_sentinel_stall_kills_total",
+    "Gang allocations killed by the stall watchdog, by attribution.",
+    labels=("attribution",),
+)
+#: Set from trial profiling reports (api_server post_metrics); the series
+#: is PRUNED when its experiment reaches a terminal state (_on_exp_state)
+#: so per-experiment labels stay bounded on a long-lived master.
+EXPERIMENT_GOODPUT = METRICS.gauge(
+    "dtpu_experiment_goodput_pct",
+    "Latest goodput percentage from each experiment's timeline ledger.",
+    labels=("experiment",),
+)
 
 
 class AgentHub:
@@ -443,6 +462,10 @@ class Master:
         self._trial_allocs: Dict[int, str] = {}    # trial_id -> latest alloc_id
         self._alloc_pool: Dict[str, str] = {}      # alloc_id -> pool name
         self._alloc_spans: Dict[str, Any] = {}     # alloc_id -> tracing span
+        #: experiment_id -> (trace_id, span_id) of the submitting request
+        #: (W3C traceparent): allocation spans and launched-task env
+        #: parent back to it — one trace from submit to first trial step.
+        self._exp_traceparents: Dict[int, tuple] = {}
         self._commands: Dict[str, Dict[str, Any]] = {}  # task_id -> command info
         self._cmd_counter = 0
         self._provisioners: List[Any] = []  # ProvisionerService
@@ -487,6 +510,15 @@ class Master:
     def _on_exp_state(self, exp: Experiment, state: str) -> None:
         self.webhooks.notify(exp.id, state, exp.config)
         if state in db_mod.TERMINAL_STATES:
+            # Terminal experiments launch nothing further; drop the submit
+            # trace context so the map stays bounded on a long-lived
+            # master. Lockless pop: this hook fires under the experiment
+            # lock, and dict.pop is atomic — taking master._lock here
+            # would invert the usual master→experiment lock order.
+            self._exp_traceparents.pop(exp.id, None)
+            # Same boundedness for the per-experiment goodput series: a
+            # finished experiment must not scrape forever at its last value.
+            EXPERIMENT_GOODPUT.remove(str(exp.id))
             config = exp.config
             exp_id = exp.id
             self._work.put(
@@ -548,16 +580,30 @@ class Master:
         )
         # Allocation lifecycle span (explicit start/end — completes in
         # _allocation_exited, the long-span pattern of the reference's otel
-        # instrumentation).
+        # instrumentation), parented to the experiment's SUBMIT trace when
+        # one was recorded — scheduling shows up inside the user's trace.
+        submit_ctx = None
+        if trial_info is not None:
+            with self._lock:
+                submit_ctx = self._exp_traceparents.get(
+                    trial_info.experiment_id
+                )
         span = self.tracer.start_span(
             "allocation",
             {
                 "alloc.id": alloc_id, "task.id": task_id,
                 "task.type": task_type, "slots": slots,
             },
+            parent=submit_ctx,
         )
         with self._lock:
             self._alloc_spans[alloc_id] = span
+        # Trace context for the launched task: the allocation span when a
+        # real tracer minted one, else the submit context pass-through —
+        # propagation works even on a master with no exporter configured.
+        task_ctx = submit_ctx
+        if getattr(span, "trace_id", ""):
+            task_ctx = (span.trace_id, span.span_id)
         rank_envs: List[tuple] = []
         for rank, agent_id in enumerate(hosts):
             info = _info.ClusterInfo(
@@ -587,6 +633,13 @@ class Master:
                 if not str(k).startswith("DTPU_") or str(k) == "DTPU_SHELL_TOKEN"
             }
             env = {**user_env, **env}
+            if task_ctx is not None:
+                # W3C trace context rides the task env: the agent parents
+                # its launch span from it, the trial's core.init Session
+                # stamps it on every API call (common/trace.py).
+                env[trace_mod.TRACEPARENT_ENV] = (
+                    trace_mod.format_traceparent(*task_ctx)
+                )
             if config.get("context"):
                 env["DTPU_CONTEXT_ID"] = str(config["context"])
             rank_envs.append((agent_id, env))
@@ -653,6 +706,16 @@ class Master:
                     self.auth.sweep()
             except Exception:  # noqa: BLE001
                 logger.exception("tick loop error")
+
+    def set_experiment_traceparent(
+        self, exp_id: int, ctx: Optional[tuple]
+    ) -> None:
+        """Remember the submitting request's trace context (api_server
+        create/fork handlers) so later allocations join the same trace."""
+        if ctx is None:
+            return
+        with self._lock:
+            self._exp_traceparents[exp_id] = ctx
 
     def record_heartbeat(self, trial_id: int) -> None:
         with self._lock:
@@ -760,6 +823,7 @@ class Master:
                 self.kill_allocation(alloc_id)
             except Exception:  # noqa: BLE001 — attribution must still land
                 logger.exception("stall kill failed for %s", alloc_id)
+            STALL_KILLS.labels("infra" if infra else "budget").inc()
             self.alloc_service.complete(
                 alloc_id, exit_code=1, reason=reason, infra=infra
             )
@@ -997,6 +1061,10 @@ class Master:
                 num_processes=int(row.get("num_processes") or 1),
                 slots=int(row.get("slots") or 0),
             )
+        # root=True: this runs synchronously inside the agent-register
+        # request (whose span is ambient via activate()); the adopted
+        # allocation's long span must root its own trace, not be misfiled
+        # under a transient re-registration request.
         span = self.tracer.start_span(
             "allocation",
             {
@@ -1004,6 +1072,7 @@ class Master:
                 "task.type": "TRIAL", "slots": row.get("slots"),
                 "adopted": True,
             },
+            root=True,
         )
         with self._lock:
             self._alloc_spans.setdefault(alloc_id, span)
@@ -1470,7 +1539,9 @@ class Master:
         self.kick_tick()
 
     # -- experiments -----------------------------------------------------------
-    def create_experiment(self, config: Dict[str, Any]) -> int:
+    def create_experiment(
+        self, config: Dict[str, Any], traceparent: Optional[tuple] = None
+    ) -> int:
         from determined_tpu.master import expconf
 
         # Template resolution first (ref master/internal/template/,
@@ -1494,6 +1565,11 @@ class Master:
         exp_id = self.db.add_experiment(config)
         if config.get("project_id"):
             self.db.set_experiment_project(exp_id, int(config["project_id"]))
+        # Submit trace context recorded BEFORE exp.start(): the launcher
+        # kicks the scheduler immediately, and an allocation launched
+        # before the mapping lands would root its own trace instead of
+        # continuing the submitter's.
+        self.set_experiment_traceparent(exp_id, traceparent)
         exp = Experiment(exp_id, config, self.db, self.launcher)
         exp.on_state_change = self._on_exp_state
         with self._lock:

@@ -17,7 +17,19 @@ import threading
 import time
 from typing import Any, Dict, List
 
+from determined_tpu.common.metrics import REGISTRY as METRICS
+
 logger = logging.getLogger("determined_tpu.master")
+
+LOGSINK_SHIPPED = METRICS.counter(
+    "dtpu_logsink_shipped_lines_total",
+    "Log lines delivered to the external sink via _bulk.",
+)
+LOGSINK_DROPPED = METRICS.counter(
+    "dtpu_logsink_dropped_lines_total",
+    "Log lines dropped by the sink (queue overflow or sink unreachable); "
+    "the SQLite system of record retains them.",
+)
 
 
 class ElasticLogSink:
@@ -78,6 +90,7 @@ class ElasticLogSink:
             try:
                 self._q.put_nowait(doc)
             except queue.Full:
+                LOGSINK_DROPPED.inc()
                 with self._dropped_lock:
                     self._dropped += 1
                     self._inflight -= 1
@@ -262,7 +275,9 @@ class ElasticLogSink:
                 continue
             try:
                 self._post_bulk(docs)
+                LOGSINK_SHIPPED.inc(len(docs))
             except Exception:  # noqa: BLE001 — sink loss must not cascade
+                LOGSINK_DROPPED.inc(len(docs))
                 with self._dropped_lock:
                     self._dropped += len(docs)
                 logger.warning(
@@ -286,7 +301,9 @@ class ElasticLogSink:
                 # Cap the post itself at the remaining budget: a single
                 # slow request must not overrun the drain budget 4x.
                 self._post_bulk(docs, timeout=remaining)
+                LOGSINK_SHIPPED.inc(len(docs))
             except Exception:  # noqa: BLE001
+                LOGSINK_DROPPED.inc(len(docs))
                 break
             finally:
                 self._settle(len(docs))

@@ -12,8 +12,10 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
+from determined_tpu.common.metrics import REGISTRY as METRICS
 from determined_tpu.master.scheduler import (
     Agent,
     Assignment,
@@ -32,12 +34,27 @@ UNSET = object()
 StartCb = Callable[[Request, Assignment], None]
 PreemptCb = Callable[[str], None]
 
+# Scheduling observability (common/metrics.py): where queue latency goes
+# is the first question every capacity incident asks.
+SCHED_QUEUE_DEPTH = METRICS.gauge(
+    "dtpu_sched_queue_depth",
+    "Pending allocation requests per pool (updated every tick).",
+    labels=("pool",),
+)
+SCHED_TIME_TO_SCHEDULE = METRICS.histogram(
+    "dtpu_sched_time_to_schedule_seconds",
+    "Submit-to-placement latency per pool.",
+    labels=("pool",),
+    buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0),
+)
+
 
 @dataclasses.dataclass
 class _Entry:
     request: Request
     on_start: StartCb
     on_preempt: PreemptCb
+    submitted_at: float = 0.0  # monotonic; 0 for adopted placements
 
 
 class ResourcePool:
@@ -192,7 +209,10 @@ class ResourcePool:
         with self._lock:
             self._order += 1
             request.order = self._order
-            self._entries[request.alloc_id] = _Entry(request, on_start, on_preempt)
+            self._entries[request.alloc_id] = _Entry(
+                request, on_start, on_preempt,
+                submitted_at=time.monotonic(),
+            )
             self._pending.append(request.alloc_id)
         self.tick()
 
@@ -224,6 +244,7 @@ class ResourcePool:
                 assignments=self._running,
             )
             decision: Decision = self.scheduler.schedule(state)
+            now = time.monotonic()
             for req, asg in decision.to_start:
                 if req.alloc_id not in self._pending:
                     continue
@@ -231,11 +252,17 @@ class ResourcePool:
                 self._running[req.alloc_id] = asg
                 for agent_id, n in asg.items():
                     self._agents[agent_id].used[req.alloc_id] = n
-                to_fire.append(("start", self._entries[req.alloc_id], asg))
+                entry = self._entries[req.alloc_id]
+                if entry.submitted_at:
+                    SCHED_TIME_TO_SCHEDULE.labels(self.name).observe(
+                        now - entry.submitted_at
+                    )
+                to_fire.append(("start", entry, asg))
             for alloc_id in decision.to_preempt:
                 entry = self._entries.get(alloc_id)
                 if entry is not None:
                     to_fire.append(("preempt", entry, None))
+            SCHED_QUEUE_DEPTH.labels(self.name).set(len(self._pending))
         # Callbacks outside the lock: they reach into allocation/agent layers.
         for kind, entry, asg in to_fire:
             try:

@@ -24,7 +24,7 @@ import logging
 import secrets
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 logger = logging.getLogger("determined_tpu.master")
 
@@ -163,9 +163,12 @@ class Tracer:
     # -- span lifecycle ----------------------------------------------------
     @contextlib.contextmanager
     def span(
-        self, name: str, attributes: Optional[Dict[str, Any]] = None
+        self,
+        name: str,
+        attributes: Optional[Dict[str, Any]] = None,
+        parent: Optional[Tuple[str, str]] = None,
     ) -> Iterator[Span]:
-        s = self.start_span(name, attributes)
+        s = self.start_span(name, attributes, parent=parent)
         token = _current_span.set(s)
         try:
             yield s
@@ -176,16 +179,51 @@ class Tracer:
             _current_span.reset(token)
             self.end_span(s)
 
+    @contextlib.contextmanager
+    def activate(self, span: Span) -> Iterator[Span]:
+        """Make an already-started span the ambient parent for the block
+        (the dispatcher's request span wraps handler work it does not
+        lexically contain)."""
+        token = _current_span.set(span)
+        try:
+            yield span
+        finally:
+            _current_span.reset(token)
+
     def start_span(
-        self, name: str, attributes: Optional[Dict[str, Any]] = None
+        self,
+        name: str,
+        attributes: Optional[Dict[str, Any]] = None,
+        parent: Optional[Tuple[str, str]] = None,
+        root: bool = False,
     ) -> Span:
-        parent: Optional[Span] = _current_span.get()
         if parent is not None:
-            return Span(name, parent.trace_id, parent.span_id, attributes)
+            # Remote parent: a W3C traceparent extracted from an incoming
+            # request (common/trace.py) — the caller's trace continues
+            # through this process instead of starting a fresh root.
+            return Span(name, parent[0], parent[1], attributes)
+        if not root:
+            ambient: Optional[Span] = _current_span.get()
+            if ambient is not None:
+                return Span(
+                    name, ambient.trace_id, ambient.span_id, attributes
+                )
+        # root=True: a long-lived span that happens to START on a request
+        # thread (adopted allocation inside agent-register) must not be
+        # misfiled under that transient request's trace.
         return Span(name, secrets.token_hex(16), None, attributes)
 
     def end_span(self, span: Span) -> None:
         span.end = time.time()
+        if self._stop.is_set():
+            # Stopped tracer (master shutdown in progress): the batch
+            # pipeline is gone, so export inline — spans ended by
+            # lingering request threads must not vanish into a dead queue.
+            try:
+                self.exporter.export([span])
+            except Exception:  # noqa: BLE001
+                logger.exception("post-stop span export failed")
+            return
         with self._lock:
             self._batch.append(span)
             full = len(self._batch) >= self._batch_size
@@ -223,10 +261,15 @@ class NullTracer:
     """Tracing disabled: same surface, zero work on the hot path."""
 
     @contextlib.contextmanager
-    def span(self, name: str, attributes: Optional[Dict[str, Any]] = None):
+    def span(self, name: str, attributes: Optional[Dict[str, Any]] = None,
+             parent: Optional[Tuple[str, str]] = None):
         yield _NULL_SPAN
 
-    def start_span(self, name, attributes=None):
+    @contextlib.contextmanager
+    def activate(self, span):
+        yield span
+
+    def start_span(self, name, attributes=None, parent=None, root=False):
         return _NULL_SPAN
 
     def end_span(self, span) -> None:

@@ -98,6 +98,11 @@ class ProfilerAgent:
         self._max_reports = max_reports
         self._enabled = enabled
         self._samples: List[Dict[str, float]] = []
+        # Guards _samples: the sampler thread appends while stop() (the
+        # trainer's thread) flushes — unsynchronized, the final flush could
+        # read a list mid-append and the post-flush reset could drop a
+        # sample the sampler was just adding.
+        self._samples_lock = threading.Lock()
         self._reports_sent = 0
         self._steps_completed = 0
         self._stop = threading.Event()
@@ -143,16 +148,24 @@ class ProfilerAgent:
         while not self._stop.wait(self._interval):
             if self._reports_sent >= self._max_reports:
                 return  # hard cap, like the reference's auto-disable
-            self._samples.append(self._sample())
-            if len(self._samples) >= self._report_every:
+            sample = self._sample()
+            with self._samples_lock:
+                self._samples.append(sample)
+                full = len(self._samples) >= self._report_every
+            if full:
                 self._flush()
 
     def _flush(self) -> None:
-        if not self._samples:
+        # Swap under the lock, aggregate outside it: a concurrent sampler
+        # append lands in the fresh list instead of racing the one being
+        # averaged (the old code mutated _samples from two threads).
+        with self._samples_lock:
+            samples, self._samples = self._samples, []
+        if not samples:
             return
-        keys = set().union(*(s.keys() for s in self._samples))
+        keys = set().union(*(s.keys() for s in samples))
         avg = {
-            k: sum(s.get(k, 0.0) for s in self._samples) / len(self._samples)
+            k: sum(s.get(k, 0.0) for s in samples) / len(samples)
             for k in keys
         }
         try:
@@ -160,7 +173,6 @@ class ProfilerAgent:
             self._reports_sent += 1
         except Exception as e:  # noqa: BLE001
             logger.warning("profiler report failed: %s", e)
-        self._samples = []
 
     def stop(self) -> None:
         self._stop.set()

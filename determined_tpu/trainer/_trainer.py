@@ -35,6 +35,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from determined_tpu import core as core_mod
+from determined_tpu.common import trace as trace_mod
 from determined_tpu.core._searcher import DummySearcherContext
 from determined_tpu.models.base import Model
 from determined_tpu.parallel.mesh import batch_axes, make_mesh
@@ -45,6 +46,7 @@ from determined_tpu.parallel.sharding import (
 )
 from determined_tpu.trainer import _checkpoint as ckpt_io
 from determined_tpu.trainer import _sentinel
+from determined_tpu.trainer import _timeline
 from determined_tpu.trainer._trial import JAXTrial
 from determined_tpu.trainer._units import Batch, TrainUnit, to_batches
 
@@ -123,6 +125,13 @@ class Trainer:
         #: metadata so a process restart fast-forwards identically.
         self._data_offset = 0
         self._data_consumed = 0     # absolute batch cursor (fit-local)
+        # Step-phase timer + goodput ledger (trainer/_timeline.py): phase
+        # accumulators settle at report boundaries (no per-step host
+        # sync); the ledger rides the trainer metadata across restarts.
+        self.timeline = _timeline.Timeline()
+        #: a rollback restore must NOT reload the checkpoint's ledger —
+        #: the in-memory one is newer (it's about to record this rollback).
+        self._restoring_for_rollback = False
 
         self.model: Model = trial.build_model(self.mesh)
         self._tx = trial.build_optimizer()
@@ -181,6 +190,13 @@ class Trainer:
                 self._tb_manager.sync()
             except Exception:  # noqa: BLE001
                 logger.exception("tensorboard sync failed")
+
+    def _trial_id(self) -> int:
+        """This run's trial identity (0 off-cluster) — the goodput
+        ledger's ownership key across restarts."""
+        if self.core.info is not None and self.core.info.trial is not None:
+            return int(self.core.info.trial.trial_id)
+        return 0
 
     # -- state construction -------------------------------------------------
     def _param_shardings(self) -> Any:
@@ -377,6 +393,9 @@ class Trainer:
         checkpoint_ctx = self.core.checkpoint
         seed = self.seed
         data_offset = self._data_offset
+        # Ledger snapshot at submit time (the work() closure runs on the
+        # writer thread while the step loop keeps mutating the live one).
+        timeline_md = self.timeline.to_metadata(trial_id=self._trial_id())
 
         def work() -> str:
             with tempfile.TemporaryDirectory() as tmp:
@@ -401,6 +420,10 @@ class Trainer:
                                 # windows skipped); a restart must fast-
                                 # forward the same distance (fit()).
                                 "data_offset": data_offset,
+                                # Goodput ledger: a restart resumes the
+                                # SAME accounting (save→restore gap is
+                                # charged as restart loss on load).
+                                "timeline": timeline_md,
                             },
                             f,
                         )
@@ -528,9 +551,16 @@ class Trainer:
             if os.path.exists(md_path):
                 try:
                     with open(md_path) as f:
-                        self._data_offset = int(
-                            json.load(f).get("data_offset", 0) or 0
-                        )
+                        md = json.load(f)
+                    self._data_offset = int(md.get("data_offset", 0) or 0)
+                    tl_md = md.get("timeline")
+                    if tl_md and not self._restoring_for_rollback:
+                        # Process restart/resume: continue the persisted
+                        # goodput ledger. A rollback restore skips this —
+                        # its in-memory ledger is newer than the
+                        # checkpoint's. load() itself rejects foreign
+                        # ledgers (warm-started fork = different trial id).
+                        self.timeline.load(tl_md, trial_id=self._trial_id())
                 except (ValueError, OSError):
                     logger.warning(
                         "unreadable trainer metadata in %s; assuming no "
@@ -620,7 +650,16 @@ class Trainer:
             "sentinel rollback at step %d: %s — restoring %s and skipping "
             "the poisoned data window", at_step, reason, target,
         )
-        self._restore_with_fallback(target)
+        _t0 = self.timeline.pc()
+        self._restoring_for_rollback = True
+        try:
+            with trace_mod.span("trial.rollback", {"reason": reason}):
+                self._restore_with_fallback(target)
+        finally:
+            self._restoring_for_rollback = False
+        # Ledger: the uncommitted window time trained state this restore
+        # just discarded; the restore itself is pure overhead too.
+        self.timeline.on_rollback(self.timeline.pc() - _t0)
         self._rollbacks += 1
         restored = self.steps_completed
         # The stream is NOT rewound: everything consumed past the restored
@@ -733,6 +772,8 @@ class Trainer:
         t_report = time.time()
         preempted = False
 
+        timeline = self.timeline
+
         def flush_report() -> None:
             nonlocal pending, t_report
             # Sentinel sees EVERY window before it is dropped — flushes
@@ -744,8 +785,14 @@ class Trainer:
                 reason = self._sentinel_check(pending)
                 if reason and self._sentinel_reason is None:
                     self._sentinel_reason = reason
+            had_pending = bool(pending)
             if not pending or not self.core.distributed.is_chief:
                 pending = []
+                if had_pending and timeline.enabled:
+                    # _sentinel_check just blocked on the device, so the
+                    # window residual includes the jitted steps — the one
+                    # sync the timeline is allowed to piggyback on.
+                    timeline.close_window()
                 return
             host = [jax.device_get(m) for m in pending]
             # Aggregate over FINITE values only: a guarded (skipped) step
@@ -772,8 +819,20 @@ class Trainer:
             agg["steps_skipped"] = float(self._steps_skipped)
             agg["rollbacks"] = float(self._rollbacks)
             steps_now = self.steps_completed
+            _t0 = timeline.pc()
             self.core.train.report_training_metrics(steps_now, agg)
             self._tb_scalars(steps_now, agg)
+            if timeline.enabled:
+                timeline.window["report"] += timeline.pc() - _t0
+                # Settle the window (the device_get above was the sync),
+                # then ship the step-phase breakdown + goodput ledger
+                # under the `profiling` group — the same channel the
+                # ProfilerAgent uses, so the WebUI/SDK read both together.
+                fractions = timeline.close_window()
+                self.core.train.report_metrics(
+                    "profiling", steps_now,
+                    {**fractions, **timeline.snapshot()},
+                )
             if self._profiler is not None:
                 self._profiler.set_steps_completed(steps_now)
             pending = []
@@ -792,6 +851,19 @@ class Trainer:
         self.core.train.heartbeat_step(step)
         if self._profiler is not None:
             self._profiler.start()
+        # Trial-lifecycle span: parents under the launch chain's
+        # DTPU_TRACEPARENT (ambient via common/trace.py), so the fit loop
+        # appears inside the submit trace.
+        import contextlib as _contextlib
+
+        _fit_scope = _contextlib.ExitStack()
+        _fit_scope.enter_context(
+            trace_mod.span("trial.fit", {"resume_step": resume_steps})
+        )
+        # Host-phase clock bound once: the hot loop pays 3 perf_counter
+        # calls + 2 float adds per step when enabled, nothing when not.
+        _pc = timeline.pc
+        timeline.reset_window()
 
         # The finally-join below keeps a raising step loop from abandoning
         # an in-flight background save: the daemon writer thread would
@@ -803,7 +875,17 @@ class Trainer:
             for op in searcher.operations():
                 target = to_batches(op.length, bpe)
                 while step < target:
-                    batch = self._put_batch(next(train_iter))
+                    if timeline.enabled:
+                        _t0 = _pc()
+                        raw = next(train_iter)
+                        _t1 = _pc()
+                        batch = self._put_batch(raw)
+                        _w = timeline.window
+                        _w["data_wait"] += _t1 - _t0
+                        _w["h2d_put"] += _pc() - _t1
+                        timeline.step_done()
+                    else:
+                        batch = self._put_batch(next(train_iter))
                     self._data_consumed += 1
                     # poison: 1.0 outside fault drills (one None check);
                     # np scalar, not python float, so jit sees a stable
@@ -854,7 +936,15 @@ class Trainer:
                             self._tb_scalars(step, last_val, prefix="val_")
                     if ckpt_period and step % ckpt_period == 0:
                         flush_report()
+                        _t0 = _pc()
                         self._save_checkpoint()
+                        if timeline.enabled:
+                            # Host-blocking part only (snapshot + writer
+                            # join); the async upload overlaps training.
+                            timeline.window["checkpoint"] += _pc() - _t0
+                        # A durable checkpoint is the ledger's commit
+                        # point: time since the last one is now goodput.
+                        timeline.commit()
                         last_ckpt_step = step
                         self._tb_sync()
                     # Preemption is a collective (ZMQ broadcast) — checking every
@@ -864,6 +954,7 @@ class Trainer:
                     if boundary and self.core.preempt.should_preempt():
                         flush_report()
                         self._save_checkpoint(sync=True)
+                        timeline.commit()
                         last_ckpt_step = step
                         logger.info("preempted at step %d; exiting cleanly", step)
                         preempted = True
@@ -894,6 +985,7 @@ class Trainer:
                 and last_ckpt_step != step
             ):
                 self._save_checkpoint(sync=True)
+                timeline.commit()
         except BaseException as e:
             fit_error = e
             raise
@@ -906,6 +998,8 @@ class Trainer:
                 # The loop's own exception is the primary failure; log the
                 # checkpoint one rather than masking it.
                 logger.exception("background checkpoint failed during teardown")
+            finally:
+                _fit_scope.close()  # end the trial.fit span either way
         if self._profiler is not None:
             self._profiler.stop()
         self._tb_sync()

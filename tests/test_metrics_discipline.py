@@ -1,0 +1,160 @@
+"""Observability discipline gate (style of test_no_adhoc_retries.py):
+
+1. EVERY registered API route is covered by the request-latency histogram
+   AND a request span — exercised dynamically: one real request per route
+   pattern, then the histogram and the span file are checked per route.
+   Instrumentation lives on the single dispatch path, so a new route is
+   covered by construction; this test keeps it that way (someone adding a
+   side-channel route handler that bypasses _dispatch breaks it).
+2. Metric-name discipline: everything in the process-global registry is
+   `dtpu_`-prefixed and each name registers exactly once (a kind/label
+   mismatch on an existing name is an error, not a merge).
+"""
+import json
+import re
+
+import pytest
+import requests
+
+from determined_tpu.common.metrics import (
+    REGISTRY,
+    parse_exposition,
+    sample_value,
+)
+from determined_tpu.master.api_server import ApiServer, build_routes
+from determined_tpu.master.core import Master
+
+#: Example value per capture-group construct appearing in route patterns.
+#: A NEW group shape fails the sweep with a clear message — extend the
+#: table when you add one (that forced look is the point).
+GROUP_SAMPLES = {
+    r"(\d+)": "1",
+    r"([\w.\-]+)": "x1",
+    r"([0-9a-f-]+)": "0abc",
+    r"([0-9a-f]+)": "0abc",
+    r"([\w.@+\-]+)": "user1",
+    r"(pause|activate|cancel|kill)": "pause",
+    r"(archive|unarchive)": "archive",
+    r"(enable|disable)": "enable",
+    r"(?:ui)?": "ui",
+}
+
+
+def _example_path(pattern: re.Pattern) -> str:
+    s = pattern.pattern
+    assert s.startswith("^") and s.endswith("$"), s
+    s = s[1:-1]
+    for group, sample in GROUP_SAMPLES.items():
+        s = s.replace(group, sample)
+    assert "(" not in s, (
+        f"route {pattern.pattern} has a capture group with no sample in "
+        "GROUP_SAMPLES — add one so the coverage sweep exercises it"
+    )
+    return s
+
+
+class TestEveryRouteObserved:
+    def test_latency_histogram_and_span_cover_all_routes(self, tmp_path):
+        trace_path = str(tmp_path / "spans.jsonl")
+        master = Master(trace_file=trace_path)
+        api = ApiServer(master)
+        api.start()
+        routes = build_routes(master)
+        try:
+            for method, pattern, _handler in routes:
+                path = _example_path(pattern)
+                url = f"{api.url}{path}?timeout_seconds=0.01"
+                kw = {"timeout": 30}
+                if method in ("POST", "PATCH", "DELETE"):
+                    kw["json"] = {}
+                # stream=True: SSE follow routes return headers immediately
+                # (they are observed at stream start); close right after.
+                resp = requests.request(method, url, stream=True, **kw)
+                resp.close()
+            text = requests.get(f"{api.url}/metrics", timeout=30).text
+            samples = parse_exposition(text)
+        finally:
+            api.stop()
+            master.shutdown()
+
+        unobserved = [
+            f"{method} {pattern.pattern}"
+            for method, pattern, _h in routes
+            if not sample_value(
+                samples, "dtpu_api_request_duration_seconds_count",
+                method=method, route=pattern.pattern,
+            )
+        ]
+        assert not unobserved, (
+            "routes with no request-latency observation (did a handler "
+            "bypass the instrumented dispatch path?):\n"
+            + "\n".join(unobserved)
+        )
+
+        span_names = {
+            json.loads(line)["name"] for line in open(trace_path)
+        }
+        unspanned = [
+            f"{method} {pattern.pattern}"
+            for method, pattern, _h in routes
+            if f"http {method} {pattern.pattern}" not in span_names
+        ]
+        assert not unspanned, (
+            "routes with no request span:\n" + "\n".join(unspanned)
+        )
+
+    def test_status_label_records_errors(self):
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            requests.get(f"{api.url}/api/v1/trials/424242", timeout=10)
+            text = requests.get(f"{api.url}/metrics", timeout=10).text
+        finally:
+            api.stop()
+            master.shutdown()
+        samples = parse_exposition(text)
+        assert sample_value(
+            samples, "dtpu_api_requests_total",
+            method="GET", route=r"^/api/v1/trials/(\d+)$", status="404",
+        ) >= 1
+
+
+class TestNameDiscipline:
+    def test_all_registered_names_are_dtpu_prefixed(self):
+        # Importing the instrumented modules populates the registry.
+        import determined_tpu.agent.agent  # noqa: F401
+        import determined_tpu.common.resilience  # noqa: F401
+        import determined_tpu.master.api_server  # noqa: F401
+        import determined_tpu.master.core  # noqa: F401
+        import determined_tpu.master.logsink  # noqa: F401
+        import determined_tpu.master.rm  # noqa: F401
+
+        offenders = [
+            n for n in REGISTRY.names() if not n.startswith("dtpu_")
+        ]
+        assert not offenders, (
+            "registry metric names must carry the dtpu_ namespace prefix: "
+            f"{offenders}"
+        )
+
+    def test_duplicate_registration_is_an_error(self):
+        import determined_tpu.master.api_server  # noqa: F401 — registers
+
+        with pytest.raises(ValueError):
+            REGISTRY.gauge("dtpu_api_requests_total", "clash")
+        with pytest.raises(ValueError):
+            REGISTRY.counter(
+                "dtpu_api_requests_total", "clash", labels=("other",)
+            )
+
+    def test_counter_names_end_in_total(self):
+        """Prometheus naming convention: counters are *_total."""
+        from determined_tpu.common.metrics import Counter
+
+        bad = [
+            n for n in REGISTRY.names()
+            if isinstance(REGISTRY.get(n), Counter)
+            and not n.endswith("_total")
+        ]
+        assert not bad, f"counters must end in _total: {bad}"

@@ -1,0 +1,294 @@
+"""Metrics registry (common/metrics.py): primitives, strict exposition,
+and the master/agent /metrics surfaces parsing under the strict parser —
+the exposition-format bugs of the old hand-rolled handler (`dtpu_x{} 1`,
+no HELP/TYPE, unescaped label values) are pinned here."""
+import math
+
+import pytest
+import requests
+
+from determined_tpu.common.metrics import (
+    REGISTRY,
+    MetricsRegistry,
+    parse_exposition,
+    sample_value,
+)
+
+
+class TestPrimitives:
+    def test_counter_and_labels(self):
+        r = MetricsRegistry()
+        c = r.counter("dtpu_t_total", "help", labels=("route",))
+        c.labels("a").inc()
+        c.labels("a").inc(2)
+        c.labels(route="b").inc()
+        samples = parse_exposition(r.render())
+        assert sample_value(samples, "dtpu_t_total", route="a") == 3
+        assert sample_value(samples, "dtpu_t_total", route="b") == 1
+
+    def test_counter_monotone(self):
+        r = MetricsRegistry()
+        with pytest.raises(ValueError):
+            r.counter("dtpu_c_total", "h").inc(-1)
+
+    def test_gauge_set_inc_dec(self):
+        r = MetricsRegistry()
+        g = r.gauge("dtpu_g", "h")
+        g.set(5)
+        g.inc()
+        g.dec(2)
+        assert sample_value(parse_exposition(r.render()), "dtpu_g") == 4
+
+    def test_histogram_buckets_sum_count(self):
+        r = MetricsRegistry()
+        h = r.histogram("dtpu_h_seconds", "h", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        s = parse_exposition(r.render())
+        assert sample_value(s, "dtpu_h_seconds_bucket", le="0.1") == 1
+        assert sample_value(s, "dtpu_h_seconds_bucket", le="1") == 2
+        assert sample_value(s, "dtpu_h_seconds_bucket", le="+Inf") == 3
+        assert sample_value(s, "dtpu_h_seconds_count") == 3
+        assert abs(sample_value(s, "dtpu_h_seconds_sum") - 5.55) < 1e-9
+
+    def test_registered_exactly_once(self):
+        """Same (kind, labels) re-registration is the SAME family object;
+        a mismatched re-registration is an error, not a silent merge."""
+        r = MetricsRegistry()
+        a = r.counter("dtpu_once_total", "h", labels=("x",))
+        assert r.counter("dtpu_once_total", "h", labels=("x",)) is a
+        with pytest.raises(ValueError):
+            r.gauge("dtpu_once_total", "h", labels=("x",))
+        with pytest.raises(ValueError):
+            r.counter("dtpu_once_total", "h", labels=("x", "y"))
+        h = r.histogram("dtpu_once_seconds", "h", buckets=(0.1, 1.0))
+        assert r.histogram("dtpu_once_seconds", "h", buckets=(1.0, 0.1)) is h
+        with pytest.raises(ValueError):  # buckets are part of the contract
+            r.histogram("dtpu_once_seconds", "h", buckets=(1.0, 60.0))
+
+    def test_labelless_series_render_at_zero(self):
+        r = MetricsRegistry()
+        r.counter("dtpu_idle_total", "never fired")
+        s = parse_exposition(r.render())
+        assert sample_value(s, "dtpu_idle_total") == 0
+
+
+class TestExposition:
+    def test_no_empty_label_braces(self):
+        """The seed bug: label-less gauges rendered `dtpu_x{} 1`."""
+        r = MetricsRegistry()
+        r.gauge("dtpu_plain", "h").set(1)
+        text = r.render()
+        assert "dtpu_plain 1" in text
+        assert "{}" not in text
+
+    def test_help_and_type_present(self):
+        r = MetricsRegistry()
+        r.counter("dtpu_x_total", "counts x")
+        text = r.render()
+        assert "# HELP dtpu_x_total counts x" in text
+        assert "# TYPE dtpu_x_total counter" in text
+
+    def test_label_value_escaping_roundtrip(self):
+        r = MetricsRegistry()
+        g = r.gauge("dtpu_esc", "h", labels=("v",))
+        nasty = 'a"b\\c\nd'
+        g.labels(nasty).set(7)
+        s = parse_exposition(r.render())
+        assert sample_value(s, "dtpu_esc", v=nasty) == 7
+
+    def test_parser_rejects_legacy_format(self):
+        """What the pre-registry handler emitted must NOT parse."""
+        with pytest.raises(ValueError):
+            parse_exposition('dtpu_agents{pool="default"} 1\n')  # no TYPE
+        with pytest.raises(ValueError):
+            parse_exposition(
+                "# HELP dtpu_x h\n# TYPE dtpu_x gauge\ndtpu_x{} 1\n"
+            )
+        with pytest.raises(ValueError):
+            parse_exposition(
+                "# HELP dtpu_x h\n# TYPE dtpu_x gauge\ndtpu_x nope\n"
+            )
+        with pytest.raises(ValueError):  # duplicate series
+            parse_exposition(
+                "# HELP dtpu_x h\n# TYPE dtpu_x gauge\ndtpu_x 1\ndtpu_x 2\n"
+            )
+
+    def test_parser_rejects_garbage_in_label_block(self):
+        """The anchored label scan must reject stray bytes a finditer-style
+        scan would silently skip (the parser is the acceptance gate for
+        render(), so leniency here hides exposition bugs)."""
+        for block in ('m{!!a="b"} 1', 'm{a="b",##c="d"} 1',
+                      'm{a="b",} 1', 'm{a="b"x} 1'):
+            with pytest.raises(ValueError):
+                parse_exposition(f"# HELP m h\n# TYPE m gauge\n{block}\n")
+
+    def test_gauge_replace_is_atomic_snapshot(self):
+        r = MetricsRegistry()
+        g = r.gauge("dtpu_states", "h", labels=("state",))
+        g.labels("OLD").set(3)
+        g.replace({("ACTIVE",): 2.0, ("PAUSED",): 1.0})
+        s = parse_exposition(r.render())
+        assert sample_value(s, "dtpu_states", state="ACTIVE") == 2
+        assert sample_value(s, "dtpu_states", state="OLD") is None
+
+    def test_parser_accepts_inf_and_nan(self):
+        s = parse_exposition(
+            "# HELP dtpu_x h\n# TYPE dtpu_x gauge\n"
+            'dtpu_x{k="a"} +Inf\ndtpu_x{k="b"} NaN\n'
+        )
+        assert math.isinf(sample_value(s, "dtpu_x", k="a"))
+        assert math.isnan(sample_value(s, "dtpu_x", k="b"))
+
+
+class TestEndpoints:
+    def test_master_metrics_parse_strictly(self):
+        """Master /metrics parses under the strict parser and carries the
+        cluster-state gauges plus the resilience + sentinel families."""
+        from determined_tpu.master.api_server import ApiServer
+        from determined_tpu.master.core import Master
+
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            text = requests.get(f"{api.url}/metrics", timeout=10).text
+            samples = parse_exposition(text)
+            assert sample_value(samples, "dtpu_agents", pool="default") == 0
+            names = {name for name, _ in samples}
+            # label-less sentinel counters scrape at 0, not absent
+            assert "dtpu_sentinel_steps_skipped_total" in names
+            assert "dtpu_sentinel_rollbacks_total" in names
+            assert "dtpu_sentinel_stall_kills_total" in text  # TYPE'd family
+            # resilience families are declared on the same exposition
+            assert "# TYPE dtpu_retries_total counter" in text
+            assert "# TYPE dtpu_circuit_state gauge" in text
+            # legacy alias route serves the same payload
+            text2 = requests.get(f"{api.url}/prom/metrics", timeout=10).text
+            parse_exposition(text2)
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_agent_metrics_endpoint(self):
+        """The agent serves /metrics (+ /healthz) on its health port."""
+        from determined_tpu.agent.agent import AgentDaemon
+
+        agent = AgentDaemon(
+            "http://127.0.0.1:1", agent_id="m-agent", slots=1,
+            metrics_port=0,
+        )
+        try:
+            port = agent.metrics.port
+            assert requests.get(
+                f"http://127.0.0.1:{port}/healthz", timeout=10
+            ).text == "ok\n"
+            resp = requests.get(
+                f"http://127.0.0.1:{port}/metrics", timeout=10)
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            samples = parse_exposition(resp.text)
+            # per-agent gauge (labeled so co-resident agents compose)
+            assert "# TYPE dtpu_agent_tasks_running gauge" in resp.text
+            names = {name for name, _ in samples}
+            assert "dtpu_agent_log_lines_shipped_total" in names
+        finally:
+            agent.stop()
+
+    def test_sentinel_counter_reset_handling(self):
+        """A restarted trial reports cumulative counters from 0 again
+        (they are process-lifetime): a drop must fold the NEW value as a
+        fresh delta, never a negative/zero-clamped one."""
+        from determined_tpu.master.api_server import (
+            SENTINEL_STEPS_SKIPPED,
+            ApiServer,
+        )
+        from determined_tpu.master.core import Master
+
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            base = SENTINEL_STEPS_SKIPPED.value
+
+            def report(v):
+                requests.post(
+                    f"{api.url}/api/v1/trials/31337/metrics",
+                    json={"group": "training", "steps_completed": 1,
+                          "metrics": {"loss": 1.0, "steps_skipped": v,
+                                      "rollbacks": 0.0}},
+                    timeout=10,
+                ).raise_for_status()
+
+            report(5.0)          # lifetime 5 -> +5
+            report(5.0)          # unchanged -> +0
+            report(3.0)          # RESET (restarted trial) -> +3
+            report(4.0)          # continues -> +1
+            assert SENTINEL_STEPS_SKIPPED.value - base == 9.0
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_goodput_series_pruned_on_terminal_experiment(self):
+        """Per-experiment goodput gauges are removed when the experiment
+        ends — the label set must not grow forever on a long master."""
+        from determined_tpu.common.metrics import REGISTRY
+        from determined_tpu.master.core import EXPERIMENT_GOODPUT, Master
+
+        master = Master()
+        try:
+            exp_id = master.create_experiment({
+                "unmanaged": True, "entrypoint": "unmanaged",
+                "searcher": {"name": "single", "max_length": 1},
+            })
+            EXPERIMENT_GOODPUT.labels(str(exp_id)).set(97.0)
+            exp = master.get_experiment(exp_id)
+            exp.kill()
+            exp.wait_done(timeout=10)
+            text = REGISTRY.render()
+            assert f'experiment="{exp_id}"' not in text
+        finally:
+            master.shutdown()
+
+    def test_family_remove(self):
+        r = MetricsRegistry()
+        g = r.gauge("dtpu_rm", "h", labels=("k",))
+        g.labels("a").set(1)
+        g.labels("b").set(2)
+        g.remove("a")
+        s = parse_exposition(r.render())
+        assert sample_value(s, "dtpu_rm", k="a") is None
+        assert sample_value(s, "dtpu_rm", k="b") == 2
+
+    def test_resilience_series_move(self):
+        """Retries and breaker transitions land in the shared registry."""
+        from determined_tpu.common.faults import InjectedFault
+        from determined_tpu.common.resilience import (
+            RETRIES,
+            CIRCUIT_OPENS,
+            CIRCUIT_STATE,
+            CircuitBreaker,
+            RetryPolicy,
+        )
+
+        key = "test.metrics.retry"
+        before = RETRIES.labels(key).value
+        policy = RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise InjectedFault("boom")
+            return "ok"
+
+        assert policy.call(flaky, key=key, sleep=lambda d: None) == "ok"
+        assert RETRIES.labels(key).value - before == 2
+
+        b = CircuitBreaker("test.metrics.endpoint", failure_threshold=2)
+        opens_before = CIRCUIT_OPENS.labels(b.key).value
+        b.record_failure()
+        b.record_failure()  # threshold -> open
+        assert CIRCUIT_STATE.labels(b.key).value == 2
+        assert CIRCUIT_OPENS.labels(b.key).value - opens_before == 1
+        b.record_success()
+        assert CIRCUIT_STATE.labels(b.key).value == 0

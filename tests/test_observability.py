@@ -42,6 +42,29 @@ class TestProfiler:
         agent.stop()
         assert len(train._reported) <= 3  # cap + possible final flush
 
+    def test_sampler_flush_race(self):
+        """_samples is shared by the sampler thread and stop()/_flush();
+        the lock added for it must keep every sample accounted for —
+        hammer concurrent flushes against a fast sampler and check no
+        sample is double-counted or lost mid-append."""
+        import threading
+
+        class CountingTrain(DummyTrainContext):
+            pass
+
+        train = CountingTrain()
+        agent = ProfilerAgent(
+            train, sample_interval_s=0.001, report_every=3, max_reports=10_000
+        )
+        agent.start()
+        stop = time.time() + 1.0
+        while time.time() < stop:
+            agent._flush()  # trainer-thread flushes race the sampler
+        agent.stop()
+        # every reported batch averaged at least one sample and nothing
+        # blew up; the exact count is timing-dependent
+        assert all(m for (_g, _s, m) in train._reported)
+
 
 class TestTensorboard:
     def test_write_and_read_scalars(self, tmp_path):

@@ -1,0 +1,248 @@
+"""W3C trace propagation (common/trace.py): client → master parenting,
+the launch-chain env contract, the full-stack one-trace-id acceptance
+drill, and the tracer flush-through fix."""
+import json
+import os
+import tempfile
+
+import pytest
+import requests
+
+from determined_tpu.common import trace
+from determined_tpu.common.api_session import Session
+from determined_tpu.master.api_server import ApiServer
+from determined_tpu.master.core import Master
+from determined_tpu.master.tracing import JsonlExporter, Tracer
+
+
+class TestTraceparent:
+    def test_roundtrip(self):
+        tid, sid = trace.new_trace_id(), trace.new_span_id()
+        assert trace.parse_traceparent(
+            trace.format_traceparent(tid, sid)
+        ) == (tid, sid)
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-short-short-01",
+        "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",      # forbidden version
+        "00-" + "0" * 32 + "-" + "b" * 16 + "-01",      # zero trace id
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",      # zero span id
+        "00-" + "A" * 31 + "-" + "b" * 16 + "-01",      # wrong length
+    ])
+    def test_malformed_ignored(self, bad):
+        assert trace.parse_traceparent(bad) is None
+
+    def test_span_nesting_and_env_ambient(self):
+        assert trace.current() is None or os.environ.get("DTPU_TRACEPARENT")
+        with trace.span("outer") as (tid, sid):
+            assert trace.current() == (tid, sid)
+            with trace.span("inner") as (tid2, sid2):
+                assert tid2 == tid and sid2 != sid
+            assert trace.current() == (tid, sid)
+        # env fallback: a launched task is born inside the launch trace
+        hdr = trace.format_traceparent(trace.new_trace_id(),
+                                       trace.new_span_id())
+        os.environ["DTPU_TRACEPARENT"] = hdr
+        try:
+            assert trace.traceparent() == hdr
+            with trace.span("child") as (tid3, _):
+                assert tid3 == hdr.split("-")[1]
+        finally:
+            del os.environ["DTPU_TRACEPARENT"]
+
+    def test_span_exports_jsonl(self, tmp_path):
+        path = str(tmp_path / "client.jsonl")
+        os.environ["DTPU_TRACE_FILE"] = path
+        try:
+            with trace.span("a", {"k": 1}):
+                with trace.span("b"):
+                    pass
+        finally:
+            del os.environ["DTPU_TRACE_FILE"]
+        spans = [json.loads(l) for l in open(path)]
+        by_name = {s["name"]: s for s in spans}
+        assert by_name["b"]["parentSpanId"] == by_name["a"]["spanId"]
+        assert by_name["b"]["traceId"] == by_name["a"]["traceId"]
+
+
+class TestClientToMaster:
+    def test_request_span_parents_to_client_traceparent(self, tmp_path):
+        """A harness-side request produces a master span whose traceId
+        matches the client's traceparent (ISSUE satellite)."""
+        path = str(tmp_path / "spans.jsonl")
+        master = Master(trace_file=path)
+        api = ApiServer(master)
+        api.start()
+        try:
+            with trace.span("client.op") as (tid, sid):
+                Session(api.url).get("/api/v1/master")
+        finally:
+            api.stop()
+            master.shutdown()
+        spans = [json.loads(l) for l in open(path)]
+        req = next(s for s in spans if "api/v1/master" in s["name"])
+        assert req["traceId"] == tid
+        assert req["parentSpanId"] == sid
+
+    def test_session_root_spans_all_calls(self, tmp_path):
+        """With no ambient span, one Session = one trace: every call the
+        CLI/SDK makes through it reassembles under a single trace id."""
+        path = str(tmp_path / "spans.jsonl")
+        master = Master(trace_file=path)
+        api = ApiServer(master)
+        api.start()
+        try:
+            sess = Session(api.url)
+            sess.get("/api/v1/master")
+            sess.get("/api/v1/experiments")
+        finally:
+            api.stop()
+            master.shutdown()
+        spans = [json.loads(l) for l in open(path)]
+        http = [s for s in spans if s["name"].startswith("http ")]
+        assert len(http) == 2
+        assert http[0]["traceId"] == http[1]["traceId"]
+
+    def test_malformed_traceparent_never_breaks_request(self):
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            r = requests.get(
+                f"{api.url}/api/v1/master",
+                headers={"traceparent": "zz-not-a-trace"}, timeout=10,
+            )
+            assert r.status_code == 200
+        finally:
+            api.stop()
+            master.shutdown()
+
+
+class TestLaunchChain:
+    def test_master_env_carries_submit_trace(self, tmp_path):
+        """enqueue_start_actions stamps DTPU_TRACEPARENT derived from the
+        allocation span, itself parented to the submit trace."""
+        path = str(tmp_path / "spans.jsonl")
+        master = Master(trace_file=path)
+        captured = {}
+        master.agent_hub.enqueue = lambda a, act: captured.setdefault(a, act)
+        try:
+            from determined_tpu import _info
+
+            submit = (trace.new_trace_id(), trace.new_span_id())
+            trial_info = _info.TrialInfo(
+                trial_id=7, experiment_id=3, trial_seed=0, hparams={},
+                config={}, latest_checkpoint=None,
+            )
+            master.set_experiment_traceparent(3, submit)
+            master.rm.pool().add_agent("agent-x", 1)
+            master.enqueue_start_actions(
+                alloc_id="a.7.0", task_id="trial-7", task_type="TRIAL",
+                entrypoint="x", assignment={"agent-x": 1}, slots=1,
+                config={}, trial_info=trial_info, trial_id=7,
+            )
+            env = captured["agent-x"]["env"]
+            ctx = trace.parse_traceparent(env.get("DTPU_TRACEPARENT"))
+            assert ctx is not None and ctx[0] == submit[0]
+            master.alloc_service.complete("a.7.0", exit_code=0, reason="")
+        finally:
+            master.shutdown()
+        spans = [json.loads(l) for l in open(path)]
+        alloc = next(s for s in spans if s["name"] == "allocation")
+        assert alloc["traceId"] == submit[0]
+        assert alloc["parentSpanId"] == submit[1]
+        # the task env context IS the allocation span
+        assert ctx == (alloc["traceId"], alloc["spanId"])
+
+    def test_null_tracer_still_propagates(self):
+        """Propagation must not require a configured exporter: with the
+        default NullTracer the submit context passes through to the env."""
+        master = Master()  # NullTracer
+        captured = {}
+        master.agent_hub.enqueue = lambda a, act: captured.setdefault(a, act)
+        try:
+            from determined_tpu import _info
+
+            submit = (trace.new_trace_id(), trace.new_span_id())
+            master.set_experiment_traceparent(9, submit)
+            master.rm.pool().add_agent("agent-y", 1)
+            master.enqueue_start_actions(
+                alloc_id="a.9.0", task_id="trial-9", task_type="TRIAL",
+                entrypoint="x", assignment={"agent-y": 1}, slots=1,
+                config={},
+                trial_info=_info.TrialInfo(
+                    trial_id=9, experiment_id=9, trial_seed=0, hparams={},
+                    config={}, latest_checkpoint=None,
+                ),
+                trial_id=9,
+            )
+            ctx = trace.parse_traceparent(
+                captured["agent-y"]["env"].get("DTPU_TRACEPARENT")
+            )
+            assert ctx == submit
+            master.alloc_service.complete("a.9.0", exit_code=0, reason="")
+        finally:
+            master.shutdown()
+
+
+class TestFullStack:
+    def test_one_trace_id_submit_to_first_step(self, tmp_path):
+        """Acceptance: ONE trace id spans CLI submit → master schedule →
+        agent launch → the trial's first reported step, asserted on the
+        master's span file from a real devcluster run."""
+        from determined_tpu.devcluster import DevCluster
+
+        trace_path = str(tmp_path / "spans.jsonl")
+        with DevCluster(n_agents=1, slots_per_agent=1,
+                        trace_file=trace_path) as dc:
+            sess = dc.session()
+            root_trace = sess._trace_root[0]
+            exp_id = sess.post("/api/v1/experiments", json_body={"config": {
+                "entrypoint":
+                    "determined_tpu.exec.builtin_trials:SyntheticTrial",
+                "searcher": {"name": "single", "max_length": 2,
+                             "metric": "loss"},
+                "hyperparameters": {
+                    "model": "mnist-mlp", "batch_size": 8,
+                    "lr": {"type": "log", "minval": -3, "maxval": -1},
+                },
+                "resources": {"slots_per_trial": 1},
+                "scheduling_unit": 1,
+                "checkpoint_storage": {
+                    "type": "shared_fs",
+                    "host_path": str(tmp_path / "ckpt"),
+                },
+                "environment": {"jax_platform": "cpu"},
+            }})["id"]
+            assert dc.wait_experiment(exp_id, timeout=240) == "COMPLETED"
+        spans = [json.loads(l) for l in open(trace_path)]
+        chain = [s["name"] for s in spans if s["traceId"] == root_trace]
+        # submit request
+        assert any(
+            "POST" in n and n.endswith("experiments$") for n in chain
+        ), chain
+        # scheduled allocation
+        assert "allocation" in chain
+        # the trial's own reports ride the SAME trace (its Session carries
+        # the DTPU_TRACEPARENT the launch chain injected)
+        assert any(
+            "POST" in n and "metrics" in n for n in chain
+        ), chain
+        assert any(
+            "POST" in n and "checkpoints" in n for n in chain
+        ), chain
+
+
+class TestTracerShutdown:
+    def test_end_span_after_stop_still_exports(self, tmp_path):
+        """Spans ended by lingering request threads after Tracer.stop()
+        export inline instead of vanishing into the dead batch queue."""
+        path = str(tmp_path / "spans.jsonl")
+        tracer = Tracer(JsonlExporter(path))
+        s1 = tracer.start_span("before")
+        tracer.end_span(s1)
+        tracer.stop()
+        s2 = tracer.start_span("after-stop")
+        tracer.end_span(s2)
+        names = {json.loads(l)["name"] for l in open(path)}
+        assert names == {"before", "after-stop"}
