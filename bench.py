@@ -294,6 +294,109 @@ def _sentinel_drill():
         return None
 
 
+def _reclaim_drill(elastic: bool):
+    """One scripted spot-reclaim drill through a REAL devcluster: a
+    2-process gang trains with per-batch checkpoints; once training is
+    underway the rank-1 task is SIGKILLed via the `agent.reclaim.rank1`
+    fault site (armed in-process so the reclaim lands at a chosen step).
+    With `elastic` the survivors reshard in place (resize_cost_s = the
+    ledger's resize_lost_s, restart budget charged 0); without it the
+    gang takes the classic checkpoint→requeue→restart path
+    (restart_cost_s = restart_lost_s). Returns (cost_s, goodput_pct,
+    budget_charged) or None."""
+    import tempfile
+    import time as _time
+
+    from determined_tpu.common import faults
+    from determined_tpu.devcluster import DevCluster
+
+    faults.clear()
+    try:
+        with tempfile.TemporaryDirectory() as tmp, DevCluster(
+            n_agents=2, slots_per_agent=1
+        ) as dc:
+            exp_id = dc.create_experiment({
+                "entrypoint":
+                    "determined_tpu.exec.builtin_trials:SyntheticTrial",
+                "searcher": {"name": "single", "max_length": 24,
+                             "metric": "loss"},
+                "hyperparameters": {"model": "mnist-mlp", "batch_size": 16,
+                                    "lr": 1e-3, "sleep_s": 0.3},
+                "resources": {"slots_per_trial": 2},
+                "scheduling_unit": 2,
+                "min_checkpoint_period": {"batches": 2},
+                "checkpoint_storage": {"type": "shared_fs",
+                                       "host_path": tmp + "/ckpt"},
+                "environment": {"jax_platform": "cpu"},
+                "max_restarts": 3,
+                "elastic": {"enabled": elastic},
+            })
+            deadline = _time.time() + 240
+            trial_id = None
+            while _time.time() < deadline:
+                trials = dc.master.db.list_trials(exp_id)
+                if trials:
+                    trial_id = trials[0]["id"]
+                    rows = dc.master.db.get_metrics(trial_id, "training")
+                    if trials[0].get("latest_checkpoint") and len(rows) >= 2:
+                        break
+                _time.sleep(0.3)
+            faults.install(faults.FaultPlan(
+                {"agent.reclaim.rank1": faults.FaultSpec(failures=1)}
+            ))
+            state = dc.wait_experiment(exp_id, timeout=300)
+            if state != "COMPLETED":
+                return None
+            trial = dc.master.db.list_trials(exp_id)[0]
+            rows = dc.master.db.get_metrics(trial_id, "profiling")
+            if not rows:
+                return None
+            ledger = rows[-1]["body"]
+            events = float(ledger.get(
+                "ledger_resizes" if elastic else "ledger_restarts", 0.0
+            ))
+            if events < 1:
+                # The reclaim never actually fired (the run outraced the
+                # arming): a 0.0 "cost" here would publish a perfect
+                # number for a drill that didn't happen.
+                return None
+            cost = float(ledger.get(
+                "resize_lost_s" if elastic else "restart_lost_s", 0.0
+            ))
+            return (
+                round(cost, 3),
+                round(float(ledger.get("goodput_pct", 0.0)), 2),
+                int(trial.get("restarts", 0)),
+            )
+    except Exception:  # noqa: BLE001 — skip the rung, keep the headline
+        import traceback
+
+        traceback.print_exc()
+        return None
+    finally:
+        faults.clear()
+
+
+def _elastic_drill():
+    """Elastic-resize cost vs full-restart cost, measured from the SAME
+    scripted reclaim (one leg with elastic.enabled, one without). The
+    elastic leg must charge the restart budget 0; the cost ratio is the
+    headline the ROADMAP's elastic-gangs item asked for."""
+    elastic = _reclaim_drill(elastic=True)
+    restart = _reclaim_drill(elastic=False)
+    out = {}
+    if elastic is not None:
+        cost, goodput, budget = elastic
+        out["resize_cost_s"] = cost
+        out["resize_goodput_pct"] = goodput
+        out["resize_budget_charged"] = budget  # acceptance: 0
+    if restart is not None:
+        cost, goodput, budget = restart
+        out["restart_cost_s"] = cost
+        out["restart_goodput_pct"] = goodput
+    return out or None
+
+
 def _timeline_overhead_pct(step_time_s: float) -> float:
     """Per-step cost of the trainer's timeline instrumentation (the 3
     perf_counter reads + 2 dict accumulations + step_done the hot loop
@@ -570,6 +673,13 @@ def main() -> None:
             # instrumentation overhead vs the headline step loop
             # (acceptance < 1%).
             record.update(tl_rec)
+    if not os.environ.get("DTPU_BENCH_SKIP_ELASTIC"):
+        # Elastic gang resize vs full restart, same scripted reclaim:
+        # resize_cost_s must come in strictly below restart_cost_s with
+        # the restart budget charged 0 (resize_budget_charged).
+        er = _elastic_drill()
+        if er is not None:
+            record.update(er)
     step_time_s = batch_size * config.seq_len / tokens_per_sec
     record["timeline_overhead_pct"] = round(
         _timeline_overhead_pct(step_time_s), 4

@@ -207,6 +207,7 @@ class _Task:
         proc: Optional[subprocess.Popen] = None,
         offset: int = 0,
         start_time: Optional[int] = None,
+        rank: Optional[int] = None,
     ) -> None:
         self.alloc_id = alloc_id
         self.task_id = task_id
@@ -218,6 +219,9 @@ class _Task:
         self.proc = proc  # None when re-adopted (not our child)
         self.offset = offset  # log bytes already shipped
         self.start_time = start_time
+        #: the task's DTPU_ALLOC_RANK at launch — addresses the
+        #: `agent.reclaim.rank<r>` deterministic spot-reclaim drill.
+        self.rank = rank
         self.done = threading.Event()  # process observed dead
         self.follower: Optional[threading.Thread] = None
 
@@ -264,6 +268,14 @@ class AgentDaemon:
         if metrics_port is not None:
             self.metrics = AgentMetricsServer(port=metrics_port)
         self._recover_tasks()
+        # Deterministic spot-reclaim drill (`agent.reclaim.rank<r>` fault
+        # sites): a dedicated watcher so the reclaim lands mid-training,
+        # not at the ~30s action-poll cadence. One faults.active() None
+        # check per tick when no plan is installed.
+        threading.Thread(
+            target=self._reclaim_loop, daemon=True,
+            name=f"reclaim-{self.agent_id}",
+        ).start()
 
     # -- lifecycle -----------------------------------------------------------
     def register(self) -> bool:
@@ -402,6 +414,35 @@ class AgentDaemon:
         self._dead = True
         self.stop()
 
+    def _reclaim_loop(self) -> None:
+        """Deterministic spot-reclaim drill: when a DTPU_FAULT_PLAN arms
+        `agent.reclaim.rank<r>`, the supervised task launched as rank r is
+        SIGKILLed — the wire shape of a reclaimed host's process dying
+        mid-step. The ordinary exit pipeline then reports the nonzero exit
+        to the master, whose elastic layer sheds the rank and reshards the
+        survivors (or, elastic off, requeues the gang as an infra
+        failure). Per-rank site names because the env-inherited plan is
+        identical in every agent process."""
+        while not self._stop.is_set():
+            if faults.active() is not None:
+                with self._lock:
+                    tasks = [
+                        t for t in self._tasks.values() if t.rank is not None
+                    ]
+                for task in tasks:
+                    try:
+                        faults.inject(f"agent.reclaim.rank{task.rank}")
+                    except faults.InjectedFault:
+                        logger.warning(
+                            "fault drill: reclaiming task %s (rank %s) — "
+                            "SIGKILL, no grace", task.alloc_id, task.rank,
+                        )
+                        try:
+                            os.killpg(os.getpgid(task.pid), signal.SIGKILL)
+                        except (ProcessLookupError, PermissionError, OSError):
+                            pass
+            self._stop.wait(0.5)
+
     # -- task state files ------------------------------------------------------
     def _write_state(self, task: _Task) -> None:
         tmp = task.state_path + ".tmp"
@@ -412,6 +453,7 @@ class AgentDaemon:
                         "alloc_id": task.alloc_id, "task_id": task.task_id,
                         "pid": task.pid, "start_time": task.start_time,
                         "slots": task.slots, "offset": task.offset,
+                        "rank": task.rank,
                     },
                     f,
                 )
@@ -458,6 +500,7 @@ class AgentDaemon:
                 proc=None,
                 offset=int(st.get("offset", 0)),
                 start_time=st.get("start_time"),
+                rank=st.get("rank"),
             )
             stat = _proc_stat(task.pid) if task.pid else None
             alive = (
@@ -510,6 +553,20 @@ class AgentDaemon:
             logger.warning("unknown action %r", kind)
 
     def _start(self, action: Dict[str, Any]) -> None:
+        with self._lock:
+            old = self._tasks.get(action["alloc_id"])
+        if old is not None:
+            # A START while the previous process of the SAME allocation is
+            # still draining (elastic grow re-placed onto this host before
+            # the dropped rank finished exiting): spawning now would
+            # clobber the old task's state/exit files and cross-wire its
+            # exit report to the newcomer. Kill it and wait it out first.
+            logger.warning(
+                "START for %s while its previous process (pid %d) is "
+                "draining; killing it first", action["alloc_id"], old.pid,
+            )
+            self._kill(old)
+            old.done.wait(timeout=15.0)
         env = dict(os.environ)
         env.update(action["env"])
         env["DTPU_ENTRYPOINT"] = action.get("entrypoint", "")
@@ -582,6 +639,7 @@ class AgentDaemon:
             exit_file=exit_file,
             state_path=os.path.join(self.state_dir, f"{alloc_id}.json"),
             proc=proc,
+            rank=int(env.get("DTPU_ALLOC_RANK", "0") or 0),
         )
         stat = _proc_stat(proc.pid)
         task.start_time = stat[0] if stat else None
@@ -723,7 +781,11 @@ class AgentDaemon:
         if code is None:
             code = self._read_exit_file(task)
         with self._lock:
-            self._tasks.pop(task.alloc_id, None)
+            # Identity-matched pop: a grow may have already registered a
+            # SUCCESSOR task under the same alloc_id — the old waiter must
+            # not evict it.
+            if self._tasks.get(task.alloc_id) is task:
+                self._tasks.pop(task.alloc_id, None)
             AGENT_TASKS_RUNNING.labels(self.agent_id).set(len(self._tasks))
         if self._dead:
             return  # abrupt death: no goodbye (see die())

@@ -23,6 +23,7 @@ Design differences from the reference:
 """
 from __future__ import annotations
 
+import logging
 import pickle
 import socket
 import threading
@@ -30,6 +31,8 @@ import time
 from typing import Any, Callable, Dict, Hashable, List, Optional
 
 import zmq
+
+logger = logging.getLogger("determined_tpu.ipc")
 
 _HELLO = b"__hello__"
 _POLL_MS = 50  # receiver-thread recv timeout; bounds send-lock hold time
@@ -197,7 +200,19 @@ class ChiefServer:
         payload = pickle.dumps((channel, obj))
         with self._sock_lock:
             for ident in self._identities:
-                self._sock.send_multipart([ident, payload])
+                try:
+                    self._sock.send_multipart([ident, payload])
+                except zmq.ZMQError as e:
+                    # ROUTER_MANDATORY surfaces an unreachable peer
+                    # (EHOSTUNREACH): under elastic resize a reclaimed
+                    # worker is EXPECTED to be gone, and the chief's
+                    # boundary broadcast must keep reaching the survivors
+                    # — one dead rank must not take the control plane (and
+                    # with it the whole gang) down.
+                    logger.warning(
+                        "broadcast to worker %r failed (%s); peer presumed "
+                        "dead", ident, e,
+                    )
 
     def close(self) -> None:
         self._closed = True

@@ -93,6 +93,20 @@ def _maybe_init_jax_distributed(info: _info.ClusterInfo) -> None:
     rdzv = info.rendezvous
     if rdzv is None or rdzv.num_processes <= 1:
         return
+    if os.environ.get("DTPU_JAX_PLATFORM") == "cpu":
+        # CPU XLA cannot run multiprocess computations: initializing the
+        # coordination service would only move the failure from here to the
+        # first jit ("Multiprocess computations aren't implemented on the
+        # CPU backend"). CPU gangs (devcluster e2e, elastic drills) run one
+        # local mesh per process and coordinate over the ZMQ control plane
+        # alone — the platform semantics under test don't need cross-host
+        # XLA collectives.
+        logger.info(
+            "CPU platform: skipping jax.distributed.initialize for the "
+            "%d-process gang (control-plane-only coordination)",
+            rdzv.num_processes,
+        )
+        return
     import jax
 
     jax.distributed.initialize(

@@ -85,15 +85,23 @@ class DistributedContext:
         self._client.send(obj, channel=channel)
         return None
 
-    def broadcast(self, obj: Any, channel: str = ipc.CHANNEL_MAIN) -> Any:
-        """Chief's object is returned on every process."""
+    def broadcast(
+        self,
+        obj: Any,
+        channel: str = ipc.CHANNEL_MAIN,
+        timeout_s: Optional[float] = None,
+    ) -> Any:
+        """Chief's object is returned on every process. `timeout_s` bounds
+        a WORKER's wait for the chief's frame (TimeoutError past it) — the
+        escape hatch elastic resize needs when the chief itself was
+        reclaimed and will never send; chief-side sends never block."""
         if self.size == 1:
             return obj
         if self._server is not None:
             self._server.broadcast(obj, channel=channel)
             return obj
         assert self._client is not None
-        return self._client.recv(channel=channel)
+        return self._client.recv(timeout_s=timeout_s, channel=channel)
 
     def allgather(self, obj: Any, channel: str = ipc.CHANNEL_MAIN) -> List[Any]:
         gathered = self.gather(obj, channel=channel)

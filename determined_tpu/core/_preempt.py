@@ -26,25 +26,45 @@ class PreemptMode(enum.Enum):
 
 
 class _PreemptionWatcher(threading.Thread):
-    """Long-polls the master for the allocation's preemption signal."""
+    """Long-polls the master for the allocation's preemption signal.
 
-    def __init__(self, session: Session, allocation_id: str) -> None:
+    The poll carries this process's rendezvous GENERATION (elastic gangs),
+    so the same channel doubles as the low-latency resize signal: the
+    master returns early the moment a resize leaves the generation behind,
+    with the directive attached. A captured directive ends the watcher —
+    the trainer is about to exit the step loop and rebuild everything
+    (including a fresh watcher) under the new generation."""
+
+    def __init__(
+        self, session: Session, allocation_id: str, generation: int = 0
+    ) -> None:
         super().__init__(daemon=True, name="preemption-watcher")
         self._session = session
         self._allocation_id = allocation_id
+        self._generation = int(generation)
         self._should_preempt = False
         self._should_quit = False
+        self.resize: Optional[dict] = None
 
     def run(self) -> None:
-        while not self._should_quit and not self._should_preempt:
+        while (
+            not self._should_quit
+            and not self._should_preempt
+            and self.resize is None
+        ):
             try:
                 resp = self._session.get(
                     f"/api/v1/allocations/{self._allocation_id}/signals/preemption",
-                    params={"timeout_seconds": 60},
+                    params={
+                        "timeout_seconds": 60,
+                        "generation": self._generation,
+                    },
                     timeout=70,
                 )
                 if resp.get("preempt"):
                     self._should_preempt = True
+                if resp.get("resize"):
+                    self.resize = resp["resize"]
             except Exception as e:
                 logger.warning("preemption poll failed: %s", e)
                 if self._should_quit:
@@ -67,28 +87,124 @@ class PreemptContext:
         distributed: DistributedContext,
         preempt_mode: PreemptMode = PreemptMode.ChiefOnly,
     ) -> None:
+        import os
+
         self._session = session
         self._allocation_id = allocation_id
         self._dist = distributed
         self._mode = preempt_mode
         self._watcher: Optional[_PreemptionWatcher] = None
         self._ack_sent = False
+        self._generation = int(os.environ.get("DTPU_ALLOC_GENERATION", "0"))
+        #: resize directive latched by the last should_preempt round; the
+        #: trainer consumes it via take_resize() at the same boundary.
+        self._pending_resize: Optional[dict] = None
+        #: how long a WORKER waits for the chief's boundary broadcast
+        #: before suspecting the chief itself was reclaimed and falling
+        #: back to asking the master directly. Generous by default — the
+        #: chief may legitimately sit in a long validation/checkpoint
+        #: pass; a timeout only ever ADDS a master poll, never a wrong
+        #: decision (only a master-issued directive acts).
+        self._ctl_timeout_s = float(
+            os.environ.get("DTPU_ELASTIC_CTL_TIMEOUT_S", "20")
+        )
         if distributed.is_chief:
-            self._watcher = _PreemptionWatcher(session, allocation_id)
+            self._watcher = _PreemptionWatcher(
+                session, allocation_id, generation=self._generation
+            )
             self._watcher.start()
 
-    def should_preempt(self, auto_ack: bool = True) -> bool:
-        """Collective at step boundaries: chief polls, result broadcast."""
+    def should_preempt(
+        self, auto_ack: bool = True, resize_hint: Optional[dict] = None
+    ) -> bool:
+        """Collective at step boundaries: chief polls, result broadcast.
+
+        Elastic resize rides the same collective: the chief folds any
+        pending directive (from its watcher long-poll, or the caller's
+        `resize_hint` — the boundary heartbeat's response) into the
+        broadcast, so every rank reaches the same resize verdict at the
+        same boundary with no extra collective. Consume it with
+        take_resize().
+
+        Chief-loss escape: a worker whose hint says the CHIEF was dropped
+        (rank 0 absent from the directive's rank_map) acts on the master's
+        directive directly — the dead chief will never broadcast — and a
+        worker blocked in the broadcast recv falls back to polling the
+        master after `DTPU_ELASTIC_CTL_TIMEOUT_S`. Acting on a
+        master-issued directive is always consistent: the master is the
+        source of truth and the new-generation rendezvous is the barrier
+        every decision converges at."""
+        directive: Optional[dict] = None
         if self._dist.is_chief:
             assert self._watcher is not None
             flag = self._watcher.should_preempt
+            directive = self._watcher.resize or resize_hint
         else:
             flag = False
         if self._mode == PreemptMode.WorkersAskChief or self._dist.size > 1:
-            flag = bool(self._dist.broadcast(flag))
+            if self._dist.is_chief:
+                flag, directive = self._dist.broadcast((flag, directive))
+            elif resize_hint is not None and not self._chief_survives(
+                resize_hint
+            ):
+                # The chief is gone per the master: no broadcast is coming.
+                # Skipping our recv is safe — the dead chief's round was
+                # never sent, so the channel stays aligned for nobody.
+                flag, directive = False, resize_hint
+            else:
+                flag, directive = self._recv_decision()
+        elif directive is None:
+            directive = resize_hint
+        self._pending_resize = directive
         if flag and auto_ack and self._dist.is_chief and not self._ack_sent:
             self.acknowledge_preemption_signal()
-        return flag
+        return bool(flag)
+
+    @staticmethod
+    def _chief_survives(directive: dict) -> bool:
+        return "0" in (directive.get("rank_map") or {})
+
+    def _recv_decision(self):
+        """Worker side of the boundary broadcast, with the chief-death
+        fallback: on recv timeout, ask the master whether a resize dropped
+        the chief; only that (master-authoritative) answer breaks the
+        wait — a slow-but-alive chief still owns the decision."""
+        while True:
+            try:
+                return self._dist.broadcast(
+                    None, timeout_s=self._ctl_timeout_s
+                )
+            except TimeoutError:
+                try:
+                    resp = self._session.get(
+                        f"/api/v1/allocations/{self._allocation_id}"
+                        "/signals/preemption",
+                        params={
+                            "timeout_seconds": 0,
+                            "generation": self._generation,
+                        },
+                        timeout=30,
+                    )
+                except Exception as e:  # noqa: BLE001 — keep waiting
+                    logger.warning("chief-loss fallback poll failed: %s", e)
+                    continue
+                directive = resp.get("resize")
+                if directive is not None and not self._chief_survives(
+                    directive
+                ):
+                    logger.warning(
+                        "chief did not broadcast within %.0fs and the "
+                        "master's resize directive drops rank 0: acting on "
+                        "the directive (chief reclaimed)",
+                        self._ctl_timeout_s,
+                    )
+                    return bool(resp.get("preempt")), directive
+
+    def take_resize(self) -> Optional[dict]:
+        """Pop the resize directive latched by the last should_preempt
+        round (one consumer: the trainer's boundary check)."""
+        directive, self._pending_resize = self._pending_resize, None
+        return directive
 
     def acknowledge_preemption_signal(self) -> None:
         self._ack_sent = True
@@ -107,8 +223,13 @@ class DummyPreemptContext(PreemptContext):
     def __init__(self, distributed: DistributedContext) -> None:  # noqa
         self._dist = distributed
 
-    def should_preempt(self, auto_ack: bool = True) -> bool:
+    def should_preempt(
+        self, auto_ack: bool = True, resize_hint: Optional[dict] = None
+    ) -> bool:
         return False
+
+    def take_resize(self) -> Optional[dict]:
+        return None
 
     def acknowledge_preemption_signal(self) -> None:
         pass

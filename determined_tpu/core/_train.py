@@ -59,23 +59,35 @@ class TrainContext:
             json_body={"progress": float(progress)},
         )
 
-    def heartbeat_step(self, steps_completed: int) -> None:
+    def heartbeat_step(self, steps_completed: int) -> Optional[Dict[str, Any]]:
         """Gang-progress beat: EVERY rank posts its last-completed step to
         the allocation (→ master stall watchdog, which kills a gang whose
         counter stops advancing within `health.stall_timeout_s`). Advisory
         by design — a failed beat must never crash the step loop; the
-        watchdog tolerates gaps up to its timeout."""
+        watchdog tolerates gaps up to its timeout.
+
+        The beat carries this rank's rendezvous GENERATION; when the
+        master has resized the gang past it, the response is the pending
+        resize directive — returned to the trainer, which exits the step
+        loop at this boundary and re-shards onto the new topology."""
         if not self._allocation_id:
-            return
+            return None
+        import os
+
         try:
-            self._session.post(
+            resp = self._session.post(
                 f"/api/v1/allocations/{self._allocation_id}/progress",
                 json_body={
                     "rank": int(self._rank),
                     "step": int(steps_completed),
+                    "generation": int(
+                        os.environ.get("DTPU_ALLOC_GENERATION", "0")
+                    ),
                 },
             )
             self._heartbeat_warned = False
+            if isinstance(resp, dict) and resp.get("resize"):
+                return resp["resize"]
         except Exception as e:  # noqa: BLE001 — advisory beat, never fatal
             if not self._heartbeat_warned:
                 self._heartbeat_warned = True
@@ -83,6 +95,7 @@ class TrainContext:
                     "progress heartbeat failed at step %d: %s (suppressing "
                     "until one succeeds)", steps_completed, e,
                 )
+        return None
 
     def set_status(self, status: str) -> None:
         self._session.post(
@@ -108,8 +121,9 @@ class DummyTrainContext(TrainContext):
     def report_progress(self, progress: float) -> None:
         logger.info("[dummy] progress: %.3f", progress)
 
-    def heartbeat_step(self, steps_completed: int) -> None:
+    def heartbeat_step(self, steps_completed: int) -> Optional[Dict[str, Any]]:
         self._heartbeats.append(int(steps_completed))
+        return None
 
     def set_status(self, status: str) -> None:
         logger.info("[dummy] status: %s", status)

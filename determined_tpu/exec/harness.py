@@ -29,11 +29,34 @@ def import_entrypoint(entrypoint: str) -> Any:
     return getattr(module, attr)
 
 
-def resolve_mesh(hparams: Dict[str, Any], cfg: Dict[str, Any]):
+def resolve_mesh(
+    hparams: Dict[str, Any], cfg: Dict[str, Any], elastic: bool = False
+):
     """Mesh from hparams beats config: lets a searcher sweep parallelism
-    layouts (mesh autotuning — the platform's DeepSpeed-autotune analog)."""
+    layouts (mesh autotuning — the platform's DeepSpeed-autotune analog).
+
+    `elastic`: the gang was resized, so the configured layout may no
+    longer fit the surviving device count — refit it (MeshConfig.refit:
+    model-parallel degrees preserved, data/fsdp absorb the change) instead
+    of erroring a gang that just survived a reclaim."""
     mesh_cfg = hparams.get("mesh") or cfg.get("mesh")
-    return make_mesh(MeshConfig(**mesh_cfg)) if mesh_cfg else None
+    if not mesh_cfg:
+        return None
+    mc = MeshConfig(**mesh_cfg)
+    if elastic:
+        import jax
+
+        try:
+            return make_mesh(mc)
+        except ValueError:
+            refitted = mc.refit(len(jax.devices()))
+            logger.warning(
+                "elastic resize: configured mesh %s does not fit %d "
+                "device(s); refitted to %s", mesh_cfg, len(jax.devices()),
+                refitted,
+            )
+            return make_mesh(refitted)
+    return make_mesh(mc)
 
 
 def parse_unit(spec: Any) -> Optional[TrainUnit]:
@@ -73,51 +96,114 @@ def run(entrypoint: str) -> int:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     assert info is not None and info.trial is not None, "harness needs a trial env"
-    cfg: Dict[str, Any] = info.trial.config
-    trial_cls = import_entrypoint(entrypoint)
-    trial = trial_cls(info.trial.hparams)
 
-    mesh = resolve_mesh(info.trial.hparams, cfg)
+    from determined_tpu.trainer._trainer import ElasticResizeExit
 
-    scfg = cfg.get("searcher", {})
-    try:
-        # Trial lifecycle span: child of the DTPU_TRACEPARENT the launch
-        # chain injected (master allocation span → agent launch span), and
-        # the ambient parent of every Session call the trial makes — the
-        # master's request spans for metric reports land in the SAME trace
-        # as the `det experiment create` that submitted this work.
-        with trace.span(
-            "trial.run",
-            {"trial.id": info.trial.trial_id, "task.id": info.task_id},
-        ), core.init() as ctx:
-            tb_dir = None
-            if cfg.get("tensorboard", True):
-                import tempfile
+    # Elastic resize loop: a resize directive exits Trainer.fit with
+    # ElasticResizeExit; this loop re-enters rendezvous under the new
+    # generation (exec/prep_and_run.apply_resize), rebuilds the core
+    # context + mesh + Trainer for the new world size, and resumes from
+    # the survivors' last verified checkpoint — all inside the same
+    # allocation and the same process. A rank DROPPED by the directive
+    # exits 0 (the master ignores resized-away members' exits).
+    resume_ckpt: Optional[str] = None
+    resume_event = "restart"
+    while True:
+        info = core._context._info.get_cluster_info()
+        assert info is not None and info.trial is not None
+        cfg: Dict[str, Any] = info.trial.config
+        trial_cls = import_entrypoint(entrypoint)
+        trial = trial_cls(info.trial.hparams)
 
-                tb_dir = os.path.join(
-                    tempfile.gettempdir(), f"dtpu-tb-{info.task_id}"
+        # Any nonzero-generation identity is an elastic leg — including a
+        # GROW NEWCOMER, a fresh process launched into a gang smaller (or
+        # larger) than the configured mesh expects: it must refit too.
+        elastic_leg = (
+            resume_event == "resize"
+            or int(os.environ.get("DTPU_ALLOC_GENERATION", "0")) > 0
+        )
+
+        scfg = cfg.get("searcher", {})
+        try:
+            # Trial lifecycle span: child of the DTPU_TRACEPARENT the launch
+            # chain injected (master allocation span → agent launch span), and
+            # the ambient parent of every Session call the trial makes — the
+            # master's request spans for metric reports land in the SAME trace
+            # as the `det experiment create` that submitted this work.
+            with trace.span(
+                "trial.run",
+                {"trial.id": info.trial.trial_id, "task.id": info.task_id},
+            ), core.init() as ctx:
+                # Mesh AFTER core.init(): on TPU pods jax.distributed is
+                # (re)initialized there, and the device set the mesh must
+                # cover — especially after a resize changed the world —
+                # only exists once that handshake is done. Building it
+                # earlier would enumerate the previous topology's devices.
+                mesh = resolve_mesh(
+                    info.trial.hparams, cfg, elastic=elastic_leg
                 )
-            trainer = Trainer(
-                trial,
-                ctx,
-                mesh=mesh,
-                seed=info.trial.trial_seed,
-                searcher_metric=scfg.get("metric", "loss"),
-                smaller_is_better=bool(scfg.get("smaller_is_better", True)),
-                profiling=bool(cfg.get("profiling", {}).get("enabled", False)),
-                tensorboard_dir=tb_dir,
-                health=cfg.get("health"),
-            )
-            trainer.fit(
-                validation_period=parse_unit(cfg.get("min_validation_period")),
-                checkpoint_period=parse_unit(cfg.get("min_checkpoint_period")),
-                report_period=parse_unit(cfg.get("scheduling_unit")) or Batch(10),
-                latest_checkpoint=info.trial.latest_checkpoint,
-            )
-        return 0
-    except Exception:  # noqa: BLE001
-        logger.exception("trial failed")
-        return 1
+                tb_dir = None
+                if cfg.get("tensorboard", True):
+                    import tempfile
+
+                    tb_dir = os.path.join(
+                        tempfile.gettempdir(), f"dtpu-tb-{info.task_id}"
+                    )
+                trainer = Trainer(
+                    trial,
+                    ctx,
+                    mesh=mesh,
+                    seed=info.trial.trial_seed,
+                    searcher_metric=scfg.get("metric", "loss"),
+                    smaller_is_better=bool(scfg.get("smaller_is_better", True)),
+                    profiling=bool(cfg.get("profiling", {}).get("enabled", False)),
+                    tensorboard_dir=tb_dir,
+                    health=cfg.get("health"),
+                    resume_event=resume_event,
+                )
+                trainer.fit(
+                    validation_period=parse_unit(cfg.get("min_validation_period")),
+                    checkpoint_period=parse_unit(cfg.get("min_checkpoint_period")),
+                    report_period=parse_unit(cfg.get("scheduling_unit")) or Batch(10),
+                    latest_checkpoint=resume_ckpt or info.trial.latest_checkpoint,
+                )
+            return 0
+        except ElasticResizeExit as rz:
+            # The `with` above already tore down the old gang's contexts
+            # (ZMQ star, preemption watcher) on the way out.
+            if rz.dropped:
+                logger.info(
+                    "elastic resize dropped this rank (%s); exiting cleanly",
+                    rz.directive.get("reason", ""),
+                )
+                return 0
+            _teardown_jax_distributed()
+            from determined_tpu.exec import prep_and_run
+
+            if not prep_and_run.apply_resize(info.master_url, rz.directive):
+                return 0  # dropped (directive had no mapping for us)
+            # Identity env changed (rank/world/generation/rendezvous):
+            # the next core.init() must re-read it.
+            core._context._info.reset_cluster_info_cache()
+            resume_ckpt = rz.restore_from
+            resume_event = "resize"
+            continue
+        except Exception:  # noqa: BLE001
+            logger.exception("trial failed")
+            return 1
+
+
+def _teardown_jax_distributed() -> None:
+    """Best-effort shutdown of the jax coordination service before a
+    resize re-init: on TPU pods the old service spans the old (broken)
+    topology. On CPU gangs nothing was initialized (see
+    _maybe_init_jax_distributed) and this is a no-op."""
+    try:
+        import jax
+
+        jax.distributed.shutdown()
+    except Exception:  # noqa: BLE001 — not initialized / backend quirk
+        pass
 
 
 def main() -> None:

@@ -27,10 +27,18 @@ import socket
 import subprocess
 import sys
 
-from determined_tpu.common import ipc
+import requests
+
+from determined_tpu.common import faults, ipc
 from determined_tpu.common.api_session import Session
 
 logger = logging.getLogger("determined_tpu.exec")
+
+#: The rendezvous GENERATION this process belongs to (elastic gangs): 0 at
+#: launch, bumped by `apply_resize` when the master reshapes the gang. The
+#: env var is the single source of truth — `core.init()` and the trainer's
+#: heartbeats read it from here.
+GENERATION_ENV = "DTPU_ALLOC_GENERATION"
 
 
 def _my_ip(master_url: str) -> str:
@@ -46,9 +54,85 @@ def _my_ip(master_url: str) -> str:
         return "127.0.0.1"
 
 
-def rendezvous(master_url: str, alloc_id: str, rank: int, num_procs: int) -> None:
-    """Run the rendezvous protocol; mutates os.environ for the entrypoint."""
+def _rendezvous_arrive(
+    session: Session, alloc_id: str, rank: int, addr: str, generation: int
+) -> None:
+    """THE generation-aware rendezvous post — the only place in the
+    client tree allowed to POST `/rendezvous` (tests/test_no_adhoc_retries
+    enforces it). The generation fences stale identities: a straggler that
+    missed an elastic resize gets a terminal 409 re-sync here instead of
+    corrupting the new gang's address table."""
+    session.post(
+        f"/api/v1/allocations/{alloc_id}/rendezvous",
+        json_body={"rank": rank, "addr": addr, "generation": generation},
+    )
+
+
+def rendezvous(
+    master_url: str, alloc_id: str, rank: int, num_procs: int,
+    generation: int = 0,
+) -> None:
+    """Run the rendezvous protocol; mutates os.environ for the entrypoint.
+
+    Generation-fence handling: a 409 re-sync (the gang was elastically
+    resized while this process was arriving or waiting for the table)
+    re-maps this rank through the rejection's directive and retries under
+    the new generation; a rank the directive DROPPED exits cleanly
+    (SystemExit 0 — the master ignores resized-away members' exits)."""
+    for _ in range(8):
+        try:
+            _rendezvous_round(master_url, alloc_id, rank, num_procs, generation)
+            return
+        except requests.HTTPError as e:
+            resp = getattr(e, "response", None)
+            if resp is None or resp.status_code != 409:
+                raise
+            try:
+                body = resp.json()
+            except ValueError:
+                raise e
+            directive = body.get("resize")
+            if not directive:
+                # Fenced with NO directive (e.g. a post-restart master
+                # whose adopted record disagrees about the generation):
+                # this is an error, not a drop — exiting 0 here would let
+                # the master complete the trial as finished work.
+                raise
+            new_rank = (directive.get("rank_map") or {}).get(str(rank))
+            if new_rank is None:
+                if directive.get("resync_only"):
+                    raise  # unmappable: error out, never a clean exit
+                logger.info(
+                    "rendezvous fenced at generation %s and this rank was "
+                    "dropped; exiting for re-sync", body.get("generation"),
+                )
+                raise SystemExit(0)
+            rank = int(new_rank)
+            num_procs = int(directive["num_processes"])
+            generation = int(directive["generation"])
+            os.environ["DTPU_ALLOC_RANK"] = str(rank)
+            os.environ["DTPU_ALLOC_NUM_PROCS"] = str(num_procs)
+            logger.info(
+                "rendezvous fenced; retrying as rank %d of %d (generation "
+                "%d)", rank, num_procs, generation,
+            )
+    raise RuntimeError(
+        f"rendezvous for {alloc_id} could not settle within 8 resize "
+        "generations"
+    )
+
+
+def _rendezvous_round(
+    master_url: str, alloc_id: str, rank: int, num_procs: int,
+    generation: int,
+) -> None:
+    os.environ[GENERATION_ENV] = str(generation)
     if num_procs <= 1:
+        # A 1-process (possibly elastically shrunken) allocation has no
+        # table to publish; stale rendezvous env from a wider generation
+        # must not leak into core.init().
+        os.environ.pop("DTPU_RENDEZVOUS_INFO", None)
+        os.environ.pop("DTPU_CHIEF_PORT", None)
         return
     session = _task_session(master_url)
     ip = _my_ip(master_url)
@@ -57,13 +141,11 @@ def rendezvous(master_url: str, alloc_id: str, rank: int, num_procs: int) -> Non
         addr = f"{ip}:{coord_port}:{chief_port}"
     else:
         addr = ip
-    session.post(
-        f"/api/v1/allocations/{alloc_id}/rendezvous",
-        json_body={"rank": rank, "addr": addr},
-    )
+    _rendezvous_arrive(session, alloc_id, rank, addr, generation)
     info = session.get(
         f"/api/v1/allocations/{alloc_id}/rendezvous",
-        params={"timeout_seconds": 600}, timeout=610,
+        params={"timeout_seconds": 600, "generation": generation},
+        timeout=610,
     )
     chief = info["container_addrs"][0]
     chief_ip, coord_port, chief_port = chief.split(":")
@@ -77,6 +159,44 @@ def rendezvous(master_url: str, alloc_id: str, rank: int, num_procs: int) -> Non
         }
     )
     os.environ["DTPU_CHIEF_PORT"] = chief_port
+
+
+def apply_resize(master_url: str, directive: dict) -> bool:
+    """Re-enter rendezvous under a resize directive's new generation
+    (elastic gang resize, master/allocation.py): re-number this process's
+    rank through `rank_map`, rewrite the DTPU_* identity env, and run the
+    rendezvous protocol again so the survivors (plus any grow newcomers)
+    re-form the gang — all inside the same allocation and process.
+
+    Returns False when this rank was DROPPED by the directive (absent
+    from rank_map): the caller must exit cleanly — the master ignores
+    resized-away members' exits. Drillable via the `resize.rendezvous`
+    fault site."""
+    old_rank = int(os.environ.get("DTPU_ALLOC_RANK", "0"))
+    new_rank = (directive.get("rank_map") or {}).get(str(old_rank))
+    if new_rank is None:
+        if directive.get("resync_only"):
+            raise RuntimeError(
+                "resize directive could not map rank "
+                f"{old_rank} (history gap); erroring out for re-sync"
+            )
+        logger.info(
+            "resize to generation %s dropped rank %d; exiting for re-sync",
+            directive.get("generation"), old_rank,
+        )
+        return False
+    num_procs = int(directive["num_processes"])
+    generation = int(directive["generation"])
+    alloc_id = os.environ.get("DTPU_ALLOCATION_ID", "")
+    os.environ["DTPU_ALLOC_RANK"] = str(new_rank)
+    os.environ["DTPU_ALLOC_NUM_PROCS"] = str(num_procs)
+    faults.inject("resize.rendezvous")
+    logger.info(
+        "elastic resize: rank %d -> %d of %d (generation %d); re-entering "
+        "rendezvous", old_rank, new_rank, num_procs, generation,
+    )
+    rendezvous(master_url, alloc_id, int(new_rank), num_procs, generation)
+    return True
 
 
 def _task_session(master_url: str) -> Session:
@@ -124,16 +244,26 @@ def main() -> int:
     entrypoint = os.environ.get("DTPU_ENTRYPOINT", "")
 
     prepare_context(master_url)
-    rendezvous(master_url, alloc_id, rank, num_procs)
+    rendezvous(
+        master_url, alloc_id, rank, num_procs,
+        generation=int(os.environ.get(GENERATION_ENV, "0")),
+    )
 
     if ":" in entrypoint and " " not in entrypoint:
         # Trial-class entrypoint: run in-process via the harness.
         # SIGTERM → preemption signal so the trainer checkpoints and exits 0.
+        # The notice names OUR RANK (read at signal time — a resize may
+        # have renumbered it): on an elastic gang the master sheds just
+        # this rank and reshards the survivors instead of preempting the
+        # whole gang.
         def on_sigterm(signum, frame):  # noqa: ANN001
             logger.info("SIGTERM: requesting preemption")
             try:
                 _task_session(master_url).post(
-                    f"/api/v1/allocations/{alloc_id}/signals/preemption_from_task"
+                    f"/api/v1/allocations/{alloc_id}/signals/preemption_from_task",
+                    json_body={
+                        "rank": int(os.environ.get("DTPU_ALLOC_RANK", "0")),
+                    },
                 )
             except Exception:  # noqa: BLE001
                 os._exit(143)

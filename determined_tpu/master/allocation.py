@@ -21,6 +21,27 @@ from typing import Any, Callable, Dict, List, Optional
 PENDING, ASSIGNED, RUNNING, TERMINATED = "PENDING", "ASSIGNED", "RUNNING", "TERMINATED"
 
 
+class StaleGenerationError(RuntimeError):
+    """A caller presented a rendezvous/progress generation older than the
+    allocation's current one: it missed an elastic resize. The response is
+    terminal for that identity — the caller must re-sync through the
+    attached directive (or exit, when the directive's rank_map dropped
+    it), never write into the new gang's rendezvous state."""
+
+    def __init__(
+        self, alloc_id: str, caller_gen: int, current_gen: int,
+        directive: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        super().__init__(
+            f"allocation {alloc_id}: generation {caller_gen} is stale "
+            f"(current {current_gen}); re-sync required"
+        )
+        self.alloc_id = alloc_id
+        self.caller_gen = caller_gen
+        self.current_gen = current_gen
+        self.directive = directive
+
+
 @dataclasses.dataclass
 class Allocation:
     id: str
@@ -29,6 +50,40 @@ class Allocation:
     num_processes: int
     slots: int
     state: str = PENDING
+    # elastic resize: the rendezvous GENERATION. Every rendezvous arrive /
+    # progress beat carries the caller's generation; a resize bumps it and
+    # re-numbers the surviving ranks, and stale-generation posts are fenced
+    # off (StaleGenerationError → terminal "re-sync" response).
+    generation: int = 0
+    #: current rank -> agent id realizing it (set at launch, renumbered on
+    #: resize). Empty for adopted allocations (master restart), which makes
+    #: them ineligible for elastic resize — they fall back to full failover.
+    rank_agents: Dict[int, str] = dataclasses.field(default_factory=dict)
+    #: the gang size the trial ASKED for — the grow sweep's target after
+    #: shrinks.
+    target_num_processes: int = 0
+    #: per-host slot share at launch (uniform by construction) — what a
+    #: grow must reserve on the newcomer agent.
+    host_slots: int = 0
+    #: pending resize directive, served to stale-generation ranks:
+    #: {"generation", "num_processes", "rank_map" {old->new}, "from_generation",
+    #:  "reason"}. Self-clearing by construction: ranks on the current
+    #: generation never see it.
+    resize: Optional[Dict[str, Any]] = None
+    resized_at: Optional[float] = None
+    #: recent directives, oldest first (bounded): lets a rank several
+    #: generations behind COMPOSE its mapping old→…→current instead of
+    #: being wrongly told it was dropped — correlated spot reclaims stack
+    #: two resizes inside one beat window routinely.
+    resize_history: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list
+    )
+    #: agents whose DROPPED rank's process may still be draining (SIGTERM
+    #: notice, straggler kill in flight): the grow sweep must not place a
+    #: newcomer there until the old process's exit is confirmed — the
+    #: agent would clobber the task state files and the old exit report
+    #: would be cross-wired to the newcomer.
+    draining_agents: set = dataclasses.field(default_factory=set)
     # rendezvous
     addrs: Dict[int, str] = dataclasses.field(default_factory=dict)  # rank -> addr
     # preemption
@@ -70,11 +125,17 @@ class AllocationService:
     def create(
         self, alloc_id: str, *, task_id: str, trial_id: Optional[int],
         num_processes: int, slots: int,
+        rank_agents: Optional[Dict[int, str]] = None,
     ) -> Allocation:
         with self._cond:
             alloc = Allocation(
                 id=alloc_id, task_id=task_id, trial_id=trial_id,
                 num_processes=num_processes, slots=slots, state=ASSIGNED,
+                rank_agents=dict(rank_agents or {}),
+                target_num_processes=num_processes,
+                host_slots=(
+                    slots // num_processes if num_processes > 0 else slots
+                ),
             )
             self._allocs[alloc_id] = alloc
             self._cond.notify_all()
@@ -134,16 +195,197 @@ class AllocationService:
                     return None
                 self._cond.wait(timeout=remaining)
 
+    # -- elastic resize (generation protocol) ----------------------------------
+    def resize(
+        self,
+        alloc_id: str,
+        *,
+        lost_ranks: Any = (),
+        lost_agents: Any = (),
+        add_agents: Any = (),
+        min_survivors: int = 1,
+        reason: str = "",
+    ) -> Optional[Dict[str, Any]]:
+        """Issue a resize directive: survivors (current ranks minus
+        `lost_ranks`) are re-numbered 0..n-1 in rank order, `add_agents`
+        (grow) append as the highest new ranks, the generation bumps, and
+        the rendezvous table resets for the new generation. Ranks learn of
+        the resize when their next beat (or preemption poll) carries the
+        now-stale generation; the directive names each survivor's new rank
+        — a rank absent from `rank_map` was dropped and must exit.
+
+        Returns the directive, or None when the allocation is unknown /
+        terminated / has no rank bookkeeping (adopted allocs fall back to
+        full failover)."""
+        now = time.time()
+        with self._cond:
+            alloc = self._allocs.get(alloc_id)
+            if alloc is None or alloc.state == TERMINATED or not alloc.rank_agents:
+                return None
+            if alloc.preempt_requested:
+                return None  # the gang is already checkpoint-and-exiting
+            lost = {int(r) for r in lost_ranks}
+            by_agent = {a: r for r, a in alloc.rank_agents.items()}
+            lost.update(
+                by_agent[a] for a in lost_agents if a in by_agent
+            )
+            lost &= set(alloc.rank_agents)
+            if not lost and not add_agents:
+                return None  # stale trigger: nothing actually changed
+            survivors = [r for r in sorted(alloc.rank_agents) if r not in lost]
+            if len(survivors) < max(1, int(min_survivors)):
+                return None  # below the floor: caller falls back to failover
+            new_agents: Dict[int, str] = {
+                new: alloc.rank_agents[old]
+                for new, old in enumerate(survivors)
+            }
+            rank_map = {str(old): new for new, old in enumerate(survivors)}
+            for agent_id in add_agents:
+                new_agents[len(new_agents)] = agent_id
+            if not new_agents:
+                return None  # nobody left: not a resize, a failure
+            from_gen = alloc.generation
+            alloc.generation += 1
+            alloc.rank_agents = new_agents
+            alloc.num_processes = len(new_agents)
+            alloc.addrs.clear()
+            alloc.progress.clear()
+            # Keep the stall watchdog ARMED across the resize window: a
+            # resize that wedges (survivor stuck in a collective, restore
+            # hang) must still age into a bounded-time kill rather than
+            # pin the allocation forever with the watch disarmed.
+            alloc.progress_advanced_at = now
+            alloc.progress_last_beat = now
+            alloc.resized_at = now
+            alloc.resize = {
+                "generation": alloc.generation,
+                "from_generation": from_gen,
+                "num_processes": alloc.num_processes,
+                "rank_map": rank_map,
+                "reason": reason,
+            }
+            alloc.resize_history.append(dict(alloc.resize))
+            del alloc.resize_history[:-16]  # bounded composition window
+            self._cond.notify_all()
+            return dict(alloc.resize)
+
+    @staticmethod
+    def _fast_forward_generation(alloc: Allocation, generation: int) -> None:
+        """Caller holds the lock. A caller AHEAD of the record is only
+        possible after a master restart: adopt() recreates allocations at
+        generation 0 with no rank bookkeeping, while the live ranks kept
+        the real (resized) generation in their env. The ranks know best —
+        fast-forward the record to their generation rather than fencing a
+        healthy gang into a spurious 'stale' exit."""
+        if generation > alloc.generation:
+            alloc.generation = generation
+            alloc.addrs.clear()
+            alloc.resize = None
+            alloc.resize_history.clear()
+
+    @staticmethod
+    def _stale_directive(
+        alloc: Allocation, generation: Optional[int]
+    ) -> Optional[Dict[str, Any]]:
+        """Caller holds the lock. The directive a caller at `generation`
+        must apply, or None when it is current.
+
+        A caller MORE than one generation behind gets its rank_map
+        COMPOSED across the retained directive history (old→…→current):
+        correlated spot reclaims stack two resizes inside one beat
+        window, and handing the survivors an empty map would make the
+        whole gang exit "dropped" — a partially-trained trial silently
+        completing. Only when the history has a gap (rotated out) does
+        the map come back empty, which the CLIENT treats as a nonzero
+        re-sync exit (never a clean completion)."""
+        if generation is None or alloc.resize is None:
+            return None
+        generation = int(generation)
+        if generation >= alloc.generation:
+            return None
+        directive = dict(alloc.resize)
+        if generation == directive.get("from_generation"):
+            return directive
+        chain = sorted(
+            (d for d in alloc.resize_history
+             if d["from_generation"] >= generation),
+            key=lambda d: d["from_generation"],
+        )
+        contiguous = (
+            bool(chain)
+            and chain[0]["from_generation"] == generation
+            and chain[-1]["generation"] == alloc.generation
+            and all(
+                a["generation"] == b["from_generation"]
+                for a, b in zip(chain, chain[1:])
+            )
+        )
+        if not contiguous:
+            # Unmappable (history rotated out): the caller must exit and
+            # re-sync, but NOT as a clean "dropped" exit — resync_only
+            # tells the client to exit nonzero so a wrong verdict can at
+            # worst shed one rank, never complete the trial early.
+            directive["rank_map"] = {}
+            directive["resync_only"] = True
+            return directive
+        composed: Dict[str, int] = {}
+        for old in chain[0]["rank_map"]:
+            r: Any = old
+            for d in chain:
+                r = d["rank_map"].get(str(r))
+                if r is None:
+                    break
+            if r is not None:
+                composed[old] = int(r)
+        directive["rank_map"] = composed
+        return directive
+
+    def pending_resize(
+        self, alloc_id: str, generation: Optional[int]
+    ) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            alloc = self._allocs.get(alloc_id)
+            if alloc is None:
+                return None
+            return self._stale_directive(alloc, generation)
+
+    def mark_draining(self, alloc_id: str, agents: Any) -> None:
+        """Record agents whose dropped rank's process is still exiting."""
+        with self._lock:
+            alloc = self._allocs.get(alloc_id)
+            if alloc is not None:
+                alloc.draining_agents |= set(agents)
+
+    def clear_draining(self, alloc_id: str, agent_id: str) -> None:
+        """The dropped rank's exit was confirmed: its agent is safe to
+        host this allocation's grow newcomer again."""
+        with self._lock:
+            alloc = self._allocs.get(alloc_id)
+            if alloc is not None:
+                alloc.draining_agents.discard(agent_id)
+
     # -- gang progress (stall watchdog feed) -----------------------------------
-    def record_progress(self, alloc_id: str, rank: int, step: int) -> None:
+    def record_progress(
+        self, alloc_id: str, rank: int, step: int,
+        generation: Optional[int] = None,
+    ) -> Optional[Dict[str, Any]]:
         """One rank's last-completed-step beat (harness report boundary).
         Unknown allocations are dropped silently — a beat racing its own
-        allocation's teardown is normal during preemption/kill."""
+        allocation's teardown is normal during preemption/kill.
+
+        Returns the pending resize directive when the beat carries a stale
+        generation (the rank missed a resize: its beat is NOT recorded —
+        its rank number belongs to the old numbering — and the directive
+        tells it how to re-sync), else None."""
         now = time.time()
         with self._cond:
             alloc = self._allocs.get(alloc_id)
             if alloc is None or alloc.state == TERMINATED:
-                return
+                return None
+            if generation is not None:
+                self._fast_forward_generation(alloc, int(generation))
+                if int(generation) < alloc.generation:
+                    return self._stale_directive(alloc, generation)
             prev = alloc.progress.get(int(rank))
             alloc.progress[int(rank)] = {"step": int(step), "time": now}
             alloc.progress_last_beat = now
@@ -174,24 +416,49 @@ class AllocationService:
             )
 
     # -- rendezvous (ref: rendezvous.go try/ready/push) ------------------------
-    def rendezvous_arrive(self, alloc_id: str, rank: int, addr: str) -> None:
+    def rendezvous_arrive(
+        self, alloc_id: str, rank: int, addr: str,
+        generation: int = 0,
+    ) -> None:
+        """Idempotent PER GENERATION: the same rank re-arriving in the
+        current generation just refreshes its address (rendezvous re-entry
+        under churn must not corrupt the table). A stale-generation
+        arrival — a straggler that missed a resize — is fenced off with a
+        terminal StaleGenerationError instead of poisoning the new gang's
+        address table with an old rank numbering."""
         with self._cond:
             alloc = self._allocs[alloc_id]
+            self._fast_forward_generation(alloc, int(generation))
+            if int(generation) != alloc.generation:
+                raise StaleGenerationError(
+                    alloc_id, int(generation), alloc.generation,
+                    self._stale_directive(alloc, int(generation)),
+                )
             alloc.addrs[rank] = addr
             if len(alloc.addrs) == alloc.num_processes:
                 alloc.state = RUNNING
             self._cond.notify_all()
 
     def rendezvous_info(
-        self, alloc_id: str, timeout: float = 600.0
+        self, alloc_id: str, timeout: float = 600.0,
+        generation: int = 0,
     ) -> Optional[Dict[str, Any]]:
-        """Block until every process arrived; returns the published table."""
+        """Block until every process arrived; returns the published table.
+        Raises StaleGenerationError if the caller's generation falls
+        behind mid-wait (a second resize landed): waiting out the timeout
+        would leave the straggler blind to the re-sync it now needs."""
         deadline = time.time() + timeout
         with self._cond:
             while True:
                 alloc = self._allocs.get(alloc_id)
                 if alloc is None:
                     return None
+                self._fast_forward_generation(alloc, int(generation))
+                if int(generation) != alloc.generation:
+                    raise StaleGenerationError(
+                        alloc_id, int(generation), alloc.generation,
+                        self._stale_directive(alloc, int(generation)),
+                    )
                 if len(alloc.addrs) == alloc.num_processes:
                     addrs = [alloc.addrs[r] for r in sorted(alloc.addrs)]
                     return {
@@ -216,9 +483,14 @@ class AllocationService:
             self._cond.notify_all()
 
     def should_preempt(
-        self, alloc_id: str, timeout: float = 60.0
+        self, alloc_id: str, timeout: float = 60.0,
+        generation: Optional[int] = None,
     ) -> bool:
-        """Long-poll: returns current preemption flag (True as soon as set)."""
+        """Long-poll: returns current preemption flag (True as soon as set).
+        When the caller supplies its `generation`, the poll ALSO returns
+        early the moment a resize leaves that generation behind — the
+        preemption channel doubles as the low-latency resize signal (the
+        HTTP layer attaches the pending directive to the response)."""
         deadline = time.time() + timeout
         with self._cond:
             while True:
@@ -227,6 +499,8 @@ class AllocationService:
                     return False
                 if alloc.preempt_requested or alloc.state == TERMINATED:
                     return alloc.preempt_requested
+                if generation is not None and alloc.generation > int(generation):
+                    return False  # caller checks pending_resize next
                 remaining = deadline - time.time()
                 if remaining <= 0:
                     return False

@@ -280,9 +280,16 @@ class _IdempotencyCache:
 
 
 class ApiError(Exception):
-    def __init__(self, status: int, message: str) -> None:
+    def __init__(
+        self, status: int, message: str,
+        payload: Optional[Dict[str, Any]] = None,
+    ) -> None:
         super().__init__(message)
         self.status = status
+        #: extra structured fields merged into the error body (e.g. the
+        #: generation-fence 409 carries the resize directive so a fenced
+        #: straggler can re-sync from the rejection itself).
+        self.payload = payload or {}
 
 
 class _PlainText(Exception):
@@ -538,11 +545,22 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
 
     # -- harness: allocation signals -----------------------------------------
     def preemption_signal(r: ApiRequest):
-        return {
+        # `generation` (elastic gangs) turns this long-poll into the
+        # low-latency resize channel too: it returns early the moment a
+        # resize leaves the caller's generation behind, with the pending
+        # directive attached.
+        gen = r.q("generation")
+        gen_i = int(gen) if gen is not None else None
+        resp = {
             "preempt": m.alloc_service.should_preempt(
-                r.groups[0], timeout=r.qfloat("timeout_seconds", 60.0)
+                r.groups[0], timeout=r.qfloat("timeout_seconds", 60.0),
+                generation=gen_i,
             )
         }
+        resize = m.alloc_service.pending_resize(r.groups[0], gen_i)
+        if resize is not None:
+            resp["resize"] = resize
+        return resp
 
     def ack_preemption(r: ApiRequest):
         m.alloc_service.ack_preempt(r.groups[0])
@@ -551,6 +569,13 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
     def preempt_from_task(r: ApiRequest):
         # A task saw SIGTERM (cloud TPU preemption notice) and asks to be
         # preempted gracefully (ref: exec/launch.py:16 SLURM handler).
+        # When the notice names a RANK and the trial is elastic, only that
+        # rank is reclaimed: the master resizes the gang in place instead
+        # of checkpoint-and-requeueing everyone (resize_cost_s, not
+        # restart_cost_s).
+        rank = r.body.get("rank") if isinstance(r.body, dict) else None
+        if rank is not None and m.reclaim_rank(r.groups[0], int(rank)):
+            return {"resized": True}
         m.alloc_service.signal_preempt(r.groups[0])
         return {}
 
@@ -583,24 +608,61 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
     def alloc_progress(r: ApiRequest):
         # Gang-progress beat (stall watchdog): every rank posts its
         # last-completed step; the master tick kills the gang when the
-        # counter stops advancing within health.stall_timeout_s.
-        m.alloc_service.record_progress(
+        # counter stops advancing within health.stall_timeout_s. The beat
+        # doubles as the elastic resize channel: a rank whose generation
+        # is stale gets the pending directive back (its beat is NOT
+        # recorded — old rank numbering) and must re-sync.
+        gen = r.body.get("generation")
+        directive = m.alloc_service.record_progress(
             r.groups[0],
             int(r.body.get("rank", 0)),
             int(r.body.get("step", 0)),
+            generation=int(gen) if gen is not None else None,
         )
+        if directive is not None:
+            return {"resize": directive}
         return {}
 
     def rendezvous_arrive(r: ApiRequest):
-        m.alloc_service.rendezvous_arrive(
-            r.groups[0], int(r.body["rank"]), r.body["addr"]
-        )
+        from determined_tpu.master.allocation import StaleGenerationError
+
+        try:
+            m.alloc_service.rendezvous_arrive(
+                r.groups[0], int(r.body["rank"]), r.body["addr"],
+                generation=int(r.body.get("generation", 0)),
+            )
+        except StaleGenerationError as e:
+            # Terminal fence, not a retry: a straggler that missed the
+            # resize must never write into the new gang's rendezvous
+            # table. The directive rides the 409 so it can re-sync (or
+            # exit, when its rank was dropped) from the rejection itself.
+            raise ApiError(
+                409, str(e),
+                payload={
+                    "resync": True,
+                    "generation": e.current_gen,
+                    "resize": e.directive,
+                },
+            )
         return {}
 
     def rendezvous_info(r: ApiRequest):
-        info = m.alloc_service.rendezvous_info(
-            r.groups[0], timeout=r.qfloat("timeout_seconds", 600.0)
-        )
+        from determined_tpu.master.allocation import StaleGenerationError
+
+        try:
+            info = m.alloc_service.rendezvous_info(
+                r.groups[0], timeout=r.qfloat("timeout_seconds", 600.0),
+                generation=int(r.q("generation", "0") or 0),
+            )
+        except StaleGenerationError as e:
+            raise ApiError(
+                409, str(e),
+                payload={
+                    "resync": True,
+                    "generation": e.current_gen,
+                    "resize": e.directive,
+                },
+            )
         if info is None:
             raise ApiError(408, "rendezvous timeout")
         return info
@@ -1920,7 +1982,9 @@ class ApiServer:
                             status_code = e.status
                             if e.status >= 500:
                                 span.status = "ERROR"
-                            self._send(e.status, {"error": str(e)})
+                            self._send(
+                                e.status, {"error": str(e), **e.payload}
+                            )
                         except KeyError as e:
                             status_code = 404
                             self._send(404, {"error": f"not found: {e}"})

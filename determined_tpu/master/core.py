@@ -46,6 +46,15 @@ EXPERIMENT_GOODPUT = METRICS.gauge(
     "Latest goodput percentage from each experiment's timeline ledger.",
     labels=("experiment",),
 )
+#: Elastic gang resizes by direction: "shrink" = a rank was reclaimed/lost
+#: and the survivors reshard in place (no restart-budget charge, no queue
+#: round-trip), "grow" = a capacity tick re-expanded a shrunken gang back
+#: toward its requested size.
+ELASTIC_RESIZES = METRICS.counter(
+    "dtpu_elastic_resizes_total",
+    "Elastic gang resizes issued, by direction.",
+    labels=("direction",),
+)
 
 
 class AgentHub:
@@ -573,6 +582,10 @@ class Master:
         self.alloc_service.create(
             alloc_id, task_id=task_id, trial_id=trial_id,
             num_processes=len(hosts), slots=slots,
+            # rank -> agent bookkeeping feeds elastic resize: a lost agent
+            # maps to the rank it realized, so the resize directive can
+            # re-number the survivors.
+            rank_agents={rank: a for rank, a in enumerate(hosts)},
         )
         self.db.upsert_allocation(
             alloc_id, task_id=task_id, trial_id=trial_id,
@@ -604,50 +617,83 @@ class Master:
         task_ctx = submit_ctx
         if getattr(span, "trace_id", ""):
             task_ctx = (span.trace_id, span.span_id)
-        rank_envs: List[tuple] = []
-        for rank, agent_id in enumerate(hosts):
-            info = _info.ClusterInfo(
-                master_url=self.external_url,
-                cluster_id=self.cluster_id,
-                agent_id=agent_id,
-                session_token=self.auth.issue_task_token(task_id),
-                task_id=task_id,
-                allocation_id=alloc_id,
-                task_type=task_type,
-                trial=trial_info,
-                checkpoint_storage=config.get("checkpoint_storage"),
+        rank_envs: List[tuple] = [
+            (
+                agent_id,
+                self._build_task_env(
+                    alloc_id=alloc_id, task_id=task_id, task_type=task_type,
+                    agent_id=agent_id, rank=rank, num_procs=len(hosts),
+                    slots=assignment[agent_id], config=config,
+                    trial_info=trial_info, task_ctx=task_ctx,
+                ),
             )
-            env = info.to_env()
-            env["DTPU_ALLOC_RANK"] = str(rank)
-            env["DTPU_ALLOC_NUM_PROCS"] = str(len(hosts))
-            env["DTPU_SLOTS"] = str(assignment[agent_id])
-            jax_platform = config.get("environment", {}).get("jax_platform")
-            if jax_platform:
-                env["DTPU_JAX_PLATFORM"] = jax_platform
-            # User env vars (ref expconf environment.environment_variables):
-            # applied before the DTPU_* contract so they cannot clobber it.
-            user_env = {
-                str(k): str(v)
-                for k, v in config.get("environment", {})
-                .get("variables", {}).items()
-                if not str(k).startswith("DTPU_") or str(k) == "DTPU_SHELL_TOKEN"
-            }
-            env = {**user_env, **env}
-            if task_ctx is not None:
-                # W3C trace context rides the task env: the agent parents
-                # its launch span from it, the trial's core.init Session
-                # stamps it on every API call (common/trace.py).
-                env[trace_mod.TRACEPARENT_ENV] = (
-                    trace_mod.format_traceparent(*task_ctx)
-                )
-            if config.get("context"):
-                env["DTPU_CONTEXT_ID"] = str(config["context"])
-            rank_envs.append((agent_id, env))
+            for rank, agent_id in enumerate(hosts)
+        ]
 
         self.pool_of(alloc_id).start(
             alloc_id=alloc_id, task_id=task_id, entrypoint=entrypoint,
             rank_envs=rank_envs, agent_hub=self.agent_hub,
         )
+
+    def _build_task_env(
+        self,
+        *,
+        alloc_id: str,
+        task_id: str,
+        task_type: str,
+        agent_id: str,
+        rank: int,
+        num_procs: int,
+        slots: int,
+        config: Dict[str, Any],
+        trial_info: Optional[_info.TrialInfo],
+        task_ctx: Optional[tuple],
+        generation: int = 0,
+    ) -> Dict[str, str]:
+        """One rank's DTPU_* env — THE single source of the task env
+        contract, shared by the launch path (enqueue_start_actions) and
+        the elastic grow path (_enqueue_grow_start): the two must never
+        drift, or grow newcomers launch under a different contract than
+        the survivors they join."""
+        info = _info.ClusterInfo(
+            master_url=self.external_url,
+            cluster_id=self.cluster_id,
+            agent_id=agent_id,
+            session_token=self.auth.issue_task_token(task_id),
+            task_id=task_id,
+            allocation_id=alloc_id,
+            task_type=task_type,
+            trial=trial_info,
+            checkpoint_storage=config.get("checkpoint_storage"),
+        )
+        env = info.to_env()
+        env["DTPU_ALLOC_RANK"] = str(rank)
+        env["DTPU_ALLOC_NUM_PROCS"] = str(num_procs)
+        if generation:
+            env["DTPU_ALLOC_GENERATION"] = str(generation)
+        env["DTPU_SLOTS"] = str(slots)
+        jax_platform = config.get("environment", {}).get("jax_platform")
+        if jax_platform:
+            env["DTPU_JAX_PLATFORM"] = jax_platform
+        # User env vars (ref expconf environment.environment_variables):
+        # applied before the DTPU_* contract so they cannot clobber it.
+        user_env = {
+            str(k): str(v)
+            for k, v in config.get("environment", {})
+            .get("variables", {}).items()
+            if not str(k).startswith("DTPU_") or str(k) == "DTPU_SHELL_TOKEN"
+        }
+        env = {**user_env, **env}
+        if task_ctx is not None:
+            # W3C trace context rides the task env: the agent parents
+            # its launch span from it, the trial's core.init Session
+            # stamps it on every API call (common/trace.py).
+            env[trace_mod.TRACEPARENT_ENV] = (
+                trace_mod.format_traceparent(*task_ctx)
+            )
+        if config.get("context"):
+            env["DTPU_CONTEXT_ID"] = str(config["context"])
+        return env
 
     @property
     def external_url(self) -> str:
@@ -681,7 +727,31 @@ class Master:
                 # cheap, and latency here is trial-start latency.
                 self.rm.tick_all()
                 for alloc_id in self.alloc_service.overdue_preemptions():
-                    self.kill_allocation(alloc_id)
+                    # Escalate, don't just kill: a rank that acked the
+                    # preemption but never exits (wedged teardown, agent
+                    # that lost the KILL, watchdog disarmed mid-resize)
+                    # would otherwise pin the allocation RUNNING forever —
+                    # the kill alone only helps when the agent is healthy
+                    # enough to report the exit. Completing with OUR
+                    # attribution (infra: the task got its full
+                    # preempt_timeout_s of grace; overrunning it is an
+                    # operational failure, not the workload's) unsticks
+                    # the trial either way; a late agent EXITED report
+                    # finds the record TERMINATED and no-ops.
+                    try:
+                        self.kill_allocation(alloc_id)
+                    except Exception:  # noqa: BLE001 — escalation must land
+                        logger.exception(
+                            "preempt-timeout kill failed for %s", alloc_id
+                        )
+                    self.alloc_service.complete(
+                        alloc_id, exit_code=1,
+                        reason=(
+                            "preemption deadline exceeded (acked or "
+                            "ignored, never exited); escalated to kill"
+                        ),
+                        infra=True,
+                    )
                 # Maintenance half stays on the 1 s cadence even under a
                 # kick storm (an ASHA burst of exits): pool.sync() can be
                 # a live k8s LIST, and the sweeps are O(cluster) — kicks
@@ -702,6 +772,7 @@ class Master:
                     self._reap_unmanaged()
                     self._reap_idle_commands()
                     self._stall_sweep()
+                    self._elastic_grow_sweep()
                     self._prune_heartbeats()
                     self.auth.sweep()
             except Exception:  # noqa: BLE001
@@ -797,6 +868,36 @@ class Master:
             )
             vanished = suspects + missing
             infra = bool(vanished)
+            if vanished and len(vanished) < alloc.num_processes:
+                # Elastic gangs drop ONLY the vanished/straggling ranks and
+                # reshard the survivors in place — the watchdog's rank
+                # attribution becomes a resize trigger, not a gang kill.
+                # Capture the doomed ranks' agents BEFORE the resize
+                # renumbers the table: a straggler stuck in a collective is
+                # still holding chips and must be killed on its host.
+                doomed_agents = [
+                    alloc.rank_agents[r] for r in vanished
+                    if r in alloc.rank_agents
+                ]
+                if self.resize_allocation(
+                    alloc_id, lost_ranks=vanished,
+                    reason=(
+                        f"stall watchdog: no step progress in "
+                        f"{now - basis:.0f}s; dropping unresponsive rank(s)"
+                    ),
+                ):
+                    for agent_id in doomed_agents:
+                        self.agent_hub.enqueue(
+                            agent_id,
+                            {"type": "KILL", "alloc_id": alloc_id},
+                        )
+                    logger.warning(
+                        "stall watchdog resized allocation %s (trial %s) "
+                        "instead of killing it: dropped %s",
+                        alloc_id, trial_id,
+                        ", ".join(f"rank {r}" for r in vanished),
+                    )
+                    continue
             named = ", ".join(
                 f"rank {r}"
                 + (f" ({alloc.addrs[r]})" if r in alloc.addrs else "")
@@ -827,6 +928,226 @@ class Master:
             self.alloc_service.complete(
                 alloc_id, exit_code=1, reason=reason, infra=infra
             )
+
+    # -- elastic gang resize (ROADMAP: survive spot reclaim by resharding
+    # -- onto the surviving mesh, not restarting the gang) ---------------------
+    def _elastic_conf(self, alloc_id: str) -> Optional[Dict[str, Any]]:
+        """The trial's `elastic:` config when elastic resize is enabled for
+        this allocation, else None (NTSC tasks and non-elastic trials fall
+        through to the classic whole-gang failover)."""
+        with self._lock:
+            exp_trial = self._alloc_index.get(alloc_id)
+        if exp_trial is None:
+            return None
+        ecfg = exp_trial[0].config.get("elastic") or {}
+        return ecfg if ecfg.get("enabled") else None
+
+    def resize_allocation(
+        self,
+        alloc_id: str,
+        *,
+        lost_agents: Any = (),
+        lost_ranks: Any = (),
+        exited_agents: Any = (),
+        reason: str = "",
+    ) -> bool:
+        """Shrink an elastic gang in place: the lost ranks drop out, the
+        survivors are re-numbered under a new rendezvous generation, and
+        the directive is served over the existing progress/preemption
+        polling channel — no kill, no requeue, no restart-budget charge.
+        Returns True when a directive was issued (the caller must NOT fail
+        the allocation over); False means elastic is off / below the
+        min-world floor / not resizable — classic failover applies."""
+        ecfg = self._elastic_conf(alloc_id)
+        if ecfg is None:
+            return False
+        directive = self.alloc_service.resize(
+            alloc_id,
+            lost_ranks=lost_ranks,
+            lost_agents=lost_agents,
+            min_survivors=max(1, int(ecfg.get("min_world_size", 1) or 1)),
+            reason=reason,
+        )
+        if directive is None:
+            return False
+        # Free the dropped hosts' slot shares in place — no queue
+        # round-trip; the freed capacity schedules on the immediate tick
+        # (and may host this gang's own grow later).
+        alloc = self.alloc_service.get(alloc_id)
+        survivors = set(alloc.rank_agents.values()) if alloc else set()
+        pool = self.pool_of(alloc_id)
+        assignment = pool.assignment_of(alloc_id) or {}
+        dropped = [a for a in assignment if a not in survivors]
+        for agent_id in dropped:
+            pool.shrink_alloc(alloc_id, agent_id)
+        # Dropped hosts whose process has NOT yet confirmed its exit
+        # (SIGTERM notice, straggler kill still in flight) are off-limits
+        # to the grow sweep until the exit lands — a newcomer started
+        # there would clobber the draining task's state files and inherit
+        # its exit report.
+        self.alloc_service.mark_draining(
+            alloc_id, set(dropped) - set(exited_agents)
+        )
+        self.db.upsert_allocation(
+            alloc_id, num_processes=directive["num_processes"]
+        )
+        ELASTIC_RESIZES.labels("shrink").inc()
+        logger.warning(
+            "elastic resize of %s: %s -> generation %d, %d process(es) "
+            "(%s); restart budget untouched",
+            alloc_id, reason, directive["generation"],
+            directive["num_processes"],
+            "survivors reshard from the last verified checkpoint",
+        )
+        self.kick_tick()
+        return True
+
+    def reclaim_rank(self, alloc_id: str, rank: int) -> bool:
+        """A single rank got a spot-reclaim notice (SIGTERM → the task's
+        preemption_from_task POST with its rank). Elastic gangs shed just
+        that rank; the doomed process sees itself dropped from the
+        directive's rank_map at its next beat and exits cleanly. Returns
+        False when elastic is off — the caller falls back to whole-gang
+        preemption."""
+        alloc = self.alloc_service.get(alloc_id)
+        if alloc is None or alloc.num_processes <= 1:
+            return False
+        return self.resize_allocation(
+            alloc_id, lost_ranks=[int(rank)],
+            reason=f"spot reclaim notice (SIGTERM) on rank {rank}",
+        )
+
+    def _elastic_grow_sweep(self) -> None:
+        """Capacity tick: grow shrunken elastic gangs back toward their
+        requested size, one host per tick per allocation. The newcomer
+        gets a START carrying the new generation's rendezvous identity;
+        the survivors learn of the grow from their next stale-generation
+        beat and re-enter rendezvous alongside it. Opt-in via
+        `elastic.grow` — a drill asserting steady state on the shrunk
+        mesh must not have the mesh grow back underneath it."""
+        with self._lock:
+            index = {
+                a: (exp, trial_id)
+                for a, (exp, trial_id) in self._alloc_index.items()
+            }
+        for alloc_id, (exp, trial_id) in index.items():
+            ecfg = exp.config.get("elastic") or {}
+            if not (ecfg.get("enabled") and ecfg.get("grow")):
+                continue
+            alloc = self.alloc_service.get(alloc_id)
+            if (
+                alloc is None
+                or alloc.state != "RUNNING"
+                or not alloc.rank_agents
+                or alloc.preempt_requested
+                or alloc.num_processes >= alloc.target_num_processes
+            ):
+                continue
+            # Let the previous resize settle first (every current-
+            # generation rank beating again) — stacking generations while
+            # survivors are mid-restore multiplies the re-sync churn.
+            ranks, _ = self.alloc_service.progress_snapshot(alloc_id)
+            if len(ranks) < alloc.num_processes:
+                continue
+            n_slots = max(1, alloc.host_slots)
+            pool = self.pool_of(alloc_id)
+            agent_id = pool.grow_alloc(
+                alloc_id, n_slots, exclude=set(alloc.draining_agents)
+            )
+            if agent_id is None:
+                continue  # no free capacity yet; try next tick
+            directive = self.alloc_service.resize(
+                alloc_id, add_agents=[agent_id],
+                reason=(
+                    f"grow back toward {alloc.target_num_processes} "
+                    "process(es)"
+                ),
+            )
+            if directive is None:
+                pool.shrink_alloc(alloc_id, agent_id)  # return the hold
+                continue
+            try:
+                self._enqueue_grow_start(
+                    alloc_id, exp, trial_id, agent_id, directive
+                )
+            except Exception:  # noqa: BLE001 — roll the grow back
+                # The directive is already issued: survivors will wait in
+                # the new generation's rendezvous for a newcomer whose
+                # START never went out. Shrink the phantom rank right back
+                # out (a follow-up directive) so they re-sync to a world
+                # that actually exists; a later tick retries the growth.
+                logger.exception("grow start failed for %s", alloc_id)
+                self.alloc_service.resize(
+                    alloc_id,
+                    lost_ranks=[directive["num_processes"] - 1],
+                    reason="grow start failed; retracting the newcomer",
+                )
+                pool.shrink_alloc(alloc_id, agent_id)
+                continue
+            self.db.upsert_allocation(
+                alloc_id, num_processes=directive["num_processes"]
+            )
+            ELASTIC_RESIZES.labels("grow").inc()
+            logger.info(
+                "elastic grow of %s: +%s as rank %d (generation %d, now "
+                "%d processes)",
+                alloc_id, agent_id, directive["num_processes"] - 1,
+                directive["generation"], directive["num_processes"],
+            )
+
+    def _enqueue_grow_start(
+        self,
+        alloc_id: str,
+        exp: Experiment,
+        trial_id: int,
+        agent_id: str,
+        directive: Dict[str, Any],
+    ) -> None:
+        """START action for a grow's newcomer rank: the same DTPU_* env
+        contract enqueue_start_actions builds, plus the rendezvous
+        generation, with the trial's LATEST registered checkpoint so the
+        newcomer reshards the same state the survivors restore."""
+        alloc = self.alloc_service.get(alloc_id)
+        assert alloc is not None
+        cfg = exp.config
+        rec = exp.trials.get(trial_id)
+        trial_row = self.db.get_trial(trial_id) or {}
+        trial_info = _info.TrialInfo(
+            trial_id=trial_id,
+            experiment_id=exp.id,
+            trial_seed=rec.seed if rec else int(trial_row.get("seed") or 0),
+            hparams=(rec.hparams if rec else trial_row.get("hparams")) or {},
+            config=cfg,
+            latest_checkpoint=(
+                trial_row.get("latest_checkpoint")
+                or cfg.get("warm_start_checkpoint")
+            ),
+            trial_run_id=rec.run_id if rec else int(trial_row.get("run_id") or 0),
+        )
+        # Trace parity with the launch path: parent the newcomer under the
+        # allocation span when one exists, else the submit context.
+        with self._lock:
+            span = self._alloc_spans.get(alloc_id)
+            submit_ctx = self._exp_traceparents.get(exp.id)
+        task_ctx = submit_ctx
+        if span is not None and getattr(span, "trace_id", ""):
+            task_ctx = (span.trace_id, span.span_id)
+        env = self._build_task_env(
+            alloc_id=alloc_id, task_id=alloc.task_id, task_type="TRIAL",
+            agent_id=agent_id, rank=directive["num_processes"] - 1,
+            num_procs=directive["num_processes"],
+            slots=max(1, alloc.host_slots), config=cfg,
+            trial_info=trial_info, task_ctx=task_ctx,
+            generation=directive["generation"],
+        )
+        self.agent_hub.enqueue(
+            agent_id,
+            {
+                "type": "START", "alloc_id": alloc_id,
+                "task_id": alloc.task_id,
+                "entrypoint": cfg.get("entrypoint", ""), "env": env,
+            },
+        )
 
     def _reap_unmanaged(self) -> None:
         """Unmanaged-trial liveness: a silent driver means the trial errored
@@ -965,6 +1286,18 @@ class Master:
             if alloc_id in reported:
                 continue
             if self.agent_hub.has_pending_start(agent_id, alloc_id):
+                continue
+            if self.resize_allocation(
+                alloc_id, lost_agents=[agent_id],
+                exited_agents=[agent_id],  # the agent has no such process
+                reason=f"agent {agent_id} re-registered without the rank",
+            ):
+                # Elastic: the host lost its task state (reboot) but the
+                # rest of the gang is alive — drop just this rank.
+                logger.warning(
+                    "agent %s re-registered without allocation %s; elastic "
+                    "resize dropped its rank", agent_id, alloc_id,
+                )
                 continue
             logger.warning(
                 "agent %s re-registered without allocation %s; failing it "
@@ -1117,7 +1450,9 @@ class Master:
                     )
 
     def lose_agent(self, agent_id: str) -> None:
-        """Remove a dead agent and fail over everything it was running."""
+        """Remove a dead agent and fail over everything it was running —
+        except elastic gangs that span other agents, which shed only the
+        lost host's rank and reshard in place (resize_allocation)."""
         logger.warning("agent %s lost; failing over its allocations", agent_id)
         self.agent_hub.remove(agent_id)
         for pool in self.rm.pools.values():
@@ -1130,12 +1465,31 @@ class Master:
                 if agent:
                     for alloc_id in agent.used:
                         victims[alloc_id] = dict(pool._running.get(alloc_id, {}))
-            for alloc_id in pool.remove_agent(agent_id):
-                for other_agent in victims.get(alloc_id, {}):
+            # Pop the dead agent from the pool FIRST, keeping every
+            # victim's surviving occupancy: the resize path below runs
+            # scheduler ticks (shrink_alloc), and a tick that still sees
+            # the dead agent's freed slots would place pending work onto
+            # a host that no longer polls.
+            pool.remove_agent(agent_id, keep=set(victims))
+            for alloc_id, assignment in victims.items():
+                if len(assignment) > 1 and self.resize_allocation(
+                    alloc_id, lost_agents=[agent_id],
+                    exited_agents=[agent_id],  # host gone, process with it
+                    reason=f"agent {agent_id} lost (spot reclaim)",
+                ):
+                    continue  # survivors reshard in place
+                if self.alloc_service.get(alloc_id) is None:
+                    # Occupancy with no lifecycle record (a reattach hold):
+                    # nothing to complete — just free it.
+                    pool.release(alloc_id)
+                    continue
+                for other_agent in assignment:
                     if other_agent != agent_id:
                         self.agent_hub.enqueue(
                             other_agent, {"type": "KILL", "alloc_id": alloc_id}
                         )
+                # complete() releases the remaining occupancy through the
+                # _allocation_exited exit hook.
                 self.alloc_service.complete(
                     alloc_id, exit_code=1, reason=f"agent {agent_id} lost",
                     # A lost host (spot reclaim, VM failure) is the
@@ -1734,7 +2088,8 @@ class Master:
             alloc_id = event["alloc_id"]
             code = int(event.get("exit_code", 0))
             reason = event.get("reason", "")
-            if self.alloc_service.get(alloc_id) is None:
+            alloc = self.alloc_service.get(alloc_id)
+            if alloc is None:
                 # Exit for an allocation this master never adopted — e.g.
                 # the trial finished during the master bounce and the exit
                 # report raced ahead of the agent's re-registration.
@@ -1742,6 +2097,34 @@ class Master:
                 # the reconcile grace and relaunching work that is already
                 # done; route it to the trial FSM directly.
                 return self._exit_unadopted(alloc_id, code, reason)
+            if alloc.state != "TERMINATED" and alloc.rank_agents:
+                members = set(alloc.rank_agents.values())
+                if agent_id not in members:
+                    # A resized-away member finishing its re-sync exit (a
+                    # dropped rank exits clean; a killed straggler exits
+                    # nonzero): the current gang doesn't contain it, so
+                    # this is not an allocation exit — but it DOES confirm
+                    # the host drained, unblocking grow placement there.
+                    self.alloc_service.clear_draining(alloc_id, agent_id)
+                    logger.info(
+                        "ignoring exit of resized-away member %s of %s "
+                        "(code %d)", agent_id, alloc_id, code,
+                    )
+                    return True
+                if code != 0 and len(members) > 1:
+                    # One rank of a live gang died (reclaimed task, OOM-
+                    # killed process) while its peers keep running: elastic
+                    # gangs shed the rank and reshard instead of tearing
+                    # the whole gang down.
+                    if self.resize_allocation(
+                        alloc_id, lost_agents=[agent_id],
+                        exited_agents=[agent_id],  # the exit IS this event
+                        reason=(
+                            f"rank process on agent {agent_id} exited: "
+                            f"{reason or f'code {code}'}"
+                        ),
+                    ):
+                        return True
             self.alloc_service.complete(alloc_id, exit_code=code, reason=reason)
         else:
             logger.warning("unknown agent event %r from %s", kind, agent_id)

@@ -32,6 +32,14 @@ MESH_AXES = {"data", "fsdp", "tensor", "pipeline", "context", "expert"}
 #: training health sentinel knobs (trainer/_sentinel.py + the master's
 #: stall watchdog). Typo'd keys get masterconf-style named errors — a
 #: silently-ignored `stall_timeout` leaves a gang unwatched.
+#: elastic gang-resize knobs (master/core.py resize_allocation + the
+#: grow sweep). Same typo discipline as health.*: a silently-ignored
+#: `enabled` would leave a spot-fleet gang un-resizable.
+KNOWN_ELASTIC_KEYS = {
+    "enabled",
+    "min_world_size",
+    "grow",
+}
 KNOWN_HEALTH_KEYS = {
     "stall_timeout_s",
     "max_consecutive_skips",
@@ -359,6 +367,27 @@ def validate(config: Dict[str, Any]) -> List[str]:
                     "(0 disables the loss-spike detector)"
                 )
 
+    elastic = config.get("elastic")
+    if elastic is not None:
+        if not isinstance(elastic, dict):
+            errors.append("elastic must be an object")
+        else:
+            for key in elastic:
+                if key not in KNOWN_ELASTIC_KEYS:
+                    errors.append(
+                        f"elastic: unknown key {key!r} "
+                        f"(one of: {', '.join(sorted(KNOWN_ELASTIC_KEYS))})"
+                    )
+            for key in ("enabled", "grow"):
+                v = elastic.get(key)
+                if v is not None and not isinstance(v, bool):
+                    errors.append(f"elastic.{key} must be a boolean")
+            mws = elastic.get("min_world_size")
+            if mws is not None and (
+                not isinstance(mws, int) or isinstance(mws, bool) or mws < 1
+            ):
+                errors.append("elastic.min_world_size must be an int >= 1")
+
     _check_unit(config.get("min_validation_period"), "min_validation_period", errors)
     _check_unit(config.get("min_checkpoint_period"), "min_checkpoint_period", errors)
     _check_unit(config.get("scheduling_unit"), "scheduling_unit", errors)
@@ -511,6 +540,23 @@ FIELDS: List[Tuple[str, str, str, str]] = [
      "checksum of every param shard, compared across all data-parallel "
      "replicas of the same region. A mismatch errors the trial naming "
      "the offending host/device (silent data corruption)."),
+    ("elastic.enabled", "bool", "false",
+     "Elastic gang resize: when a rank is reclaimed (spot loss, dead "
+     "host, task OOM-kill) the survivors reshard the GSPMD state onto "
+     "the remaining mesh from the last verified checkpoint — same "
+     "allocation, new rendezvous generation, restart budget charged 0 — "
+     "instead of the whole gang being requeued. See docs/robustness.md "
+     "'Elastic gangs'."),
+    ("elastic.min_world_size", "int >= 1", "1",
+     "Floor for in-place shrinks: a resize that would leave fewer "
+     "surviving processes than this falls back to the classic whole-"
+     "gang failover (checkpoint -> requeue, infra-attributed)."),
+    ("elastic.grow", "bool", "false",
+     "Let the master's capacity tick grow a shrunken elastic gang back "
+     "toward its requested size: a newcomer rank STARTs on freed "
+     "capacity under a new generation and the survivors re-enter "
+     "rendezvous alongside it. Off by default so a drill (or an "
+     "operator) observing the shrunk mesh keeps it stable."),
     ("environment.variables", "object", "{}",
      "Extra environment variables for the task process."),
     ("environment.jax_platform", "string", "",

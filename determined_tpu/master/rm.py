@@ -152,14 +152,61 @@ class ResourcePool:
             asg[agent_id] = n_slots
             agent.used[request.alloc_id] = n_slots
 
-    def remove_agent(self, agent_id: str) -> List[str]:
-        """Returns alloc_ids that lost resources (caller fails them over)."""
+    def remove_agent(self, agent_id: str, keep: Any = ()) -> List[str]:
+        """Returns alloc_ids that lost resources (caller fails them over).
+        Allocations in `keep` (elastic gangs being resized in place) shed
+        only the dead agent's share — their other agents' occupancy stays
+        booked — and are NOT returned as victims."""
+        keep = set(keep)
         with self._lock:
             agent = self._agents.pop(agent_id, None)
             victims = list(agent.used) if agent else []
         for alloc_id in victims:
-            self.release(alloc_id)
-        return victims
+            if alloc_id in keep:
+                self.shrink_alloc(alloc_id, agent_id)
+            else:
+                self.release(alloc_id)
+        return [a for a in victims if a not in keep]
+
+    def shrink_alloc(self, alloc_id: str, agent_id: str) -> None:
+        """Elastic in-place shrink: drop ONLY `agent_id`'s share of a
+        running allocation — no queue round-trip, no start/preempt
+        callbacks, the surviving agents' occupancy untouched. The freed
+        slots schedule on the immediate tick (and may later host the same
+        gang's grow)."""
+        with self._lock:
+            asg = self._running.get(alloc_id)
+            if asg is not None:
+                asg.pop(agent_id, None)
+            agent = self._agents.get(agent_id)
+            if agent is not None:
+                agent.used.pop(alloc_id, None)
+        self.tick()
+
+    def grow_alloc(
+        self, alloc_id: str, n_slots: int, exclude: Any = ()
+    ) -> Optional[str]:
+        """Elastic in-place grow: reserve `n_slots` on an enabled agent
+        not already hosting this allocation (and not in `exclude` — hosts
+        whose dropped rank is still draining), without a queue round-trip.
+        Returns the chosen agent id, or None when no agent has room."""
+        exclude = set(exclude)
+        with self._lock:
+            asg = self._running.get(alloc_id)
+            if asg is None:
+                return None  # not running here (raced a release)
+            candidates = [
+                a for a in self._agents.values()
+                if a.id not in asg and a.id not in exclude
+                and a.free >= n_slots
+            ]
+            if not candidates:
+                return None
+            # Best-fit, like the gang scheduler: least leftover room.
+            agent = min(candidates, key=lambda a: a.free - n_slots)
+            asg[agent.id] = n_slots
+            agent.used[alloc_id] = n_slots
+            return agent.id
 
     def allocs_on_agent(self, agent_id: str) -> List[str]:
         """Alloc ids booking slots on this agent (reattach reconciliation)."""

@@ -68,6 +68,50 @@ class MeshConfig:
         d = dataclasses.asdict(self)
         return tuple(d[name] for name in AXIS_NAMES)
 
+    def refit(self, n_devices: int) -> "MeshConfig":
+        """Re-resolve this layout for a CHANGED device count (elastic gang
+        resize: the surviving mesh is smaller — or grew back). Model-
+        parallel degrees (tensor/pipeline/context/expert) are preserved —
+        the compiled program's sharding depends on them — and the
+        REPLICATION axes (data/fsdp) absorb the change: fsdp keeps its
+        largest degree that still divides the remaining replication room
+        (an inferred fsdp: -1 keeps its shard-over-everything intent —
+        collapsing it to replicated DP would OOM the very gang the resize
+        is rescuing), data takes the rest. Falls back to a pure
+        data-parallel mesh when the model-parallel product no longer fits
+        (a 4-way tensor mesh cannot survive on 2 devices; resharding to
+        data-parallel can)."""
+        sizes = dataclasses.asdict(self)
+        try:
+            # An inferred (-1) axis absorbs the change natively.
+            return self.resolve(n_devices)
+        except ValueError:
+            pass
+        mp_sizes = {
+            k: v for k, v in sizes.items() if k not in ("data", "fsdp")
+        }
+        if any(v == -1 for v in mp_sizes.values()):
+            # An inferred model-parallel degree that no longer resolves is
+            # underdetermined — pure DP is the only safe layout left.
+            return MeshConfig(data=-1).resolve(n_devices)
+        mp = math.prod(max(1, v) for v in mp_sizes.values())
+        if n_devices % mp != 0:
+            return MeshConfig(data=-1).resolve(n_devices)
+        dp_total = n_devices // mp
+        fsdp = sizes["fsdp"]
+        fsdp = (
+            dp_total if fsdp == -1 else math.gcd(max(1, fsdp), dp_total)
+        )
+        cfg = MeshConfig(
+            data=-1,
+            fsdp=fsdp,
+            tensor=max(1, sizes["tensor"]),
+            pipeline=max(1, sizes["pipeline"]),
+            context=max(1, sizes["context"]),
+            expert=max(1, sizes["expert"]),
+        )
+        return cfg.resolve(n_devices)
+
 
 def make_mesh(
     config: Optional[MeshConfig] = None,

@@ -3,8 +3,11 @@
 Ref: harness/determined/pytorch/{_pytorch_trial.py,_trainer.py} — rebuilt
 for JAX/XLA (see _trainer.py module docstring).
 """
-from determined_tpu.trainer._trainer import Trainer
+from determined_tpu.trainer._trainer import ElasticResizeExit, Trainer
 from determined_tpu.trainer._trial import JAXTrial
 from determined_tpu.trainer._units import Batch, Epoch, TrainUnit, to_batches
 
-__all__ = ["Trainer", "JAXTrial", "Batch", "Epoch", "TrainUnit", "to_batches"]
+__all__ = [
+    "ElasticResizeExit", "Trainer", "JAXTrial", "Batch", "Epoch",
+    "TrainUnit", "to_batches",
+]

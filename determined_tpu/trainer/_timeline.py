@@ -56,11 +56,17 @@ class Timeline:
         self.phase_totals: Dict[str, float] = {p: 0.0 for p in ALL_PHASES}
         # -- goodput ledger --------------------------------------------------
         self.productive_s = 0.0       # window time behind a checkpoint
-        self.lost_s = 0.0             # rollback + restart + stall time
+        self.lost_s = 0.0             # rollback + restart + resize time
         self.rollback_lost_s = 0.0
         self.restart_lost_s = 0.0
+        #: elastic resize event class: drain→resume wall time of in-place
+        #: gang resizes (spot reclaim survived WITHOUT a restart). Charged
+        #: as lost time like a restart, but in its own bucket so bench can
+        #: publish resize_cost_s against the measured full-restart cost.
+        self.resize_lost_s = 0.0
         self.rollbacks = 0
         self.restarts = 0
+        self.resizes = 0
         #: window time since the last commit point — tentatively
         #: productive; a rollback reclassifies it as lost wholesale.
         self.uncommitted_s = 0.0
@@ -123,6 +129,16 @@ class Timeline:
         self.restart_lost_s += gap
         self.restarts += 1
 
+    def on_resize(self, gap_s: float) -> None:
+        """Elastic resize resumed this ledger IN PLACE (same allocation,
+        same process): the save→resume gap covers the drained window, the
+        re-rendezvous and the reshard-restore — the whole drain→resume
+        cost of surviving a reclaim, with the restart budget charged 0."""
+        gap = max(gap_s, 0.0)
+        self.lost_s += gap
+        self.resize_lost_s += gap
+        self.resizes += 1
+
     @property
     def goodput_pct(self) -> float:
         good = self.productive_s + self.uncommitted_s
@@ -138,8 +154,10 @@ class Timeline:
             "lost_s": self.lost_s,
             "rollback_lost_s": self.rollback_lost_s,
             "restart_lost_s": self.restart_lost_s,
+            "resize_lost_s": self.resize_lost_s,
             "ledger_rollbacks": float(self.rollbacks),
             "ledger_restarts": float(self.restarts),
+            "ledger_resizes": float(self.resizes),
         }
         lifetime = sum(self.phase_totals.values())
         if lifetime > 0:
@@ -158,8 +176,10 @@ class Timeline:
             "lost_s": self.lost_s,
             "rollback_lost_s": self.rollback_lost_s,
             "restart_lost_s": self.restart_lost_s,
+            "resize_lost_s": self.resize_lost_s,
             "rollbacks": self.rollbacks,
             "restarts": self.restarts,
+            "resizes": self.resizes,
             "phase_totals": dict(self.phase_totals),
             # wall-clock stamp: the resume charges save→restore as loss
             "saved_at": time.time(),
@@ -171,11 +191,17 @@ class Timeline:
         *,
         now: Optional[float] = None,
         trial_id: int = 0,
+        event: str = "restart",
     ) -> None:
         """Resume the ledger from checkpoint metadata — SAME-TRIAL process
         restarts only. A trial-id mismatch (warm-started fork, continue
         into a new trial) keeps the fresh ledger: the new trial owes
-        nothing to the source's history."""
+        nothing to the source's history.
+
+        `event` classifies the save→resume gap: "restart" (a new process
+        resumed the trial) or "resize" (an elastic in-place resize —
+        drain, re-rendezvous, reshard-restore — resumed it; its gap is
+        the `resize_cost_s` bench publishes)."""
         try:
             if int(md.get("trial_id", 0)) != int(trial_id):
                 return
@@ -183,16 +209,21 @@ class Timeline:
             self.lost_s = float(md.get("lost_s", 0.0))
             self.rollback_lost_s = float(md.get("rollback_lost_s", 0.0))
             self.restart_lost_s = float(md.get("restart_lost_s", 0.0))
+            self.resize_lost_s = float(md.get("resize_lost_s", 0.0))
             self.rollbacks = int(md.get("rollbacks", 0))
             self.restarts = int(md.get("restarts", 0))
+            self.resizes = int(md.get("resizes", 0))
             totals = md.get("phase_totals") or {}
             for p in ALL_PHASES:
                 self.phase_totals[p] = float(totals.get(p, 0.0))
             self.uncommitted_s = 0.0
             saved_at = float(md.get("saved_at", 0.0))
             if saved_at:
-                self.on_restart((now if now is not None else time.time())
-                                - saved_at)
+                gap = (now if now is not None else time.time()) - saved_at
+                if event == "resize":
+                    self.on_resize(gap)
+                else:
+                    self.on_restart(gap)
             self.reset_window()
         except (TypeError, ValueError):
             pass  # corrupt ledger metadata must never block a restore

@@ -35,6 +35,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from determined_tpu import core as core_mod
+from determined_tpu.common import faults
 from determined_tpu.common import trace as trace_mod
 from determined_tpu.core._searcher import DummySearcherContext
 from determined_tpu.models.base import Model
@@ -56,6 +57,34 @@ TRAINER_METADATA = "trainer_state.json"
 ORBAX_SUBDIR = "orbax"  # presence marks an orbax/ocdbt-format checkpoint
 
 
+class ElasticResizeExit(Exception):
+    """Control-flow out of Trainer.fit: the master resized the gang (spot
+    reclaim survived, or a grow back toward the requested size). The
+    harness (exec/harness.py) catches this at the top of its resize loop,
+    re-enters rendezvous under the directive's new generation, rebuilds
+    the mesh for the new world size, and resumes from `restore_from` with
+    every region resharded onto the new NamedShardings — same allocation,
+    same process, restart budget untouched.
+
+    `dropped`: this rank is absent from the directive's rank_map — it was
+    resized away and must exit cleanly instead of re-entering."""
+
+    def __init__(
+        self,
+        directive: Dict[str, Any],
+        *,
+        dropped: bool,
+        restore_from: Optional[str],
+    ) -> None:
+        super().__init__(
+            f"elastic resize to generation {directive.get('generation')} "
+            f"({directive.get('num_processes')} processes)"
+        )
+        self.directive = directive
+        self.dropped = dropped
+        self.restore_from = restore_from
+
+
 class Trainer:
     def __init__(
         self,
@@ -71,6 +100,7 @@ class Trainer:
         tensorboard_dir: Optional[str] = None,
         checkpoint_format: str = "npy",
         health: Optional[Dict[str, Any]] = None,
+        resume_event: str = "restart",
     ) -> None:
         self.trial = trial
         self.core = core_context or core_mod.init()
@@ -132,6 +162,16 @@ class Trainer:
         #: a rollback restore must NOT reload the checkpoint's ledger —
         #: the in-memory one is newer (it's about to record this rollback).
         self._restoring_for_rollback = False
+        #: how the ledger classifies the save→resume gap on the first
+        #: restore: "restart" (new process) or "resize" (elastic in-place
+        #: resize — the harness rebuilt this Trainer after re-rendezvous;
+        #: the gap is the drain→resume resize cost, charged to its own
+        #: ledger bucket with the restart budget untouched).
+        if resume_event not in ("restart", "resize"):
+            raise ValueError(
+                f"resume_event {resume_event!r} (one of: restart, resize)"
+            )
+        self._resume_event = resume_event
 
         self.model: Model = trial.build_model(self.mesh)
         self._tx = trial.build_optimizer()
@@ -560,7 +600,15 @@ class Trainer:
                         # its in-memory ledger is newer than the
                         # checkpoint's. load() itself rejects foreign
                         # ledgers (warm-started fork = different trial id).
-                        self.timeline.load(tl_md, trial_id=self._trial_id())
+                        # The event class routes the save→resume gap into
+                        # restart_lost_s vs resize_lost_s.
+                        self.timeline.load(
+                            tl_md, trial_id=self._trial_id(),
+                            event=self._resume_event,
+                        )
+                        # One-shot: only the FIRST resume gap carries the
+                        # resize classification.
+                        self._resume_event = "restart"
                 except (ValueError, OSError):
                     logger.warning(
                         "unreadable trainer metadata in %s; assuming no "
@@ -675,6 +723,51 @@ class Trainer:
         )
         return restored
 
+    def _exit_for_resize(self, directive: Dict[str, Any], step: int) -> None:
+        """Leave the step loop at this report boundary for an elastic
+        resize: raise ElasticResizeExit carrying the directive and this
+        gang's collectively-agreed last verified checkpoint (the reshard
+        source). Uncommitted window time since that checkpoint is
+        discarded by the resize — the resumed ledger charges the whole
+        drain→resume wall gap as resize loss, which covers it."""
+        rank = self.core.distributed.rank
+        dropped = str(rank) not in (directive.get("rank_map") or {})
+        if dropped and directive.get("resync_only"):
+            # Unmappable straggler (directive history rotated out): exit
+            # NONZERO — a clean exit from a rank the master still counts
+            # as a member would complete the trial as finished work.
+            raise RuntimeError(
+                "resize directive could not map this rank (generation "
+                f"{directive.get('generation')}); erroring out for re-sync"
+            )
+        logger.warning(
+            "elastic resize at step %d: generation %s, %s process(es) "
+            "(%s) — rank %d %s",
+            step, directive.get("generation"),
+            directive.get("num_processes"), directive.get("reason", ""),
+            rank,
+            "was DROPPED; exiting for re-sync" if dropped
+            else "exits the step loop to reshard",
+        )
+        if self._ckpt_writer.in_flight and self.core.distributed.size > 1:
+            # An in-flight SHARDED save runs collectives against peers that
+            # may already be dead (that is WHY we are resizing): fit's
+            # teardown join would hang forever on the chief's gather from
+            # the reclaimed rank. Closing the control plane fails the
+            # collective fast (ipc inbox.die wakes blocked waiters); the
+            # torn upload is harmless — manifest-last commit means it never
+            # verifies, and restore_from targets the last VERIFIED id.
+            self.core.distributed.close()
+            try:
+                self._ckpt_writer.wait()
+            except BaseException as e:  # noqa: BLE001 — expected abort
+                logger.warning(
+                    "in-flight checkpoint abandoned by the resize: %s", e
+                )
+        raise ElasticResizeExit(
+            directive, dropped=dropped, restore_from=self._last_ckpt_id
+        )
+
     def _divergence_audit(self) -> None:
         """Replica-divergence audit: deterministic per-shard checksums of
         the params, compared across every holder of the same logical
@@ -734,6 +827,13 @@ class Trainer:
         ):
             latest_checkpoint = self.core.info.trial.latest_checkpoint
         if latest_checkpoint:
+            if self._resume_event == "resize":
+                # Drillable branch (DTPU_FAULT_PLAN `resize.restore`): a
+                # failure HERE errors this rank's process, and the master's
+                # elastic layer sheds the rank with infra attribution — the
+                # resize path must degrade into another resize, never a
+                # budget charge.
+                faults.inject("resize.restore")
             self._restore_with_fallback(latest_checkpoint)
 
         if self._step_fn is None:
@@ -910,10 +1010,46 @@ class Trainer:
                         # Progress beat from EVERY rank: the master's
                         # stall watchdog kills the gang when this counter
                         # stops advancing (hung collective → bounded-time
-                        # recovery instead of forever-stuck).
-                        self.core.train.heartbeat_step(step)
+                        # recovery instead of forever-stuck). The response
+                        # doubles as the elastic resize channel: a pending
+                        # directive rides back when the master resized the
+                        # gang past this rank's generation.
+                        beat_resize = self.core.train.heartbeat_step(step)
                         if self.core.distributed.is_chief:
                             op.report_progress(float(step))
+                        # Preemption is a collective (ZMQ broadcast) —
+                        # checking every batch would put a TCP roundtrip in
+                        # the hot loop, so it shares the report boundary
+                        # (the reference's analog knob is scheduling_unit
+                        # granularity). Elastic resize rides the SAME
+                        # collective (the chief folds the boundary beat's
+                        # directive hint into the broadcast), so every rank
+                        # reaches the same resize verdict at the same
+                        # boundary — and it MUST be the boundary's FIRST
+                        # gather-shaped action: once a peer is dead, any
+                        # other collective (joining an in-flight sharded
+                        # save, a rollback restore's agreement round, the
+                        # divergence audit) would hang on it forever. The
+                        # resize exit is also allowed to supersede a latched
+                        # sentinel rollback: both restore the same last
+                        # verified checkpoint, the resize just does it on
+                        # the new mesh.
+                        preempt_now = self.core.preempt.should_preempt(
+                            resize_hint=beat_resize
+                        )
+                        directive = self.core.preempt.take_resize()
+                        if directive is not None:
+                            self._exit_for_resize(directive, step)
+                        if preempt_now:
+                            flush_report()
+                            self._save_checkpoint(sync=True)
+                            timeline.commit()
+                            last_ckpt_step = step
+                            logger.info(
+                                "preempted at step %d; exiting cleanly", step
+                            )
+                            preempted = True
+                            break
                         if rollback_reason is not None:
                             restored = self._sentinel_rollback(
                                 rollback_reason, step
@@ -947,18 +1083,6 @@ class Trainer:
                         timeline.commit()
                         last_ckpt_step = step
                         self._tb_sync()
-                    # Preemption is a collective (ZMQ broadcast) — checking every
-                    # batch would put a TCP roundtrip in the hot loop, so it
-                    # shares the report boundary (the reference's analog knob is
-                    # scheduling_unit granularity).
-                    if boundary and self.core.preempt.should_preempt():
-                        flush_report()
-                        self._save_checkpoint(sync=True)
-                        timeline.commit()
-                        last_ckpt_step = step
-                        logger.info("preempted at step %d; exiting cleanly", step)
-                        preempted = True
-                        break
                 if preempted:
                     break
 

@@ -1,0 +1,485 @@
+"""Elastic gang resize: reshard-on-restore, generation fencing, and the
+end-to-end reclaim drill.
+
+The tentpole claim under test (docs/robustness.md "Elastic gangs"): a
+reclaimed rank is not a gang failure. The master issues a resize
+directive (new rendezvous generation, survivors renumbered), the
+survivors reshard the GSPMD state onto the remaining mesh from the last
+verified checkpoint via `load_pytree(shardings=...)`, and training
+resumes in the SAME allocation with the restart budget charged 0.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from determined_tpu.master.allocation import (
+    AllocationService,
+    StaleGenerationError,
+)
+
+
+# ---------------------------------------------------------------------------
+# Reshard-on-restore: a pytree saved shard-wise on an 8-way mesh restores
+# bitwise-identically onto 4-way and 2-way meshes (and detects holes).
+# ---------------------------------------------------------------------------
+class TestReshardRestore:
+    @staticmethod
+    def _reference():
+        rng = np.random.default_rng(7)
+        return {
+            "w": rng.normal(size=(16, 8)).astype(np.float32),
+            "b": rng.normal(size=(16,)).astype(np.float32),
+            "scalar": np.float32(3.5),
+        }
+
+    @staticmethod
+    def _write_8way(tree, directory):
+        """Simulate an 8-host sharded save: each leaf split into 8
+        row-shards named by the `{leaf}.shard<starts>.npy` convention
+        (trainer/_checkpoint.snapshot_pytree's multi-host layout)."""
+        os.makedirs(directory, exist_ok=True)
+        w, b = tree["w"], tree["b"]
+        for i in range(8):
+            np.save(
+                os.path.join(directory, f"w.shard{i * 2}_0.npy"),
+                w[i * 2:(i + 1) * 2],
+            )
+            np.save(
+                os.path.join(directory, f"b.shard{i * 2}.npy"),
+                b[i * 2:(i + 1) * 2],
+            )
+        np.save(os.path.join(directory, "scalar.npy"), tree["scalar"])
+        with open(os.path.join(directory, "tree.json"), "w") as f:
+            json.dump({"structure": "keypath-flat-v1"}, f)
+
+    @staticmethod
+    def _restore_on_mesh(directory, tree, n_devices, devices8):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from determined_tpu.parallel.mesh import MeshConfig, make_mesh
+        from determined_tpu.trainer import _checkpoint as ckpt_io
+
+        mesh = make_mesh(
+            MeshConfig(data=n_devices), devices8[:n_devices]
+        )
+        like = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+            tree,
+        )
+        shardings = {
+            "w": NamedSharding(mesh, P("data")),
+            "b": NamedSharding(mesh, P("data")),
+            "scalar": NamedSharding(mesh, P()),
+        }
+        return ckpt_io.load_pytree(directory, like, shardings)
+
+    @pytest.mark.parametrize("n_devices", [4, 2])
+    def test_8way_save_restores_onto_smaller_mesh(
+        self, tmp_path, devices8, n_devices
+    ):
+        import jax
+
+        ref = self._reference()
+        d = str(tmp_path / "ckpt")
+        self._write_8way(ref, d)
+        restored = self._restore_on_mesh(d, ref, n_devices, devices8)
+        for key in ("w", "b", "scalar"):
+            got = np.asarray(jax.device_get(restored[key]))
+            assert got.dtype == np.asarray(ref[key]).dtype
+            # bitwise equality against the single-host reference: the
+            # region reads must stitch shard files exactly, never
+            # round-trip through a lossy cast.
+            assert np.array_equal(got, np.asarray(ref[key])), key
+        # each restored leaf actually lives on the smaller mesh
+        assert len(restored["w"].sharding.mesh.devices.flatten()) == n_devices
+
+    def test_incomplete_shard_set_raises(self, tmp_path, devices8):
+        from determined_tpu.storage.base import CorruptCheckpointError
+
+        ref = self._reference()
+        d = str(tmp_path / "ckpt")
+        self._write_8way(ref, d)
+        os.remove(os.path.join(d, "w.shard6_0.npy"))
+        with pytest.raises(CorruptCheckpointError):
+            self._restore_on_mesh(d, ref, 2, devices8)
+
+
+# ---------------------------------------------------------------------------
+# Generation protocol: resize directives, fencing, idempotent re-entry.
+# ---------------------------------------------------------------------------
+def _make_alloc(svc, n=4, alloc_id="a.1.0"):
+    svc.create(
+        alloc_id, task_id="trial-1", trial_id=1, num_processes=n, slots=n,
+        rank_agents={r: f"agent-{r}" for r in range(n)},
+    )
+    for r in range(n):
+        svc.rendezvous_arrive(alloc_id, r, f"10.0.0.{r}", generation=0)
+    return svc.get(alloc_id)
+
+
+class TestGenerationProtocol:
+    def test_resize_renumbers_survivors_and_bumps_generation(self):
+        svc = AllocationService()
+        alloc = _make_alloc(svc, 4)
+        directive = svc.resize(
+            "a.1.0", lost_ranks=[1], reason="reclaimed"
+        )
+        assert directive["generation"] == 1
+        assert directive["from_generation"] == 0
+        assert directive["num_processes"] == 3
+        # survivors renumbered 0..n-1 in rank order
+        assert directive["rank_map"] == {"0": 0, "2": 1, "3": 2}
+        assert alloc.rank_agents == {
+            0: "agent-0", 1: "agent-2", 2: "agent-3"
+        }
+        assert alloc.addrs == {}  # rendezvous table reset per generation
+        # watchdog stays armed across the resize window
+        assert alloc.progress_last_beat is not None
+
+    def test_lost_agents_resolve_to_ranks(self):
+        svc = AllocationService()
+        _make_alloc(svc, 3)
+        directive = svc.resize("a.1.0", lost_agents=["agent-2"])
+        assert directive["rank_map"] == {"0": 0, "1": 1}
+
+    def test_min_survivors_floor_refuses(self):
+        svc = AllocationService()
+        _make_alloc(svc, 2)
+        assert svc.resize("a.1.0", lost_ranks=[1], min_survivors=2) is None
+        assert svc.get("a.1.0").generation == 0  # untouched
+
+    def test_preempting_gang_refuses_resize(self):
+        svc = AllocationService()
+        _make_alloc(svc, 2)
+        svc.signal_preempt("a.1.0")
+        assert svc.resize("a.1.0", lost_ranks=[1]) is None
+
+    def test_stale_trigger_is_a_noop(self):
+        svc = AllocationService()
+        _make_alloc(svc, 2)
+        assert svc.resize("a.1.0", lost_agents=["agent-77"]) is None
+
+    def test_grow_appends_new_ranks(self):
+        svc = AllocationService()
+        alloc = _make_alloc(svc, 2)
+        svc.resize("a.1.0", lost_ranks=[1])
+        directive = svc.resize("a.1.0", add_agents=["agent-9"])
+        assert directive["generation"] == 2
+        assert directive["num_processes"] == 2
+        assert directive["rank_map"] == {"0": 0}
+        assert alloc.rank_agents == {0: "agent-0", 1: "agent-9"}
+
+    def test_stale_rendezvous_arrive_is_fenced_terminally(self):
+        svc = AllocationService()
+        _make_alloc(svc, 3)
+        svc.resize("a.1.0", lost_ranks=[2])
+        with pytest.raises(StaleGenerationError) as ei:
+            svc.rendezvous_arrive("a.1.0", 2, "10.0.0.2", generation=0)
+        # the fence carries the re-sync directive
+        assert ei.value.directive["rank_map"] == {"0": 0, "1": 1}
+        # and the stale arrival never touched the new generation's table
+        assert svc.get("a.1.0").addrs == {}
+
+    def test_rendezvous_reentry_is_idempotent_per_generation(self):
+        svc = AllocationService()
+        alloc = _make_alloc(svc, 2)
+        # same rank re-arriving in the same generation just refreshes
+        svc.rendezvous_arrive("a.1.0", 1, "10.0.0.99", generation=0)
+        assert alloc.addrs[1] == "10.0.0.99"
+        assert alloc.state == "RUNNING"
+
+    def test_stale_beat_returns_directive_and_is_not_recorded(self):
+        svc = AllocationService()
+        alloc = _make_alloc(svc, 2)
+        svc.record_progress("a.1.0", 0, 5, generation=0)
+        svc.resize("a.1.0", lost_ranks=[1])
+        before = dict(alloc.progress)
+        directive = svc.record_progress("a.1.0", 0, 7, generation=0)
+        assert directive is not None and directive["generation"] == 1
+        assert alloc.progress == before  # stale rank numbering not recorded
+        # current-generation beat records normally and gets no directive
+        assert svc.record_progress("a.1.0", 0, 7, generation=1) is None
+        assert alloc.progress[0]["step"] == 7
+
+    def test_stacked_resizes_compose_rank_maps(self):
+        """Correlated reclaims stack two resizes inside one beat window:
+        a survivor two generations behind must get the COMPOSED mapping,
+        not be told it was dropped (that verdict, taken by every
+        survivor, would complete a partially-trained trial)."""
+        svc = AllocationService()
+        _make_alloc(svc, 4)
+        svc.resize("a.1.0", lost_ranks=[1])  # gen1: 0->0, 2->1, 3->2
+        svc.resize("a.1.0", lost_ranks=[2])  # gen2 drops gen1-rank 2 (old 3)
+        directive = svc.pending_resize("a.1.0", 0)
+        assert directive["generation"] == 2
+        assert directive["rank_map"] == {"0": 0, "2": 1}
+        assert not directive.get("resync_only")
+
+    def test_history_gap_is_resync_only_never_a_clean_drop(self):
+        svc = AllocationService()
+        _make_alloc(svc, 3)
+        svc.resize("a.1.0", lost_ranks=[2])
+        svc.resize("a.1.0", lost_ranks=[1])
+        # Simulate the bounded history rotating out (17+ stacked resizes)
+        svc.get("a.1.0").resize_history.clear()
+        directive = svc.pending_resize("a.1.0", 0)
+        assert directive["generation"] == 2
+        assert directive["rank_map"] == {}
+        # unmappable -> the client must ERROR out, not exit clean
+        assert directive["resync_only"] is True
+
+    def test_rendezvous_info_raises_when_fenced_mid_wait(self):
+        svc = AllocationService()
+        _make_alloc(svc, 3)
+        svc.resize("a.1.0", lost_ranks=[2])  # table reset, gen 1, world 2
+        caught = {}
+
+        def wait_gen1():
+            # arrive as one survivor, then wait for a table the SECOND
+            # resize invalidates mid-wait (the other survivor never came)
+            svc.rendezvous_arrive("a.1.0", 0, "10.0.0.0", generation=1)
+            try:
+                svc.rendezvous_info("a.1.0", timeout=10.0, generation=1)
+            except StaleGenerationError as e:
+                caught["err"] = e
+
+        t = threading.Thread(target=wait_gen1)
+        t.start()
+        time.sleep(0.2)
+        svc.resize("a.1.0", add_agents=["agent-5"])  # gen 2 mid-wait
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        assert isinstance(caught.get("err"), StaleGenerationError)
+
+    def test_should_preempt_wakes_on_generation_change(self):
+        svc = AllocationService()
+        _make_alloc(svc, 2)
+        t0 = time.time()
+        out = {}
+
+        def poll():
+            out["flag"] = svc.should_preempt(
+                "a.1.0", timeout=20.0, generation=0
+            )
+
+        t = threading.Thread(target=poll)
+        t.start()
+        time.sleep(0.2)
+        svc.resize("a.1.0", lost_ranks=[1])
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        assert time.time() - t0 < 10.0  # long-poll returned early
+        assert out["flag"] is False
+        assert svc.pending_resize("a.1.0", 0) is not None
+
+
+# ---------------------------------------------------------------------------
+# Mesh refit: the surviving device count reshapes data/fsdp, never the
+# model-parallel degrees (until they cannot fit at all).
+# ---------------------------------------------------------------------------
+class TestMeshRefit:
+    def test_data_axis_absorbs_the_shrink(self):
+        from determined_tpu.parallel.mesh import MeshConfig
+
+        cfg = MeshConfig(data=8).refit(4)
+        assert cfg.data == 4
+
+    def test_fsdp_keeps_largest_dividing_degree(self):
+        from determined_tpu.parallel.mesh import MeshConfig
+
+        cfg = MeshConfig(data=2, fsdp=4).refit(6)
+        assert cfg.fsdp == 2 and cfg.data == 3
+
+    def test_model_parallel_degrees_survive(self):
+        from determined_tpu.parallel.mesh import MeshConfig
+
+        cfg = MeshConfig(data=4, tensor=2).refit(4)
+        assert cfg.tensor == 2 and cfg.data == 2
+
+    def test_unfittable_model_parallel_falls_back_to_dp(self):
+        from determined_tpu.parallel.mesh import MeshConfig
+
+        cfg = MeshConfig(tensor=4).refit(2)
+        assert cfg.tensor == 1 and cfg.data == 2
+
+    def test_inferred_fsdp_keeps_shard_over_everything_intent(self):
+        from determined_tpu.parallel.mesh import MeshConfig
+
+        # fsdp: -1 (params sharded over all devices — the memory plan)
+        # must NOT collapse to replicated DP after a shrink
+        cfg = MeshConfig(data=1, fsdp=-1).refit(4)
+        assert cfg.fsdp == 4 and cfg.data == 1
+        cfg = MeshConfig(data=2, fsdp=-1).refit(3)
+        assert cfg.fsdp == 3 and cfg.data == 1
+
+
+# ---------------------------------------------------------------------------
+# Preemption-deadline escalation: an acked-but-never-exiting rank must not
+# pin the allocation forever — the sweep escalates to kill + infra.
+# ---------------------------------------------------------------------------
+class TestOverduePreemptEscalation:
+    def test_sweep_escalates_to_infra_completion(self):
+        from determined_tpu.master.core import Master
+
+        master = Master(preempt_timeout_s=0.05)
+        try:
+            master.alloc_service.create(
+                "esc.1.0", task_id="trial-1", trial_id=None,
+                num_processes=1, slots=1,
+            )
+            master.alloc_service.signal_preempt("esc.1.0")
+            master.alloc_service.ack_preempt("esc.1.0")
+            deadline = time.time() + 10.0
+            alloc = master.alloc_service.get("esc.1.0")
+            while alloc.state != "TERMINATED" and time.time() < deadline:
+                master.kick_tick()
+                time.sleep(0.1)
+            assert alloc.state == "TERMINATED"
+            assert alloc.infra_failure  # escalation, not a budget charge
+            assert "preemption deadline" in (alloc.exit_reason or "")
+        finally:
+            master.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# The acceptance drill: reclaim one rank of a live 2-process gang
+# mid-training; the survivor resumes on the shrunk mesh in the SAME
+# allocation at the right step with zero restart-budget charge, and the
+# ledger's resize event class records the drain→resume cost.
+# ---------------------------------------------------------------------------
+def _elastic_config(tmp_path, **over):
+    cfg = {
+        "entrypoint": "determined_tpu.exec.builtin_trials:SyntheticTrial",
+        "searcher": {"name": "single", "max_length": 24, "metric": "loss"},
+        "hyperparameters": {"model": "mnist-mlp", "batch_size": 16,
+                            "lr": 1e-3, "sleep_s": 0.3},
+        "resources": {"slots_per_trial": 2},
+        "scheduling_unit": 2,
+        "min_checkpoint_period": {"batches": 2},
+        "checkpoint_storage": {"type": "shared_fs",
+                               "host_path": str(tmp_path / "ckpt")},
+        # 1 device per trial process: the pytest env's 8-virtual-device
+        # XLA_FLAGS otherwise reaches the subprocesses, whose resize-leg
+        # restores then hit the KNOWN pre-existing 8-device-restore glibc
+        # abort flake (see ROADMAP known env failures) — unrelated to the
+        # elastic protocol under test here.
+        "environment": {
+            "jax_platform": "cpu",
+            "variables": {
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            },
+        },
+        "max_restarts": 1,
+        "elastic": {"enabled": True},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _wait_training_underway(dc, exp_id, timeout=240.0):
+    """Block until the trial has a verified checkpoint AND two training
+    reports — the reclaim must land mid-training, after a restore point
+    exists."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        trials = dc.master.db.list_trials(exp_id)
+        if trials:
+            trial_id = trials[0]["id"]
+            rows = dc.master.db.get_metrics(trial_id, "training")
+            if trials[0].get("latest_checkpoint") and len(rows) >= 2:
+                return trial_id
+        time.sleep(0.3)
+    raise AssertionError("trial never got training underway")
+
+
+class TestElasticDrill:
+    def test_reclaim_one_rank_resizes_in_place(self, tmp_path):
+        from determined_tpu.common import faults
+        from determined_tpu.devcluster import DevCluster
+        from determined_tpu.master.core import ELASTIC_RESIZES
+
+        def shrinks():
+            # shared process-global registry: read order-independently
+            # (counters only accumulate)
+            return ELASTIC_RESIZES.labels("shrink").value
+
+        faults.clear()
+        before = shrinks()
+        try:
+            with DevCluster(n_agents=2, slots_per_agent=1) as dc:
+                exp_id = dc.create_experiment(_elastic_config(tmp_path))
+                trial_id = _wait_training_underway(dc, exp_id)
+                # Arm the deterministic reclaim NOW (in-process plan): the
+                # rank-1 task is SIGKILLed within ~0.5s — mid-training, as
+                # a spot reclaim would land.
+                faults.install(faults.FaultPlan(
+                    {"agent.reclaim.rank1": faults.FaultSpec(failures=1)}
+                ))
+                state = dc.wait_experiment(exp_id, timeout=300)
+                assert state == "COMPLETED", state
+
+                trial = dc.master.db.list_trials(exp_id)[0]
+                # zero restart-budget charge, zero requeues: the SAME run
+                # survived the reclaim
+                assert trial["run_id"] == 0
+                assert trial["restarts"] == 0
+                assert trial["infra_requeues"] == 0
+                assert trial["state"] == "COMPLETED"
+                # correct resumed step: the survivor trained to the target
+                assert trial["steps_completed"] == 24
+
+                alloc = dc.master.alloc_service.get(f"{exp_id}.{trial_id}.0")
+                assert alloc is not None
+                assert alloc.generation >= 1       # a resize happened
+                assert alloc.num_processes == 1    # on the shrunk mesh
+                assert alloc.exit_code == 0
+
+                # the goodput ledger recorded the drain→resume cost in the
+                # resize event class — NOT as a restart
+                rows = dc.master.db.get_metrics(trial_id, "profiling")
+                ledger = rows[-1]["body"]
+                assert ledger["ledger_resizes"] >= 1
+                assert ledger["resize_lost_s"] > 0
+                assert ledger["ledger_restarts"] == 0
+                assert ledger["goodput_pct"] < 100.0
+                assert shrinks() >= before + 1
+        finally:
+            faults.clear()
+
+    @pytest.mark.slow
+    def test_grow_back_after_reclaim(self, tmp_path):
+        """With elastic.grow the capacity tick re-expands the shrunken
+        gang: a newcomer STARTs on the freed agent under a new
+        generation and the survivor re-enters rendezvous alongside it."""
+        from determined_tpu.common import faults
+        from determined_tpu.devcluster import DevCluster
+
+        faults.clear()
+        try:
+            with DevCluster(n_agents=2, slots_per_agent=1) as dc:
+                exp_id = dc.create_experiment(_elastic_config(
+                    tmp_path,
+                    searcher={"name": "single", "max_length": 60,
+                              "metric": "loss"},
+                    elastic={"enabled": True, "grow": True},
+                ))
+                trial_id = _wait_training_underway(dc, exp_id)
+                faults.install(faults.FaultPlan(
+                    {"agent.reclaim.rank1": faults.FaultSpec(failures=1)}
+                ))
+                state = dc.wait_experiment(exp_id, timeout=420)
+                assert state == "COMPLETED", state
+                trial = dc.master.db.list_trials(exp_id)[0]
+                assert trial["run_id"] == 0 and trial["restarts"] == 0
+                assert trial["steps_completed"] == 60
+                alloc = dc.master.alloc_service.get(f"{exp_id}.{trial_id}.0")
+                # shrink (gen 1) then grow (gen 2) back to 2 processes
+                assert alloc.generation >= 2
+                assert alloc.num_processes == 2
+        finally:
+            faults.clear()

@@ -173,6 +173,116 @@ def test_poll_lint_actually_detects_a_violation(tmp_path):
     assert _poll_violations_in_file(str(waived)) == []
 
 
+# ---------------------------------------------------------------------------
+# Rendezvous discipline: every client-side rendezvous arrival must go
+# through the generation-aware helper (exec/prep_and_run._rendezvous_arrive),
+# and the AllocationService.rendezvous_arrive service call is reserved to
+# the HTTP layer. A bare POST to `/rendezvous` (or a direct service call)
+# bypasses the generation fence that keeps a straggler rank from
+# corrupting a resized gang's address table — the exact class of bug the
+# elastic-resize 409 re-sync exists to prevent.
+# ---------------------------------------------------------------------------
+#: (relative path, function name) pairs allowed to POST the rendezvous
+#: route / call the service directly.
+RENDEZVOUS_POST_ALLOWED = {
+    (os.path.join("exec", "prep_and_run.py"), "_rendezvous_arrive"),
+}
+RENDEZVOUS_SERVICE_ALLOWED = {
+    os.path.join("master", "api_server.py"),   # the HTTP route handler
+    os.path.join("master", "allocation.py"),   # the definition itself
+}
+
+
+def _contains_rendezvous_literal(call: ast.Call) -> bool:
+    for sub in ast.walk(call):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            if "/rendezvous" in sub.value:
+                return True
+    return False
+
+
+def _rendezvous_violations_in_file(path: str, rel: str):
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    tree = ast.parse(source, filename=path)
+    out = []
+
+    def scan(node, func_name):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan(child, child.name)
+                continue
+            if isinstance(child, ast.Call):
+                fn = child.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr == "post"
+                    and _contains_rendezvous_literal(child)
+                    and (rel, func_name) not in RENDEZVOUS_POST_ALLOWED
+                ):
+                    out.append(
+                        f"{path}:{child.lineno}: POST to /rendezvous outside "
+                        "the generation-aware helper "
+                        "(exec/prep_and_run._rendezvous_arrive)"
+                    )
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr == "rendezvous_arrive"
+                    and rel not in RENDEZVOUS_SERVICE_ALLOWED
+                ):
+                    out.append(
+                        f"{path}:{child.lineno}: direct "
+                        "AllocationService.rendezvous_arrive call outside "
+                        "the HTTP layer"
+                    )
+            scan(child, func_name)
+
+    scan(tree, "<module>")
+    return out
+
+
+def test_rendezvous_goes_through_generation_aware_helper():
+    violations = []
+    for dirpath, _, filenames in os.walk(PKG_ROOT):
+        for name in filenames:
+            if not name.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, PKG_ROOT)
+            violations.extend(_rendezvous_violations_in_file(full, rel))
+    assert not violations, (
+        "rendezvous arrivals bypassing the generation-aware helper — route "
+        "them through exec/prep_and_run._rendezvous_arrive (client) or the "
+        "HTTP layer (master):\n" + "\n".join(violations)
+    )
+
+
+def test_rendezvous_lint_actually_detects_a_violation(tmp_path):
+    bad = tmp_path / "bad_rdv.py"
+    bad.write_text(
+        "def f(session, alloc_id, rank, addr):\n"
+        "    session.post(\n"
+        "        f'/api/v1/allocations/{alloc_id}/rendezvous',\n"
+        "        json_body={'rank': rank, 'addr': addr},\n"
+        "    )\n"
+    )
+    assert len(_rendezvous_violations_in_file(str(bad), "x.py")) == 1
+
+    svc = tmp_path / "bad_svc.py"
+    svc.write_text(
+        "def g(service):\n"
+        "    service.rendezvous_arrive('a', 0, 'addr')\n"
+    )
+    assert len(_rendezvous_violations_in_file(str(svc), "y.py")) == 1
+
+    good = tmp_path / "good_rdv.py"
+    good.write_text(
+        "def h(session, alloc_id):\n"
+        "    session.get(f'/api/v1/allocations/{alloc_id}/rendezvous')\n"
+    )
+    assert _rendezvous_violations_in_file(str(good), "z.py") == []
+
+
 def test_lint_actually_detects_a_violation(tmp_path):
     """The linter itself must not rot: a textbook bare retry loop is
     flagged, a policy-driven one is not."""
