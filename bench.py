@@ -566,6 +566,68 @@ def neox_2layer_crosscheck(dev, on_tpu: bool):
         return None
 
 
+def serving_rung(on_tpu: bool):
+    """Serving bench rung: the continuous-batching generation service
+    under a concurrent streaming load (loadgen through its own HTTP
+    surface), recording served tokens/sec and p99 TTFT next to the
+    training MFU rungs. On TPU the decode step is the Pallas flash
+    kernel's kv_offset path (the engine records which backend ran —
+    the record asserts the rung measured the kernel, not the
+    reference fallback)."""
+    try:
+        from determined_tpu.models import gpt as gpt_mod
+        from determined_tpu.serving import GenerationEngine, ServingConfig
+        from determined_tpu.serving.loadgen import drive
+        from determined_tpu.serving.service import GenerationServer
+
+        if on_tpu:
+            model = gpt_mod.GPT(GPTConfig(remat=False))  # GPT-2 small
+            scfg = ServingConfig(
+                model="small", page_size=128, num_pages=129,
+                max_pages_per_request=8, max_batch_size=8,
+                prefill_rows=4, prefill_seq=512, max_new_tokens=128,
+                max_queue_depth=64,
+            )
+            n_req, conc, p_len, m_new = 16, 8, 64, 64
+        else:
+            model = gpt_mod.GPT(GPTConfig(
+                vocab_size=1024, n_layers=2, n_heads=4, d_model=128,
+                d_ff=512, seq_len=256, remat=False,
+            ))
+            scfg = ServingConfig(
+                page_size=16, num_pages=65, max_pages_per_request=4,
+                max_batch_size=8, prefill_rows=4, prefill_seq=64,
+                max_new_tokens=32, max_queue_depth=64,
+            )
+            n_req, conc, p_len, m_new = 8, 8, 8, 8
+        params = model.init(jax.random.PRNGKey(0))
+        engine = GenerationEngine(model, params, scfg)
+        engine.start()
+        server = GenerationServer(engine)
+        server.start()
+        try:
+            # warmup: compile prefill + decode outside the timed run
+            drive(server.url, 2, 2, prompt_len=p_len,
+                  max_new_tokens=4, timeout_s=600.0)
+            report = drive(
+                server.url, n_req, conc, prompt_len=p_len,
+                max_new_tokens=m_new, timeout_s=600.0,
+            )
+        finally:
+            server.stop()
+            engine.stop()
+        out = {f"serving_{k}" if not k.startswith("serving") else k: v
+               for k, v in report.summary().items()}
+        out["serving_decode_backend"] = engine.stats()["decode_backend"]
+        out["serving_concurrency"] = conc
+        return out
+    except Exception:  # noqa: BLE001 — skip the rung, keep the headline
+        import traceback
+
+        traceback.print_exc()
+        return None
+
+
 def main() -> None:
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
@@ -721,6 +783,15 @@ def main() -> None:
                 record["asha_trials_per_hour_load_normalized"] = round(
                     median * correction, 1
                 )
+    if not os.environ.get("DTPU_BENCH_SKIP_SERVING"):
+        # The platform's second workload class: continuous-batching
+        # serving under concurrent streaming load (tokens/sec served and
+        # p99 TTFT are the serving SLO numbers; decode_backend records
+        # that the rung exercised the Pallas kv_offset decode path on
+        # TPU, not the reference fallback).
+        sr = serving_rung(on_tpu)
+        if sr is not None:
+            record.update(sr)
     print(json.dumps(record))
 
 

@@ -50,6 +50,23 @@ from determined_tpu import core as core_mod
 logger = logging.getLogger("determined_tpu.batch_inference")
 
 
+class SequenceTooLongError(ValueError):
+    """A document exceeds the pack's seq_len under overflow="error".
+
+    Named (rather than a bare ValueError) so admission layers — the
+    serving engine packs every prefill batch through here — can rely on
+    catching exactly this condition and answer with a client error
+    instead of silently mis-packing a truncated prompt."""
+
+    def __init__(self, doc_len: int, seq_len: int) -> None:
+        super().__init__(
+            f"document of {doc_len} tokens exceeds pack seq_len {seq_len} "
+            '(overflow="error")'
+        )
+        self.doc_len = doc_len
+        self.seq_len = seq_len
+
+
 def pack_sequences(
     docs: Iterable[Sequence[int]],
     seq_len: int,
@@ -57,13 +74,20 @@ def pack_sequences(
     *,
     pad_id: int = 0,
     drop_remainder: bool = False,
+    overflow: str = "truncate",
 ) -> Iterator[Dict[str, np.ndarray]]:
     """Pack variable-length documents into fixed [B, S] batches for the
     flash kernels' segment-id masking (models take the emitted
     "segment_ids" straight through attention — see ops/flash_attention.py).
 
-    Greedy first-fit: each doc (truncated to seq_len) goes into the first
-    open row with room, rows close when full. Emitted batches carry
+    A document longer than seq_len follows `overflow`: "truncate" (the
+    default — its head packs, the tail is dropped; right for training
+    streams) or "error" (raise SequenceTooLongError — right for serving,
+    where a silently-truncated prompt would generate from the wrong
+    context). Any other value is rejected up front.
+
+    Greedy first-fit: each doc goes into the first open row with room,
+    rows close when full. Emitted batches carry
 
     - "tokens"       int32 [B, S] — docs back to back, pad_id after;
     - "segment_ids"  int32 [B, S] — 1, 2, ... per doc within a row, 0 on
@@ -77,6 +101,10 @@ def pack_sequences(
     """
     if seq_len < 1 or batch_size < 1:
         raise ValueError("seq_len and batch_size must be >= 1")
+    if overflow not in ("truncate", "error"):
+        raise ValueError(
+            f'overflow must be "truncate" or "error", got {overflow!r}'
+        )
 
     def emit(rows, segs) -> Dict[str, np.ndarray]:
         tokens = np.full((batch_size, seq_len), pad_id, np.int32)
@@ -92,7 +120,11 @@ def pack_sequences(
     segs: List[List[int]] = []   # per-row segment-id buffers
     counts: List[int] = []       # docs packed per row (last id used)
     for doc in docs:
-        toks = list(doc)[:seq_len]
+        toks = list(doc)
+        if len(toks) > seq_len:
+            if overflow == "error":
+                raise SequenceTooLongError(len(toks), seq_len)
+            toks = toks[:seq_len]
         if not toks:
             continue
         placed = False

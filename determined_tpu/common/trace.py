@@ -141,6 +141,29 @@ def _attr_value(v: Any) -> Dict[str, Any]:
     return {"stringValue": str(v)}
 
 
+def export_span(
+    name: str,
+    *,
+    trace_id: str,
+    span_id: str,
+    start: float,
+    end: float,
+    parent_span_id: Optional[str] = None,
+    attributes: Optional[Dict[str, Any]] = None,
+    error: bool = False,
+) -> None:
+    """Export one finished span from explicit timestamps.
+
+    For components that measure phases with their own clocks instead of a
+    `with span()` block — the serving engine records submit/queue/prefill/
+    first-token times across threads and emits the request's phase spans
+    at completion. Same wire shape and DTPU_TRACE_FILE gating as span()."""
+    _export(
+        name, trace_id, span_id, parent_span_id, start, end,
+        dict(attributes or {}), error,
+    )
+
+
 @contextlib.contextmanager
 def span(
     name: str,

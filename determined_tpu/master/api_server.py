@@ -2033,19 +2033,46 @@ class ApiServer:
                                close=True)
                     return
                 body = self.rfile.read(length) if length else b""
-                status, headers, data = master.proxy.forward(
+                status, headers, chunks = master.proxy.forward_stream(
                     task_id, method, rest, parsed.query,
                     dict(self.headers), body,
                 )
+                # Pass-through is UNBUFFERED: chunks reach the client as
+                # the task service produces them (an SSE token stream's
+                # TTFT must survive the proxy). With a backend
+                # Content-Length the connection stays reusable; without
+                # one the response is close-delimited.
+                expected = next(
+                    (int(v) for k, v in headers.items()
+                     if k.lower() == "content-length" and v.isdigit()),
+                    None,
+                )
+                sent = 0
                 try:
                     self.send_response(status)
                     for k, v in headers.items():
                         self.send_header(k, v)
-                    self.send_header("Content-Length", str(len(data)))
+                    if expected is None:
+                        self.send_header("Connection", "close")
+                        self.close_connection = True
                     self.end_headers()
-                    self.wfile.write(data)
+                    for chunk in chunks:
+                        self.wfile.write(chunk)
+                        self.wfile.flush()
+                        sent += len(chunk)
                 except (BrokenPipeError, ConnectionResetError):
                     pass
+                finally:
+                    if expected is not None and sent != expected:
+                        # The backend died mid-body: we advertised
+                        # Content-Length but delivered less. Reusing the
+                        # keep-alive connection would hand the next
+                        # request misaligned bytes — tear it down (the
+                        # client sees a truncated response, as it should).
+                        self.close_connection = True
+                    close = getattr(chunks, "close", None)
+                    if close is not None:
+                        close()
 
             def _proxy_upgrade(self, method: str, parsed) -> None:
                 """WebSocket (or any Upgrade) pass-through: hand the raw

@@ -11,6 +11,7 @@ commands, and POST lifecycle events back — same message shapes as
 """
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
@@ -2000,6 +2001,29 @@ class Master:
         proxying is not implemented yet)."""
         task_type = config.get("task_type", "COMMAND").upper()
         entrypoint = config.get("entrypoint", "")
+        if task_type == "SERVING":
+            # The generation service is a first-class task shape: default
+            # entrypoint, serving knobs validated HERE with named errors
+            # (a typo'd page_size must fail the create, not the replica
+            # minutes later), and the section injected into the task env
+            # for the service to pick up.
+            from determined_tpu.serving.config import validate_serving
+
+            serving_errors = validate_serving(config.get("serving", {}))
+            if serving_errors:
+                raise ValueError(
+                    "invalid serving config: " + "; ".join(serving_errors)
+                )
+            if not entrypoint:
+                entrypoint = "python -m determined_tpu.serving.service"
+                config = dict(config, entrypoint=entrypoint)
+            env = dict(config.get("environment") or {})
+            env_vars = dict(env.get("variables") or {})
+            env_vars.setdefault(
+                "DTPU_SERVING_CONFIG", json.dumps(config.get("serving", {}))
+            )
+            env["variables"] = env_vars
+            config = dict(config, environment=env)
         if not entrypoint:
             raise ValueError("command config needs an entrypoint")
         idle = config.get("idle_timeout_s")

@@ -48,6 +48,10 @@ KNOWN_HEALTH_KEYS = {
     "spike_min_history",
     "divergence_check_period",
 }
+#: generation-service knobs (serving/config.py owns the key set and the
+#: per-key checks; this module routes a config's `serving:` section
+#: through them so `experiment create` / task create rejects typos with
+#: the same named-error discipline as health.*/elastic.*).
 
 
 def _check_unit(spec: Any, field: str, errors: List[str]) -> None:
@@ -388,6 +392,14 @@ def validate(config: Dict[str, Any]) -> List[str]:
             ):
                 errors.append("elastic.min_world_size must be an int >= 1")
 
+    serving = config.get("serving")
+    if serving is not None:
+        # Lazy import: the serving key set lives next to the engine so
+        # the two cannot drift; the config module itself is stdlib-only.
+        from determined_tpu.serving.config import validate_serving
+
+        errors.extend(validate_serving(serving))
+
     _check_unit(config.get("min_validation_period"), "min_validation_period", errors)
     _check_unit(config.get("min_checkpoint_period"), "min_checkpoint_period", errors)
     _check_unit(config.get("scheduling_unit"), "scheduling_unit", errors)
@@ -557,6 +569,48 @@ FIELDS: List[Tuple[str, str, str, str]] = [
      "capacity under a new generation and the survivors re-enter "
      "rendezvous alongside it. Off by default so a drill (or an "
      "operator) observing the shrunk mesh keeps it stable."),
+    ("serving.model", "string", "tiny",
+     "Generation-service tasks (task_type SERVING): model the replica "
+     "serves — `tiny`, `small` (GPT-2 124M class), or `medium`. See "
+     "docs/serving.md."),
+    ("serving.page_size", "int >= 1", "128",
+     "KV-cache page size in tokens. Lane-friendly multiples of 128 keep "
+     "the paged decode gather and flash-kernel block fitting efficient "
+     "on TPU."),
+    ("serving.num_pages", "int >= 2", "65",
+     "Preallocated KV pool pages (page 0 is the scratch page, so "
+     "`num_pages - 1` are allocatable). Pool bytes = 2 × layers × "
+     "num_pages × page_size × d_model × dtype."),
+    ("serving.max_pages_per_request", "int >= 1", "8",
+     "Page-table width per request: caps one request's context at "
+     "`max_pages_per_request × page_size` tokens (and at the model's "
+     "seq_len)."),
+    ("serving.max_batch_size", "int >= 1", "8",
+     "Decode batch slots — the static batch dimension of the jitted "
+     "decode step; requests join/leave between iterations without "
+     "recompiling."),
+    ("serving.max_new_tokens", "int >= 1", "256",
+     "Cap on any request's max_new_tokens."),
+    ("serving.prefill_rows", "int >= 1", "4",
+     "Packed-prefill rows (pack_sequences batch_size): prefill compiles "
+     "once at `prefill_rows × prefill_seq`."),
+    ("serving.prefill_seq", "int >= 1", "256",
+     "Packed-prefill row length — also the longest admissible prompt."),
+    ("serving.max_queue_depth", "int >= 1", "32",
+     "Admission queue bound; beyond it requests are shed with 503 + "
+     "Retry-After."),
+    ("serving.default_deadline_s", "number > 0", "120",
+     "Deadline applied when a request names none; expired requests are "
+     "shed in queue and cut off mid-decode."),
+    ("serving.shed_retry_after_s", "number > 0", "1",
+     "Retry-After hint on shed responses."),
+    ("serving.max_prefills_per_iter", "int >= 1", "1",
+     "Prefill/decode interleaving: packed prefill batches admitted per "
+     "engine iteration, bounding the decode-latency bubble a prefill "
+     "burst can cause."),
+    ("serving.eos_id", "int", "-1",
+     "End-of-sequence token id; negative means generation stops only at "
+     "max_new_tokens / deadline / context."),
     ("environment.variables", "object", "{}",
      "Extra environment variables for the task process."),
     ("environment.jax_platform", "string", "",
@@ -601,10 +655,12 @@ def generate_reference() -> str:
     lines += [
         "",
         "Command/notebook/shell TASK configs are smaller: `entrypoint`,",
-        "`task_type` (COMMAND/NOTEBOOK/SHELL/TENSORBOARD), `resources."
-        "slots`,",
+        "`task_type` (COMMAND/NOTEBOOK/SHELL/TENSORBOARD/SERVING), "
+        "`resources.slots`,",
         "`environment.variables`, and `idle_timeout_s` (kill the task",
-        "after this many seconds without proxied activity).",
+        "after this many seconds without proxied activity). SERVING",
+        "tasks default their entrypoint to the generation service and",
+        "take the `serving.*` section above (docs/serving.md).",
         "",
     ]
     return "\n".join(lines)

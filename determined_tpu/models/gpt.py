@@ -38,6 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from determined_tpu.models import attention as attn_mod
 from determined_tpu.models.base import Metrics, Model
+from determined_tpu.ops.flash_attention import fit_block, flash_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -888,6 +889,156 @@ class GPT(Model):
     ) -> jax.Array:
         """tokens [B, S] int32 → logits [B, S, V] (compute dtype)."""
         return self._forward(params, tokens, positions, segment_ids)[0]
+
+    # -- serving: kv-cache-aware forward ------------------------------------
+    # The generation service (determined_tpu/serving) runs two step shapes,
+    # both static so the engine never recompiles as requests come and go:
+    # a packed prefill over pack_sequences batches, and a single-token
+    # decode over a paged KV pool. Both lean on the flash kernels' masking
+    # model — segment_ids isolate packed prompts, and decode runs
+    # causal + kv_offset (the bottom-aligned short-q geometry) with
+    # segment masking trimming each row's dead cache tail.
+    def prefill_kv(
+        self,
+        params: Dict[str, Any],
+        tokens: jax.Array,
+        positions: jax.Array,
+        segment_ids: jax.Array,
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Packed prefill that also returns every layer's K/V.
+
+        tokens [B, S] int32 — prompts packed back to back per row
+        (batch_inference.pack_sequences layout); positions [B, S] int32 —
+        each token's position WITHIN its own document (pos_embed index);
+        segment_ids [B, S] int32 — 1, 2, ... per document, 0 on padding.
+
+        → (logits [B, S, V] compute dtype,
+           k [L, B, S, H, Dh], v [L, B, S, H, Dh] compute dtype).
+
+        The serving engine scatters each document's K/V slice into its
+        page-pool pages and samples the first generated token from the
+        logits at the document's last prompt position. No sharding
+        constraints: serving replicas are single-device (mesh=None).
+        """
+        c = self.config
+        if c.pipeline_stages > 1:
+            raise ValueError("prefill_kv does not support pipeline stages")
+        b, s = tokens.shape
+        x = (
+            params["tok_embed"].astype(c.dtype)[tokens]
+            + params["pos_embed"].astype(c.dtype)[positions]
+        )
+        bq = fit_block(s, c.flash_block_q)
+        bk = fit_block(s, c.flash_block_k)
+        ks, vs = [], []
+        for i in range(c.n_layers):
+            blk = jax.tree_util.tree_map(lambda a, i=i: a[i], params["blocks"])
+            h = _layernorm(x, blk["ln1_scale"], blk["ln1_bias"])
+            qkv = (
+                jnp.einsum("bsd,dthk->bsthk", h, blk["wqkv"].astype(c.dtype))
+                + blk["bqkv"].astype(c.dtype)
+            )
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            ks.append(k)
+            vs.append(v)
+            o = flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk,
+                segment_ids=segment_ids,
+            )
+            o = jnp.einsum("bshk,hkd->bsd", o, blk["wo"].astype(c.dtype))
+            x = x + o + blk["bo"].astype(c.dtype)
+            x, _aux = self._mlp_half(x, blk, manual=False)
+        logits = self._head(params, x)
+        return logits, jnp.stack(ks), jnp.stack(vs)
+
+    def decode_kv(
+        self,
+        params: Dict[str, Any],
+        last_tokens: jax.Array,
+        lengths: jax.Array,
+        active: jax.Array,
+        cache_k: jax.Array,
+        cache_v: jax.Array,
+        page_table: jax.Array,
+        *,
+        q_pad: int = 1,
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """One iteration-level decode step over the paged KV cache.
+
+        last_tokens [B] int32 — the token each slot processes this
+        iteration (it sits at position lengths[b]); lengths [B] int32 —
+        tokens already cached per slot; active [B] bool — live slots;
+        cache_k/cache_v [L, n_pages, page_size, H, Dh] — the page pool
+        (page 0 is the engine's scratch page); page_table [B, P] int32 —
+        each slot's pages in order.
+
+        → (logits [B, V] fp32 for the NEXT token, cache_k, cache_v) with
+        the processed token's K/V written at its position. Every shape is
+        static in (B, P, pool geometry): requests joining/leaving the
+        batch between iterations never trigger a recompile.
+
+        Masking: causal + kv_offset puts the single real query row at the
+        last key position (the kernels' bottom-aligned decode geometry —
+        the kv_offset path, never the mono fallback); segment ids trim
+        each row's dead cache tail, and inactive rows carry a q-segment
+        matching nothing (they write to the scratch page and read zeros).
+        `q_pad` pads the query block up to a lane-friendly row count on
+        TPU (rows past 0 attend real keys but their output is dropped).
+        """
+        c = self.config
+        n_layers, _n_pages, page_size, h, hd = cache_k.shape
+        b = last_tokens.shape[0]
+        s_max = page_table.shape[1] * page_size
+        positions = jnp.clip(lengths, 0, c.seq_len - 1)
+        x = (
+            params["tok_embed"].astype(c.dtype)[last_tokens][:, None, :]
+            + params["pos_embed"].astype(c.dtype)[positions][:, None, :]
+        )  # [B, 1, D]
+        # Write coordinates for this iteration's token; inactive rows are
+        # routed to the scratch page so the scatter stays unconditional.
+        widx = page_table[jnp.arange(b), lengths // page_size]
+        widx = jnp.where(active, widx, 0)
+        woff = lengths % page_size
+        kv_pos = jnp.arange(s_max)[None, :]
+        kv_seg = (
+            (kv_pos <= lengths[:, None]) & active[:, None]
+        ).astype(jnp.int32)  # [B, S_max]: live cache rows incl. this token
+        qpad = max(1, int(q_pad))
+        # q row 0 matches live keys (id 1); inactive slots and pad rows get
+        # ids that match nothing on the kv side (never 0 — padding is 0).
+        q_seg = jnp.where(active, 1, 2).astype(jnp.int32)[:, None]
+        if qpad > 1:
+            q_seg = jnp.concatenate(
+                [q_seg, jnp.full((b, qpad - 1), 2, jnp.int32)], axis=1
+            )
+        bq = fit_block(qpad, 128)
+        bk = fit_block(s_max, c.flash_block_k)
+        for i in range(n_layers):
+            blk = jax.tree_util.tree_map(lambda a, i=i: a[i], params["blocks"])
+            hn = _layernorm(x, blk["ln1_scale"], blk["ln1_bias"])
+            qkv = (
+                jnp.einsum("bsd,dthk->bsthk", hn, blk["wqkv"].astype(c.dtype))
+                + blk["bqkv"].astype(c.dtype)
+            )
+            q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            cache_k = cache_k.at[i, widx, woff].set(k_new[:, 0])
+            cache_v = cache_v.at[i, widx, woff].set(v_new[:, 0])
+            k_full = cache_k[i][page_table].reshape(b, s_max, h, hd)
+            v_full = cache_v[i][page_table].reshape(b, s_max, h, hd)
+            if qpad > 1:
+                q = jnp.concatenate(
+                    [q, jnp.zeros((b, qpad - 1, h, hd), q.dtype)], axis=1
+                )
+            o = flash_attention(
+                q, k_full, v_full, causal=True, kv_offset=s_max - 1,
+                segment_ids=q_seg, kv_segment_ids=kv_seg,
+                block_q=bq, block_k=bk,
+            )[:, :1]
+            o = jnp.einsum("bshk,hkd->bsd", o, blk["wo"].astype(c.dtype))
+            x = x + o + blk["bo"].astype(c.dtype)
+            x, _aux = self._mlp_half(x, blk, manual=False)
+        logits = self._head(params, x)  # [B, 1, V]
+        return logits[:, 0].astype(jnp.float32), cache_k, cache_v
 
     # -- 1F1B training path ------------------------------------------------
     def _loss_1f1b(

@@ -1,0 +1,30 @@
+"""Serving: the platform's second workload class — a continuous-batching
+generation service behind the master.
+
+The training half of the platform schedules gangs; this package carries
+user-facing inference traffic as a long-running task the master schedules
+and proxies to (clients hit the master URL; SSE token streams pass through
+the proxy unbuffered). Pieces:
+
+- ``config``    — validated serving knobs (expconf `serving:` section);
+- ``kv_cache``  — paged KV cache: fixed-size pages in a preallocated
+  pool, per-request page tables, alloc/free with no realloc/recompile;
+- ``engine``    — iteration-level (Orca-style) continuous batching: one
+  jitted decode step per iteration, packed prefill admitted into spare
+  slots, requests join/leave between iterations; SLO-aware admission
+  with load shedding;
+- ``service``   — the HTTP surface (`POST /api/v1/generate`, SSE token
+  streaming) registered in the master's ProxyRegistry;
+- ``loadgen``   — the load driver behind the serving bench rung
+  (`serving_tokens_per_sec`, `p99_ttft_ms`) and the devcluster drills.
+"""
+from determined_tpu.serving.config import ServingConfig  # noqa: F401
+from determined_tpu.serving.engine import (  # noqa: F401
+    GenerationEngine,
+    PromptTooLong,
+    Shed,
+)
+from determined_tpu.serving.kv_cache import (  # noqa: F401
+    PagePool,
+    PoolExhausted,
+)

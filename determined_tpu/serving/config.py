@@ -1,0 +1,145 @@
+"""Serving configuration: the expconf-style knob tier for the generation
+service.
+
+Same philosophy as masterconf/expconf: the whole tree is validated up
+front with every problem named (a typo'd `page_size` must fail the task
+at create, not surface as a shape error deep inside the decode step).
+The `serving:` section of an experiment/task config maps 1:1 onto
+`ServingConfig.from_dict`; `master/expconf.py` carries the same key set
+so `experiment create` rejects bad serving configs with named errors.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List
+
+#: Keys accepted in a config's `serving:` section. This set is the ONE
+#: source of truth: master/expconf.py validates `serving:` by calling
+#: validate_serving below (lazy import), so there is no duplicate key
+#: set anywhere to keep in sync.
+KNOWN_SERVING_KEYS = {
+    "model",
+    "page_size",
+    "num_pages",
+    "max_pages_per_request",
+    "max_batch_size",
+    "max_new_tokens",
+    "prefill_rows",
+    "prefill_seq",
+    "max_queue_depth",
+    "default_deadline_s",
+    "shed_retry_after_s",
+    "max_prefills_per_iter",
+    "eos_id",
+}
+
+KNOWN_MODELS = ("tiny", "small", "medium")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Knobs for one generation-service replica.
+
+    Pool geometry (`page_size` × `num_pages`) bounds total cached tokens;
+    `max_pages_per_request` bounds one request's context (admission caps
+    prompt + max_new_tokens to `min(model seq_len, pages × page_size)`).
+    Page 0 of the pool is the scratch page inactive slots write to, so
+    `num_pages - 1` pages are allocatable.
+    """
+
+    model: str = "tiny"
+    #: tokens per KV page. Lane-friendly multiples of 128 keep the decode
+    #: gather and the flash kernel's block fitting happy on TPU; smaller
+    #: pages waste less on short tails but grow the page-table gather.
+    page_size: int = 128
+    #: pool pages (page 0 reserved as the scratch page).
+    num_pages: int = 65
+    #: per-request page-table width: max context = this × page_size.
+    max_pages_per_request: int = 8
+    #: decode batch slots — the static decode-step batch dimension.
+    max_batch_size: int = 8
+    #: cap on any request's max_new_tokens.
+    max_new_tokens: int = 256
+    #: packed-prefill geometry (pack_sequences batch_size × seq_len);
+    #: static, so prefill compiles exactly once.
+    prefill_rows: int = 4
+    prefill_seq: int = 256
+    #: admission queue bound — beyond it requests are shed (429/503-class).
+    max_queue_depth: int = 32
+    #: deadline applied when a request names none (seconds, submit→done).
+    default_deadline_s: float = 120.0
+    #: Retry-After hint handed back with a shed.
+    shed_retry_after_s: float = 1.0
+    #: prefill/decode interleaving: at most this many packed-prefill
+    #: batches are admitted per engine iteration, so a prefill burst
+    #: cannot starve in-flight decode latency.
+    max_prefills_per_iter: int = 1
+    #: end-of-sequence token id (negative = never stop on a token).
+    eos_id: int = -1
+
+    @property
+    def max_context(self) -> int:
+        return self.max_pages_per_request * self.page_size
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ServingConfig":
+        errors = validate_serving(d)
+        if errors:
+            raise ValueError("invalid serving config: " + "; ".join(errors))
+        return cls(**{k: d[k] for k in d})
+
+
+def validate_serving(d: Any) -> List[str]:
+    """Human-readable errors for a `serving:` section (empty = valid)."""
+    errors: List[str] = []
+    if not isinstance(d, dict):
+        return ["serving must be an object"]
+    for key in d:
+        if key not in KNOWN_SERVING_KEYS:
+            errors.append(
+                f"serving: unknown key {key!r} "
+                f"(one of: {', '.join(sorted(KNOWN_SERVING_KEYS))})"
+            )
+    model = d.get("model", "tiny")
+    if model not in KNOWN_MODELS:
+        errors.append(
+            f"serving.model {model!r} unknown (one of {sorted(KNOWN_MODELS)})"
+        )
+    for key in (
+        "page_size", "num_pages", "max_pages_per_request", "max_batch_size",
+        "max_new_tokens", "prefill_rows", "prefill_seq", "max_queue_depth",
+        "max_prefills_per_iter",
+    ):
+        v = d.get(key)
+        if v is not None and (
+            not isinstance(v, int) or isinstance(v, bool) or v < 1
+        ):
+            errors.append(f"serving.{key} must be an int >= 1")
+    for key in ("default_deadline_s", "shed_retry_after_s"):
+        v = d.get(key)
+        if v is not None and (
+            not isinstance(v, (int, float)) or isinstance(v, bool)
+            or not math.isfinite(v) or v <= 0
+        ):
+            errors.append(f"serving.{key} must be a finite number > 0")
+    eos = d.get("eos_id")
+    if eos is not None and (not isinstance(eos, int) or isinstance(eos, bool)):
+        errors.append("serving.eos_id must be an int (negative disables)")
+    # Cross-field geometry: admission relies on these invariants.
+    num_pages = d.get("num_pages", 65)
+    per_req = d.get("max_pages_per_request", 8)
+    if (
+        isinstance(num_pages, int) and isinstance(per_req, int)
+        and num_pages >= 2 and per_req >= 1 and per_req > num_pages - 1
+    ):
+        errors.append(
+            "serving.max_pages_per_request must fit the allocatable pool "
+            "(num_pages - 1; page 0 is the scratch page)"
+        )
+    if isinstance(num_pages, int) and 0 < num_pages < 2:
+        errors.append(
+            "serving.num_pages must be >= 2 (page 0 is reserved as the "
+            "scratch page)"
+        )
+    return errors

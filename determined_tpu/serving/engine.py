@@ -1,0 +1,728 @@
+"""Iteration-level continuous-batching generation engine.
+
+Orca-style scheduling on top of the repo's own primitives: ONE jitted
+decode step runs over the whole active batch per iteration, and requests
+join/leave the batch BETWEEN iterations without draining it —
+
+- admission packs waiting prompts into a fixed-geometry prefill batch via
+  ``batch_inference.pack_sequences`` (segment ids isolate prompts; the
+  flash kernels mask within segments) and scatters each prompt's K/V into
+  pages borrowed from the preallocated pool (kv_cache.PagePool);
+- decode gathers each slot's pages and runs the flash kernel in the
+  bottom-aligned ``kv_offset`` geometry with segment masking trimming the
+  dead tail — every shape is static in (max_batch_size, page-table width,
+  pool geometry), so batch composition changes never recompile;
+- the SLO layer sheds at submit (queue bound, expired deadline, page-pool
+  pressure → ``Shed`` with a Retry-After hint) and finishes in-flight
+  requests the moment their deadline passes;
+- every phase is observable: ``dtpu_serving_*`` metrics and per-request
+  W3C trace spans (queue → prefill → decode) parented to the submitting
+  client's traceparent.
+
+Fault sites (common/faults.py): ``serving.admission`` (deterministic
+shed), ``serving.decode`` (mid-stream failure — SSE error event, pages
+freed), ``serving.page_alloc`` (pool exhaustion) — the chaos drills in
+tests/test_serving.py exercise all three.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+import queue as queue_mod
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from determined_tpu.batch_inference import pack_sequences
+from determined_tpu.common import faults
+from determined_tpu.common import trace as trace_mod
+from determined_tpu.common.metrics import REGISTRY as METRICS
+from determined_tpu.serving.config import ServingConfig
+from determined_tpu.serving.kv_cache import PagePool, PoolExhausted
+
+logger = logging.getLogger("determined_tpu.serving")
+
+# -- observability plane (dtpu_serving_*) ------------------------------------
+REQUESTS = METRICS.counter(
+    "dtpu_serving_requests_total",
+    "Generation requests by outcome (ok, shed, error, deadline).",
+    labels=("outcome",),
+)
+SHED = METRICS.counter(
+    "dtpu_serving_shed_total",
+    "Requests shed by the admission layer, by reason.",
+    labels=("reason",),
+)
+TOKENS = METRICS.counter(
+    "dtpu_serving_tokens_total",
+    "Tokens generated (streamed to clients).",
+)
+DECODE_ITERATIONS = METRICS.counter(
+    "dtpu_serving_decode_iterations_total",
+    "Iteration-level decode steps executed over the active batch.",
+)
+BATCH_JOINS = METRICS.counter(
+    "dtpu_serving_batch_joins_total",
+    "Requests admitted into an already-non-empty batch (the "
+    "continuous-batching signature: late joiners never drain the batch).",
+)
+DECODE_FAILURES = METRICS.counter(
+    "dtpu_serving_decode_failures_total",
+    "Decode iterations lost to failure (injected or real); affected "
+    "requests get an SSE error event and their pages return to the pool.",
+)
+QUEUE_DEPTH = METRICS.gauge(
+    "dtpu_serving_queue_depth", "Requests waiting for admission.",
+)
+BATCH_OCCUPANCY = METRICS.gauge(
+    "dtpu_serving_batch_occupancy", "Active decode-batch slots.",
+)
+TTFT = METRICS.histogram(
+    "dtpu_serving_ttft_seconds",
+    "Submit-to-first-token latency (the serving SLO; p99 via buckets).",
+)
+E2E = METRICS.histogram(
+    "dtpu_serving_e2e_seconds",
+    "Submit-to-done latency of completed requests.",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+             30.0, 60.0, 120.0),
+)
+
+
+def first_fit_layout(lens, seq_len, rows_cap):
+    """(row, start) coordinates for docs of `lens` under pack_sequences'
+    greedy first-fit over at most `rows_cap` rows of `seq_len`, or None
+    when they don't fit ONE emitted batch. The engine's admission AND its
+    prefill scatter both use this ONE mirror of the packing algorithm
+    (pack_sequences builds the arrays; a runtime assert in _prefill keeps
+    the two implementations honest)."""
+    rows: List[int] = []
+    layout: List[Tuple[int, int]] = []
+    for ln in lens:
+        for i, used in enumerate(rows):
+            if used + ln <= seq_len:
+                layout.append((i, used))
+                rows[i] = used + ln
+                break
+        else:
+            if len(rows) == rows_cap:
+                return None
+            layout.append((len(rows), 0))
+            rows.append(ln)
+    return layout
+
+
+def _scatter_kv(cache_k, cache_v, k_l, v_l, pages, offs):
+    """Move a whole prefill batch's K/V into the paged pool in ONE
+    in-place (donated) update. k_l/v_l are [L, B, S, H, Dh] from
+    prefill_kv; pages/offs are flat [B*S] destination coordinates with
+    every non-prompt position routed to scratch page 0 (whose contents
+    are only ever read under a segment mask). Eager per-request
+    ``.at[].set()`` would copy the full pool twice per admitted request."""
+    n_layers, _, _, n_heads, head_dim = k_l.shape
+    cache_k = cache_k.at[:, pages, offs].set(
+        k_l.reshape(n_layers, -1, n_heads, head_dim)
+    )
+    cache_v = cache_v.at[:, pages, offs].set(
+        v_l.reshape(n_layers, -1, n_heads, head_dim)
+    )
+    return cache_k, cache_v
+
+
+class Shed(Exception):
+    """Admission refused the request; retry after `retry_after` seconds."""
+
+    def __init__(self, reason: str, retry_after: float) -> None:
+        super().__init__(f"request shed: {reason}")
+        self.reason = reason
+        self.retry_after = retry_after
+
+
+class PromptTooLong(ValueError):
+    """The prompt (or prompt + max_new_tokens) exceeds what this replica's
+    pool geometry / model context can ever hold — a client error (400),
+    not a transient shed."""
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: str
+    prompt: List[int]
+    max_new_tokens: int
+    deadline: float                     # absolute wall time
+    temperature: float = 0.0
+    trace: Optional[Tuple[str, str]] = None
+    # -- engine-owned state --
+    events: "queue_mod.Queue" = dataclasses.field(
+        default_factory=queue_mod.Queue
+    )
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    pages: List[int] = dataclasses.field(default_factory=list)
+    slot: int = -1
+    length: int = 0                     # tokens in cache
+    last_token: int = 0
+    finish_reason: str = ""
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_first_token: float = 0.0
+    t_done: float = 0.0
+
+    def stream(
+        self, timeout: Optional[float] = None
+    ) -> Iterator[Tuple[str, Any]]:
+        """Yield ("token", id) events then exactly one terminal
+        ("done", info) or ("error", message) event. The default timeout
+        derives from the REQUEST's deadline (+ slack for the terminal
+        event) — a fixed constant would cut off generations whose
+        configured deadline legitimately runs longer."""
+        if timeout is None:
+            timeout = max(30.0, self.deadline - time.time() + 30.0)
+        deadline = time.time() + timeout
+        while True:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                yield ("error", "client stream timeout")
+                return
+            try:
+                kind, payload = self.events.get(timeout=min(remaining, 1.0))
+            except queue_mod.Empty:
+                continue
+            yield (kind, payload)
+            if kind in ("done", "error"):
+                return
+
+    def result(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Drain the stream and return the final summary (non-SSE mode)."""
+        toks: List[int] = []
+        for kind, payload in self.stream(timeout=timeout):
+            if kind == "token":
+                toks.append(payload)
+            elif kind == "done":
+                return {"tokens": toks, **payload}
+            else:
+                return {"tokens": toks, "error": payload}
+        return {"tokens": toks, "error": "stream ended unexpectedly"}
+
+
+class GenerationEngine:
+    """Continuous-batching engine over one model replica.
+
+    Thread model: HTTP handler threads call submit(); ONE engine thread
+    owns all device state (caches, jitted calls) and drives admission →
+    prefill → decode iterations. Per-request event queues carry tokens
+    back to the handler threads.
+    """
+
+    def __init__(self, model, params, config: ServingConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.model = model
+        self.params = params
+        self.cfg = config
+        c = model.config
+        if config.prefill_seq > c.seq_len:
+            raise ValueError(
+                f"serving.prefill_seq ({config.prefill_seq}) exceeds the "
+                f"model context ({c.seq_len})"
+            )
+        self.max_total = min(c.seq_len, config.max_context)
+        self.pool = PagePool(config.num_pages)
+        self._jnp = jnp
+        self.cache_k = jnp.zeros(
+            (c.n_layers, config.num_pages, config.page_size,
+             c.n_heads, c.head_dim), c.dtype,
+        )
+        self.cache_v = jnp.zeros_like(self.cache_k)
+        #: decode query-row padding: lane-friendly on TPU, minimal on CPU
+        #: (the blockwise reference pays per padded row; the MXU doesn't).
+        self._q_pad = 8 if jax.default_backend() == "tpu" else 1
+        self._prefill_fn = jax.jit(model.prefill_kv)
+        self._scatter_fn = jax.jit(_scatter_kv, donate_argnums=(0, 1))
+        self._decode_fn = jax.jit(
+            functools.partial(self._decode_step, q_pad=self._q_pad),
+            donate_argnums=(4, 5),
+        )
+        self._queue: deque = deque()
+        self._slots: List[Optional[Request]] = [None] * config.max_batch_size
+        self._lock = threading.Lock()
+        # Stats counters get their own lock: _count_shed fires from paths
+        # that may already hold the queue lock (submit's bounded-queue
+        # check), and threading.Lock is not reentrant.
+        self._stats_lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._rng = np.random.default_rng(0)
+        self._counter = 0
+        self._iter_count = 0
+        self._done_count = 0
+        self._shed_count = 0
+        self._tokens_emitted = 0
+        self._decode_backend = (
+            "pallas" if jax.default_backend() == "tpu" else "reference"
+        )
+
+    # -- jitted decode ------------------------------------------------------
+    def _decode_step(self, params, last, lengths, active, ck, cv, pt,
+                     temps, key, *, q_pad):
+        import jax
+        import jax.numpy as jnp
+
+        logits, ck, cv = self.model.decode_kv(
+            params, last, lengths, active, ck, cv, pt, q_pad=q_pad,
+        )
+        greedy = jnp.argmax(logits, axis=-1)
+        sampled = jax.random.categorical(
+            key, logits / jnp.maximum(temps, 1e-6)[:, None]
+        )
+        nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+        return nxt, ck, cv
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="serving-engine", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+        with self._lock:
+            pending = list(self._queue)
+            self._queue.clear()
+            QUEUE_DEPTH.set(0)
+        for req in pending:
+            req.events.put(("error", "engine shutting down"))
+        for i, req in enumerate(self._slots):
+            if req is not None:
+                self._slots[i] = None
+                self.pool.free(req.pages)
+                req.events.put(("error", "engine shutting down"))
+        BATCH_OCCUPANCY.set(0)
+
+    # -- admission (SLO layer) ---------------------------------------------
+    def submit(
+        self,
+        prompt: List[int],
+        max_new_tokens: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+        temperature: float = 0.0,
+        trace: Optional[Tuple[str, str]] = None,
+    ) -> Request:
+        """Admit a request into the waiting queue, or refuse it.
+
+        Raises PromptTooLong (client error — this replica can never serve
+        it) or Shed (transient — queue full, expired deadline, injected
+        admission fault; carries retry_after). Instrumented fault site:
+        ``serving.admission``.
+        """
+        cfg = self.cfg
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise PromptTooLong("prompt must be a non-empty token list")
+        explicit = bool(max_new_tokens)
+        mnt = int(max_new_tokens) if explicit else cfg.max_new_tokens
+        mnt = max(1, min(mnt, cfg.max_new_tokens))
+        if len(prompt) > cfg.prefill_seq:
+            raise PromptTooLong(
+                f"prompt is {len(prompt)} tokens; this replica packs "
+                f"prefills at {cfg.prefill_seq}"
+            )
+        if not explicit:
+            # The config-default token budget is a CAP, not a promise:
+            # clamp it to the remaining context so the documented defaults
+            # (e.g. model=tiny whose seq_len is below max_new_tokens=256)
+            # serve out of the box. An EXPLICIT ask that cannot fit is
+            # still the client error below.
+            mnt = max(1, min(mnt, self.max_total - len(prompt)))
+        if len(prompt) + mnt > self.max_total:
+            raise PromptTooLong(
+                f"prompt + max_new_tokens = {len(prompt) + mnt} exceeds "
+                f"the replica context ({self.max_total} = min(model "
+                f"seq_len, {cfg.max_pages_per_request} pages × "
+                f"{cfg.page_size}))"
+            )
+        try:
+            faults.inject("serving.admission")
+        except faults.InjectedFault:
+            self._count_shed("fault")
+            raise Shed("injected admission fault", cfg.shed_retry_after_s)
+        now = time.time()
+        deadline = now + float(deadline_s or cfg.default_deadline_s)
+        if deadline <= now:
+            self._count_shed("deadline")
+            raise Shed("deadline already expired", cfg.shed_retry_after_s)
+        with self._lock:
+            if len(self._queue) >= cfg.max_queue_depth:
+                self._count_shed("queue_full")
+                raise Shed(
+                    f"queue full ({cfg.max_queue_depth})",
+                    cfg.shed_retry_after_s,
+                )
+            self._counter += 1
+            req = Request(
+                request_id=f"req-{self._counter}",
+                prompt=prompt,
+                max_new_tokens=mnt,
+                deadline=deadline,
+                temperature=float(temperature),
+                trace=trace,
+                t_submit=now,
+            )
+            self._queue.append(req)
+            QUEUE_DEPTH.set(len(self._queue))
+        self._wake.set()
+        return req
+
+    def _count_shed(self, reason: str) -> None:
+        SHED.labels(reason).inc()
+        REQUESTS.labels("shed").inc()
+        with self._stats_lock:
+            self._shed_count += 1
+
+    # -- engine loop --------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                progressed = False
+                for _ in range(max(1, self.cfg.max_prefills_per_iter)):
+                    admitted = self._admit()
+                    if not admitted:
+                        break
+                    self._prefill(admitted)
+                    progressed = True
+                if any(r is not None for r in self._slots):
+                    self._decode_iter()
+                    progressed = True
+                if not progressed:
+                    self._wake.wait(timeout=0.02)
+                    self._wake.clear()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.exception("serving engine iteration failed")
+                self._recover()
+                time.sleep(0.1)  # resilience-ok: crash-loop damper, not a remote retry
+
+    def _recover(self) -> None:
+        """A REAL (non-injected) prefill/decode failure must behave like
+        the injected serving.decode drill: evict the in-flight requests,
+        return their pages, and close their client streams with an error
+        event. Without this the crash leaks slots+pages forever and the
+        affected clients hang to their stream timeout."""
+        import jax.numpy as jnp
+
+        DECODE_FAILURES.inc()
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            self._slots[i] = None
+            if req.pages:
+                self.pool.free(req.pages)
+                req.pages = []
+            req.finish_reason = "error"
+            REQUESTS.labels("error").inc()
+            req.events.put(
+                ("error", "engine iteration failed; partial stream, "
+                 "pages freed")
+            )
+        BATCH_OCCUPANCY.set(0)
+        if self.cache_k.is_deleted() or self.cache_v.is_deleted():
+            # A jit that raises AFTER consuming its donated inputs leaves
+            # the pool buffers invalidated; rebuild them — evicting
+            # everyone above made the contents disposable.
+            c = self.model.config
+            self.cache_k = jnp.zeros(
+                (c.n_layers, self.cfg.num_pages, self.cfg.page_size,
+                 c.n_heads, c.head_dim), c.dtype,
+            )
+            self.cache_v = jnp.zeros_like(self.cache_k)
+
+    def _free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self._slots) if r is None]
+
+    def _pack_fits(self, lens: List[int], new_len: int) -> bool:
+        """True when `new_len` joins `lens` in ONE emitted prefill batch
+        (the shared first_fit_layout mirror of pack_sequences)."""
+        return first_fit_layout(
+            lens + [new_len], self.cfg.prefill_seq, self.cfg.prefill_rows
+        ) is not None
+
+    def _admit(self) -> List[Request]:
+        """Move queue heads into free slots for ONE packed prefill batch.
+        Stops at slot/pack/page capacity; expired deadlines shed here."""
+        admitted: List[Request] = []
+        occupied_before = sum(1 for r in self._slots if r is not None)
+        while True:
+            with self._lock:
+                if not self._queue:
+                    break
+                req = self._queue[0]
+            free = self._free_slots()
+            if len(free) <= len(admitted):
+                break
+            if time.time() > req.deadline:
+                with self._lock:
+                    self._queue.popleft()
+                    QUEUE_DEPTH.set(len(self._queue))
+                self._count_shed("deadline")
+                req.events.put(("error", "deadline expired in queue"))
+                continue
+            if not self._pack_fits(
+                [len(a.prompt) for a in admitted], len(req.prompt)
+            ):
+                break
+            need = self.pool.pages_for(
+                len(req.prompt) + req.max_new_tokens, self.cfg.page_size
+            )
+            try:
+                pages = self.pool.alloc(need)
+            except PoolExhausted:
+                if not admitted and occupied_before == 0:
+                    # Nothing in flight will ever free pages: shed rather
+                    # than wedge the queue head forever (the fault-driven
+                    # exhaustion drill lands here deterministically).
+                    with self._lock:
+                        self._queue.popleft()
+                        QUEUE_DEPTH.set(len(self._queue))
+                    self._count_shed("pages")
+                    req.events.put(
+                        ("error", "page pool exhausted; retry later")
+                    )
+                    continue
+                break  # pages free when an in-flight request finishes
+            with self._lock:
+                self._queue.popleft()
+                QUEUE_DEPTH.set(len(self._queue))
+            req.pages = pages
+            req.t_admit = time.time()
+            slot = free[len(admitted)]
+            req.slot = slot
+            self._slots[slot] = req
+            admitted.append(req)
+            if occupied_before > 0:
+                BATCH_JOINS.inc()
+        return admitted
+
+    # -- prefill ------------------------------------------------------------
+    def _prefill(self, reqs: List[Request]) -> None:
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        # The ONE shared mirror of pack_sequences' first-fit gives each
+        # request its (row, start) coordinates; pack_sequences builds the
+        # actual arrays, and the layout-drift assert below keeps the
+        # mirror honest against it.
+        layout = first_fit_layout(
+            [len(r.prompt) for r in reqs], cfg.prefill_seq, cfg.prefill_rows
+        )
+        assert layout is not None, "admission sized the pack to one batch"
+        batches = list(pack_sequences(
+            [r.prompt for r in reqs], cfg.prefill_seq, cfg.prefill_rows,
+            overflow="error",
+        ))
+        assert len(batches) == 1, "admission sized the pack to one batch"
+        batch = batches[0]
+        tokens = batch["tokens"]
+        segs = batch["segment_ids"]
+        # per-token position within its own document, and each prompt
+        # token's destination (page, offset) in the pool — non-prompt
+        # positions scatter to the (segment-masked) scratch page 0.
+        positions = np.zeros_like(tokens)
+        dest_page = np.zeros(tokens.shape, np.int32)
+        dest_off = np.zeros(tokens.shape, np.int32)
+        for (row, start), req in zip(layout, reqs):
+            ln = len(req.prompt)
+            positions[row, start:start + ln] = np.arange(ln)
+            assert tokens[row, start] == req.prompt[0], "pack layout drift"
+            idx = np.arange(ln)
+            dest_page[row, start:start + ln] = np.asarray(
+                req.pages, np.int32
+            )[idx // cfg.page_size]
+            dest_off[row, start:start + ln] = idx % cfg.page_size
+        logits, k_l, v_l = self._prefill_fn(
+            self.params, jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(segs),
+        )
+        self.cache_k, self.cache_v = self._scatter_fn(
+            self.cache_k, self.cache_v, k_l, v_l,
+            jnp.asarray(dest_page.reshape(-1)),
+            jnp.asarray(dest_off.reshape(-1)),
+        )
+        logits = np.asarray(logits, np.float32)
+        now = time.time()
+        for (row, start), req in zip(layout, reqs):
+            ln = len(req.prompt)
+            req.length = ln
+            first = self._sample_host(logits[row, start + ln - 1], req)
+            req.last_token = first
+            req.tokens.append(first)
+            req.t_first_token = now
+            TTFT.observe(now - req.t_submit)
+            TOKENS.inc()
+            with self._stats_lock:
+                self._tokens_emitted += 1
+            req.events.put(("token", first))
+            # a 1-token request is complete at prefill
+            if len(req.tokens) >= req.max_new_tokens or (
+                self.cfg.eos_id >= 0 and first == self.cfg.eos_id
+            ):
+                self._finish(req, "length" if len(req.tokens)
+                             >= req.max_new_tokens else "eos")
+        BATCH_OCCUPANCY.set(sum(1 for r in self._slots if r is not None))
+
+    def _sample_host(self, logits: np.ndarray, req: Request) -> int:
+        if req.temperature <= 0:
+            return int(np.argmax(logits))
+        z = logits / req.temperature
+        z = z - z.max()
+        p = np.exp(z) / np.exp(z).sum()
+        return int(self._rng.choice(len(p), p=p))
+
+    # -- decode -------------------------------------------------------------
+    def _decode_iter(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        try:
+            faults.inject("serving.decode")
+        except faults.InjectedFault:
+            DECODE_FAILURES.inc()
+            for i, req in enumerate(self._slots):
+                if req is None:
+                    continue
+                self._slots[i] = None
+                self.pool.free(req.pages)
+                req.finish_reason = "error"
+                REQUESTS.labels("error").inc()
+                req.events.put(
+                    ("error", "decode step failed; partial stream, "
+                     "pages freed")
+                )
+            BATCH_OCCUPANCY.set(0)
+            return
+        b = cfg.max_batch_size
+        last = np.zeros((b,), np.int32)
+        lengths = np.zeros((b,), np.int32)
+        active = np.zeros((b,), bool)
+        temps = np.zeros((b,), np.float32)
+        pt = np.zeros((b, cfg.max_pages_per_request), np.int32)
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            last[i] = req.last_token
+            lengths[i] = req.length
+            active[i] = True
+            temps[i] = req.temperature
+            pt[i, : len(req.pages)] = req.pages
+        self._iter_count += 1
+        key = jax.random.PRNGKey(self._iter_count)
+        nxt, self.cache_k, self.cache_v = self._decode_fn(
+            self.params, jnp.asarray(last), jnp.asarray(lengths),
+            jnp.asarray(active), self.cache_k, self.cache_v,
+            jnp.asarray(pt), jnp.asarray(temps), key,
+        )
+        nxt = np.asarray(nxt)
+        DECODE_ITERATIONS.inc()
+        now = time.time()
+        for i, req in enumerate(list(self._slots)):
+            if req is None:
+                continue
+            tok = int(nxt[i])
+            req.length += 1          # the processed token entered the cache
+            req.last_token = tok
+            req.tokens.append(tok)
+            TOKENS.inc()
+            with self._stats_lock:
+                self._tokens_emitted += 1
+            req.events.put(("token", tok))
+            if cfg.eos_id >= 0 and tok == cfg.eos_id:
+                self._finish(req, "eos")
+            elif len(req.tokens) >= req.max_new_tokens:
+                self._finish(req, "length")
+            elif req.length + 1 >= self.max_total:
+                self._finish(req, "length")
+            elif now > req.deadline:
+                self._finish(req, "deadline")
+        BATCH_OCCUPANCY.set(sum(1 for r in self._slots if r is not None))
+
+    def _finish(self, req: Request, reason: str) -> None:
+        """Request leaves the batch between iterations: pages return to
+        the pool immediately (an early finisher frees capacity while its
+        batch-mates keep decoding), spans and counters are emitted, and
+        the terminal event closes the client stream."""
+        self._slots[req.slot] = None
+        self.pool.free(req.pages)
+        req.pages = []
+        req.finish_reason = reason
+        req.t_done = time.time()
+        outcome = "ok" if reason in ("length", "eos") else reason
+        REQUESTS.labels(outcome).inc()
+        E2E.observe(req.t_done - req.t_submit)
+        with self._stats_lock:
+            self._done_count += 1
+        self._emit_spans(req)
+        req.events.put(("done", {
+            "reason": reason,
+            "request_id": req.request_id,
+            "prompt_tokens": len(req.prompt),
+            "generated": len(req.tokens),
+            "ttft_ms": round((req.t_first_token - req.t_submit) * 1e3, 3),
+            "total_ms": round((req.t_done - req.t_submit) * 1e3, 3),
+        }))
+
+    def _emit_spans(self, req: Request) -> None:
+        """Per-request W3C spans: submit → queue → prefill → first token →
+        done, parented to the submitting client's traceparent."""
+        trace_id = req.trace[0] if req.trace else trace_mod.new_trace_id()
+        parent = req.trace[1] if req.trace else None
+        root = trace_mod.new_span_id()
+        trace_mod.export_span(
+            "serving.request", trace_id=trace_id, span_id=root,
+            parent_span_id=parent, start=req.t_submit, end=req.t_done,
+            attributes={
+                "serving.request_id": req.request_id,
+                "serving.reason": req.finish_reason,
+                "serving.prompt_tokens": len(req.prompt),
+                "serving.generated": len(req.tokens),
+            },
+            error=req.finish_reason not in ("length", "eos"),
+        )
+        for name, start, end in (
+            ("serving.queue", req.t_submit, req.t_admit),
+            ("serving.prefill", req.t_admit, req.t_first_token),
+            ("serving.decode", req.t_first_token, req.t_done),
+        ):
+            if end >= start > 0:
+                trace_mod.export_span(
+                    name, trace_id=trace_id, span_id=trace_mod.new_span_id(),
+                    parent_span_id=root, start=start, end=end,
+                )
+
+    # -- introspection ------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            queued = len(self._queue)
+        with self._stats_lock:
+            done = self._done_count
+            shed = self._shed_count
+            emitted = self._tokens_emitted
+        return {
+            "queued": queued,
+            "active": sum(1 for r in self._slots if r is not None),
+            "done": done,
+            "shed": shed,
+            "tokens_emitted": emitted,
+            "pages_in_use": self.pool.pages_in_use,
+            "pages_free": self.pool.free_pages,
+            "decode_backend": self._decode_backend,
+            "max_batch_size": self.cfg.max_batch_size,
+            "max_context": self.max_total,
+        }

@@ -1,0 +1,109 @@
+"""Paged KV cache: a preallocated pool of fixed-size pages.
+
+The vLLM idea mapped onto this repo's primitives: the engine owns two
+device arrays ``[L, num_pages, page_size, H, Dh]`` (K and V) allocated
+ONCE at startup, and every request's context lives in pages borrowed from
+that pool via a host-side free-list. Admission reserves a request's full
+page budget (ceil((prompt + max_new) / page_size)) up front, so decode
+never allocates mid-flight and a request can never strand half its
+context; completion returns the pages in O(1). Because the pool and the
+per-slot page-table width are fixed, every decode step has identical
+shapes — requests joining and leaving the batch never recompile anything.
+
+Page 0 is the scratch page: inactive batch slots write their (masked)
+K/V there so the decode scatter stays unconditional.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List
+
+from determined_tpu.common import faults
+from determined_tpu.common.metrics import REGISTRY as METRICS
+
+PAGES_IN_USE = METRICS.gauge(
+    "dtpu_serving_pages_in_use",
+    "KV-cache pages currently allocated to live requests.",
+)
+PAGE_ALLOC_FAILURES = METRICS.counter(
+    "dtpu_serving_page_alloc_failures_total",
+    "Page allocations refused (pool exhausted or injected fault).",
+)
+
+
+class PoolExhausted(Exception):
+    """The page pool cannot satisfy an allocation right now.
+
+    Admission maps this to a shed with Retry-After — pages free as soon
+    as any in-flight request finishes, so the condition is transient.
+    """
+
+    def __init__(self, wanted: int, free: int) -> None:
+        super().__init__(
+            f"page pool exhausted: wanted {wanted} pages, {free} free"
+        )
+        self.wanted = wanted
+        self.free = free
+
+
+class PagePool:
+    """Host-side free-list allocator over page ids 1..num_pages-1.
+
+    Thread-safe (the HTTP handlers' admission path and the engine loop
+    both touch it). The device arrays themselves live in the engine; this
+    class only tracks ownership.
+    """
+
+    def __init__(self, num_pages: int) -> None:
+        if num_pages < 2:
+            raise ValueError(
+                "num_pages must be >= 2 (page 0 is the scratch page)"
+            )
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(1, num_pages))
+        self._lock = threading.Lock()
+        PAGES_IN_USE.set(0)
+
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return (self.num_pages - 1) - self.free_pages
+
+    def alloc(self, n: int) -> List[int]:
+        """Take `n` pages or raise PoolExhausted (all-or-nothing — a
+        request must never hold a partial context). Instrumented fault
+        site ``serving.page_alloc``: an injected fault IS an exhaustion,
+        so chaos drills exercise the shed path deterministically."""
+        if n < 1:
+            raise ValueError(f"page allocation must be >= 1, got {n}")
+        try:
+            faults.inject("serving.page_alloc")
+        except faults.InjectedFault:
+            PAGE_ALLOC_FAILURES.inc()
+            raise PoolExhausted(n, self.free_pages) from None
+        with self._lock:
+            if n > len(self._free):
+                PAGE_ALLOC_FAILURES.inc()
+                raise PoolExhausted(n, len(self._free))
+            taken = self._free[:n]
+            del self._free[:n]
+            PAGES_IN_USE.set((self.num_pages - 1) - len(self._free))
+            return taken
+
+    def free(self, pages: List[int]) -> None:
+        with self._lock:
+            for p in pages:
+                if not 1 <= p < self.num_pages:
+                    raise ValueError(f"page {p} is not a pool page")
+                if p in self._free:
+                    raise ValueError(f"double free of page {p}")
+            self._free.extend(pages)
+            PAGES_IN_USE.set((self.num_pages - 1) - len(self._free))
+
+    def pages_for(self, total_tokens: int, page_size: int) -> int:
+        """Pages a context of `total_tokens` needs (the admission math)."""
+        return -(-max(1, total_tokens) // page_size)

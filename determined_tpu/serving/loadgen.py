@@ -1,0 +1,184 @@
+"""Serving load driver: concurrent streaming requests + SLO accounting.
+
+Fires N streaming generate requests at `concurrency` against a service
+URL (directly, or through the master proxy — the URL decides) and records
+per-request TTFT and token timing. The aggregate report carries the two
+numbers the serving bench rung publishes next to the training MFU rungs:
+``serving_tokens_per_sec`` and ``p99_ttft_ms``. The devcluster drills
+reuse it to assert mid-flight batch composition changes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import requests
+
+
+@dataclasses.dataclass
+class RequestTrace:
+    ok: bool = False
+    shed: bool = False
+    error: str = ""
+    status: int = 0
+    tokens: int = 0
+    t_start: float = 0.0
+    t_first_token: float = 0.0
+    t_done: float = 0.0
+
+    @property
+    def ttft_ms(self) -> float:
+        return (self.t_first_token - self.t_start) * 1e3
+
+
+@dataclasses.dataclass
+class LoadReport:
+    traces: List[RequestTrace]
+    wall_s: float
+
+    @property
+    def completed(self) -> int:
+        return sum(1 for t in self.traces if t.ok)
+
+    @property
+    def shed(self) -> int:
+        return sum(1 for t in self.traces if t.shed)
+
+    @property
+    def total_tokens(self) -> int:
+        return sum(t.tokens for t in self.traces)
+
+    @property
+    def tokens_per_sec(self) -> float:
+        return self.total_tokens / self.wall_s if self.wall_s > 0 else 0.0
+
+    def ttft_percentile_ms(self, pct: float) -> float:
+        samples = sorted(
+            t.ttft_ms for t in self.traces if t.ok and t.t_first_token > 0
+        )
+        if not samples:
+            return float("nan")
+        idx = min(len(samples) - 1, int(round(pct / 100.0 * (len(samples) - 1))))
+        return samples[idx]
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "requests": len(self.traces),
+            "completed": self.completed,
+            "shed": self.shed,
+            "serving_tokens_per_sec": round(self.tokens_per_sec, 2),
+            "p50_ttft_ms": round(self.ttft_percentile_ms(50), 3),
+            "p99_ttft_ms": round(self.ttft_percentile_ms(99), 3),
+            "total_tokens": self.total_tokens,
+            "wall_s": round(self.wall_s, 3),
+        }
+
+
+def _iter_sse_lines(resp):
+    """Lines from a streaming response WITHOUT requests' iter_lines
+    buffering: iter_lines waits for a full chunk_size of bytes, which on
+    a close-delimited SSE body delays every event (and falsifies TTFT);
+    read1 yields whatever has arrived."""
+    read1 = getattr(resp.raw, "read1", None)
+    buf = b""
+    while True:
+        chunk = read1(65536) if read1 is not None else resp.raw.read(1)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            yield line.decode("utf-8", "replace").rstrip("\r")
+    if buf:
+        yield buf.decode("utf-8", "replace")
+
+
+def _read_sse(resp, trace: RequestTrace) -> None:
+    """Consume one SSE generate stream, stamping first-token time when the
+    first `event: token` block arrives."""
+    event = ""
+    for line in _iter_sse_lines(resp):
+        if line.startswith("event: "):
+            event = line[len("event: "):]
+            continue
+        if not line.startswith("data: "):
+            continue
+        payload = json.loads(line[len("data: "):])
+        if event == "token":
+            if trace.tokens == 0:
+                trace.t_first_token = time.time()
+            trace.tokens += 1
+        elif event == "done":
+            trace.ok = True
+            return
+        elif event == "error":
+            trace.error = str(payload.get("error", "stream error"))
+            return
+
+
+def drive(
+    url: str,
+    n_requests: int,
+    concurrency: int,
+    *,
+    prompt_len: int = 16,
+    max_new_tokens: int = 16,
+    deadline_ms: Optional[int] = None,
+    stagger_s: float = 0.0,
+    timeout_s: float = 300.0,
+) -> LoadReport:
+    """POST `n_requests` streaming generates at `concurrency` against
+    `url` (service root or master `/proxy/<task>` root). `stagger_s`
+    delays each worker's start — the drills use it to force late joins
+    into a non-empty batch."""
+    traces = [RequestTrace() for _ in range(n_requests)]
+    sem = threading.Semaphore(concurrency)
+
+    def one(i: int) -> None:
+        trace = traces[i]
+        body = {
+            "prompt": [(7 * i + j) % 200 + 1 for j in range(prompt_len)],
+            "max_new_tokens": max_new_tokens,
+            "stream": True,
+        }
+        if deadline_ms is not None:
+            body["deadline_ms"] = deadline_ms
+        with sem:
+            trace.t_start = time.time()
+            try:
+                resp = requests.post(
+                    f"{url}/api/v1/generate", json=body, stream=True,
+                    timeout=timeout_s,
+                )
+                trace.status = resp.status_code
+                if resp.status_code == 503:
+                    trace.shed = True
+                    resp.close()
+                    return
+                if resp.status_code != 200:
+                    trace.error = resp.text[:200]
+                    resp.close()
+                    return
+                try:
+                    _read_sse(resp, trace)
+                finally:
+                    resp.close()
+            except requests.RequestException as e:
+                trace.error = str(e)
+            finally:
+                trace.t_done = time.time()
+
+    t0 = time.time()
+    threads = []
+    for i in range(n_requests):
+        if stagger_s and i:
+            time.sleep(stagger_s)
+        t = threading.Thread(target=one, args=(i,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=timeout_s)
+    return LoadReport(traces=traces, wall_s=time.time() - t0)

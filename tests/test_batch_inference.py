@@ -290,6 +290,27 @@ class TestPackSequences:
         assert len(out) == 1
         assert out[0]["tokens"][0].tolist() == list(range(1, 17))
 
+    def test_pack_oversized_doc_overflow_error(self):
+        """overflow="error": an overlong doc raises the NAMED error (the
+        serving admission path relies on exactly this — a silently
+        truncated prompt would generate from the wrong context), and a
+        fitting doc stream is unaffected. The error carries the sizes,
+        and nothing is emitted for the offending batch."""
+        from determined_tpu.batch_inference import (
+            SequenceTooLongError,
+            pack_sequences,
+        )
+
+        with pytest.raises(SequenceTooLongError) as e:
+            list(pack_sequences(
+                [[1, 2], list(range(1, 100))], 16, 2, overflow="error"
+            ))
+        assert e.value.doc_len == 99 and e.value.seq_len == 16
+        ok = list(pack_sequences([[1, 2, 3]], 16, 2, overflow="error"))
+        assert ok[0]["tokens"][0].tolist()[:3] == [1, 2, 3]
+        with pytest.raises(ValueError):
+            list(pack_sequences([[1]], 16, 2, overflow="maybe"))
+
     def test_pack_drop_remainder(self):
         from determined_tpu.batch_inference import pack_sequences
 

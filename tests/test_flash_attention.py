@@ -421,6 +421,66 @@ def test_flash_kv_offset_decode_layout():
     )
 
 
+@pytest.mark.parametrize("s_k,off", [(128, 127), (256, 255), (192, 100)])
+def test_flash_single_token_decode_parity(s_k, off):
+    """q_len=1 (a sub-block query) with a large kv_offset — the exact
+    degenerate geometry the serving engine's decode step leans on (one
+    new token against a long paged cache, optionally with segment ids
+    trimming a dead tail). Checked against reference_attention on both
+    the CPU blockwise path and the Pallas kernel in interpret mode."""
+    from determined_tpu.ops.flash_attention import _flash_fwd_pallas
+
+    b, h, d = 2, 3, 16
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    q1 = jax.random.normal(kq, (b, 1, h, d))
+    k = jax.random.normal(kk, (b, s_k, h, d))
+    v = jax.random.normal(kv, (b, s_k, h, d))
+
+    # the row sits at absolute position `off`: it attends keys [0, off]
+    live = off + 1
+    got = flash_attention(
+        q1, k, v, causal=True, kv_offset=off, block_q=1, block_k=32
+    )
+    want = reference_attention(q1[:, :1], k[:, :live], v[:, :live],
+                               causal=False)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+    # segment ids trimming a dead tail shorter than the causal reach —
+    # the paged-decode mask shape (cache rows past `length` are garbage)
+    length = live - 16
+    qseg = jnp.ones((b, 1), jnp.int32)
+    kseg = (jnp.arange(s_k)[None, :] < length).astype(jnp.int32)
+    kseg = jnp.broadcast_to(kseg, (b, s_k))
+    got_seg = flash_attention(
+        q1, k, v, causal=True, kv_offset=off, block_q=1, block_k=32,
+        segment_ids=qseg, kv_segment_ids=kseg,
+    )
+    want_seg = reference_attention(
+        q1[:, :1], k[:, :length], v[:, :length], causal=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_seg), np.asarray(want_seg), atol=2e-5, rtol=2e-5
+    )
+
+    # the Pallas kernel itself (interpret mode; the blocked grid, since
+    # kv_offset != 0 never takes the mono path)
+    scale = 1.0 / d ** 0.5
+    qf = q1.transpose(0, 2, 1, 3).reshape(b * h, 1, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s_k, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s_k, d)
+    o_pl, _ = _flash_fwd_pallas(
+        qf, kf, vf, scale=scale, causal=True, kv_offset=off,
+        block_q=1, block_k=32, interpret=True,
+    )
+    wf = want.transpose(0, 2, 1, 3).reshape(b * h, 1, d)
+    np.testing.assert_allclose(
+        np.asarray(o_pl), np.asarray(wf), atol=2e-5, rtol=2e-5
+    )
+
+
 def test_flash_window_validation():
     q, k, v = _rand_qkv(jax.random.PRNGKey(0), 1, 64, 1, 8)
     with pytest.raises(ValueError):

@@ -120,6 +120,88 @@ class TestEveryRouteObserved:
         ) >= 1
 
 
+class TestServingRoutesObserved:
+    """The serving replica's HTTP surface keeps the master's discipline:
+    every route observed in the request histogram AND a span, via the one
+    instrumented dispatch path. The SSE generate route is observed at
+    stream START by design (stream lifetime is generation time)."""
+
+    def test_serving_histogram_and_span_cover_all_routes(
+        self, tmp_path, monkeypatch
+    ):
+        from determined_tpu.serving.service import (
+            GenerationServer,
+            build_serving_routes,
+        )
+        from tests.test_serving import make_engine
+
+        trace_path = tmp_path / "serving-spans.jsonl"
+        monkeypatch.setenv("DTPU_TRACE_FILE", str(trace_path))
+        engine = make_engine()
+        engine.start()
+        server = GenerationServer(engine)
+        server.start()
+        routes = build_serving_routes(engine)
+        try:
+            for method, pattern, _handler in routes:
+                path = pattern.pattern[1:-1]
+                assert "(" not in path, (
+                    f"serving route {pattern.pattern} grew a capture "
+                    "group — extend this sweep to exercise it"
+                )
+                kw = {"timeout": 120}
+                if method == "POST":
+                    kw["json"] = {"prompt": [1, 2], "max_new_tokens": 1}
+                # stream=True + close right away: SSE routes return
+                # headers at stream start, where they are observed.
+                resp = requests.request(
+                    method, f"{server.url}{path}", stream=True, **kw
+                )
+                resp.close()
+
+            def unobserved_routes():
+                text = requests.get(f"{server.url}/metrics", timeout=30).text
+                samples = parse_exposition(text)
+                return [
+                    f"{method} {pattern.pattern}"
+                    for method, pattern, _h in routes
+                    if not sample_value(
+                        samples,
+                        "dtpu_serving_api_request_duration_seconds_count",
+                        method=method, route=pattern.pattern,
+                    )
+                ]
+
+            # the loop's last hit observes in the handler's finally, which
+            # can still be running when we scrape — poll briefly
+            import time
+
+            deadline = time.time() + 10
+            unobserved = unobserved_routes()
+            while unobserved and time.time() < deadline:
+                time.sleep(0.1)
+                unobserved = unobserved_routes()
+        finally:
+            server.stop()
+            engine.stop()
+
+        assert not unobserved, (
+            "serving routes with no request-latency observation:\n"
+            + "\n".join(unobserved)
+        )
+        span_names = {
+            json.loads(line)["name"] for line in open(trace_path)
+        }
+        unspanned = [
+            f"{method} {pattern.pattern}"
+            for method, pattern, _h in routes
+            if f"http {method} {pattern.pattern}" not in span_names
+        ]
+        assert not unspanned, (
+            "serving routes with no request span:\n" + "\n".join(unspanned)
+        )
+
+
 class TestNameDiscipline:
     def test_all_registered_names_are_dtpu_prefixed(self):
         # Importing the instrumented modules populates the registry.
@@ -129,6 +211,9 @@ class TestNameDiscipline:
         import determined_tpu.master.core  # noqa: F401
         import determined_tpu.master.logsink  # noqa: F401
         import determined_tpu.master.rm  # noqa: F401
+        import determined_tpu.serving.engine  # noqa: F401
+        import determined_tpu.serving.kv_cache  # noqa: F401
+        import determined_tpu.serving.service  # noqa: F401
 
         offenders = [
             n for n in REGISTRY.names() if not n.startswith("dtpu_")
