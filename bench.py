@@ -570,10 +570,12 @@ def serving_rung(on_tpu: bool):
     """Serving bench rung: the continuous-batching generation service
     under a concurrent streaming load (loadgen through its own HTTP
     surface), recording served tokens/sec and p99 TTFT next to the
-    training MFU rungs. On TPU the decode step is the Pallas flash
-    kernel's kv_offset path (the engine records which backend ran —
-    the record asserts the rung measured the kernel, not the
-    reference fallback)."""
+    training MFU rungs. On TPU the decode step is the in-kernel
+    PAGED-attention path (K/V read straight out of the page pool; the
+    headline tokens/sec number is the paged kernel's) — the record
+    names which path ran (`serving_decode_path`) and publishes a
+    paged-vs-gather per-iteration decode latency comparison measured
+    on the SAME pool state at full context utilization."""
     try:
         from determined_tpu.models import gpt as gpt_mod
         from determined_tpu.serving import GenerationEngine, ServingConfig
@@ -619,7 +621,25 @@ def serving_rung(on_tpu: bool):
         out = {f"serving_{k}" if not k.startswith("serving") else k: v
                for k, v in report.summary().items()}
         out["serving_decode_backend"] = engine.stats()["decode_backend"]
+        out["serving_decode_path"] = engine.stats()["decode_kernel"]
         out["serving_concurrency"] = conc
+        # Paged-vs-gather: per-iteration decode latency over the SAME
+        # pool state (full batch at max context utilization — where the
+        # gather path pays a whole-window HBM round-trip per token). The
+        # engine is stopped, so the compare owns the device.
+        try:
+            cmp_ = engine.decode_latency_compare(iters=5)
+            # Per-key: on a lane-misaligned TPU pool the compare
+            # deliberately returns gather alone — publish what ran.
+            for kern in ("paged", "gather"):
+                if f"decode_iter_ms_{kern}" in cmp_:
+                    out[f"serving_decode_iter_ms_{kern}"] = round(
+                        cmp_[f"decode_iter_ms_{kern}"], 3
+                    )
+        except Exception:  # noqa: BLE001 — comparison is additive info
+            import traceback
+
+            traceback.print_exc()
         return out
     except Exception:  # noqa: BLE001 — skip the rung, keep the headline
         import traceback

@@ -611,6 +611,14 @@ FIELDS: List[Tuple[str, str, str, str]] = [
     ("serving.eos_id", "int", "-1",
      "End-of-sequence token id; negative means generation stops only at "
      "max_new_tokens / deadline / context."),
+    ("serving.decode_kernel", "string", "auto",
+     "Decode attention kernel: `auto` runs the in-kernel paged-attention "
+     "path on TPU (K/V read straight from the page pool; no per-token "
+     "gather round-trip) and the `gather` fallback elsewhere; `paged` "
+     "demands the paged kernel (page_size must be a multiple of the 128 "
+     "lane granule); `gather` reproduces the contiguous-K/V behavior "
+     "everywhere. DTPU_PAGED_ATTN=0 is the runtime kill switch. See "
+     "docs/serving.md 'Paged attention'."),
     ("environment.variables", "object", "{}",
      "Extra environment variables for the task process."),
     ("environment.jax_platform", "string", "",

@@ -962,6 +962,9 @@ class GPT(Model):
         page_table: jax.Array,
         *,
         q_pad: int = 1,
+        kernel: str = "gather",
+        block_h: Optional[int] = None,
+        interpret: bool = False,
     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
         """One iteration-level decode step over the paged KV cache.
 
@@ -977,15 +980,32 @@ class GPT(Model):
         static in (B, P, pool geometry): requests joining/leaving the
         batch between iterations never trigger a recompile.
 
-        Masking: causal + kv_offset puts the single real query row at the
-        last key position (the kernels' bottom-aligned decode geometry —
-        the kv_offset path, never the mono fallback); segment ids trim
-        each row's dead cache tail, and inactive rows carry a q-segment
-        matching nothing (they write to the scratch page and read zeros).
-        `q_pad` pads the query block up to a lane-friendly row count on
-        TPU (rows past 0 attend real keys but their output is dropped).
+        Two kernels, one contract (`kernel`):
+
+        - ``"paged"`` — ops/paged_attention.py reads K/V straight out of
+          the pool through the page table (scalar-prefetch index_map);
+          the bottom-aligned masking and dead-tail trimming live inside
+          the kernel, and NO contiguous [B, S_max, H, Dh] buffer ever
+          materializes. `block_h` (heads per grid step) comes from
+          ops/flash_autotune.tune_paged_block_h; `interpret` runs the
+          kernel in Pallas interpret mode (the CPU parity/test path).
+        - ``"gather"`` — the fallback: gather each slot's pages into a
+          contiguous K/V and run the flash kernel at causal +
+          ``kv_offset = S_max − 1`` (the bottom-aligned short-q
+          geometry) with segment ids trimming each row's dead cache
+          tail; inactive rows carry a q-segment matching nothing.
+
+        Both write the processed token's K/V at its position first
+        (inactive rows route to the scratch page so the scatter stays
+        unconditional), and `q_pad` pads the query block to a
+        lane-friendly row count on TPU (rows past 0 are dropped).
         """
         c = self.config
+        if kernel not in ("paged", "gather"):
+            raise ValueError(
+                f"decode_kv kernel must be 'paged' or 'gather', "
+                f"got {kernel!r}"
+            )
         n_layers, _n_pages, page_size, h, hd = cache_k.shape
         b = last_tokens.shape[0]
         s_max = page_table.shape[1] * page_size
@@ -999,20 +1019,23 @@ class GPT(Model):
         widx = page_table[jnp.arange(b), lengths // page_size]
         widx = jnp.where(active, widx, 0)
         woff = lengths % page_size
-        kv_pos = jnp.arange(s_max)[None, :]
-        kv_seg = (
-            (kv_pos <= lengths[:, None]) & active[:, None]
-        ).astype(jnp.int32)  # [B, S_max]: live cache rows incl. this token
         qpad = max(1, int(q_pad))
-        # q row 0 matches live keys (id 1); inactive slots and pad rows get
-        # ids that match nothing on the kv side (never 0 — padding is 0).
-        q_seg = jnp.where(active, 1, 2).astype(jnp.int32)[:, None]
-        if qpad > 1:
-            q_seg = jnp.concatenate(
-                [q_seg, jnp.full((b, qpad - 1), 2, jnp.int32)], axis=1
-            )
-        bq = fit_block(qpad, 128)
-        bk = fit_block(s_max, c.flash_block_k)
+        if kernel == "gather":
+            kv_pos = jnp.arange(s_max)[None, :]
+            kv_seg = (
+                (kv_pos <= lengths[:, None]) & active[:, None]
+            ).astype(jnp.int32)  # [B, S_max]: live cache rows incl. token
+            # q row 0 matches live keys (id 1); inactive slots and pad
+            # rows get ids matching nothing kv-side (never 0 — pad is 0).
+            q_seg = jnp.where(active, 1, 2).astype(jnp.int32)[:, None]
+            if qpad > 1:
+                q_seg = jnp.concatenate(
+                    [q_seg, jnp.full((b, qpad - 1), 2, jnp.int32)], axis=1
+                )
+            bq = fit_block(qpad, 128)
+            bk = fit_block(s_max, c.flash_block_k)
+        else:
+            from determined_tpu.ops.paged_attention import paged_attention
         for i in range(n_layers):
             blk = jax.tree_util.tree_map(lambda a, i=i: a[i], params["blocks"])
             hn = _layernorm(x, blk["ln1_scale"], blk["ln1_bias"])
@@ -1023,17 +1046,26 @@ class GPT(Model):
             q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
             cache_k = cache_k.at[i, widx, woff].set(k_new[:, 0])
             cache_v = cache_v.at[i, widx, woff].set(v_new[:, 0])
-            k_full = cache_k[i][page_table].reshape(b, s_max, h, hd)
-            v_full = cache_v[i][page_table].reshape(b, s_max, h, hd)
             if qpad > 1:
                 q = jnp.concatenate(
                     [q, jnp.zeros((b, qpad - 1, h, hd), q.dtype)], axis=1
                 )
-            o = flash_attention(
-                q, k_full, v_full, causal=True, kv_offset=s_max - 1,
-                segment_ids=q_seg, kv_segment_ids=kv_seg,
-                block_q=bq, block_k=bk,
-            )[:, :1]
+            if kernel == "paged":
+                # K/V stay in the pool: the kernel DMAs each slot's live
+                # pages through the page table (dead pages cost neither
+                # DMA nor compute) and masks the length boundary inside.
+                o = paged_attention(
+                    q, cache_k[i], cache_v[i], page_table, lengths,
+                    active, block_h=block_h, interpret=interpret,
+                )[:, :1]
+            else:
+                k_full = cache_k[i][page_table].reshape(b, s_max, h, hd)
+                v_full = cache_v[i][page_table].reshape(b, s_max, h, hd)
+                o = flash_attention(
+                    q, k_full, v_full, causal=True, kv_offset=s_max - 1,
+                    segment_ids=q_seg, kv_segment_ids=kv_seg,
+                    block_q=bq, block_k=bk,
+                )[:, :1]
             o = jnp.einsum("bshk,hkd->bsd", o, blk["wo"].astype(c.dtype))
             x = x + o + blk["bo"].astype(c.dtype)
             x, _aux = self._mlp_half(x, blk, manual=False)

@@ -5,10 +5,12 @@ from determined_tpu.ops.flash_attention import (
     flash_attention,
     flash_attention_lse,
 )
+from determined_tpu.ops.paged_attention import paged_attention
 
 __all__ = [
     "block_skip_stats",
     "fit_block",
     "flash_attention",
     "flash_attention_lse",
+    "paged_attention",
 ]

@@ -181,6 +181,137 @@ def _probe_ms(bq: int, bk: int, *, s_q: int, s_k: int, n_heads: int,
         return float("inf")
 
 
+def _probe_paged_ms(block_h: int, *, n_heads: int, head_dim: int,
+                    page_size: int, num_pages: int, pages_per_slot: int,
+                    batch: int, q_rows: int, dtype) -> float:
+    """Best-of-N wall ms of one jitted paged-attention decode step at
+    `block_h` heads per grid step; inf on compile/OOM failure."""
+    try:
+        import functools
+
+        from determined_tpu.ops.paged_attention import paged_attention
+
+        keys = jax.random.split(jax.random.PRNGKey(0), 3)
+        # Probe on a REDUCED pool: per-step cost depends on the pages a
+        # slot actually reads (page_size × pages_per_slot × batch), not
+        # on total pool residency — and the engine calls this AFTER its
+        # real pools are allocated, so probing at the full num_pages
+        # would double peak HBM (and OOM exactly the headroom-sized
+        # pools the tuner matters for).
+        probe_pages = min(num_pages, batch * pages_per_slot + 1)
+        kp = jax.random.normal(
+            keys[0], (probe_pages, page_size, n_heads, head_dim), dtype
+        )
+        vp = jax.random.normal(
+            keys[1], (probe_pages, page_size, n_heads, head_dim), dtype
+        )
+        q = jax.random.normal(
+            keys[2], (batch, q_rows, n_heads, head_dim), dtype
+        )
+        # High-occupancy state: the regime the kernel exists for.
+        pt = (
+            jnp.arange(batch * pages_per_slot, dtype=jnp.int32)
+            % max(probe_pages - 1, 1) + 1
+        ).reshape(batch, pages_per_slot)
+        lengths = jnp.full((batch,), pages_per_slot * page_size - 1,
+                           jnp.int32)
+        active = jnp.ones((batch,), jnp.int32)
+        step = jax.jit(functools.partial(paged_attention, block_h=block_h))
+        for _ in range(_PROBE_WARMUP):
+            jax.block_until_ready(step(q, kp, vp, pt, lengths, active))
+        best = float("inf")
+        for _ in range(_PROBE_STEPS):
+            t0 = time.perf_counter()
+            jax.block_until_ready(step(q, kp, vp, pt, lengths, active))
+            best = min(best, time.perf_counter() - t0)
+        return best * 1e3
+    except Exception:  # noqa: BLE001 - losing candidate, not an error
+        logger.debug("paged probe block_h=%d failed", block_h, exc_info=True)
+        return float("inf")
+
+
+def tune_paged_block_h(
+    *,
+    n_heads: int,
+    head_dim: int,
+    page_size: int,
+    num_pages: int,
+    pages_per_slot: int,
+    batch: int,
+    q_rows: int = 1,
+    dtype=jnp.bfloat16,
+    cache_file: Optional[str] = None,
+) -> int:
+    """Resolve `block_h` (heads per grid step) for the paged decode
+    kernel — the paged analog of `tune_flash_blocks`. The kernel's K
+    block is pinned to one pool page, so the head grouping is the live
+    tile knob: more heads per step amortize each page's DMA across heads
+    at the cost of VMEM residency.
+
+    Call OUTSIDE jit. Off-TPU (or with DTPU_FLASH_AUTOTUNE=0) returns
+    the deterministic VMEM-budget fallback; on TPU the winner is probed
+    once and cached, keyed by the FULL pool geometry (page_size ×
+    num_pages × pages_per_slot × batch × heads/dim/q_rows/dtype) — a
+    resized pool re-probes by construction.
+    """
+    from determined_tpu.ops.paged_attention import default_paged_block_h
+
+    fallback = default_paged_block_h(n_heads, head_dim, page_size, dtype)
+    if os.environ.get("DTPU_FLASH_AUTOTUNE", "1") == "0":
+        return fallback
+    if jax.default_backend() != "tpu":
+        return fallback
+
+    path = cache_file or cache_path()
+    key = "|".join([
+        f"v{CACHE_VERSION}",
+        "paged",
+        jax.devices()[0].device_kind,
+        f"jax{jax.__version__}",
+        f"b{batch}h{n_heads}d{head_dim}q{q_rows}",
+        f"ps{page_size}np{num_pages}pp{pages_per_slot}",
+        jnp.dtype(dtype).name,
+    ])
+    cache = _load_cache(path)
+    hit = cache.get(key)
+    if isinstance(hit, int) and hit >= 1:
+        return hit
+    from determined_tpu.ops.paged_attention import paged_block_h_fits
+
+    # Divisors of H whose resident K+V page group fits the kernel's VMEM
+    # budget — candidates past it can never win, and each would cost a
+    # full Pallas compile just to fail to inf. The fallback is always in
+    # the set by construction (it is chosen through the same predicate).
+    cands = [
+        h for h in range(1, n_heads + 1)
+        if n_heads % h == 0
+        and paged_block_h_fits(h, head_dim, page_size, dtype)
+    ] or [fallback]
+    timings = {
+        h: _probe_paged_ms(
+            h, n_heads=n_heads, head_dim=head_dim, page_size=page_size,
+            num_pages=num_pages, pages_per_slot=pages_per_slot,
+            batch=batch, q_rows=q_rows, dtype=dtype,
+        )
+        for h in cands
+    }
+    best = min(timings, key=timings.get)
+    if timings[best] == float("inf"):
+        logger.warning(
+            "paged autotune %s: all %d probes failed; using fallback %d "
+            "(not cached)", key, len(cands), fallback,
+        )
+        return fallback
+    logger.info(
+        "paged autotune %s -> block_h %d (%.2f ms; %d candidates)",
+        key, best, timings[best], len(cands),
+    )
+    cache = _load_cache(path)
+    cache[key] = int(best)
+    _store_cache(path, cache)
+    return best
+
+
 def tune_flash_blocks(
     *,
     s_q: int,

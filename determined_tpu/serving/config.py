@@ -11,8 +11,11 @@ so `experiment create` rejects bad serving configs with named errors.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 from typing import Any, Dict, List
+
+logger = logging.getLogger("determined_tpu.serving")
 
 #: Keys accepted in a config's `serving:` section. This set is the ONE
 #: source of truth: master/expconf.py validates `serving:` by calling
@@ -32,9 +35,20 @@ KNOWN_SERVING_KEYS = {
     "shed_retry_after_s",
     "max_prefills_per_iter",
     "eos_id",
+    "decode_kernel",
 }
 
 KNOWN_MODELS = ("tiny", "small", "medium")
+
+KNOWN_DECODE_KERNELS = ("auto", "paged", "gather")
+
+#: The paged decode kernel DMAs K/V pages as ``(page_size, head_dim)``
+#: MXU tiles with the page dimension lane-tiled — the same 128 granule
+#: ``ops.flash_attention.fit_block`` prefers for flash ``block_k``.
+#: Mirrored from ``ops.paged_attention.LANE_GRANULE`` (kept as a plain
+#: constant here so config validation never imports jax; a unit test
+#: pins the two equal).
+PAGE_LANE_GRANULE = 128
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,6 +91,13 @@ class ServingConfig:
     max_prefills_per_iter: int = 1
     #: end-of-sequence token id (negative = never stop on a token).
     eos_id: int = -1
+    #: decode attention kernel: `auto` runs the in-kernel paged-attention
+    #: path on TPU and the gather fallback elsewhere; `paged` demands the
+    #: paged kernel (lane-aligned page_size required); `gather`
+    #: reproduces the pre-paged behavior everywhere. The DTPU_PAGED_ATTN
+    #: env var overrides at engine build (0 = kill switch to gather,
+    #: 1 = force paged, interpret mode off-TPU).
+    decode_kernel: str = "auto"
 
     @property
     def max_context(self) -> int:
@@ -126,6 +147,26 @@ def validate_serving(d: Any) -> List[str]:
     eos = d.get("eos_id")
     if eos is not None and (not isinstance(eos, int) or isinstance(eos, bool)):
         errors.append("serving.eos_id must be an int (negative disables)")
+    kernel = d.get("decode_kernel", "auto")
+    if kernel not in KNOWN_DECODE_KERNELS:
+        errors.append(
+            f"serving.decode_kernel {kernel!r} unknown "
+            f"(one of {sorted(KNOWN_DECODE_KERNELS)})"
+        )
+    page_size = d.get("page_size", 128)
+    if (
+        kernel == "paged"
+        and isinstance(page_size, int) and page_size >= 1
+        and page_size % PAGE_LANE_GRANULE
+    ):
+        # Caught HERE, at config time with the geometry named — not as a
+        # Mosaic shape crash in the middle of a decode iteration.
+        errors.append(
+            f"serving.page_size ({page_size}) must be a multiple of the "
+            f"flash block_k lane granule ({PAGE_LANE_GRANULE}) for "
+            "decode_kernel: paged — use a lane-aligned page_size or "
+            "decode_kernel: gather"
+        )
     # Cross-field geometry: admission relies on these invariants.
     num_pages = d.get("num_pages", 65)
     per_req = d.get("max_pages_per_request", 8)
@@ -141,5 +182,22 @@ def validate_serving(d: Any) -> List[str]:
         errors.append(
             "serving.num_pages must be >= 2 (page 0 is reserved as the "
             "scratch page)"
+        )
+    # Advisory, not an error (a deliberately oversubscribed pool is a
+    # valid way to run — admission sheds): warn when a FULL batch of
+    # max-context requests cannot hold pages simultaneously, i.e.
+    # num_pages - 1 < max_batch_size × ceil(max_context / page_size).
+    batch = d.get("max_batch_size", 8)
+    if (
+        not errors
+        and isinstance(num_pages, int) and isinstance(per_req, int)
+        and isinstance(batch, int)
+        and num_pages - 1 < batch * per_req
+    ):
+        logger.warning(
+            "serving: pool of %d allocatable pages cannot admit a full "
+            "batch (%d slots x %d pages/request = %d); requests will be "
+            "queued or shed under load",
+            num_pages - 1, batch, per_req, batch * per_req,
         )
     return errors

@@ -29,6 +29,8 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+import math
+import os
 import queue as queue_mod
 import threading
 import time
@@ -81,6 +83,21 @@ QUEUE_DEPTH = METRICS.gauge(
 BATCH_OCCUPANCY = METRICS.gauge(
     "dtpu_serving_batch_occupancy", "Active decode-batch slots.",
 )
+KV_PAGES_READ = METRICS.counter(
+    "dtpu_serving_kv_pages_read_total",
+    "KV-cache pages decode iterations actually read. Paged kernel: live "
+    "pages summed over active slots (dead page-table tails cost neither "
+    "DMA nor compute). Gather fallback: the full page window every "
+    "iteration — the contiguous-buffer round-trip the paged kernel "
+    "removes; the two rates differ by exactly the win.",
+)
+DECODE_ITER_LATENCY = METRICS.histogram(
+    "dtpu_serving_decode_iteration_seconds",
+    "Decode-iteration wall latency by kernel path (paged = in-kernel "
+    "page-table attention, gather = contiguous-K/V fallback) — the "
+    "paged-vs-gather win, live on /metrics.",
+    labels=("path",),
+)
 TTFT = METRICS.histogram(
     "dtpu_serving_ttft_seconds",
     "Submit-to-first-token latency (the serving SLO; p99 via buckets).",
@@ -116,20 +133,23 @@ def first_fit_layout(lens, seq_len, rows_cap):
     return layout
 
 
-def _scatter_kv(cache_k, cache_v, k_l, v_l, pages, offs):
+def _scatter_kv(cache_k, cache_v, k_l, v_l, src_idx, dst_pages):
     """Move a whole prefill batch's K/V into the paged pool in ONE
-    in-place (donated) update. k_l/v_l are [L, B, S, H, Dh] from
-    prefill_kv; pages/offs are flat [B*S] destination coordinates with
-    every non-prompt position routed to scratch page 0 (whose contents
-    are only ever read under a segment mask). Eager per-request
-    ``.at[].set()`` would copy the full pool twice per admitted request."""
+    in-place (donated) PAGE-GRANULAR update. k_l/v_l are [L, B, S, H, Dh]
+    from prefill_kv; src_idx [P, page_size] holds flat token coordinates
+    into the packed [B·S] batch per destination page, dst_pages [P] the
+    pool page each lands on. Admission touches exactly the pages the
+    admitted requests own (padding rows target scratch page 0, whose
+    contents are never read live) — the page-identity invariant the
+    in-kernel paged decode and future prefix caching rely on. Eager
+    per-request ``.at[].set()`` would copy the full pool twice per
+    admitted request; per-token scatter coordinates would write every
+    non-prompt position of the packed batch into the scratch page."""
     n_layers, _, _, n_heads, head_dim = k_l.shape
-    cache_k = cache_k.at[:, pages, offs].set(
-        k_l.reshape(n_layers, -1, n_heads, head_dim)
-    )
-    cache_v = cache_v.at[:, pages, offs].set(
-        v_l.reshape(n_layers, -1, n_heads, head_dim)
-    )
+    flat_k = k_l.reshape(n_layers, -1, n_heads, head_dim)
+    flat_v = v_l.reshape(n_layers, -1, n_heads, head_dim)
+    cache_k = cache_k.at[:, dst_pages].set(flat_k[:, src_idx])
+    cache_v = cache_v.at[:, dst_pages].set(flat_v[:, src_idx])
     return cache_k, cache_v
 
 
@@ -221,6 +241,10 @@ class GenerationEngine:
         import jax
         import jax.numpy as jnp
 
+        # Deferred like every jax import in this module: serving.engine
+        # is imported by master-side processes that never run a kernel.
+        from determined_tpu.ops.paged_attention import LANE_GRANULE
+
         self.model = model
         self.params = params
         self.cfg = config
@@ -243,8 +267,75 @@ class GenerationEngine:
         self._q_pad = 8 if jax.default_backend() == "tpu" else 1
         self._prefill_fn = jax.jit(model.prefill_kv)
         self._scatter_fn = jax.jit(_scatter_kv, donate_argnums=(0, 1))
+        #: static page-granular prefill budget: every admitted doc spans
+        #: ceil(len/page_size) ≤ tokens/page_size + 1 pages, so one packed
+        #: batch touches at most rows·seq/page_size + docs pages (docs ≤
+        #: batch slots). Padding entries write scratch page 0.
+        self._prefill_pages_max = (
+            config.prefill_rows
+            * math.ceil(config.prefill_seq / config.page_size)
+            + config.max_batch_size
+        )
+        # -- decode kernel resolution (done ONCE, outside jit) -----------
+        # serving.decode_kernel: auto → paged on TPU, gather elsewhere;
+        # paged → paged on TPU, gather off-TPU (the CPU backend always
+        # auto-selects gather); gather → gather. DTPU_PAGED_ATTN
+        # overrides: 0 = kill switch back to the PR-6 gather behavior,
+        # 1 = force paged (Pallas interpret mode off-TPU — the CPU
+        # parity/test hook).
+        on_tpu = jax.default_backend() == "tpu"
+        env = os.environ.get("DTPU_PAGED_ATTN", "")
+        if env == "0":
+            self._decode_kernel = "gather"
+        elif env == "1":
+            self._decode_kernel = "paged"
+        elif config.decode_kernel == "gather":
+            self._decode_kernel = "gather"
+        else:  # "auto" and "paged" both follow the backend
+            self._decode_kernel = "paged" if on_tpu else "gather"
+            if config.decode_kernel == "paged" and not on_tpu:
+                logger.info(
+                    "serving.decode_kernel=paged on a %s backend: "
+                    "auto-selecting the gather fallback (DTPU_PAGED_ATTN=1 "
+                    "forces the paged kernel in interpret mode)",
+                    jax.default_backend(),
+                )
+        self._paged_interpret = self._decode_kernel == "paged" and not on_tpu
+        if (
+            self._decode_kernel == "paged"
+            and not self._paged_interpret
+            and config.page_size % LANE_GRANULE
+        ):
+            # Config validation names this for an EXPLICIT `paged`; an
+            # `auto` (or env-forced) resolution onto a misaligned pool
+            # must degrade to the gather fallback, not crash-loop the
+            # replica at its first decode iteration.
+            logger.warning(
+                "serving: page_size %d is not a multiple of the %d lane "
+                "granule; paged decode kernel unavailable — falling back "
+                "to the gather path",
+                config.page_size, LANE_GRANULE,
+            )
+            self._decode_kernel = "gather"
+        self._paged_block_h = None
+        if self._decode_kernel == "paged":
+            from determined_tpu.ops.flash_autotune import tune_paged_block_h
+
+            # Heads-per-step sizing comes from the autotuner (pool
+            # geometry in its cache key), never a literal at a call site.
+            self._paged_block_h = tune_paged_block_h(
+                n_heads=c.n_heads, head_dim=c.head_dim,
+                page_size=config.page_size, num_pages=config.num_pages,
+                pages_per_slot=config.max_pages_per_request,
+                batch=config.max_batch_size, q_rows=self._q_pad,
+                dtype=c.dtype,
+            )
         self._decode_fn = jax.jit(
-            functools.partial(self._decode_step, q_pad=self._q_pad),
+            functools.partial(
+                self._decode_step, q_pad=self._q_pad,
+                kernel=self._decode_kernel, block_h=self._paged_block_h,
+                interpret=self._paged_interpret,
+            ),
             donate_argnums=(4, 5),
         )
         self._queue: deque = deque()
@@ -264,17 +355,20 @@ class GenerationEngine:
         self._shed_count = 0
         self._tokens_emitted = 0
         self._decode_backend = (
-            "pallas" if jax.default_backend() == "tpu" else "reference"
+            "pallas" if on_tpu
+            else ("interpret" if self._paged_interpret else "reference")
         )
 
     # -- jitted decode ------------------------------------------------------
     def _decode_step(self, params, last, lengths, active, ck, cv, pt,
-                     temps, key, *, q_pad):
+                     temps, key, *, q_pad, kernel="gather", block_h=None,
+                     interpret=False):
         import jax
         import jax.numpy as jnp
 
         logits, ck, cv = self.model.decode_kv(
             params, last, lengths, active, ck, cv, pt, q_pad=q_pad,
+            kernel=kernel, block_h=block_h, interpret=interpret,
         )
         greedy = jnp.argmax(logits, axis=-1)
         sampled = jax.random.categorical(
@@ -531,29 +625,36 @@ class GenerationEngine:
         batch = batches[0]
         tokens = batch["tokens"]
         segs = batch["segment_ids"]
-        # per-token position within its own document, and each prompt
-        # token's destination (page, offset) in the pool — non-prompt
-        # positions scatter to the (segment-masked) scratch page 0.
+        # Per-token position within its own document, plus PAGE-GRANULAR
+        # scatter coordinates: one (source token window, destination
+        # page) pair per pool page the admitted prompts own. A partial
+        # last page clamps its source tail onto the doc's final token —
+        # those dest positions sit past the slot's live length and are
+        # masked by both decode kernels. Unused entries (src 0 → dst
+        # scratch page 0) keep the shapes static.
+        ps = cfg.page_size
+        seq = tokens.shape[1]
         positions = np.zeros_like(tokens)
-        dest_page = np.zeros(tokens.shape, np.int32)
-        dest_off = np.zeros(tokens.shape, np.int32)
+        src_idx = np.zeros((self._prefill_pages_max, ps), np.int32)
+        dst_pages = np.zeros((self._prefill_pages_max,), np.int32)
+        slot_i = 0
         for (row, start), req in zip(layout, reqs):
             ln = len(req.prompt)
             positions[row, start:start + ln] = np.arange(ln)
             assert tokens[row, start] == req.prompt[0], "pack layout drift"
-            idx = np.arange(ln)
-            dest_page[row, start:start + ln] = np.asarray(
-                req.pages, np.int32
-            )[idx // cfg.page_size]
-            dest_off[row, start:start + ln] = idx % cfg.page_size
+            for pi in range(-(-ln // ps)):
+                idx = start + pi * ps + np.arange(ps)
+                src_idx[slot_i] = row * seq + np.minimum(idx, start + ln - 1)
+                dst_pages[slot_i] = req.pages[pi]
+                slot_i += 1
+        assert slot_i <= self._prefill_pages_max, "prefill page budget"
         logits, k_l, v_l = self._prefill_fn(
             self.params, jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(segs),
         )
         self.cache_k, self.cache_v = self._scatter_fn(
             self.cache_k, self.cache_v, k_l, v_l,
-            jnp.asarray(dest_page.reshape(-1)),
-            jnp.asarray(dest_off.reshape(-1)),
+            jnp.asarray(src_idx), jnp.asarray(dst_pages),
         )
         logits = np.asarray(logits, np.float32)
         now = time.time()
@@ -590,6 +691,8 @@ class GenerationEngine:
         import jax
         import jax.numpy as jnp
 
+        from determined_tpu.ops.paged_attention import paged_pages_read
+
         cfg = self.cfg
         try:
             faults.inject("serving.decode")
@@ -624,12 +727,27 @@ class GenerationEngine:
             pt[i, : len(req.pages)] = req.pages
         self._iter_count += 1
         key = jax.random.PRNGKey(self._iter_count)
+        t_iter = time.monotonic()
         nxt, self.cache_k, self.cache_v = self._decode_fn(
             self.params, jnp.asarray(last), jnp.asarray(lengths),
             jnp.asarray(active), self.cache_k, self.cache_v,
             jnp.asarray(pt), jnp.asarray(temps), key,
         )
-        nxt = np.asarray(nxt)
+        nxt = np.asarray(nxt)  # blocks until the device step is done
+        DECODE_ITER_LATENCY.labels(self._decode_kernel).observe(
+            time.monotonic() - t_iter
+        )
+        # Pages this iteration actually read. Paged: the host mirror of
+        # the kernel's liveness predicate (dead page-table tails are
+        # free). Gather: the full window materializes every iteration —
+        # the counter rates differ by exactly the round-trip the paged
+        # kernel removes.
+        if self._decode_kernel == "paged":
+            KV_PAGES_READ.inc(
+                paged_pages_read(lengths, active, cfg.page_size)
+            )
+        else:
+            KV_PAGES_READ.inc(len(lengths) * cfg.max_pages_per_request)
         DECODE_ITERATIONS.inc()
         now = time.time()
         for i, req in enumerate(list(self._slots)):
@@ -706,6 +824,64 @@ class GenerationEngine:
                     parent_span_id=root, start=start, end=end,
                 )
 
+    # -- bench support ------------------------------------------------------
+    def decode_latency_compare(self, iters: int = 5) -> Dict[str, float]:
+        """Per-iteration decode latency of BOTH kernel paths over the
+        SAME pool state (full batch at max context utilization — the
+        regime the paged kernel exists for). Runs on copies without
+        donation, so the live engine state is untouched; the bench
+        serving rung publishes the two numbers side by side. Call from
+        the engine's own thread or while the engine is stopped."""
+        import jax
+        import jax.numpy as jnp
+
+        from determined_tpu.ops.paged_attention import LANE_GRANULE
+
+        cfg = self.cfg
+        c = self.model.config
+        on_tpu = jax.default_backend() == "tpu"
+        # A lane-misaligned pool has no compilable paged kernel on TPU
+        # (the engine itself degraded to gather at build) — publish the
+        # gather numbers alone rather than crash the comparison.
+        kernels = (
+            ("gather",) if on_tpu and cfg.page_size % LANE_GRANULE
+            else ("paged", "gather")
+        )
+        b = cfg.max_batch_size
+        per = cfg.max_pages_per_request
+        # Distinct live pages per slot, wrapped over the allocatable pool
+        # (slots may share pages under oversubscription — harmless for a
+        # read-only timing probe).
+        pt = (
+            np.arange(b * per, dtype=np.int32) % (cfg.num_pages - 1) + 1
+        ).reshape(b, per)
+        s_max = per * cfg.page_size
+        lengths = np.full((b,), min(s_max, self.max_total) - 2, np.int32)
+        active = np.ones((b,), bool)
+        last = np.full((b,), 1, np.int32)
+        temps = np.zeros((b,), np.float32)
+        key = jax.random.PRNGKey(0)
+        out: Dict[str, float] = {"s_max": float(s_max), "batch": float(b)}
+        for kernel in kernels:
+            interpret = kernel == "paged" and not on_tpu
+            step = jax.jit(functools.partial(
+                self._decode_step, q_pad=self._q_pad, kernel=kernel,
+                block_h=self._paged_block_h, interpret=interpret,
+            ))
+            args = (
+                self.params, jnp.asarray(last), jnp.asarray(lengths),
+                jnp.asarray(active), self.cache_k, self.cache_v,
+                jnp.asarray(pt), jnp.asarray(temps), key,
+            )
+            jax.block_until_ready(step(*args))  # compile outside timing
+            best = float("inf")
+            for _ in range(max(1, iters)):
+                t0 = time.perf_counter()
+                jax.block_until_ready(step(*args))
+                best = min(best, time.perf_counter() - t0)
+            out[f"decode_iter_ms_{kernel}"] = best * 1e3
+        return out
+
     # -- introspection ------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -723,6 +899,7 @@ class GenerationEngine:
             "pages_in_use": self.pool.pages_in_use,
             "pages_free": self.pool.free_pages,
             "decode_backend": self._decode_backend,
+            "decode_kernel": self._decode_kernel,
             "max_batch_size": self.cfg.max_batch_size,
             "max_context": self.max_total,
         }

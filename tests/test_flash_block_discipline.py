@@ -10,8 +10,10 @@ fails the build on any new one.
 
 What counts as a violation: inside `determined_tpu/`, a call to any of
 the attention entry points (`flash_attention`, `flash_attention_lse`,
-`ring_attention`, `make_ring_attention`, `attention`) passing `block_q=`
-or `block_k=` as a numeric literal. Defaults in function SIGNATURES are
+`ring_attention`, `make_ring_attention`, `attention`,
+`paged_attention`) passing `block_q=`, `block_k=` or `block_h=` (the
+paged kernel's heads-per-step tile, owned by `tune_paged_block_h`) as a
+numeric literal. Defaults in function SIGNATURES are
 fine (they are the documented neutral fallback, still fitted at the call
 site); variables, attributes and `fit_block(...)` results pass by
 construction. Tests are not scanned. A deliberate exception carries a
@@ -29,7 +31,10 @@ ATTENTION_CALLEES = {
     "ring_attention",
     "make_ring_attention",
     "attention",
+    "paged_attention",
 }
+
+BLOCK_KWARGS = ("block_q", "block_k", "block_h")
 
 WAIVER = "# flash-block-ok:"
 
@@ -66,7 +71,7 @@ def _violations_in_file(path: str):
         if _callee_name(node) not in ATTENTION_CALLEES:
             continue
         for kw in node.keywords:
-            if kw.arg in ("block_q", "block_k") and _is_literal_number(
+            if kw.arg in BLOCK_KWARGS and _is_literal_number(
                 kw.value
             ):
                 line = lines[node.lineno - 1]
@@ -107,6 +112,13 @@ def test_lint_actually_detects_a_violation(tmp_path):
         "    return flash_attention(q, k, v, block_q=512, block_k=512)\n"
     )
     assert len(_violations_in_file(str(bad))) == 1
+
+    bad_paged = tmp_path / "bad_paged.py"
+    bad_paged.write_text(
+        "def f(q, kp, vp, pt, ln, act):\n"
+        "    return paged_attention(q, kp, vp, pt, ln, act, block_h=4)\n"
+    )
+    assert len(_violations_in_file(str(bad_paged))) == 1
 
     good = tmp_path / "good.py"
     good.write_text(

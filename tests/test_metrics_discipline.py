@@ -202,6 +202,43 @@ class TestServingRoutesObserved:
         )
 
 
+class TestPagedDecodeSeriesObserved:
+    """The paged-attention observability satellite: the kv-pages-read
+    counter and the path-labeled decode-iteration histogram must land on
+    the live /metrics surface of a serving replica (scraped over HTTP,
+    not just read in-process), with the active kernel path named."""
+
+    def test_decode_series_on_live_metrics_surface(self, monkeypatch):
+        from determined_tpu.serving.service import GenerationServer
+        from tests.test_serving import make_engine
+
+        monkeypatch.setenv("DTPU_PAGED_ATTN", "1")  # paged via interpret
+        engine = make_engine()
+        engine.start()
+        server = GenerationServer(engine)
+        server.start()
+        try:
+            resp = requests.post(
+                f"{server.url}/api/v1/generate",
+                json={"prompt": [1, 2, 3], "max_new_tokens": 4,
+                      "stream": False},
+                timeout=180,
+            )
+            assert resp.status_code == 200
+            text = requests.get(f"{server.url}/metrics", timeout=30).text
+        finally:
+            server.stop()
+            engine.stop()
+        samples = parse_exposition(text)
+        assert sample_value(samples, "dtpu_serving_kv_pages_read_total") > 0
+        assert sample_value(
+            samples, "dtpu_serving_decode_iteration_seconds_count",
+            path="paged",
+        ) >= 1
+        # stats surface names the active path for dashboards/bench
+        assert engine.stats()["decode_kernel"] == "paged"
+
+
 class TestNameDiscipline:
     def test_all_registered_names_are_dtpu_prefixed(self):
         # Importing the instrumented modules populates the registry.

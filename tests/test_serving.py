@@ -86,6 +86,182 @@ class TestServingConfig:
         })
 
 
+class TestDecodeKernelConfig:
+    def test_decode_kernel_values_validated(self):
+        with pytest.raises(ValueError, match="decode_kernel 'fast'"):
+            ServingConfig.from_dict({"decode_kernel": "fast"})
+        for v in ("auto", "paged", "gather"):
+            ServingConfig.from_dict({"decode_kernel": v})
+
+    def test_paged_demands_lane_aligned_page_size(self):
+        """The geometry error is named at CONFIG time — not a Mosaic
+        shape crash in the middle of a decode iteration."""
+        with pytest.raises(ValueError, match="lane granule"):
+            ServingConfig.from_dict(
+                {"decode_kernel": "paged", "page_size": 96}
+            )
+        # lane-aligned paged, and misaligned gather/auto, are all fine
+        ServingConfig.from_dict({"decode_kernel": "paged", "page_size": 256})
+        ServingConfig.from_dict({"decode_kernel": "gather", "page_size": 96})
+        ServingConfig.from_dict({"page_size": 96})
+
+    def test_undersized_pool_warns(self, caplog):
+        import logging
+
+        with caplog.at_level(logging.WARNING, "determined_tpu.serving"):
+            ServingConfig.from_dict(
+                {"num_pages": 17, "max_pages_per_request": 4,
+                 "max_batch_size": 8}
+            )
+        assert any(
+            "cannot admit a full batch" in r.message for r in caplog.records
+        ), caplog.records
+        caplog.clear()
+        with caplog.at_level(logging.WARNING, "determined_tpu.serving"):
+            ServingConfig.from_dict(
+                {"num_pages": 33, "max_pages_per_request": 4,
+                 "max_batch_size": 8}
+            )
+        assert not any(
+            "cannot admit a full batch" in r.message for r in caplog.records
+        )
+
+    def test_expconf_routes_decode_kernel(self):
+        from determined_tpu.master import expconf
+
+        errs = expconf.validate({
+            "entrypoint": "x",
+            "serving": {"decode_kernel": "paged", "page_size": 96},
+        })
+        assert any("lane granule" in e for e in errs)
+
+
+class TestPagedDecodePath:
+    """Engine-level paged-vs-gather parity: the paged kernel is forced
+    on CPU via DTPU_PAGED_ATTN=1 (Pallas interpret mode) so tier-1
+    exercises the exact decode path TPU replicas run by default."""
+
+    def _drive(self, eng, scenario):
+        """One late-join/early-free churn scenario; returns each
+        request's full token list."""
+        reqs = []
+        long_req = eng.submit([1, 2, 3, 4], max_new_tokens=24)
+        stream = long_req.stream(timeout=180)
+        kind, _ = next(stream)              # long req is mid-flight
+        assert kind == "token"
+        # late joiners change the batch composition (and the page
+        # table) while the long request keeps decoding
+        short = eng.submit([9, 8], max_new_tokens=3)
+        tiny = eng.submit([42], max_new_tokens=2)
+        assert short.result(timeout=180)["reason"] == "length"
+        assert tiny.result(timeout=180)["reason"] == "length"
+        # a follow-up admission reuses the freed (now shuffled) pages
+        late = eng.submit([7, 7, 2], max_new_tokens=4)
+        assert late.result(timeout=180)["reason"] == "length"
+        for kind, payload in stream:
+            pass
+        assert long_req.finish_reason == "length"
+        assert eng.pool.pages_in_use == 0
+        return {
+            "long": list(long_req.tokens), "short": list(short.tokens),
+            "tiny": list(tiny.tokens), "late": list(late.tokens),
+        }
+
+    def test_paged_matches_gather_through_churn(self, monkeypatch):
+        """The tentpole acceptance at engine level: identical greedy
+        token streams from both kernels across the SAME late-join/
+        early-free page-table churn, and greedy parity with the
+        full-context forward."""
+        monkeypatch.setenv("DTPU_PAGED_ATTN", "1")
+        eng_paged = make_engine()
+        assert eng_paged.stats()["decode_kernel"] == "paged"
+        assert eng_paged.stats()["decode_backend"] == "interpret"
+        eng_paged.start()
+        try:
+            paged = self._drive(eng_paged, "churn")
+            model, params = eng_paged.model, eng_paged.params
+        finally:
+            eng_paged.stop()
+        monkeypatch.setenv("DTPU_PAGED_ATTN", "0")
+        eng_gather = make_engine()
+        assert eng_gather.stats()["decode_kernel"] == "gather"
+        eng_gather.start()
+        try:
+            gather = self._drive(eng_gather, "churn")
+        finally:
+            eng_gather.stop()
+        assert paged == gather
+        assert_greedy(model, params, [1, 2, 3, 4], paged["long"])
+        assert_greedy(model, params, [7, 7, 2], paged["late"])
+
+    def test_kill_switch_restores_gather(self, monkeypatch):
+        """DTPU_PAGED_ATTN=0 beats even an explicit decode_kernel:
+        paged — the PR-6 behavior is one env var away."""
+        monkeypatch.setenv("DTPU_PAGED_ATTN", "0")
+        eng = make_engine(decode_kernel="paged", page_size=128,
+                          num_pages=9, max_pages_per_request=1,
+                          prefill_seq=32)
+        assert eng.stats()["decode_kernel"] == "gather"
+        assert eng.stats()["decode_backend"] == "reference"
+
+    def test_cpu_auto_selects_gather(self, monkeypatch):
+        """Off-TPU, both `auto` and an explicit `paged` config resolve
+        to the gather fallback (the paged kernel only engages where the
+        Pallas path compiles, or under the explicit interpret force).
+        Hermetic against an ambient DTPU_PAGED_ATTN (the env override
+        beats `auto` by design — e.g. a tier-1 run forcing the paged
+        interpret path suite-wide)."""
+        monkeypatch.delenv("DTPU_PAGED_ATTN", raising=False)
+        for kw in ({}, {"decode_kernel": "paged", "page_size": 128,
+                        "num_pages": 9, "max_pages_per_request": 1,
+                        "prefill_seq": 32}):
+            eng = make_engine(**kw)
+            assert eng.stats()["decode_kernel"] == "gather"
+
+    def test_auto_on_misaligned_pool_degrades_to_gather(self, monkeypatch):
+        """`auto` on TPU with a page_size that passes validation but
+        misses the lane granule must degrade to the gather path with a
+        warning — never crash-loop the replica at its first decode
+        iteration (the compiled paged kernel would refuse the shape)."""
+        import jax
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        eng = make_engine(page_size=24, num_pages=9,
+                          max_pages_per_request=2, prefill_seq=32)
+        assert eng.stats()["decode_kernel"] == "gather"
+
+    def test_paged_metrics_emitted(self, monkeypatch):
+        """The new observability series move under the paged path:
+        pages-read counts live pages only, and the decode-iteration
+        histogram files under the active kernel label."""
+        from determined_tpu.common.metrics import REGISTRY
+        from determined_tpu.serving.engine import KV_PAGES_READ
+
+        monkeypatch.setenv("DTPU_PAGED_ATTN", "1")
+        eng = make_engine()
+        pages_before = KV_PAGES_READ.value
+        hist = REGISTRY.get("dtpu_serving_decode_iteration_seconds")
+        count_before = hist.labels("paged")._count
+        eng.start()
+        try:
+            out = eng.submit([3, 1, 4, 1, 5], max_new_tokens=6).result(
+                timeout=180
+            )
+            assert out["reason"] == "length"
+        finally:
+            eng.stop()
+        # 5 decode iterations (first token comes from prefill), one
+        # slot, ≤ 1 live page each: 1 page per iteration
+        assert KV_PAGES_READ.value >= pages_before + 5
+        assert hist.labels("paged")._count >= count_before + 5
+
+    def test_decode_latency_compare_runs_both_paths(self):
+        eng = make_engine()
+        out = eng.decode_latency_compare(iters=1)
+        assert out["decode_iter_ms_paged"] > 0
+        assert out["decode_iter_ms_gather"] > 0
+
+
 class TestPagePool:
     def test_alloc_free_roundtrip(self):
         pool = PagePool(9)  # 8 allocatable
