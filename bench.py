@@ -648,6 +648,126 @@ def serving_rung(on_tpu: bool):
         return None
 
 
+def timeseries_rung():
+    """Time-series plane rung (PR 9): TSDB ingest throughput through the
+    strict parser (the real scrape path), query p99 latency at FULL
+    retention, and the scrape+alert cost amortized per 1 s master tick —
+    acceptance < 1% of tick time, same discipline as
+    timeline_overhead_pct. Pure control-plane CPU work: the numbers are
+    honest on any box."""
+    try:
+        import statistics
+
+        from determined_tpu.common.metrics import parse_exposition
+        from determined_tpu.common.tsdb import TSDB
+
+        # Synthetic target shaped like a real agent page: counter families
+        # with per-worker labels plus a histogram family.
+        lines = []
+        for f in range(20):
+            name = f"bench_fam{f}_total"
+            lines += [f"# HELP {name} h", f"# TYPE {name} counter"]
+            lines += [
+                f'{name}{{worker="{w}"}} {f * 31 + w}' for w in range(16)
+            ]
+        lines += ["# HELP bench_lat_seconds h",
+                  "# TYPE bench_lat_seconds histogram"]
+        for w in range(8):
+            for le, c in [("0.01", 5), ("0.1", 60), ("1", 95), ("+Inf", 100)]:
+                lines.append(
+                    f'bench_lat_seconds_bucket{{worker="{w}",le="{le}"}} {c}'
+                )
+            lines.append(f'bench_lat_seconds_sum{{worker="{w}"}} 9.5')
+            lines.append(f'bench_lat_seconds_count{{worker="{w}"}} 100')
+        text = "\n".join(lines) + "\n"
+        n_samples = len(parse_exposition(text))
+
+        out = {}
+        tsdb = TSDB(max_points_per_series=360, retention_s=1e12,
+                    min_step_s=0.0)
+        # Fill to FULL retention (every series ring at its 360-point cap)
+        # while timing parse+ingest — the whole scrape cost per target.
+        t0 = time.perf_counter()
+        for i in range(360):
+            tsdb.ingest("bench", parse_exposition(text), ts=1e6 + i * 10.0)
+        dt = time.perf_counter() - t0
+        out["tsdb_ingest_samples_per_sec"] = round(360 * n_samples / dt, 1)
+        assert tsdb.stats()["points"] == tsdb.stats()["series"] * 360
+
+        # Query p99 at full retention: the three verbs dashboards hit.
+        end = 1e6 + 359 * 10.0
+        lat = []
+        for i in range(210):
+            t0 = time.perf_counter()
+            if i % 3 == 0:
+                tsdb.rate("bench_fam7_total", window_s=600.0, at=end)
+            elif i % 3 == 1:
+                tsdb.quantile(0.99, "bench_lat_seconds",
+                              window_s=600.0, at=end)
+            else:
+                tsdb.query("bench_fam3_total", func="rate",
+                           window_s=300.0, start=end - 900.0, end=end,
+                           step=30.0)
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        out["tsdb_query_p99_ms"] = round(1e3 * lat[int(len(lat) * 0.99)], 3)
+
+        # Scrape + alert tick overhead on a REAL master with two live
+        # HTTP agent targets: per-sweep/eval cost amortized over their
+        # intervals, as a fraction of the 1 s maintenance tick.
+        from determined_tpu.agent.agent import AgentMetricsServer
+        from determined_tpu.master.core import Master
+
+        srv_a, srv_b = AgentMetricsServer(), AgentMetricsServer()
+        master = Master()
+        try:
+            master.scraper.interval_s = float("inf")  # timed by hand
+            master.alert_engine.interval_s = float("inf")
+            master.agent_registered(
+                "bench-a0", 1, "default",
+                metrics_addr=f"127.0.0.1:{srv_a.port}",
+            )
+            master.agent_registered(
+                "bench-a1", 1, "default",
+                metrics_addr=f"127.0.0.1:{srv_b.port}",
+            )
+            scrape_times, eval_times = [], []
+            for i in range(12):
+                t0 = time.perf_counter()
+                master.scraper.scrape_once()
+                scrape_times.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                master.alert_engine.evaluate()
+                eval_times.append(time.perf_counter() - t0)
+            # First iterations pay connection setup; medians are the
+            # steady state the tick actually sees.
+            from determined_tpu.master.masterconf import (
+                ALERTS_DEFAULTS,
+                METRICS_DEFAULTS,
+            )
+
+            per_tick = (
+                statistics.median(scrape_times)
+                / METRICS_DEFAULTS["scrape_interval_s"]
+                + statistics.median(eval_times)
+                / ALERTS_DEFAULTS["interval_s"]
+            )
+            out["tsdb_tick_overhead_pct"] = round(100.0 * per_tick / 1.0, 4)
+            out["tsdb_scrape_sweep_ms"] = round(
+                1e3 * statistics.median(scrape_times), 3
+            )
+        finally:
+            master.shutdown()
+            srv_a.stop()
+            srv_b.stop()
+        return out
+    except Exception:  # noqa: BLE001 — skip the rung, keep the headline
+        import traceback
+
+        traceback.print_exc()
+        return None
+
+
 def main() -> None:
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
@@ -812,6 +932,12 @@ def main() -> None:
         sr = serving_rung(on_tpu)
         if sr is not None:
             record.update(sr)
+    if not os.environ.get("DTPU_BENCH_SKIP_TSDB"):
+        # Time-series plane (PR 9): ingest throughput, query p99 at full
+        # retention, and scrape+alert overhead per master tick (<1%).
+        tr = timeseries_rung()
+        if tr is not None:
+            record.update(tr)
     print(json.dumps(record))
 
 

@@ -298,6 +298,12 @@ class AgentDaemon:
                 "agent_id": self.agent_id, "slots": self.slots,
                 "pool": self.pool, "running_allocs": running,
                 "exiting_allocs": exiting, "devices": self.devices,
+                # Scrape-target registration: the master's time-series
+                # plane scrapes this health port (the host side is the
+                # master's view of this connection's source address).
+                "metrics_port": (
+                    self.metrics.port if self.metrics is not None else None
+                ),
             },
         ) or {}
         orphaned = set(resp.get("orphaned") or [])

@@ -921,6 +921,87 @@ def master_logs(args: argparse.Namespace) -> None:
         time.sleep(2.0)
 
 
+# -- time-series plane (ref: the reference WebUI's cluster telemetry;
+# -- here `dtpu metrics query` / `dtpu alerts` over /api/v1/metrics/*) ---------
+def _fmt_labels(labels: Dict[str, Any]) -> str:
+    return (
+        "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+        if labels else ""
+    )
+
+
+def metrics_query_cmd(args: argparse.Namespace) -> None:
+    """`dtpu metrics query NAME [--func rate] [--match l=v] [--last 900]`
+    — instant vector by default; --last/--start makes it a range and
+    prints per-series point histories."""
+    params: Dict[str, Any] = {"name": args.name, "func": args.func,
+                              "window": str(args.window), "q": str(args.q)}
+    if args.match:
+        params["match"] = list(args.match)  # repeated query params
+    now = time.time()
+    if args.start is not None or args.last is not None:
+        params["start"] = str(
+            args.start if args.start is not None else now - args.last
+        )
+        params["end"] = str(args.end if args.end is not None else now)
+        if args.step is not None:
+            params["step"] = str(args.step)
+    elif args.end is not None:
+        params["end"] = str(args.end)  # instant evaluated at a past time
+    out = _session(args).get("/api/v1/metrics/query", params=params)
+    result = out.get("result", [])
+    if not result:
+        print("(no matching series)")
+        return
+    for s in result:
+        tag = f"{args.name}{_fmt_labels(s.get('labels', {}))}"
+        if "points" in s:
+            print(tag)
+            for ts, v in s["points"]:
+                stamp = time.strftime("%H:%M:%S", time.localtime(ts))
+                print(f"  {stamp}  {v:g}")
+        else:
+            print(f"{tag}  {s['value']:g}")
+
+
+def metrics_series_cmd(args: argparse.Namespace) -> None:
+    out = _session(args).get(
+        "/api/v1/metrics/series",
+        params={"name": args.name} if args.name else None,
+    )
+    for s in out.get("series", []):
+        print(f"{s['name']}{_fmt_labels(s.get('labels', {}))}")
+    st = out.get("stats", {})
+    print(
+        f"-- {st.get('series', 0)}/{st.get('max_series', 0)} series, "
+        f"{st.get('points', 0)} points, "
+        f"{st.get('dropped_series', 0)} dropped for cardinality"
+    )
+
+
+def alerts_list(args: argparse.Namespace) -> None:
+    out = _session(args).get("/api/v1/alerts")
+    alerts = out.get("alerts", [])
+    if not alerts:
+        print("no pending or firing alerts")
+    for a in alerts:
+        since = time.strftime(
+            "%H:%M:%S", time.localtime(a.get("since", 0))
+        )
+        print(
+            f"{a['state']:<8} {a['severity']:<8} {a['rule']}"
+            f"{_fmt_labels(a.get('labels', {}))} value={a['value']:g} "
+            f"since {since}"
+        )
+    if getattr(args, "history", False):
+        for a in out.get("history", []):
+            print(
+                f"resolved {a['severity']:<8} {a['rule']}"
+                f"{_fmt_labels(a.get('labels', {}))}"
+            )
+    print(f"rules loaded: {', '.join(out.get('rules', []))}")
+
+
 # -- job queue -----------------------------------------------------------------
 def queue_list(args: argparse.Namespace) -> None:
     queues = _session(args).get("/api/v1/queues")["queues"]
@@ -1284,6 +1365,35 @@ def build_parser() -> argparse.ArgumentParser:
     v = agent.add_parser("run")
     v.add_argument("rest", nargs=argparse.REMAINDER)
     v.set_defaults(fn=agent_run)
+
+    metrics = sub.add_parser("metrics").add_subparsers(
+        dest="verb", required=True)
+    v = metrics.add_parser("query")
+    v.add_argument("name", help="metric family, e.g. dtpu_api_requests_total")
+    v.add_argument("--func", default="instant",
+                   choices=["instant", "raw", "rate", "increase", "quantile"])
+    v.add_argument("--match", "-l", action="append",
+                   help="label=value series filter (repeatable)")
+    v.add_argument("--window", type=float, default=300.0,
+                   help="window seconds for rate/increase/quantile")
+    v.add_argument("--q", type=float, default=0.99,
+                   help="quantile (with --func quantile)")
+    v.add_argument("--last", type=float, default=None,
+                   help="range query over the last N seconds")
+    v.add_argument("--start", type=float, default=None,
+                   help="range start (unix seconds)")
+    v.add_argument("--end", type=float, default=None)
+    v.add_argument("--step", type=float, default=None)
+    v.set_defaults(fn=metrics_query_cmd)
+    v = metrics.add_parser("series")
+    v.add_argument("name", nargs="?", default=None,
+                   help="optional family filter")
+    v.set_defaults(fn=metrics_series_cmd)
+
+    alerts = sub.add_parser("alerts")
+    alerts.add_argument("--history", action="store_true",
+                        help="also print recently resolved alerts")
+    alerts.set_defaults(fn=alerts_list, verb="list")
 
     queue = sub.add_parser("queue", aliases=["q"]).add_subparsers(
         dest="verb", required=True)

@@ -558,3 +558,40 @@ def sample_value(
 ) -> Optional[float]:
     """Test helper: look up one series from parse_exposition output."""
     return samples.get((name, tuple(sorted(labels.items()))))
+
+
+def histogram_quantile(
+    q: float, buckets: Iterable[Tuple[float, float]]
+) -> float:
+    """Prometheus-style quantile estimate from cumulative `le` buckets.
+
+    `buckets` is (upper_bound, cumulative_count) pairs — the shape both the
+    TSDB query path and bench read off a Histogram family (+Inf included).
+    Linear interpolation inside the bucket the rank falls in, matching
+    promql's histogramQuantile: the first bucket interpolates from a lower
+    bound of 0 (latency histograms have no negative mass), and a rank that
+    lands in the +Inf bucket answers the highest FINITE bound — the
+    estimate saturates rather than inventing an unbounded value. Returns
+    NaN when there is no mass (or no finite bucket) to estimate from.
+    """
+    pts = sorted((float(le), float(c)) for le, c in buckets)
+    if not pts:
+        return math.nan
+    total = pts[-1][1]
+    if total <= 0 or math.isnan(total):
+        return math.nan
+    q = min(1.0, max(0.0, float(q)))
+    rank = q * total
+    prev_le, prev_c = 0.0, 0.0
+    for i, (le, c) in enumerate(pts):
+        if c >= rank:
+            if math.isinf(le):
+                finite = [b for b, _ in pts if not math.isinf(b)]
+                return finite[-1] if finite else math.nan
+            if le <= 0 and i == 0:
+                return le  # no defined lower edge below a <=0 bound
+            if c == prev_c:
+                return le
+            return prev_le + (le - prev_le) * (rank - prev_c) / (c - prev_c)
+        prev_le, prev_c = (le if not math.isinf(le) else prev_le), c
+    return pts[-1][0]

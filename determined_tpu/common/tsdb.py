@@ -1,0 +1,427 @@
+"""Bounded in-process time-series store for the master's metric history.
+
+The platform built a strict Prometheus surface (PR 4) and then silently
+assumed an external Prometheus would remember it. This module is the
+self-contained alternative the reference platform ships (WebUI cluster
+telemetry, historical charts): a ring-buffer TSDB the master feeds from
+its own scrapes and queries for the WebUI, the CLI, the alert engine and
+the load-harness judge.
+
+Memory is bounded BY CONSTRUCTION, not by hygiene:
+
+- every series is a ``deque(maxlen=max_points_per_series)`` — appending
+  past the cap drops the oldest point, no pruning pass required;
+- samples arriving faster than ``min_step_s`` OVERWRITE the newest point
+  instead of appending (scrape-storm downsampling: a tick misconfigured
+  to scrape every 10 ms still stores one point per step window);
+- at most ``max_series`` distinct series exist; samples for new series
+  beyond the cap are counted in ``dropped_series`` and dropped — a
+  label-cardinality explosion degrades coverage, never master memory;
+- points older than ``retention_s`` are trimmed from the head at ingest
+  and ignored at query time.
+
+Ingest takes ``parse_exposition`` output directly — the STRICT parser is
+the only wire format in or out of the metrics plane. Queries implement
+the PromQL verbs the platform actually dashboards on: instant vectors,
+raw ranges, ``rate``/``increase`` with counter-reset handling, and
+histogram-quantile estimation over bucket increments
+(`histogram_quantile(q, rate(x_bucket[w]))` semantics).
+
+Stdlib-only and jax-free: this runs inside the master process.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from bisect import bisect_left, bisect_right
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from determined_tpu.common.metrics import histogram_quantile
+
+#: (name, sorted ((label, value), ...)) — one stored series.
+SeriesKey = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+QUERY_FUNCS = ("raw", "instant", "rate", "increase", "quantile")
+
+
+class _Series:
+    __slots__ = ("points",)
+
+    def __init__(self, cap: int) -> None:
+        self.points: Deque[Tuple[float, float]] = deque(maxlen=cap)
+
+
+def _labels_dict(key: SeriesKey) -> Dict[str, str]:
+    return dict(key[1])
+
+
+def _window_slice(
+    pts: List[Tuple[float, float]], start: float, end: float
+) -> List[Tuple[float, float]]:
+    """Points with start <= ts <= end off an already-copied, ts-sorted
+    list — bisect, not a scan (range evaluation calls this per step)."""
+    lo = bisect_left(pts, (start, -math.inf))
+    hi = bisect_right(pts, (end, math.inf))
+    return pts[lo:hi]
+
+
+class TSDB:
+    def __init__(
+        self,
+        *,
+        max_points_per_series: int = 360,
+        retention_s: float = 3600.0,
+        min_step_s: float = 1.0,
+        max_series: int = 20000,
+        stale_after_s: float = 300.0,
+    ) -> None:
+        if max_points_per_series < 2:
+            raise ValueError("max_points_per_series must be >= 2")
+        if max_series < 1:
+            raise ValueError("max_series must be >= 1")
+        self.max_points_per_series = int(max_points_per_series)
+        self.retention_s = float(retention_s)
+        self.min_step_s = float(min_step_s)
+        self.max_series = int(max_series)
+        #: series whose newest sample is older than this answer no instant
+        #: query — a dead scrape target's series go stale instead of
+        #: reporting their last value forever.
+        self.stale_after_s = float(stale_after_s)
+        self.dropped_series = 0
+        self._series: Dict[SeriesKey, _Series] = {}
+        self._lock = threading.Lock()
+
+    # -- ingest ---------------------------------------------------------------
+    def ingest(
+        self,
+        instance: str,
+        samples: Dict[SeriesKey, float],
+        ts: Optional[float] = None,
+    ) -> int:
+        """Store one scrape of `instance` (parse_exposition output).
+
+        Every series gains an ``instance`` label so the same metric from
+        two agents stays two series. Returns the number of samples stored
+        (dropped-for-cardinality samples excluded)."""
+        now = time.time() if ts is None else float(ts)
+        cutoff = now - self.retention_s
+        stored = 0
+        with self._lock:
+            for (name, labels), value in samples.items():
+                if not isinstance(value, (int, float)) or math.isnan(value):
+                    continue
+                key = (
+                    name,
+                    tuple(sorted(dict(labels, instance=instance).items())),
+                )
+                series = self._series.get(key)
+                if series is None:
+                    if len(self._series) >= self.max_series:
+                        self.dropped_series += 1
+                        continue
+                    series = _Series(self.max_points_per_series)
+                    self._series[key] = series
+                pts = series.points
+                if pts and now - pts[-1][0] < self.min_step_s:
+                    # Downsample cap: a sample landing inside the minimum
+                    # step window replaces the newest point's VALUE (last
+                    # value wins — correct for counters and gauges alike)
+                    # while keeping its anchor timestamp, so a sustained
+                    # too-fast feed stores one point per step window
+                    # rather than one forever-sliding point.
+                    pts[-1] = (pts[-1][0], float(value))
+                else:
+                    pts.append((now, float(value)))
+                while pts and pts[0][0] < cutoff:
+                    pts.popleft()
+                stored += 1
+        return stored
+
+    def drop_instance(self, instance: str) -> int:
+        """Forget every series of a vanished scrape target (agent removed,
+        serving task exited): its history must not linger at full
+        retention on a long-lived master. Returns series dropped."""
+        with self._lock:
+            victims = [
+                k for k in self._series
+                if dict(k[1]).get("instance") == instance
+            ]
+            for k in victims:
+                del self._series[k]
+        return len(victims)
+
+    # -- selection ------------------------------------------------------------
+    def _select(
+        self, name: str, matchers: Optional[Dict[str, str]] = None
+    ) -> List[Tuple[SeriesKey, List[Tuple[float, float]]]]:
+        matchers = matchers or {}
+        out = []
+        with self._lock:
+            for key, series in self._series.items():
+                if key[0] != name:
+                    continue
+                labels = _labels_dict(key)
+                if any(labels.get(k) != v for k, v in matchers.items()):
+                    continue
+                out.append((key, list(series.points)))
+        return sorted(out, key=lambda kv: kv[0])
+
+    # -- queries --------------------------------------------------------------
+    def instant(
+        self,
+        name: str,
+        matchers: Optional[Dict[str, str]] = None,
+        at: Optional[float] = None,
+    ) -> List[Dict[str, Any]]:
+        """Newest value per matching series at `at` — series with no
+        sample inside the staleness window are excluded (a dead target's
+        series disappear from instant vectors rather than freezing)."""
+        now = time.time() if at is None else float(at)
+        out = []
+        for key, pts in self._select(name, matchers):
+            live = [(t, v) for t, v in pts if t <= now]
+            if not live or now - live[-1][0] > self.stale_after_s:
+                continue
+            out.append(
+                {"labels": _labels_dict(key), "ts": live[-1][0],
+                 "value": live[-1][1]}
+            )
+        return out
+
+    def range(
+        self,
+        name: str,
+        matchers: Optional[Dict[str, str]] = None,
+        start: float = 0.0,
+        end: Optional[float] = None,
+        step: Optional[float] = None,
+    ) -> List[Dict[str, Any]]:
+        """Raw stored points per series in [start, end]; `step` thins the
+        output to at most one point per step window (newest wins)."""
+        end = time.time() if end is None else float(end)
+        out = []
+        for key, pts in self._select(name, matchers):
+            window = [(t, v) for t, v in pts if start <= t <= end]
+            if step and step > 0 and window:
+                thinned: List[Tuple[float, float]] = []
+                for t, v in window:
+                    if thinned and t - thinned[-1][0] < step:
+                        thinned[-1] = (t, v)
+                    else:
+                        thinned.append((t, v))
+                window = thinned
+            out.append({"labels": _labels_dict(key), "points": window})
+        return out
+
+    @staticmethod
+    def _increase(pts: List[Tuple[float, float]]) -> Optional[Tuple[float, float]]:
+        """(total positive delta, elapsed) over consecutive points — the
+        counter-reset-safe increase (a restarted process re-reports from
+        0; the negative jump is a reset, not a decrement)."""
+        if len(pts) < 2:
+            return None
+        inc = 0.0
+        for (_, prev), (_, cur) in zip(pts, pts[1:]):
+            if cur >= prev:
+                inc += cur - prev
+            else:
+                inc += cur  # reset: the counter restarted from 0
+        return inc, pts[-1][0] - pts[0][0]
+
+    def rate(
+        self,
+        name: str,
+        matchers: Optional[Dict[str, str]] = None,
+        window_s: float = 300.0,
+        at: Optional[float] = None,
+        *,
+        as_increase: bool = False,
+    ) -> List[Dict[str, Any]]:
+        """Per-second rate (or total increase) per matching counter series
+        over (at - window_s, at]. Series with <2 points in the window
+        produce no result (promql semantics: a rate needs a delta)."""
+        now = time.time() if at is None else float(at)
+        out = []
+        for key, pts in self._select(name, matchers):
+            window = [(t, v) for t, v in pts if now - window_s <= t <= now]
+            got = self._increase(window)
+            if got is None:
+                continue
+            inc, elapsed = got
+            value = inc if as_increase else (
+                inc / elapsed if elapsed > 0 else 0.0
+            )
+            out.append(
+                {"labels": _labels_dict(key), "ts": now, "value": value}
+            )
+        return out
+
+    def quantile(
+        self,
+        q: float,
+        name: str,
+        matchers: Optional[Dict[str, str]] = None,
+        window_s: Optional[float] = 300.0,
+        at: Optional[float] = None,
+    ) -> List[Dict[str, Any]]:
+        """Quantile estimate per histogram group from `name`_bucket series.
+
+        With a window: quantile of the observations that ARRIVED in the
+        window (bucket increments — `histogram_quantile(q, rate(...))`).
+        window_s=None: the all-time cumulative distribution at `at`.
+        Groups are the bucket series' label sets minus `le`."""
+        now = time.time() if at is None else float(at)
+        groups: Dict[Tuple[Tuple[str, str], ...], List[Tuple[float, float]]] = {}
+        for key, pts in self._select(name + "_bucket", matchers):
+            labels = _labels_dict(key)
+            le_raw = labels.pop("le", None)
+            if le_raw is None:
+                continue
+            le = math.inf if le_raw == "+Inf" else float(le_raw)
+            if window_s is None:
+                live = [(t, v) for t, v in pts if t <= now]
+                if not live or now - live[-1][0] > self.stale_after_s:
+                    continue
+                count: Optional[float] = live[-1][1]
+            else:
+                window = [
+                    (t, v) for t, v in pts if now - window_s <= t <= now
+                ]
+                got = self._increase(window)
+                count = got[0] if got is not None else None
+            if count is None:
+                continue
+            groups.setdefault(tuple(sorted(labels.items())), []).append(
+                (le, count)
+            )
+        out = []
+        for labelkey, buckets in sorted(groups.items()):
+            value = histogram_quantile(q, buckets)
+            if math.isnan(value):
+                continue
+            out.append(
+                {"labels": dict(labelkey), "ts": now, "value": value}
+            )
+        return out
+
+    def query(
+        self,
+        name: str,
+        func: str = "instant",
+        matchers: Optional[Dict[str, str]] = None,
+        *,
+        window_s: float = 300.0,
+        q: float = 0.99,
+        start: Optional[float] = None,
+        end: Optional[float] = None,
+        step: Optional[float] = None,
+    ) -> List[Dict[str, Any]]:
+        """One entry point for the API layer: instant when no start is
+        given, else a range — rate/increase/quantile evaluate at each
+        step across [start, end] so sparklines get function history."""
+        if func not in QUERY_FUNCS:
+            raise ValueError(
+                f"unknown func {func!r} (one of: {', '.join(QUERY_FUNCS)})"
+            )
+        if start is None:
+            if func == "raw":
+                func = "instant"
+            if func == "instant":
+                return self.instant(name, matchers, at=end)
+            if func in ("rate", "increase"):
+                return self.rate(
+                    name, matchers, window_s, at=end,
+                    as_increase=(func == "increase"),
+                )
+            return self.quantile(q, name, matchers, window_s, at=end)
+        start = float(start)
+        end = time.time() if end is None else float(end)
+        if end < start:
+            raise ValueError("end must be >= start")
+        if func in ("raw", "instant"):
+            return self.range(name, matchers, start, end, step)
+        # Function-over-range: evaluate at each step point. The step count
+        # is capped so a hostile step=0.001 over an hour cannot turn one
+        # request into a CPU sink — and the store is SELECTED ONCE, with
+        # per-step windows sliced off the copied point lists by bisect
+        # (re-running the full-store scan per step would hold contention
+        # with the scrape sweep for the whole evaluation).
+        if not step or step <= 0:
+            step = max((end - start) / 60.0, 1e-9)
+        n_steps = int((end - start) / step) + 1
+        if n_steps > 1000:
+            raise ValueError("range/step yields > 1000 evaluation points")
+        ats = [min(start + i * step, end) for i in range(n_steps)]
+        if func in ("rate", "increase"):
+            out = []
+            for key, pts in self._select(name, matchers):
+                points: List[List[float]] = []
+                for at in ats:
+                    got = self._increase(
+                        _window_slice(pts, at - window_s, at)
+                    )
+                    if got is None:
+                        continue
+                    inc, elapsed = got
+                    points.append([
+                        at,
+                        inc if func == "increase"
+                        else (inc / elapsed if elapsed > 0 else 0.0),
+                    ])
+                if points:
+                    out.append({"labels": _labels_dict(key), "points": points})
+            return out
+        # quantile over range: group bucket series once, then window each
+        # bucket per step.
+        grouped: Dict[Tuple[Tuple[str, str], ...], List[Tuple[float, List[Tuple[float, float]]]]] = {}
+        for key, pts in self._select(name + "_bucket", matchers):
+            labels = _labels_dict(key)
+            le_raw = labels.pop("le", None)
+            if le_raw is None:
+                continue
+            le = math.inf if le_raw == "+Inf" else float(le_raw)
+            grouped.setdefault(
+                tuple(sorted(labels.items())), []
+            ).append((le, pts))
+        out = []
+        for labelkey, buckets in sorted(grouped.items()):
+            points = []
+            for at in ats:
+                incs = []
+                for le, pts in buckets:
+                    got = self._increase(
+                        _window_slice(pts, at - window_s, at)
+                    )
+                    if got is not None:
+                        incs.append((le, got[0]))
+                value = histogram_quantile(q, incs) if incs else math.nan
+                if not math.isnan(value):
+                    points.append([at, value])
+            if points:
+                out.append({"labels": dict(labelkey), "points": points})
+        return out
+
+    # -- discovery / accounting -----------------------------------------------
+    def series(self, name: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            keys = [
+                k for k in self._series
+                if name is None or k[0] == name
+            ]
+        return [
+            {"name": k[0], "labels": dict(k[1])} for k in sorted(keys)
+        ]
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "series": len(self._series),
+                "points": sum(
+                    len(s.points) for s in self._series.values()
+                ),
+                "dropped_series": self.dropped_series,
+                "max_series": self.max_series,
+                "max_points_per_series": self.max_points_per_series,
+            }

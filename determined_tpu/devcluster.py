@@ -30,7 +30,14 @@ class DevCluster:
         preempt_timeout_s: float = 120.0,
         tls: bool = False,
         trace_file: Optional[str] = None,
+        agent_metrics: bool = False,
+        metrics_config: Optional[Dict[str, Any]] = None,
+        alerts_config: Optional[Dict[str, Any]] = None,
     ) -> None:
+        #: agent_metrics=True gives every agent an ephemeral health port
+        #: (+ registers it as a master scrape target) — opt-in so the
+        #: extra HTTP servers don't ride along under every e2e test.
+        self._agent_metrics = agent_metrics
         # Trial subprocesses must import determined_tpu without installation.
         repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         pypath = os.environ.get("PYTHONPATH", "")
@@ -44,6 +51,8 @@ class DevCluster:
             pools_config={"default": {"scheduler": scheduler or {"type": "priority"}}},
             preempt_timeout_s=preempt_timeout_s,
             trace_file=trace_file,
+            metrics_config=metrics_config,
+            alerts_config=alerts_config,
         )
         self._cert_env_prev: Optional[str] = None
         self._tls_dir: Optional[str] = None
@@ -82,6 +91,7 @@ class DevCluster:
         agent = AgentDaemon(
             self.api.url, agent_id=agent_id, slots=slots,
             python_exe=sys.executable, state_dir=state_dir,
+            metrics_port=0 if self._agent_metrics else None,
         )
         thread = threading.Thread(
             target=agent.run_forever, daemon=True, name=f"agent-{agent_id}"

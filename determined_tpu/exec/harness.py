@@ -188,9 +188,33 @@ def run(entrypoint: str) -> int:
             resume_ckpt = rz.restore_from
             resume_event = "resize"
             continue
-        except Exception:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001
             logger.exception("trial failed")
+            _report_divergence(info, e)
             return 1
+
+
+def _report_divergence(info, exc) -> None:
+    """Name a replica-divergence audit failure to the master on the way
+    down: the agent's exit report only carries 'exit code 1', so without
+    this the cluster-level divergence counter (core.py
+    SENTINEL_DIVERGENCE, watched by the shipped `replica_divergence`
+    alert rule) could never move. Best-effort — a master that is already
+    gone doesn't change the exit."""
+    from determined_tpu.trainer._sentinel import ReplicaDivergenceError
+
+    if not isinstance(exc, ReplicaDivergenceError) or info.trial is None:
+        return
+    try:
+        from determined_tpu.common.api_session import Session
+
+        Session(info.master_url, token=info.session_token).post(
+            f"/api/v1/trials/{info.trial.trial_id}/status",
+            json_body={"event": "divergence", "detail": str(exc)[:500]},
+        )
+    except Exception:  # noqa: BLE001 — reporting must not mask the exit
+        logger.warning("could not report divergence to the master",
+                       exc_info=True)
 
 
 def _teardown_jax_distributed() -> None:

@@ -1,0 +1,508 @@
+"""Alert / SLO rules engine over the master TSDB.
+
+The platform now remembers its own signals (common/tsdb.py); this module
+WATCHES them: declarative rules from masterconf, evaluated on the master's
+maintenance tick, firing through the existing webhooks plumbing with a
+dedupe/resolve lifecycle — the self-contained analog of the reference's
+alerting story (the k6-judged API-health gates per PAPER.md).
+
+Rule forms (all validated at boot with named errors — a typo'd rule must
+fail master startup, not silently never fire):
+
+- ``threshold``: a query function over one metric compared per-series
+  (``{"kind": "threshold", "metric": ..., "func": "instant|rate|increase",
+  "window_s": ..., "op": ">", "value": ...}``);
+- ``ratio``: two increase/rate expressions summed to scalars and divided
+  (shed fraction, error fraction);
+- ``absence``: a series the TSDB has seen stops reporting for
+  ``window_s`` (dead exporter, wedged replica);
+- ``burn_rate``: multiwindow-free SLO burn over a histogram — the
+  fraction of observations in ``window_s`` that missed the ``le``
+  objective bucket, divided by the error budget ``1 - objective``;
+  fires when the budget burns ``burn_factor``× faster than nominal.
+
+Lifecycle per (rule, labels) instance: pending (condition true, waiting
+out ``for_s``) → firing (ONE webhook notification; repeat evaluations
+dedupe) → resolved (condition clears: one resolve notification, instance
+moves to bounded history). Webhooks subscribe by listing the trigger
+state ``ALERT`` (the same rows experiment-state hooks use).
+"""
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from determined_tpu.common.metrics import REGISTRY as METRICS
+from determined_tpu.common.tsdb import TSDB
+
+logger = logging.getLogger("determined_tpu.master")
+
+ALERTS_FIRING = METRICS.gauge(
+    "dtpu_alerts_firing", "Alert instances currently firing, by rule.",
+    labels=("rule",),
+)
+ALERT_TRANSITIONS = METRICS.counter(
+    "dtpu_alert_transitions_total",
+    "Alert lifecycle transitions (fired / resolved), by rule.",
+    labels=("rule", "transition"),
+)
+
+RULE_KINDS = ("threshold", "ratio", "absence", "burn_rate")
+OPS: Dict[str, Callable[[float, float], bool]] = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+}
+_EXPR_FUNCS = ("instant", "rate", "increase")
+SEVERITIES = ("info", "warning", "critical")
+
+#: Shipped defaults: the signals previous PRs built, finally watched.
+#: Overridable per name (a masterconf rule with the same name replaces
+#: the default) or wholesale (`alerts.default_rules: false`).
+DEFAULT_RULES: List[Dict[str, Any]] = [
+    {
+        # p99-TTFT SLO burn: fraction of requests slower than the
+        # objective bucket, against a 1% error budget. burn_factor 6 ≈
+        # "the monthly budget gone in ~5 days" — the classic fast-burn
+        # page threshold, evaluated over one window because the TSDB
+        # retention is the long window.
+        "name": "serving_ttft_slo_burn",
+        "kind": "burn_rate",
+        "metric": "dtpu_serving_ttft_seconds",
+        "le": 2.5,
+        "objective": 0.99,
+        "window_s": 300.0,
+        "burn_factor": 6.0,
+        "for_s": 0.0,
+        "severity": "critical",
+        "help": "serving p99 TTFT error budget burning >=6x nominal",
+    },
+    {
+        "name": "serving_shed_rate",
+        "kind": "ratio",
+        "num": {"metric": "dtpu_serving_shed_total", "func": "increase",
+                "window_s": 300.0},
+        "den": {"metric": "dtpu_serving_requests_total", "func": "increase",
+                "window_s": 300.0},
+        "op": ">",
+        "value": 0.05,
+        "for_s": 60.0,
+        "severity": "warning",
+        "help": ">5% of serving requests shed over 5m",
+    },
+    {
+        # Master-owned series are matched on the master's OWN scrape
+        # instance: a co-resident agent (devcluster) shares the process
+        # registry, so its health-port scrape echoes these gauges one
+        # beat behind under its own instance label — alerting on the
+        # echo would double-fire every master-side rule.
+        "name": "goodput_collapse",
+        "kind": "threshold",
+        "metric": "dtpu_experiment_goodput_pct",
+        "match": {"instance": "master"},
+        "func": "instant",
+        "op": "<",
+        "value": 50.0,
+        "for_s": 120.0,
+        "severity": "warning",
+        "help": "an experiment's goodput ledger fell below 50%",
+    },
+    {
+        "name": "stall_kills",
+        "kind": "threshold",
+        "metric": "dtpu_sentinel_stall_kills_total",
+        "match": {"instance": "master"},
+        "func": "increase",
+        "window_s": 600.0,
+        "op": ">",
+        "value": 0.0,
+        "for_s": 0.0,
+        "severity": "critical",
+        "help": "the stall watchdog killed a gang in the last 10m",
+    },
+    {
+        "name": "replica_divergence",
+        "kind": "threshold",
+        "metric": "dtpu_sentinel_divergence_exits_total",
+        "match": {"instance": "master"},
+        "func": "increase",
+        "window_s": 600.0,
+        "op": ">",
+        "value": 0.0,
+        "for_s": 0.0,
+        "severity": "critical",
+        "help": "a trial exited on a replica-divergence audit failure",
+    },
+    {
+        "name": "scrape_target_down",
+        "kind": "threshold",
+        "metric": "dtpu_scrape_staleness_seconds",
+        "match": {"instance": "master"},
+        "func": "instant",
+        "op": ">",
+        "value": 60.0,
+        "for_s": 0.0,
+        "severity": "warning",
+        "help": "a scrape target has not answered for >60s",
+    },
+]
+
+
+def _expr_errors(where: str, expr: Any) -> List[str]:
+    errors: List[str] = []
+    if not isinstance(expr, dict):
+        return [f"{where}: must be an object"]
+    if not expr.get("metric"):
+        errors.append(f"{where}: missing 'metric'")
+    func = expr.get("func", "instant")
+    if func not in _EXPR_FUNCS:
+        errors.append(
+            f"{where}: func {func!r} (one of: {', '.join(_EXPR_FUNCS)})"
+        )
+    w = expr.get("window_s", 300.0)
+    if not isinstance(w, (int, float)) or w <= 0:
+        errors.append(f"{where}: window_s must be a positive number")
+    m = expr.get("match", {})
+    if not isinstance(m, dict):
+        errors.append(f"{where}: match must be a {{label: value}} object")
+    return errors
+
+
+def validate_rule(rule: Any) -> List[str]:
+    """Human-readable problems with one rule (empty = valid)."""
+    if not isinstance(rule, dict):
+        return ["rule must be an object"]
+    name = rule.get("name")
+    where = f"rule {name!r}" if name else "rule <unnamed>"
+    errors: List[str] = []
+    if not name or not isinstance(name, str):
+        errors.append("rule needs a string 'name'")
+    kind = rule.get("kind")
+    if kind not in RULE_KINDS:
+        errors.append(
+            f"{where}: kind {kind!r} (one of: {', '.join(RULE_KINDS)})"
+        )
+        return errors
+    for_s = rule.get("for_s", 0.0)
+    if not isinstance(for_s, (int, float)) or for_s < 0:
+        errors.append(f"{where}: for_s must be a non-negative number")
+    sev = rule.get("severity", "warning")
+    if sev not in SEVERITIES:
+        errors.append(
+            f"{where}: severity {sev!r} (one of: {', '.join(SEVERITIES)})"
+        )
+    if kind == "threshold":
+        errors += _expr_errors(where, {
+            "metric": rule.get("metric"),
+            "func": rule.get("func", "instant"),
+            "window_s": rule.get("window_s", 300.0),
+            "match": rule.get("match", {}),
+        })
+        if rule.get("op", ">") not in OPS:
+            errors.append(f"{where}: op must be one of {sorted(OPS)}")
+        if not isinstance(rule.get("value", 0.0), (int, float)):
+            errors.append(f"{where}: value must be a number")
+    elif kind == "ratio":
+        errors += _expr_errors(f"{where}.num", rule.get("num"))
+        errors += _expr_errors(f"{where}.den", rule.get("den"))
+        if rule.get("op", ">") not in OPS:
+            errors.append(f"{where}: op must be one of {sorted(OPS)}")
+        if not isinstance(rule.get("value", 0.0), (int, float)):
+            errors.append(f"{where}: value must be a number")
+    elif kind == "absence":
+        if not rule.get("metric"):
+            errors.append(f"{where}: missing 'metric'")
+        w = rule.get("window_s", 300.0)
+        if not isinstance(w, (int, float)) or w <= 0:
+            errors.append(f"{where}: window_s must be a positive number")
+    elif kind == "burn_rate":
+        if not rule.get("metric"):
+            errors.append(f"{where}: missing 'metric' (histogram family)")
+        for k in ("le", "objective", "window_s", "burn_factor"):
+            if not isinstance(rule.get(k), (int, float)):
+                errors.append(f"{where}: {k} must be a number")
+        obj = rule.get("objective")
+        if isinstance(obj, (int, float)) and not 0.0 < obj < 1.0:
+            errors.append(f"{where}: objective must be in (0, 1)")
+    unknown = set(rule) - {
+        "name", "kind", "metric", "func", "window_s", "match", "op",
+        "value", "for_s", "severity", "help", "num", "den", "le",
+        "objective", "burn_factor",
+    }
+    if unknown:
+        errors.append(f"{where}: unknown keys {sorted(unknown)}")
+    return errors
+
+
+def resolve_rules(alerts_config: Optional[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Shipped defaults + masterconf rules; a user rule reusing a default
+    name REPLACES it (re-tuning a shipped threshold, not duplicating it).
+    Assumes masterconf.validate already rejected malformed rules."""
+    cfg = alerts_config or {}
+    rules: List[Dict[str, Any]] = []
+    if cfg.get("default_rules", True):
+        rules = [dict(r) for r in DEFAULT_RULES]
+    by_name = {r["name"]: i for i, r in enumerate(rules)}
+    for r in cfg.get("rules", []) or []:
+        r = dict(r)
+        if r.get("name") in by_name:
+            rules[by_name[r["name"]]] = r
+        else:
+            by_name[r["name"]] = len(rules)
+            rules.append(r)
+    return rules
+
+
+class AlertEngine:
+    def __init__(
+        self,
+        tsdb: TSDB,
+        rules: List[Dict[str, Any]],
+        shipper: Optional[Any] = None,
+        *,
+        interval_s: float = 5.0,
+        history_cap: int = 200,
+    ) -> None:
+        errors: List[str] = []
+        for rule in rules:
+            errors += validate_rule(rule)
+        if errors:
+            raise ValueError("invalid alert rules: " + "; ".join(errors))
+        self.tsdb = tsdb
+        self.rules = rules
+        self.shipper = shipper
+        self.interval_s = float(interval_s)
+        self._last_eval = 0.0
+        self._lock = threading.Lock()
+        #: (rule_name, labels tuple) -> instance dict
+        self._instances: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Dict[str, Any]] = {}
+        self._history: deque = deque(maxlen=history_cap)
+
+    # -- evaluation ------------------------------------------------------------
+    def maybe_evaluate(self, now: Optional[float] = None) -> bool:
+        now = time.time() if now is None else float(now)
+        if now - self._last_eval < self.interval_s:
+            return False
+        self._last_eval = now
+        self.evaluate(now)
+        return True
+
+    def evaluate(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else float(now)
+        for rule in self.rules:
+            try:
+                violating = self._eval_rule(rule, now)
+            except Exception:  # noqa: BLE001 — one bad rule never stops the rest
+                logger.exception("alert rule %s failed to evaluate",
+                                 rule.get("name"))
+                continue
+            self._apply(rule, violating, now)
+        # EVERY configured rule publishes a firing count — including an
+        # explicit 0 when its last instance just resolved. Dropping the
+        # series instead would make the 1 → 0 resolve edge unobservable
+        # (a dashboard sees absence/staleness, not recovery).
+        with self._lock:
+            ALERTS_FIRING.replace({
+                (rule["name"],): float(sum(
+                    1 for (rn, _), inst in self._instances.items()
+                    if rn == rule["name"] and inst["state"] == "firing"
+                ))
+                for rule in self.rules
+            })
+
+    def _eval_rule(
+        self, rule: Dict[str, Any], now: float
+    ) -> Dict[Tuple[Tuple[str, str], ...], float]:
+        """{labels: value} of the series instances violating `rule` now."""
+        kind = rule["kind"]
+        matchers = rule.get("match") or {}
+        if kind == "threshold":
+            op = OPS[rule.get("op", ">")]
+            thr = float(rule.get("value", 0.0))
+            results = self._eval_expr(
+                {
+                    "metric": rule["metric"],
+                    "func": rule.get("func", "instant"),
+                    "window_s": rule.get("window_s", 300.0),
+                    "match": matchers,
+                },
+                now,
+            )
+            return {
+                tuple(sorted(r["labels"].items())): r["value"]
+                for r in results
+                if op(r["value"], thr)
+            }
+        if kind == "ratio":
+            # The rule-level match scopes BOTH expressions (an expression's
+            # own match refines it further) — a validated knob must act.
+            def scoped(expr: Dict[str, Any]) -> Dict[str, Any]:
+                return dict(
+                    expr, match={**matchers, **(expr.get("match") or {})}
+                )
+
+            num = sum(
+                r["value"] for r in self._eval_expr(scoped(rule["num"]), now)
+            )
+            den = sum(
+                r["value"] for r in self._eval_expr(scoped(rule["den"]), now)
+            )
+            if den <= 0:
+                return {}
+            ratio = num / den
+            op = OPS[rule.get("op", ">")]
+            if op(ratio, float(rule.get("value", 0.0))):
+                return {(): ratio}
+            return {}
+        if kind == "absence":
+            window = float(rule.get("window_s", 300.0))
+            out: Dict[Tuple[Tuple[str, str], ...], float] = {}
+            for s in self.tsdb.range(
+                rule["metric"], matchers, start=0.0, end=now
+            ):
+                if not s["points"]:
+                    continue
+                stale = now - s["points"][-1][0]
+                if stale > window:
+                    out[tuple(sorted(s["labels"].items()))] = stale
+            return out
+        # burn_rate: bad fraction over the window vs the error budget.
+        window = float(rule["window_s"])
+        le = float(rule["le"])
+        budget = 1.0 - float(rule["objective"])
+        factor = float(rule["burn_factor"])
+        totals = {
+            tuple(sorted(r["labels"].items())): r["value"]
+            for r in self.tsdb.rate(
+                rule["metric"] + "_count", matchers, window, at=now,
+                as_increase=True,
+            )
+        }
+        good: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        for r in self.tsdb.rate(
+            rule["metric"] + "_bucket", dict(matchers), window, at=now,
+            as_increase=True,
+        ):
+            labels = dict(r["labels"])
+            le_raw = labels.pop("le", None)
+            if le_raw is None or le_raw == "+Inf":
+                continue
+            if not math.isclose(float(le_raw), le):
+                continue
+            good[tuple(sorted(labels.items()))] = r["value"]
+        out = {}
+        for key, total in totals.items():
+            if total <= 0:
+                continue
+            bad_fraction = max(0.0, total - good.get(key, 0.0)) / total
+            burn = bad_fraction / budget if budget > 0 else math.inf
+            if burn >= factor:
+                out[key] = burn
+        return out
+
+    def _eval_expr(
+        self, expr: Dict[str, Any], now: float
+    ) -> List[Dict[str, Any]]:
+        func = expr.get("func", "instant")
+        matchers = expr.get("match") or {}
+        if func == "instant":
+            return self.tsdb.instant(expr["metric"], matchers, at=now)
+        return self.tsdb.rate(
+            expr["metric"], matchers,
+            float(expr.get("window_s", 300.0)), at=now,
+            as_increase=(func == "increase"),
+        )
+
+    # -- lifecycle -------------------------------------------------------------
+    def _apply(
+        self,
+        rule: Dict[str, Any],
+        violating: Dict[Tuple[Tuple[str, str], ...], float],
+        now: float,
+    ) -> None:
+        name = rule["name"]
+        for_s = float(rule.get("for_s", 0.0))
+        with self._lock:
+            for labels, value in violating.items():
+                key = (name, labels)
+                inst = self._instances.get(key)
+                if inst is None:
+                    inst = {
+                        "rule": name,
+                        "severity": rule.get("severity", "warning"),
+                        "help": rule.get("help", ""),
+                        "labels": dict(labels),
+                        "state": "pending",
+                        "since": now,
+                        "value": value,
+                    }
+                    self._instances[key] = inst
+                inst["value"] = value
+                inst["last_seen"] = now
+                if (
+                    inst["state"] == "pending"
+                    and now - inst["since"] >= for_s
+                ):
+                    inst["state"] = "firing"
+                    inst["fired_at"] = now
+                    self._notify(inst, "firing")
+            # Clear side: instances of this rule no longer violating.
+            for key in [
+                k for k, inst in self._instances.items()
+                if k[0] == name and k[1] not in violating
+            ]:
+                inst = self._instances.pop(key)
+                if inst["state"] == "firing":
+                    inst["state"] = "resolved"
+                    inst["resolved_at"] = now
+                    self._notify(inst, "resolved")
+                    self._history.append(dict(inst))
+                # pending instances clear silently (never notified)
+
+    def _notify(self, inst: Dict[str, Any], transition: str) -> None:
+        ALERT_TRANSITIONS.labels(
+            inst["rule"],
+            "fired" if transition == "firing" else transition,
+        ).inc()
+        logger.warning(
+            "alert %s %s (severity %s, value %.6g) %s",
+            inst["rule"], transition, inst["severity"], inst["value"],
+            inst["labels"] or "",
+        )
+        if self.shipper is None:
+            return
+        try:
+            self.shipper.ship_alert({
+                "event": "alert",
+                "alert": inst["rule"],
+                "state": transition,
+                "severity": inst["severity"],
+                "labels": inst["labels"],
+                "value": inst["value"],
+                "help": inst["help"],
+                "timestamp": time.time(),
+            })
+        except Exception:  # noqa: BLE001 — delivery is the shipper's problem
+            logger.exception("alert webhook enqueue failed")
+
+    # -- introspection ---------------------------------------------------------
+    def active(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return sorted(
+                (dict(i) for i in self._instances.values()),
+                key=lambda i: (i["rule"], sorted(i["labels"].items())),
+            )
+
+    def history(self, limit: int = 50) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._history)[-max(1, int(limit)):]
+
+    def rule_names(self) -> List[str]:
+        return [r["name"] for r in self.rules]

@@ -22,7 +22,11 @@ from urllib.parse import parse_qs, urlparse
 
 from determined_tpu.common import trace as trace_mod
 from determined_tpu.common.metrics import REGISTRY as METRICS
-from determined_tpu.master.core import EXPERIMENT_GOODPUT, Master
+from determined_tpu.master.core import (
+    EXPERIMENT_GOODPUT,
+    SENTINEL_DIVERGENCE,
+    Master,
+)
 from determined_tpu.master.db import TERMINAL_STATES
 
 logger = logging.getLogger("determined_tpu.master")
@@ -490,6 +494,16 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
     def post_status(r: ApiRequest):
         # Doubles as the unmanaged-trial heartbeat (core_v2._Heartbeat).
         m.record_heartbeat(int(r.groups[0]))
+        if r.body.get("event") == "divergence":
+            # The harness names a replica-divergence audit failure here on
+            # its way down (exec/harness.py) — the agent's exit report only
+            # carries the exit CODE, and the replica_divergence alert rule
+            # watches this counter.
+            SENTINEL_DIVERGENCE.inc()
+            logger.warning(
+                "trial %s reported replica divergence: %s",
+                r.groups[0], r.body.get("detail", ""),
+            )
         return {}
 
     def best_validation(r: ApiRequest):
@@ -840,6 +854,23 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
 
     # -- agents ---------------------------------------------------------------
     def register_agent(r: ApiRequest):
+        # Scrape-target registration rides the normal register: the agent
+        # names its health PORT; the host is this connection's source
+        # address (the agent may not know its own externally-reachable
+        # name, but the address it dialed us from is it).
+        metrics_port = r.body.get("metrics_port")
+        metrics_addr = None
+        if metrics_port:
+            try:
+                port_num = int(metrics_port)
+            except (TypeError, ValueError):
+                raise ApiError(
+                    400, f"metrics_port must be an integer, got {metrics_port!r}"
+                )
+            host = r.client_ip or "127.0.0.1"
+            if ":" in host:  # IPv6 literal needs brackets in a URL
+                host = f"[{host}]"
+            metrics_addr = f"{host}:{port_num}"
         res = m.agent_registered(
             r.body["agent_id"],
             int(r.body.get("slots", 0)),
@@ -847,6 +878,7 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
             r.body.get("running_allocs") or [],
             r.body.get("exiting_allocs") or [],
             devices=r.body.get("devices") or [],
+            metrics_addr=metrics_addr,
         )
         res["cluster_id"] = m.cluster_id
         return res
@@ -1549,6 +1581,73 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         )
         raise _PlainText(METRICS.render())
 
+    # -- time-series plane (common/tsdb.py + master/timeseries.py): the
+    # -- master's own metric HISTORY, not just the instant /metrics ----------
+    def metrics_query(r: ApiRequest):
+        """GET /api/v1/metrics/query — instant + range queries over the
+        in-master TSDB. `name` selects the family; `match=label=value`
+        (repeatable) filters series; `func` is raw|instant|rate|increase|
+        quantile (`window` seconds for the windowed funcs, `q` for
+        quantile); `start`/`end`/`step` (unix seconds) make it a range."""
+        name = r.q("name")
+        if not name:
+            raise ApiError(400, "query needs ?name=<metric family>")
+        matchers: Dict[str, str] = {}
+        for item in r.query.get("match", []):
+            label, sep, value = item.partition("=")
+            if not sep or not label:
+                raise ApiError(
+                    400, f"bad match {item!r} (want label=value)"
+                )
+            matchers[label] = value
+        start = r.q("start")
+        try:
+            # Numeric param junk answers 400 here too — a dashboard's
+            # malformed time range must not read as a server error.
+            result = m.tsdb.query(
+                name,
+                func=r.q("func", "instant"),
+                matchers=matchers,
+                window_s=r.qfloat("window", 300.0),
+                q=r.qfloat("q", 0.99),
+                start=float(start) if start is not None else None,
+                end=(
+                    float(r.q("end")) if r.q("end") is not None else None
+                ),
+                step=(
+                    float(r.q("step")) if r.q("step") is not None else None
+                ),
+            )
+        except (TypeError, ValueError) as e:
+            raise ApiError(400, str(e))
+        return {
+            "name": name,
+            "func": r.q("func", "instant"),
+            "range": start is not None,
+            "result": result,
+        }
+
+    def metrics_series(r: ApiRequest):
+        """GET /api/v1/metrics/series — series discovery + TSDB bounds
+        accounting (series/points vs their by-construction caps)."""
+        return {
+            "series": m.tsdb.series(r.q("name")),
+            "stats": m.tsdb.stats(),
+        }
+
+    def list_alerts(r: ApiRequest):
+        """GET /api/v1/alerts — pending/firing instances, recent resolved
+        history, and the loaded rule set's names."""
+        try:
+            limit = int(r.q("limit", "50"))
+        except ValueError:
+            raise ApiError(400, "limit must be an integer")
+        return {
+            "alerts": m.alert_engine.active(),
+            "history": m.alert_engine.history(limit),
+            "rules": m.alert_engine.rule_names(),
+        }
+
     R = lambda method, pat, h: (method, re.compile(f"^{pat}$"), h)  # noqa: E731
     return [
         R("POST", r"/api/v1/trials/(\d+)/metrics", post_metrics),
@@ -1644,6 +1743,9 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("DELETE", r"/api/v1/groups/([\w.\-]+)", delete_group),
         R("POST", r"/api/v1/auth/login", auth_login),
         R("POST", r"/api/v1/auth/logout", auth_logout),
+        R("GET", r"/api/v1/metrics/query", metrics_query),
+        R("GET", r"/api/v1/metrics/series", metrics_series),
+        R("GET", r"/api/v1/alerts", list_alerts),
         R("GET", r"/prom/metrics", prometheus_metrics),
         R("GET", r"/metrics", prometheus_metrics),
         R("GET", r"/(?:ui)?", webui_page),

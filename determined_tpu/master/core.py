@@ -56,6 +56,14 @@ ELASTIC_RESIZES = METRICS.counter(
     "Elastic gang resizes issued, by direction.",
     labels=("direction",),
 )
+#: Replica-divergence audit failures as the harness reports them on its
+#: way down (exec/harness.py _report_divergence → POST /trials/<id>/status
+#: {"event": "divergence"} — exit reports only carry the exit CODE): the
+#: cluster-level event stream the `replica_divergence` alert rule watches.
+SENTINEL_DIVERGENCE = METRICS.counter(
+    "dtpu_sentinel_divergence_exits_total",
+    "Trial exits attributed to a replica-divergence audit failure.",
+)
 
 
 class AgentHub:
@@ -83,6 +91,7 @@ class AgentHub:
         slots: int,
         pool: str,
         devices: Optional[List[Dict[str, Any]]] = None,
+        metrics_addr: Optional[str] = None,
     ) -> None:
         with self._cond:
             prev = self._agents.get(agent_id, {})
@@ -91,6 +100,13 @@ class AgentHub:
                 # per-slot device model (ref: master/pkg/device — kind/
                 # platform/coords rather than a bare count)
                 "devices": devices or [],
+                # host:port of the agent's /metrics health port (None =
+                # not served): the master's scrape sweep targets it. The
+                # registration is AUTHORITATIVE — an agent restarted
+                # without --metrics-port clears its target (keeping a
+                # stale addr would scrape a dead — or worse, recycled —
+                # port forever and wedge scrape_target_down firing).
+                "metrics_addr": metrics_addr,
                 # Admin state is MASTER-side (persisted in kv, re-applied
                 # by Master.agent_registered) — a re-registering agent
                 # must not clear its own drain/disable.
@@ -374,6 +390,8 @@ class Master:
         trace_file: Optional[str] = None,
         otlp_endpoint: Optional[str] = None,
         log_sink_url: Optional[str] = None,
+        metrics_config: Optional[Dict[str, Any]] = None,
+        alerts_config: Optional[Dict[str, Any]] = None,
     ) -> None:
         # Validated config tier (masterconf.py, the config.go:129 analog):
         # fail at boot with every problem named, not mid-scheduling on the
@@ -384,6 +402,8 @@ class Master:
             pools=pools_config,
             preempt_timeout_s=preempt_timeout_s,
             config_defaults=config_defaults,
+            metrics=metrics_config,
+            alerts=alerts_config,
         )
         self.cluster_id = uuid.uuid4().hex[:8]
         self._external_url = external_url
@@ -489,6 +509,39 @@ class Master:
         # yet at assignment); propagate now so payload deep links work
         # even when external_url is never reassigned post-start.
         self.webhooks.ui_base_url = self._external_url.rstrip("/")
+        # Time-series plane: bounded in-master TSDB fed by the maintenance
+        # tick's scrape sweep (own REGISTRY + agent health ports + serving
+        # replicas), queried by /api/v1/metrics/* and watched by the
+        # alert/SLO engine firing through the webhook shipper above.
+        from determined_tpu.common.tsdb import TSDB
+        from determined_tpu.master.alerts import AlertEngine, resolve_rules
+        from determined_tpu.master.timeseries import MetricsScraper
+
+        mcfg = dict(masterconf.METRICS_DEFAULTS)
+        mcfg.update(metrics_config or {})
+        self.tsdb = TSDB(
+            max_points_per_series=int(mcfg["retention_points"]),
+            retention_s=float(mcfg["retention_s"]),
+            min_step_s=float(mcfg["min_step_s"]),
+            max_series=int(mcfg["max_series"]),
+            # Default staleness: a target missing 3 consecutive scrapes is
+            # stale — dashboards show absence, not a frozen last value.
+            stale_after_s=(
+                float(mcfg["stale_after_s"])
+                or 3.0 * float(mcfg["scrape_interval_s"])
+            ),
+        )
+        self.scraper = MetricsScraper(
+            self, self.tsdb,
+            interval_s=float(mcfg["scrape_interval_s"]),
+            timeout_s=float(mcfg["scrape_timeout_s"]),
+        )
+        acfg = dict(masterconf.ALERTS_DEFAULTS)
+        acfg.update(alerts_config or {})
+        self.alert_engine = AlertEngine(
+            self.tsdb, resolve_rules(acfg), shipper=self.webhooks,
+            interval_s=float(acfg["interval_s"]),
+        )
         # Background worker for slow reactions to FSM events (checkpoint GC):
         # the state-change hook fires under the experiment lock and must not
         # do storage IO inline.
@@ -776,6 +829,13 @@ class Master:
                     self._elastic_grow_sweep()
                     self._prune_heartbeats()
                     self.auth.sweep()
+                    # Time-series plane: scrape sweep + alert evaluation
+                    # ride the maintenance cadence. Both are internally
+                    # interval-gated and per-target/per-rule fault-isolated
+                    # (a dead scrape target costs at most its HTTP timeout;
+                    # a broken rule logs and skips).
+                    self.scraper.maybe_scrape()
+                    self.alert_engine.maybe_evaluate()
             except Exception:  # noqa: BLE001
                 logger.exception("tick loop error")
 
@@ -1216,6 +1276,7 @@ class Master:
         running_allocs: Optional[List[Dict[str, Any]]] = None,
         exiting_allocs: Optional[List[str]] = None,
         devices: Optional[List[Dict[str, Any]]] = None,
+        metrics_addr: Optional[str] = None,
     ) -> Dict[str, List[str]]:
         """(Re)registration with container reattach (ref: restore.go:59 +
         aproto/master_message.go:46-55 ContainerReattachAck): the agent
@@ -1224,7 +1285,10 @@ class Master:
         master's experiment restore hasn't caught up yet). `exiting_allocs`
         are dead tasks whose exit report is about to be delivered — they
         must not be failed over as lost."""
-        self.agent_hub.register(agent_id, slots, pool, devices=devices)
+        self.agent_hub.register(
+            agent_id, slots, pool, devices=devices,
+            metrics_addr=metrics_addr,
+        )
         self.rm.pool(pool).add_agent(agent_id, slots)
         self._apply_agent_admin_state(agent_id, pool)
         adopted: List[str] = []

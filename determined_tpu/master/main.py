@@ -41,6 +41,15 @@ def main() -> None:
         help="also ship task logs to this Elasticsearch-compatible base URL "
              "(_bulk format)")
     parser.add_argument(
+        "--metrics-config", default=None,
+        help='JSON time-series plane knobs, e.g. '
+             '{"scrape_interval_s": 15, "retention_points": 720} '
+             "(docs/operations.md \"Time-series plane\")")
+    parser.add_argument(
+        "--alerts-config", default=None,
+        help='JSON alert-engine knobs/rules, e.g. '
+             '{"rules": [{"name": ..., "kind": "threshold", ...}]}')
+    parser.add_argument(
         "--config-defaults", default=None,
         help="JSON experiment-config defaults merged under every submitted "
              'config (master.yaml analog), e.g. {"max_restarts": 2}')
@@ -73,6 +82,12 @@ def main() -> None:
         trace_file=args.trace_file,
         otlp_endpoint=args.otlp_endpoint,
         log_sink_url=args.log_sink_url,
+        metrics_config=(
+            json.loads(args.metrics_config) if args.metrics_config else None
+        ),
+        alerts_config=(
+            json.loads(args.alerts_config) if args.alerts_config else None
+        ),
     )
     if bool(args.tls_cert) != bool(args.tls_key):
         parser.error("--tls-cert and --tls-key must be given together")

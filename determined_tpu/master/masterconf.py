@@ -18,6 +18,82 @@ POOL_TYPES = ("agent", "kubernetes")
 _SCHEDULER_KEYS = {"type", "preemption"}
 _POOL_KEYS = {"type", "scheduler"}
 
+#: Time-series plane knobs (`metrics:` section) with their defaults —
+#: the scrape cadence and the TSDB's by-construction memory bounds
+#: (docs/operations.md "Time-series plane" documents each row).
+METRICS_DEFAULTS = {
+    "scrape_interval_s": 10.0,   # maintenance-tick scrape cadence
+    "scrape_timeout_s": 2.0,     # per-target HTTP budget (never wedges the tick)
+    "retention_points": 360,     # ring cap per series (deque maxlen)
+    "retention_s": 3600.0,       # points older than this are trimmed
+    "min_step_s": 1.0,           # denser samples overwrite, not append
+    "max_series": 20000,         # hard cardinality cap (overflow counted)
+    "stale_after_s": 0.0,        # 0 = derived (3x scrape interval)
+}
+
+#: Alert engine knobs (`alerts:` section).
+ALERTS_DEFAULTS = {
+    "interval_s": 5.0,       # evaluation cadence on the maintenance tick
+    "default_rules": True,   # ship the built-in SLO rules (alerts.py)
+    "rules": [],             # extra/override rules (same-name replaces)
+}
+
+
+def validate_metrics(cfg: Optional[Dict[str, Any]]) -> List[str]:
+    errors: List[str] = []
+    if cfg is None:
+        return errors
+    if not isinstance(cfg, dict):
+        return ["metrics must be an object of time-series knobs"]
+    for key, value in cfg.items():
+        if key not in METRICS_DEFAULTS:
+            errors.append(
+                f"metrics: unknown key {key!r} "
+                f"(one of: {', '.join(sorted(METRICS_DEFAULTS))})"
+            )
+            continue
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            errors.append(f"metrics.{key} must be a number")
+            continue
+        if key == "stale_after_s":
+            if value < 0:
+                errors.append("metrics.stale_after_s must be >= 0")
+        elif value <= 0:
+            errors.append(f"metrics.{key} must be positive")
+        if key == "retention_points" and value < 2:
+            errors.append("metrics.retention_points must be >= 2")
+    return errors
+
+
+def validate_alerts(cfg: Optional[Dict[str, Any]]) -> List[str]:
+    errors: List[str] = []
+    if cfg is None:
+        return errors
+    if not isinstance(cfg, dict):
+        return ["alerts must be an object of alert-engine knobs"]
+    for key, value in cfg.items():
+        if key not in ALERTS_DEFAULTS:
+            errors.append(
+                f"alerts: unknown key {key!r} "
+                f"(one of: {', '.join(sorted(ALERTS_DEFAULTS))})"
+            )
+        elif key == "interval_s" and (
+            not isinstance(value, (int, float)) or isinstance(value, bool)
+            or value <= 0
+        ):
+            errors.append("alerts.interval_s must be a positive number")
+        elif key == "default_rules" and not isinstance(value, bool):
+            errors.append("alerts.default_rules must be a bool")
+        elif key == "rules":
+            if not isinstance(value, list):
+                errors.append("alerts.rules must be a list of rule objects")
+            else:
+                from determined_tpu.master.alerts import validate_rule
+
+                for rule in value:
+                    errors.extend(validate_rule(rule))
+    return errors
+
 
 def validate_pools(pools: Optional[Dict[str, Any]]) -> List[str]:
     """Returns human-readable errors (empty = valid)."""
@@ -78,11 +154,15 @@ def validate(
     pools: Optional[Dict[str, Any]] = None,
     preempt_timeout_s: float = 600.0,
     config_defaults: Optional[Dict[str, Any]] = None,
+    metrics: Optional[Dict[str, Any]] = None,
+    alerts: Optional[Dict[str, Any]] = None,
 ) -> None:
     """Validate the master's startup configuration; raises ValueError with
     EVERY problem named (config.go-style: fail fast at boot, not at the
     first trial that trips the knob)."""
     errors = validate_pools(pools)
+    errors += validate_metrics(metrics)
+    errors += validate_alerts(alerts)
     if not isinstance(preempt_timeout_s, (int, float)) or (
         preempt_timeout_s <= 0
     ):

@@ -1,0 +1,210 @@
+"""Master-side scrape loop feeding the in-process TSDB (common/tsdb.py).
+
+The master is its own Prometheus here: on the maintenance tick it scrapes
+(1) its own process-global REGISTRY (in-memory render — no HTTP hop),
+(2) every registered agent's health port (agents report `metrics_port` at
+registration; the address is the registering connection's source IP), and
+(3) every RUNNING serving replica through its proxy-registered endpoint.
+Everything goes through the STRICT exposition parser — the scrape path
+enforces the same format discipline the tests do.
+
+Scrape-plane rules:
+
+- a target can never wedge the tick: HTTP fetches carry a hard timeout,
+  every failure is caught, counted (`dtpu_scrape_failures_total`) and
+  surfaced as staleness (`dtpu_scrape_staleness_seconds`) — the TSDB's
+  staleness window then drops the dead target's series from instant
+  vectors, so dashboards show absence, not a frozen last value;
+- the master target is scraped LAST so the sweep's own self-telemetry
+  (durations, failures, staleness set during this sweep) lands in this
+  sweep's history rather than trailing one interval behind;
+- fault sites `master.scrape` (every target) and `master.scrape.<target>`
+  (one target) make scrape failure a drillable input (DTPU_FAULT_PLAN).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from determined_tpu.common import faults
+from determined_tpu.common.metrics import (
+    REGISTRY as METRICS,
+    parse_exposition,
+)
+from determined_tpu.common.tsdb import TSDB
+
+logger = logging.getLogger("determined_tpu.master")
+
+SCRAPE_DURATION = METRICS.histogram(
+    "dtpu_scrape_duration_seconds",
+    "Wall time of one scrape per target (fetch + strict parse + ingest).",
+    labels=("target",),
+)
+SCRAPE_FAILURES = METRICS.counter(
+    "dtpu_scrape_failures_total",
+    "Failed scrapes per target (unreachable, timeout, or strict-parse "
+    "rejection).",
+    labels=("target",),
+)
+SCRAPE_STALENESS = METRICS.gauge(
+    "dtpu_scrape_staleness_seconds",
+    "Seconds since the last successful scrape per target (0 = fresh).",
+    labels=("target",),
+)
+SCRAPE_SAMPLES = METRICS.counter(
+    "dtpu_scrape_samples_total",
+    "Samples ingested into the TSDB per target.",
+    labels=("target",),
+)
+TSDB_SERIES = METRICS.gauge(
+    "dtpu_tsdb_series", "Series currently held in the master TSDB.",
+)
+TSDB_POINTS = METRICS.gauge(
+    "dtpu_tsdb_points", "Points currently held in the master TSDB.",
+)
+TSDB_DROPPED_SERIES = METRICS.gauge(
+    "dtpu_tsdb_dropped_series",
+    "Samples refused because the TSDB series cap was reached "
+    "(label-cardinality overflow degrades coverage, never master memory).",
+)
+
+#: The master's own registry, scraped in-process.
+SELF_TARGET = "master"
+
+
+class MetricsScraper:
+    def __init__(
+        self,
+        master,
+        tsdb: TSDB,
+        *,
+        interval_s: float = 10.0,
+        timeout_s: float = 2.0,
+    ) -> None:
+        self.master = master
+        self.tsdb = tsdb
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self._last_scrape = 0.0
+        self._last_success: Dict[str, float] = {}
+        self._first_seen_at: Dict[str, float] = {}
+        self._known_targets: set = set()
+        #: one sweep in flight at a time (a sweep outliving its interval
+        #: must not stack a second one behind it).
+        self._sweep_lock = threading.Lock()
+
+    # -- target discovery ------------------------------------------------------
+    def targets(self) -> List[Tuple[str, Optional[str]]]:
+        """(target_name, metrics_url) — url None = in-process registry.
+        Master last: its self-telemetry must include THIS sweep."""
+        out: List[Tuple[str, Optional[str]]] = []
+        for agent_id, info in self.master.agent_hub.list().items():
+            addr = info.get("metrics_addr")
+            if addr:
+                out.append((agent_id, f"http://{addr}/metrics"))
+        for cmd in self.master.list_commands():
+            if cmd.get("task_type") != "SERVING" or cmd.get("state") != "RUNNING":
+                continue
+            target = self.master.proxy.target(cmd["task_id"])
+            if target is not None:
+                out.append(
+                    (cmd["task_id"], f"http://{target[0]}:{target[1]}/metrics")
+                )
+        out.append((SELF_TARGET, None))
+        return out
+
+    # -- the sweep -------------------------------------------------------------
+    def maybe_scrape(self, now: Optional[float] = None) -> bool:
+        """Tick hook: when the interval elapsed, kick a sweep on its OWN
+        daemon thread. The tick thread also runs scheduling, agent
+        reaping and preemption escalation — N unreachable targets at
+        timeout_s each would otherwise stall all of that for the whole
+        sweep (per-target boundedness is not sweep boundedness). Returns
+        True when a sweep was started."""
+        now = time.time() if now is None else float(now)
+        if now - self._last_scrape < self.interval_s:
+            return False
+        self._last_scrape = now
+        threading.Thread(
+            target=self._sweep_guarded, args=(now,),
+            name="metrics-scrape", daemon=True,
+        ).start()
+        return True
+
+    def _sweep_guarded(self, now: float) -> None:
+        # A sweep slower than the interval (every target black-holed at
+        # full timeout) drops the next trigger instead of stacking.
+        if not self._sweep_lock.acquire(blocking=False):
+            return
+        try:
+            self.scrape_once(now)
+        except Exception:  # noqa: BLE001 — a sweep bug must not kill the thread pattern
+            logger.exception("scrape sweep failed")
+        finally:
+            self._sweep_lock.release()
+
+    def scrape_once(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else float(now)
+        live = set()
+        for name, url in self.targets():
+            live.add(name)
+            t0 = time.monotonic()
+            try:
+                faults.inject("master.scrape")
+                faults.inject(f"master.scrape.{name}")
+                if url is None:
+                    text = METRICS.render()
+                else:
+                    import requests
+
+                    resp = requests.get(url, timeout=self.timeout_s)
+                    resp.raise_for_status()
+                    text = resp.text
+                samples = parse_exposition(text)
+                stored = self.tsdb.ingest(name, samples, ts=now)
+                SCRAPE_SAMPLES.labels(name).inc(stored)
+                if name not in self._last_success:
+                    logger.info("scrape target %s up (%d samples)",
+                                name, stored)
+                self._last_success[name] = now
+            except Exception as e:  # noqa: BLE001 — a target never wedges the tick
+                SCRAPE_FAILURES.labels(name).inc()
+                if self._last_success.get(name, 0.0) >= now - self.interval_s * 1.5:
+                    # First failure after a healthy scrape: worth a line.
+                    # Steady-state failures stay quiet (the counter and the
+                    # staleness gauge are the durable record).
+                    logger.warning("scrape of %s failed: %s", name, e)
+                else:
+                    logger.debug("scrape of %s failed: %s", name, e)
+            finally:
+                SCRAPE_DURATION.labels(name).observe(time.monotonic() - t0)
+                last_ok = self._last_success.get(name)
+                SCRAPE_STALENESS.labels(name).set(
+                    0.0 if last_ok == now else
+                    (now - last_ok if last_ok else now - self._first_seen(name, now))
+                )
+        # Vanished targets (agent reaped, serving task exited): their
+        # per-target telemetry series and TSDB history must not linger —
+        # serving targets are keyed by task_id, so leaked labels would
+        # grow the registry (and, via the self-scrape, eat the TSDB's
+        # series cap) by one set per finished task forever.
+        for gone in self._known_targets - live:
+            for fam in (SCRAPE_STALENESS, SCRAPE_DURATION,
+                        SCRAPE_FAILURES, SCRAPE_SAMPLES):
+                fam.remove(gone)
+            self._last_success.pop(gone, None)
+            self._first_seen_at.pop(gone, None)
+            self.tsdb.drop_instance(gone)
+        self._known_targets = live
+        stats = self.tsdb.stats()
+        TSDB_SERIES.set(stats["series"])
+        TSDB_POINTS.set(stats["points"])
+        TSDB_DROPPED_SERIES.set(stats["dropped_series"])
+
+    def _first_seen(self, name: str, now: float) -> float:
+        """Staleness basis for a target that has NEVER answered: time of
+        first observation (a target down since discovery ages from when
+        we started trying, not from epoch)."""
+        return self._first_seen_at.setdefault(name, now)

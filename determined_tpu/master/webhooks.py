@@ -38,6 +38,10 @@ class WebhookShipper:
         )
         self._thread.start()
 
+    #: trigger_states entry that subscribes a webhook to alert-engine
+    #: notifications (master/alerts.py) instead of experiment states.
+    ALERT_TRIGGER = "ALERT"
+
     def notify(self, exp_id: int, state: str, config: Dict[str, Any]) -> None:
         """Queue deliveries for every webhook triggered by `state`."""
         for hook in self.db.list_webhooks():
@@ -57,6 +61,18 @@ class WebhookShipper:
                         },
                     }
                 )
+
+    def ship_alert(self, payload: Dict[str, Any]) -> None:
+        """Queue an alert-engine notification (firing/resolved) for every
+        webhook subscribed via the ALERT trigger state — the same rows,
+        queue, retry policy and drop semantics experiment notifications
+        use; the alert engine's dedupe means one delivery per
+        transition, not per evaluation."""
+        if self.ui_base_url:
+            payload = dict(payload, url=f"{self.ui_base_url}/#alerts")
+        for hook in self.db.list_webhooks():
+            if self.ALERT_TRIGGER in hook["trigger_states"]:
+                self._queue.put({"url": hook["url"], "payload": payload})
 
     def _run(self) -> None:
         policy = RetryPolicy(

@@ -83,6 +83,10 @@ PAGE = r"""<!doctype html>
 </div>
 
 <div id="view-main">
+<h2>Cluster health <span id="alerts-label" class="muted"></span></h2>
+<div id="alerts">(no alert data yet)</div>
+<div id="cluster-charts" class="muted">(sparklines appear once the
+master's time-series plane has a few scrapes of history)</div>
 <h2>Agents</h2><table id="agents"></table>
 <h2>Resource pools</h2><table id="pools"></table>
 <h2>Job queue</h2><div id="queues">(empty)</div>
@@ -576,6 +580,73 @@ async function refreshAdmin() {
   } catch (e) { /* 403 for non-admins: leave sections empty */ }
 }
 
+// --- cluster health (time-series plane: /api/v1/alerts + the TSDB
+// --- query API rendered as sparkline history, ref WebUI cluster telemetry)
+let healthTick = 0;
+async function refreshClusterHealth() {
+  // Every other poll: history moves at scrape cadence, not UI cadence.
+  if ((healthTick++ % 2) !== 0) return;
+  try {
+    const al = await j('/api/v1/alerts');
+    const alerts = al.alerts || [];
+    $('alerts-label').textContent =
+      `· ${alerts.filter(a => a.state === 'firing').length} firing / ` +
+      `${al.rules ? al.rules.length : 0} rules`;
+    if (!alerts.length) {
+      $('alerts').textContent = '(no pending or firing alerts)';
+    } else {
+      $('alerts').innerHTML = '<table><tr><th>state</th><th>severity</th>' +
+        '<th>rule</th><th>labels</th><th>value</th><th>since</th></tr>' +
+        alerts.map(a =>
+          `<tr><td class="${a.state === 'firing' ? 'ERRORED' : 'CANCELED'}">` +
+          `${esc(a.state)}</td>${cell(a.severity)}${cell(a.rule)}` +
+          cell(Object.entries(a.labels || {})
+               .map(([k, v]) => `${k}=${v}`).join(' ')) +
+          cell(Number(a.value).toPrecision(4)) +
+          cell(new Date(a.since * 1000).toLocaleTimeString()) +
+          '</tr>').join('') + '</table>';
+    }
+    const end = Date.now() / 1000, start = end - 900;
+    const charts = [
+      ['API req/s', {name: 'dtpu_api_requests_total', func: 'rate',
+                     window: 120, start, end, step: 30}],
+      ['queue depth', {name: 'dtpu_sched_queue_depth', func: 'raw',
+                       start, end}],
+      ['goodput %', {name: 'dtpu_experiment_goodput_pct', func: 'raw',
+                     start, end}],
+      ['scrape staleness s', {name: 'dtpu_scrape_staleness_seconds',
+                              func: 'raw', start, end}],
+      ['serving tokens/s', {name: 'dtpu_serving_tokens_total',
+                            func: 'rate', window: 120, start, end, step: 30}],
+      ['p99 TTFT s', {name: 'dtpu_serving_ttft_seconds', func: 'quantile',
+                      q: 0.99, window: 300, start, end, step: 60}],
+    ];
+    // One round-trip, not six: the chart queries are independent.
+    const results = await Promise.all(charts.map(([, p]) =>
+      j('/api/v1/metrics/query?' + new URLSearchParams(p).toString())
+        .catch(() => ({result: []}))));
+    const rendered = [];
+    charts.forEach(([title], i) => {
+      // Collapse the label set to the values that differ (instance,
+      // pool, ...) so sparkline legends stay short.
+      const series = (results[i].result || []).slice(0, 6)
+        .filter(s => (s.points || []).length)
+        .map(s => ({
+          name: Object.entries(s.labels || {})
+            .filter(([k]) => k !== 'le')
+            .map(([, v]) => v).join(' ').slice(0, 24),
+          points: s.points}));
+      if (series.length) rendered.push(lineChart(title, series, 320, 110));
+    });
+    const div = $('cluster-charts');
+    if (rendered.length) {
+      div.textContent = '';
+      div.classList.remove('muted');
+      rendered.forEach(svg => div.appendChild(svg));
+    }
+  } catch (e) { /* plane not up yet: leave the placeholder */ }
+}
+
 function pager(el, page, total, onchange, redraw = 'refresh') {
   const pages = Math.max(1, Math.ceil(total / PAGE_SIZE));
   el.innerHTML = `page ${page + 1}/${pages} · ${total} total ` +
@@ -713,6 +784,7 @@ async function refresh() {
       $('logs').scrollTop = $('logs').scrollHeight;
     }
     await refreshAdmin();
+    await refreshClusterHealth();
   } catch (e) { console.error(e); }
 }
 // --- hash router (#/experiments/<id>, #/trials/<id>) -------------------

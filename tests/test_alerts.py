@@ -1,0 +1,470 @@
+"""Alert/SLO rules engine (master/alerts.py): rule validation, the four
+rule forms against known-answer series, the pending→firing→resolved
+lifecycle with dedupe, and the end-to-end drill — shipped default rules
+firing and resolving through the REAL webhook shipper, driven
+deterministically by DTPU_FAULT_PLAN on the master.scrape site."""
+import json
+import math
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from determined_tpu.common import faults
+from determined_tpu.common.tsdb import TSDB
+from determined_tpu.master.alerts import (
+    DEFAULT_RULES,
+    AlertEngine,
+    resolve_rules,
+    validate_rule,
+)
+
+
+class _Shipper:
+    def __init__(self):
+        self.shipped = []
+
+    def ship_alert(self, payload):
+        self.shipped.append(payload)
+
+    def of(self, name, state=None):
+        return [
+            p for p in self.shipped
+            if p["alert"] == name and (state is None or p["state"] == state)
+        ]
+
+
+def _engine(rules, tsdb=None):
+    shipper = _Shipper()
+    tsdb = tsdb or TSDB(min_step_s=0, stale_after_s=1e9)
+    return AlertEngine(tsdb, rules, shipper, interval_s=0), tsdb, shipper
+
+
+THRESH = {
+    "name": "t", "kind": "threshold", "metric": "g", "func": "instant",
+    "op": ">", "value": 10.0, "for_s": 0.0, "severity": "warning",
+}
+
+
+class TestRuleValidation:
+    def test_good_rules_pass(self):
+        for rule in DEFAULT_RULES:
+            assert validate_rule(rule) == []
+
+    def test_bad_kind_named(self):
+        errs = validate_rule({"name": "x", "kind": "wat"})
+        assert any("kind 'wat'" in e for e in errs)
+
+    def test_missing_fields_named(self):
+        errs = validate_rule({"name": "x", "kind": "burn_rate"})
+        assert any("metric" in e for e in errs)
+        assert any("objective" in e for e in errs)
+
+    def test_unknown_keys_named(self):
+        errs = validate_rule(dict(THRESH, bogus=1))
+        assert any("unknown keys" in e and "bogus" in e for e in errs)
+
+    def test_master_boot_rejects_bad_rule(self):
+        from determined_tpu.master.core import Master
+
+        with pytest.raises(ValueError, match="kind 'wat'"):
+            Master(alerts_config={"rules": [{"name": "x", "kind": "wat"}]})
+
+    def test_masterconf_rejects_bad_knobs(self):
+        from determined_tpu.master import masterconf
+
+        with pytest.raises(ValueError, match="unknown key 'scrap_interval'"):
+            masterconf.validate(metrics={"scrap_interval": 1})
+        with pytest.raises(ValueError, match="interval_s"):
+            masterconf.validate(alerts={"interval_s": -1})
+
+    def test_resolve_rules_override_by_name(self):
+        rules = resolve_rules({
+            "rules": [dict(THRESH, name="stall_kills")],
+        })
+        assert len(rules) == len(DEFAULT_RULES)
+        (stall,) = [r for r in rules if r["name"] == "stall_kills"]
+        assert stall["kind"] == "threshold" and stall["metric"] == "g"
+        assert resolve_rules({"default_rules": False}) == []
+
+
+class TestThresholdLifecycle:
+    def test_fire_dedupe_resolve(self):
+        engine, tsdb, shipper = _engine([dict(THRESH)])
+        tsdb.ingest("m", {("g", ()): 20.0}, ts=1000.0)
+        engine.evaluate(now=1001.0)
+        engine.evaluate(now=1002.0)  # still violating: must dedupe
+        assert len(shipper.of("t", "firing")) == 1
+        (active,) = engine.active()
+        assert active["state"] == "firing" and active["value"] == 20.0
+        tsdb.ingest("m", {("g", ()): 5.0}, ts=1003.0)
+        engine.evaluate(now=1004.0)
+        engine.evaluate(now=1005.0)
+        assert len(shipper.of("t", "resolved")) == 1
+        assert engine.active() == []
+        assert engine.history()[-1]["rule"] == "t"
+
+    def test_for_s_holds_pending(self):
+        engine, tsdb, shipper = _engine([dict(THRESH, for_s=60.0)])
+        tsdb.ingest("m", {("g", ()): 20.0}, ts=1000.0)
+        engine.evaluate(now=1001.0)
+        assert engine.active()[0]["state"] == "pending"
+        assert shipper.shipped == []
+        tsdb.ingest("m", {("g", ()): 20.0}, ts=1050.0)
+        engine.evaluate(now=1062.0)  # 61s past first violation
+        assert engine.active()[0]["state"] == "firing"
+        assert len(shipper.of("t", "firing")) == 1
+
+    def test_pending_clears_silently(self):
+        engine, tsdb, shipper = _engine([dict(THRESH, for_s=60.0)])
+        tsdb.ingest("m", {("g", ()): 20.0}, ts=1000.0)
+        engine.evaluate(now=1001.0)
+        tsdb.ingest("m", {("g", ()): 1.0}, ts=1002.0)
+        engine.evaluate(now=1003.0)
+        assert engine.active() == [] and shipper.shipped == []
+
+    def test_per_series_instances(self):
+        engine, tsdb, shipper = _engine([dict(THRESH, op="<")])
+        tsdb.ingest("m", {
+            ("g", (("experiment", "1"),)): 3.0,
+            ("g", (("experiment", "2"),)): 4.0,
+            ("g", (("experiment", "3"),)): 50.0,
+        }, ts=1000.0)
+        engine.evaluate(now=1001.0)
+        assert len(engine.active()) == 2
+        assert len(shipper.of("t", "firing")) == 2
+
+    def test_increase_func(self):
+        rule = dict(THRESH, func="increase", window_s=100.0, value=5.0)
+        engine, tsdb, shipper = _engine([rule])
+        tsdb.ingest("m", {("g", ()): 0.0}, ts=1000.0)
+        tsdb.ingest("m", {("g", ()): 4.0}, ts=1050.0)
+        engine.evaluate(now=1060.0)
+        assert engine.active() == []  # +4 <= 5
+        tsdb.ingest("m", {("g", ()): 10.0}, ts=1090.0)
+        engine.evaluate(now=1095.0)
+        assert engine.active()[0]["state"] == "firing"
+
+    def test_broken_rule_never_stops_the_rest(self):
+        # A rule whose evaluation explodes (engine-internal error) must
+        # log and skip, not mask the healthy rule after it.
+        engine, tsdb, shipper = _engine([
+            dict(THRESH, name="boom"), dict(THRESH, name="ok"),
+        ])
+        tsdb.ingest("m", {("g", ()): 20.0}, ts=1000.0)
+        engine.rules[0]["op"] = "not-an-op"  # post-validation corruption
+        engine.evaluate(now=1001.0)
+        assert [a["rule"] for a in engine.active()] == ["ok"]
+
+
+class TestRatioAbsenceBurn:
+    def test_ratio_fires_on_fraction(self):
+        rule = {
+            "name": "shed", "kind": "ratio",
+            "num": {"metric": "shed_total", "func": "increase",
+                    "window_s": 100.0},
+            "den": {"metric": "req_total", "func": "increase",
+                    "window_s": 100.0},
+            "op": ">", "value": 0.05, "for_s": 0.0,
+        }
+        engine, tsdb, shipper = _engine([rule])
+        tsdb.ingest("m", {("shed_total", ()): 0.0, ("req_total", ()): 0.0},
+                    ts=1000.0)
+        tsdb.ingest("m", {("shed_total", ()): 2.0, ("req_total", ()): 100.0},
+                    ts=1050.0)
+        engine.evaluate(now=1060.0)
+        assert engine.active() == []  # 2% <= 5%
+        tsdb.ingest("m", {("shed_total", ()): 12.0, ("req_total", ()): 150.0},
+                    ts=1090.0)
+        engine.evaluate(now=1095.0)
+        (a,) = engine.active()
+        assert a["value"] == pytest.approx(12.0 / 150.0)
+
+    def test_ratio_rule_level_match_scopes_both_expressions(self):
+        # Review fix: a rule-level `match` must filter num AND den — it
+        # validated fine but was silently ignored.
+        rule = {
+            "name": "shed", "kind": "ratio",
+            "match": {"instance": "r1"},
+            "num": {"metric": "shed_total", "func": "increase",
+                    "window_s": 100.0},
+            "den": {"metric": "req_total", "func": "increase",
+                    "window_s": 100.0},
+            "op": ">", "value": 0.5, "for_s": 0.0,
+        }
+        engine, tsdb, shipper = _engine([rule])
+        for ts, r1_shed, r2_shed in [(1000.0, 0.0, 0.0), (1050.0, 9.0, 0.0)]:
+            tsdb.ingest("r1", {("shed_total", ()): r1_shed,
+                               ("req_total", ()): ts / 100}, ts=ts)
+            tsdb.ingest("r2", {("shed_total", ()): r2_shed,
+                               ("req_total", ()): ts}, ts=ts)
+        # r1 alone: 9 shed / 0.5 requests → fires. Summed across both
+        # instances the huge r2 denominator would dilute it to silence.
+        engine.evaluate(now=1060.0)
+        (a,) = engine.active()
+        assert a["rule"] == "shed" and a["value"] > 0.5
+
+    def test_firing_gauge_publishes_zero_on_resolve(self):
+        # Review fix: the resolve edge must be observable as 1 → 0, not
+        # as the series vanishing from the exposition.
+        from determined_tpu.common.metrics import REGISTRY
+
+        engine, tsdb, shipper = _engine([dict(THRESH, name="edge_rule")])
+        gauge = REGISTRY.get("dtpu_alerts_firing")
+        tsdb.ingest("m", {("g", ()): 20.0}, ts=1000.0)
+        engine.evaluate(now=1001.0)
+        assert gauge.labels("edge_rule").value == 1.0
+        tsdb.ingest("m", {("g", ()): 1.0}, ts=1002.0)
+        engine.evaluate(now=1003.0)
+        assert gauge.labels("edge_rule").value == 0.0  # present, at 0
+
+    def test_ratio_no_data_no_fire(self):
+        rule = {
+            "name": "shed", "kind": "ratio",
+            "num": {"metric": "shed_total", "func": "increase"},
+            "den": {"metric": "req_total", "func": "increase"},
+            "op": ">", "value": 0.0,
+        }
+        engine, _, _ = _engine([rule])
+        engine.evaluate(now=1000.0)
+        assert engine.active() == []
+
+    def test_absence_fires_when_a_seen_series_goes_silent(self):
+        rule = {"name": "gone", "kind": "absence", "metric": "beat",
+                "window_s": 60.0, "for_s": 0.0}
+        engine, tsdb, shipper = _engine([rule])
+        tsdb.ingest("m", {("beat", ()): 1.0}, ts=1000.0)
+        engine.evaluate(now=1030.0)
+        assert engine.active() == []  # fresh
+        engine.evaluate(now=1100.0)   # 100s silent > 60
+        (a,) = engine.active()
+        assert a["state"] == "firing" and a["value"] == pytest.approx(100.0)
+        tsdb.ingest("m", {("beat", ()): 2.0}, ts=1110.0)
+        engine.evaluate(now=1120.0)
+        assert engine.active() == []
+        assert len(shipper.of("gone", "resolved")) == 1
+
+    def test_burn_rate_known_answer(self):
+        rule = {
+            "name": "slo", "kind": "burn_rate", "metric": "lat_seconds",
+            "le": 0.5, "objective": 0.9, "window_s": 100.0,
+            "burn_factor": 4.0, "for_s": 0.0,
+        }
+        engine, tsdb, shipper = _engine([rule])
+
+        def obs(ts, good, total):
+            tsdb.ingest("m", {
+                ("lat_seconds_bucket", (("le", "0.5"),)): float(good),
+                ("lat_seconds_bucket", (("le", "+Inf"),)): float(total),
+                ("lat_seconds_count", ()): float(total),
+            }, ts=ts)
+
+        obs(1000.0, 0.0, 0.0)
+        obs(1050.0, 97.0, 100.0)  # 3% bad / 10% budget = burn 0.3
+        engine.evaluate(now=1060.0)
+        assert engine.active() == []
+        obs(1090.0, 100.0, 200.0)  # window: 100 good of 200 → 50% bad
+        engine.evaluate(now=1095.0)
+        (a,) = engine.active()
+        # bad_fraction/budget = 0.5/0.1 = 5 >= 4
+        assert a["value"] == pytest.approx(5.0)
+        assert len(shipper.of("slo", "firing")) == 1
+
+
+class _WebhookSink:
+    """Local HTTP receiver recording alert webhook deliveries."""
+
+    def __init__(self):
+        self.payloads = []
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                outer.payloads.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self._httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}/hook"
+        threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        ).start()
+
+    def of(self, name, state):
+        return [
+            p for p in self.payloads
+            if p.get("event") == "alert" and p.get("alert") == name
+            and p.get("state") == state
+        ]
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class TestAlertWebhookEndToEnd:
+    """Satellite + acceptance: a shipped DEFAULT rule fires, dedupes on
+    repeat evaluation, and resolves on recovery — through the REAL
+    WebhookShipper, in a devcluster (real agent health port as the
+    scrape target), driven deterministically by DTPU_FAULT_PLAN on the
+    master.scrape site."""
+
+    def test_default_rule_fires_and_resolves_through_webhooks(self):
+        from determined_tpu.devcluster import DevCluster
+
+        sink = _WebhookSink()
+        try:
+            with DevCluster(
+                n_agents=1, slots_per_agent=1, agent_metrics=True,
+                metrics_config={"stale_after_s": 1e9},
+            ) as dc:
+                master = dc.master
+                # Synthetic clock only: the tick loop must not interleave
+                # real-time sweeps/evaluations with this drill's.
+                master.scraper.interval_s = math.inf
+                master.alert_engine.interval_s = math.inf
+                # The agent registers its health port as a scrape target.
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    info = master.agent_hub.list().get("agent-0")
+                    if info and info.get("metrics_addr"):
+                        break
+                    time.sleep(0.2)
+                assert master.agent_hub.list()["agent-0"]["metrics_addr"]
+
+                master.db.add_webhook(sink.url, ["ALERT"])
+                # Healthy baseline: the agent's health port answers and
+                # its series land in the TSDB under its instance label.
+                master.scraper.scrape_once(now=5000.0)
+                assert master.tsdb.instant(
+                    "dtpu_agent_tasks_started_total",
+                    {"instance": "agent-0"}, at=5000.0,
+                )
+                master.alert_engine.evaluate(now=5001.0)
+                # Assertions stay rule-scoped: the process-global REGISTRY
+                # may carry other tests' series into the self-scrape.
+                assert not [
+                    a for a in master.alert_engine.active()
+                    if a["rule"] == "scrape_target_down"
+                ]
+
+                plan = faults.FaultPlan({
+                    "master.scrape.agent-0": faults.FaultSpec(failures=99),
+                })
+                with faults.plan_active(plan):
+                    master.scraper.scrape_once(now=5030.0)
+                    master.scraper.scrape_once(now=5100.0)
+                # agent-0 stale 100s > the shipped 60s threshold.
+                master.alert_engine.evaluate(now=5101.0)
+                firing = [
+                    a for a in master.alert_engine.active()
+                    if a["rule"] == "scrape_target_down"
+                    and a["labels"].get("target") == "agent-0"
+                ]
+                assert firing and firing[0]["state"] == "firing"
+                assert firing[0]["severity"] == "warning"
+                # Repeat evaluation while still firing: DEDUPED.
+                master.alert_engine.evaluate(now=5102.0)
+
+                deadline = time.time() + 15
+                while (
+                    not sink.of("scrape_target_down", "firing")
+                    and time.time() < deadline
+                ):
+                    time.sleep(0.05)
+                assert len(sink.of("scrape_target_down", "firing")) == 1
+
+                # /api/v1/alerts surfaces the firing instance over HTTP.
+                import requests
+
+                out = requests.get(
+                    f"{dc.api.url}/api/v1/alerts", timeout=10
+                ).json()
+                assert any(
+                    a["rule"] == "scrape_target_down"
+                    and a["state"] == "firing"
+                    for a in out["alerts"]
+                )
+
+                # Recovery: the plan is gone, the target answers again.
+                master.scraper.scrape_once(now=5110.0)
+                master.alert_engine.evaluate(now=5111.0)
+                assert not [
+                    a for a in master.alert_engine.active()
+                    if a["rule"] == "scrape_target_down"
+                    and a["labels"].get("target") == "agent-0"
+                ]
+                deadline = time.time() + 15
+                while (
+                    not sink.of("scrape_target_down", "resolved")
+                    and time.time() < deadline
+                ):
+                    time.sleep(0.05)
+                assert len(sink.of("scrape_target_down", "resolved")) == 1
+                # Still exactly one firing delivery: the dedupe held.
+                assert len(sink.of("scrape_target_down", "firing")) == 1
+        finally:
+            sink.stop()
+
+    def test_divergence_report_reaches_counter_and_rule(self):
+        """Review fix: exit reports only carry the exit CODE, so the
+        harness names a divergence on its way down via POST
+        /trials/<id>/status {"event": "divergence"} — that must move the
+        counter the replica_divergence default rule watches."""
+        import requests
+
+        from determined_tpu.common.metrics import REGISTRY
+        from determined_tpu.master.api_server import ApiServer
+        from determined_tpu.master.core import Master
+
+        master = Master(metrics_config={"stale_after_s": 1e9})
+        master.scraper.interval_s = math.inf
+        master.alert_engine.interval_s = math.inf
+        api = ApiServer(master)
+        api.start()
+        try:
+            counter = REGISTRY.get("dtpu_sentinel_divergence_exits_total")
+            before = counter.value
+            master.scraper.scrape_once(now=6000.0)
+            requests.post(
+                f"{api.url}/api/v1/trials/7/status",
+                json={"event": "divergence",
+                      "detail": "rank 1 checksum mismatch"},
+                timeout=10,
+            ).raise_for_status()
+            assert counter.value == before + 1
+            master.scraper.scrape_once(now=6030.0)
+            master.alert_engine.evaluate(now=6031.0)
+            assert [
+                a for a in master.alert_engine.active()
+                if a["rule"] == "replica_divergence"
+                and a["state"] == "firing"
+            ]
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_alerts_api_route(self):
+        import requests
+
+        from determined_tpu.master.api_server import ApiServer
+        from determined_tpu.master.core import Master
+
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            out = requests.get(f"{api.url}/api/v1/alerts", timeout=10).json()
+            assert set(out) == {"alerts", "history", "rules"}
+            assert "scrape_target_down" in out["rules"]
+            assert "serving_ttft_slo_burn" in out["rules"]
+        finally:
+            api.stop()
+            master.shutdown()

@@ -112,6 +112,29 @@ class TestCLI:
         out = capsys.readouterr().out
         assert master.cluster_id in out
 
+    def test_metrics_and_alerts_verbs(self, live_master, capsys):
+        """`dtpu metrics query/series` + `dtpu alerts` over the
+        time-series plane (PR 9)."""
+        master, api = live_master
+        for i in range(3):
+            master.tsdb.ingest(
+                "t1", {("dtpu_cli_demo_total", ()): i * 6.0},
+                ts=1000.0 + i * 10,
+            )
+        self._run(api, "metrics", "query", "dtpu_cli_demo_total",
+                  "--func", "rate", "--window", "30", "--end", "1020",
+                  "-l", "instance=t1")
+        out = capsys.readouterr().out
+        assert "dtpu_cli_demo_total{instance=t1}" in out
+        assert "0.6" in out  # 12 over 20s
+        self._run(api, "metrics", "series", "dtpu_cli_demo_total")
+        out = capsys.readouterr().out
+        assert "instance=t1" in out and "series" in out
+        self._run(api, "alerts")
+        out = capsys.readouterr().out
+        assert "rules loaded:" in out
+        assert "scrape_target_down" in out
+
 
 class TestDownloadCode:
     def test_download_code_roundtrip(self, live_master, tmp_path, capsys):

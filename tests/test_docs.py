@@ -86,7 +86,10 @@ class TestGuides:
                       "multislice"),
         "deploy.md": ("deploy local", "deploy gcp", "deploy k8s",
                       "provisioner", "spot"),
-        "operations.md": ("drain", "DTPU_PG_DSN", "tunnel"),
+        "operations.md": ("drain", "DTPU_PG_DSN", "tunnel",
+                          # time-series plane (PR 9)
+                          "metrics/query", "burn_rate", "ALERT",
+                          "scrape_interval_s", "master.scrape"),
         "expconf-reference.md": ("slots_per_trial", "max_slots",
                                  "checkpoint_storage"),
     }

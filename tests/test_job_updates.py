@@ -177,7 +177,16 @@ class TestLiveUpdateE2E:
             "checkpoint_storage": {
                 "type": "shared_fs", "host_path": str(tmp_path / "ckpt"),
             },
-            "environment": {"jax_platform": "cpu"},
+            # 1 device per trial: these drills preempt-and-RESUME, and a
+            # resume under the conftest's 8-virtual-device XLA_FLAGS hits
+            # the known 8-device-restore glibc abort flake (same pinning
+            # as tests/test_elastic.py / test_devcluster restore drills).
+            "environment": {
+                "jax_platform": "cpu",
+                "variables": {
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                },
+            },
             "max_restarts": 0,
         }
         cfg.update(over)

@@ -46,3 +46,58 @@ class TestMasterConf:
     def test_master_boot_rejects_bad_config(self):
         with pytest.raises(ValueError, match="invalid master config"):
             Master(pools_config={"default": {"scheduler": {"type": "wat"}}})
+
+
+class TestTimeSeriesKnobs:
+    """PR 9: `metrics:`/`alerts:` masterconf sections (the time-series
+    plane's scrape cadence, TSDB bounds, and alert rules)."""
+
+    def test_valid_sections_pass(self):
+        masterconf.validate(
+            metrics={"scrape_interval_s": 5, "retention_points": 720,
+                     "max_series": 1000},
+            alerts={"interval_s": 2.0, "default_rules": False, "rules": []},
+        )
+
+    def test_typod_metrics_knob_named(self):
+        with pytest.raises(ValueError, match="unknown key 'scrape_intervall_s'"):
+            masterconf.validate(metrics={"scrape_intervall_s": 5})
+
+    def test_nonpositive_knobs_named(self):
+        with pytest.raises(ValueError, match="scrape_interval_s must be positive"):
+            masterconf.validate(metrics={"scrape_interval_s": 0})
+        with pytest.raises(ValueError, match="retention_points must be >= 2"):
+            masterconf.validate(metrics={"retention_points": 1})
+
+    def test_alert_rules_validated_with_named_errors(self):
+        with pytest.raises(ValueError, match="kind 'wat'"):
+            masterconf.validate(
+                alerts={"rules": [{"name": "r", "kind": "wat"}]}
+            )
+        with pytest.raises(ValueError, match="unknown keys.*bogus"):
+            masterconf.validate(alerts={"rules": [{
+                "name": "r", "kind": "threshold", "metric": "m",
+                "op": ">", "value": 1, "bogus": 2,
+            }]})
+
+    def test_all_plane_errors_reported_at_once(self):
+        with pytest.raises(ValueError) as exc:
+            masterconf.validate(
+                metrics={"max_series": -5},
+                alerts={"interval_s": "fast"},
+            )
+        msg = str(exc.value)
+        assert "max_series" in msg and "interval_s" in msg
+
+    def test_master_boot_applies_metrics_config(self):
+        m = Master(metrics_config={"retention_points": 16,
+                                   "max_series": 123,
+                                   "scrape_interval_s": 7.0})
+        try:
+            assert m.tsdb.max_points_per_series == 16
+            assert m.tsdb.max_series == 123
+            assert m.scraper.interval_s == 7.0
+            # stale_after derives from the scrape cadence when unset.
+            assert m.tsdb.stale_after_s == 21.0
+        finally:
+            m.shutdown()

@@ -239,15 +239,93 @@ class TestPagedDecodeSeriesObserved:
         assert engine.stats()["decode_kernel"] == "paged"
 
 
+class TestTimeSeriesPlaneRoutes:
+    """PR 9 satellite: the time-series plane's routes ride the SAME
+    instrumented dispatch path (so the sweep above covers them by
+    construction) — this pins their existence, and the scrape plane's
+    self-telemetry landing on the live /metrics surface."""
+
+    def test_new_routes_registered_on_the_dispatch_path(self):
+        master = Master()
+        try:
+            patterns = {
+                (method, pattern.pattern)
+                for method, pattern, _h in build_routes(master)
+            }
+        finally:
+            master.shutdown()
+        for path in (
+            "/api/v1/metrics/query",
+            "/api/v1/metrics/series",
+            "/api/v1/alerts",
+        ):
+            assert ("GET", f"^{path}$") in patterns
+
+    def test_scrape_self_telemetry_on_live_metrics_surface(self):
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            master.scraper.scrape_once()
+            master.scraper.scrape_once()
+            text = requests.get(f"{api.url}/metrics", timeout=30).text
+        finally:
+            api.stop()
+            master.shutdown()
+        samples = parse_exposition(text)
+        assert sample_value(
+            samples, "dtpu_scrape_duration_seconds_count", target="master"
+        ) >= 2
+        assert sample_value(
+            samples, "dtpu_scrape_staleness_seconds", target="master"
+        ) == 0.0
+        assert sample_value(samples, "dtpu_tsdb_series") > 0
+        assert sample_value(samples, "dtpu_tsdb_points") > 0
+
+    def test_tsdb_memory_capped_under_sustained_scrape_churn(self):
+        """Satellite: the TSDB's memory is bounded by construction — a
+        long scrape history AND a hostile label-cardinality churn leave
+        series/points at their caps, with the overflow counted."""
+        master = Master(metrics_config={
+            "retention_points": 8, "max_series": 300, "min_step_s": 0.001,
+            "retention_s": 1e9,
+        })
+        import math
+
+        master.scraper.interval_s = math.inf  # drive sweeps by hand
+        try:
+            for i in range(50):
+                master.scraper.scrape_once(now=1e6 + i * 10)
+            for i in range(5000):
+                master.tsdb.ingest(
+                    "churn",
+                    {("dtpu_churn_metric", (("k", str(i)),)): 1.0},
+                    ts=2e6 + i,
+                )
+            st = master.tsdb.stats()
+            assert st["series"] <= 300
+            assert st["points"] <= 300 * 8
+            assert st["dropped_series"] > 0
+            # One more sweep after the churn: the cap holds, the tick
+            # keeps running, and the overflow is published as telemetry.
+            master.scraper.scrape_once(now=3e6)
+            assert master.tsdb.stats()["series"] <= 300
+            assert REGISTRY.get("dtpu_tsdb_dropped_series").value > 0
+        finally:
+            master.shutdown()
+
+
 class TestNameDiscipline:
     def test_all_registered_names_are_dtpu_prefixed(self):
         # Importing the instrumented modules populates the registry.
         import determined_tpu.agent.agent  # noqa: F401
         import determined_tpu.common.resilience  # noqa: F401
+        import determined_tpu.master.alerts  # noqa: F401
         import determined_tpu.master.api_server  # noqa: F401
         import determined_tpu.master.core  # noqa: F401
         import determined_tpu.master.logsink  # noqa: F401
         import determined_tpu.master.rm  # noqa: F401
+        import determined_tpu.master.timeseries  # noqa: F401
         import determined_tpu.serving.engine  # noqa: F401
         import determined_tpu.serving.kv_cache  # noqa: F401
         import determined_tpu.serving.service  # noqa: F401

@@ -31,6 +31,33 @@ class TestWebUI:
         ):
             assert marker in html, marker
 
+    def test_cluster_health_panel_and_its_endpoints(self, live):
+        """PR 9: the cluster-health panel's markup + the /api/v1/alerts
+        and /api/v1/metrics/query shapes its JS destructures."""
+        master, api = live
+        html = requests.get(f"{api.url}/ui", timeout=10).text
+        for marker in ("Cluster health", "refreshClusterHealth",
+                       "cluster-charts", "api/v1/alerts",
+                       "api/v1/metrics/query"):
+            assert marker in html, marker
+        out = requests.get(f"{api.url}/api/v1/alerts", timeout=10).json()
+        assert isinstance(out["alerts"], list)
+        assert isinstance(out["rules"], list)
+        # A range query the sparklines make: result entries carry labels
+        # + points even when empty.
+        master.tsdb.ingest(
+            "m", {("dtpu_ui_demo_total", ()): 4.0},
+        )
+        out = requests.get(
+            f"{api.url}/api/v1/metrics/query",
+            params={"name": "dtpu_ui_demo_total", "func": "raw",
+                    "start": "0"},
+            timeout=10,
+        ).json()
+        (series,) = out["result"]
+        assert series["labels"]["instance"] == "m"
+        assert len(series["points"]) == 1
+
     def test_experiment_actions_the_buttons_call(self, live):
         """The pause/activate/kill endpoints the UI's action buttons hit."""
         master, api = live
