@@ -75,12 +75,18 @@ class AgentMetricsServer:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         class _Handler(BaseHTTPRequestHandler):
+            # same Nagle × delayed-ACK fix as the master's ApiServer:
+            # scrape round-trips must not pay a 40 ms idle tax.
+            disable_nagle_algorithm = True
+
             def log_message(self, fmt: str, *args: Any) -> None:
                 logger.debug("metrics http: " + fmt, *args)
 
             def do_GET(self) -> None:  # noqa: N802
                 if self.path.split("?")[0] == "/metrics":
-                    body = METRICS.render().encode()
+                    # exemplars ride as comment lines (parsers skip them;
+                    # the master's scrape sweep harvests them).
+                    body = METRICS.render(exemplars=True).encode()
                     ctype = "text/plain; version=0.0.4"
                 elif self.path.split("?")[0] == "/healthz":
                     body, ctype = b"ok\n", "text/plain"
@@ -244,6 +250,10 @@ class AgentDaemon:
         self.devices = detect_devices(slots)
         self.pool = pool
         self.session = Session(master_url, token=token)
+        # Trace plane: this daemon's spans (agent.task_launch) ship to the
+        # master's trace store — the agent has no launch env to
+        # self-configure from, so it points the shipper explicitly.
+        trace_mod.configure_shipper(master_url, token)
         self.python_exe = python_exe or sys.executable
         # State dir is the reattach anchor: task state files, log files and
         # exit files live here. An ephemeral default still gives master-
@@ -392,6 +402,10 @@ class AgentDaemon:
     def stop(self) -> None:
         self._stop.set()
         self._kill_all_tasks()
+        # Ship the tail span batch before the process (or test) moves on:
+        # the launch spans of just-killed tasks are exactly what a
+        # post-mortem wants.
+        trace_mod.flush_shipper()
         if self.metrics is not None:
             self.metrics.stop()
             self.metrics = None

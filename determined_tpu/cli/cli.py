@@ -979,6 +979,80 @@ def metrics_series_cmd(args: argparse.Namespace) -> None:
     )
 
 
+def traces_list_cmd(args: argparse.Namespace) -> None:
+    """`dtpu traces list [--experiment N] [--status error]
+    [--min-duration-ms X] [--root NAME]` — trace summaries from the
+    master's trace store, newest first."""
+    params: Dict[str, Any] = {"limit": str(args.limit)}
+    if args.experiment is not None:
+        params["experiment"] = str(args.experiment)
+    if args.status:
+        params["status"] = args.status
+    if args.root:
+        params["root"] = args.root
+    if args.min_duration_ms is not None:
+        params["min_duration_ms"] = str(args.min_duration_ms)
+    out = _session(args).get("/api/v1/traces", params=params)
+    traces = out.get("traces", [])
+    if not traces:
+        print("(no matching traces)")
+    for t in traces:
+        stamp = time.strftime("%H:%M:%S", time.localtime(t["start"]))
+        exp = t.get("experiment_id")
+        print(
+            f"{t['trace_id']}  {stamp}  {t['duration_ms']:>9.1f}ms  "
+            f"{t['status']:<5}  exp={exp if exp is not None else '-':<5}  "
+            f"{t['span_count']:>3} span(s)  {t['root']}"
+        )
+    st = out.get("stats", {})
+    print(
+        f"-- {st.get('traces', 0)}/{st.get('max_traces', 0)} traces, "
+        f"{st.get('spans', 0)} spans held"
+    )
+
+
+def traces_show_cmd(args: argparse.Namespace) -> None:
+    """`dtpu traces show TRACE_ID` — the assembled span tree as a text
+    waterfall plus the derived lifecycle critical path."""
+    t = _session(args).get(f"/api/v1/traces/{args.trace_id}")
+    print(
+        f"trace {t['trace_id']}  {t['duration_ms']:g}ms  {t['status']}"
+        + (
+            f"  experiment={t['experiment_id']}"
+            if t.get("experiment_id") is not None else ""
+        )
+        + (
+            f"  ({t['dropped_spans']} span(s) dropped at cap)"
+            if t.get("dropped_spans") else ""
+        )
+    )
+    start_ns = min(
+        (s["start_ns"] for s in t.get("tree", [])), default=0
+    )
+    total_ms = max(t.get("duration_ms", 0.0), 1e-9)
+
+    def walk(nodes, depth):
+        for s in nodes:
+            off_ms = (s["start_ns"] - start_ns) / 1e6
+            # 40-column waterfall bar: position = offset, width = duration.
+            lo = max(0, min(39, int(40 * off_ms / total_ms)))
+            hi = max(lo + 1, int(40 * (off_ms + s["duration_ms"]) / total_ms))
+            bar = " " * lo + "█" * min(40 - lo, hi - lo)
+            err = "  ERROR" if s.get("error") else ""
+            print(
+                f"  |{bar:<40}| {'  ' * depth}{s['name']}  "
+                f"+{off_ms:.1f}ms {s['duration_ms']:g}ms{err}"
+            )
+            walk(s.get("children", []), depth + 1)
+
+    walk(t.get("tree", []), 0)
+    cp = t.get("critical_path") or []
+    if cp:
+        print("critical path: " + "  ".join(
+            f"{seg['segment']}={seg['seconds']:.3f}s" for seg in cp
+        ))
+
+
 def alerts_list(args: argparse.Namespace) -> None:
     out = _session(args).get("/api/v1/alerts")
     alerts = out.get("alerts", [])
@@ -1389,6 +1463,23 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("name", nargs="?", default=None,
                    help="optional family filter")
     v.set_defaults(fn=metrics_series_cmd)
+
+    traces = sub.add_parser("traces").add_subparsers(
+        dest="verb", required=True)
+    v = traces.add_parser("list")
+    v.add_argument("--experiment", type=int, default=None,
+                   help="only traces tagged with this experiment id")
+    v.add_argument("--status", default=None, choices=["ok", "error"])
+    v.add_argument("--root", default=None,
+                   help="substring filter on the root span name")
+    v.add_argument("--min-duration-ms", type=float, default=None,
+                   dest="min_duration_ms")
+    v.add_argument("--limit", type=int, default=20)
+    v.set_defaults(fn=traces_list_cmd)
+    v = traces.add_parser("show")
+    v.add_argument("trace_id", help="32-hex trace id (from traces list "
+                                    "or a metrics-query exemplar)")
+    v.set_defaults(fn=traces_show_cmd)
 
     alerts = sub.add_parser("alerts")
     alerts.add_argument("--history", action="store_true",

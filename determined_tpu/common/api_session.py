@@ -70,8 +70,13 @@ class Session:
         # Trace root: with no ambient span (bare CLI/SDK use), every call
         # this Session makes still shares ONE trace — `det experiment
         # create` and the polls that follow it reassemble into a single
-        # submit trace on the master side.
+        # submit trace on the master side. ROTATED for long-lived owners
+        # (see _session_root): an agent daemon polling once a second
+        # through one forever-root would hit the trace store's per-trace
+        # span cap within minutes and then count a steady stream of
+        # bogus "span loss" forever.
         self._trace_root = (trace_mod.new_trace_id(), trace_mod.new_span_id())
+        self._trace_root_uses = 0
         self._http = requests.Session()
         self._verify: Any = None
         if self.master_url.startswith("https:"):
@@ -93,6 +98,22 @@ class Session:
                 )
         if token:
             self._http.headers["Authorization"] = f"Bearer {token}"
+
+    #: Fallback-root rotation period: well under the trace store's
+    #: per-trace span cap (512), far above any CLI session's call count —
+    #: a `dtpu experiment create` plus its polls stay one trace, a daemon
+    #: gets a fresh trace per window instead of a capped forever-trace.
+    TRACE_ROOT_MAX_USES = 256
+
+    def _session_root(self) -> tuple:
+        self._trace_root_uses += 1
+        if self._trace_root_uses > self.TRACE_ROOT_MAX_USES:
+            # Benign under concurrency: the worst case is two fresh roots.
+            self._trace_root = (
+                trace_mod.new_trace_id(), trace_mod.new_span_id()
+            )
+            self._trace_root_uses = 1
+        return self._trace_root
 
     @property
     def token(self) -> str:
@@ -118,7 +139,7 @@ class Session:
         # inherited), else this Session's own root — the master extracts
         # it and parents its request span, so one trace id follows the
         # work across processes.
-        ctx = trace_mod.current() or self._trace_root
+        ctx = trace_mod.current() or self._session_root()
         req_headers.setdefault(
             "traceparent", trace_mod.format_traceparent(*ctx)
         )

@@ -71,6 +71,20 @@ def _labels_text(pairs: Sequence[Tuple[str, str]]) -> str:
     return "{" + inner + "}"
 
 
+def _exemplar_line(series: str, ex: Tuple[str, float, float]) -> str:
+    """One exemplar as a comment line the 0.0.4 text format tolerates:
+    `# EXEMPLAR <series> <trace_id> <value> <ts>`. Lenient AND strict
+    parsers skip `#` comments, so exposition round-trips are unaffected;
+    the master's scrape sweep harvests these via parse_exemplars so a
+    remote target's exemplars (serving TTFT, agent-side latencies) reach
+    the query API."""
+    trace_id, value, ts = ex
+    return (
+        f"# EXEMPLAR {series} {trace_id} {_fmt_value(value)} "
+        f"{repr(float(ts))}"
+    )
+
+
 class _Child:
     """One labeled series of a family (or the single series of a
     label-less family)."""
@@ -110,7 +124,8 @@ class _GaugeChild(_Child):
 
 
 class _HistogramChild:
-    __slots__ = ("_lock", "_buckets", "_counts", "_sum", "_count")
+    __slots__ = ("_lock", "_buckets", "_counts", "_sum", "_count",
+                 "_exemplars")
 
     def __init__(self, buckets: Tuple[float, ...]) -> None:
         self._lock = threading.Lock()
@@ -118,20 +133,39 @@ class _HistogramChild:
         self._counts = [0] * len(buckets)
         self._sum = 0.0
         self._count = 0
+        # Last exemplar per bucket (index len(buckets) = +Inf):
+        # (trace_id, observed value, unix ts) — the OpenMetrics exemplar
+        # model, which is what lets a histogram_quantile answer name the
+        # concrete trace behind it.
+        self._exemplars: List[Optional[Tuple[str, float, float]]] = (
+            [None] * (len(buckets) + 1)
+        )
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
+        import time as _time
+
         with self._lock:
             self._sum += value
             self._count += 1
             # Per-bucket tally; render() emits the cumulative `le` series.
+            idx = len(self._buckets)
             for i, b in enumerate(self._buckets):
                 if value <= b:
                     self._counts[i] += 1
+                    idx = i
                     break
+            if trace_id:
+                self._exemplars[idx] = (
+                    str(trace_id), float(value), _time.time()
+                )
 
     def snapshot(self) -> Tuple[List[int], float, int]:
         with self._lock:
             return list(self._counts), self._sum, self._count
+
+    def exemplars_snapshot(self) -> List[Optional[Tuple[str, float, float]]]:
+        with self._lock:
+            return list(self._exemplars)
 
 
 class _Family:
@@ -221,7 +255,7 @@ class _Family:
         with self._lock:
             return list(self._children.items())
 
-    def render(self) -> List[str]:
+    def render(self, exemplars: bool = False) -> List[str]:
         lines = [
             f"# HELP {self.name} {_escape_help(self.help)}",
             f"# TYPE {self.name} {self.kind}",
@@ -289,10 +323,28 @@ class Histogram(_Family):
     def _new_child(self) -> _HistogramChild:
         return _HistogramChild(self.buckets)
 
-    def observe(self, value: float) -> None:
-        self._default_child().observe(value)
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
+        self._default_child().observe(value, trace_id=trace_id)
 
-    def render(self) -> List[str]:
+    def exemplars(self) -> List[Dict[str, Any]]:
+        """Per-bucket last exemplars across all children, as flat rows:
+        {"labels": {..., "le": bound}, "trace_id", "value", "ts"} —
+        the shape the metrics query API attaches to quantile answers."""
+        out: List[Dict[str, Any]] = []
+        for vals, child in sorted(self._iter_children()):
+            base = dict(zip(self.labelnames, vals))
+            bounds = [_fmt_value(b) for b in self.buckets] + ["+Inf"]
+            for le, ex in zip(bounds, child.exemplars_snapshot()):
+                if ex is None:
+                    continue
+                trace_id, value, ts = ex
+                out.append({
+                    "labels": dict(base, le=le),
+                    "trace_id": trace_id, "value": value, "ts": ts,
+                })
+        return out
+
+    def render(self, exemplars: bool = False) -> List[str]:
         lines = [
             f"# HELP {self.name} {_escape_help(self.help)}",
             f"# TYPE {self.name} {self.kind}",
@@ -300,17 +352,26 @@ class Histogram(_Family):
         for vals, child in sorted(self._iter_children()):
             pairs = list(zip(self.labelnames, vals))
             counts, total, count = child.snapshot()
+            exs = child.exemplars_snapshot() if exemplars else None
             cum = 0
-            for b, c in zip(self.buckets, counts):
+            for i, (b, c) in enumerate(zip(self.buckets, counts)):
                 cum += c
-                lines.append(
+                series = (
                     f"{self.name}_bucket"
-                    f"{_labels_text(pairs + [('le', _fmt_value(b))])} {cum}"
+                    f"{_labels_text(pairs + [('le', _fmt_value(b))])}"
                 )
-            lines.append(
+                lines.append(f"{series} {cum}")
+                if exs is not None and exs[i] is not None:
+                    lines.append(_exemplar_line(series, exs[i]))
+            series = (
                 f"{self.name}_bucket"
-                f"{_labels_text(pairs + [('le', '+Inf')])} {count}"
+                f"{_labels_text(pairs + [('le', '+Inf')])}"
             )
+            lines.append(f"{series} {count}")
+            if exs is not None and exs[len(self.buckets)] is not None:
+                lines.append(
+                    _exemplar_line(series, exs[len(self.buckets)])
+                )
             lines.append(
                 f"{self.name}_sum{_labels_text(pairs)} {_fmt_value(total)}"
             )
@@ -389,12 +450,12 @@ class MetricsRegistry:
         with self._lock:
             return self._families.get(name)
 
-    def render(self) -> str:
+    def render(self, exemplars: bool = False) -> str:
         with self._lock:
             fams = [self._families[n] for n in sorted(self._families)]
         out: List[str] = []
         for fam in fams:
-            out.extend(fam.render())
+            out.extend(fam.render(exemplars=exemplars))
         return "\n".join(out) + "\n"
 
 
@@ -436,6 +497,29 @@ def _unescape_label_value(v: str) -> str:
             out.append(ch)
             i += 1
     return "".join(out)
+
+
+def _scan_label_block(labelblock: str) -> List[Tuple[str, str]]:
+    """Anchored sequential scan of a `a="x",b="y"` block: every byte must
+    be a well-formed pair or a separating comma — finditer-style scanning
+    would silently skip garbage between pairs, which is exactly what a
+    STRICT parser must reject. Shared by the sample parser and the
+    exemplar harvester."""
+    labels: List[Tuple[str, str]] = []
+    pos = 0
+    while pos < len(labelblock):
+        pm = _LABEL_PAIR_RE.match(labelblock, pos)
+        if pm is None:
+            raise ValueError("malformed label block")
+        labels.append((pm.group(1), _unescape_label_value(pm.group(2))))
+        pos = pm.end()
+        if pos < len(labelblock):
+            if labelblock[pos] != ",":
+                raise ValueError("malformed label block")
+            pos += 1
+            if pos == len(labelblock):
+                raise ValueError("trailing comma in label block")
+    return labels
 
 
 def _parse_value(s: str) -> float:
@@ -514,32 +598,10 @@ def parse_exposition(
             )
         labels: List[Tuple[str, str]] = []
         if labelblock:
-            # Anchored sequential scan: every byte of the block must be a
-            # well-formed pair or a separating comma — finditer-style
-            # scanning would silently skip garbage between pairs, which is
-            # exactly what a STRICT parser must reject.
-            pos = 0
-            while pos < len(labelblock):
-                pm = _LABEL_PAIR_RE.match(labelblock, pos)
-                if pm is None:
-                    raise ValueError(
-                        f"line {lineno}: malformed label block: {line!r}"
-                    )
-                labels.append(
-                    (pm.group(1), _unescape_label_value(pm.group(2)))
-                )
-                pos = pm.end()
-                if pos < len(labelblock):
-                    if labelblock[pos] != ",":
-                        raise ValueError(
-                            f"line {lineno}: malformed label block: {line!r}"
-                        )
-                    pos += 1
-                    if pos == len(labelblock):
-                        raise ValueError(
-                            f"line {lineno}: trailing comma in label "
-                            f"block: {line!r}"
-                        )
+            try:
+                labels = _scan_label_block(labelblock)
+            except ValueError as e:
+                raise ValueError(f"line {lineno}: {e}: {line!r}")
         try:
             value = _parse_value(rawvalue)
         except ValueError:
@@ -549,6 +611,38 @@ def parse_exposition(
             raise ValueError(f"line {lineno}: duplicate series {key}")
         samples[key] = value
     return samples
+
+
+_EXEMPLAR_RE = re.compile(
+    r"^# EXEMPLAR ([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(.*)\})?"
+    r" (\S+) (\S+) (\S+)$"
+)
+
+
+def parse_exemplars(
+    text: str,
+) -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Tuple[str, float, float]]:
+    """Harvest `# EXEMPLAR` comment lines from an exposition page:
+    {(series_name, sorted label tuple incl. le): (trace_id, value, ts)}.
+    Best-effort by design (a malformed exemplar line is skipped, not
+    fatal): exemplars are debugging sugar riding a comment channel, and a
+    target must never fail its scrape over one."""
+    out: Dict[
+        Tuple[str, Tuple[Tuple[str, str], ...]], Tuple[str, float, float]
+    ] = {}
+    for line in text.splitlines():
+        m = _EXEMPLAR_RE.match(line)
+        if m is None:
+            continue
+        name, labelblock, trace_id, rawvalue, rawts = m.groups()
+        try:
+            labels = _scan_label_block(labelblock) if labelblock else []
+            value, ts = _parse_value(rawvalue), float(rawts)
+        except ValueError:
+            continue
+        out[(name, tuple(sorted(labels)))] = (trace_id, value, ts)
+    return out
 
 
 def sample_value(

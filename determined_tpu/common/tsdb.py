@@ -91,6 +91,10 @@ class TSDB:
         self.stale_after_s = float(stale_after_s)
         self.dropped_series = 0
         self._series: Dict[SeriesKey, _Series] = {}
+        #: last harvested exemplar per STORED series (trace_id, value, ts)
+        #: — admission piggybacks on the series map, so exemplar
+        #: cardinality is bounded by max_series by construction.
+        self._exemplars: Dict[SeriesKey, Tuple[str, float, float]] = {}
         self._lock = threading.Lock()
 
     # -- ingest ---------------------------------------------------------------
@@ -139,6 +143,55 @@ class TSDB:
                 stored += 1
         return stored
 
+    def note_exemplars(
+        self,
+        instance: str,
+        exemplars: Dict[
+            Tuple[str, Tuple[Tuple[str, str], ...]],
+            Tuple[str, float, float],
+        ],
+    ) -> int:
+        """Record one scrape's harvested exemplars (parse_exemplars
+        output) for `instance`. Only series the store already holds get
+        one — exemplar memory can never exceed series memory. Returns
+        exemplars stored."""
+        stored = 0
+        with self._lock:
+            for (name, labels), ex in exemplars.items():
+                key = (
+                    name,
+                    tuple(sorted(dict(labels, instance=instance).items())),
+                )
+                if key not in self._series:
+                    continue
+                self._exemplars[key] = ex
+                stored += 1
+        return stored
+
+    def exemplars(
+        self, name: str, matchers: Optional[Dict[str, str]] = None
+    ) -> List[Dict[str, Any]]:
+        """Stored exemplars for `name`'s bucket series (or `name` itself
+        when it already ends in _bucket) — the trace ids behind a
+        histogram_quantile answer, newest-harvest last-write-wins."""
+        matchers = matchers or {}
+        names = {name} if name.endswith("_bucket") else {name + "_bucket"}
+        out = []
+        with self._lock:
+            items = list(self._exemplars.items())
+        for (series_name, labels), (trace_id, value, ts) in items:
+            if series_name not in names:
+                continue
+            ld = dict(labels)
+            if any(ld.get(k) != v for k, v in matchers.items()):
+                continue
+            out.append({
+                "labels": ld, "trace_id": trace_id,
+                "value": value, "ts": ts,
+            })
+        out.sort(key=lambda e: e["ts"], reverse=True)
+        return out
+
     def drop_instance(self, instance: str) -> int:
         """Forget every series of a vanished scrape target (agent removed,
         serving task exited): its history must not linger at full
@@ -150,6 +203,7 @@ class TSDB:
             ]
             for k in victims:
                 del self._series[k]
+                self._exemplars.pop(k, None)
         return len(victims)
 
     # -- selection ------------------------------------------------------------

@@ -33,6 +33,7 @@ class DevCluster:
         agent_metrics: bool = False,
         metrics_config: Optional[Dict[str, Any]] = None,
         alerts_config: Optional[Dict[str, Any]] = None,
+        traces_config: Optional[Dict[str, Any]] = None,
     ) -> None:
         #: agent_metrics=True gives every agent an ephemeral health port
         #: (+ registers it as a master scrape target) — opt-in so the
@@ -53,6 +54,7 @@ class DevCluster:
             trace_file=trace_file,
             metrics_config=metrics_config,
             alerts_config=alerts_config,
+            traces_config=traces_config,
         )
         self._cert_env_prev: Optional[str] = None
         self._tls_dir: Optional[str] = None
@@ -165,6 +167,12 @@ class DevCluster:
             agent.stop()
         self.master.shutdown()
         self.api.stop()
+        # The agents pointed the process-global span shipper at this
+        # master; drop it so later in-process spans (next test's cluster)
+        # don't ship to a dead port.
+        from determined_tpu.common import trace as trace_mod
+
+        trace_mod.reset_shipper()
         self._restore_tls_state()
 
     def __enter__(self) -> "DevCluster":

@@ -71,6 +71,17 @@ class SyntheticTrial(JAXTrial):
         return [next(it) for _ in range(2)]
 
 
+class CrashingTrial(SyntheticTrial):
+    """Fails deterministically at model build — the e2e fixture for
+    error-path drills (restart budget, errored-trace retention under
+    tail sampling). `crash_message` hparam names the raise."""
+
+    def build_model(self, mesh):
+        raise RuntimeError(
+            str(self.hparams.get("crash_message", "CrashingTrial: boom"))
+        )
+
+
 class LearnableTrial(SyntheticTrial):
     """Deterministic learnable task (linear labels): loss actually falls,
     so HP-search e2e tests can distinguish good lrs from bad ones."""

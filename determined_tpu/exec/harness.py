@@ -97,8 +97,6 @@ def run(entrypoint: str) -> int:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     assert info is not None and info.trial is not None, "harness needs a trial env"
 
-    from determined_tpu.trainer._trainer import ElasticResizeExit
-
     # Elastic resize loop: a resize directive exits Trainer.fit with
     # ElasticResizeExit; this loop re-enters rendezvous under the new
     # generation (exec/prep_and_run.apply_resize), rebuilds the core
@@ -108,6 +106,25 @@ def run(entrypoint: str) -> int:
     # exits 0 (the master ignores resized-away members' exits).
     resume_ckpt: Optional[str] = None
     resume_event = "restart"
+    try:
+        return _run_loop(entrypoint, resume_ckpt, resume_event)
+    finally:
+        # Ship the tail span batch NOW: trial.run (and any spans its
+        # teardown produced) must reach the master's trace store before
+        # this short-lived subprocess exits — atexit is the backstop, but
+        # an exec'd or hard-exiting wrapper would skip it.
+        trace.flush_shipper()
+
+
+def _run_loop(
+    entrypoint: str,
+    resume_ckpt: Optional[str],
+    resume_event: str,
+) -> int:
+    import os
+
+    from determined_tpu.trainer._trainer import ElasticResizeExit
+
     while True:
         info = core._context._info.get_cluster_info()
         assert info is not None and info.trial is not None

@@ -102,6 +102,7 @@ TASK_TOKEN_ROUTES = re.compile(
     r"|proxies"
     r"|master"
     r"|auth/logout"
+    r"|traces/ingest"              # span shipper (trial/serving processes)
     r")$"
 )
 
@@ -113,6 +114,7 @@ AGENT_TOKEN_ROUTES = re.compile(
     r"|task_logs"
     r"|master"
     r"|auth/logout"
+    r"|traces/ingest"              # span shipper (agent launch spans)
     r")$"
 )
 
@@ -1579,7 +1581,10 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         EXPERIMENTS_BY_STATE.replace(
             {(state,): float(n) for state, n in by_state.items()}
         )
-        raise _PlainText(METRICS.render())
+        # exemplars ride as `# EXEMPLAR` comment lines: strict/lenient
+        # parsers skip them; the scrape sweep harvests them so quantile
+        # answers can name the concrete trace behind a bucket.
+        raise _PlainText(METRICS.render(exemplars=True))
 
     # -- time-series plane (common/tsdb.py + master/timeseries.py): the
     # -- master's own metric HISTORY, not just the instant /metrics ----------
@@ -1620,12 +1625,20 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
             )
         except (TypeError, ValueError) as e:
             raise ApiError(400, str(e))
-        return {
+        payload = {
             "name": name,
             "func": r.q("func", "instant"),
             "range": start is not None,
             "result": result,
         }
+        # Quantile answers carry the exemplars of the bucket series they
+        # were computed from (trace plane: `histogram_quantile` → the
+        # concrete slow trace). ?exemplars=1 attaches them to any func.
+        if r.q("func", "instant") == "quantile" or r.q("exemplars") in (
+            "1", "true",
+        ):
+            payload["exemplars"] = m.tsdb.exemplars(name, matchers)
+        return payload
 
     def metrics_series(r: ApiRequest):
         """GET /api/v1/metrics/series — series discovery + TSDB bounds
@@ -1647,6 +1660,60 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
             "history": m.alert_engine.history(limit),
             "rules": m.alert_engine.rule_names(),
         }
+
+    # -- trace plane (master/tracestore.py): the master's own span store,
+    # -- fed by the common/trace.py SpanShipper in every process ------------
+    def traces_ingest(r: ApiRequest):
+        """POST /api/v1/traces/ingest — batch span ingest from shippers.
+        Never 4xxes a well-formed envelope: per-span problems are dropped
+        and counted inside the store (a shipper must not retry-loop over
+        one bad span)."""
+        from determined_tpu.common import faults
+
+        if not m._traces_cfg["enabled"]:
+            # Launched tasks are told not to ship (DTPU_TRACE_INGEST=off)
+            # but daemons configured before the toggle — or agents, which
+            # ship unconditionally — must not fill a disabled plane's
+            # store. 404 is a non-retryable status for the shipper: the
+            # batch is counted dropped once, no retry churn.
+            raise ApiError(404, "trace plane disabled (traces.enabled)")
+        faults.inject("master.trace_ingest")
+        spans = r.body.get("spans")
+        if spans is None:
+            spans = []
+        if not isinstance(spans, list):
+            raise ApiError(400, "spans must be a list of OTLP span objects")
+        return {"stored": m.tracestore.ingest(spans)}
+
+    def traces_get(r: ApiRequest):
+        """GET /api/v1/traces/<trace_id> — ONE assembled trace: span tree
+        plus the derived lifecycle critical-path breakdown."""
+        doc = m.tracestore.get(r.groups[0])
+        if doc is None:
+            raise ApiError(404, f"no trace {r.groups[0]}")
+        return doc
+
+    def traces_search(r: ApiRequest):
+        """GET /api/v1/traces?experiment=…&status=error&min_duration_ms=…
+        &root=…&limit=… — trace summaries, newest first, plus the store's
+        bounds accounting."""
+        exp = r.q("experiment")
+        limit = r.q("limit", "50")
+        min_dur = r.q("min_duration_ms")
+        try:
+            # Numeric junk answers 400, same contract as metrics_query.
+            traces = m.tracestore.search(
+                experiment=int(exp) if exp is not None else None,
+                status=r.q("status"),
+                root=r.q("root"),
+                min_duration_ms=(
+                    float(min_dur) if min_dur is not None else None
+                ),
+                limit=int(limit),
+            )
+        except (TypeError, ValueError) as e:
+            raise ApiError(400, str(e))
+        return {"traces": traces, "stats": m.tracestore.stats()}
 
     R = lambda method, pat, h: (method, re.compile(f"^{pat}$"), h)  # noqa: E731
     return [
@@ -1746,6 +1813,9 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("GET", r"/api/v1/metrics/query", metrics_query),
         R("GET", r"/api/v1/metrics/series", metrics_series),
         R("GET", r"/api/v1/alerts", list_alerts),
+        R("POST", r"/api/v1/traces/ingest", traces_ingest),
+        R("GET", r"/api/v1/traces/([0-9a-f]+)", traces_get),
+        R("GET", r"/api/v1/traces", traces_search),
         R("GET", r"/prom/metrics", prometheus_metrics),
         R("GET", r"/metrics", prometheus_metrics),
         R("GET", r"/(?:ui)?", webui_page),
@@ -1774,6 +1844,12 @@ class ApiServer:
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # TCP_NODELAY: without it, small request/response pairs on a
+            # keep-alive connection stall on the Nagle × delayed-ACK
+            # interaction — measured 44 ms → 1.5 ms per API call (the
+            # trace-plane bench rung surfaced it; every control-plane
+            # round-trip was paying the same tax).
+            disable_nagle_algorithm = True
 
             def log_message(self, fmt: str, *args: Any) -> None:
                 logger.debug("http: " + fmt, *args)
@@ -2000,8 +2076,24 @@ class ApiServer:
                             finished = True
                             span.set_attribute("http.status_code", status)
                             master.tracer.end_span(span)
+                            # The latency observation carries the request
+                            # span's trace id as its exemplar: the p99
+                            # answer links to the stored slow trace. Only
+                            # spans the StoreExporter will actually keep
+                            # (propagated parent, errored, or slow) get
+                            # one — an exemplar must never 404.
+                            dur = time.monotonic() - t_start
+                            linkable = bool(span.trace_id) and (
+                                bool(span.parent_span_id)
+                                or span.status == "ERROR"
+                                or dur * 1e3 >= trace_mod._env_float(
+                                    trace_mod.TRACE_SLOW_MS_ENV,
+                                    trace_mod.DEFAULT_SLOW_MS,
+                                )
+                            )
                             API_LATENCY.labels(method, pat.pattern).observe(
-                                time.monotonic() - t_start
+                                dur,
+                                trace_id=span.trace_id if linkable else None,
                             )
                             API_REQUESTS.labels(
                                 method, pat.pattern, str(status)

@@ -392,6 +392,7 @@ class Master:
         log_sink_url: Optional[str] = None,
         metrics_config: Optional[Dict[str, Any]] = None,
         alerts_config: Optional[Dict[str, Any]] = None,
+        traces_config: Optional[Dict[str, Any]] = None,
     ) -> None:
         # Validated config tier (masterconf.py, the config.go:129 analog):
         # fail at boot with every problem named, not mid-scheduling on the
@@ -404,6 +405,7 @@ class Master:
             config_defaults=config_defaults,
             metrics=metrics_config,
             alerts=alerts_config,
+            traces=traces_config,
         )
         self.cluster_id = uuid.uuid4().hex[:8]
         self._external_url = external_url
@@ -444,9 +446,39 @@ class Master:
         from determined_tpu.master.auth import AuthService
         from determined_tpu.master.proxy import ProxyRegistry
 
-        from determined_tpu.master.tracing import tracer_from_config
+        from determined_tpu.master.tracing import (
+            JsonlExporter,
+            MultiExporter,
+            OTLPHttpExporter,
+            Tracer,
+            tracer_from_config,
+        )
+        from determined_tpu.master.tracestore import StoreExporter, TraceStore
 
-        self.tracer = tracer_from_config(trace_file, otlp_endpoint)
+        # Trace plane (master/tracestore.py): the master is its own
+        # Jaeger — bounded in-process trace store fed by (1) the master's
+        # own Tracer via StoreExporter and (2) POST /api/v1/traces/ingest
+        # from every shipper-equipped process (agents, trials, serving),
+        # served at GET /api/v1/traces*. File/OTLP exporters stay as
+        # additional sinks when configured.
+        tcfg = dict(masterconf.TRACES_DEFAULTS)
+        tcfg.update(traces_config or {})
+        self._traces_cfg = tcfg
+        self.tracestore = TraceStore(
+            max_traces=int(tcfg["max_traces"]),
+            max_spans=int(tcfg["max_spans"]),
+            max_spans_per_trace=int(tcfg["max_spans_per_trace"]),
+            retention_s=float(tcfg["retention_s"]),
+        )
+        if tcfg["enabled"]:
+            exporters: List[Any] = [StoreExporter(self.tracestore)]
+            if trace_file:
+                exporters.append(JsonlExporter(trace_file))
+            if otlp_endpoint:
+                exporters.append(OTLPHttpExporter(otlp_endpoint))
+            self.tracer = Tracer(MultiExporter(*exporters))
+        else:
+            self.tracer = tracer_from_config(trace_file, otlp_endpoint)
         self.log_sink = None
         if log_sink_url:
             from determined_tpu.master.logsink import ElasticLogSink
@@ -745,6 +777,15 @@ class Master:
             env[trace_mod.TRACEPARENT_ENV] = (
                 trace_mod.format_traceparent(*task_ctx)
             )
+        # Trace-plane shipping policy rides the task env too: the task's
+        # SpanShipper self-configures from DTPU_MASTER + these knobs
+        # (master-owned sampling policy — uniform across the cluster).
+        tcfg = self._traces_cfg
+        if not tcfg["enabled"]:
+            env[trace_mod.TRACE_INGEST_ENV] = "off"
+        else:
+            env[trace_mod.TRACE_SAMPLE_ENV] = str(float(tcfg["sample"]))
+            env[trace_mod.TRACE_SLOW_MS_ENV] = str(float(tcfg["slow_ms"]))
         if config.get("context"):
             env["DTPU_CONTEXT_ID"] = str(config["context"])
         return env
@@ -836,6 +877,10 @@ class Master:
                     # a broken rule logs and skips).
                     self.scraper.maybe_scrape()
                     self.alert_engine.maybe_evaluate()
+                    # Trace plane retention: a quiet store must not hold
+                    # stale traces at full retention forever (O(evictions)
+                    # per sweep; ingest trims too).
+                    self.tracestore.trim()
             except Exception:  # noqa: BLE001
                 logger.exception("tick loop error")
 
@@ -848,6 +893,10 @@ class Master:
             return
         with self._lock:
             self._exp_traceparents[exp_id] = ctx
+        # Index the submit trace by experiment in the trace store too:
+        # `GET /api/v1/traces?experiment=N` works even before any span
+        # carrying an experiment attribute lands.
+        self.tracestore.tag_experiment(ctx[0], exp_id)
 
     def record_heartbeat(self, trial_id: int) -> None:
         with self._lock:

@@ -50,6 +50,11 @@ def main() -> None:
         help='JSON alert-engine knobs/rules, e.g. '
              '{"rules": [{"name": ..., "kind": "threshold", ...}]}')
     parser.add_argument(
+        "--traces-config", default=None,
+        help='JSON trace-plane knobs, e.g. '
+             '{"max_traces": 5000, "sample": 0.1, "slow_ms": 250} '
+             "(docs/operations.md \"Trace plane\")")
+    parser.add_argument(
         "--config-defaults", default=None,
         help="JSON experiment-config defaults merged under every submitted "
              'config (master.yaml analog), e.g. {"max_restarts": 2}')
@@ -87,6 +92,9 @@ def main() -> None:
         ),
         alerts_config=(
             json.loads(args.alerts_config) if args.alerts_config else None
+        ),
+        traces_config=(
+            json.loads(args.traces_config) if args.traces_config else None
         ),
     )
     if bool(args.tls_cert) != bool(args.tls_key):

@@ -38,6 +38,19 @@ ALERTS_DEFAULTS = {
     "rules": [],             # extra/override rules (same-name replaces)
 }
 
+#: Trace plane knobs (`traces:` section): the store's by-construction
+#: bounds plus the sampling policy the master injects into every task env
+#: (docs/operations.md "Trace plane" documents each row).
+TRACES_DEFAULTS = {
+    "enabled": True,          # False: no store exporter, tasks told not to ship
+    "max_traces": 2000,       # hard trace-count cap (oldest evicted, counted)
+    "max_spans": 200000,      # hard total-span cap across all traces
+    "max_spans_per_trace": 512,  # extras dropped + counted per trace
+    "retention_s": 3600.0,    # traces idle past this are trimmed
+    "sample": 1.0,            # task head-sample rate (DTPU_TRACE_SAMPLE)
+    "slow_ms": 500.0,         # tail-keep threshold (DTPU_TRACE_SLOW_MS)
+}
+
 
 def validate_metrics(cfg: Optional[Dict[str, Any]]) -> List[str]:
     errors: List[str] = []
@@ -92,6 +105,37 @@ def validate_alerts(cfg: Optional[Dict[str, Any]]) -> List[str]:
 
                 for rule in value:
                     errors.extend(validate_rule(rule))
+    return errors
+
+
+def validate_traces(cfg: Optional[Dict[str, Any]]) -> List[str]:
+    errors: List[str] = []
+    if cfg is None:
+        return errors
+    if not isinstance(cfg, dict):
+        return ["traces must be an object of trace-plane knobs"]
+    for key, value in cfg.items():
+        if key not in TRACES_DEFAULTS:
+            errors.append(
+                f"traces: unknown key {key!r} "
+                f"(one of: {', '.join(sorted(TRACES_DEFAULTS))})"
+            )
+            continue
+        if key == "enabled":
+            if not isinstance(value, bool):
+                errors.append("traces.enabled must be a bool")
+            continue
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            errors.append(f"traces.{key} must be a number")
+            continue
+        if key == "sample":
+            if not 0.0 <= value <= 1.0:
+                errors.append("traces.sample must be in [0, 1]")
+        elif key == "slow_ms":
+            if value < 0:
+                errors.append("traces.slow_ms must be >= 0")
+        elif value <= 0:
+            errors.append(f"traces.{key} must be positive")
     return errors
 
 
@@ -156,6 +200,7 @@ def validate(
     config_defaults: Optional[Dict[str, Any]] = None,
     metrics: Optional[Dict[str, Any]] = None,
     alerts: Optional[Dict[str, Any]] = None,
+    traces: Optional[Dict[str, Any]] = None,
 ) -> None:
     """Validate the master's startup configuration; raises ValueError with
     EVERY problem named (config.go-style: fail fast at boot, not at the
@@ -163,6 +208,7 @@ def validate(
     errors = validate_pools(pools)
     errors += validate_metrics(metrics)
     errors += validate_alerts(alerts)
+    errors += validate_traces(traces)
     if not isinstance(preempt_timeout_s, (int, float)) or (
         preempt_timeout_s <= 0
     ):

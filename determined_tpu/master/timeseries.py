@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Tuple
 from determined_tpu.common import faults
 from determined_tpu.common.metrics import (
     REGISTRY as METRICS,
+    parse_exemplars,
     parse_exposition,
 )
 from determined_tpu.common.tsdb import TSDB
@@ -155,7 +156,7 @@ class MetricsScraper:
                 faults.inject("master.scrape")
                 faults.inject(f"master.scrape.{name}")
                 if url is None:
-                    text = METRICS.render()
+                    text = METRICS.render(exemplars=True)
                 else:
                     import requests
 
@@ -164,6 +165,11 @@ class MetricsScraper:
                     text = resp.text
                 samples = parse_exposition(text)
                 stored = self.tsdb.ingest(name, samples, ts=now)
+                # Exemplar harvest AFTER ingest: only bucket series the
+                # TSDB admitted carry one (bounded by construction).
+                exs = parse_exemplars(text)
+                if exs:
+                    self.tsdb.note_exemplars(name, exs)
                 SCRAPE_SAMPLES.labels(name).inc(stored)
                 if name not in self._last_success:
                     logger.info("scrape target %s up (%d samples)",

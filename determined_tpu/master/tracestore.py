@@ -1,0 +1,562 @@
+"""Bounded in-master trace store: the master as its own Jaeger.
+
+PR 4 gave every layer W3C-propagated spans and per-process JSONL export;
+this module closes the loop the way common/tsdb.py did for metrics: spans
+from every process (master-internal tracing via `StoreExporter`, agents,
+trial harnesses, serving replicas via the `common/trace.py` SpanShipper)
+land in ONE in-process store, reassembled per trace id and served at
+`GET /api/v1/traces/<id>` / searched at `GET /api/v1/traces`.
+
+Memory is bounded BY CONSTRUCTION, mirroring the TSDB's discipline:
+
+- at most ``max_spans_per_trace`` spans per trace — extras are dropped
+  and counted on the trace (a runaway span loop degrades one trace's
+  fidelity, never master memory);
+- at most ``max_traces`` traces and ``max_spans`` total spans — admitting
+  a new trace past either cap evicts the OLDEST trace (debugging wants
+  recency; Jaeger's in-memory store does the same), counted in
+  ``traces_evicted``;
+- traces whose newest span ended before ``retention_s`` ago are trimmed
+  at ingest and on the maintenance tick.
+
+Traces are indexed by experiment (the submit handler tags the submit
+trace; spans may also carry an ``experiment.id`` attribute), root span
+name, duration, and error status. For lifecycle traces
+(submit → queue → schedule → launch → first step) the store derives a
+critical-path segment breakdown and publishes it as
+``dtpu_lifecycle_segment_seconds{segment}`` — which the PR 9 scrape sweep
+carries into the TSDB, where the alert engine can watch
+submit-to-first-step regressions.
+
+Stdlib-only and jax-free: this runs inside the master process.
+"""
+from __future__ import annotations
+
+import logging
+import re
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+from determined_tpu.common.metrics import REGISTRY as METRICS
+from determined_tpu.common.trace import SPANS_DROPPED, SPANS_SAMPLED_OUT
+
+logger = logging.getLogger("determined_tpu.master")
+
+SPANS_INGESTED = METRICS.counter(
+    "dtpu_trace_spans_ingested_total",
+    "Spans accepted into the master trace store.",
+)
+TRACES_EVICTED = METRICS.counter(
+    "dtpu_trace_traces_evicted_total",
+    "Traces evicted to admit newer ones (trace-count or total-span cap).",
+)
+STORE_TRACES = METRICS.gauge(
+    "dtpu_trace_store_traces", "Traces currently held in the trace store.",
+)
+STORE_SPANS = METRICS.gauge(
+    "dtpu_trace_store_spans", "Spans currently held in the trace store.",
+)
+#: Lifecycle critical path, one observation per segment per completed
+#: lifecycle trace. Buckets stretch past the API-latency band: queue and
+#: first-step segments are seconds-to-minutes quantities.
+LIFECYCLE_SEGMENT = METRICS.histogram(
+    "dtpu_lifecycle_segment_seconds",
+    "Critical-path segment durations of experiment lifecycle traces "
+    "(submit, queue, schedule, launch, first_step, total).",
+    labels=("segment",),
+    buckets=(0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+             60.0, 300.0, 1800.0),
+)
+
+#: Span-name anchors of the lifecycle critical path, in chain order.
+#: These names are the instrumentation contract of PR 4's launch chain;
+#: tests/test_tracestore.py pins them against the live emitters.
+SUBMIT_NAME_SUFFIX = "/api/v1/experiments$"
+ALLOC_NAME = "allocation"
+LAUNCH_NAME = "agent.task_launch"
+RUN_NAME = "trial.run"
+FIRST_STEP_NAME = "trial.first_step"
+_ANCHOR_NAMES = frozenset({ALLOC_NAME, LAUNCH_NAME, RUN_NAME,
+                           FIRST_STEP_NAME})
+
+#: The master's own request span for the ingest route is self-referential
+#: noise (every shipper flush would append one more span to the SHIPPER
+#: session's trace until its per-trace cap) — filtered at the exporter.
+_INGEST_ROUTE_MARK = "/api/v1/traces/ingest"
+
+
+class _Trace:
+    __slots__ = (
+        "trace_id", "spans", "dropped", "start_ns", "end_ns", "error",
+        "experiment_id", "last_ingest", "published",
+    )
+
+    def __init__(self, trace_id: str) -> None:
+        self.trace_id = trace_id
+        #: span_id -> normalized span record (insertion-ordered).
+        self.spans: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self.dropped = 0
+        self.start_ns: Optional[int] = None
+        self.end_ns: Optional[int] = None
+        self.error = False
+        self.experiment_id: Optional[int] = None
+        self.last_ingest = 0.0
+        #: lifecycle segment names already observed into the histogram —
+        #: each publishes at most once, as soon as ITS anchors are in.
+        self.published: set = set()
+
+
+def _attrs_dict(span: Dict[str, Any]) -> Dict[str, Any]:
+    """Flatten OTLP's attribute list into {key: python value}."""
+    out: Dict[str, Any] = {}
+    for attr in span.get("attributes") or []:
+        if not isinstance(attr, dict):
+            continue
+        key, value = attr.get("key"), attr.get("value")
+        if not isinstance(key, str) or not isinstance(value, dict):
+            continue
+        if "intValue" in value:
+            try:
+                out[key] = int(value["intValue"])
+            except (TypeError, ValueError):
+                out[key] = value["intValue"]
+        elif "doubleValue" in value:
+            out[key] = value["doubleValue"]
+        elif "boolValue" in value:
+            out[key] = value["boolValue"]
+        else:
+            out[key] = value.get("stringValue")
+    return out
+
+
+_TRACE_ID_RE = re.compile(r"^[0-9a-fA-F]{32}$")
+
+
+def _normalize(span: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """One ingested span → the stored record, or None when malformed.
+    "Shrugging off a weird client" means counting its spans as malformed,
+    never crashing — and never storing a trace the query route cannot
+    serve: trace ids must be W3C 32-hex (case-normalized to match
+    `GET /api/v1/traces/([0-9a-f]+)`)."""
+    if not isinstance(span, dict):
+        return None
+    trace_id = span.get("traceId")
+    span_id = span.get("spanId")
+    name = span.get("name")
+    try:
+        start_ns = int(span.get("startTimeUnixNano", 0))
+        end_ns = int(span.get("endTimeUnixNano", 0))
+    except (TypeError, ValueError):
+        return None
+    if (
+        not isinstance(trace_id, str)
+        or not _TRACE_ID_RE.match(trace_id)
+        or not isinstance(span_id, str) or not span_id
+        or not isinstance(name, str) or not name
+        or end_ns < start_ns or start_ns <= 0
+    ):
+        return None
+    trace_id = trace_id.lower()
+    status = span.get("status") or {}
+    error = isinstance(status, dict) and status.get("code") == 2
+    parent = span.get("parentSpanId")
+    return {
+        "span_id": span_id,
+        "parent_span_id": parent if isinstance(parent, str) else None,
+        "name": name,
+        "start_ns": start_ns,
+        "end_ns": end_ns,
+        "error": bool(error),
+        "attributes": _attrs_dict(span),
+        "trace_id": trace_id,
+    }
+
+
+class TraceStore:
+    def __init__(
+        self,
+        *,
+        max_traces: int = 2000,
+        max_spans: int = 200_000,
+        max_spans_per_trace: int = 512,
+        retention_s: float = 3600.0,
+    ) -> None:
+        if max_traces < 1:
+            raise ValueError("max_traces must be >= 1")
+        if max_spans_per_trace < 1:
+            raise ValueError("max_spans_per_trace must be >= 1")
+        self.max_traces = int(max_traces)
+        self.max_spans = int(max_spans)
+        self.max_spans_per_trace = int(max_spans_per_trace)
+        self.retention_s = float(retention_s)
+        #: trace_id -> _Trace, oldest-created first (eviction order).
+        self._traces: "OrderedDict[str, _Trace]" = OrderedDict()
+        self._span_total = 0
+        #: submit-handler experiment tags for traces whose spans haven't
+        #: arrived yet (the submit request span exports at request END,
+        #: after create_experiment tagged it). Bounded like the store.
+        self._exp_tags: "OrderedDict[str, int]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    # -- ingest ---------------------------------------------------------------
+    def ingest(
+        self, spans: List[Any], now: Optional[float] = None
+    ) -> int:
+        """Store a batch of OTLP-shaped span dicts. Returns spans stored;
+        malformed or cap-dropped spans are counted, never raised — span
+        ingest must not be able to fail a well-behaved shipper."""
+        now = time.time() if now is None else float(now)
+        stored = 0
+        lifecycle_candidates: List[_Trace] = []
+        with self._lock:
+            for raw in spans:
+                rec = _normalize(raw)
+                if rec is None:
+                    SPANS_DROPPED.labels("malformed").inc()
+                    continue
+                trace = self._traces.get(rec["trace_id"])
+                if trace is None:
+                    self._evict_for_admission()
+                    trace = _Trace(rec["trace_id"])
+                    self._traces[rec["trace_id"]] = trace
+                    tag = self._exp_tags.pop(rec["trace_id"], None)
+                    if tag is not None:
+                        trace.experiment_id = tag
+                if len(trace.spans) >= self.max_spans_per_trace:
+                    trace.dropped += 1
+                    SPANS_DROPPED.labels("trace_span_cap").inc()
+                    continue
+                # idempotent re-ship (a retried batch whose first attempt
+                # landed): last write wins, no double count.
+                fresh = rec["span_id"] not in trace.spans
+                trace.spans[rec["span_id"]] = rec
+                if fresh:
+                    self._span_total += 1
+                    stored += 1
+                    SPANS_INGESTED.inc()
+                    # Total-span cap holds on GROWTH of existing traces
+                    # too, not just trace admission. The receiving trace
+                    # itself is never the victim (its own growth is
+                    # bounded by max_spans_per_trace).
+                    while (
+                        self._span_total > self.max_spans
+                        and next(iter(self._traces)) != rec["trace_id"]
+                    ):
+                        _, victim = self._traces.popitem(last=False)
+                        self._span_total -= len(victim.spans)
+                        TRACES_EVICTED.inc()
+                trace.last_ingest = now
+                trace.start_ns = (
+                    rec["start_ns"] if trace.start_ns is None
+                    else min(trace.start_ns, rec["start_ns"])
+                )
+                trace.end_ns = (
+                    rec["end_ns"] if trace.end_ns is None
+                    else max(trace.end_ns, rec["end_ns"])
+                )
+                trace.error = trace.error or rec["error"]
+                exp = rec["attributes"].get("experiment.id")
+                if trace.experiment_id is None and isinstance(exp, int):
+                    trace.experiment_id = exp
+                # Lifecycle publication re-evaluates on ANY anchor
+                # arrival: anchors land out of order across processes
+                # (trial.first_step ships mid-trial; trial.run and
+                # allocation only END — and export — at trial exit).
+                if (
+                    rec["name"] in _ANCHOR_NAMES
+                    or rec["name"].endswith(SUBMIT_NAME_SUFFIX)
+                ) and trace not in lifecycle_candidates:
+                    lifecycle_candidates.append(trace)
+            self._trim_locked(now)
+            publish: List[Dict[str, Any]] = []
+            for t in lifecycle_candidates:
+                # Each segment publishes at most once, the moment ITS
+                # anchors are assembled. PER segment, not per trace: the
+                # `total` (= submit-to-first-step, the SLO the alert
+                # engine watches) needs only the submit span and the
+                # mid-trial first-step span — gating it on the whole
+                # chain would delay a 3-day job's number by 3 days
+                # (allocation/trial.run spans export only at trial exit).
+                for seg in self._critical_path_locked(t):
+                    if seg["segment"] not in t.published:
+                        t.published.add(seg["segment"])
+                        publish.append(seg)
+        # Histogram observes OUTSIDE the store lock (metrics have their
+        # own locks; no reason to serialize ingest behind them).
+        for seg in publish:
+            LIFECYCLE_SEGMENT.labels(seg["segment"]).observe(seg["seconds"])
+        self._publish_gauges()
+        return stored
+
+    def tag_experiment(self, trace_id: Optional[str], exp_id: int) -> None:
+        """Associate a trace id with the experiment it submitted — called
+        by Master.create_experiment with the submit request's traceparent,
+        which makes `GET /api/v1/traces?experiment=N` work even for spans
+        that never carry an experiment attribute."""
+        if not trace_id:
+            return
+        with self._lock:
+            trace = self._traces.get(trace_id)
+            if trace is not None:
+                trace.experiment_id = exp_id
+                return
+            self._exp_tags[trace_id] = exp_id
+            self._exp_tags.move_to_end(trace_id)
+            while len(self._exp_tags) > self.max_traces:
+                self._exp_tags.popitem(last=False)
+
+    def _evict_for_admission(self) -> None:
+        """Make room for one NEW trace: evict oldest-created traces while
+        either hard cap is exceeded. Called under the lock."""
+        while self._traces and (
+            len(self._traces) >= self.max_traces
+            or self._span_total >= self.max_spans
+        ):
+            _, victim = self._traces.popitem(last=False)
+            self._span_total -= len(victim.spans)
+            TRACES_EVICTED.inc()
+
+    def _trim_locked(self, now: float) -> None:
+        cutoff_ns = int((now - self.retention_s) * 1e9)
+        dead = [
+            tid for tid, t in self._traces.items()
+            if (t.end_ns or 0) < cutoff_ns
+        ]
+        for tid in dead:
+            victim = self._traces.pop(tid)
+            self._span_total -= len(victim.spans)
+
+    def trim(self, now: Optional[float] = None) -> None:
+        """Retention sweep (maintenance tick): a quiet store must not
+        keep stale traces at full retention forever."""
+        with self._lock:
+            self._trim_locked(time.time() if now is None else float(now))
+        self._publish_gauges()
+
+    def _publish_gauges(self) -> None:
+        with self._lock:
+            STORE_TRACES.set(len(self._traces))
+            STORE_SPANS.set(self._span_total)
+
+    # -- queries --------------------------------------------------------------
+    @staticmethod
+    def _root_of(trace: _Trace) -> Optional[Dict[str, Any]]:
+        """The trace's root span: earliest-starting span whose parent is
+        absent (or not stored — orphans happen when a parent was sampled
+        out upstream or hasn't arrived yet)."""
+        roots = [
+            s for s in trace.spans.values()
+            if not s["parent_span_id"]
+            or s["parent_span_id"] not in trace.spans
+        ]
+        if not roots:
+            return None
+        return min(roots, key=lambda s: s["start_ns"])
+
+    def _summary_locked(self, trace: _Trace) -> Dict[str, Any]:
+        root = self._root_of(trace)
+        return {
+            "trace_id": trace.trace_id,
+            "root": root["name"] if root else "",
+            "start": (trace.start_ns or 0) / 1e9,
+            "duration_ms": round(
+                ((trace.end_ns or 0) - (trace.start_ns or 0)) / 1e6, 3
+            ),
+            "status": "error" if trace.error else "ok",
+            "experiment_id": trace.experiment_id,
+            "span_count": len(trace.spans),
+            "dropped_spans": trace.dropped,
+        }
+
+    def get(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        """One assembled trace: summary + span tree + critical path."""
+        with self._lock:
+            trace = self._traces.get(trace_id)
+            if trace is None:
+                return None
+            summary = self._summary_locked(trace)
+            spans = [dict(s) for s in trace.spans.values()]
+            critical = self._critical_path_locked(trace)
+        children: Dict[Optional[str], List[Dict[str, Any]]] = {}
+        present = {s["span_id"] for s in spans}
+        for s in spans:
+            s.pop("trace_id", None)
+            s["duration_ms"] = round(
+                (s["end_ns"] - s["start_ns"]) / 1e6, 3
+            )
+            parent = s["parent_span_id"]
+            key = parent if parent in present else None
+            children.setdefault(key, []).append(s)
+
+        def build(parent_key: Optional[str]) -> List[Dict[str, Any]]:
+            out = []
+            for s in sorted(
+                children.get(parent_key, []), key=lambda x: x["start_ns"]
+            ):
+                node = dict(s)
+                node["children"] = build(s["span_id"])
+                out.append(node)
+            return out
+
+        summary["tree"] = build(None)
+        summary["critical_path"] = critical
+        return summary
+
+    def search(
+        self,
+        *,
+        experiment: Optional[int] = None,
+        status: Optional[str] = None,
+        root: Optional[str] = None,
+        min_duration_ms: Optional[float] = None,
+        limit: int = 50,
+    ) -> List[Dict[str, Any]]:
+        """Trace summaries, newest first. The store is small by
+        construction (≤ max_traces), so a filtered linear scan is the
+        whole index."""
+        with self._lock:
+            summaries = [
+                self._summary_locked(t) for t in self._traces.values()
+            ]
+        out = []
+        for s in summaries:
+            if experiment is not None and s["experiment_id"] != experiment:
+                continue
+            if status is not None and s["status"] != status:
+                continue
+            if root is not None and root not in s["root"]:
+                continue
+            if (
+                min_duration_ms is not None
+                and s["duration_ms"] < min_duration_ms
+            ):
+                continue
+            out.append(s)
+        out.sort(key=lambda s: s["start"], reverse=True)
+        return out[: max(0, int(limit))]
+
+    # -- critical path --------------------------------------------------------
+    def _critical_path_locked(
+        self, trace: _Trace
+    ) -> List[Dict[str, Any]]:
+        """Segment breakdown of a lifecycle trace from its anchor spans
+        (earliest instance of each — a multi-trial experiment's first
+        trial defines submit-to-first-step). Segments cover consecutive
+        anchors that are PRESENT; gaps clamp at zero (clock skew between
+        master/agent/trial hosts must not produce negative time)."""
+        anchors: Dict[str, Dict[str, Any]] = {}
+        for s in trace.spans.values():
+            name = s["name"]
+            if name.endswith(SUBMIT_NAME_SUFFIX) and "POST" in name:
+                key = "submit"
+            elif name == ALLOC_NAME:
+                key = "alloc"
+            elif name == LAUNCH_NAME:
+                key = "launch"
+            elif name == RUN_NAME:
+                key = "run"
+            elif name == FIRST_STEP_NAME:
+                key = "first_step"
+            else:
+                continue
+            cur = anchors.get(key)
+            if cur is None or s["start_ns"] < cur["start_ns"]:
+                anchors[key] = s
+
+        def sec(a_ns: int, b_ns: int) -> float:
+            return max(0.0, (b_ns - a_ns) / 1e9)
+
+        segs: List[Dict[str, Any]] = []
+
+        def seg(name: str, seconds: float) -> None:
+            segs.append({"segment": name, "seconds": round(seconds, 6)})
+
+        submit = anchors.get("submit")
+        alloc = anchors.get("alloc")
+        launch = anchors.get("launch")
+        run = anchors.get("run")
+        first = anchors.get("first_step")
+        if submit:
+            seg("submit", sec(submit["start_ns"], submit["end_ns"]))
+        if submit and alloc:
+            # queue: request answered → allocation assigned (scheduler
+            # decision + any time spent waiting for capacity).
+            seg("queue", sec(submit["end_ns"], alloc["start_ns"]))
+        if alloc and launch:
+            # schedule: allocation assigned → agent picked up the START.
+            seg("schedule", sec(alloc["start_ns"], launch["start_ns"]))
+        if launch and run:
+            # launch: agent spawn → harness entry (interpreter boot).
+            seg("launch", sec(launch["start_ns"], run["start_ns"]))
+        if run and first:
+            seg("first_step", sec(run["start_ns"], first["end_ns"]))
+        if submit and first:
+            seg("total", sec(submit["start_ns"], first["end_ns"]))
+        return segs
+
+    def critical_path(self, trace_id: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            trace = self._traces.get(trace_id)
+            return [] if trace is None else self._critical_path_locked(trace)
+
+    # -- accounting -----------------------------------------------------------
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "traces": len(self._traces),
+                "spans": self._span_total,
+                "max_traces": self.max_traces,
+                "max_spans": self.max_spans,
+                "max_spans_per_trace": self.max_spans_per_trace,
+            }
+
+
+class StoreExporter:
+    """master/tracing.py exporter that feeds the in-process TraceStore —
+    the master's own request/allocation spans land in the same store the
+    HTTP ingest path fills, no loopback hop.
+
+    Two classes of master-origin span are NOT stored:
+
+    - the ingest route's own request spans (self-referential: every
+      shipper flush would grow the shipper session's trace by one);
+    - ROOTLESS fast-and-healthy request spans — a request with no
+      incoming traceparent is a traceless client (browser WebUI polls,
+      curl, health probes; every Session-based caller propagates one),
+      and each such request mints a fresh one-span trace. An open
+      dashboard fires several API calls per second: unfiltered, that
+      churn fully turns over the bounded store in minutes, evicting the
+      lifecycle traces the plane exists for. The shipper's tail policy
+      applies instead: errored or slow rootless requests ARE kept (those
+      are the ones someone will come looking for).
+    """
+
+    def __init__(self, store: TraceStore) -> None:
+        self.store = store
+
+    @staticmethod
+    def _noise(s: Any) -> bool:
+        if _INGEST_ROUTE_MARK in s.name:
+            return True
+        if not s.name.startswith("http ") or s.parent_span_id:
+            return False
+        if s.status == "ERROR":
+            return False
+        from determined_tpu.common import trace as trace_mod
+
+        dur_ms = ((s.end or s.start) - s.start) * 1e3
+        return dur_ms < trace_mod._env_float(
+            trace_mod.TRACE_SLOW_MS_ENV, trace_mod.DEFAULT_SLOW_MS
+        )
+
+    def export(self, spans: List[Any]) -> None:
+        docs = []
+        for s in spans:
+            if self._noise(s):
+                SPANS_SAMPLED_OUT.inc()
+            else:
+                docs.append(s.to_otlp())
+        if docs:
+            self.store.ingest(docs)

@@ -141,6 +141,24 @@ class OTLPHttpExporter:
             logger.warning("trace export to %s failed", self.endpoint)
 
 
+class MultiExporter:
+    """Fan one batch out to several exporters (trace store + optional
+    JSONL/OTLP). Per-exporter isolation: a failing file sink must not
+    stop spans from reaching the in-master store, or vice versa."""
+
+    def __init__(self, *exporters: Any) -> None:
+        self.exporters = list(exporters)
+
+    def export(self, spans: List[Span]) -> None:
+        for exporter in self.exporters:
+            try:
+                exporter.export(spans)
+            except Exception:  # noqa: BLE001
+                logger.exception(
+                    "span export via %s failed", type(exporter).__name__
+                )
+
+
 class Tracer:
     """Span factory + batching pipeline (the OTel BatchSpanProcessor role:
     finished spans queue up and flush on size/interval from one thread)."""

@@ -87,6 +87,10 @@ PAGE = r"""<!doctype html>
 <div id="alerts">(no alert data yet)</div>
 <div id="cluster-charts" class="muted">(sparklines appear once the
 master's time-series plane has a few scrapes of history)</div>
+<h2>Traces <span class="muted" id="traces-label"></span></h2>
+<div id="traces" class="muted">(recent traces appear once spans reach the
+master's trace store; click one for its waterfall)</div>
+<div id="trace-detail"></div>
 <h2>Agents</h2><table id="agents"></table>
 <h2>Resource pools</h2><table id="pools"></table>
 <h2>Job queue</h2><div id="queues">(empty)</div>
@@ -647,6 +651,62 @@ async function refreshClusterHealth() {
   } catch (e) { /* plane not up yet: leave the placeholder */ }
 }
 
+// --- trace plane: recent-trace table + per-trace waterfall off
+// --- /api/v1/traces* (the master's own span store)
+let traceShown = null;
+async function refreshTraces() {
+  try {
+    const out = await j('/api/v1/traces?limit=12');
+    const traces = out.traces || [];
+    $('traces-label').textContent =
+      `· ${out.stats.traces}/${out.stats.max_traces} held`;
+    if (!traces.length) return;
+    const div = $('traces');
+    div.classList.remove('muted');
+    div.innerHTML = '<table><tr><th>when</th><th>root</th><th>ms</th>' +
+      '<th>spans</th><th>exp</th><th>status</th></tr>' +
+      traces.map(t =>
+        `<tr style="cursor:pointer" onclick="showTrace('${esc(t.trace_id)}')">` +
+        cell(new Date(t.start * 1000).toLocaleTimeString()) +
+        cell(t.root) + cell(t.duration_ms.toFixed(1)) +
+        cell(t.span_count) +
+        cell(t.experiment_id === null ? '-' : t.experiment_id) +
+        `<td class="${t.status === 'error' ? 'ERRORED' : 'COMPLETED'}">` +
+        `${esc(t.status)}</td></tr>`).join('') + '</table>';
+    if (traceShown) showTrace(traceShown, true);
+  } catch (e) { /* trace plane not up yet */ }
+}
+async function showTrace(id, silent) {
+  try {
+    const t = await j('/api/v1/traces/' + id);
+    traceShown = id;
+    const t0 = Math.min(...t.tree.map(s => s.start_ns));
+    const total = Math.max(t.duration_ms, 1e-9);
+    const rows = [];
+    const walk = (nodes, depth) => nodes.forEach(s => {
+      const off = (s.start_ns - t0) / 1e6;
+      rows.push(
+        `<tr><td style="white-space:nowrap;padding-left:${depth}em">` +
+        `${esc(s.name)}${s.error ? ' <b class="ERRORED">!</b>' : ''}</td>` +
+        cell('+' + off.toFixed(1) + 'ms') +
+        cell(s.duration_ms.toFixed(1) + 'ms') +
+        '<td style="width:45%"><div style="margin-left:' +
+        (100 * off / total).toFixed(2) + '%;width:' +
+        Math.max(0.5, 100 * s.duration_ms / total).toFixed(2) +
+        '%;height:0.7em;background:' +
+        (s.error ? '#c33' : '#69c') + '"></div></td></tr>');
+      walk(s.children || [], depth + 1);
+    });
+    walk(t.tree, 0);
+    const cp = (t.critical_path || []).map(seg =>
+      `${esc(seg.segment)}=${seg.seconds.toFixed(3)}s`).join(' · ');
+    $('trace-detail').innerHTML =
+      `<p><b>${esc(id)}</b> ${t.duration_ms.toFixed(1)}ms ${esc(t.status)}` +
+      (cp ? ` — critical path: ${cp}` : '') + '</p>' +
+      `<table>${rows.join('')}</table>`;
+  } catch (e) { if (!silent) $('trace-detail').textContent = '(trace gone)'; }
+}
+
 function pager(el, page, total, onchange, redraw = 'refresh') {
   const pages = Math.max(1, Math.ceil(total / PAGE_SIZE));
   el.innerHTML = `page ${page + 1}/${pages} · ${total} total ` +
@@ -785,6 +845,7 @@ async function refresh() {
     }
     await refreshAdmin();
     await refreshClusterHealth();
+    await refreshTraces();
   } catch (e) { console.error(e); }
 }
 // --- hash router (#/experiments/<id>, #/trials/<id>) -------------------

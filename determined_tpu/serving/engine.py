@@ -468,7 +468,11 @@ class GenerationEngine:
                 max_new_tokens=mnt,
                 deadline=deadline,
                 temperature=float(temperature),
-                trace=trace,
+                # Trace identity is fixed at ADMISSION (traceless clients
+                # get a fresh root here, not at span-emit time): the TTFT
+                # exemplar recorded at prefill must name the same trace
+                # the request's spans later export under.
+                trace=trace or (trace_mod.new_trace_id(), None),
                 t_submit=now,
             )
             self._queue.append(req)
@@ -665,7 +669,19 @@ class GenerationEngine:
             req.last_token = first
             req.tokens.append(first)
             req.t_first_token = now
-            TTFT.observe(now - req.t_submit)
+            # Exemplar: the p99 TTFT answer links to this request's
+            # trace — but only when the head-sample will actually ship
+            # the request's spans (the decision is a pure function of
+            # the trace id, so it's knowable here). A sampled-out trace
+            # as an exemplar would 404 in `dtpu traces show`.
+            TTFT.observe(
+                now - req.t_submit,
+                trace_id=(
+                    req.trace[0]
+                    if trace_mod._keep_span(req.trace[0], False, 0.0)
+                    else None
+                ),
+            )
             TOKENS.inc()
             with self._stats_lock:
                 self._tokens_emitted += 1
@@ -783,7 +799,16 @@ class GenerationEngine:
         req.t_done = time.time()
         outcome = "ok" if reason in ("length", "eos") else reason
         REQUESTS.labels(outcome).inc()
-        E2E.observe(req.t_done - req.t_submit)
+        # error/head-sampled requests ship their spans (tail policy), so
+        # their trace ids are safe exemplars; head-sampled-out healthy
+        # ones would dangle.
+        e2e_linkable = reason not in ("length", "eos") or trace_mod._keep_span(
+            req.trace[0], False, 0.0
+        )
+        E2E.observe(
+            req.t_done - req.t_submit,
+            trace_id=req.trace[0] if e2e_linkable else None,
+        )
         with self._stats_lock:
             self._done_count += 1
         self._emit_spans(req)
@@ -799,8 +824,9 @@ class GenerationEngine:
     def _emit_spans(self, req: Request) -> None:
         """Per-request W3C spans: submit → queue → prefill → first token →
         done, parented to the submitting client's traceparent."""
-        trace_id = req.trace[0] if req.trace else trace_mod.new_trace_id()
-        parent = req.trace[1] if req.trace else None
+        # trace identity fixed at admission (submit); parent span id is
+        # None for traceless clients — the request span roots the trace.
+        trace_id, parent = req.trace
         root = trace_mod.new_span_id()
         trace_mod.export_span(
             "serving.request", trace_id=trace_id, span_id=root,

@@ -159,7 +159,11 @@ def build_serving_routes(
         return {"status": "ok", **engine.stats()}
 
     def metrics(body, query):
-        raise _PlainText(METRICS.render(), "text/plain; version=0.0.4")
+        # exemplars ride as comment lines; the master's scrape sweep
+        # harvests them so p99 TTFT answers can name the slow trace.
+        raise _PlainText(
+            METRICS.render(exemplars=True), "text/plain; version=0.0.4"
+        )
 
     R = lambda method, pat, h: (method, re.compile(f"^{pat}$"), h)  # noqa: E731
     return [
@@ -184,6 +188,10 @@ class GenerationServer:
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Nagle × delayed-ACK stalls small writes ~40 ms — fatal to
+            # SSE token TTFT on a keep-alive socket (same fix as the
+            # master's ApiServer).
+            disable_nagle_algorithm = True
 
             def log_message(self, fmt: str, *args: Any) -> None:
                 logger.debug("serving http: " + fmt, *args)

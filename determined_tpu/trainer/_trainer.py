@@ -960,6 +960,14 @@ class Trainer:
         _fit_scope.enter_context(
             trace_mod.span("trial.fit", {"resume_step": resume_steps})
         )
+        # First-step anchor for the trace plane's lifecycle critical path
+        # (submit→…→first_step, master/tracestore.py): exported the moment
+        # the first step's dispatch returns — jit compilation happens
+        # synchronously inside that first call, so this span IS the
+        # compile + dispatch cost. One int compare per step afterwards.
+        _first_step_ctx = trace_mod.current()
+        _first_step_t0 = time.time()
+        _first_step_at = step + 1
         # Host-phase clock bound once: the hot loop pays 3 perf_counter
         # calls + 2 float adds per step when enabled, nothing when not.
         _pc = timeline.pc
@@ -996,6 +1004,16 @@ class Trainer:
                     )
                     pending.append(metrics)
                     step += 1
+                    if step == _first_step_at and _first_step_ctx is not None:
+                        _first_step_at = -1
+                        trace_mod.export_span(
+                            "trial.first_step",
+                            trace_id=_first_step_ctx[0],
+                            span_id=trace_mod.new_span_id(),
+                            parent_span_id=_first_step_ctx[1],
+                            start=_first_step_t0, end=time.time(),
+                            attributes={"step": step},
+                        )
 
                     boundary = step % rep_period == 0 or step == target
                     if boundary:

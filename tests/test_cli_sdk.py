@@ -135,6 +135,46 @@ class TestCLI:
         assert "rules loaded:" in out
         assert "scrape_target_down" in out
 
+    def test_traces_verbs(self, live_master, capsys):
+        """`dtpu traces list/show` over the trace plane (PR 10): list
+        filters, the waterfall tree, and the critical-path line."""
+        import time as _time
+
+        master, api = live_master
+        t0 = _time.time()
+        tid = "ab" * 16
+
+        def span(sid, name, start, end, parent=None, error=False):
+            return {
+                "traceId": tid, "spanId": sid, "name": name,
+                **({"parentSpanId": parent} if parent else {}),
+                "startTimeUnixNano": int(start * 1e9),
+                "endTimeUnixNano": int(end * 1e9),
+                "status": {"code": 2 if error else 1},
+            }
+
+        master.tracestore.tag_experiment(tid, 7)
+        master.tracestore.ingest([
+            span("su", "http POST ^/api/v1/experiments$", t0, t0 + 0.1),
+            span("al", "allocation", t0 + 0.2, t0 + 4.0, parent="su"),
+            span("la", "agent.task_launch", t0 + 0.3, t0 + 0.4,
+                 parent="al"),
+            span("ru", "trial.run", t0 + 0.8, t0 + 3.9, parent="la"),
+            span("fs", "trial.first_step", t0 + 0.9, t0 + 1.9,
+                 parent="ru"),
+        ])
+        self._run(api, "traces", "list", "--experiment", "7")
+        out = capsys.readouterr().out
+        assert tid in out and "exp=7" in out
+        assert "5 span(s)" in out
+        self._run(api, "traces", "list", "--status", "error")
+        out = capsys.readouterr().out
+        assert "(no matching traces)" in out
+        self._run(api, "traces", "show", tid)
+        out = capsys.readouterr().out
+        assert "trial.first_step" in out and "allocation" in out
+        assert "critical path:" in out and "first_step=1.100s" in out
+
 
 class TestDownloadCode:
     def test_download_code_roundtrip(self, live_master, tmp_path, capsys):

@@ -89,7 +89,14 @@ class TestGuides:
         "operations.md": ("drain", "DTPU_PG_DSN", "tunnel",
                           # time-series plane (PR 9)
                           "metrics/query", "burn_rate", "ALERT",
-                          "scrape_interval_s", "master.scrape"),
+                          "scrape_interval_s", "master.scrape",
+                          # trace plane (PR 10)
+                          "Trace plane", "traces/ingest",
+                          "min_duration_ms", "client.trace_ship",
+                          "master.trace_ingest", "DTPU_TRACE_SAMPLE",
+                          "dtpu_lifecycle_segment_seconds",
+                          "max_spans_per_trace", "EXEMPLAR",
+                          "traces show"),
         "expconf-reference.md": ("slots_per_trial", "max_slots",
                                  "checkpoint_storage"),
     }

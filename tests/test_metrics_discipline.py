@@ -315,6 +315,114 @@ class TestTimeSeriesPlaneRoutes:
             master.shutdown()
 
 
+class TestTracePlaneRoutes:
+    """PR 10 satellite: the trace plane's routes ride the SAME
+    instrumented dispatch path (histogram+span per route, by
+    construction via the sweep above) — this pins their existence, the
+    store's by-construction bounds under hostile load, and exemplar
+    presence on the live query surface."""
+
+    def test_trace_routes_registered_on_the_dispatch_path(self):
+        master = Master()
+        try:
+            patterns = {
+                (method, pattern.pattern)
+                for method, pattern, _h in build_routes(master)
+            }
+        finally:
+            master.shutdown()
+        assert ("POST", r"^/api/v1/traces/ingest$") in patterns
+        assert ("GET", r"^/api/v1/traces/([0-9a-f]+)$") in patterns
+        assert ("GET", r"^/api/v1/traces$") in patterns
+
+    def test_store_bounded_under_span_flood_and_trace_cardinality(self):
+        """Span-flood one trace + a trace-cardinality attack: the store
+        stays under every cap with the overflow counted."""
+        master = Master(traces_config={
+            "max_traces": 50, "max_spans": 400, "max_spans_per_trace": 16,
+        })
+        try:
+            store = master.tracestore
+            import time as _time
+
+            t0 = _time.time()
+
+            def span(tid, sid):
+                return {
+                    "traceId": tid, "spanId": sid, "name": "flood",
+                    "startTimeUnixNano": int(t0 * 1e9),
+                    "endTimeUnixNano": int((t0 + 0.1) * 1e9),
+                    "status": {"code": 1},
+                }
+
+            # span flood: 500 spans into ONE trace
+            store.ingest([span("f" * 32, f"s{i}") for i in range(500)])
+            # cardinality attack: 500 distinct traces
+            for i in range(500):
+                store.ingest([span(f"{i:08x}" + "c" * 24, "s0")])
+            st = store.stats()
+            assert st["traces"] <= 50
+            assert st["spans"] <= 400
+            flood = store.get("f" * 32)
+            if flood is not None:  # may have been evicted by the attack
+                assert flood["span_count"] <= 16
+            assert REGISTRY.get(
+                "dtpu_trace_spans_dropped_total"
+            ).labels("trace_span_cap").value > 0
+            assert REGISTRY.get("dtpu_trace_traces_evicted_total").value > 0
+            # the gauges publish the post-attack accounting
+            assert REGISTRY.get("dtpu_trace_store_traces").value <= 50
+        finally:
+            master.shutdown()
+
+    def test_exemplars_on_live_query_surface(self):
+        """Histogram exemplars survive the full loop: request → latency
+        observation (trace id) → scrape harvest → TSDB →
+        /api/v1/metrics/query quantile answer."""
+        import math
+
+        from determined_tpu.common.api_session import Session
+
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        master.scraper.interval_s = math.inf
+        try:
+            # Session, not raw requests: only requests that PROPAGATE a
+            # traceparent (so their spans are stored) get exemplars —
+            # a rootless poller's trace id would 404 in traces show.
+            sess = Session(api.url)
+            for _ in range(3):
+                sess.get("/api/v1/experiments")
+            # live /metrics page carries the exemplar comment lines —
+            # and still strict-parses (comments are skipped)
+            text = requests.get(f"{api.url}/metrics", timeout=30).text
+            parse_exposition(text)
+            from determined_tpu.common.metrics import parse_exemplars
+
+            page_exemplars = parse_exemplars(text)
+            assert any(
+                name == "dtpu_api_request_duration_seconds_bucket"
+                for name, _ in page_exemplars
+            )
+            master.scraper.scrape_once()
+            out = requests.get(
+                f"{api.url}/api/v1/metrics/query"
+                "?name=dtpu_api_request_duration_seconds&func=quantile",
+                timeout=30,
+            ).json()
+            exemplars = out.get("exemplars") or []
+            assert exemplars, out
+            assert all(
+                re.fullmatch(r"[0-9a-f]{32}", e["trace_id"])
+                for e in exemplars
+            )
+            assert all("le" in e["labels"] for e in exemplars)
+        finally:
+            api.stop()
+            master.shutdown()
+
+
 class TestNameDiscipline:
     def test_all_registered_names_are_dtpu_prefixed(self):
         # Importing the instrumented modules populates the registry.

@@ -155,9 +155,12 @@ class TestLaunchChain:
         assert ctx == (alloc["traceId"], alloc["spanId"])
 
     def test_null_tracer_still_propagates(self):
-        """Propagation must not require a configured exporter: with the
-        default NullTracer the submit context passes through to the env."""
-        master = Master()  # NullTracer
+        """Propagation must not require a working tracer: with the trace
+        plane disabled (NullTracer) the submit context passes through to
+        the env unchanged. (With the default in-master trace store the
+        env carries the allocation SPAN's context instead — same trace
+        id, new span id — covered by test_master_env_carries_submit_trace.)"""
+        master = Master(traces_config={"enabled": False})  # NullTracer
         captured = {}
         master.agent_hub.enqueue = lambda a, act: captured.setdefault(a, act)
         try:

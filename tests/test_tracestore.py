@@ -1,0 +1,799 @@
+"""Trace plane (master/tracestore.py + the common/trace.py SpanShipper):
+store bounds by construction, tree assembly, critical-path derivation,
+the shipper's tail-sampling policy, the ingest/query API, fault drills
+(client.trace_ship / master.trace_ingest), and the devcluster e2e
+acceptance: one assembled submit→first-step tree, errored-trace retention
+under aggressive sampling, exemplar→trace reachability."""
+import json
+import time
+
+import pytest
+import requests
+
+from determined_tpu.common import faults, trace
+from determined_tpu.common.metrics import REGISTRY
+from determined_tpu.master.api_server import ApiServer
+from determined_tpu.master.core import Master
+from determined_tpu.master.tracestore import TraceStore
+
+
+def _counter(name: str, **labels) -> float:
+    fam = REGISTRY.get(name)
+    if fam is None:
+        return 0.0
+    child = fam.labels(**labels) if labels else fam
+    return child.value
+
+
+def _span(
+    trace_id: str,
+    span_id: str,
+    name: str,
+    start: float,
+    end: float,
+    parent: str = None,
+    error: bool = False,
+    attrs: dict = None,
+) -> dict:
+    return {
+        "traceId": trace_id,
+        "spanId": span_id,
+        **({"parentSpanId": parent} if parent else {}),
+        "name": name,
+        "startTimeUnixNano": int(start * 1e9),
+        "endTimeUnixNano": int(end * 1e9),
+        "attributes": [
+            {"key": k, "value": {"intValue": str(v)} if isinstance(v, int)
+             else {"stringValue": str(v)}}
+            for k, v in (attrs or {}).items()
+        ],
+        "status": {"code": 2 if error else 1},
+    }
+
+
+@pytest.fixture()
+def fresh_shipper():
+    """Every shipper test owns the process-global shipper state."""
+    trace.reset_shipper()
+    yield
+    trace.reset_shipper()
+
+
+class TestTraceStoreBounds:
+    def test_tree_assembly_and_orphans(self):
+        store = TraceStore()
+        t0 = time.time()
+        tid = "a" * 32
+        store.ingest([
+            _span(tid, "r1", "root", t0, t0 + 1.0),
+            _span(tid, "c1", "child", t0 + 0.1, t0 + 0.5, parent="r1"),
+            _span(tid, "g1", "grandchild", t0 + 0.2, t0 + 0.3, parent="c1"),
+            # orphan: parent was sampled out upstream — surfaces at root
+            _span(tid, "o1", "orphan", t0 + 0.4, t0 + 0.6, parent="gone"),
+        ])
+        doc = store.get(tid)
+        assert doc["span_count"] == 4
+        roots = {n["name"] for n in doc["tree"]}
+        assert roots == {"root", "orphan"}
+        root = next(n for n in doc["tree"] if n["name"] == "root")
+        assert root["children"][0]["name"] == "child"
+        assert root["children"][0]["children"][0]["name"] == "grandchild"
+        assert doc["root"] == "root"  # earliest-starting root names it
+        assert doc["status"] == "ok"
+
+    def test_per_trace_span_cap_counted(self):
+        store = TraceStore(max_spans_per_trace=5)
+        before = _counter(
+            "dtpu_trace_spans_dropped_total", reason="trace_span_cap"
+        )
+        t0 = time.time()
+        tid = "b" * 32
+        store.ingest([
+            _span(tid, f"s{i}", "n", t0, t0 + 0.001) for i in range(8)
+        ])
+        doc = store.get(tid)
+        assert doc["span_count"] == 5
+        assert doc["dropped_spans"] == 3
+        assert _counter(
+            "dtpu_trace_spans_dropped_total", reason="trace_span_cap"
+        ) == before + 3
+
+    def test_trace_count_cap_evicts_oldest(self):
+        store = TraceStore(max_traces=3)
+        before = _counter("dtpu_trace_traces_evicted_total")
+        t0 = time.time()
+        ids = [f"{i:032x}" for i in range(5)]
+        for i, tid in enumerate(ids):
+            store.ingest([_span(tid, "s", "n", t0 + i, t0 + i + 0.1)])
+        assert store.stats()["traces"] == 3
+        assert store.get(ids[0]) is None and store.get(ids[1]) is None
+        assert store.get(ids[4]) is not None  # recency wins
+        assert _counter("dtpu_trace_traces_evicted_total") == before + 2
+
+    def test_total_span_cap_holds_on_growth(self):
+        store = TraceStore(max_spans=10, max_spans_per_trace=8)
+        t0 = time.time()
+        a, b = "c" * 32, "d" * 32
+        store.ingest([_span(a, f"s{i}", "n", t0, t0 + 0.1)
+                      for i in range(6)])
+        # growing trace b past the TOTAL cap evicts trace a
+        store.ingest([_span(b, f"s{i}", "n", t0 + 1, t0 + 1.1)
+                      for i in range(7)])
+        st = store.stats()
+        assert st["spans"] <= 10
+        assert store.get(a) is None and store.get(b) is not None
+
+    def test_retention_trim(self):
+        store = TraceStore(retention_s=100.0)
+        t0 = time.time()
+        old, new = "e" * 32, "f" * 32
+        store.ingest([_span(old, "s", "n", t0 - 500, t0 - 499)], now=t0 - 499)
+        store.ingest([_span(new, "s", "n", t0, t0 + 0.1)], now=t0)
+        store.trim(now=t0 + 1)
+        assert store.get(old) is None
+        assert store.get(new) is not None
+
+    def test_malformed_spans_dropped_counted(self):
+        store = TraceStore()
+        before = _counter(
+            "dtpu_trace_spans_dropped_total", reason="malformed"
+        )
+        t0 = time.time()
+        stored = store.ingest([
+            None, 7, {}, {"traceId": "x"},
+            {"traceId": "x", "spanId": "y", "name": "n",
+             "startTimeUnixNano": "soon", "endTimeUnixNano": 2},
+            # non-W3C trace id: would be listed but unreachable through
+            # GET /api/v1/traces/([0-9a-f]+) — rejected at the door
+            _span("zz" * 16, "s", "weird", t0, t0 + 0.1),
+            _span("0" * 32, "ok", "fine", t0, t0 + 0.1),
+        ])
+        assert stored == 1
+        assert _counter(
+            "dtpu_trace_spans_dropped_total", reason="malformed"
+        ) == before + 6
+
+    def test_uppercase_trace_id_normalized(self):
+        """W3C ids are lowercase hex; an uppercase-emitting client's
+        trace must still be reachable through the lowercase-hex route."""
+        store = TraceStore()
+        t0 = time.time()
+        store.ingest([_span("AB" * 16, "s", "n", t0, t0 + 0.1)])
+        assert store.get("ab" * 16) is not None
+        assert store.search()[0]["trace_id"] == "ab" * 16
+
+    def test_experiment_tag_and_search(self):
+        store = TraceStore()
+        t0 = time.time()
+        tid = "9" * 32
+        store.tag_experiment(tid, 42)  # tag BEFORE spans arrive
+        store.ingest([
+            _span(tid, "s", "http POST ^/api/v1/experiments$",
+                  t0, t0 + 0.3),
+        ])
+        slow_err = "8" * 32
+        store.ingest([
+            _span(slow_err, "s", "other", t0 + 1, t0 + 3, error=True),
+        ])
+        assert store.get(tid)["experiment_id"] == 42
+        assert [t["trace_id"] for t in store.search(experiment=42)] == [tid]
+        assert [t["trace_id"] for t in store.search(status="error")] == (
+            [slow_err]
+        )
+        assert [
+            t["trace_id"] for t in store.search(min_duration_ms=1000)
+        ] == [slow_err]
+        assert [t["trace_id"] for t in store.search(root="experiments")] == (
+            [tid]
+        )
+        # newest first, limit applies
+        assert store.search(limit=1)[0]["trace_id"] == slow_err
+
+
+class TestCriticalPath:
+    def lifecycle(self, store, tid, t0, with_first_step=True):
+        spans = [
+            _span(tid, "su", "http POST ^/api/v1/experiments$",
+                  t0, t0 + 0.05, attrs={"experiment.id": 5}),
+            _span(tid, "al", "allocation", t0 + 0.25, t0 + 9.0,
+                  parent="su"),
+            _span(tid, "la", "agent.task_launch", t0 + 0.45, t0 + 0.50,
+                  parent="al"),
+            _span(tid, "ru", "trial.run", t0 + 1.05, t0 + 8.0,
+                  parent="la"),
+        ]
+        if with_first_step:
+            spans.append(
+                _span(tid, "fs", "trial.first_step", t0 + 1.1, t0 + 3.05,
+                      parent="ru")
+            )
+        store.ingest(spans)
+
+    def test_segments_and_publication(self):
+        store = TraceStore()
+        fam = REGISTRY.get("dtpu_lifecycle_segment_seconds")
+        counts_before = {
+            seg: fam.labels(seg)._count
+            for seg in ("submit", "queue", "schedule", "launch",
+                        "first_step", "total")
+        }
+        t0 = time.time()
+        tid = "ab" * 16
+        self.lifecycle(store, tid, t0)
+        cp = {s["segment"]: s["seconds"] for s in store.critical_path(tid)}
+        assert cp["submit"] == pytest.approx(0.05, abs=0.01)
+        assert cp["queue"] == pytest.approx(0.20, abs=0.01)
+        assert cp["schedule"] == pytest.approx(0.20, abs=0.01)
+        assert cp["launch"] == pytest.approx(0.60, abs=0.01)
+        assert cp["first_step"] == pytest.approx(2.0, abs=0.01)
+        assert cp["total"] == pytest.approx(3.05, abs=0.01)
+        for seg in counts_before:
+            assert fam.labels(seg)._count == counts_before[seg] + 1, seg
+        # idempotent: re-shipping the first-step span must not double-
+        # publish the lifecycle histogram
+        self.lifecycle(store, tid, t0)
+        for seg in counts_before:
+            assert fam.labels(seg)._count == counts_before[seg] + 1, seg
+
+    def test_out_of_order_anchor_arrival_still_publishes(self):
+        """Anchors land out of order across processes (first_step ships
+        mid-trial; trial.run and allocation only export at trial EXIT):
+        publication triggers on the LAST anchor's arrival, and only once
+        the whole chain is assembled."""
+        store = TraceStore()
+        fam = REGISTRY.get("dtpu_lifecycle_segment_seconds")
+        before = fam.labels("queue")._count
+        total_before = fam.labels("total")._count
+        t0 = time.time()
+        tid = "0f" * 16
+        # submit + launch early, first_step mid-trial ...
+        store.ingest([
+            _span(tid, "su", "http POST ^/api/v1/experiments$",
+                  t0, t0 + 0.05),
+            _span(tid, "la", "agent.task_launch", t0 + 0.45, t0 + 0.50),
+            _span(tid, "fs", "trial.first_step", t0 + 1.1, t0 + 3.05),
+        ])
+        # `total` (submit → first step, the SLO number) publishes NOW —
+        # a 3-day job must not report its time-to-first-step on day 3
+        assert fam.labels("total")._count == total_before + 1
+        assert fam.labels("queue")._count == before  # needs allocation
+        # ... run and allocation only at trial exit
+        store.ingest([_span(tid, "ru", "trial.run", t0 + 1.05, t0 + 8.0)])
+        assert fam.labels("queue")._count == before
+        store.ingest([
+            _span(tid, "al", "allocation", t0 + 0.25, t0 + 9.0),
+        ])
+        assert fam.labels("queue")._count == before + 1
+        assert fam.labels("total")._count == total_before + 1  # still once
+
+    def test_partial_chain_yields_partial_path(self):
+        store = TraceStore()
+        t0 = time.time()
+        tid = "cd" * 16
+        self.lifecycle(store, tid, t0, with_first_step=False)
+        segs = {s["segment"] for s in store.critical_path(tid)}
+        assert segs == {"submit", "queue", "schedule", "launch"}
+
+    def test_clock_skew_clamps_at_zero(self):
+        store = TraceStore()
+        t0 = time.time()
+        tid = "ef" * 16
+        store.ingest([
+            _span(tid, "su", "http POST ^/api/v1/experiments$",
+                  t0, t0 + 0.5),
+            # agent clock behind the master's: alloc "starts" before the
+            # submit request finished
+            _span(tid, "al", "allocation", t0 + 0.2, t0 + 5.0),
+        ])
+        cp = {s["segment"]: s["seconds"] for s in store.critical_path(tid)}
+        assert cp["queue"] == 0.0
+
+
+class TestShipperPolicy:
+    def test_keep_rules(self, monkeypatch):
+        monkeypatch.setenv(trace.TRACE_SLOW_MS_ENV, "100")
+        monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "0.0")
+        tid = "a" * 32
+        assert trace._keep_span(tid, error=True, duration_s=0.0)
+        assert trace._keep_span(tid, error=False, duration_s=0.2)
+        assert not trace._keep_span(tid, error=False, duration_s=0.01)
+        monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "1.0")
+        assert trace._keep_span(tid, error=False, duration_s=0.01)
+        # fractional rate: deterministic per trace id, identical across
+        # processes (pure function of the id hash)
+        monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "0.5")
+        import hashlib
+
+        ids = [
+            hashlib.sha256(str(i).encode()).hexdigest()[:32]
+            for i in range(200)
+        ]
+        kept = [i for i in ids if trace._keep_span(i, False, 0.0)]
+        assert 40 < len(kept) < 160
+        assert kept == [i for i in ids if trace._keep_span(i, False, 0.0)]
+        # junk env never breaks the workload
+        monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "soon")
+        assert trace._keep_span(tid, error=False, duration_s=0.0)
+
+    def test_ships_to_live_store_and_samples_out(
+        self, fresh_shipper, monkeypatch
+    ):
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            trace.configure_shipper(api.url)
+            monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "0.0")
+            monkeypatch.setenv(trace.TRACE_SLOW_MS_ENV, "60000")
+            sampled_before = _counter("dtpu_trace_spans_sampled_out_total")
+            with trace.span("fast.noise"):
+                pass
+            # errored span: tail-kept even at sample 0
+            err_tid = None
+            with pytest.raises(RuntimeError):
+                with trace.span("errored.op") as (tid, _):
+                    err_tid = tid
+                    raise RuntimeError("boom")
+            trace.flush_shipper()
+            assert master.tracestore.get(err_tid) is not None
+            assert master.tracestore.get(err_tid)["status"] == "error"
+            assert (
+                _counter("dtpu_trace_spans_sampled_out_total")
+                > sampled_before
+            )
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_ship_failure_counted_never_raises(self, fresh_shipper):
+        trace.configure_shipper("http://127.0.0.1:1")  # nothing listens
+        before = _counter(
+            "dtpu_trace_spans_dropped_total", reason="ship_failed"
+        )
+        with trace.span("doomed", parent=(("a" * 32), "b" * 16)):
+            pass
+        trace.flush_shipper()  # must return, not raise
+        assert _counter(
+            "dtpu_trace_spans_dropped_total", reason="ship_failed"
+        ) > before
+
+    def test_client_trace_ship_fault_drill(self, fresh_shipper):
+        """Satellite: client.trace_ship drills span loss — the batch is
+        counted lost, the shipper survives, and an instrumented API
+        request on the same Session machinery never fails."""
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            trace.configure_shipper(api.url)
+            before = _counter(
+                "dtpu_trace_spans_dropped_total", reason="ship_failed"
+            )
+            plan = faults.FaultPlan(
+                {"client.trace_ship": faults.FaultSpec(failures=1)}
+            )
+            with faults.plan_active(plan):
+                with trace.span("lost.batch"):
+                    pass
+                trace.flush_shipper()  # injected failure: batch lost
+                # the instrumented request path stays healthy mid-drill
+                sess = master_session(api)
+                assert sess.get("/api/v1/master")["cluster_id"]
+                with trace.span("second.batch") as (tid2, _):
+                    pass
+                trace.flush_shipper()  # site healed: this batch lands
+            assert _counter(
+                "dtpu_trace_spans_dropped_total", reason="ship_failed"
+            ) == before + 1
+            assert master.tracestore.get(tid2) is not None
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_master_trace_ingest_fault_drill(self, fresh_shipper):
+        """Satellite: master.trace_ingest failing answers 500 to the
+        shipper (loss counted client-side) and never poisons the other
+        routes on the dispatch path."""
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            trace.configure_shipper(api.url)
+            before = _counter(
+                "dtpu_trace_spans_dropped_total", reason="ship_failed"
+            )
+            plan = faults.FaultPlan(
+                {"master.trace_ingest": faults.FaultSpec(failures=1)}
+            )
+            with faults.plan_active(plan):
+                resp = requests.post(
+                    f"{api.url}/api/v1/traces/ingest",
+                    json={"spans": []}, timeout=10,
+                )
+                assert resp.status_code == 500
+                # neighboring routes unaffected while the site is armed
+                assert requests.get(
+                    f"{api.url}/api/v1/master", timeout=10
+                ).status_code == 200
+                with trace.span("after.heal") as (tid, _):
+                    pass
+                trace.flush_shipper()
+            assert master.tracestore.get(tid) is not None
+            assert _counter(
+                "dtpu_trace_spans_dropped_total", reason="ship_failed"
+            ) == before
+        finally:
+            api.stop()
+            master.shutdown()
+
+
+def master_session(api):
+    from determined_tpu.common.api_session import Session
+
+    return Session(api.url)
+
+
+class TestTraceAPI:
+    def test_query_surface(self, fresh_shipper):
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            t0 = time.time()
+            tid = "12" * 16
+            resp = requests.post(
+                f"{api.url}/api/v1/traces/ingest",
+                json={"spans": [
+                    _span(tid, "r", "root.op", t0, t0 + 1.5,
+                          attrs={"experiment.id": 3}),
+                    _span(tid, "c", "child.op", t0 + 0.1, t0 + 0.4,
+                          parent="r"),
+                ]},
+                timeout=10,
+            )
+            assert resp.json()["stored"] == 2
+            doc = requests.get(
+                f"{api.url}/api/v1/traces/{tid}", timeout=10
+            ).json()
+            assert doc["tree"][0]["children"][0]["name"] == "child.op"
+            assert doc["duration_ms"] == pytest.approx(1500, abs=5)
+            out = requests.get(
+                f"{api.url}/api/v1/traces?experiment=3&min_duration_ms=1000",
+                timeout=10,
+            ).json()
+            assert [t["trace_id"] for t in out["traces"]] == [tid]
+            assert out["stats"]["max_traces"] == 2000
+            # 404 / 400 contracts
+            assert requests.get(
+                f"{api.url}/api/v1/traces/{'0' * 32}", timeout=10
+            ).status_code == 404
+            assert requests.get(
+                f"{api.url}/api/v1/traces?experiment=soon", timeout=10
+            ).status_code == 400
+            assert requests.get(
+                f"{api.url}/api/v1/traces?min_duration_ms=abc", timeout=10
+            ).status_code == 400
+            assert requests.post(
+                f"{api.url}/api/v1/traces/ingest",
+                json={"spans": "nope"}, timeout=10,
+            ).status_code == 400
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_master_request_spans_reach_store(self, fresh_shipper):
+        """The master's own Tracer exports into the same store (no HTTP
+        loopback): request spans are queryable by trace id."""
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            sess = master_session(api)
+            root_trace = sess._trace_root[0]
+            sess.get("/api/v1/experiments")
+            # the request span ends in the handler's finally, AFTER the
+            # response reaches us — poll the store briefly
+            doc = None
+            deadline = time.time() + 10
+            while doc is None and time.time() < deadline:
+                master.tracer.flush()
+                doc = master.tracestore.get(root_trace)
+                if doc is None:
+                    time.sleep(0.05)
+            assert doc is not None
+            assert any(
+                "experiments" in s["name"] for s in doc["tree"]
+            )
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_rootless_poller_spans_not_stored(self, fresh_shipper):
+        """A traceless client (browser poll, curl, health probe) mints a
+        fresh one-span trace per request — unfiltered, an open dashboard
+        would churn the bounded store past its cap in minutes, evicting
+        the lifecycle traces the plane exists for. Fast-and-healthy
+        rootless request spans are sampled out at the store exporter;
+        propagating callers (Session) are kept."""
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            for _ in range(5):
+                requests.get(f"{api.url}/api/v1/experiments", timeout=10)
+            sess = master_session(api)
+            root_trace = sess._trace_root[0]
+            sess.get("/api/v1/experiments")
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                master.tracer.flush()
+                if master.tracestore.get(root_trace) is not None:
+                    break
+                time.sleep(0.05)
+            assert master.tracestore.get(root_trace) is not None
+            # the 5 rootless polls minted no stored traces
+            assert master.tracestore.stats()["traces"] == 1
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_session_trace_root_rotates(self):
+        """A daemon's Session must not funnel its whole lifetime into one
+        trace: the fallback root rotates well under the store's per-trace
+        span cap, so agent polling never degenerates into a capped
+        forever-trace counting bogus span loss."""
+        from determined_tpu.common.api_session import Session
+
+        s = Session("http://127.0.0.1:1")
+        first = s._session_root()
+        for _ in range(Session.TRACE_ROOT_MAX_USES - 1):
+            assert s._session_root() == first
+        assert s._session_root() != first
+
+    def test_ingest_route_spans_not_self_stored(self, fresh_shipper):
+        """The ingest route's own request spans are filtered at the store
+        exporter — each shipper flush must not grow a trace of ingest
+        POSTs forever."""
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            sess = master_session(api)
+            root_trace = sess._trace_root[0]
+            for _ in range(3):
+                sess.post("/api/v1/traces/ingest", json_body={"spans": []})
+            # sentinel request on the same session-trace: once ITS span
+            # lands, the ingest spans (older) had their chance
+            sess.get("/api/v1/master")
+            doc = None
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                master.tracer.flush()
+                doc = master.tracestore.get(root_trace)
+                if doc is not None:
+                    break
+                time.sleep(0.05)
+            assert doc is not None
+            assert not any(
+                "traces/ingest" in s["name"] for s in _flatten(doc["tree"])
+            ), doc
+        finally:
+            api.stop()
+            master.shutdown()
+
+
+class TestMasterconfTraces:
+    def test_unknown_key_named(self):
+        with pytest.raises(ValueError, match="traces: unknown key"):
+            Master(traces_config={"max_tarces": 10})
+
+    def test_bad_values_named(self):
+        from determined_tpu.master import masterconf
+
+        errs = masterconf.validate_traces(
+            {"sample": 1.5, "max_traces": 0, "enabled": "yes",
+             "slow_ms": -1}
+        )
+        assert len(errs) == 4
+        assert any("sample" in e for e in errs)
+        assert any("enabled" in e for e in errs)
+
+    def test_disabled_plane(self, fresh_shipper):
+        """traces.enabled=false: NullTracer (no store exporter) and tasks
+        are told not to ship (DTPU_TRACE_INGEST=off in the task env)."""
+        from determined_tpu import _info
+        from determined_tpu.master.tracing import NullTracer
+
+        master = Master(traces_config={"enabled": False})
+        api = ApiServer(master)
+        api.start()
+        try:
+            assert isinstance(master.tracer, NullTracer)
+            env = master._build_task_env(
+                alloc_id="a.1.0", task_id="trial-1", task_type="TRIAL",
+                agent_id="ag", rank=0, num_procs=1, slots=1, config={},
+                trial_info=None, task_ctx=None,
+            )
+            assert env[trace.TRACE_INGEST_ENV] == "off"
+            # a daemon that ships anyway (agents configure their shipper
+            # unconditionally) must not fill a disabled plane's store:
+            # the ingest route refuses with a NON-retryable status
+            resp = requests.post(
+                f"{api.url}/api/v1/traces/ingest",
+                json={"spans": []}, timeout=10,
+            )
+            assert resp.status_code == 404
+            assert master.tracestore.stats()["spans"] == 0
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_sampling_knobs_injected_into_task_env(self):
+        master = Master(
+            traces_config={"sample": 0.25, "slow_ms": 125.0}
+        )
+        try:
+            env = master._build_task_env(
+                alloc_id="a.1.0", task_id="trial-1", task_type="TRIAL",
+                agent_id="ag", rank=0, num_procs=1, slots=1, config={},
+                trial_info=None, task_ctx=None,
+            )
+            assert env[trace.TRACE_SAMPLE_ENV] == "0.25"
+            assert env[trace.TRACE_SLOW_MS_ENV] == "125.0"
+            assert trace.TRACE_INGEST_ENV not in env
+        finally:
+            master.shutdown()
+
+
+class TestDevclusterE2E:
+    """Acceptance: a real devcluster trial produces ONE assembled tree —
+    master submit, allocation, agent launch, trial.run, trial.first_step
+    — with a non-empty critical path; and the lifecycle histogram lands
+    on the live metrics surface."""
+
+    CONFIG = {
+        "entrypoint": "determined_tpu.exec.builtin_trials:SyntheticTrial",
+        "searcher": {"name": "single", "max_length": 2, "metric": "loss"},
+        "hyperparameters": {
+            "model": "mnist-mlp", "batch_size": 8,
+            "lr": {"type": "log", "minval": -3, "maxval": -1},
+        },
+        "resources": {"slots_per_trial": 1},
+        "scheduling_unit": 1,
+        "environment": {"jax_platform": "cpu"},
+    }
+
+    def test_lifecycle_trace_assembled_and_exemplar_reachable(
+        self, tmp_path, fresh_shipper
+    ):
+        from determined_tpu.devcluster import DevCluster
+
+        with DevCluster(n_agents=1, slots_per_agent=1) as dc:
+            sess = dc.session()
+            root_trace = sess._trace_root[0]
+            cfg = dict(self.CONFIG)
+            cfg["checkpoint_storage"] = {
+                "type": "shared_fs", "host_path": str(tmp_path / "ckpt"),
+            }
+            exp_id = sess.post(
+                "/api/v1/experiments", json_body={"config": cfg}
+            )["id"]
+            assert dc.wait_experiment(exp_id, timeout=240) == "COMPLETED"
+            # the agent flushes at stop; the trial flushed at exit — give
+            # the last shipper batch a beat, then flush everything still
+            # in flight on our side of the process.
+            trace.flush_shipper()
+            dc.master.tracer.flush()
+            deadline = time.time() + 30
+            names = set()
+            want = {"allocation", "agent.task_launch", "trial.run",
+                    "trial.first_step"}
+            while time.time() < deadline and not want <= names:
+                trace.flush_shipper()
+                dc.master.tracer.flush()
+                doc = dc.master.tracestore.get(root_trace)
+                names = (
+                    {s["name"] for s in _flatten(doc["tree"])}
+                    if doc else set()
+                )
+                if not want <= names:
+                    time.sleep(1.0)
+            assert any("POST" in n and n.endswith("experiments$")
+                       for n in names), names
+            assert want <= names, names
+
+            # search finds it by experiment; critical path is non-empty
+            hits = requests.get(
+                f"{dc.api.url}/api/v1/traces?experiment={exp_id}",
+                timeout=10,
+            ).json()["traces"]
+            assert root_trace in [t["trace_id"] for t in hits]
+            doc = requests.get(
+                f"{dc.api.url}/api/v1/traces/{root_trace}", timeout=10
+            ).json()
+            cp = {s["segment"] for s in doc["critical_path"]}
+            assert "first_step" in cp and "submit" in cp, doc["critical_path"]
+
+            # lifecycle histogram published; exemplar links a quantile
+            # answer back to a STORED trace on the live query surface
+            import math
+
+            dc.master.scraper.interval_s = math.inf
+            dc.master.scraper.scrape_once()
+            q = requests.get(
+                f"{dc.api.url}/api/v1/metrics/query"
+                "?name=dtpu_api_request_duration_seconds&func=quantile",
+                timeout=10,
+            ).json()
+            exemplars = q.get("exemplars") or []
+            assert exemplars, q
+            reachable = [
+                e for e in exemplars
+                if requests.get(
+                    f"{dc.api.url}/api/v1/traces/{e['trace_id']}",
+                    timeout=10,
+                ).status_code == 200
+            ]
+            assert reachable, exemplars
+            lc = requests.get(
+                f"{dc.api.url}/api/v1/metrics/query"
+                "?name=dtpu_lifecycle_segment_seconds"
+                "&func=quantile&q=0.5&window=600",
+                timeout=10,
+            ).json()
+            # ingested into the TSDB via the self-scrape: series exist
+            series = requests.get(
+                f"{dc.api.url}/api/v1/metrics/series"
+                "?name=dtpu_lifecycle_segment_seconds_bucket",
+                timeout=10,
+            ).json()["series"]
+            assert series, lc
+
+    def test_errored_trial_retained_under_aggressive_sampling(
+        self, fresh_shipper
+    ):
+        """Tail sampling keeps errors: with head-sampling at 0 the failed
+        trial's errored trial.run span still reaches the store."""
+        from determined_tpu.devcluster import DevCluster
+
+        with DevCluster(
+            n_agents=1, slots_per_agent=1,
+            traces_config={"sample": 0.0, "slow_ms": 1e9},
+        ) as dc:
+            sess = dc.session()
+            root_trace = sess._trace_root[0]
+            cfg = dict(self.CONFIG)
+            cfg["entrypoint"] = (
+                "determined_tpu.exec.builtin_trials:CrashingTrial"
+            )
+            cfg["max_restarts"] = 0
+            exp_id = sess.post(
+                "/api/v1/experiments", json_body={"config": cfg}
+            )["id"]
+            state = dc.wait_experiment(exp_id, timeout=240)
+            assert state in ("ERRORED", "COMPLETED"), state
+            deadline = time.time() + 30
+            doc = None
+            while time.time() < deadline:
+                dc.master.tracer.flush()
+                doc = dc.master.tracestore.get(root_trace)
+                if doc is not None and any(
+                    s["name"] == "trial.run" and s["error"]
+                    for s in _flatten(doc["tree"])
+                ):
+                    break
+                time.sleep(1.0)
+            assert doc is not None
+            runs = [
+                s for s in _flatten(doc["tree"])
+                if s["name"] == "trial.run"
+            ]
+            assert runs and any(s["error"] for s in runs), doc
+
+
+def _flatten(tree):
+    out = []
+    for node in tree:
+        out.append(node)
+        out.extend(_flatten(node.get("children", [])))
+    return out
