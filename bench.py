@@ -31,6 +31,7 @@ import functools
 import json
 import os
 import tempfile
+import threading
 import time
 
 import jax
@@ -874,6 +875,114 @@ def trace_rung(step_time_s: float):
         return None
 
 
+def profiling_rung(step_time_s: float):
+    """Profiling plane rung (PR 12): sampler overhead against the measured
+    step time (acceptance < 1% — the sampler's whole cost is its
+    stack-walk, priced directly and scaled by the sampling rate), window
+    ingest throughput through the REAL HTTP path (shipper batches →
+    POST /api/v1/profiles/ingest → bounded store), and flame-merge query
+    p99 with the store at its full window cap."""
+    try:
+        from determined_tpu.common import profiling as profiling_mod
+        from determined_tpu.common.api_session import Session
+        from determined_tpu.master.api_server import ApiServer
+        from determined_tpu.master.core import Master
+
+        out = {}
+
+        # Sampler overhead: the walk cost is the ONLY per-sample work the
+        # profiled process pays (aggregation rides the same call; shipping
+        # is the flush thread's). Fraction of one core stolen from the
+        # workload = hz × per-walk seconds; report it against the step
+        # time's core-second the way timeline_overhead_pct does.
+        stop_evt = threading.Event()
+
+        def churn():  # give the walker a real multi-thread stack set
+            while not stop_evt.is_set():
+                sum(i * i for i in range(200))
+
+        threads = [threading.Thread(target=churn, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        prof = profiling_mod.SamplingProfiler("bench", sink=lambda w: None)
+        n = 2000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            prof._sample_once()
+        per_walk = (time.perf_counter() - t0) / n
+        stop_evt.set()
+        for t in threads:
+            t.join()
+        hz = profiling_mod.DEFAULT_HZ
+        out["profiling_sampler_us_per_walk"] = round(1e6 * per_walk, 2)
+        out["profiling_sampler_overhead_pct"] = round(
+            100.0 * hz * per_walk, 4
+        )
+
+        master = Master(profiling_config={"max_windows": 2000})
+        api = ApiServer(master)
+        api.start()
+        try:
+            sess = Session(api.url)
+            bench_epoch = time.time()  # inside retention, or trim eats it
+
+            def window(target_i: int, w: int, groups: int = 50):
+                t0w = bench_epoch - 60 + w * 1e-3
+                return {
+                    "target": f"trial:{target_i}.r0",
+                    "start": t0w, "end": t0w + 10.0, "hz": 19.0,
+                    "samples": [{
+                        "thread": "MainThread",
+                        "phase": ("step", "data_wait")[g % 2],
+                        "stack": "bench.py:main;bench.py:fit;"
+                                 f"bench.py:frame{g % 97}",
+                        "count": 1 + g % 7,
+                    } for g in range(groups)],
+                }
+
+            # Ingest throughput: 200 shipper-sized batches (8 windows of
+            # 50 stack groups each) through the real dispatch path.
+            payloads = [
+                [window(i % 8, i * 8 + k) for k in range(8)]
+                for i in range(200)
+            ]
+            t0 = time.perf_counter()
+            for p in payloads:
+                sess.post("/api/v1/profiles/ingest", json_body={"windows": p})
+            dt = time.perf_counter() - t0
+            out["profiling_ingest_windows_per_sec"] = round(200 * 8 / dt, 1)
+
+            # Fill the store to its FULL window cap (direct ingest — the
+            # HTTP hop is already priced above), then time flame merges
+            # over it through the API.
+            for i in range(2000):
+                master.profilestore.ingest([window(8 + i % 16, i)])
+            assert master.profilestore.stats()["windows"] == 2000
+            lat = []
+            for i in range(300):
+                tgt = f"trial:{8 + (i % 16)}.r0"
+                t0 = time.perf_counter()
+                doc = sess.get(
+                    "/api/v1/profiles/flame", params={"target": tgt}
+                )
+                lat.append(time.perf_counter() - t0)
+                assert doc["samples"] > 0
+            lat.sort()
+            out["profiling_flame_p99_ms"] = round(
+                1e3 * lat[int(len(lat) * 0.99)], 3
+            )
+        finally:
+            api.stop()
+            master.shutdown()
+        return out
+    except Exception:  # noqa: BLE001 — skip the rung, keep the headline
+        import traceback
+
+        traceback.print_exc()
+        return None
+
+
 def main() -> None:
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
@@ -1051,6 +1160,13 @@ def main() -> None:
         trr = trace_rung(step_time_s)
         if trr is not None:
             record.update(trr)
+    if not os.environ.get("DTPU_BENCH_SKIP_PROFILING"):
+        # Profiling plane (PR 12): sampler stack-walk overhead (<1%),
+        # window ingest throughput over HTTP, flame-merge query p99 at
+        # the full window cap.
+        pr = profiling_rung(step_time_s)
+        if pr is not None:
+            record.update(pr)
     print(json.dumps(record))
 
 

@@ -34,6 +34,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from determined_tpu.common import faults
+from determined_tpu.common import profiling as profiling_mod
 from determined_tpu.common import trace as trace_mod
 from determined_tpu.common.api_session import Session
 from determined_tpu.common.metrics import REGISTRY as METRICS
@@ -250,6 +251,7 @@ class AgentDaemon:
         self.devices = detect_devices(slots)
         self.pool = pool
         self.session = Session(master_url, token=token)
+        self._token = token
         # Trace plane: this daemon's spans (agent.task_launch) ship to the
         # master's trace store — the agent has no launch env to
         # self-configure from, so it points the shipper explicitly.
@@ -277,6 +279,10 @@ class AgentDaemon:
         self.metrics: Optional[AgentMetricsServer] = None
         if metrics_port is not None:
             self.metrics = AgentMetricsServer(port=metrics_port)
+        #: continuous-profiling sampler for this daemon (started when the
+        #: register ack opts us in; per-agent object, NOT the module
+        #: singleton — devcluster runs several agents in one process).
+        self._profiler: Optional[profiling_mod.SamplingProfiler] = None
         self._recover_tasks()
         # Deterministic spot-reclaim drill (`agent.reclaim.rank<r>` fault
         # sites): a dedicated watcher so the reclaim lands mid-training,
@@ -332,6 +338,22 @@ class AgentDaemon:
             if running else "",
         )
         self._flush_pending_exits()
+        prof_cfg = resp.get("profiling")
+        if prof_cfg and self._profiler is None:
+            # Master opted this daemon into the profiling plane: sample our
+            # own stacks (poll loops, launch path, log pumps) and ship
+            # folded windows back as target agent:<id>.
+            try:
+                self._profiler = profiling_mod.SamplingProfiler(
+                    f"agent:{self.agent_id}",
+                    hz=float(prof_cfg.get("sample_hz") or 0) or None,
+                    window_s=float(prof_cfg.get("window_s") or 0) or None,
+                    shipper=profiling_mod.ProfileShipper(
+                        self.master_url, self._token
+                    ),
+                ).start()
+            except Exception:  # noqa: BLE001 — observability never kills work
+                logger.debug("agent profiler start failed", exc_info=True)
         return bool(retry)
 
     def run_forever(self) -> None:
@@ -406,6 +428,11 @@ class AgentDaemon:
         # the launch spans of just-killed tasks are exactly what a
         # post-mortem wants.
         trace_mod.flush_shipper()
+        if self._profiler is not None:
+            # Final window ships with the stop (the master keeps it under
+            # retention; an agent vanishing mid-window loses ≤ one window).
+            self._profiler.stop(flush=True)
+            self._profiler = None
         if self.metrics is not None:
             self.metrics.stop()
             self.metrics = None

@@ -1053,6 +1053,132 @@ def traces_show_cmd(args: argparse.Namespace) -> None:
         ))
 
 
+def _profile_params(args: argparse.Namespace) -> Dict[str, Any]:
+    params: Dict[str, Any] = {}
+    if getattr(args, "target", None):
+        params["target"] = args.target
+    if getattr(args, "span", None):
+        params["span"] = args.span
+    if getattr(args, "phase", None):
+        params["phase"] = args.phase
+    if getattr(args, "last", None):
+        params["since"] = str(time.time() - args.last)
+    return params
+
+
+def profiles_top_cmd(args: argparse.Namespace) -> None:
+    """`dtpu profiles top [--target T] [--span HEX] [--phase P]
+    [--last S] [-n N]` — hottest frames by self time from the master's
+    continuous-profiling store."""
+    params = _profile_params(args)
+    params["n"] = str(args.n)
+    out = _session(args).get("/api/v1/profiles/top", params=params)
+    frames = out.get("frames", [])
+    if not frames:
+        print("(no samples matched)")
+    else:
+        print(f"{'SELF%':>6} {'SELF':>8} {'TOTAL':>8}  FRAME")
+        for f in frames:
+            print(
+                f"{f['self_pct']:>5.1f}% {f['self']:>8} {f['total']:>8}  "
+                f"{f['frame']}"
+            )
+    print(
+        f"-- {out.get('samples', 0)} sample(s) over "
+        f"{out.get('windows', 0)} window(s)"
+    )
+
+
+def profiles_flame_cmd(args: argparse.Namespace) -> None:
+    """`dtpu profiles flame [--target T] [--span HEX] [--phase P]
+    [--last S]` — merged folded stacks (collapse format: pipe straight
+    into flamegraph.pl or speedscope)."""
+    out = _session(args).get(
+        "/api/v1/profiles/flame", params=_profile_params(args)
+    )
+    stacks = out.get("stacks", [])
+    if not stacks:
+        print("(no samples matched)")
+        return
+    for s in stacks:
+        print(f"{s['stack']} {s['count']}")
+
+
+def profiles_diff_cmd(args: argparse.Namespace) -> None:
+    """`dtpu profiles diff [--last S] [...]` — window-vs-window regression
+    diff: the latest `--last` seconds (B) against the `--last` seconds
+    before them (A), unless explicit bounds are given."""
+    now = time.time()
+    last = args.last or 600.0
+    params = _profile_params(args)
+    params.pop("since", None)
+    params.update({
+        "a_since": str(args.a_since if args.a_since is not None
+                       else now - 2 * last),
+        "a_until": str(args.a_until if args.a_until is not None
+                       else now - last),
+        "b_since": str(args.b_since if args.b_since is not None
+                       else now - last),
+        "b_until": str(args.b_until if args.b_until is not None else now),
+    })
+    out = _session(args).get("/api/v1/profiles/diff", params=params)
+    rows = out.get("stacks", [])
+    if not rows:
+        print("(no samples in either window)")
+        return
+    print(f"{'ΔFRAC':>7} {'A':>7} {'B':>7}  STACK")
+    for s in rows[: args.n]:
+        leaf = s["stack"].rsplit(";", 1)[-1]
+        print(
+            f"{s['delta_frac']:>+6.1%} {s['a']:>7} {s['b']:>7}  {leaf}"
+            f"  [{s['stack'][:120]}]"
+        )
+
+
+def profiles_capture_cmd(args: argparse.Namespace) -> None:
+    """`dtpu profiles capture (--trial N | --task ID) [--steps K]
+    [--wait]` — ask the master to deliver a bounded XLA-trace directive
+    on the target's next poll; --wait follows the record to terminal."""
+    body: Dict[str, Any] = {"steps": args.steps}
+    if args.trial is not None:
+        body["trial_id"] = args.trial
+    if args.task:
+        body["task_id"] = args.task
+    sess = _session(args)
+    cap = sess.post("/api/v1/profiles/capture", json_body=body)
+    print(
+        f"capture {cap.get('id')} pending for "
+        f"{cap.get('kind')}:{cap.get('ident')}"
+    )
+    if not args.wait:
+        return
+    deadline = time.time() + args.timeout
+    while time.time() < deadline:
+        time.sleep(2)
+        rec = sess.get("/api/v1/profiles/captures").get("captures", [])
+        match = next((c for c in rec if c.get("id") == cap.get("id")), None)
+        if match and match.get("state") in ("completed", "failed"):
+            if match.get("artifact"):
+                print(f"{match['state']}: artifact {match['artifact']}")
+            else:
+                print(f"{match['state']}: {match.get('error') or '(no detail)'}")
+            return
+    print("timed out waiting for capture to complete")
+
+
+def profiles_captures_cmd(args: argparse.Namespace) -> None:
+    """`dtpu profiles captures` — capture directive records, newest last."""
+    caps = _session(args).get("/api/v1/profiles/captures").get("captures", [])
+    if not caps:
+        print("(no captures)")
+    for c in caps:
+        extra = c.get("artifact") or c.get("error") or ""
+        print(
+            f"{c['id']}  {c['state']:<9}  {c['kind']}:{c['ident']}  "
+            f"steps={c['steps']}  {extra}"
+        )
+
+
 def alerts_list(args: argparse.Namespace) -> None:
     out = _session(args).get("/api/v1/alerts")
     alerts = out.get("alerts", [])
@@ -1480,6 +1606,47 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("trace_id", help="32-hex trace id (from traces list "
                                     "or a metrics-query exemplar)")
     v.set_defaults(fn=traces_show_cmd)
+
+    profiles = sub.add_parser("profiles").add_subparsers(
+        dest="verb", required=True)
+
+    def _prof_filters(p: argparse.ArgumentParser) -> None:
+        p.add_argument("--target", default=None,
+                       help="process identity: master, agent:<id>, "
+                            "trial:<t>.r<k>, serving:<task>")
+        p.add_argument("--span", default=None,
+                       help="16-hex span id (from `dtpu traces show`): only "
+                            "samples taken while that span was open")
+        p.add_argument("--phase", default=None,
+                       help="trainer timeline phase: data_wait, h2d_put, "
+                            "step, report, checkpoint")
+        p.add_argument("--last", type=float, default=None,
+                       help="only the last N seconds of windows")
+
+    v = profiles.add_parser("top")
+    _prof_filters(v)
+    v.add_argument("-n", type=int, default=20)
+    v.set_defaults(fn=profiles_top_cmd)
+    v = profiles.add_parser("flame")
+    _prof_filters(v)
+    v.set_defaults(fn=profiles_flame_cmd)
+    v = profiles.add_parser("diff")
+    _prof_filters(v)
+    v.add_argument("-n", type=int, default=20)
+    v.add_argument("--a-since", type=float, default=None, dest="a_since")
+    v.add_argument("--a-until", type=float, default=None, dest="a_until")
+    v.add_argument("--b-since", type=float, default=None, dest="b_since")
+    v.add_argument("--b-until", type=float, default=None, dest="b_until")
+    v.set_defaults(fn=profiles_diff_cmd)
+    v = profiles.add_parser("capture")
+    v.add_argument("--trial", type=int, default=None)
+    v.add_argument("--task", default=None)
+    v.add_argument("--steps", type=int, default=3,
+                   help="trace length: steps (trial) / seconds (task)")
+    v.add_argument("--wait", action="store_true")
+    v.add_argument("--timeout", type=float, default=120.0)
+    v.set_defaults(fn=profiles_capture_cmd)
+    profiles.add_parser("captures").set_defaults(fn=profiles_captures_cmd)
 
     alerts = sub.add_parser("alerts")
     alerts.add_argument("--history", action="store_true",

@@ -99,6 +99,23 @@ _current: contextvars.ContextVar = contextvars.ContextVar(
 
 Context = Tuple[str, str]
 
+#: thread-ident → active (trace_id, span_id), maintained by span() for
+#: CROSS-thread readers: a contextvar is invisible outside its own
+#: thread, and the sampling profiler (common/profiling.py) attributes
+#: stacks from sys._current_frames() on its own daemon thread — this is
+#: how a sample learns which span the sampled thread was inside. Plain
+#: dict ops are GIL-atomic; the hot-path cost is two dict stores per
+#: span() block.
+_thread_spans: Dict[int, Context] = {}
+
+
+def span_for_thread(ident: int) -> Optional[Context]:
+    """The (trace_id, span_id) the given thread is currently inside —
+    None when its active code is not under a span() block. Profiling-
+    plane reader; snapshot semantics only (the span may end between the
+    read and any use)."""
+    return _thread_spans.get(ident)
+
 
 def new_trace_id() -> str:
     return secrets.token_hex(16)
@@ -438,6 +455,9 @@ def span(
     parent_span_id = ctx[1] if ctx else None
     span_id = new_span_id()
     token = _current.set((trace_id, span_id))
+    ident = threading.get_ident()
+    prev_thread_span = _thread_spans.get(ident)
+    _thread_spans[ident] = (trace_id, span_id)
     start = time.time()
     error = False
     try:
@@ -446,6 +466,10 @@ def span(
         error = True
         raise
     finally:
+        if prev_thread_span is not None:
+            _thread_spans[ident] = prev_thread_span
+        else:
+            _thread_spans.pop(ident, None)
         _current.reset(token)
         _export(
             name, trace_id, span_id, parent_span_id, start, time.time(),

@@ -31,6 +31,10 @@ class TrainContext:
         self._allocation_id = allocation_id
         self._rank = rank
         self._heartbeat_warned = False
+        #: capture directive latched off a heartbeat response (the
+        #: profiling plane's operator-triggered XLA trace); the trainer
+        #: pops it at its report boundary via take_profile_capture().
+        self._pending_capture: Optional[Dict[str, Any]] = None
 
     def _report(self, group: str, steps_completed: int, metrics: Dict[str, Any]) -> None:
         self._session.post(
@@ -86,6 +90,10 @@ class TrainContext:
                 },
             )
             self._heartbeat_warned = False
+            if isinstance(resp, dict) and resp.get("profile_capture"):
+                # One-shot latch, popped by the trainer at its next
+                # boundary — the beat must stay advisory either way.
+                self._pending_capture = resp["profile_capture"]
             if isinstance(resp, dict) and resp.get("resize"):
                 return resp["resize"]
         except Exception as e:  # noqa: BLE001 — advisory beat, never fatal
@@ -96,6 +104,11 @@ class TrainContext:
                     "until one succeeds)", steps_completed, e,
                 )
         return None
+
+    def take_profile_capture(self) -> Optional[Dict[str, Any]]:
+        """Pop the latched profile-capture directive, if any (one-shot)."""
+        cap, self._pending_capture = self._pending_capture, None
+        return cap
 
     def set_status(self, status: str) -> None:
         self._session.post(
@@ -123,6 +136,9 @@ class DummyTrainContext(TrainContext):
 
     def heartbeat_step(self, steps_completed: int) -> Optional[Dict[str, Any]]:
         self._heartbeats.append(int(steps_completed))
+        return None
+
+    def take_profile_capture(self) -> Optional[Dict[str, Any]]:
         return None
 
     def set_status(self, status: str) -> None:

@@ -34,6 +34,7 @@ class DevCluster:
         metrics_config: Optional[Dict[str, Any]] = None,
         alerts_config: Optional[Dict[str, Any]] = None,
         traces_config: Optional[Dict[str, Any]] = None,
+        profiling_config: Optional[Dict[str, Any]] = None,
     ) -> None:
         #: agent_metrics=True gives every agent an ephemeral health port
         #: (+ registers it as a master scrape target) — opt-in so the
@@ -55,6 +56,7 @@ class DevCluster:
             metrics_config=metrics_config,
             alerts_config=alerts_config,
             traces_config=traces_config,
+            profiling_config=profiling_config,
         )
         self._cert_env_prev: Optional[str] = None
         self._tls_dir: Optional[str] = None
@@ -173,6 +175,11 @@ class DevCluster:
         from determined_tpu.common import trace as trace_mod
 
         trace_mod.reset_shipper()
+        # Same hygiene for the module-singleton profiler a task started
+        # in-process (notebook/serving helpers under tests).
+        from determined_tpu.common import profiling as profiling_mod
+
+        profiling_mod.reset_profiler()
         self._restore_tls_state()
 
     def __enter__(self) -> "DevCluster":

@@ -15,6 +15,7 @@ import sys
 from typing import Any, Dict, Optional
 
 from determined_tpu import core
+from determined_tpu.common import profiling
 from determined_tpu.common import trace
 from determined_tpu.parallel.mesh import MeshConfig, make_mesh
 from determined_tpu.trainer import Batch, Epoch, Trainer
@@ -97,6 +98,16 @@ def run(entrypoint: str) -> int:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     assert info is not None and info.trial is not None, "harness needs a trial env"
 
+    # Continuous-profiling plane: when the master enabled it for this
+    # allocation (DTPU_PROFILE=1 in the task env), every rank samples its
+    # own stacks and ships folded windows back — identity trial:<t>.r<k>.
+    rank = int(os.environ.get("DTPU_ALLOC_RANK", "0"))
+    profiling.maybe_start_from_env(
+        target=f"trial:{info.trial.trial_id}.r{rank}",
+        master_url=info.master_url,
+        token=info.session_token,
+    )
+
     # Elastic resize loop: a resize directive exits Trainer.fit with
     # ElasticResizeExit; this loop re-enters rendezvous under the new
     # generation (exec/prep_and_run.apply_resize), rebuilds the core
@@ -114,6 +125,7 @@ def run(entrypoint: str) -> int:
         # this short-lived subprocess exits — atexit is the backstop, but
         # an exec'd or hard-exiting wrapper would skip it.
         trace.flush_shipper()
+        profiling.flush_profiler()
 
 
 def _run_loop(

@@ -25,6 +25,7 @@ from determined_tpu.common.metrics import REGISTRY as METRICS
 from determined_tpu.master.core import (
     EXPERIMENT_GOODPUT,
     SENTINEL_DIVERGENCE,
+    STEP_FLOPS,
     Master,
 )
 from determined_tpu.master.db import TERMINAL_STATES
@@ -103,6 +104,8 @@ TASK_TOKEN_ROUTES = re.compile(
     r"|master"
     r"|auth/logout"
     r"|traces/ingest"              # span shipper (trial/serving processes)
+    r"|profiles/ingest"            # profile sampler (trial/serving processes)
+    r"|profiles/captures/[\w\-]+/complete"  # capture artifact registration
     r")$"
 )
 
@@ -115,6 +118,7 @@ AGENT_TOKEN_ROUTES = re.compile(
     r"|master"
     r"|auth/logout"
     r"|traces/ingest"              # span shipper (agent launch spans)
+    r"|profiles/ingest"            # profile sampler (agent daemon)
     r")$"
 )
 
@@ -464,6 +468,21 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
                         # fired, so undo our own write (check-then-set
                         # alone would leak the series forever).
                         EXPERIMENT_GOODPUT.remove(exp_label)
+            # Per-step FLOPs from the trainer's compiled-step
+            # cost_analysis: the MFU numerator, scraped into the TSDB
+            # next to the phase fractions. Same live-experiment +
+            # undo-on-race discipline as the goodput gauge above.
+            sf = metrics.get("step_flops")
+            if isinstance(sf, (int, float)) and sf > 0:
+                exp_label = _experiment_of(trial_id)
+                live = (
+                    m.get_experiment(int(exp_label))
+                    if exp_label is not None else None
+                )
+                if live is not None and live.state not in TERMINAL_STATES:
+                    STEP_FLOPS.labels(exp_label).set(float(sf))
+                    if live.state in TERMINAL_STATES:
+                        STEP_FLOPS.remove(exp_label)
             # Feed device HBM utilization to profiling-driven searchers
             # (autotune's microbatch-jump heuristic; experiment.report_hbm
             # no-ops for every other method).
@@ -576,6 +595,11 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         resize = m.alloc_service.pending_resize(r.groups[0], gen_i)
         if resize is not None:
             resp["resize"] = resize
+        # Task-kind capture directives (serving replicas) ride the
+        # preemption poll — the only channel a serving replica drives.
+        capture = m.pop_profile_capture(r.groups[0], kinds=("task",))
+        if capture is not None:
+            resp["profile_capture"] = capture
         return resp
 
     def ack_preemption(r: ApiRequest):
@@ -637,7 +661,15 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         )
         if directive is not None:
             return {"resize": directive}
-        return {}
+        resp: Dict[str, Any] = {}
+        if int(r.body.get("rank", 0)) == 0:
+            # Trial-kind capture directives ride the chief's beat: one
+            # rank owns the jax.profiler session, and the chief is the
+            # rank that already does the window's reporting sync.
+            capture = m.pop_profile_capture(r.groups[0], kinds=("trial",))
+            if capture is not None:
+                resp["profile_capture"] = capture
+        return resp
 
     def rendezvous_arrive(r: ApiRequest):
         from determined_tpu.master.allocation import StaleGenerationError
@@ -883,6 +915,14 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
             metrics_addr=metrics_addr,
         )
         res["cluster_id"] = m.cluster_id
+        # Profiling-plane opt-in rides the register ack: the agent daemon
+        # has no launch env to read DTPU_PROFILE from, so the master tells
+        # it directly whether (and how fast) to sample itself.
+        if m._profiling_cfg["enabled"]:
+            res["profiling"] = {
+                "sample_hz": m._profiling_cfg["sample_hz"],
+                "window_s": m._profiling_cfg["window_s"],
+            }
         return res
 
     def agent_actions(r: ApiRequest):
@@ -1715,6 +1755,116 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
             raise ApiError(400, str(e))
         return {"traces": traces, "stats": m.tracestore.stats()}
 
+    # -- profiling plane (master/profilestore.py): the master's own
+    # -- flamegraph store, fed by the common/profiling.py sampler in every
+    # -- process ------------------------------------------------------------
+    def profiles_ingest(r: ApiRequest):
+        """POST /api/v1/profiles/ingest — batch window ingest from
+        samplers. Never 4xxes a well-formed envelope: per-window problems
+        are dropped and counted inside the store (a shipper must not
+        retry-loop over one bad window)."""
+        from determined_tpu.common import faults
+
+        if not m._profiling_cfg["enabled"]:
+            # Same contract as the disabled trace plane: 404 is a
+            # non-retryable status for the shipper — the batch is counted
+            # dropped once, no retry churn filling a disabled store.
+            raise ApiError(404, "profiling plane disabled (profiling.enabled)")
+        faults.inject("master.profile_ingest")
+        windows = r.body.get("windows")
+        if windows is None:
+            windows = []
+        if not isinstance(windows, list):
+            raise ApiError(400, "windows must be a list of profile windows")
+        return {"stored": m.profilestore.ingest(windows)}
+
+    def _profile_filters(r: ApiRequest) -> Dict[str, Any]:
+        try:
+            since = r.q("since")
+            until = r.q("until")
+            return {
+                "target": r.q("target"),
+                "span": r.q("span"),
+                "phase": r.q("phase"),
+                "since": float(since) if since is not None else None,
+                "until": float(until) if until is not None else None,
+            }
+        except (TypeError, ValueError) as e:
+            raise ApiError(400, str(e))
+
+    def profiles_flame(r: ApiRequest):
+        """GET /api/v1/profiles/flame?target=…&span=…&phase=…&since=…
+        &until=… — merged folded stacks over the slice (flamegraph wire
+        format), plus the store's bounds accounting."""
+        flt = _profile_filters(r)
+        doc = m.profilestore.flame(
+            limit=int(r.q("limit", "5000")), **flt
+        )
+        doc["stats"] = m.profilestore.stats()
+        return doc
+
+    def profiles_top(r: ApiRequest):
+        """GET /api/v1/profiles/top?n=… — top-N frames by self time."""
+        flt = _profile_filters(r)
+        doc = m.profilestore.top(n=int(r.q("n", "20")), **flt)
+        doc["stats"] = m.profilestore.stats()
+        return doc
+
+    def profiles_diff(r: ApiRequest):
+        """GET /api/v1/profiles/diff?a_since=…&a_until=…&b_since=…
+        &b_until=… — window-vs-window folded-stack delta."""
+        try:
+            ranges = {
+                k: (float(v) if (v := r.q(k)) is not None else None)
+                for k in ("a_since", "a_until", "b_since", "b_until")
+            }
+        except (TypeError, ValueError) as e:
+            raise ApiError(400, str(e))
+        return m.profilestore.diff(
+            target=r.q("target"), span=r.q("span"), phase=r.q("phase"),
+            limit=int(r.q("limit", "200")), **ranges,
+        )
+
+    def profiles_capture(r: ApiRequest):
+        """POST /api/v1/profiles/capture — operator-requested bounded XLA
+        trace on a running trial ({"trial_id": N}) or serving/command
+        task ({"task_id": "…"}); delivered as a directive on the target's
+        next progress-beat / preemption poll."""
+        trial_id = r.body.get("trial_id")
+        task_id = r.body.get("task_id")
+        steps = r.body.get("steps", 3)
+        if (trial_id is None) == (task_id is None):
+            raise ApiError(400, "exactly one of trial_id / task_id required")
+        try:
+            steps = int(steps)
+        except (TypeError, ValueError):
+            raise ApiError(400, "steps must be an integer")
+        if trial_id is not None:
+            exp_of_trial(int(trial_id))  # 404s unknown trials
+            cap = m.profilestore.request_capture("trial", int(trial_id),
+                                                 steps=steps)
+        else:
+            if str(task_id) not in m._commands:
+                raise ApiError(404, f"no such task {task_id}")
+            cap = m.profilestore.request_capture("task", str(task_id),
+                                                 steps=steps)
+        return cap
+
+    def profiles_captures(r: ApiRequest):
+        return {"captures": m.profilestore.list_captures()}
+
+    def profiles_capture_complete(r: ApiRequest):
+        """POST /api/v1/profiles/captures/<id>/complete — the captured
+        process registers the uploaded artifact link (or the failure)."""
+        doc = m.profilestore.complete_capture(
+            r.groups[0],
+            artifact=str(r.body.get("artifact", "") or ""),
+            error=str(r.body.get("error", "") or ""),
+        )
+        if doc is None:
+            raise ApiError(404, f"no capture {r.groups[0]}")
+        return doc
+
     R = lambda method, pat, h: (method, re.compile(f"^{pat}$"), h)  # noqa: E731
     return [
         R("POST", r"/api/v1/trials/(\d+)/metrics", post_metrics),
@@ -1816,6 +1966,14 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("POST", r"/api/v1/traces/ingest", traces_ingest),
         R("GET", r"/api/v1/traces/([0-9a-f]+)", traces_get),
         R("GET", r"/api/v1/traces", traces_search),
+        R("POST", r"/api/v1/profiles/ingest", profiles_ingest),
+        R("GET", r"/api/v1/profiles/flame", profiles_flame),
+        R("GET", r"/api/v1/profiles/top", profiles_top),
+        R("GET", r"/api/v1/profiles/diff", profiles_diff),
+        R("POST", r"/api/v1/profiles/capture", profiles_capture),
+        R("GET", r"/api/v1/profiles/captures", profiles_captures),
+        R("POST", r"/api/v1/profiles/captures/([\w\-]+)/complete",
+          profiles_capture_complete),
         R("GET", r"/prom/metrics", prometheus_metrics),
         R("GET", r"/metrics", prometheus_metrics),
         R("GET", r"/(?:ui)?", webui_page),

@@ -19,6 +19,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from determined_tpu import _info
+from determined_tpu.common import profiling as profiling_mod
 from determined_tpu.common import trace as trace_mod
 from determined_tpu.common.metrics import REGISTRY as METRICS
 from determined_tpu.master import checkpoint_gc, db as db_mod
@@ -45,6 +46,16 @@ STALL_KILLS = METRICS.counter(
 EXPERIMENT_GOODPUT = METRICS.gauge(
     "dtpu_experiment_goodput_pct",
     "Latest goodput percentage from each experiment's timeline ledger.",
+    labels=("experiment",),
+)
+#: Per-step model FLOPs from the trainer's compiled-step cost_analysis()
+#: (trial profiling reports) — MFU attribution lands in the TSDB next to
+#: the phase fractions. Same per-experiment label + terminal-state prune
+#: discipline as EXPERIMENT_GOODPUT.
+STEP_FLOPS = METRICS.gauge(
+    "dtpu_step_flops",
+    "Latest per-step model FLOPs reported by each experiment's trainer "
+    "(XLA cost_analysis of the compiled step).",
     labels=("experiment",),
 )
 #: Elastic gang resizes by direction: "shrink" = a rank was reclaimed/lost
@@ -393,6 +404,7 @@ class Master:
         metrics_config: Optional[Dict[str, Any]] = None,
         alerts_config: Optional[Dict[str, Any]] = None,
         traces_config: Optional[Dict[str, Any]] = None,
+        profiling_config: Optional[Dict[str, Any]] = None,
     ) -> None:
         # Validated config tier (masterconf.py, the config.go:129 analog):
         # fail at boot with every problem named, not mid-scheduling on the
@@ -406,6 +418,7 @@ class Master:
             metrics=metrics_config,
             alerts=alerts_config,
             traces=traces_config,
+            profiling=profiling_config,
         )
         self.cluster_id = uuid.uuid4().hex[:8]
         self._external_url = external_url
@@ -574,6 +587,26 @@ class Master:
             self.tsdb, resolve_rules(acfg), shipper=self.webhooks,
             interval_s=float(acfg["interval_s"]),
         )
+        # Profiling plane (master/profilestore.py): the master is its own
+        # Pyroscope — bounded folded-stack store fed by POST
+        # /api/v1/profiles/ingest from every sampler-equipped process AND
+        # by the master's OWN continuous sampler through a direct
+        # in-process sink (the StoreExporter precedent: no HTTP loopback
+        # to profile yourself).
+        from determined_tpu.master.profilestore import ProfileStore
+
+        pcfg = dict(masterconf.PROFILING_DEFAULTS)
+        pcfg.update(profiling_config or {})
+        self._profiling_cfg = pcfg
+        self.profilestore = ProfileStore(pcfg)
+        self._self_profiler: Optional[Any] = None
+        if pcfg["enabled"]:
+            self._self_profiler = profiling_mod.SamplingProfiler(
+                "master",
+                hz=float(pcfg["sample_hz"]),
+                window_s=float(pcfg["window_s"]),
+                sink=self.profilestore.ingest,
+            ).start()
         # Background worker for slow reactions to FSM events (checkpoint GC):
         # the state-change hook fires under the experiment lock and must not
         # do storage IO inline.
@@ -614,6 +647,7 @@ class Master:
             # Same boundedness for the per-experiment goodput series: a
             # finished experiment must not scrape forever at its last value.
             EXPERIMENT_GOODPUT.remove(str(exp.id))
+            STEP_FLOPS.remove(str(exp.id))
             config = exp.config
             exp_id = exp.id
             self._work.put(
@@ -786,6 +820,23 @@ class Master:
         else:
             env[trace_mod.TRACE_SAMPLE_ENV] = str(float(tcfg["sample"]))
             env[trace_mod.TRACE_SLOW_MS_ENV] = str(float(tcfg["slow_ms"]))
+        # Profiling-plane policy rides the env the same way: the task's
+        # sampling profiler (common/profiling.py) starts iff DTPU_PROFILE=1
+        # and reads its rate/window from these knobs. The experiment's
+        # `profiling.sample_hz` expconf field overrides the cluster rate
+        # for that experiment's tasks.
+        pcfg = self._profiling_cfg
+        if not pcfg["enabled"]:
+            env[profiling_mod.PROFILE_ENV] = "0"
+        else:
+            exp_hz = config.get("profiling", {}).get("sample_hz")
+            env[profiling_mod.PROFILE_ENV] = "1"
+            env[profiling_mod.PROFILE_HZ_ENV] = str(
+                float(exp_hz) if exp_hz else float(pcfg["sample_hz"])
+            )
+            env[profiling_mod.PROFILE_WINDOW_ENV] = str(
+                float(pcfg["window_s"])
+            )
         if config.get("context"):
             env["DTPU_CONTEXT_ID"] = str(config["context"])
         return env
@@ -881,6 +932,9 @@ class Master:
                     # stale traces at full retention forever (O(evictions)
                     # per sweep; ingest trims too).
                     self.tracestore.trim()
+                    # Profiling plane retention: same contract for the
+                    # profile store's windows.
+                    self.profilestore.trim()
             except Exception:  # noqa: BLE001
                 logger.exception("tick loop error")
 
@@ -1953,12 +2007,51 @@ class Master:
         self._provisioners.append(service)
         service.start()
 
+    def pop_profile_capture(
+        self, alloc_id: str, kinds: tuple = ("trial", "task"),
+    ) -> Optional[Dict[str, Any]]:
+        """One pending XLA-capture directive for whatever this allocation
+        runs (trial rank or serving/command task), or None. Delivered on
+        the progress-beat / preemption-poll responses — channels the
+        workload already drives — so capture needs no new connection and
+        reaches exactly the process that owns the device. `kinds` scopes
+        the channel: beats deliver trial captures (the chief's beat),
+        preemption polls deliver task captures (serving replicas)."""
+        with self._lock:
+            exp_trial = self._alloc_index.get(alloc_id)
+            task_ids = [
+                tid for tid, cmd in self._commands.items()
+                if cmd.get("alloc_id") == alloc_id
+            ]
+        def _with_storage(cap: Dict[str, Any]) -> Dict[str, Any]:
+            # Serving/command tasks have no checkpoint_storage of their
+            # own; the directive carries the cluster default so the
+            # artifact still lands in a PR 1 storage manager.
+            st = self.config_defaults.get("checkpoint_storage")
+            if st:
+                cap = dict(cap)
+                cap["storage"] = st
+            return cap
+
+        if exp_trial is not None and "trial" in kinds:
+            cap = self.profilestore.pop_capture("trial", exp_trial[1])
+            if cap is not None:
+                return _with_storage(cap)
+        if "task" in kinds:
+            for tid in task_ids:
+                cap = self.profilestore.pop_capture("task", tid)
+                if cap is not None:
+                    return _with_storage(cap)
+        return None
+
     def shutdown(self) -> None:
         self._stop.set()
         self._tick_kick.set()  # wake the ticker so it observes _stop now
         self.agent_hub.close()
         self.webhooks.stop()
         self.tracer.stop()
+        if self._self_profiler is not None:
+            self._self_profiler.stop(flush=False)
         if self.log_sink is not None:
             self.log_sink.stop()
         for svc in self._provisioners:

@@ -408,6 +408,20 @@ def validate(config: Dict[str, Any]) -> List[str]:
     if mr is not None and (not isinstance(mr, int) or mr < 0):
         errors.append("max_restarts must be an int >= 0")
 
+    prof = config.get("profiling")
+    if prof is not None:
+        if not isinstance(prof, dict):
+            errors.append("profiling must be an object")
+        else:
+            hz = prof.get("sample_hz")
+            if hz is not None and (
+                not isinstance(hz, (int, float))
+                or isinstance(hz, bool) or not 0.1 <= hz <= 1000
+            ):
+                errors.append(
+                    "profiling.sample_hz must be a number in [0.1, 1000]"
+                )
+
     hp = config.get("hyperparameters", {})
     if isinstance(hp, dict):
         _check_hparams(hp, "", errors)
@@ -627,6 +641,11 @@ FIELDS: List[Tuple[str, str, str, str]] = [
     ("profiling.enabled", "bool", "false",
      "Ship host/device profiler samples as the `profiling` metric group "
      "(WebUI Profiler pane)."),
+    ("profiling.sample_hz", "float", "(masterconf profiling.sample_hz)",
+     "Per-experiment override of the continuous-profiling plane's stack "
+     "sampling rate for this experiment's trial processes (the master "
+     "injects it into the task env as DTPU_PROFILE_HZ). Must be in "
+     "[0.1, 1000]. See docs/operations.md 'Profiling plane'."),
     ("tensorboard.enabled", "bool", "false",
      "Write tfevents alongside metrics and sync them to checkpoint "
      "storage."),

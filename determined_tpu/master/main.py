@@ -55,6 +55,11 @@ def main() -> None:
              '{"max_traces": 5000, "sample": 0.1, "slow_ms": 250} '
              "(docs/operations.md \"Trace plane\")")
     parser.add_argument(
+        "--profiling-config", default=None,
+        help='JSON profiling-plane knobs, e.g. '
+             '{"sample_hz": 19, "retention_s": 7200} '
+             "(docs/operations.md \"Profiling plane\")")
+    parser.add_argument(
         "--config-defaults", default=None,
         help="JSON experiment-config defaults merged under every submitted "
              'config (master.yaml analog), e.g. {"max_restarts": 2}')
@@ -95,6 +100,10 @@ def main() -> None:
         ),
         traces_config=(
             json.loads(args.traces_config) if args.traces_config else None
+        ),
+        profiling_config=(
+            json.loads(args.profiling_config)
+            if args.profiling_config else None
         ),
     )
     if bool(args.tls_cert) != bool(args.tls_key):

@@ -52,6 +52,23 @@ TRACES_DEFAULTS = {
 }
 
 
+#: Profiling plane knobs (`profiling:` section): the profile store's
+#: by-construction bounds plus the sampler policy the master injects into
+#: every task env (docs/operations.md "Profiling plane" documents each
+#: row).
+PROFILING_DEFAULTS = {
+    "enabled": True,          # False: no self-profiler, ingest 404s, tasks told off
+    "sample_hz": 19.0,        # sampler rate pushed to tasks (DTPU_PROFILE_HZ)
+    "window_s": 10.0,         # aggregation window (DTPU_PROFILE_WINDOW_S)
+    "retention_s": 3600.0,    # windows older than this are trimmed
+    "max_windows": 4096,      # hard global window cap (oldest evicted, counted)
+    "max_windows_per_target": 1024,  # per-process window cap
+    "max_stacks": 65536,      # global interned-stack-table cap (counted)
+    "max_samples_per_window": 2000,  # per-window sample-group cap at ingest
+    "max_captures": 64,       # capture-registry cap (oldest terminal evicted)
+}
+
+
 def validate_metrics(cfg: Optional[Dict[str, Any]]) -> List[str]:
     errors: List[str] = []
     if cfg is None:
@@ -139,6 +156,34 @@ def validate_traces(cfg: Optional[Dict[str, Any]]) -> List[str]:
     return errors
 
 
+def validate_profiling(cfg: Optional[Dict[str, Any]]) -> List[str]:
+    errors: List[str] = []
+    if cfg is None:
+        return errors
+    if not isinstance(cfg, dict):
+        return ["profiling must be an object of profiling-plane knobs"]
+    for key, value in cfg.items():
+        if key not in PROFILING_DEFAULTS:
+            errors.append(
+                f"profiling: unknown key {key!r} "
+                f"(one of: {', '.join(sorted(PROFILING_DEFAULTS))})"
+            )
+            continue
+        if key == "enabled":
+            if not isinstance(value, bool):
+                errors.append("profiling.enabled must be a bool")
+            continue
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            errors.append(f"profiling.{key} must be a number")
+            continue
+        if key == "sample_hz":
+            if not 0.1 <= value <= 1000.0:
+                errors.append("profiling.sample_hz must be in [0.1, 1000]")
+        elif value <= 0:
+            errors.append(f"profiling.{key} must be positive")
+    return errors
+
+
 def validate_pools(pools: Optional[Dict[str, Any]]) -> List[str]:
     """Returns human-readable errors (empty = valid)."""
     errors: List[str] = []
@@ -201,6 +246,7 @@ def validate(
     metrics: Optional[Dict[str, Any]] = None,
     alerts: Optional[Dict[str, Any]] = None,
     traces: Optional[Dict[str, Any]] = None,
+    profiling: Optional[Dict[str, Any]] = None,
 ) -> None:
     """Validate the master's startup configuration; raises ValueError with
     EVERY problem named (config.go-style: fail fast at boot, not at the
@@ -209,6 +255,7 @@ def validate(
     errors += validate_metrics(metrics)
     errors += validate_alerts(alerts)
     errors += validate_traces(traces)
+    errors += validate_profiling(profiling)
     if not isinstance(preempt_timeout_s, (int, float)) or (
         preempt_timeout_s <= 0
     ):

@@ -1,0 +1,497 @@
+"""In-master profile store: bounded window storage + flamegraph queries
+(the master as its own Pyroscope).
+
+The query half of the profiling plane (common/profiling.py is the
+shipping half). Receives folded-stack windows at ``POST
+/api/v1/profiles/ingest``, interns every stack in a GLOBAL refcounted
+stack table, and serves:
+
+- ``flame``  — merged folded stacks over any filter slice (target /
+  time range / span id / timeline phase), the flamegraph wire format;
+- ``top``    — per-frame self/total time over the same filters;
+- ``diff``   — window-vs-window folded-stack delta (regression triage);
+- the capture registry — operator-requested bounded XLA traces
+  (``POST /api/v1/profiles/capture``) delivered to trials/replicas as
+  directives on their existing progress-beat/preemption polls, artifact
+  links registered back on completion.
+
+Bounded BY CONSTRUCTION, the tracestore discipline:
+
+- per-target window cap and a global window cap, oldest evicted first
+  with the eviction counted (`dtpu_profile_store_windows_evicted_total`);
+- the stack table caps globally; a novel stack past the cap folds into
+  the ``(stack-table-full)`` sentinel (counted) instead of growing the
+  table — and because entries are refcounted per referencing window,
+  window eviction shrinks the table back;
+- retention trims at ingest AND at the master's maintenance tick.
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import secrets
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Deque, Dict, Iterable, List, Optional, Tuple
+
+from determined_tpu.common.metrics import REGISTRY as METRICS
+
+logger = logging.getLogger("determined_tpu.master")
+
+#: Sentinel the store substitutes for novel stacks once the table is full.
+FULL_SENTINEL = "(stack-table-full)"
+
+STORE_WINDOWS = METRICS.gauge(
+    "dtpu_profile_store_windows",
+    "Profile windows currently held by the master's profile store.",
+)
+STORE_STACKS = METRICS.gauge(
+    "dtpu_profile_store_stacks",
+    "Distinct interned folded stacks in the store's global stack table "
+    "(refcounted; shrinks when windows evict).",
+)
+STORE_TARGETS = METRICS.gauge(
+    "dtpu_profile_store_targets",
+    "Distinct profile targets (processes) with windows in the store.",
+)
+STORE_EVICTED = METRICS.counter(
+    "dtpu_profile_store_windows_evicted_total",
+    "Profile windows evicted from the bounded store, by reason "
+    "(target_cap / global_cap / retention).",
+    labels=("reason",),
+)
+STORE_REJECTED = METRICS.counter(
+    "dtpu_profile_store_windows_rejected_total",
+    "Profile windows rejected at ingest, by reason (malformed).",
+    labels=("reason",),
+)
+STORE_STACKS_REJECTED = METRICS.counter(
+    "dtpu_profile_store_stacks_rejected_total",
+    "Novel folded stacks folded into the (stack-table-full) sentinel "
+    "because the global stack table was at its cap.",
+)
+
+
+class _Window:
+    __slots__ = ("target", "start", "end", "hz", "samples", "received_at",
+                 "seq")
+
+    def __init__(self, target: str, start: float, end: float, hz: float,
+                 samples: List[Tuple[str, str, str, str, int, int]],
+                 received_at: float, seq: int) -> None:
+        self.target = target
+        self.start = start
+        self.end = end
+        self.hz = hz
+        #: (thread, span_id, trace_id, phase, stack_id, count)
+        self.samples = samples
+        self.received_at = received_at
+        self.seq = seq
+
+
+class _Capture:
+    __slots__ = ("id", "kind", "ident", "steps", "state", "created_at",
+                 "delivered_at", "completed_at", "artifact", "error")
+
+    def __init__(self, cid: str, kind: str, ident: str, steps: int,
+                 now: float) -> None:
+        self.id = cid
+        self.kind = kind            # "trial" | "task"
+        self.ident = ident          # trial id / task id, as a string
+        self.steps = steps
+        self.state = "pending"      # pending → delivered → completed|failed
+        self.created_at = now
+        self.delivered_at = 0.0
+        self.completed_at = 0.0
+        self.artifact = ""
+        self.error = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "id": self.id, "kind": self.kind, "ident": self.ident,
+            "steps": self.steps, "state": self.state,
+            "created_at": self.created_at,
+            "delivered_at": self.delivered_at or None,
+            "completed_at": self.completed_at or None,
+            "artifact": self.artifact or None,
+            "error": self.error or None,
+        }
+
+
+class ProfileStore:
+    def __init__(self, config: Optional[Dict[str, Any]] = None) -> None:
+        cfg = dict(config or {})
+        self.enabled = bool(cfg.get("enabled", True))
+        self.retention_s = float(cfg.get("retention_s", 3600.0))
+        self.max_windows = int(cfg.get("max_windows", 4096))
+        self.max_windows_per_target = int(
+            cfg.get("max_windows_per_target", 1024)
+        )
+        self.max_stacks = int(cfg.get("max_stacks", 65536))
+        self.max_samples_per_window = int(
+            cfg.get("max_samples_per_window", 2000)
+        )
+        self.max_captures = int(cfg.get("max_captures", 64))
+        self._lock = threading.Lock()
+        #: target → windows in arrival order (leftmost oldest).
+        self._by_target: Dict[str, Deque[_Window]] = {}
+        self._window_count = 0
+        self._seq = itertools.count()
+        #: folded stack → [stack_id, refcount]; id → folded.
+        self._stack_ids: Dict[str, List[int]] = {}
+        self._stacks: Dict[int, str] = {}
+        self._next_stack_id = itertools.count(1)
+        self._captures: "OrderedDict[str, _Capture]" = OrderedDict()
+
+    # -- interning -----------------------------------------------------------
+    def _intern_locked(self, folded: str) -> int:
+        ent = self._stack_ids.get(folded)
+        if ent is not None:
+            ent[1] += 1
+            return ent[0]
+        if len(self._stack_ids) >= self.max_stacks and folded != FULL_SENTINEL:
+            STORE_STACKS_REJECTED.inc()
+            return self._intern_locked(FULL_SENTINEL)
+        sid = next(self._next_stack_id)
+        self._stack_ids[folded] = [sid, 1]
+        self._stacks[sid] = folded
+        return sid
+
+    def _release_locked(self, window: _Window) -> None:
+        for (_t, _s, _tr, _p, sid, _c) in window.samples:
+            folded = self._stacks.get(sid)
+            if folded is None:
+                continue
+            ent = self._stack_ids[folded]
+            ent[1] -= 1
+            if ent[1] <= 0:
+                del self._stack_ids[folded]
+                del self._stacks[sid]
+
+    # -- ingest --------------------------------------------------------------
+    def ingest(self, windows: Iterable[Dict[str, Any]],
+               now: Optional[float] = None) -> Dict[str, int]:
+        now = time.time() if now is None else now
+        accepted = rejected = 0
+        for doc in windows:
+            norm = self._normalize(doc)
+            if norm is None:
+                rejected += 1
+                STORE_REJECTED.labels("malformed").inc()
+                continue
+            target, start, end, hz, raw_samples = norm
+            with self._lock:
+                samples = [
+                    (thread, span, trace, ph, self._intern_locked(folded), c)
+                    for (thread, span, trace, ph, folded, c) in raw_samples
+                ]
+                w = _Window(target, start, end, hz, samples, now,
+                            next(self._seq))
+                dq = self._by_target.setdefault(target, deque())
+                dq.append(w)
+                self._window_count += 1
+                self._evict_locked()
+                self._trim_locked(now)
+            accepted += 1
+        if accepted or rejected:
+            self._publish_gauges()
+        return {"accepted": accepted, "rejected": rejected}
+
+    def _normalize(self, doc: Any) -> Optional[tuple]:
+        """Validated + shape-coerced window, or None (counted malformed).
+        A single bad sample drops that sample, not the window; a window
+        with no usable identity drops whole."""
+        if not isinstance(doc, dict):
+            return None
+        target = doc.get("target")
+        if not isinstance(target, str) or not target:
+            return None
+        try:
+            start = float(doc.get("start", 0.0))
+            end = float(doc.get("end", start))
+            hz = float(doc.get("hz", 0.0))
+        except (TypeError, ValueError):
+            return None
+        raw = doc.get("samples")
+        if not isinstance(raw, list):
+            return None
+        samples: List[Tuple[str, str, str, str, str, int]] = []
+        for s in raw[: self.max_samples_per_window]:
+            if not isinstance(s, dict):
+                continue
+            folded = s.get("stack")
+            try:
+                count = int(s.get("count", 0))
+            except (TypeError, ValueError):
+                continue
+            if not isinstance(folded, str) or not folded or count <= 0:
+                continue
+            samples.append((
+                str(s.get("thread", "") or ""),
+                str(s.get("span", "") or "").lower(),
+                str(s.get("trace", "") or "").lower(),
+                str(s.get("phase", "") or ""),
+                folded,
+                count,
+            ))
+        return target, start, end, hz, samples
+
+    # -- bounding ------------------------------------------------------------
+    def _drop_locked(self, target: str, reason: str) -> None:
+        dq = self._by_target[target]
+        self._release_locked(dq.popleft())
+        if not dq:
+            del self._by_target[target]
+        self._window_count -= 1
+        STORE_EVICTED.labels(reason).inc()
+
+    def _evict_locked(self) -> None:
+        for target, dq in list(self._by_target.items()):
+            while len(dq) > self.max_windows_per_target:
+                self._drop_locked(target, "target_cap")
+        while self._window_count > self.max_windows:
+            # Oldest overall: per-target deques are arrival-ordered, so
+            # the global oldest is one of the heads (few targets — this
+            # scan is cheap at admission).
+            target = min(self._by_target,
+                         key=lambda t: self._by_target[t][0].seq)
+            self._drop_locked(target, "global_cap")
+
+    def _trim_locked(self, now: float) -> None:
+        horizon = now - self.retention_s
+        for target in list(self._by_target):
+            dq = self._by_target.get(target)
+            while dq and dq[0].end < horizon:
+                self._drop_locked(target, "retention")
+                dq = self._by_target.get(target)
+
+    def trim(self, now: Optional[float] = None) -> None:
+        """Retention pass for the master's maintenance tick."""
+        with self._lock:
+            self._trim_locked(time.time() if now is None else now)
+        self._publish_gauges()
+
+    def _publish_gauges(self) -> None:
+        with self._lock:
+            STORE_WINDOWS.set(self._window_count)
+            STORE_STACKS.set(len(self._stacks))
+            STORE_TARGETS.set(len(self._by_target))
+
+    # -- queries -------------------------------------------------------------
+    def _iter_samples_locked(
+        self,
+        target: Optional[str] = None,
+        since: Optional[float] = None,
+        until: Optional[float] = None,
+        span: Optional[str] = None,
+        phase: Optional[str] = None,
+    ):
+        """(window, thread, span, trace, phase, folded, count) over the
+        filter slice."""
+        span = span.lower() if span else None
+        targets = ([target] if target else list(self._by_target))
+        for t in targets:
+            for w in self._by_target.get(t, ()):
+                if since is not None and w.end < since:
+                    continue
+                if until is not None and w.start > until:
+                    continue
+                for (thread, sp, tr, ph, sid, count) in w.samples:
+                    if span is not None and sp != span:
+                        continue
+                    if phase is not None and ph != phase:
+                        continue
+                    folded = self._stacks.get(sid)
+                    if folded is None:
+                        continue
+                    yield w, thread, sp, tr, ph, folded, count
+
+    def _merge(self, **filters: Any) -> Tuple[Dict[str, int], int, set]:
+        stacks: Dict[str, int] = {}
+        windows = set()
+        total = 0
+        for w, _th, _sp, _tr, _ph, folded, count in (
+            self._iter_samples_locked(**filters)
+        ):
+            stacks[folded] = stacks.get(folded, 0) + count
+            windows.add(id(w))
+            total += count
+        return stacks, total, windows
+
+    def flame(
+        self,
+        target: Optional[str] = None,
+        since: Optional[float] = None,
+        until: Optional[float] = None,
+        span: Optional[str] = None,
+        phase: Optional[str] = None,
+        limit: int = 5000,
+    ) -> Dict[str, Any]:
+        """Merged folded stacks over the slice — paste straight into any
+        flamegraph renderer (`stack count` lines)."""
+        with self._lock:
+            stacks, total, windows = self._merge(
+                target=target, since=since, until=until, span=span,
+                phase=phase,
+            )
+        rows = sorted(stacks.items(), key=lambda kv: -kv[1])[: int(limit)]
+        return {
+            "stacks": [{"stack": s, "count": c} for s, c in rows],
+            "distinct_stacks": len(stacks),
+            "samples": total,
+            "windows": len(windows),
+        }
+
+    def top(
+        self,
+        n: int = 20,
+        target: Optional[str] = None,
+        since: Optional[float] = None,
+        until: Optional[float] = None,
+        span: Optional[str] = None,
+        phase: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Top-N frames by SELF time (leaf-frame samples), with total
+        (anywhere-on-stack) alongside — `perf report` semantics."""
+        with self._lock:
+            stacks, total, windows = self._merge(
+                target=target, since=since, until=until, span=span,
+                phase=phase,
+            )
+        self_t: Dict[str, int] = {}
+        total_t: Dict[str, int] = {}
+        for folded, count in stacks.items():
+            frames = folded.split(";")
+            self_t[frames[-1]] = self_t.get(frames[-1], 0) + count
+            for f in set(frames):
+                total_t[f] = total_t.get(f, 0) + count
+        rows = sorted(self_t.items(), key=lambda kv: -kv[1])[: int(n)]
+        return {
+            "frames": [
+                {
+                    "frame": f,
+                    "self": c,
+                    "total": total_t.get(f, c),
+                    "self_pct": round(100.0 * c / total, 2) if total else 0.0,
+                }
+                for f, c in rows
+            ],
+            "samples": total,
+            "windows": len(windows),
+        }
+
+    def diff(
+        self,
+        a_since: Optional[float] = None,
+        a_until: Optional[float] = None,
+        b_since: Optional[float] = None,
+        b_until: Optional[float] = None,
+        target: Optional[str] = None,
+        span: Optional[str] = None,
+        phase: Optional[str] = None,
+        limit: int = 200,
+    ) -> Dict[str, Any]:
+        """Window-vs-window folded-stack delta: counts NORMALIZED to
+        per-sample fractions before differencing so unequal-length ranges
+        compare, sorted by |delta| — the regression-triage view."""
+        with self._lock:
+            a_stacks, a_total, _ = self._merge(
+                target=target, since=a_since, until=a_until, span=span,
+                phase=phase,
+            )
+            b_stacks, b_total, _ = self._merge(
+                target=target, since=b_since, until=b_until, span=span,
+                phase=phase,
+            )
+        rows = []
+        for folded in set(a_stacks) | set(b_stacks):
+            fa = a_stacks.get(folded, 0) / a_total if a_total else 0.0
+            fb = b_stacks.get(folded, 0) / b_total if b_total else 0.0
+            rows.append({
+                "stack": folded,
+                "a": a_stacks.get(folded, 0),
+                "b": b_stacks.get(folded, 0),
+                "a_frac": round(fa, 6),
+                "b_frac": round(fb, 6),
+                "delta_frac": round(fb - fa, 6),
+            })
+        rows.sort(key=lambda r: -abs(r["delta_frac"]))
+        return {
+            "stacks": rows[: int(limit)],
+            "a_samples": a_total,
+            "b_samples": b_total,
+        }
+
+    # -- capture registry ----------------------------------------------------
+    def request_capture(self, kind: str, ident: Any,
+                        steps: int = 3) -> Dict[str, Any]:
+        """Register an operator capture request; delivered as a directive
+        the next time the target's allocation polls progress/preemption."""
+        now = time.time()
+        cap = _Capture(
+            "cap-" + secrets.token_hex(6), str(kind), str(ident),
+            max(1, min(int(steps), 64)), now,
+        )
+        with self._lock:
+            self._captures[cap.id] = cap
+            while len(self._captures) > self.max_captures:
+                # Oldest terminal first; else oldest outright — the
+                # registry stays bounded even under request floods.
+                victim = next(
+                    (k for k, c in self._captures.items()
+                     if c.state in ("completed", "failed")),
+                    next(iter(self._captures)),
+                )
+                del self._captures[victim]
+        return cap.to_dict()
+
+    def pop_capture(self, kind: str, ident: Any) -> Optional[Dict[str, Any]]:
+        """One pending capture for this target, atomically marked
+        delivered (one-shot: a directive is delivered to exactly one
+        poll response)."""
+        with self._lock:
+            for cap in self._captures.values():
+                if (cap.state == "pending" and cap.kind == kind
+                        and cap.ident == str(ident)):
+                    cap.state = "delivered"
+                    cap.delivered_at = time.time()
+                    return {"id": cap.id, "steps": cap.steps}
+        return None
+
+    def complete_capture(self, cid: str, artifact: str = "",
+                         error: str = "") -> Optional[Dict[str, Any]]:
+        with self._lock:
+            cap = self._captures.get(cid)
+            if cap is None:
+                return None
+            cap.state = "failed" if error else "completed"
+            cap.completed_at = time.time()
+            cap.artifact = str(artifact or "")
+            cap.error = str(error or "")
+            return cap.to_dict()
+
+    def get_capture(self, cid: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            cap = self._captures.get(cid)
+            return cap.to_dict() if cap else None
+
+    def list_captures(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [c.to_dict() for c in self._captures.values()]
+
+    # -- introspection -------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "windows": self._window_count,
+                "max_windows": self.max_windows,
+                "targets": len(self._by_target),
+                "stacks": len(self._stacks),
+                "max_stacks": self.max_stacks,
+                "captures": len(self._captures),
+                "sample_groups": sum(
+                    len(w.samples)
+                    for dq in self._by_target.values() for w in dq
+                ),
+            }

@@ -91,6 +91,18 @@ master's time-series plane has a few scrapes of history)</div>
 <div id="traces" class="muted">(recent traces appear once spans reach the
 master's trace store; click one for its waterfall)</div>
 <div id="trace-detail"></div>
+<h2>Profiles <span class="muted" id="profiles-label"></span></h2>
+<div style="margin-bottom:0.3em">
+  <input id="prof-target" placeholder="target (master, trial:1.r0, …)"
+         size="24" onchange="refreshProfiles()">
+  <input id="prof-phase" placeholder="phase" size="10"
+         onchange="refreshProfiles()">
+  <input id="prof-span" placeholder="span id" size="18"
+         onchange="refreshProfiles()">
+</div>
+<div id="profiles" class="muted">(hot frames appear once the
+continuous-profiling plane has shipped a window)</div>
+<div id="profile-flame"></div>
 <h2>Agents</h2><table id="agents"></table>
 <h2>Resource pools</h2><table id="pools"></table>
 <h2>Job queue</h2><div id="queues">(empty)</div>
@@ -707,6 +719,55 @@ async function showTrace(id, silent) {
   } catch (e) { if (!silent) $('trace-detail').textContent = '(trace gone)'; }
 }
 
+// --- profiling plane: hot-frame table + on-demand flame merge off
+// --- /api/v1/profiles/* (the master-as-its-own-Pyroscope store)
+function profParams() {
+  const q = [];
+  for (const [id, key] of [['prof-target', 'target'],
+                           ['prof-phase', 'phase'], ['prof-span', 'span']]) {
+    const v = $(id).value.trim();
+    if (v) q.push(`${key}=${encodeURIComponent(v)}`);
+  }
+  return q.join('&');
+}
+async function refreshProfiles() {
+  try {
+    const out = await j('/api/v1/profiles/top?n=12&' + profParams());
+    const st = out.stats || {};
+    $('profiles-label').textContent =
+      `· ${st.windows || 0}/${st.max_windows || 0} windows, ` +
+      `${st.stacks || 0} stacks, ${st.targets || 0} target(s)`;
+    const frames = out.frames || [];
+    if (!frames.length) return;
+    const div = $('profiles');
+    div.classList.remove('muted');
+    div.innerHTML =
+      '<table><tr><th>self%</th><th>self</th><th>total</th><th>frame</th>' +
+      '</tr>' + frames.map(f =>
+        `<tr>${cell(f.self_pct.toFixed(1) + '%')}${cell(f.self)}` +
+        `${cell(f.total)}${cell(f.frame)}</tr>`).join('') +
+      '</table>' +
+      `<button onclick="showFlame()">flame (merged stacks)</button>`;
+  } catch (e) { /* profiling plane not up yet */ }
+}
+async function showFlame() {
+  try {
+    const out = await j('/api/v1/profiles/flame?' + profParams());
+    const stacks = out.stacks || [];
+    const max = Math.max(1, ...stacks.map(s => s.count));
+    // Left-heavy icicle: one bar per folded stack, width ∝ sample count —
+    // collapse-format text stays selectable for external flamegraph tools.
+    $('profile-flame').innerHTML =
+      `<p>${out.samples} sample(s), ${out.distinct_stacks} distinct ` +
+      'stack(s)</p>' + stacks.slice(0, 40).map(s =>
+        '<div style="white-space:nowrap;overflow:hidden">' +
+        '<div style="display:inline-block;height:0.7em;background:#d84;' +
+        `width:${(100 * s.count / max).toFixed(1)}%;max-width:30%"></div> ` +
+        `<span class="muted">${s.count}</span> ${esc(s.stack)}</div>`
+      ).join('');
+  } catch (e) { $('profile-flame').textContent = '(flame query failed)'; }
+}
+
 function pager(el, page, total, onchange, redraw = 'refresh') {
   const pages = Math.max(1, Math.ceil(total / PAGE_SIZE));
   el.innerHTML = `page ${page + 1}/${pages} · ${total} total ` +
@@ -846,6 +907,7 @@ async function refresh() {
     await refreshAdmin();
     await refreshClusterHealth();
     await refreshTraces();
+    await refreshProfiles();
   } catch (e) { console.error(e); }
 }
 // --- hash router (#/experiments/<id>, #/trials/<id>) -------------------

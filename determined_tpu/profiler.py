@@ -193,3 +193,63 @@ def jax_profiler_trace(logdir: str):
         yield logdir
     finally:
         jax.profiler.stop_trace()
+
+
+def run_bounded_capture(
+    session: Any,
+    cap: Dict[str, Any],
+    *,
+    seconds: Optional[float] = None,
+    base_dir: str = "/tmp/dtpu_captures",
+) -> None:
+    """Execute a profile-capture directive outside a step loop (serving
+    replicas, notebooks): trace XLA activity for a bounded wall-time
+    window, upload the artifact through a storage manager built from the
+    directive's cluster-default storage config, and register the result on
+    the master's capture record. Never raises — a capture is observability,
+    not work."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    cid = str(cap.get("id", ""))
+    if not cid:
+        return
+    # The directive's `steps` bounds the trace; off a step loop it reads
+    # as seconds (clamped — an operator typo must not trace for minutes).
+    budget = seconds if seconds is not None else min(
+        max(float(cap.get("steps", 3) or 3), 0.5), 30.0
+    )
+    logdir = tempfile.mkdtemp(prefix="dtpu-xla-capture-")
+    try:
+        try:
+            with jax_profiler_trace(logdir):
+                _time.sleep(budget)
+        except Exception as e:  # noqa: BLE001
+            _report_capture(session, cid, error=f"trace failed: {e}")
+            return
+        try:
+            from determined_tpu.storage.base import from_config
+
+            storage = from_config(cap.get("storage"), base_dir=base_dir)
+            storage_id = f"profile-capture-{cid}"
+            storage.upload(logdir, storage_id)
+            _report_capture(session, cid, artifact=storage_id)
+        except Exception as e:  # noqa: BLE001
+            _report_capture(session, cid, error=f"upload failed: {e}")
+    finally:
+        shutil.rmtree(logdir, ignore_errors=True)
+
+
+def _report_capture(
+    session: Any, cid: str, artifact: str = "", error: str = ""
+) -> None:
+    try:
+        session.post(
+            f"/api/v1/profiles/captures/{cid}/complete",
+            json_body={"artifact": artifact, "error": error},
+        )
+    except Exception:  # noqa: BLE001 — registration loss is survivable
+        logging.getLogger("determined_tpu.profiler").warning(
+            "capture %s completion report failed", cid
+        )

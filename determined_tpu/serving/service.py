@@ -415,12 +415,51 @@ def main(argv: Optional[List[str]] = None) -> int:
     from determined_tpu.exec.proxy_util import register_proxy
 
     register_proxy(server.port)
+    # Continuous-profiling plane: sample this replica's threads (decode
+    # loop, SSE writers) when the master enabled it for the task env.
+    from determined_tpu.common import profiling as profiling_mod
+
+    task_id = os.environ.get("DTPU_TASK_ID") or "serving"
+    profiling_mod.maybe_start_from_env(target=f"serving:{task_id}")
+    # The idle loop doubles as the replica's control channel: poll the
+    # allocation's preemption signal (short timeout — a capture directive
+    # rides back on poll RETURN, so the timeout bounds its latency) and
+    # run operator-triggered bounded XLA captures in place.
+    master = os.environ.get("DTPU_MASTER")
+    alloc = os.environ.get("DTPU_ALLOCATION_ID")
+    session = None
+    if master and alloc:
+        from determined_tpu.common.api_session import Session
+
+        session = Session(
+            master, token=os.environ.get("DTPU_SESSION_TOKEN", ""),
+            max_retries=1,
+        )
     try:
         while True:
-            time.sleep(3600)
+            if session is None:
+                time.sleep(3600)
+                continue
+            try:
+                resp = session.get(
+                    f"/api/v1/allocations/{alloc}/signals/preemption",
+                    params={"timeout_seconds": 5}, timeout=15,
+                ) or {}
+            except Exception:  # noqa: BLE001 — master away; keep serving
+                time.sleep(5)  # resilience-ok: fixed-cadence signal poll, not a retry
+                continue
+            cap = resp.get("profile_capture")
+            if cap:
+                from determined_tpu.profiler import run_bounded_capture
+
+                run_bounded_capture(session, cap)
+            if resp.get("preempt"):
+                logger.info("preemption signal; draining and exiting")
+                break
     except KeyboardInterrupt:
         pass
     finally:
+        profiling_mod.flush_profiler()
         server.stop()
         engine.stop()
     return 0

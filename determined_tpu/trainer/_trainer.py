@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from determined_tpu import core as core_mod
 from determined_tpu.common import faults
+from determined_tpu.common import profiling as profiling_mod
 from determined_tpu.common import trace as trace_mod
 from determined_tpu.core._searcher import DummySearcherContext
 from determined_tpu.models.base import Model
@@ -192,6 +193,15 @@ class Trainer:
         self._batch_shardings: Optional[Tuple[Any, Any]] = None
         self._replicated_keys: Optional[frozenset] = None
 
+        # Profiling plane: operator-triggered bounded XLA capture (one at
+        # a time, chief-only) + the compiled step's cost_analysis FLOPs
+        # (reported once under the profiling group → dtpu_step_flops).
+        self._capture_dir: Optional[str] = None
+        self._capture_id: Optional[str] = None
+        self._capture_until: Optional[int] = None
+        self._capture_storage: Optional[Dict[str, Any]] = None
+        self._step_flops: Optional[float] = None
+
         # Observability (chief-only): system/device metrics to the master
         # (ref ProfilerAgent) + tfevents scalars for TensorBoard.
         self._profiler = None
@@ -230,6 +240,100 @@ class Trainer:
                 self._tb_manager.sync()
             except Exception:  # noqa: BLE001
                 logger.exception("tensorboard sync failed")
+
+    # -- profiling plane: operator-triggered XLA capture + step FLOPs -------
+    def _begin_capture(self, cap: Dict[str, Any], step: int) -> None:
+        """Start a bounded jax.profiler trace for a capture directive the
+        master delivered on the progress beat. Never raises — a failed
+        capture reports its error and training continues."""
+        if self._capture_dir is not None:
+            return  # one capture at a time; the directive stays delivered
+        try:
+            self._capture_dir = tempfile.mkdtemp(prefix="dtpu-xla-capture-")
+            jax.profiler.start_trace(self._capture_dir)
+            self._capture_id = str(cap.get("id", ""))
+            self._capture_storage = cap.get("storage")
+            self._capture_until = step + max(1, int(cap.get("steps", 3)))
+            logger.info(
+                "profile capture %s: tracing steps %d..%d",
+                self._capture_id, step + 1, self._capture_until,
+            )
+        except Exception:  # noqa: BLE001 — profiling never breaks training
+            logger.exception("profile capture start failed")
+            self._report_capture(str(cap.get("id", "")), error="start failed")
+            self._capture_dir = None
+            self._capture_until = None
+
+    def _finish_capture(self, step: int) -> None:
+        """Stop the bounded trace, upload the artifact through the trial's
+        storage manager (PR 1), register the link on the capture record."""
+        cid, logdir = self._capture_id, self._capture_dir
+        storage_cfg = self._capture_storage
+        self._capture_dir = self._capture_id = None
+        self._capture_until = self._capture_storage = None
+        try:
+            jax.block_until_ready(self._state)  # trace covers the steps
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001
+            logger.exception("profile capture stop failed")
+            self._report_capture(cid, error="stop failed")
+            return
+        try:
+            from determined_tpu.storage.base import from_config
+
+            storage = getattr(self.core.checkpoint, "_storage", None)
+            if storage is None or storage_cfg:
+                storage = from_config(
+                    storage_cfg, base_dir="/tmp/dtpu_captures"
+                )
+            storage_id = f"profile-capture-{cid}"
+            storage.upload(logdir, storage_id)
+            logger.info(
+                "profile capture %s uploaded as %s (step %d)",
+                cid, storage_id, step,
+            )
+            self._report_capture(cid, artifact=storage_id)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("profile capture upload failed")
+            self._report_capture(cid, error=f"upload failed: {e}")
+        finally:
+            import shutil
+
+            shutil.rmtree(logdir, ignore_errors=True)
+
+    def _report_capture(self, cid: Optional[str], artifact: str = "",
+                        error: str = "") -> None:
+        if not cid:
+            return
+        session = getattr(self.core.train, "_session", None)
+        if session is None:
+            return
+        try:
+            session.post(
+                f"/api/v1/profiles/captures/{cid}/complete",
+                json_body={"artifact": artifact, "error": error},
+            )
+        except Exception:  # noqa: BLE001 — registration loss is survivable
+            logger.warning("capture %s completion report failed", cid)
+
+    def _compute_step_flops(self, batch: Dict[str, Any],
+                            poison: Any) -> float:
+        """Per-step model FLOPs from XLA's cost_analysis of the already-
+        compiled step (lower+compile hits the jit cache — no recompile).
+        0.0 when the backend doesn't expose it; reported once."""
+        try:
+            lowered = self._step_fn.lower(
+                self.state, batch, poison, self._skips
+            )
+            ca = lowered.compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            if not isinstance(ca, dict):
+                return 0.0
+            return max(float(ca.get("flops", 0.0)), 0.0)
+        except Exception:  # noqa: BLE001 — attribution, never a failure
+            logger.debug("step cost_analysis failed", exc_info=True)
+            return 0.0
 
     def _trial_id(self) -> int:
         """This run's trial identity (0 off-cluster) — the goodput
@@ -874,8 +978,14 @@ class Trainer:
 
         timeline = self.timeline
 
+        # Continuous-profiling phase tag: the sampler (common/profiling.py)
+        # reads this thread's phase on every walk, so flamegraphs split by
+        # data_wait / h2d_put / step / report / checkpoint for free.
+        _set_phase = profiling_mod.set_phase
+
         def flush_report() -> None:
             nonlocal pending, t_report
+            _set_phase("report")
             # Sentinel sees EVERY window before it is dropped — flushes
             # also happen at checkpoint/preemption/op-end boundaries that
             # are not report boundaries, and a spike (or skip count) in
@@ -893,6 +1003,7 @@ class Trainer:
                     # window residual includes the jitted steps — the one
                     # sync the timeline is allowed to piggyback on.
                     timeline.close_window()
+                _set_phase("step")
                 return
             host = [jax.device_get(m) for m in pending]
             # Aggregate over FINITE values only: a guarded (skipped) step
@@ -929,14 +1040,17 @@ class Trainer:
                 # under the `profiling` group — the same channel the
                 # ProfilerAgent uses, so the WebUI/SDK read both together.
                 fractions = timeline.close_window()
-                self.core.train.report_metrics(
-                    "profiling", steps_now,
-                    {**fractions, **timeline.snapshot()},
-                )
+                prof = {**fractions, **timeline.snapshot()}
+                if self._step_flops:
+                    # XLA's per-step model FLOPs (cost_analysis of the
+                    # compiled step) → master's dtpu_step_flops gauge.
+                    prof["step_flops"] = self._step_flops
+                self.core.train.report_metrics("profiling", steps_now, prof)
             if self._profiler is not None:
                 self._profiler.set_steps_completed(steps_now)
             pending = []
             t_report = time.time()
+            _set_phase("step")
 
         # Host-side step counter: one device sync here, none in the loop —
         # reading state["step"] per batch would block on the in-flight step
@@ -972,6 +1086,7 @@ class Trainer:
         # calls + 2 float adds per step when enabled, nothing when not.
         _pc = timeline.pc
         timeline.reset_window()
+        _set_phase("step")
 
         # The finally-join below keeps a raising step loop from abandoning
         # an in-flight background save: the daemon writer thread would
@@ -984,16 +1099,23 @@ class Trainer:
                 target = to_batches(op.length, bpe)
                 while step < target:
                     if timeline.enabled:
+                        _set_phase("data_wait")
                         _t0 = _pc()
                         raw = next(train_iter)
                         _t1 = _pc()
+                        _set_phase("h2d_put")
                         batch = self._put_batch(raw)
+                        _set_phase("step")
                         _w = timeline.window
                         _w["data_wait"] += _t1 - _t0
                         _w["h2d_put"] += _pc() - _t1
                         timeline.step_done()
                     else:
-                        batch = self._put_batch(next(train_iter))
+                        _set_phase("data_wait")
+                        raw = next(train_iter)
+                        _set_phase("h2d_put")
+                        batch = self._put_batch(raw)
+                        _set_phase("step")
                     self._data_consumed += 1
                     # poison: 1.0 outside fault drills (one None check);
                     # np scalar, not python float, so jit sees a stable
@@ -1004,6 +1126,11 @@ class Trainer:
                     )
                     pending.append(metrics)
                     step += 1
+                    if (
+                        self._capture_until is not None
+                        and step >= self._capture_until
+                    ):
+                        self._finish_capture(step)
                     if step == _first_step_at and _first_step_ctx is not None:
                         _first_step_at = -1
                         trace_mod.export_span(
@@ -1035,6 +1162,15 @@ class Trainer:
                         beat_resize = self.core.train.heartbeat_step(step)
                         if self.core.distributed.is_chief:
                             op.report_progress(float(step))
+                            if self._step_flops is None:
+                                self._step_flops = self._compute_step_flops(
+                                    batch, poison
+                                )
+                            # Operator-triggered XLA capture rides the beat
+                            # response (chief-only: one trace per trial).
+                            cap = self.core.train.take_profile_capture()
+                            if cap is not None:
+                                self._begin_capture(cap, step)
                         # Preemption is a collective (ZMQ broadcast) —
                         # checking every batch would put a TCP roundtrip in
                         # the hot loop, so it shares the report boundary
@@ -1060,7 +1196,9 @@ class Trainer:
                             self._exit_for_resize(directive, step)
                         if preempt_now:
                             flush_report()
+                            _set_phase("checkpoint")
                             self._save_checkpoint(sync=True)
+                            _set_phase("step")
                             timeline.commit()
                             last_ckpt_step = step
                             logger.info(
@@ -1090,8 +1228,10 @@ class Trainer:
                             self._tb_scalars(step, last_val, prefix="val_")
                     if ckpt_period and step % ckpt_period == 0:
                         flush_report()
+                        _set_phase("checkpoint")
                         _t0 = _pc()
                         self._save_checkpoint()
+                        _set_phase("step")
                         if timeline.enabled:
                             # Host-blocking part only (snapshot + writer
                             # join); the async upload overlaps training.
@@ -1126,6 +1266,7 @@ class Trainer:
                 (ckpt_period or preempted or self.core.info is not None)
                 and last_ckpt_step != step
             ):
+                _set_phase("checkpoint")
                 self._save_checkpoint(sync=True)
                 timeline.commit()
         except BaseException as e:
@@ -1141,6 +1282,11 @@ class Trainer:
                 # checkpoint one rather than masking it.
                 logger.exception("background checkpoint failed during teardown")
             finally:
+                _set_phase(None)
+                if self._capture_dir is not None:
+                    # Abandoned mid-capture exit: stop + report so the
+                    # master's capture record does not stay "delivered".
+                    self._finish_capture(step)
                 _fit_scope.close()  # end the trial.fit span either way
         if self._profiler is not None:
             self._profiler.stop()

@@ -175,6 +175,46 @@ class TestCLI:
         assert "trial.first_step" in out and "allocation" in out
         assert "critical path:" in out and "first_step=1.100s" in out
 
+    def test_profiles_verbs(self, live_master, capsys):
+        """`dtpu profiles top/flame/diff/capture/captures` over the
+        continuous-profiling plane (PR 12)."""
+        import time as _time
+
+        master, api = live_master
+        now = _time.time()
+        master.profilestore.ingest([{
+            "target": "trial:1.r0", "start": now - 30, "end": now - 20,
+            "hz": 19.0, "samples": [
+                {"thread": "MainThread", "phase": "step",
+                 "stack": "t.py:main;t.py:fit;t.py:step", "count": 40},
+                {"thread": "MainThread",
+                 "stack": "t.py:main;t.py:fit;t.py:data", "count": 10},
+            ],
+        }], now=now)
+        self._run(api, "profiles", "top", "--target", "trial:1.r0")
+        out = capsys.readouterr().out
+        assert "t.py:step" in out and "FRAME" in out
+        assert "50 sample(s) over 1 window(s)" in out
+        self._run(api, "profiles", "flame", "--phase", "step")
+        out = capsys.readouterr().out
+        assert "t.py:main;t.py:fit;t.py:step 40" in out
+        self._run(api, "profiles", "flame", "--target", "ghost")
+        assert "(no samples matched)" in capsys.readouterr().out
+        # diff: the seeded window is B (last 60s), empty A before it
+        self._run(api, "profiles", "diff", "--last", "60")
+        out = capsys.readouterr().out
+        assert "STACK" in out and "t.py:step" in out
+        self._run(api, "profiles", "captures")
+        assert "(no captures)" in capsys.readouterr().out
+        Determined(api.url).create_experiment(CONFIG)
+        self._run(api, "profiles", "capture", "--trial", "1",
+                  "--steps", "3")
+        out = capsys.readouterr().out
+        assert "pending for trial:1" in out
+        self._run(api, "profiles", "captures")
+        out = capsys.readouterr().out
+        assert "pending" in out and "trial:1" in out and "steps=3" in out
+
 
 class TestDownloadCode:
     def test_download_code_roundtrip(self, live_master, tmp_path, capsys):

@@ -96,9 +96,16 @@ class TestGuides:
                           "master.trace_ingest", "DTPU_TRACE_SAMPLE",
                           "dtpu_lifecycle_segment_seconds",
                           "max_spans_per_trace", "EXEMPLAR",
-                          "traces show"),
+                          "traces show",
+                          # profiling plane (PR 12)
+                          "Profiling plane", "profiles/ingest",
+                          "client.profile_ship", "master.profile_ingest",
+                          "stack-table-full", "profiles flame",
+                          "profiles capture", "dtpu_step_flops",
+                          "sample_hz"),
         "expconf-reference.md": ("slots_per_trial", "max_slots",
-                                 "checkpoint_storage"),
+                                 "checkpoint_storage",
+                                 "profiling.sample_hz"),
     }
 
     def test_guides_exist_with_key_content(self):

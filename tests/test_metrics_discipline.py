@@ -33,6 +33,7 @@ GROUP_SAMPLES = {
     r"([0-9a-f-]+)": "0abc",
     r"([0-9a-f]+)": "0abc",
     r"([\w.@+\-]+)": "user1",
+    r"([\w\-]+)": "cap-0abc",
     r"(pause|activate|cancel|kill)": "pause",
     r"(archive|unarchive)": "archive",
     r"(enable|disable)": "enable",
@@ -418,6 +419,120 @@ class TestTracePlaneRoutes:
                 for e in exemplars
             )
             assert all("le" in e["labels"] for e in exemplars)
+        finally:
+            api.stop()
+            master.shutdown()
+
+
+class TestProfilePlaneRoutes:
+    """PR 12 satellite: the profiling plane's routes ride the SAME
+    instrumented dispatch path (histogram+span per route via the sweep
+    above) — this pins their existence, the store's by-construction
+    bounds under a hostile stack-cardinality attack, and the plane's
+    self-telemetry landing on the live /metrics surface."""
+
+    def test_profile_routes_registered_on_the_dispatch_path(self):
+        master = Master()
+        try:
+            patterns = {
+                (method, pattern.pattern)
+                for method, pattern, _h in build_routes(master)
+            }
+        finally:
+            master.shutdown()
+        assert ("POST", r"^/api/v1/profiles/ingest$") in patterns
+        assert ("GET", r"^/api/v1/profiles/flame$") in patterns
+        assert ("GET", r"^/api/v1/profiles/top$") in patterns
+        assert ("GET", r"^/api/v1/profiles/diff$") in patterns
+        assert ("POST", r"^/api/v1/profiles/capture$") in patterns
+        assert ("GET", r"^/api/v1/profiles/captures$") in patterns
+        assert (
+            "POST", r"^/api/v1/profiles/captures/([\w\-]+)/complete$"
+        ) in patterns
+
+    def test_store_bounded_under_stack_cardinality_attack(self):
+        """Window flood + a hostile stack-cardinality attack through the
+        MASTER's configured store: every cap holds, overflow is counted,
+        and the gauges publish the post-attack accounting."""
+        import time as _time
+
+        master = Master(profiling_config={
+            "max_windows": 30, "max_windows_per_target": 10,
+            "max_stacks": 40,
+        })
+        try:
+            store = master.profilestore
+            now = _time.time()
+
+            def window(target, i, samples):
+                return {"target": target, "start": now + i * 0.01,
+                        "end": now + i * 0.01 + 0.01, "hz": 19.0,
+                        "samples": samples}
+
+            # window flood on one target, then a target-cardinality churn
+            for i in range(50):
+                store.ingest([window("attacker", i, [
+                    {"thread": "t", "stack": "a.py:f", "count": 1},
+                ])], now=now)
+            # 25 one-window targets push past max_windows=30: the global
+            # sweep (after per-target caps) evicts oldest-first
+            for i in range(25):
+                store.ingest([window(f"t{i}", i, [
+                    {"thread": "t", "stack": "a.py:f", "count": 1},
+                ])], now=now)
+            # stack-cardinality attack: thousands of novel folded stacks
+            for i in range(20):
+                store.ingest([window("attacker", i, [
+                    {"thread": "t", "stack": f"a.py:f{i}_{j}", "count": 1}
+                    for j in range(100)
+                ])], now=now)
+            st = store.stats()
+            assert st["windows"] <= 30
+            assert st["stacks"] <= 40 + 1  # cap + (stack-table-full)
+            assert REGISTRY.get(
+                "dtpu_profile_store_windows_evicted_total"
+            ).labels("target_cap").value > 0
+            assert REGISTRY.get(
+                "dtpu_profile_store_windows_evicted_total"
+            ).labels("global_cap").value > 0
+            assert REGISTRY.get(
+                "dtpu_profile_store_stacks_rejected_total"
+            ).value > 0
+            assert REGISTRY.get("dtpu_profile_store_windows").value <= 30
+            assert REGISTRY.get("dtpu_profile_store_stacks").value <= 41
+        finally:
+            master.shutdown()
+
+    def test_sampler_self_telemetry_on_live_metrics_surface(self):
+        """The master's self-profiler publishes the plane's own health on
+        the live /metrics page: samples taken, windows stored, and the
+        sampler's measured walk cost (the overhead-budget signal)."""
+        import time as _time
+
+        master = Master(
+            profiling_config={"sample_hz": 97.0, "window_s": 0.2}
+        )
+        api = ApiServer(master)
+        api.start()
+        try:
+            deadline = _time.time() + 15
+            samples = {}
+            while _time.time() < deadline:
+                text = requests.get(f"{api.url}/metrics", timeout=30).text
+                samples = parse_exposition(text)
+                # both in one snapshot: the gauge moves on the sink call,
+                # the shipped counter a beat later
+                if sample_value(samples, "dtpu_profile_store_windows") and \
+                        sample_value(
+                            samples, "dtpu_profile_windows_shipped_total"):
+                    break
+                _time.sleep(0.2)
+            assert sample_value(samples, "dtpu_profile_store_windows") > 0
+            assert sample_value(samples, "dtpu_profile_samples_total") > 0
+            assert sample_value(
+                samples, "dtpu_profile_windows_shipped_total"
+            ) > 0
+            assert sample_value(samples, "dtpu_profile_store_targets") >= 1
         finally:
             api.stop()
             master.shutdown()

@@ -1,0 +1,948 @@
+"""Profiling plane (common/profiling.py sampler+shipper,
+master/profilestore.py store+queries+captures): the sampler's identity/
+span/phase tagging, the shipper's counted-loss discipline and fault
+drills (client.profile_ship / master.profile_ingest), the store's
+by-construction bounds, the flame/top/diff query surface, the capture
+directive lifecycle over the existing poll channels, masterconf/expconf
+knobs, the step-FLOPs metrics fold, and the devcluster e2e acceptance:
+a trial AND a serving replica continuously profiled, span-filtered
+flamegraphs from a stored trace, a capture producing a retrievable
+artifact."""
+import os
+import sys
+import threading
+import time
+
+import pytest
+import requests
+
+from determined_tpu.common import faults, profiling, trace
+from determined_tpu.common.metrics import (
+    REGISTRY,
+    parse_exposition,
+    sample_value,
+)
+from determined_tpu.master.api_server import ApiServer
+from determined_tpu.master.core import Master
+from determined_tpu.master.profilestore import FULL_SENTINEL, ProfileStore
+
+
+def _counter(name: str, **labels) -> float:
+    fam = REGISTRY.get(name)
+    if fam is None:
+        return 0.0
+    child = fam.labels(**labels) if labels else fam
+    return child.value
+
+
+def _w(target, start, end, samples, hz=19.0):
+    return {
+        "target": target, "start": start, "end": end, "hz": hz,
+        "samples": samples,
+    }
+
+
+def _s(stack, count, thread="MainThread", span="", phase=""):
+    d = {"stack": stack, "count": count, "thread": thread}
+    if span:
+        d["span"] = span
+    if phase:
+        d["phase"] = phase
+    return d
+
+
+@pytest.fixture()
+def fresh_profiling():
+    """Every test owns the process-global profiler + trace shipper."""
+    profiling.reset_profiler()
+    trace.reset_shipper()
+    yield
+    profiling.reset_profiler()
+    trace.reset_shipper()
+
+
+class TestSampler:
+    def test_windows_carry_identity_and_thread(self):
+        docs = []
+        prof = profiling.SamplingProfiler(
+            "trial:7.r0", hz=50.0, window_s=60.0, sink=docs.extend
+        )
+        for _ in range(3):
+            prof._sample_once()
+        prof._close_window(force=True)
+        assert len(docs) == 1
+        doc = docs[0]
+        assert doc["target"] == "trial:7.r0"
+        assert doc["hz"] == 50.0
+        mine = [s for s in doc["samples"] if s["thread"] == "MainThread"]
+        assert mine, doc["samples"]
+        # root-first folded frames; this very function is the leaf side
+        assert any(
+            "test_profiling" in s["stack"] for s in mine
+        ), mine
+        assert all(s["count"] >= 1 for s in doc["samples"])
+
+    def test_span_and_phase_tagging_cross_thread(self, fresh_profiling):
+        seen = {}
+        entered = threading.Event()
+        release = threading.Event()
+
+        def work():
+            profiling.set_phase("data_wait")
+            try:
+                with trace.span("prof.unit") as (tid, sid):
+                    seen["trace"], seen["span"] = tid, sid
+                    entered.set()
+                    release.wait(10)
+            finally:
+                profiling.set_phase(None)
+
+        t = threading.Thread(target=work, name="prof-worker", daemon=True)
+        t.start()
+        assert entered.wait(10)
+        docs = []
+        prof = profiling.SamplingProfiler(
+            "unit", hz=50.0, window_s=60.0, sink=docs.extend
+        )
+        try:
+            prof._sample_once()
+            prof._close_window(force=True)
+        finally:
+            release.set()
+            t.join(10)
+        tagged = [
+            s for s in docs[0]["samples"] if s["thread"] == "prof-worker"
+        ]
+        assert tagged, docs[0]["samples"]
+        assert tagged[0]["span"] == seen["span"]
+        assert tagged[0]["trace"] == seen["trace"]
+        assert tagged[0]["phase"] == "data_wait"
+
+    def test_phase_contextmanager_restores_previous(self):
+        ident = threading.get_ident()
+        profiling.set_phase("step")
+        try:
+            with profiling.phase("checkpoint"):
+                assert profiling._thread_phase[ident] == "checkpoint"
+            assert profiling._thread_phase[ident] == "step"
+        finally:
+            profiling.set_phase(None)
+        assert ident not in profiling._thread_phase
+
+    def test_window_group_cap_folds_into_truncated(self, monkeypatch):
+        # with room for ONE group, the second+ thread's samples must fold
+        # into the counted "(truncated)" stack, not grow the window
+        monkeypatch.setattr(profiling, "MAX_WINDOW_GROUPS", 1)
+        release = threading.Event()
+        t = threading.Thread(
+            target=release.wait, args=(10,), name="extra", daemon=True
+        )
+        t.start()
+        docs = []
+        prof = profiling.SamplingProfiler(
+            "unit", hz=50.0, window_s=60.0, sink=docs.extend
+        )
+        try:
+            prof._sample_once()
+            prof._close_window(force=True)
+        finally:
+            release.set()
+            t.join(10)
+        stacks = [s["stack"] for s in docs[0]["samples"]]
+        assert len([s for s in stacks if s != "(truncated)"]) == 1
+        assert "(truncated)" in stacks
+
+    def test_fold_frame_is_root_first_and_depth_capped(self):
+        def leaf(depth):
+            if depth:
+                return leaf(depth - 1)
+            return profiling.fold_frame(sys._getframe())
+
+        folded = leaf(100)
+        frames = folded.split(";")
+        assert len(frames) <= profiling.MAX_STACK_DEPTH
+        # deepest frames kept are the leaf side; the last frame is leaf()
+        assert frames[-1].endswith(":leaf")
+
+    def test_hz_and_window_clamped(self):
+        prof = profiling.SamplingProfiler("t", hz=1e9, window_s=0.0001)
+        assert prof.hz == 1000.0
+        assert prof.window_s == 0.1
+        assert profiling.SamplingProfiler("t", hz=0.0001).hz == 0.1
+
+    def test_env_start_contract(self, fresh_profiling, monkeypatch):
+        monkeypatch.delenv(profiling.PROFILE_ENV, raising=False)
+        assert profiling.maybe_start_from_env("t") is None
+        monkeypatch.setenv(profiling.PROFILE_ENV, "1")
+        monkeypatch.delenv("DTPU_MASTER", raising=False)
+        monkeypatch.delenv(profiling.PROFILE_INGEST_ENV, raising=False)
+        # no destination resolvable: profiles nothing rather than sample
+        # into a void
+        assert profiling.maybe_start_from_env("t") is None
+        monkeypatch.setenv(profiling.PROFILE_INGEST_ENV, "off")
+        assert profiling.maybe_start_from_env("t") is None
+        monkeypatch.setenv(
+            profiling.PROFILE_INGEST_ENV, "http://127.0.0.1:1"
+        )
+        monkeypatch.setenv(profiling.PROFILE_HZ_ENV, "31")
+        monkeypatch.setenv(profiling.PROFILE_WINDOW_ENV, "2.5")
+        prof = profiling.maybe_start_from_env("trial:9.r0")
+        assert prof is not None
+        assert prof.hz == 31.0 and prof.window_s == 2.5
+        profiling.stop_profiler(flush=False)
+
+
+class TestShipperAndDrills:
+    def test_ships_windows_to_live_store(self, fresh_profiling):
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            shipper = profiling.ProfileShipper(api.url)
+            now = time.time()
+            shipper.enqueue(_w("unit:1", now - 2, now - 1, [_s("a:b", 3)]))
+            shipper.flush()
+            assert master.profilestore.stats()["windows"] == 1
+            shipper.stop(flush=False)
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_buffer_overflow_drops_oldest_counted(self):
+        before = _counter(
+            "dtpu_profile_windows_dropped_total", reason="buffer_overflow"
+        )
+        shipper = profiling.ProfileShipper(
+            "http://127.0.0.1:1", max_buffer=2, batch_size=64,
+            flush_interval_s=3600.0,
+        )
+        for i in range(4):
+            shipper.enqueue(_w(f"t{i}", 1.0, 2.0, [_s("a:b", 1)]))
+        assert _counter(
+            "dtpu_profile_windows_dropped_total", reason="buffer_overflow"
+        ) == before + 2
+        # the NEWEST windows survived the overflow
+        assert [w["target"] for w in shipper._buffer] == ["t2", "t3"]
+        shipper.stop(flush=False)
+
+    def test_ship_failure_counted_never_raises(self):
+        shipper = profiling.ProfileShipper(
+            "http://127.0.0.1:1", flush_interval_s=3600.0
+        )
+        before = _counter(
+            "dtpu_profile_windows_dropped_total", reason="ship_failed"
+        )
+        shipper.enqueue(_w("t", 1.0, 2.0, [_s("a:b", 1)]))
+        shipper.flush()  # must return, not raise
+        assert _counter(
+            "dtpu_profile_windows_dropped_total", reason="ship_failed"
+        ) == before + 1
+        shipper.stop(flush=False)
+
+    def test_client_profile_ship_fault_drill(self, fresh_profiling):
+        """Satellite: client.profile_ship drills window loss — the batch
+        is counted lost, the shipper survives, and the next flush after
+        the site heals lands its batch."""
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            shipper = profiling.ProfileShipper(
+                api.url, flush_interval_s=3600.0
+            )
+            before = _counter(
+                "dtpu_profile_windows_dropped_total", reason="ship_failed"
+            )
+            now = time.time()
+            plan = faults.FaultPlan(
+                {"client.profile_ship": faults.FaultSpec(failures=1)}
+            )
+            with faults.plan_active(plan):
+                shipper.enqueue(_w("lost", now - 2, now - 1, [_s("a:b", 1)]))
+                shipper.flush()  # injected failure: batch lost, counted
+                # the master stays healthy mid-drill
+                assert requests.get(
+                    f"{api.url}/api/v1/master", timeout=10
+                ).status_code == 200
+                shipper.enqueue(_w("kept", now - 2, now - 1, [_s("a:b", 1)]))
+                shipper.flush()  # site healed: this batch lands
+            assert _counter(
+                "dtpu_profile_windows_dropped_total", reason="ship_failed"
+            ) == before + 1
+            flame = master.profilestore.flame(target="kept")
+            assert flame["samples"] == 1
+            assert master.profilestore.flame(target="lost")["samples"] == 0
+            shipper.stop(flush=False)
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_master_profile_ingest_fault_drill(self, fresh_profiling):
+        """Satellite: master.profile_ingest failing answers 500 to the
+        shipper (loss counted client-side) and never poisons neighboring
+        routes on the dispatch path."""
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            plan = faults.FaultPlan(
+                {"master.profile_ingest": faults.FaultSpec(failures=1)}
+            )
+            with faults.plan_active(plan):
+                resp = requests.post(
+                    f"{api.url}/api/v1/profiles/ingest",
+                    json={"windows": []}, timeout=10,
+                )
+                assert resp.status_code == 500
+                # neighboring routes unaffected while the site is armed
+                assert requests.get(
+                    f"{api.url}/api/v1/master", timeout=10
+                ).status_code == 200
+                # site healed: ingest works again
+                now = time.time()
+                resp = requests.post(
+                    f"{api.url}/api/v1/profiles/ingest",
+                    json={"windows": [
+                        _w("t", now - 2, now - 1, [_s("a:b", 2)])
+                    ]},
+                    timeout=10,
+                )
+                assert resp.status_code == 200
+            assert master.profilestore.stats()["windows"] == 1
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_disabled_plane(self, fresh_profiling):
+        """profiling.enabled=false: no master self-profiler, tasks told
+        off (DTPU_PROFILE=0), and ingest refuses with a NON-retryable 404
+        so a shipper that ships anyway counts one loss, no retry churn."""
+        master = Master(profiling_config={"enabled": False})
+        api = ApiServer(master)
+        api.start()
+        try:
+            assert master._self_profiler is None
+            env = master._build_task_env(
+                alloc_id="a.1.0", task_id="trial-1", task_type="TRIAL",
+                agent_id="ag", rank=0, num_procs=1, slots=1, config={},
+                trial_info=None, task_ctx=None,
+            )
+            assert env[profiling.PROFILE_ENV] == "0"
+            resp = requests.post(
+                f"{api.url}/api/v1/profiles/ingest",
+                json={"windows": [_w("t", 1.0, 2.0, [_s("a:b", 1)])]},
+                timeout=10,
+            )
+            assert resp.status_code == 404
+            assert master.profilestore.stats()["windows"] == 0
+            # the shipper counts the refusal as one loss and terminates
+            before = _counter(
+                "dtpu_profile_windows_dropped_total", reason="ship_failed"
+            )
+            shipper = profiling.ProfileShipper(
+                api.url, flush_interval_s=3600.0
+            )
+            shipper.enqueue(_w("t", 1.0, 2.0, [_s("a:b", 1)]))
+            shipper.flush()
+            assert _counter(
+                "dtpu_profile_windows_dropped_total", reason="ship_failed"
+            ) == before + 1
+            shipper.stop(flush=False)
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_sampling_knobs_injected_into_task_env(self):
+        master = Master(
+            profiling_config={"sample_hz": 5.0, "window_s": 2.0}
+        )
+        try:
+            env = master._build_task_env(
+                alloc_id="a.1.0", task_id="trial-1", task_type="TRIAL",
+                agent_id="ag", rank=0, num_procs=1, slots=1, config={},
+                trial_info=None, task_ctx=None,
+            )
+            assert env[profiling.PROFILE_ENV] == "1"
+            assert env[profiling.PROFILE_HZ_ENV] == "5.0"
+            assert env[profiling.PROFILE_WINDOW_ENV] == "2.0"
+            # the experiment's expconf sample_hz overrides the cluster rate
+            env = master._build_task_env(
+                alloc_id="a.1.0", task_id="trial-1", task_type="TRIAL",
+                agent_id="ag", rank=0, num_procs=1, slots=1,
+                config={"profiling": {"sample_hz": 3.5}},
+                trial_info=None, task_ctx=None,
+            )
+            assert env[profiling.PROFILE_HZ_ENV] == "3.5"
+            assert env[profiling.PROFILE_WINDOW_ENV] == "2.0"
+        finally:
+            master.shutdown()
+
+
+class TestStoreBounds:
+    def test_per_target_and_global_caps_counted(self):
+        store = ProfileStore({
+            "max_windows": 6, "max_windows_per_target": 4,
+        })
+        t_before = _counter(
+            "dtpu_profile_store_windows_evicted_total", reason="target_cap"
+        )
+        g_before = _counter(
+            "dtpu_profile_store_windows_evicted_total", reason="global_cap"
+        )
+        now = time.time()
+        for i in range(7):
+            store.ingest([_w("a", now + i, now + i + 1, [_s("x:y", 1)])],
+                         now=now)
+        assert store.stats()["windows"] == 4
+        assert _counter(
+            "dtpu_profile_store_windows_evicted_total", reason="target_cap"
+        ) == t_before + 3
+        for i in range(4):
+            store.ingest([_w("b", now + i, now + i + 1, [_s("x:z", 1)])],
+                         now=now)
+        st = store.stats()
+        assert st["windows"] <= 6
+        assert _counter(
+            "dtpu_profile_store_windows_evicted_total", reason="global_cap"
+        ) > g_before
+
+    def test_stack_cardinality_attack_bounded(self):
+        """A hostile stack-cardinality flood leaves the interned table at
+        its cap: novel stacks past it fold into the counted
+        (stack-table-full) sentinel instead of growing memory."""
+        store = ProfileStore({"max_stacks": 50})
+        before = _counter("dtpu_profile_store_stacks_rejected_total")
+        now = time.time()
+        for i in range(10):
+            store.ingest([_w(
+                "attacker", now, now + 1,
+                [_s(f"mod.py:f{i}_{j}", 1) for j in range(50)],
+            )], now=now)
+        st = store.stats()
+        assert st["stacks"] <= 50 + 1  # cap + the sentinel itself
+        assert _counter(
+            "dtpu_profile_store_stacks_rejected_total"
+        ) > before
+        flame = store.flame(target="attacker")
+        sentinel = [
+            r for r in flame["stacks"] if r["stack"] == FULL_SENTINEL
+        ]
+        assert sentinel and sentinel[0]["count"] >= 400
+
+    def test_window_eviction_shrinks_stack_table(self):
+        """Interning is refcounted: evicting the only windows referencing
+        a stack releases its table entry (the attack above heals)."""
+        store = ProfileStore({"max_windows_per_target": 2})
+        now = time.time()
+        for i in range(5):
+            store.ingest([_w("t", now + i, now + i + 1,
+                             [_s(f"only.py:f{i}", 1)])], now=now)
+        st = store.stats()
+        assert st["windows"] == 2
+        assert st["stacks"] == 2  # the 3 evicted windows' stacks released
+
+    def test_retention_trims_at_tick(self):
+        store = ProfileStore({"retention_s": 60.0})
+        before = _counter(
+            "dtpu_profile_store_windows_evicted_total", reason="retention"
+        )
+        t0 = 1_000_000.0
+        store.ingest([_w("t", t0, t0 + 1, [_s("a:b", 1)])], now=t0)
+        store.ingest([_w("t", t0 + 500, t0 + 501, [_s("a:c", 1)])],
+                     now=t0 + 501)
+        store.trim(now=t0 + 520)
+        st = store.stats()
+        assert st["windows"] == 1
+        assert _counter(
+            "dtpu_profile_store_windows_evicted_total", reason="retention"
+        ) == before + 1
+
+    def test_malformed_rejected_counted(self):
+        store = ProfileStore()
+        before = _counter(
+            "dtpu_profile_store_windows_rejected_total", reason="malformed"
+        )
+        out = store.ingest([
+            "junk",
+            {"no": "target"},
+            {"target": "t", "samples": "nope"},
+            {"target": "t", "start": "soon", "samples": []},
+        ], now=5.0)
+        assert out == {"accepted": 0, "rejected": 4}
+        assert _counter(
+            "dtpu_profile_store_windows_rejected_total", reason="malformed"
+        ) == before + 4
+        # a bad SAMPLE drops that sample, not the window
+        out = store.ingest([_w("t", 1.0, 2.0, [
+            _s("good:stack", 2), {"stack": "", "count": 1},
+            {"stack": "neg:count", "count": -5}, "junk",
+        ])], now=5.0)
+        assert out["accepted"] == 1
+        assert store.flame(target="t")["samples"] == 2
+
+    def test_samples_per_window_capped(self):
+        store = ProfileStore({"max_samples_per_window": 3})
+        store.ingest([_w("t", 1.0, 2.0,
+                         [_s(f"s{i}:f", 1) for i in range(10)])], now=5.0)
+        assert store.flame(target="t")["samples"] == 3
+
+
+class TestQueriesAPI:
+    def _seed(self, api):
+        # recent timestamps: HTTP ingest retention-trims against real now
+        t0 = time.time() - 50.0
+        resp = requests.post(
+            f"{api.url}/api/v1/profiles/ingest",
+            json={"windows": [
+                _w("trial:1.r0", t0, t0 + 10, [
+                    _s("a.py:main;a.py:fit;a.py:step", 50,
+                       span="CAFE" * 4, phase="step"),
+                    _s("a.py:main;a.py:fit;a.py:data", 10,
+                       phase="data_wait"),
+                ]),
+                _w("master", t0 + 5, t0 + 15, [
+                    _s("m.py:serve;m.py:tick", 30),
+                ]),
+            ]},
+            timeout=10,
+        )
+        assert resp.json()["stored"] == {"accepted": 2, "rejected": 0}
+        return t0
+
+    def test_flame_top_diff_filters(self, fresh_profiling):
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            t0 = self._seed(api)
+
+            def flame(**params):
+                return requests.get(
+                    f"{api.url}/api/v1/profiles/flame", params=params,
+                    timeout=10,
+                ).json()
+
+            out = flame()
+            assert out["samples"] == 90 and out["windows"] == 2
+            assert out["stats"]["windows"] == 2
+            assert flame(target="trial:1.r0")["samples"] == 60
+            # span filter is case-insensitive (ids normalize lowercase)
+            assert flame(span="CAFE" * 4)["samples"] == 50
+            assert flame(span="cafe" * 4)["samples"] == 50
+            assert flame(phase="data_wait")["samples"] == 10
+            assert flame(since=t0 + 12)["samples"] == 30
+            assert flame(until=t0 + 2)["samples"] == 60
+            assert flame(since=t0 + 100)["samples"] == 0
+
+            top = requests.get(
+                f"{api.url}/api/v1/profiles/top",
+                params={"target": "trial:1.r0", "n": 1}, timeout=10,
+            ).json()
+            (f,) = top["frames"]
+            assert f["frame"] == "a.py:step"
+            assert f["self"] == 50 and f["total"] == 50
+            assert f["self_pct"] == pytest.approx(83.33, abs=0.01)
+
+            diff = requests.get(
+                f"{api.url}/api/v1/profiles/diff",
+                params={
+                    "a_since": t0 - 200, "a_until": t0 - 100,
+                    "b_since": t0 - 1, "b_until": t0 + 20,
+                    "target": "trial:1.r0",
+                },
+                timeout=10,
+            ).json()
+            assert diff["a_samples"] == 0 and diff["b_samples"] == 60
+            assert diff["stacks"][0]["delta_frac"] == pytest.approx(
+                50 / 60, abs=1e-4
+            )
+            # 400 contracts
+            assert requests.get(
+                f"{api.url}/api/v1/profiles/flame?since=soon", timeout=10
+            ).status_code == 400
+            assert requests.get(
+                f"{api.url}/api/v1/profiles/diff?a_since=soon", timeout=10
+            ).status_code == 400
+            assert requests.post(
+                f"{api.url}/api/v1/profiles/ingest",
+                json={"windows": "nope"}, timeout=10,
+            ).status_code == 400
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_master_profiles_itself_into_own_store(self, fresh_profiling):
+        """The tentpole's aha: a bare master IS its own Pyroscope — its
+        self-sampler lands windows in the store with no HTTP loopback,
+        queryable under target=master."""
+        master = Master(
+            profiling_config={"sample_hz": 97.0, "window_s": 0.2}
+        )
+        try:
+            deadline = time.time() + 15
+            flame = {}
+            while time.time() < deadline:
+                flame = master.profilestore.flame(target="master")
+                if flame["samples"] > 0:
+                    break
+                time.sleep(0.1)
+            assert flame["samples"] > 0, master.profilestore.stats()
+            # the sampler never profiles ITSELF into the data
+            assert not any(
+                "dtpu-profiler" in s.get("thread", "")
+                for w in master.profilestore._by_target.get("master", ())
+                for s in ()
+            )
+        finally:
+            master.shutdown()
+
+
+class TestCaptures:
+    def test_capture_api_validation(self):
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            url = f"{api.url}/api/v1/profiles/capture"
+            assert requests.post(url, json={}, timeout=10
+                                 ).status_code == 400
+            assert requests.post(
+                url, json={"trial_id": 1, "task_id": "x"}, timeout=10
+            ).status_code == 400
+            assert requests.post(
+                url, json={"trial_id": 424242}, timeout=10
+            ).status_code == 404
+            assert requests.post(
+                url, json={"task_id": "ghost"}, timeout=10
+            ).status_code == 404
+            assert requests.post(
+                url, json={"trial_id": 1, "steps": "many"}, timeout=10
+            ).status_code == 400
+            assert requests.post(
+                f"{api.url}/api/v1/profiles/captures/cap-ghost/complete",
+                json={"artifact": "x"}, timeout=10,
+            ).status_code == 404
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_directive_rides_preemption_poll_one_shot(self):
+        """The task-kind capture channel: the directive is delivered on
+        the allocation's preemption-poll RETURN, exactly once, scoped to
+        its kind, and the completion registers the artifact."""
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            master.alloc_service.create(
+                "serve.1.0", task_id="svc-9", trial_id=None,
+                num_processes=1, slots=0,
+            )
+            with master._lock:
+                master._commands["svc-9"] = {
+                    "task_id": "svc-9", "alloc_id": "serve.1.0",
+                    "config": {}, "task_type": "SERVING",
+                    "state": "RUNNING",
+                }
+            cap = requests.post(
+                f"{api.url}/api/v1/profiles/capture",
+                json={"task_id": "svc-9", "steps": 2}, timeout=10,
+            ).json()
+            assert cap["state"] == "pending"
+            assert cap["kind"] == "task" and cap["ident"] == "svc-9"
+            # trial-kind polls must NOT receive a task capture
+            assert master.pop_profile_capture(
+                "serve.1.0", kinds=("trial",)
+            ) is None
+            resp = requests.get(
+                f"{api.url}/api/v1/allocations/serve.1.0/signals/"
+                "preemption?timeout_seconds=0.01",
+                timeout=10,
+            ).json()
+            directive = resp.get("profile_capture")
+            assert directive == {"id": cap["id"], "steps": 2}
+            # one-shot: the next poll carries nothing
+            resp = requests.get(
+                f"{api.url}/api/v1/allocations/serve.1.0/signals/"
+                "preemption?timeout_seconds=0.01",
+                timeout=10,
+            ).json()
+            assert "profile_capture" not in resp
+            rec = master.profilestore.get_capture(cap["id"])
+            assert rec["state"] == "delivered"
+            done = requests.post(
+                f"{api.url}/api/v1/profiles/captures/{cap['id']}/complete",
+                json={"artifact": f"profile-capture-{cap['id']}"},
+                timeout=10,
+            ).json()
+            assert done["state"] == "completed"
+            assert done["artifact"] == f"profile-capture-{cap['id']}"
+            caps = requests.get(
+                f"{api.url}/api/v1/profiles/captures", timeout=10
+            ).json()["captures"]
+            assert [c["id"] for c in caps] == [cap["id"]]
+            # failure completion marks failed
+            cap2 = master.profilestore.request_capture("task", "svc-9")
+            rec2 = master.profilestore.complete_capture(
+                cap2["id"], error="start failed"
+            )
+            assert rec2["state"] == "failed"
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_capture_registry_bounded(self):
+        store = ProfileStore({"max_captures": 3})
+        for i in range(6):
+            store.request_capture("task", f"t{i}")
+        assert len(store.list_captures()) == 3
+
+    def test_directive_carries_cluster_storage_default(self):
+        """Serving tasks have no checkpoint_storage; the directive carries
+        the cluster default so the artifact lands in a storage manager."""
+        master = Master(config_defaults={"checkpoint_storage": {
+            "type": "shared_fs", "host_path": "/tmp/dtpu-cap-test",
+        }})
+        try:
+            with master._lock:
+                master._commands["svc-1"] = {
+                    "task_id": "svc-1", "alloc_id": "cmd.1.0",
+                    "config": {}, "task_type": "SERVING",
+                    "state": "RUNNING",
+                }
+            master.profilestore.request_capture("task", "svc-1")
+            cap = master.pop_profile_capture("cmd.1.0", kinds=("task",))
+            assert cap["storage"]["host_path"] == "/tmp/dtpu-cap-test"
+        finally:
+            master.shutdown()
+
+
+class TestMasterconfProfiling:
+    def test_unknown_key_named(self):
+        with pytest.raises(ValueError, match="profiling: unknown key"):
+            Master(profiling_config={"sample_rate": 10})
+
+    def test_bad_values_named(self):
+        from determined_tpu.master import masterconf
+
+        errs = masterconf.validate_profiling({
+            "enabled": "yes", "sample_hz": 0.01, "window_s": -1,
+            "max_windows": True,
+        })
+        assert len(errs) == 4
+        assert any("sample_hz must be in [0.1, 1000]" in e for e in errs)
+        assert any("enabled" in e for e in errs)
+
+    def test_expconf_sample_hz_validation(self):
+        from determined_tpu.master import expconf
+
+        base = {
+            "entrypoint": "x:y",
+            "searcher": {"name": "single", "max_length": 1},
+        }
+        errs = expconf.validate({**base, "profiling": {"sample_hz": 1e6}})
+        assert any("profiling.sample_hz" in e for e in errs)
+        errs = expconf.validate({**base, "profiling": "fast"})
+        assert any("profiling must be an object" in e for e in errs)
+        assert not expconf.validate(
+            {**base, "profiling": {"sample_hz": 47.0}}
+        )
+
+
+class TestStepFlopsFold:
+    CONFIG = {
+        "entrypoint": "determined_tpu.exec.builtin_trials:SyntheticTrial",
+        "searcher": {"name": "single", "max_length": 2},
+        "resources": {"slots_per_trial": 1},
+    }
+
+    def test_step_flops_gauge_lifecycle(self):
+        """A profiling-group report's step_flops lands on the master's
+        /metrics as dtpu_step_flops{experiment} while the experiment is
+        live, and the series is pruned at the terminal transition."""
+        from determined_tpu.sdk import Determined
+
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            d = Determined(api.url)
+            exp = d.create_experiment(self.CONFIG)
+            tid = exp.trials()[0].id
+            requests.post(
+                f"{api.url}/api/v1/trials/{tid}/metrics",
+                json={"group": "profiling", "steps_completed": 1,
+                      "metrics": {"step_flops": 123456789.0,
+                                  "goodput_pct": 88.0}},
+                timeout=10,
+            ).raise_for_status()
+            samples = parse_exposition(
+                requests.get(f"{api.url}/metrics", timeout=10).text
+            )
+            assert sample_value(
+                samples, "dtpu_step_flops", experiment=str(exp.id)
+            ) == 123456789.0
+            # zero/absent step_flops never sets the gauge
+            requests.post(
+                f"{api.url}/api/v1/trials/{tid}/metrics",
+                json={"group": "profiling",
+                      "metrics": {"step_flops": 0.0}},
+                timeout=10,
+            ).raise_for_status()
+            # foreign trial id: folded without error, no series
+            requests.post(
+                f"{api.url}/api/v1/trials/999999/metrics",
+                json={"group": "profiling",
+                      "metrics": {"step_flops": 5.0}},
+                timeout=10,
+            ).raise_for_status()
+            exp.kill()
+            exp.wait(timeout=20)
+            text = REGISTRY.render()
+            flops_lines = [
+                ln for ln in text.splitlines()
+                if ln.startswith("dtpu_step_flops{")
+            ]
+            assert not any(
+                f'experiment="{exp.id}"' in ln for ln in flops_lines
+            ), flops_lines
+        finally:
+            api.stop()
+            master.shutdown()
+
+
+class TestDevclusterE2E:
+    """Acceptance: a devcluster trial AND a serving replica are profiled
+    continuously into the master's store; a span id from the stored
+    lifecycle trace (PR 10) filters to a non-empty flamegraph; a capture
+    on the serving replica produces a retrievable artifact link."""
+
+    CONFIG = {
+        "entrypoint": "determined_tpu.exec.builtin_trials:SyntheticTrial",
+        "searcher": {"name": "single", "max_length": 2, "metric": "loss"},
+        "hyperparameters": {
+            "model": "mnist-mlp", "batch_size": 8,
+            "lr": {"type": "log", "minval": -3, "maxval": -1},
+        },
+        "resources": {"slots_per_trial": 1},
+        "scheduling_unit": 1,
+        "environment": {"jax_platform": "cpu"},
+    }
+
+    def test_trial_and_serving_profiled_span_filter_and_capture(
+        self, tmp_path, fresh_profiling
+    ):
+        from determined_tpu.devcluster import DevCluster
+
+        with DevCluster(
+            n_agents=1, slots_per_agent=1,
+            profiling_config={"sample_hz": 47.0, "window_s": 0.5},
+        ) as dc:
+            sess = dc.session()
+            root_trace = sess._trace_root[0]
+            cfg = dict(self.CONFIG)
+            cfg["checkpoint_storage"] = {
+                "type": "shared_fs", "host_path": str(tmp_path / "ckpt"),
+            }
+            exp_id = sess.post(
+                "/api/v1/experiments", json_body={"config": cfg}
+            )["id"]
+            task_id = sess.post(
+                "/api/v1/commands",
+                json_body={"config": {"task_type": "SERVING"}},
+            )["task_id"]
+            assert dc.wait_experiment(exp_id, timeout=240) == "COMPLETED"
+            # serving replica up (tiny model compiled + proxy registered)
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                if dc.master.proxy.target(task_id):
+                    break
+                time.sleep(1.0)
+            assert dc.master.proxy.target(task_id), "replica never up"
+
+            # every process class lands windows: the master's self-sampler
+            # (in-process sink), the trial ranks and the serving replica
+            # (HTTP shipper; the trial flushed at harness exit, serving
+            # ships on its flush interval)
+            store = dc.master.profilestore
+            deadline = time.time() + 60
+            targets = set()
+            while time.time() < deadline:
+                targets = set(store._by_target)
+                if (
+                    "master" in targets
+                    and any(t.startswith("trial:") for t in targets)
+                    and any(t.startswith("serving:") for t in targets)
+                ):
+                    break
+                time.sleep(1.0)
+            assert "master" in targets, targets
+            trial_targets = [t for t in targets if t.startswith("trial:")]
+            assert trial_targets, targets
+            assert f"serving:{task_id}" in targets, targets
+            flame = requests.get(
+                f"{dc.api.url}/api/v1/profiles/flame",
+                params={"target": trial_targets[0]}, timeout=10,
+            ).json()
+            assert flame["samples"] > 0
+
+            # plane chaining: span ids from the STORED lifecycle trace
+            # filter the flamegraph to that span's wall-clock
+            span_ids = []
+            deadline = time.time() + 30
+            while time.time() < deadline and not span_ids:
+                dc.master.tracer.flush()
+                doc = dc.master.tracestore.get(root_trace)
+                if doc:
+                    span_ids = [
+                        s["span_id"] for s in _flatten(doc["tree"])
+                        if s["name"] in
+                        ("trial.fit", "trial.run", "trial.first_step")
+                    ]
+                if not span_ids:
+                    time.sleep(1.0)
+            assert span_ids, "lifecycle trace never assembled"
+            merged = [
+                requests.get(
+                    f"{dc.api.url}/api/v1/profiles/flame",
+                    params={"span": sid}, timeout=10,
+                ).json()
+                for sid in span_ids
+            ]
+            assert any(m["samples"] > 0 for m in merged), [
+                (sid, m["samples"]) for sid, m in zip(span_ids, merged)
+            ]
+
+            # capture: directive rides the replica's preemption poll; the
+            # uploaded artifact registers back on the record
+            cap = sess.post(
+                "/api/v1/profiles/capture",
+                json_body={"task_id": task_id, "steps": 1},
+            )
+            deadline = time.time() + 90
+            rec = None
+            while time.time() < deadline:
+                caps = sess.get("/api/v1/profiles/captures")["captures"]
+                rec = next(
+                    (c for c in caps if c["id"] == cap["id"]), None
+                )
+                if rec and rec["state"] in ("completed", "failed"):
+                    break
+                time.sleep(2.0)
+            assert rec is not None and rec["state"] == "completed", rec
+            artifact = rec["artifact"]
+            assert artifact == f"profile-capture-{cap['id']}"
+            # retrievable: the storage manager landed the XLA dump
+            assert os.path.isdir(os.path.join(
+                "/tmp/dtpu_captures", artifact
+            )), artifact
+
+            sess.post(f"/api/v1/commands/{task_id}/kill")
+
+
+def _flatten(tree):
+    out = []
+    for node in tree:
+        out.append(node)
+        out.extend(_flatten(node.get("children", [])))
+    return out
