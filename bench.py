@@ -983,6 +983,113 @@ def profiling_rung(step_time_s: float):
         return None
 
 
+def log_rung(step_time_s: float):
+    """Log plane rung (PR 13): line ingest throughput through the REAL
+    HTTP path (shipper batches → POST /api/v1/logs/ingest → bounded
+    store), label-search query p99 with the store at its full line cap,
+    and the handler's per-record emit cost against the measured step
+    time (acceptance < 1% — a trial emits a handful of records per
+    step at most, so per-record/step_time is the WORST-case fraction)."""
+    try:
+        import logging as logging_mod
+
+        from determined_tpu.common import logship as logship_mod
+        from determined_tpu.common.api_session import Session
+        from determined_tpu.master.api_server import ApiServer
+        from determined_tpu.master.core import Master
+
+        out = {}
+        master = Master(logs_config={
+            "max_lines": 50_000, "max_lines_per_target": 10_000,
+        })
+        api = ApiServer(master)
+        api.start()
+        try:
+            sess = Session(api.url)
+            bench_epoch = time.time()  # inside retention, or trim eats it
+
+            def batch(batch_i: int, n: int):
+                t0 = bench_epoch - 60 + batch_i * 1e-3
+                return [{
+                    "ts": t0 + i * 1e-6,
+                    "level": ("INFO", "WARNING")[i % 2],
+                    "logger": "bench",
+                    "message": f"bench line {batch_i}/{i} phase={i % 7}",
+                    "target": f"trial:{batch_i % 8}.r0",
+                    "labels": {"experiment": "1",
+                               "trial": str(batch_i % 8)},
+                } for i in range(n)]
+
+            # Ingest throughput: 200 shipper-sized batches (256 lines)
+            # through the real dispatch path.
+            payloads = [batch(i, 256) for i in range(200)]
+            t0 = time.perf_counter()
+            for p in payloads:
+                sess.post("/api/v1/logs/ingest", json_body={"lines": p})
+            dt = time.perf_counter() - t0
+            out["log_ingest_lines_per_sec"] = round(200 * 256 / dt, 1)
+
+            # Fill the store to its FULL line cap (direct ingest — the
+            # HTTP hop is already priced above), then time label+substring
+            # searches over it through the API.
+            i = 0
+            while master.logstore.stats()["lines"] < 50_000:
+                master.logstore.ingest(batch(200 + i, 500))
+                i += 1
+            assert master.logstore.stats()["lines"] == 50_000
+            lat = []
+            for i in range(300):
+                t0 = time.perf_counter()
+                doc = sess.get("/api/v1/logs/query", params={
+                    "target": f"trial:{i % 8}.r0", "level": "WARNING",
+                    "search": f"phase={i % 7}", "limit": "100",
+                })
+                lat.append(time.perf_counter() - t0)
+                assert doc["logs"]
+            lat.sort()
+            out["log_query_p99_ms"] = round(
+                1e3 * lat[int(len(lat) * 0.99)], 3
+            )
+
+            # Handler overhead per record at the emit site: render +
+            # context lookup + bounded enqueue (the flush happens on the
+            # shipper's own thread, off the instrumented path).
+            # batch_size above n too: enqueue() wakes the flush thread at
+            # batch_size, and a concurrent POST burst would contend with
+            # the timed loop.
+            shipper = logship_mod.LogShipper(
+                api.url, max_buffer=50_000, flush_interval_s=3600.0,
+                batch_size=50_000,
+            )
+            handler = logship_mod.StructuredLogHandler(
+                "bench:overhead", shipper=shipper,
+            )
+            lg = logging_mod.getLogger("dtpu.bench.logship")
+            lg.setLevel(logging_mod.INFO)
+            lg.propagate = False
+            lg.addHandler(handler)
+            n = 20_000
+            t0 = time.perf_counter()
+            for i in range(n):
+                lg.info("bench overhead line %d", i)
+            per_rec = (time.perf_counter() - t0) / n
+            lg.removeHandler(handler)
+            shipper.stop(flush=False)
+            out["log_ship_overhead_pct"] = round(
+                100.0 * per_rec / max(step_time_s, 1e-9), 4
+            )
+            out["log_ship_us_per_record"] = round(1e6 * per_rec, 2)
+        finally:
+            api.stop()
+            master.shutdown()
+        return out
+    except Exception:  # noqa: BLE001 — skip the rung, keep the headline
+        import traceback
+
+        traceback.print_exc()
+        return None
+
+
 def main() -> None:
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
@@ -1167,6 +1274,13 @@ def main() -> None:
         pr = profiling_rung(step_time_s)
         if pr is not None:
             record.update(pr)
+    if not os.environ.get("DTPU_BENCH_SKIP_LOGS"):
+        # Log plane (PR 13): HTTP line ingest throughput, label-search
+        # query p99 at the full line cap, handler emit overhead vs the
+        # measured step time (<1%).
+        lr = log_rung(step_time_s)
+        if lr is not None:
+            record.update(lr)
     print(json.dumps(record))
 
 

@@ -34,6 +34,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from determined_tpu.common import faults
+from determined_tpu.common import logship as logship_mod
 from determined_tpu.common import profiling as profiling_mod
 from determined_tpu.common import trace as trace_mod
 from determined_tpu.common.api_session import Session
@@ -283,6 +284,21 @@ class AgentDaemon:
         #: register ack opts us in; per-agent object, NOT the module
         #: singleton — devcluster runs several agents in one process).
         self._profiler: Optional[profiling_mod.SamplingProfiler] = None
+        #: structured-log shipping for this daemon's own records — a
+        #: per-agent handler object on the agent logger tree (NOT the
+        #: module singleton — devcluster runs several agents in one
+        #: process; each tags lines with its own identity).
+        self._log_handler: Optional[logship_mod.StructuredLogHandler] = None
+        try:
+            self._log_handler = logship_mod.StructuredLogHandler(
+                f"agent:{self.agent_id}",
+                shipper=logship_mod.LogShipper(master_url, token),
+            )
+            logging.getLogger("determined_tpu.agent").addHandler(
+                self._log_handler
+            )
+        except Exception:  # noqa: BLE001 — observability never kills work
+            logger.debug("agent log shipper start failed", exc_info=True)
         self._recover_tasks()
         # Deterministic spot-reclaim drill (`agent.reclaim.rank<r>` fault
         # sites): a dedicated watcher so the reclaim lands mid-training,
@@ -433,6 +449,15 @@ class AgentDaemon:
             # retention; an agent vanishing mid-window loses ≤ one window).
             self._profiler.stop(flush=True)
             self._profiler = None
+        if self._log_handler is not None:
+            # Detach first so the close/flush path's own records don't
+            # re-enter the handler being torn down; close() flushes the
+            # tail batch through the shipper.
+            logging.getLogger("determined_tpu.agent").removeHandler(
+                self._log_handler
+            )
+            self._log_handler.close()
+            self._log_handler = None
         if self.metrics is not None:
             self.metrics.stop()
             self.metrics = None

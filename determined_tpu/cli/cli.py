@@ -1179,6 +1179,80 @@ def profiles_captures_cmd(args: argparse.Namespace) -> None:
         )
 
 
+def _log_params(args: argparse.Namespace) -> Dict[str, Any]:
+    """Selector params shared by `dtpu logs query` and `dtpu logs tail`
+    (the /api/v1/logs/query surface: cluster-wide, no task_id needed)."""
+    params: Dict[str, Any] = {}
+    if getattr(args, "target", None):
+        params["target"] = args.target
+    if getattr(args, "trace", None):
+        params["trace"] = args.trace
+    if getattr(args, "span", None):
+        params["span"] = args.span
+    if getattr(args, "level", None):
+        params["level"] = args.level
+    if getattr(args, "search", None):
+        params["search"] = args.search
+    if getattr(args, "label", None):
+        params["match"] = list(args.label)
+    if getattr(args, "last", None):
+        params["since"] = str(time.time() - args.last)
+    return params
+
+
+def _print_log_line(line: Dict[str, Any]) -> None:
+    stamp = time.strftime("%H:%M:%S", time.localtime(line["ts"]))
+    trace = line.get("trace")
+    suffix = f"  trace={trace[:8]}…" if trace else ""
+    print(
+        f"{stamp} {line['level']:<8} {line['target']:<18} "
+        f"{line['message']}{suffix}"
+    )
+
+
+def logs_query_cmd(args: argparse.Namespace) -> None:
+    """`dtpu logs query [--target T] [--trace HEX] [--span HEX]
+    [--level WARNING] [--search STR] [--label k=v] [--last S]` —
+    cluster-wide structured-log search from the master's log store."""
+    params = _log_params(args)
+    params["limit"] = str(args.limit)
+    out = _session(args).get("/api/v1/logs/query", params=params)
+    logs = out.get("logs", [])
+    if not logs:
+        print("(no matching log lines)")
+    for line in logs:
+        _print_log_line(line)
+    st = out.get("stats", {})
+    print(
+        f"-- {st.get('lines', 0)}/{st.get('max_lines', 0)} lines held, "
+        f"{st.get('targets', 0)} target(s), "
+        f"{st.get('traces_indexed', 0)} trace(s) indexed"
+    )
+
+
+def logs_tail_cmd(args: argparse.Namespace) -> None:
+    """`dtpu logs tail [same selectors]` — live follow over the query
+    cursor (the SSE route serves the WebUI; the CLI polls ?after=N,
+    same semantics)."""
+    session = _session(args)
+    base = _log_params(args)
+    after = 0
+    # Start at the live edge: the newest held line's id, not history.
+    head = session.get(
+        "/api/v1/logs/query", params={**base, "limit": "1"}
+    ).get("logs", [])
+    if head:
+        after = head[-1]["id"]
+    while True:
+        params = {**base, "after": str(after), "limit": "500"}
+        logs = session.get("/api/v1/logs/query", params=params).get("logs", [])
+        for line in logs:
+            _print_log_line(line)
+            after = max(after, line["id"])
+        if not logs:
+            time.sleep(1.0)
+
+
 def alerts_list(args: argparse.Namespace) -> None:
     out = _session(args).get("/api/v1/alerts")
     alerts = out.get("alerts", [])
@@ -1647,6 +1721,36 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("--timeout", type=float, default=120.0)
     v.set_defaults(fn=profiles_capture_cmd)
     profiles.add_parser("captures").set_defaults(fn=profiles_captures_cmd)
+
+    logs = sub.add_parser("logs").add_subparsers(dest="verb", required=True)
+
+    def _log_filters(p: argparse.ArgumentParser) -> None:
+        p.add_argument("--target", default=None,
+                       help="process identity: master, agent:<id>, "
+                            "trial:<t>.r<k>, serving:<task>")
+        p.add_argument("--trace", default=None,
+                       help="32-hex trace id: only lines inside that trace")
+        p.add_argument("--span", default=None,
+                       help="16-hex span id (with --trace)")
+        p.add_argument("--level", default=None,
+                       choices=["DEBUG", "INFO", "WARNING", "ERROR",
+                                "CRITICAL"],
+                       help="level floor (WARNING keeps ERROR/CRITICAL too)")
+        p.add_argument("--search", default=None,
+                       help="substring filter on the message")
+        p.add_argument("--label", "-l", action="append",
+                       help="label=value matcher (repeatable), e.g. "
+                            "experiment=3")
+        p.add_argument("--last", type=float, default=None,
+                       help="only lines from the last N seconds")
+
+    v = logs.add_parser("query")
+    _log_filters(v)
+    v.add_argument("--limit", type=int, default=100)
+    v.set_defaults(fn=logs_query_cmd)
+    v = logs.add_parser("tail")
+    _log_filters(v)
+    v.set_defaults(fn=logs_tail_cmd)
 
     alerts = sub.add_parser("alerts")
     alerts.add_argument("--history", action="store_true",

@@ -35,6 +35,7 @@ class DevCluster:
         alerts_config: Optional[Dict[str, Any]] = None,
         traces_config: Optional[Dict[str, Any]] = None,
         profiling_config: Optional[Dict[str, Any]] = None,
+        logs_config: Optional[Dict[str, Any]] = None,
     ) -> None:
         #: agent_metrics=True gives every agent an ephemeral health port
         #: (+ registers it as a master scrape target) — opt-in so the
@@ -57,6 +58,7 @@ class DevCluster:
             alerts_config=alerts_config,
             traces_config=traces_config,
             profiling_config=profiling_config,
+            logs_config=logs_config,
         )
         self._cert_env_prev: Optional[str] = None
         self._tls_dir: Optional[str] = None
@@ -180,6 +182,11 @@ class DevCluster:
         from determined_tpu.common import profiling as profiling_mod
 
         profiling_mod.reset_profiler()
+        # And for the module-singleton structured-log handler (a task's
+        # in-process logship.start_shipping under tests).
+        from determined_tpu.common import logship as logship_mod
+
+        logship_mod.reset_shipping()
         self._restore_tls_state()
 
     def __enter__(self) -> "DevCluster":

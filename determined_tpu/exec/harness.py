@@ -15,6 +15,7 @@ import sys
 from typing import Any, Dict, Optional
 
 from determined_tpu import core
+from determined_tpu.common import logship
 from determined_tpu.common import profiling
 from determined_tpu.common import trace
 from determined_tpu.parallel.mesh import MeshConfig, make_mesh
@@ -107,6 +108,21 @@ def run(entrypoint: str) -> int:
         master_url=info.master_url,
         token=info.session_token,
     )
+    # Structured log plane (DTPU_LOG_SHIP=1): every record this rank logs
+    # — harness, trainer, user trial code (root-logger attach) — ships as
+    # a structured line tagged with the trial identity and the ambient
+    # trace/span of the emitting thread.
+    logship.maybe_start_from_env(
+        target=f"trial:{info.trial.trial_id}.r{rank}",
+        master_url=info.master_url,
+        token=info.session_token,
+        labels={
+            "experiment": str(info.trial.experiment_id),
+            "trial": str(info.trial.trial_id),
+            "rank": str(rank),
+            "task": str(info.task_id),
+        },
+    )
 
     # Elastic resize loop: a resize directive exits Trainer.fit with
     # ElasticResizeExit; this loop re-enters rendezvous under the new
@@ -126,6 +142,7 @@ def run(entrypoint: str) -> int:
         # an exec'd or hard-exiting wrapper would skip it.
         trace.flush_shipper()
         profiling.flush_profiler()
+        logship.flush_shipping()
 
 
 def _run_loop(
@@ -189,6 +206,14 @@ def _run_loop(
                     tensorboard_dir=tb_dir,
                     health=cfg.get("health"),
                     resume_event=resume_event,
+                )
+                # Emitted inside the trial.run span: the structured-log
+                # plane tags this line with the lifecycle trace, so
+                # `dtpu logs query --trace <id>` names the rank's entry.
+                logger.info(
+                    "trial %d rank %d entering fit (%s)",
+                    info.trial.trial_id, int(os.environ.get(
+                        "DTPU_ALLOC_RANK", "0")), resume_event,
                 )
                 trainer.fit(
                     validation_period=parse_unit(cfg.get("min_validation_period")),

@@ -152,6 +152,25 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
         "severity": "warning",
         "help": "a scrape target has not answered for >60s",
     },
+    {
+        # Log-derived alerting (the log plane's metric fold): a burst of
+        # ERROR-level lines across the cluster — the rate is read off
+        # dtpu_log_lines_total, which the log store increments at ingest
+        # and the self-scrape carries into the TSDB. Matched per level
+        # only (any target), on the master's own scrape instance like
+        # every master-owned series above.
+        "name": "log_error_burst",
+        "kind": "threshold",
+        "metric": "dtpu_log_lines_total",
+        "match": {"instance": "master", "level": "ERROR"},
+        "func": "increase",
+        "window_s": 60.0,
+        "op": ">",
+        "value": 10.0,
+        "for_s": 0.0,
+        "severity": "warning",
+        "help": ">10 ERROR log lines ingested cluster-wide in the last 60s",
+    },
 ]
 
 

@@ -106,6 +106,7 @@ TASK_TOKEN_ROUTES = re.compile(
     r"|traces/ingest"              # span shipper (trial/serving processes)
     r"|profiles/ingest"            # profile sampler (trial/serving processes)
     r"|profiles/captures/[\w\-]+/complete"  # capture artifact registration
+    r"|logs/ingest"                # log shipper (trial/serving processes)
     r")$"
 )
 
@@ -119,6 +120,7 @@ AGENT_TOKEN_ROUTES = re.compile(
     r"|auth/logout"
     r"|traces/ingest"              # span shipper (agent launch spans)
     r"|profiles/ingest"            # profile sampler (agent daemon)
+    r"|logs/ingest"                # log shipper (agent daemon)
     r")$"
 )
 
@@ -300,6 +302,18 @@ class ApiError(Exception):
         #: generation-fence 409 carries the resize directive so a fenced
         #: straggler can re-sync from the rejection itself).
         self.payload = payload or {}
+
+
+def _q_num(raw: Any, conv: Callable[[Any], Any], name: str) -> Any:
+    """Numeric query-param parse that answers 400, not a 500 from a bare
+    int()/float(): `?rank=junk` is the caller's mistake, not ours. An
+    absent/empty param is None (caller applies its own default)."""
+    if raw in (None, ""):
+        return None
+    try:
+        return conv(raw)
+    except (TypeError, ValueError):
+        raise ApiError(400, f"query param {name!r} must be a number")
 
 
 class _PlainText(Exception):
@@ -548,8 +562,15 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
 
     def searcher_completed(r: ApiRequest):
         trial_id = int(r.groups[0])
-        exp_of_trial(trial_id).op_completed(
-            trial_id, int(r.body["length"]), float(r.body["metric"])
+        length = int(r.body["length"])
+        metric = float(r.body["metric"])
+        exp_of_trial(trial_id).op_completed(trial_id, length, metric)
+        # Emitted under the request's dispatch span, which parents from
+        # the trial's traceparent: the master-class log line that lands
+        # in the SAME trace as the trial's own lines (log plane e2e).
+        logger.info(
+            "trial %d searcher op completed: length=%d metric=%s",
+            trial_id, length, metric,
         )
         return {}
 
@@ -777,17 +798,22 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         kw = dict(
             substring=r.q("search", "") or None,
             level=r.q("level", "") or None,
-            since=float(r.q("since", "0") or 0) or None,
-            until=float(r.q("until", "0") or 0) or None,
-            rank=int(r.q("rank")) if r.q("rank") not in (None, "") else None,
-            limit=int(r.q("limit", "1000") or 1000),
+            since=_q_num(r.q("since"), float, "since") or None,
+            until=_q_num(r.q("until"), float, "until") or None,
+            rank=_q_num(r.q("rank"), int, "rank"),
+            limit=_q_num(r.q("limit"), int, "limit"),
         )
+        if kw["limit"] is None:
+            kw["limit"] = 1000
         backend = "sqlite"
         want = r.q("backend", "")  # operators may force the SQLite system
         if m.log_sink is not None and want != "sqlite":
             try:
-                # Bound the ship lag: drain what's queued before querying.
-                m.log_sink.flush(timeout=2.0)
+                # Bound the ship lag: drain what's queued before querying —
+                # but only when something IS queued; an already-settled
+                # sink must not charge every search the barrier round-trip.
+                if not m.log_sink.settled():
+                    m.log_sink.flush(timeout=2.0)
                 logs = m.log_sink.search(
                     task_id,
                     substring=kw["substring"] or "",
@@ -1731,6 +1757,10 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         doc = m.tracestore.get(r.groups[0])
         if doc is None:
             raise ApiError(404, f"no trace {r.groups[0]}")
+        # Log correlation: per-span structured-log counts ride the trace
+        # answer (lines outside any span count under ""), so a waterfall
+        # can offer "show this span's logs" without a round-trip per span.
+        doc["log_counts"] = m.logstore.span_counts(r.groups[0])
         return doc
 
     def traces_search(r: ApiRequest):
@@ -1865,6 +1895,82 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
             raise ApiError(404, f"no capture {r.groups[0]}")
         return doc
 
+    # -- log plane (master/logstore.py): the master's own structured-log
+    # -- store, fed by the common/logship.py handler in every process --------
+    def logs_ingest(r: ApiRequest):
+        """POST /api/v1/logs/ingest — batch line ingest from shippers.
+        Never 4xxes a well-formed envelope: per-line problems are dropped
+        and counted inside the store (a shipper must not retry-loop over
+        one bad line)."""
+        from determined_tpu.common import faults
+
+        if not m._logs_cfg["enabled"]:
+            # Same contract as the disabled trace/profiling planes: 404
+            # is a non-retryable status for the shipper.
+            raise ApiError(404, "log plane disabled (logs.enabled)")
+        faults.inject("master.log_ingest")
+        lines = r.body.get("lines")
+        if lines is None:
+            lines = []
+        if not isinstance(lines, list):
+            raise ApiError(400, "lines must be a list of structured lines")
+        return {"stored": m.logstore.ingest(lines)}
+
+    def _log_selectors(r: ApiRequest) -> Dict[str, Any]:
+        """Shared selector surface of query and tail: label matchers
+        (?match=k=v, repeatable; ?target= is shorthand for the identity
+        label), trace/span ids, a level FLOOR, substring, time range."""
+        labels: Dict[str, str] = {}
+        for raw in r.query.get("match", []):
+            key, sep, value = raw.partition("=")
+            if not sep or not key:
+                raise ApiError(400, f"match must be key=value, got {raw!r}")
+            labels[key] = value
+        target = r.q("target")
+        if target:
+            labels["target"] = target
+        return {
+            "labels": labels or None,
+            "trace": r.q("trace"),
+            "span": r.q("span"),
+            "level": r.q("level"),
+            "substring": r.q("search") or None,
+            "since": _q_num(r.q("since"), float, "since"),
+            "until": _q_num(r.q("until"), float, "until"),
+        }
+
+    def logs_query(r: ApiRequest):
+        """GET /api/v1/logs/query?trace=…&match=k=v&level=…&search=…
+        &since=…&until=…&limit=… — cluster-wide selector search, no
+        task_id required; newest `limit` matches in id order, plus the
+        store's bounds accounting."""
+        sel = _log_selectors(r)
+        limit = _q_num(r.q("limit"), int, "limit")
+        # ?after=N flips to cursor semantics (FIRST limit past the id,
+        # for poll-style follows like `dtpu logs tail`); without it the
+        # LAST limit (a debugger wants recency).
+        after = _q_num(r.q("after"), int, "after")
+        logs = m.logstore.query(
+            limit=500 if limit is None else limit, after_id=after, **sel
+        )
+        return {"logs": logs, "stats": m.logstore.stats()}
+
+    def logs_tail(r: ApiRequest):
+        """GET /api/v1/logs/tail?…same selectors…&after=N — SSE live
+        follow over the same selector surface as /logs/query (the WebUI
+        log pane; `dtpu logs tail`)."""
+        sel = _log_selectors(r)
+        start = _sse_start(r)
+
+        def fetch(cursor):
+            cursor = start if cursor is None else cursor
+            rows = m.logstore.query(after_id=cursor, limit=500, **sel)
+            if rows:
+                cursor = rows[-1]["id"]
+            return rows, cursor
+
+        raise _EventStream(_sse_follow(fetch))
+
     R = lambda method, pat, h: (method, re.compile(f"^{pat}$"), h)  # noqa: E731
     return [
         R("POST", r"/api/v1/trials/(\d+)/metrics", post_metrics),
@@ -1966,6 +2072,9 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("POST", r"/api/v1/traces/ingest", traces_ingest),
         R("GET", r"/api/v1/traces/([0-9a-f]+)", traces_get),
         R("GET", r"/api/v1/traces", traces_search),
+        R("POST", r"/api/v1/logs/ingest", logs_ingest),
+        R("GET", r"/api/v1/logs/query", logs_query),
+        R("GET", r"/api/v1/logs/tail", logs_tail),
         R("POST", r"/api/v1/profiles/ingest", profiles_ingest),
         R("GET", r"/api/v1/profiles/flame", profiles_flame),
         R("GET", r"/api/v1/profiles/top", profiles_top),

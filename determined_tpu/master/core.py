@@ -19,6 +19,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from determined_tpu import _info
+from determined_tpu.common import logship as logship_mod
 from determined_tpu.common import profiling as profiling_mod
 from determined_tpu.common import trace as trace_mod
 from determined_tpu.common.metrics import REGISTRY as METRICS
@@ -405,6 +406,7 @@ class Master:
         alerts_config: Optional[Dict[str, Any]] = None,
         traces_config: Optional[Dict[str, Any]] = None,
         profiling_config: Optional[Dict[str, Any]] = None,
+        logs_config: Optional[Dict[str, Any]] = None,
     ) -> None:
         # Validated config tier (masterconf.py, the config.go:129 analog):
         # fail at boot with every problem named, not mid-scheduling on the
@@ -419,6 +421,7 @@ class Master:
             alerts=alerts_config,
             traces=traces_config,
             profiling=profiling_config,
+            logs=logs_config,
         )
         self.cluster_id = uuid.uuid4().hex[:8]
         self._external_url = external_url
@@ -607,6 +610,51 @@ class Master:
                 window_s=float(pcfg["window_s"]),
                 sink=self.profilestore.ingest,
             ).start()
+        # Log plane (master/logstore.py): the master is its own Loki —
+        # bounded structured-log store fed by POST /api/v1/logs/ingest
+        # from every shipper-equipped process AND by the master's OWN
+        # logger tree through a direct in-process sink (same no-HTTP-
+        # loopback rule as the self-profiler above). Handler goes on
+        # "determined_tpu.master", not the whole tree: in a devcluster
+        # the agent/common loggers belong to OTHER process classes that
+        # ship for themselves.
+        from determined_tpu.master.logstore import LogStore
+
+        lcfg = dict(masterconf.LOGS_DEFAULTS)
+        lcfg.update(logs_config or {})
+        self._logs_cfg = lcfg
+        self.logstore = LogStore(
+            max_lines=int(lcfg["max_lines"]),
+            max_lines_per_target=int(lcfg["max_lines_per_target"]),
+            max_targets=int(lcfg["max_targets"]),
+            retention_s=float(lcfg["retention_s"]),
+        )
+        self._log_handler: Optional[logship_mod.StructuredLogHandler] = None
+        self._log_level_prev: Optional[int] = None
+        if lcfg["enabled"]:
+            from determined_tpu.master import tracing as tracing_mod
+
+            ship_no = logship_mod.level_no(lcfg["ship_level"])
+            self._log_handler = logship_mod.StructuredLogHandler(
+                "master",
+                sink=self.logstore.ingest,
+                level=ship_no,
+                # Master log lines correlate through the master tracer's
+                # ambient span (the per-request dispatch span), not the
+                # common/trace.py client registry.
+                context_fn=tracing_mod.current_context,
+            )
+            mlog = logging.getLogger("determined_tpu.master")
+            if mlog.getEffectiveLevel() > ship_no:
+                # `logs.ship_level` is cluster policy: records at that
+                # level must reach the store even when the host process
+                # never called basicConfig (effective level WARNING
+                # otherwise filters them before any handler runs).
+                # Restored on shutdown.
+                self._log_level_prev = mlog.level
+                mlog.setLevel(ship_no)
+            mlog.addHandler(self._log_handler)
+        self._last_task_log_trim = 0.0
         # Background worker for slow reactions to FSM events (checkpoint GC):
         # the state-change hook fires under the experiment lock and must not
         # do storage IO inline.
@@ -837,6 +885,15 @@ class Master:
             env[profiling_mod.PROFILE_WINDOW_ENV] = str(
                 float(pcfg["window_s"])
             )
+        # Log-plane policy: the task's StructuredLogHandler attaches iff
+        # DTPU_LOG_SHIP=1 (logship.maybe_start_from_env in the harness /
+        # serving entrypoints) and floors at the cluster ship_level.
+        lcfg = self._logs_cfg
+        if not lcfg["enabled"]:
+            env[logship_mod.LOG_SHIP_ENV] = "0"
+        else:
+            env[logship_mod.LOG_SHIP_ENV] = "1"
+            env[logship_mod.LOG_LEVEL_ENV] = str(lcfg["ship_level"])
         if config.get("context"):
             env["DTPU_CONTEXT_ID"] = str(config["context"])
         return env
@@ -935,6 +992,19 @@ class Master:
                     # Profiling plane retention: same contract for the
                     # profile store's windows.
                     self.profilestore.trim()
+                    # Log plane retention: same contract for the line store.
+                    self.logstore.trim()
+                    # task_logs (SQLite system of record) retention: the
+                    # table otherwise only shrinks on per-trial delete, so
+                    # a chatty fleet grows it forever. Gated to ~30 s —
+                    # it's a table scan, not a dict sweep.
+                    if now - self._last_task_log_trim >= 30.0:
+                        self._last_task_log_trim = now
+                        lcfg = self._logs_cfg
+                        self.db.trim_task_logs(
+                            max_age_s=float(lcfg["task_log_retention_s"]),
+                            max_rows=int(lcfg["task_log_max_rows"]),
+                        )
             except Exception:  # noqa: BLE001
                 logger.exception("tick loop error")
 
@@ -2052,6 +2122,14 @@ class Master:
         self.tracer.stop()
         if self._self_profiler is not None:
             self._self_profiler.stop(flush=False)
+        if self._log_handler is not None:
+            mlog = logging.getLogger("determined_tpu.master")
+            mlog.removeHandler(self._log_handler)
+            if self._log_level_prev is not None:
+                mlog.setLevel(self._log_level_prev)
+                self._log_level_prev = None
+            self._log_handler.close()
+            self._log_handler = None
         if self.log_sink is not None:
             self.log_sink.stop()
         for svc in self._provisioners:

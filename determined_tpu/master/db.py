@@ -19,6 +19,16 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from determined_tpu.common.metrics import REGISTRY as METRICS
+
+TASK_LOG_ROWS_TRIMMED = METRICS.counter(
+    "dtpu_task_log_rows_trimmed_total",
+    "task_logs rows removed by retention (max age / global row cap) on "
+    "the maintenance tick — before retention, rows were only freed by "
+    "per-experiment delete.",
+    labels=("reason",),
+)
+
 SCHEMA = """
 CREATE TABLE IF NOT EXISTS experiments (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -888,6 +898,45 @@ class Database:
                 (task_id, after_id, limit),
             )
         ]
+
+    def trim_task_logs(
+        self,
+        *,
+        max_age_s: float = 0.0,
+        max_rows: int = 0,
+        now: Optional[float] = None,
+    ) -> int:
+        """Retention trim for the task_logs system of record (called on
+        the master's maintenance tick): rows older than ``max_age_s``
+        go first, then oldest-first excess over the global ``max_rows``
+        cap. Returns rows removed, also counted at
+        dtpu_task_log_rows_trimmed_total{reason} — a chatty fleet must
+        not grow the DB forever while per-experiment delete is the only
+        other way out. A knob of 0 disables that bound."""
+        if now is None:
+            now = time.time()
+        removed = 0
+        if max_age_s and max_age_s > 0:
+            n = self._execute(
+                "DELETE FROM task_logs WHERE ts < ?",
+                (now - float(max_age_s),),
+            ).rowcount
+            if n and n > 0:
+                TASK_LOG_ROWS_TRIMMED.labels("age").inc(n)
+                removed += n
+        if max_rows and max_rows > 0:
+            count = self._query("SELECT COUNT(*) AS n FROM task_logs")
+            excess = int(count[0]["n"]) - int(max_rows)
+            if excess > 0:
+                n = self._execute(
+                    "DELETE FROM task_logs WHERE id IN "
+                    "(SELECT id FROM task_logs ORDER BY id LIMIT ?)",
+                    (excess,),
+                ).rowcount
+                if n and n > 0:
+                    TASK_LOG_ROWS_TRIMMED.labels("rows").inc(n)
+                    removed += n
+        return removed
 
     # -- allocations ------------------------------------------------------------
     def upsert_allocation(self, alloc_id: str, **fields: Any) -> None:

@@ -103,6 +103,13 @@ class ElasticLogSink:
             if self._inflight == 0:
                 self._settled_cond.notify_all()
 
+    def settled(self) -> bool:
+        """True when nothing is queued or mid-_bulk — lets read paths skip
+        the flush barrier entirely instead of paying its lock/wait setup
+        on every search against an idle sink."""
+        with self._dropped_lock:
+            return self._inflight == 0
+
     def flush(self, timeout: float = 10.0) -> bool:
         """Wait until everything shipped before this call is POSTed or
         dropped (tests / read-after-ship search paths). Counts in-flight

@@ -1,0 +1,357 @@
+"""Bounded in-master structured log store: the master as its own Loki.
+
+The client half (`common/logship.py`) ships structured lines from every
+process class — agents, trial ranks, serving replicas over
+`POST /api/v1/logs/ingest`, the master itself through an in-process
+handler straight into `ingest()` (no HTTP loopback). This store is the
+cluster-wide searchable half: label-indexed, trace-correlated
+(`GET /api/v1/logs/query?trace=<id>` answers "what did the cluster SAY
+inside this span"), live-tailable over SSE — no task_id required,
+unlike the per-trial `task_logs` rows that remain the system of record
+for trial stdout.
+
+Memory is bounded BY CONSTRUCTION, mirroring the TSDB/tracestore
+discipline:
+
+- at most ``max_lines_per_target`` lines per process identity — extras
+  evict that target's OLDEST (counted ``target_cap``);
+- at most ``max_lines`` lines overall — admission past the cap evicts
+  the oldest line in the store (counted ``global_cap``);
+- at most ``max_targets`` distinct process identities — lines for a
+  NEW target past the cap are dropped and counted (label-cardinality
+  cap; an identity-spraying client degrades its own visibility, never
+  master memory);
+- lines older than ``retention_s`` are trimmed on the maintenance tick;
+- malformed records are rejected and counted, never raised.
+
+Ingest also folds the plane's derived metric —
+``dtpu_log_lines_total{target,level}`` — which the PR 9 self-scrape
+carries into the TSDB, where the shipped `log_error_burst` alert rule
+watches it.
+
+Stdlib-only and jax-free: this runs inside the master process. The
+ingest path must never log (the master's own log handler feeds it —
+a logging ingest would recurse).
+"""
+from __future__ import annotations
+
+import re
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from determined_tpu.common.logship import LINES_DROPPED, level_no
+from determined_tpu.common.metrics import REGISTRY as METRICS
+
+LINES_INGESTED = METRICS.counter(
+    "dtpu_log_lines_ingested_total",
+    "Structured log lines accepted into the master log store.",
+)
+#: The log-derived metric: line volume by process identity and level.
+#: Cardinality is bounded by the store's own max_targets cap — only
+#: admitted lines count.
+LOG_LINES = METRICS.counter(
+    "dtpu_log_lines_total",
+    "Structured log lines ingested, by process identity and level "
+    "(folded into the TSDB via self-scrape; the log_error_burst alert "
+    "rule watches the ERROR rate).",
+    labels=("target", "level"),
+)
+LINES_EVICTED = METRICS.counter(
+    "dtpu_log_store_lines_evicted_total",
+    "Stored lines evicted to admit newer ones (per-target or global "
+    "line cap).",
+    labels=("reason",),
+)
+STORE_LINES = METRICS.gauge(
+    "dtpu_log_store_lines",
+    "Structured log lines currently held in the log store.",
+)
+STORE_TARGETS = METRICS.gauge(
+    "dtpu_log_store_targets",
+    "Distinct process identities currently held in the log store.",
+)
+
+_TRACE_RE = re.compile(r"^[0-9a-f]{32}$")
+_SPAN_RE = re.compile(r"^[0-9a-f]{16}$")
+
+#: Known level names (anything else normalizes to INFO — a creative
+#: client must not mint unbounded level label values on LOG_LINES).
+_LEVEL_NAMES = frozenset({"DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"})
+
+MAX_MESSAGE_CHARS = 16384
+MAX_LABELS_PER_LINE = 16
+MAX_LABEL_CHARS = 256
+MAX_TARGET_CHARS = 200
+
+
+class LogStore:
+    def __init__(
+        self,
+        *,
+        max_lines: int = 100_000,
+        max_lines_per_target: int = 20_000,
+        max_targets: int = 512,
+        retention_s: float = 3600.0,
+    ) -> None:
+        if min(max_lines, max_lines_per_target, max_targets) < 1:
+            raise ValueError("log store caps must be >= 1")
+        self.max_lines = int(max_lines)
+        self.max_lines_per_target = int(max_lines_per_target)
+        self.max_targets = int(max_targets)
+        self.retention_s = float(retention_s)
+        self._lock = threading.Lock()
+        #: target → lines in id order (deque: retention pops left).
+        self._targets: Dict[str, Deque[Dict[str, Any]]] = {}
+        #: trace_id → the SAME line dicts, for O(1) correlation reads.
+        self._by_trace: Dict[str, List[Dict[str, Any]]] = {}
+        self._total = 0
+        #: monotonically increasing line id — the SSE tail cursor.
+        self._next_id = 1
+
+    # -- write path ----------------------------------------------------------
+    def ingest(
+        self, lines: List[Dict[str, Any]], now: Optional[float] = None
+    ) -> int:
+        """Admit a batch; returns the number stored. Malformed lines and
+        cap overflows are counted, never raised — and this path never
+        logs (the master's own log handler feeds it)."""
+        if now is None:
+            now = time.time()
+        stored = 0
+        level_counts: Dict[Tuple[str, str], int] = {}
+        with self._lock:
+            for line in lines if isinstance(lines, list) else []:
+                rec = self._normalize(line, now)
+                if rec is None:
+                    LINES_DROPPED.labels("malformed").inc()
+                    continue
+                target = rec["target"]
+                bucket = self._targets.get(target)
+                if bucket is None:
+                    if len(self._targets) >= self.max_targets:
+                        # Label-cardinality cap: a new identity past the
+                        # cap loses ITS lines; held targets are untouched.
+                        LINES_DROPPED.labels("target_cardinality").inc()
+                        continue
+                    bucket = self._targets[target] = deque()
+                rec["id"] = self._next_id
+                self._next_id += 1
+                bucket.append(rec)
+                self._total += 1
+                trace_id = rec.get("trace")
+                if trace_id:
+                    self._by_trace.setdefault(trace_id, []).append(rec)
+                if len(bucket) > self.max_lines_per_target:
+                    self._evict_locked(target, "target_cap")
+                while self._total > self.max_lines:
+                    self._evict_oldest_locked("global_cap")
+                stored += 1
+                key = (target, rec["level"])
+                level_counts[key] = level_counts.get(key, 0) + 1
+            self._trim_locked(now)
+        # Counters/gauges OUTSIDE the lock: metric work must not extend
+        # the ingest critical section.
+        if stored:
+            LINES_INGESTED.inc(stored)
+            for (target, level), n in level_counts.items():
+                LOG_LINES.labels(target, level).inc(n)
+        self._publish_gauges()
+        return stored
+
+    def _normalize(
+        self, line: Any, now: float
+    ) -> Optional[Dict[str, Any]]:
+        """A stored record, or None when the line is malformed (counted
+        by the caller). Lenient where safety allows (missing ts → now,
+        unknown level → INFO), strict where a bad value would poison the
+        store (non-string message/target, unbounded labels)."""
+        if not isinstance(line, dict):
+            return None
+        message = line.get("message")
+        target = line.get("target")
+        if not isinstance(message, str) or not message:
+            return None
+        if (not isinstance(target, str) or not target
+                or len(target) > MAX_TARGET_CHARS):
+            return None
+        ts = line.get("ts", now)
+        if isinstance(ts, bool) or not isinstance(ts, (int, float)) or ts <= 0:
+            return None
+        level = line.get("level")
+        level = (level.strip().upper()
+                 if isinstance(level, str) else "INFO")
+        if level not in _LEVEL_NAMES:
+            level = "INFO"
+        rec: Dict[str, Any] = {
+            "ts": float(ts),
+            "level": level,
+            "logger": (line.get("logger")
+                       if isinstance(line.get("logger"), str) else ""),
+            "message": message[:MAX_MESSAGE_CHARS],
+            "target": target,
+        }
+        labels = line.get("labels")
+        if isinstance(labels, dict) and labels:
+            rec["labels"] = {
+                str(k)[:MAX_LABEL_CHARS]: str(v)[:MAX_LABEL_CHARS]
+                for k, v in list(labels.items())[:MAX_LABELS_PER_LINE]
+            }
+        trace_id = line.get("trace")
+        if isinstance(trace_id, str) and _TRACE_RE.match(trace_id):
+            rec["trace"] = trace_id
+            span_id = line.get("span")
+            if isinstance(span_id, str) and _SPAN_RE.match(span_id):
+                rec["span"] = span_id
+        return rec
+
+    def _evict_locked(self, target: str, reason: str) -> None:
+        bucket = self._targets.get(target)
+        if not bucket:
+            return
+        rec = bucket.popleft()
+        self._total -= 1
+        self._unindex_locked(rec)
+        if not bucket:
+            del self._targets[target]
+        LINES_EVICTED.labels(reason).inc()
+
+    def _evict_oldest_locked(self, reason: str) -> None:
+        """Evict the single oldest line in the store: the target whose
+        HEAD has the smallest id (each bucket is id-ordered, so heads
+        are the per-target oldest; the scan is bounded by max_targets)."""
+        oldest = min(
+            self._targets, key=lambda t: self._targets[t][0]["id"],
+            default=None,
+        )
+        if oldest is not None:
+            self._evict_locked(oldest, reason)
+
+    def _unindex_locked(self, rec: Dict[str, Any]) -> None:
+        trace_id = rec.get("trace")
+        if not trace_id:
+            return
+        held = self._by_trace.get(trace_id)
+        if held is None:
+            return
+        try:
+            held.remove(rec)
+        except ValueError:
+            pass
+        if not held:
+            del self._by_trace[trace_id]
+
+    def _trim_locked(self, now: float) -> None:
+        horizon = now - self.retention_s
+        trimmed = 0
+        for target in list(self._targets):
+            bucket = self._targets[target]
+            while bucket and bucket[0]["ts"] < horizon:
+                rec = bucket.popleft()
+                self._total -= 1
+                trimmed += 1
+                self._unindex_locked(rec)
+            if not bucket:
+                del self._targets[target]
+        if trimmed:
+            LINES_EVICTED.labels("retention").inc(trimmed)
+
+    def trim(self, now: Optional[float] = None) -> None:
+        """Retention pass for the maintenance tick."""
+        with self._lock:
+            self._trim_locked(time.time() if now is None else now)
+        self._publish_gauges()
+
+    def _publish_gauges(self) -> None:
+        with self._lock:
+            lines, targets = self._total, len(self._targets)
+        STORE_LINES.set(lines)
+        STORE_TARGETS.set(targets)
+
+    # -- read path -----------------------------------------------------------
+    def query(
+        self,
+        *,
+        labels: Optional[Dict[str, str]] = None,
+        trace: Optional[str] = None,
+        span: Optional[str] = None,
+        level: Optional[str] = None,
+        substring: Optional[str] = None,
+        since: Optional[float] = None,
+        until: Optional[float] = None,
+        limit: int = 500,
+        after_id: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        """Selector query over the whole cluster's lines, chronological
+        (id) order. ``labels`` matches the special key ``target`` plus
+        any shipped label exactly; ``level`` is a FLOOR (WARNING keeps
+        ERROR/CRITICAL too); ``after_id`` is the live-tail cursor —
+        with it, the FIRST `limit` matches past the cursor return (the
+        stream must not skip), without it the LAST `limit` (a debugger
+        wants recency)."""
+        limit = max(1, int(limit))
+        floor = level_no(level, 0) if level else 0
+        matchers = dict(labels or {})
+        target_sel = matchers.pop("target", None)
+        with self._lock:
+            if trace:
+                candidates = list(self._by_trace.get(trace, ()))
+            elif target_sel is not None:
+                candidates = list(self._targets.get(target_sel, ()))
+            else:
+                candidates = [
+                    rec for bucket in self._targets.values()
+                    for rec in bucket
+                ]
+        out: List[Dict[str, Any]] = []
+        for rec in candidates:
+            if target_sel is not None and rec["target"] != target_sel:
+                continue
+            if span and rec.get("span") != span:
+                continue
+            if trace and rec.get("trace") != trace:
+                continue
+            if floor and level_no(rec["level"]) < floor:
+                continue
+            if since is not None and rec["ts"] < since:
+                continue
+            if until is not None and rec["ts"] >= until:
+                continue
+            if after_id is not None and rec["id"] <= after_id:
+                continue
+            if substring and substring not in rec["message"]:
+                continue
+            rec_labels = rec.get("labels") or {}
+            if any(rec_labels.get(k) != v for k, v in matchers.items()):
+                continue
+            out.append(rec)
+        out.sort(key=lambda r: r["id"])
+        if after_id is not None:
+            return [dict(r) for r in out[:limit]]
+        return [dict(r) for r in out[-limit:]]
+
+    def span_counts(self, trace_id: str) -> Dict[str, int]:
+        """Per-span line counts for one trace — what the trace answer
+        carries so a waterfall can say "this span logged 12 lines".
+        Lines in the trace but outside any span count under ''."""
+        with self._lock:
+            held = list(self._by_trace.get(trace_id, ()))
+        counts: Dict[str, int] = {}
+        for rec in held:
+            key = rec.get("span", "")
+            counts[key] = counts.get(key, 0) + 1
+        return counts
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "lines": self._total,
+                "targets": len(self._targets),
+                "traces_indexed": len(self._by_trace),
+                "max_lines": self.max_lines,
+                "max_lines_per_target": self.max_lines_per_target,
+                "max_targets": self.max_targets,
+                "retention_s": self.retention_s,
+                "next_id": self._next_id,
+            }

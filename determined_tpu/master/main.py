@@ -60,6 +60,11 @@ def main() -> None:
              '{"sample_hz": 19, "retention_s": 7200} '
              "(docs/operations.md \"Profiling plane\")")
     parser.add_argument(
+        "--logs-config", default=None,
+        help='JSON log-plane knobs, e.g. '
+             '{"max_lines": 100000, "ship_level": "INFO"} '
+             "(docs/operations.md \"Log plane\")")
+    parser.add_argument(
         "--config-defaults", default=None,
         help="JSON experiment-config defaults merged under every submitted "
              'config (master.yaml analog), e.g. {"max_restarts": 2}')
@@ -104,6 +109,9 @@ def main() -> None:
         profiling_config=(
             json.loads(args.profiling_config)
             if args.profiling_config else None
+        ),
+        logs_config=(
+            json.loads(args.logs_config) if args.logs_config else None
         ),
     )
     if bool(args.tls_cert) != bool(args.tls_key):

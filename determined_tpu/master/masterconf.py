@@ -69,6 +69,25 @@ PROFILING_DEFAULTS = {
 }
 
 
+#: Structured log plane knobs (`logs:` section): the log store's
+#: by-construction bounds plus the shipper policy the master injects
+#: into every task env, and the retention bounds of the per-trial
+#: `task_logs` SQLite table (docs/operations.md "Log plane" documents
+#: each row).
+LOGS_DEFAULTS = {
+    "enabled": True,          # False: ingest 404s, tasks told not to ship
+    "max_lines": 100000,      # hard global line cap (oldest evicted, counted)
+    "max_lines_per_target": 20000,  # per-process-identity line cap
+    "max_targets": 512,       # label-cardinality cap on process identities
+    "retention_s": 3600.0,    # lines older than this are trimmed
+    "ship_level": "INFO",     # level floor pushed to tasks (DTPU_LOG_SHIP_LEVEL)
+    "task_log_retention_s": 604800.0,  # task_logs SQLite rows: max age (7d)
+    "task_log_max_rows": 1000000,      # task_logs SQLite rows: global cap
+}
+
+_LOG_LEVELS = ("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL")
+
+
 def validate_metrics(cfg: Optional[Dict[str, Any]]) -> List[str]:
     errors: List[str] = []
     if cfg is None:
@@ -184,6 +203,38 @@ def validate_profiling(cfg: Optional[Dict[str, Any]]) -> List[str]:
     return errors
 
 
+def validate_logs(cfg: Optional[Dict[str, Any]]) -> List[str]:
+    errors: List[str] = []
+    if cfg is None:
+        return errors
+    if not isinstance(cfg, dict):
+        return ["logs must be an object of log-plane knobs"]
+    for key, value in cfg.items():
+        if key not in LOGS_DEFAULTS:
+            errors.append(
+                f"logs: unknown key {key!r} "
+                f"(one of: {', '.join(sorted(LOGS_DEFAULTS))})"
+            )
+            continue
+        if key == "enabled":
+            if not isinstance(value, bool):
+                errors.append("logs.enabled must be a bool")
+            continue
+        if key == "ship_level":
+            if value not in _LOG_LEVELS:
+                errors.append(
+                    "logs.ship_level must be one of: "
+                    + ", ".join(_LOG_LEVELS)
+                )
+            continue
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            errors.append(f"logs.{key} must be a number")
+            continue
+        if value <= 0:
+            errors.append(f"logs.{key} must be positive")
+    return errors
+
+
 def validate_pools(pools: Optional[Dict[str, Any]]) -> List[str]:
     """Returns human-readable errors (empty = valid)."""
     errors: List[str] = []
@@ -247,6 +298,7 @@ def validate(
     alerts: Optional[Dict[str, Any]] = None,
     traces: Optional[Dict[str, Any]] = None,
     profiling: Optional[Dict[str, Any]] = None,
+    logs: Optional[Dict[str, Any]] = None,
 ) -> None:
     """Validate the master's startup configuration; raises ValueError with
     EVERY problem named (config.go-style: fail fast at boot, not at the
@@ -256,6 +308,7 @@ def validate(
     errors += validate_alerts(alerts)
     errors += validate_traces(traces)
     errors += validate_profiling(profiling)
+    errors += validate_logs(logs)
     if not isinstance(preempt_timeout_s, (int, float)) or (
         preempt_timeout_s <= 0
     ):

@@ -33,6 +33,17 @@ _current_span: contextvars.ContextVar = contextvars.ContextVar(
 )
 
 
+def current_context() -> Optional[Tuple[str, str]]:
+    """(trace_id, span_id) of the ambient master-side span, if any — the
+    log plane's correlation hook (StructuredLogHandler context_fn): a
+    master log line emitted inside a request handler lands in that
+    request's trace, same as a task line inside a common/trace span()."""
+    s = _current_span.get()
+    if s is None:
+        return None
+    return (s.trace_id, s.span_id)
+
+
 def _ns(t: float) -> int:
     return int(t * 1e9)
 

@@ -103,6 +103,19 @@ master's trace store; click one for its waterfall)</div>
 <div id="profiles" class="muted">(hot frames appear once the
 continuous-profiling plane has shipped a window)</div>
 <div id="profile-flame"></div>
+<h2>Logs (cluster) <span class="muted" id="logs-label"></span></h2>
+<div style="margin-bottom:0.3em">
+  <input id="log-target" placeholder="target (master, trial:1.r0, …)"
+         size="24" onchange="refreshLogs()">
+  <input id="log-level" placeholder="level floor" size="10"
+         onchange="refreshLogs()">
+  <input id="log-search" placeholder="substring" size="16"
+         onchange="refreshLogs()">
+  <input id="log-trace" placeholder="trace id" size="18"
+         onchange="refreshLogs()">
+</div>
+<div id="logpane" class="muted">(structured log lines appear once the log
+plane has ingested from a shipper)</div>
 <h2>Agents</h2><table id="agents"></table>
 <h2>Resource pools</h2><table id="pools"></table>
 <h2>Job queue</h2><div id="queues">(empty)</div>
@@ -768,6 +781,46 @@ async function showFlame() {
   } catch (e) { $('profile-flame').textContent = '(flame query failed)'; }
 }
 
+// --- log plane: cluster-wide structured-log table off /api/v1/logs/query
+// --- (the master's bounded log store; trace column links into the
+// --- waterfall above)
+function logParams() {
+  const q = [];
+  for (const [id, key] of [['log-target', 'target'],
+                           ['log-level', 'level'],
+                           ['log-search', 'search'],
+                           ['log-trace', 'trace']]) {
+    const v = $(id).value.trim();
+    if (v) q.push(`${key}=${encodeURIComponent(v)}`);
+  }
+  return q.join('&');
+}
+async function refreshLogs() {
+  try {
+    const out = await j('/api/v1/logs/query?limit=30&' + logParams());
+    const st = out.stats || {};
+    $('logs-label').textContent =
+      `· ${st.lines || 0}/${st.max_lines || 0} lines, ` +
+      `${st.targets || 0} target(s), ${st.traces_indexed || 0} trace(s) indexed`;
+    const lines = out.logs || [];
+    if (!lines.length) return;
+    const div = $('logpane');
+    div.classList.remove('muted');
+    div.innerHTML =
+      '<table><tr><th>when</th><th>level</th><th>target</th>' +
+      '<th>message</th><th>trace</th></tr>' + lines.map(l =>
+        '<tr>' + cell(new Date(l.ts * 1000).toLocaleTimeString()) +
+        `<td class="${l.level === 'ERROR' || l.level === 'CRITICAL'
+          ? 'ERRORED' : ''}">${esc(l.level)}</td>` +
+        cell(l.target) + cell(l.message) +
+        (l.trace
+          ? `<td style="cursor:pointer;text-decoration:underline" ` +
+            `onclick="showTrace('${esc(l.trace)}')">` +
+            `${esc(l.trace.slice(0, 8))}…</td>`
+          : '<td>-</td>') + '</tr>').join('') + '</table>';
+  } catch (e) { /* log plane not up yet */ }
+}
+
 function pager(el, page, total, onchange, redraw = 'refresh') {
   const pages = Math.max(1, Math.ceil(total / PAGE_SIZE));
   el.innerHTML = `page ${page + 1}/${pages} · ${total} total ` +
@@ -908,6 +961,7 @@ async function refresh() {
     await refreshClusterHealth();
     await refreshTraces();
     await refreshProfiles();
+    await refreshLogs();
   } catch (e) { console.error(e); }
 }
 // --- hash router (#/experiments/<id>, #/trials/<id>) -------------------

@@ -417,10 +417,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     register_proxy(server.port)
     # Continuous-profiling plane: sample this replica's threads (decode
     # loop, SSE writers) when the master enabled it for the task env.
+    from determined_tpu.common import logship as logship_mod
     from determined_tpu.common import profiling as profiling_mod
 
     task_id = os.environ.get("DTPU_TASK_ID") or "serving"
     profiling_mod.maybe_start_from_env(target=f"serving:{task_id}")
+    # Structured log plane: this replica's records (admission decisions,
+    # preemption drain, capture runs) ship as structured lines under the
+    # serving identity when the master enabled the plane in the task env.
+    logship_mod.maybe_start_from_env(
+        target=f"serving:{task_id}", labels={"task": task_id},
+    )
     # The idle loop doubles as the replica's control channel: poll the
     # allocation's preemption signal (short timeout — a capture directive
     # rides back on poll RETURN, so the timeout bounds its latency) and
@@ -460,6 +467,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         pass
     finally:
         profiling_mod.flush_profiler()
+        logship_mod.flush_shipping()
         server.stop()
         engine.stop()
     return 0

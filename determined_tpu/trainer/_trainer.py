@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from determined_tpu import core as core_mod
 from determined_tpu.common import faults
+from determined_tpu.common import logship as logship_mod
 from determined_tpu.common import profiling as profiling_mod
 from determined_tpu.common import trace as trace_mod
 from determined_tpu.core._searcher import DummySearcherContext
@@ -1290,6 +1291,10 @@ class Trainer:
                 _fit_scope.close()  # end the trial.fit span either way
         if self._profiler is not None:
             self._profiler.stop()
+        # The fit's tail records (final checkpoint, searcher completion)
+        # must survive a hard kill right after fit returns: drain the
+        # structured log shipper now rather than relying on atexit.
+        logship_mod.flush_shipping()
         self._tb_sync()
         return last_val
 

@@ -102,7 +102,14 @@ class TestGuides:
                           "client.profile_ship", "master.profile_ingest",
                           "stack-table-full", "profiles flame",
                           "profiles capture", "dtpu_step_flops",
-                          "sample_hz"),
+                          "sample_hz",
+                          # log plane (PR 13)
+                          "Log plane", "logs/ingest", "logs query",
+                          "logs tail", "client.log_ship",
+                          "master.log_ingest", "ship_level",
+                          "max_lines_per_target", "log_error_burst",
+                          "dtpu_log_lines_total",
+                          "dtpu_task_log_rows_trimmed_total"),
         "expconf-reference.md": ("slots_per_trial", "max_slots",
                                  "checkpoint_storage",
                                  "profiling.sample_hz"),

@@ -1,0 +1,834 @@
+"""Structured log plane (PR 13): the common/logship.py client half
+(handler rendering, trace correlation, shipper discipline), the
+master/logstore.py bounded store (caps, retention, selector queries,
+span correlation), the ingest/query/tail API surface, both fault drills
+(client.log_ship / master.log_ingest), the log-derived log_error_burst
+alert through the real webhook shipper, task_logs DB retention, and the
+devcluster e2e acceptance: one trial's trace resolves to log lines from
+BOTH process classes (trial rank + master) on the live query surface."""
+import json
+import logging
+import math
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+import requests
+
+from determined_tpu.common import faults, logship
+from determined_tpu.common import trace
+from determined_tpu.common.metrics import REGISTRY
+from determined_tpu.master.api_server import ApiServer
+from determined_tpu.master.core import Master
+from determined_tpu.master.logstore import LogStore
+
+
+def _counter(name: str, **labels) -> float:
+    fam = REGISTRY.get(name)
+    if fam is None:
+        return 0.0
+    child = fam.labels(**labels) if labels else fam
+    return child.value
+
+
+def _line(target="t", message="hello", ts=None, level="INFO", **extra):
+    rec = {"target": target, "message": message,
+           "ts": time.time() if ts is None else ts, "level": level}
+    rec.update(extra)
+    return rec
+
+
+@pytest.fixture()
+def fresh_logship():
+    """Every shipping test owns the process-global handler state."""
+    logship.reset_shipping()
+    yield
+    logship.reset_shipping()
+
+
+class TestLogStoreBounds:
+    def test_per_target_and_global_caps_evict_oldest_counted(self):
+        store = LogStore(max_lines=10, max_lines_per_target=4)
+        before_t = _counter(
+            "dtpu_log_store_lines_evicted_total", reason="target_cap"
+        )
+        before_g = _counter(
+            "dtpu_log_store_lines_evicted_total", reason="global_cap"
+        )
+        now = time.time()
+        store.ingest([_line("a", f"m{i}", ts=now + i) for i in range(7)])
+        assert store.stats()["lines"] == 4  # per-target cap
+        assert _counter(
+            "dtpu_log_store_lines_evicted_total", reason="target_cap"
+        ) == before_t + 3
+        # oldest went first: the survivors are the newest 4
+        msgs = [r["message"] for r in store.query(labels={"target": "a"})]
+        assert msgs == ["m3", "m4", "m5", "m6"]
+        for t in ("b", "c"):
+            store.ingest(
+                [_line(t, f"m{i}", ts=now + i) for i in range(4)]
+            )
+        assert store.stats()["lines"] == 10  # global cap binds at 12-2
+        assert _counter(
+            "dtpu_log_store_lines_evicted_total", reason="global_cap"
+        ) == before_g + 2
+
+    def test_target_cardinality_cap_drops_new_identities(self):
+        store = LogStore(max_targets=2)
+        before = _counter(
+            "dtpu_log_lines_dropped_total", reason="target_cardinality"
+        )
+        store.ingest([_line("a"), _line("b"), _line("evil")])
+        assert store.stats()["targets"] == 2
+        assert not store.query(labels={"target": "evil"})
+        # held targets still ingest
+        assert store.ingest([_line("a", "again")]) == 1
+        assert _counter(
+            "dtpu_log_lines_dropped_total", reason="target_cardinality"
+        ) == before + 1
+
+    def test_malformed_rejected_counted_never_raises(self):
+        store = LogStore()
+        before = _counter(
+            "dtpu_log_lines_dropped_total", reason="malformed"
+        )
+        stored = store.ingest([
+            "not a dict",
+            {"target": "t"},                          # no message
+            {"message": "m"},                         # no target
+            {"target": "t", "message": ""},           # empty message
+            {"target": "t", "message": "m", "ts": "soon"},
+            {"target": "t", "message": "m", "ts": -5},
+            {"target": "x" * 500, "message": "m"},    # target too long
+            _line("t", "good"),
+        ])
+        assert stored == 1
+        assert _counter(
+            "dtpu_log_lines_dropped_total", reason="malformed"
+        ) == before + 7
+        # lenient where safe: unknown level normalizes, bad trace dropped
+        store.ingest([_line("t", "m2", level="NOISE", trace="xyz")])
+        (rec,) = store.query(substring="m2")
+        assert rec["level"] == "INFO" and "trace" not in rec
+
+    def test_retention_trim_on_ingest_and_tick(self):
+        store = LogStore(retention_s=60.0)
+        now = time.time()
+        before = _counter(
+            "dtpu_log_store_lines_evicted_total", reason="retention"
+        )
+        store.ingest([_line("t", "old", ts=now - 120)], now=now)
+        assert store.stats()["lines"] == 0  # ingest-path trim ate it
+        store.ingest([_line("t", "fresh", ts=now - 50)], now=now)
+        assert store.stats()["lines"] == 1
+        store.trim(now=now + 30)  # the maintenance tick, 80s later
+        assert store.stats()["lines"] == 0
+        assert _counter(
+            "dtpu_log_store_lines_evicted_total", reason="retention"
+        ) == before + 2
+
+    def test_query_selectors(self):
+        store = LogStore()
+        now = time.time()
+        tid, sid = "ab" * 16, "cd" * 8
+        store.ingest([
+            _line("a", "warm start", ts=now - 10, level="WARNING",
+                  labels={"experiment": "1"}),
+            _line("a", "error out", ts=now - 5, level="ERROR",
+                  trace=tid, span=sid),
+            _line("b", "info line", ts=now - 2, level="INFO",
+                  trace=tid, labels={"experiment": "2"}),
+            _line("b", "debug line", ts=now - 1, level="DEBUG"),
+        ])
+        # level is a FLOOR
+        assert {r["message"] for r in store.query(level="WARNING")} == \
+            {"warm start", "error out"}
+        # trace pulls lines from BOTH targets; span narrows further
+        assert {r["target"] for r in store.query(trace=tid)} == {"a", "b"}
+        assert [r["message"] for r in store.query(trace=tid, span=sid)] \
+            == ["error out"]
+        # substring + labels + time range
+        assert [r["message"] for r in store.query(substring="line")] == \
+            ["info line", "debug line"]
+        assert [r["message"] for r in store.query(
+            labels={"experiment": "2"}
+        )] == ["info line"]
+        assert [r["message"] for r in store.query(
+            since=now - 6, until=now - 1.5
+        )] == ["error out", "info line"]
+        # span_counts: one line under the span, one under '' (no span)
+        assert store.span_counts(tid) == {sid: 1, "": 1}
+
+    def test_limit_and_after_cursor_semantics(self):
+        store = LogStore()
+        now = time.time()
+        store.ingest([_line("t", f"m{i}", ts=now + i * 1e-3)
+                      for i in range(10)])
+        # no cursor: the LAST limit, ascending (a debugger wants recency)
+        assert [r["message"] for r in store.query(limit=3)] == \
+            ["m7", "m8", "m9"]
+        # cursor: the FIRST limit past it (a tail must not skip)
+        first = store.query(limit=1)[0]  # m7's id - 1 window
+        rows = store.query(after_id=2, limit=3)
+        assert [r["message"] for r in rows] == ["m2", "m3", "m4"]
+        assert rows[0]["id"] > 2
+        assert first["id"] > rows[-1]["id"]
+
+
+class TestShipperDiscipline:
+    def test_buffer_overflow_drops_oldest_counted(self, fresh_logship):
+        shipper = logship.LogShipper(
+            "http://127.0.0.1:1", max_buffer=3,
+            flush_interval_s=3600.0, batch_size=1000,
+        )
+        try:
+            before = _counter(
+                "dtpu_log_lines_dropped_total", reason="buffer_overflow"
+            )
+            for i in range(5):
+                shipper.enqueue({"message": f"m{i}"})
+            assert _counter(
+                "dtpu_log_lines_dropped_total", reason="buffer_overflow"
+            ) == before + 2
+            # newest survive: what the process is doing NOW
+            assert [x["message"] for x in shipper._buffer] == \
+                ["m2", "m3", "m4"]
+        finally:
+            shipper.stop(flush=False)
+
+    def test_ship_failure_counted_never_raises(self, fresh_logship):
+        shipper = logship.LogShipper("http://127.0.0.1:1")  # nothing there
+        try:
+            before = _counter(
+                "dtpu_log_lines_dropped_total", reason="ship_failed"
+            )
+            shipper.enqueue({"message": "doomed"})
+            shipper.flush()  # must return, not raise
+            assert _counter(
+                "dtpu_log_lines_dropped_total", reason="ship_failed"
+            ) == before + 1
+        finally:
+            shipper.stop(flush=False)
+
+    def test_handler_renders_identity_labels_and_trace(self, fresh_logship):
+        got = []
+        handler = logship.StructuredLogHandler(
+            "trial:7.r0", {"experiment": "3", "rank": "0"},
+            sink=got.extend,
+        )
+        lg = logging.getLogger("dtpu.test.render")
+        lg.setLevel(logging.DEBUG)
+        lg.propagate = False
+        lg.addHandler(handler)
+        try:
+            with trace.span("unit.op") as (tid, sid):
+                lg.info("step %d done", 12)
+            lg.debug("below the floor")  # handler level INFO
+            lg.error("plain %s", "error")
+        finally:
+            lg.removeHandler(handler)
+            handler.close()
+        assert len(got) == 2
+        line = got[0]
+        assert line["message"] == "step 12 done"
+        assert line["target"] == "trial:7.r0"
+        assert line["level"] == "INFO" and line["logger"] == "dtpu.test.render"
+        assert line["labels"] == {"experiment": "3", "rank": "0"}
+        assert line["trace"] == tid and line["span"] == sid
+        assert "trace" not in got[1]  # no ambient span at emit time
+
+    def test_emit_never_raises_and_is_counted(self, fresh_logship):
+        def explode(lines):
+            raise RuntimeError("sink down")
+
+        handler = logship.StructuredLogHandler("t", sink=explode)
+        lg = logging.getLogger("dtpu.test.explode")
+        lg.setLevel(logging.INFO)
+        lg.propagate = False
+        lg.addHandler(handler)
+        before = _counter(
+            "dtpu_log_lines_dropped_total", reason="emit_error"
+        )
+        try:
+            lg.info("this must not propagate")
+        finally:
+            lg.removeHandler(handler)
+            handler.close()
+        assert _counter(
+            "dtpu_log_lines_dropped_total", reason="emit_error"
+        ) == before + 1
+
+    def test_start_shipping_floors_logger_level(self, fresh_logship):
+        """stdlib filters at the LOGGER's level before handlers run — the
+        attach must floor it or ship_level is silently violated in a
+        process that never configured logging."""
+        lg = logging.getLogger("dtpu.test.floor")
+        lg.setLevel(logging.ERROR)
+        handler = logship.start_shipping(
+            "t", master_url="http://127.0.0.1:1",
+            attach_to="dtpu.test.floor",
+        )
+        try:
+            assert handler is not None
+            assert lg.getEffectiveLevel() == logging.INFO
+        finally:
+            logship.reset_shipping()
+            lg.setLevel(logging.NOTSET)
+
+
+class TestLogAPI:
+    def test_ingest_query_roundtrip_and_contracts(self, fresh_logship):
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            tid = "12" * 16
+            resp = requests.post(
+                f"{api.url}/api/v1/logs/ingest",
+                json={"lines": [
+                    _line("trial:1.r0", "step 5 done", trace=tid,
+                          labels={"experiment": "9"}),
+                    _line("trial:1.r0", "noise", level="DEBUG"),
+                    "malformed",
+                ]},
+                timeout=10,
+            )
+            assert resp.json()["stored"] == 2
+            out = requests.get(
+                f"{api.url}/api/v1/logs/query?trace={tid}", timeout=10
+            ).json()
+            assert [r["message"] for r in out["logs"]] == ["step 5 done"]
+            assert out["stats"]["lines"] >= 2
+            out = requests.get(
+                f"{api.url}/api/v1/logs/query"
+                "?match=experiment=9&level=INFO&search=done",
+                timeout=10,
+            ).json()
+            assert [r["target"] for r in out["logs"]] == ["trial:1.r0"]
+            # contracts: bad envelope 400, junk numerics 400 (not 500),
+            # bad matcher 400
+            assert requests.post(
+                f"{api.url}/api/v1/logs/ingest", json={"lines": "nope"},
+                timeout=10,
+            ).status_code == 400
+            for q in ("since=junk", "until=junk", "limit=junk",
+                      "after=junk", "match=nosep"):
+                r = requests.get(
+                    f"{api.url}/api/v1/logs/query?{q}", timeout=10
+                )
+                assert r.status_code == 400, (q, r.status_code)
+        finally:
+            api.stop()
+            master.shutdown()
+
+    @staticmethod
+    def _task_env(master):
+        return master._build_task_env(
+            alloc_id="a-1", task_id="t-1", task_type="trial",
+            agent_id="agent-0", rank=0, num_procs=1, slots=1,
+            config={}, trial_info=None, task_ctx=None,
+        )
+
+    def test_disabled_plane_404s_ingest_and_task_env_opts_out(self):
+        master = Master(logs_config={"enabled": False})
+        api = ApiServer(master)
+        api.start()
+        try:
+            assert requests.post(
+                f"{api.url}/api/v1/logs/ingest", json={"lines": []},
+                timeout=10,
+            ).status_code == 404
+            env = self._task_env(master)
+            assert env[logship.LOG_SHIP_ENV] == "0"
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_enabled_plane_injects_ship_env(self):
+        master = Master(logs_config={"ship_level": "WARNING"})
+        try:
+            env = self._task_env(master)
+            assert env[logship.LOG_SHIP_ENV] == "1"
+            assert env[logship.LOG_LEVEL_ENV] == "WARNING"
+        finally:
+            master.shutdown()
+
+    def test_masterconf_validates_logs_section(self):
+        from determined_tpu.master import masterconf
+
+        assert masterconf.validate_logs(None) == []
+        assert masterconf.validate_logs({"max_lines": 10}) == []
+        errs = masterconf.validate_logs({
+            "enabled": "yes", "ship_level": "LOUD", "max_lines": -1,
+            "bogus": 1,
+        })
+        assert len(errs) == 4
+        with pytest.raises(ValueError):
+            Master(logs_config={"max_lines": "lots"})
+
+    def test_master_own_records_reach_store_with_request_trace(
+        self, fresh_logship
+    ):
+        """The master ingests ITSELF in-process (no HTTP loopback), and a
+        record logged under an active master-tracer span carries that
+        span's trace (the context_fn correlation hook) — so a client's
+        trace resolves to the master-side lines its request produced."""
+        master = Master()
+        try:
+            mlog = logging.getLogger("determined_tpu.master")
+            span = master.tracer.start_span("unit.request")
+            with master.tracer.activate(span):
+                mlog.info("inside the request span")
+            master.tracer.end_span(span)
+            mlog.info("outside any span")
+            rows = master.logstore.query(
+                substring="inside the request span"
+            )
+            assert rows
+            assert rows[0]["target"] == "master"
+            assert rows[0]["trace"] == span.trace_id
+            assert rows[0]["span"] == span.span_id
+            (plain,) = master.logstore.query(
+                substring="outside any span"
+            )
+            assert "trace" not in plain
+        finally:
+            master.shutdown()
+
+    def test_traces_answer_carries_log_counts(self, fresh_logship):
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            t0 = time.time()
+            tid, sid = "34" * 16, "ef" * 8
+            requests.post(
+                f"{api.url}/api/v1/traces/ingest",
+                json={"spans": [{
+                    "traceId": tid, "spanId": sid, "name": "op",
+                    "startTimeUnixNano": int(t0 * 1e9),
+                    "endTimeUnixNano": int((t0 + 1) * 1e9),
+                    "status": {"code": 1},
+                }]},
+                timeout=10,
+            )
+            requests.post(
+                f"{api.url}/api/v1/logs/ingest",
+                json={"lines": [
+                    _line("w", "in span", trace=tid, span=sid),
+                    _line("w", "in trace only", trace=tid),
+                ]},
+                timeout=10,
+            )
+            doc = requests.get(
+                f"{api.url}/api/v1/traces/{tid}", timeout=10
+            ).json()
+            assert doc["log_counts"] == {sid: 1, "": 1}
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_sse_tail_streams_new_lines(self, fresh_logship):
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            got = []
+
+            def consume():
+                with requests.get(
+                    f"{api.url}/api/v1/logs/tail?target=tailed",
+                    stream=True, timeout=30,
+                ) as r:
+                    assert r.headers["Content-Type"].startswith(
+                        "text/event-stream"
+                    )
+                    for raw in r.iter_lines(chunk_size=1):
+                        if raw.startswith(b"data: "):
+                            got.append(json.loads(raw[6:]))
+                            return
+
+            th = threading.Thread(target=consume, daemon=True)
+            th.start()
+            time.sleep(0.8)  # the tail must deliver lines ingested AFTER open
+            master.logstore.ingest([_line("tailed", "live line")])
+            th.join(timeout=15)
+            assert [g["message"] for g in got] == ["live line"]
+        finally:
+            api.stop()
+            master.shutdown()
+
+
+class TestTaskLogsHardening:
+    def test_search_malformed_numeric_params_answer_400(self):
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            base = f"{api.url}/api/v1/task_logs/search?task_id=t-1"
+            for q in ("rank=junk", "since=junk", "until=junk",
+                      "limit=junk"):
+                r = requests.get(f"{base}&{q}", timeout=10)
+                assert r.status_code == 400, (q, r.status_code)
+                assert "must be a number" in r.json()["error"]
+            assert requests.get(
+                f"{base}&rank=0&limit=5", timeout=10
+            ).status_code == 200
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_search_skips_flush_barrier_when_sink_settled(self):
+        """An already-settled ES sink must not charge every search the
+        2 s flush barrier; an unsettled one still drains before reading."""
+
+        class _FakeSink:
+            def __init__(self):
+                self.flushes = []
+                self.queue_empty = True
+
+            def settled(self):
+                return self.queue_empty
+
+            def flush(self, timeout=None):
+                self.flushes.append(timeout)
+                self.queue_empty = True
+
+            def search(self, task_id, **kw):
+                return []
+
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            sink = master.log_sink = _FakeSink()
+            url = f"{api.url}/api/v1/task_logs/search?task_id=t-1"
+            out = requests.get(url, timeout=10).json()
+            assert out["backend"] == "elastic"
+            assert sink.flushes == []  # settled queue: no barrier paid
+            sink.queue_empty = False
+            requests.get(url, timeout=10)
+            assert sink.flushes == [2.0]  # queued lines: drained first
+        finally:
+            master.log_sink = None
+            api.stop()
+            master.shutdown()
+
+    def test_task_log_db_trim_age_and_rowcap_counted(self):
+        from determined_tpu.master.db import Database
+
+        db = Database(":memory:", batch_writes=False)
+        try:
+            now = time.time()
+            db.add_task_logs("t-old", [
+                {"ts": now - 1000, "log": f"old {i}\n"} for i in range(5)
+            ])
+            db.add_task_logs("t-new", [
+                {"ts": now, "log": f"new {i}\n"} for i in range(10)
+            ])
+            before_age = _counter(
+                "dtpu_task_log_rows_trimmed_total", reason="age"
+            )
+            before_rows = _counter(
+                "dtpu_task_log_rows_trimmed_total", reason="rows"
+            )
+            removed = db.trim_task_logs(
+                max_age_s=500.0, max_rows=6, now=now
+            )
+            assert removed == 9  # 5 by age, then 4 oldest over the cap
+            assert _counter(
+                "dtpu_task_log_rows_trimmed_total", reason="age"
+            ) == before_age + 5
+            assert _counter(
+                "dtpu_task_log_rows_trimmed_total", reason="rows"
+            ) == before_rows + 4
+            assert db.get_task_logs("t-old") == []
+            kept = db.get_task_logs("t-new")
+            assert [r["log"] for r in kept] == \
+                [f"new {i}\n" for i in range(4, 10)]
+            # knob 0 disables a bound
+            assert db.trim_task_logs(max_age_s=0, max_rows=0) == 0
+        finally:
+            db.close()
+
+    def test_master_tick_wires_trim_knobs(self):
+        master = Master(logs_config={
+            "task_log_retention_s": 123.0, "task_log_max_rows": 456,
+        })
+        try:
+            assert master._logs_cfg["task_log_retention_s"] == 123.0
+            assert master._logs_cfg["task_log_max_rows"] == 456
+        finally:
+            master.shutdown()
+
+
+class TestFaultDrills:
+    def test_client_log_ship_fault_drill(self, fresh_logship):
+        """client.log_ship drills line loss: the batch is counted lost,
+        the shipper survives, the logging path never raises, and a batch
+        after the site heals lands."""
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            shipper = logship.LogShipper(
+                api.url, flush_interval_s=3600.0, batch_size=10_000,
+            )
+            handler = logship.StructuredLogHandler(
+                "drilled", shipper=shipper,
+            )
+            lg = logging.getLogger("dtpu.test.drill")
+            lg.setLevel(logging.INFO)
+            lg.propagate = False
+            lg.addHandler(handler)
+            try:
+                before = _counter(
+                    "dtpu_log_lines_dropped_total", reason="ship_failed"
+                )
+                plan = faults.FaultPlan(
+                    {"client.log_ship": faults.FaultSpec(failures=1)}
+                )
+                with faults.plan_active(plan):
+                    lg.info("lost line")       # never blocks, never raises
+                    shipper.flush()            # injected failure: lost
+                    lg.info("healed line")
+                    shipper.flush()            # site healed: lands
+                assert _counter(
+                    "dtpu_log_lines_dropped_total", reason="ship_failed"
+                ) == before + 1
+                rows = master.logstore.query(
+                    labels={"target": "drilled"}
+                )
+                assert [r["message"] for r in rows] == ["healed line"]
+            finally:
+                lg.removeHandler(handler)
+                handler.close()
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_master_log_ingest_fault_drill(self, fresh_logship):
+        """master.log_ingest failing answers 500 to the shipper (loss
+        counted client-side), neighboring routes stay healthy, and the
+        master's OWN in-process sink path keeps working mid-drill (the
+        fault site is the HTTP ingest, not the store)."""
+        master = Master()
+        api = ApiServer(master)
+        api.start()
+        try:
+            shipper = logship.LogShipper(
+                api.url, flush_interval_s=3600.0, batch_size=10_000,
+            )
+            try:
+                before = _counter(
+                    "dtpu_log_lines_dropped_total", reason="ship_failed"
+                )
+                plan = faults.FaultPlan(
+                    {"master.log_ingest": faults.FaultSpec(failures=1)}
+                )
+                with faults.plan_active(plan):
+                    resp = requests.post(
+                        f"{api.url}/api/v1/logs/ingest",
+                        json={"lines": []}, timeout=10,
+                    )
+                    assert resp.status_code == 500
+                    assert requests.get(
+                        f"{api.url}/api/v1/master", timeout=10
+                    ).status_code == 200
+                    # in-process sink unaffected by the HTTP fault site
+                    logging.getLogger("determined_tpu.master").warning(
+                        "mid-drill master line"
+                    )
+                assert master.logstore.query(
+                    substring="mid-drill master line"
+                )
+                shipper.enqueue(_line("after-heal", "ships now"))
+                shipper.flush()
+                assert _counter(
+                    "dtpu_log_lines_dropped_total", reason="ship_failed"
+                ) == before
+                assert master.logstore.query(
+                    labels={"target": "after-heal"}
+                )
+            finally:
+                shipper.stop(flush=False)
+        finally:
+            api.stop()
+            master.shutdown()
+
+
+class _WebhookSink:
+    """Local HTTP receiver recording alert webhook deliveries."""
+
+    def __init__(self):
+        self.payloads = []
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                outer.payloads.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self._httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}/hook"
+        threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        ).start()
+
+    def of(self, name, state):
+        return [
+            p for p in self.payloads
+            if p.get("event") == "alert" and p.get("alert") == name
+            and p.get("state") == state
+        ]
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class TestLogErrorBurstAlert:
+    """Acceptance: the shipped log_error_burst rule fires EXACTLY once
+    through the real webhook shipper when an ERROR burst folds through
+    ingest → dtpu_log_lines_total → self-scrape → TSDB → alert engine,
+    and resolves when the burst ends."""
+
+    def test_fires_once_and_resolves(self):
+        sink = _WebhookSink()
+        master = Master()
+        try:
+            # Synthetic clock only: no real-time sweeps interleaved.
+            master.scraper.interval_s = math.inf
+            master.alert_engine.interval_s = math.inf
+            master.db.add_webhook(sink.url, ["ALERT"])
+
+            def my(alerts):
+                return [a for a in alerts
+                        if a["rule"] == "log_error_burst"
+                        and a["labels"].get("target") == "bursting"]
+
+            # Healthy baseline: one ERROR is under the >10/60s threshold.
+            master.logstore.ingest([_line("bursting", "one-off",
+                                          level="ERROR")])
+            master.scraper.scrape_once(now=5000.0)
+            master.alert_engine.evaluate(now=5001.0)
+            assert not my(master.alert_engine.active())
+
+            # The burst: a crash-looping fleet's 30 ERROR lines.
+            master.logstore.ingest([
+                _line("bursting", f"boom {i}", level="ERROR")
+                for i in range(30)
+            ])
+            master.scraper.scrape_once(now=5030.0)
+            master.alert_engine.evaluate(now=5031.0)
+            firing = my(master.alert_engine.active())
+            assert firing and firing[0]["state"] == "firing"
+            assert firing[0]["severity"] == "warning"
+            # Repeat evaluation while still firing: DEDUPED.
+            master.alert_engine.evaluate(now=5032.0)
+            deadline = time.time() + 15
+            while (not sink.of("log_error_burst", "firing")
+                   and time.time() < deadline):
+                time.sleep(0.05)
+            assert len(sink.of("log_error_burst", "firing")) == 1
+
+            # Recovery: no new ERRORs; the 60s window slides past the
+            # burst and the instance resolves — exactly one notification.
+            master.scraper.scrape_once(now=5100.0)
+            master.scraper.scrape_once(now=5155.0)
+            master.scraper.scrape_once(now=5160.0)
+            master.alert_engine.evaluate(now=5161.0)
+            assert not my(master.alert_engine.active())
+            deadline = time.time() + 15
+            while (not sink.of("log_error_burst", "resolved")
+                   and time.time() < deadline):
+                time.sleep(0.05)
+            assert len(sink.of("log_error_burst", "firing")) == 1
+            assert len(sink.of("log_error_burst", "resolved")) == 1
+        finally:
+            master.shutdown()
+            sink.stop()
+
+
+class TestDevclusterE2E:
+    """Acceptance: a real devcluster trial's lifecycle trace resolves —
+    on the LIVE query surface — to structured log lines from at least
+    two process classes: the trial rank (shipped over HTTP from the
+    subprocess) and the master (in-process sink, request-span context),
+    in the SAME trace."""
+
+    CONFIG = {
+        "entrypoint": "determined_tpu.exec.builtin_trials:SyntheticTrial",
+        "searcher": {"name": "single", "max_length": 2, "metric": "loss"},
+        "hyperparameters": {
+            "model": "mnist-mlp", "batch_size": 8,
+            "lr": {"type": "log", "minval": -3, "maxval": -1},
+        },
+        "resources": {"slots_per_trial": 1},
+        "scheduling_unit": 1,
+        "environment": {"jax_platform": "cpu"},
+    }
+
+    def test_trace_resolves_to_lines_from_both_process_classes(
+        self, tmp_path, fresh_logship
+    ):
+        from determined_tpu.devcluster import DevCluster
+
+        with DevCluster(n_agents=1, slots_per_agent=1) as dc:
+            sess = dc.session()
+            root_trace = sess._trace_root[0]
+            cfg = dict(self.CONFIG)
+            cfg["checkpoint_storage"] = {
+                "type": "shared_fs", "host_path": str(tmp_path / "ckpt"),
+            }
+            exp_id = sess.post(
+                "/api/v1/experiments", json_body={"config": cfg}
+            )["id"]
+            assert dc.wait_experiment(exp_id, timeout=240) == "COMPLETED"
+
+            # The trial subprocess flushed its shipper on harness exit;
+            # poll the LIVE query surface until the trace answers with
+            # lines from both classes.
+            deadline = time.time() + 30
+            classes = set()
+            rows = []
+            while time.time() < deadline:
+                rows = requests.get(
+                    f"{dc.api.url}/api/v1/logs/query?trace={root_trace}",
+                    timeout=10,
+                ).json()["logs"]
+                classes = {
+                    "trial" if r["target"].startswith("trial:")
+                    else r["target"]
+                    for r in rows
+                }
+                if {"trial", "master"} <= classes:
+                    break
+                time.sleep(1.0)
+            assert {"trial", "master"} <= classes, (classes, rows)
+
+            # the deterministic lines each class contributes
+            trial_lines = [r for r in rows
+                           if r["target"].startswith("trial:")]
+            assert any("entering fit" in r["message"]
+                       for r in trial_lines), trial_lines
+            assert any(r["labels"].get("experiment") == str(exp_id)
+                       for r in trial_lines), trial_lines
+            master_lines = [r for r in rows if r["target"] == "master"]
+            assert any("searcher op completed" in r["message"]
+                       for r in master_lines), master_lines
+            # correlation the other way: the stored trace's answer
+            # carries per-span line counts covering what we just queried
+            doc = requests.get(
+                f"{dc.api.url}/api/v1/traces/{root_trace}", timeout=10
+            ).json()
+            assert doc["log_counts"]
+            assert sum(doc["log_counts"].values()) >= len(rows)

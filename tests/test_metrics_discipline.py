@@ -538,6 +538,96 @@ class TestProfilePlaneRoutes:
             master.shutdown()
 
 
+class TestLogPlaneRoutes:
+    """PR 13 satellite: the log plane's routes ride the SAME instrumented
+    dispatch path (histogram+span per route via the sweep above) — this
+    pins their existence and the store's by-construction bounds under a
+    hostile log flood + label-cardinality attack, with the overflow
+    accounting read off the LIVE /metrics surface."""
+
+    def test_log_routes_registered_on_the_dispatch_path(self):
+        master = Master()
+        try:
+            patterns = {
+                (method, pattern.pattern)
+                for method, pattern, _h in build_routes(master)
+            }
+        finally:
+            master.shutdown()
+        assert ("POST", r"^/api/v1/logs/ingest$") in patterns
+        assert ("GET", r"^/api/v1/logs/query$") in patterns
+        assert ("GET", r"^/api/v1/logs/tail$") in patterns
+
+    def test_store_bounded_under_flood_and_cardinality_attack(self):
+        """Line-flood one target + a target-cardinality attack through
+        the MASTER's configured store: every cap holds, the overflow is
+        counted, and the accounting is read off the live /metrics page
+        (not the store's internals)."""
+        import time as _time
+
+        master = Master(logs_config={
+            "max_lines": 60, "max_lines_per_target": 25, "max_targets": 8,
+        })
+        api = ApiServer(master)
+        api.start()
+        try:
+            store = master.logstore
+            now = _time.time()
+
+            def line(target, i):
+                return {"ts": now + i * 1e-3, "level": "INFO",
+                        "message": f"flood {i}", "target": target}
+
+            # line flood on one target: per-target cap evicts oldest
+            store.ingest([line("attacker", i) for i in range(100)], now=now)
+            # fill the rest of the namespace, pushing past the global cap
+            for t in range(6):
+                store.ingest(
+                    [line(f"t{t}", i) for i in range(20)], now=now
+                )
+            # cardinality attack: 50 NOVEL targets. Most lose THEIR
+            # lines; a global-cap eviction that empties a flood bucket
+            # frees a slot, so up to max_targets attackers are admitted
+            # — the cap still holds either way, held targets untouched.
+            before = sample_value(
+                parse_exposition(
+                    requests.get(f"{api.url}/metrics", timeout=30).text
+                ),
+                "dtpu_log_lines_dropped_total",
+                reason="target_cardinality",
+            ) or 0.0
+            for t in range(50):
+                store.ingest([line(f"evil{t}", 0)], now=now)
+            st = store.stats()
+            assert st["lines"] <= 60
+            assert st["targets"] <= 8
+            text = requests.get(f"{api.url}/metrics", timeout=30).text
+            samples = parse_exposition(text)
+            assert sample_value(
+                samples, "dtpu_log_store_lines_evicted_total",
+                reason="target_cap",
+            ) > 0
+            assert sample_value(
+                samples, "dtpu_log_store_lines_evicted_total",
+                reason="global_cap",
+            ) > 0
+            dropped = sample_value(
+                samples, "dtpu_log_lines_dropped_total",
+                reason="target_cardinality",
+            ) - before
+            assert 50 - 8 <= dropped <= 50, dropped
+            assert sample_value(samples, "dtpu_log_store_lines") <= 60
+            assert sample_value(samples, "dtpu_log_store_targets") <= 8
+            # the per-level fold the TSDB self-scrape carries
+            assert sample_value(
+                samples, "dtpu_log_lines_total",
+                target="attacker", level="INFO",
+            ) > 0
+        finally:
+            api.stop()
+            master.shutdown()
+
+
 class TestNameDiscipline:
     def test_all_registered_names_are_dtpu_prefixed(self):
         # Importing the instrumented modules populates the registry.

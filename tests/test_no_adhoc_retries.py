@@ -283,6 +283,105 @@ def test_rendezvous_lint_actually_detects_a_violation(tmp_path):
     assert _rendezvous_violations_in_file(str(good), "z.py") == []
 
 
+# ---------------------------------------------------------------------------
+# Structured-logging discipline: the control plane (master/, agent/,
+# serving/) must not `print(`. A bare print bypasses every log surface at
+# once — no level, no logger name, no task-log capture, and (PR 13) no
+# structured-log shipping, so the line is invisible to `dtpu logs query`
+# and uncorrelatable to any trace. Route it through `logging` instead.
+# A module's `if __name__ == "__main__":` block is exempt (a CLI entry
+# printing its output IS the interface — expconf's reference generator);
+# a deliberate exception elsewhere carries `# print-ok: <reason>`.
+# ---------------------------------------------------------------------------
+NO_PRINT_SUBTREES = ("master", "agent", "serving")
+
+PRINT_WAIVER = "# print-ok:"
+
+
+def _is_main_guard(node: ast.stmt) -> bool:
+    """`if __name__ == "__main__":` (either operand order)."""
+    if not isinstance(node, ast.If):
+        return False
+    t = node.test
+    if not isinstance(t, ast.Compare) or len(t.comparators) != 1:
+        return False
+    sides = [t.left, t.comparators[0]]
+    return (
+        any(isinstance(s, ast.Name) and s.id == "__name__" for s in sides)
+        and any(
+            isinstance(s, ast.Constant) and s.value == "__main__"
+            for s in sides
+        )
+    )
+
+
+def _print_violations_in_file(path: str):
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=path)
+    body = [n for n in tree.body if not _is_main_guard(n)]
+    out = []
+    for top in body:
+        for sub in ast.walk(top):
+            if not (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Name)
+                    and sub.func.id == "print"):
+                continue
+            line = lines[sub.lineno - 1]
+            if PRINT_WAIVER in line:
+                continue
+            out.append(f"{path}:{sub.lineno}: {line.strip()}")
+    return out
+
+
+def test_no_bare_print_in_control_plane():
+    violations = []
+    for sub in NO_PRINT_SUBTREES:
+        root = os.path.join(PKG_ROOT, sub)
+        for dirpath, _, filenames in os.walk(root):
+            for name in filenames:
+                if name.endswith(".py"):
+                    violations.extend(
+                        _print_violations_in_file(
+                            os.path.join(dirpath, name)
+                        )
+                    )
+    assert not violations, (
+        "bare print( in master//agent//serving/ — use the logging module "
+        "(levels, task-log capture, and structured-log shipping all hang "
+        "off it), or annotate a deliberate exception with "
+        f"'{PRINT_WAIVER} <reason>':\n" + "\n".join(violations)
+    )
+
+
+def test_print_lint_actually_detects_a_violation(tmp_path):
+    """The print linter must not rot: a bare print is flagged; prints in
+    a __main__ guard, waived prints, a print-in-a-string, and a method
+    named print are not."""
+    bad = tmp_path / "bad_print.py"
+    bad.write_text(
+        "def f(x):\n"
+        "    print('state:', x)\n"
+    )
+    assert len(_print_violations_in_file(str(bad))) == 1
+
+    good = tmp_path / "good_print.py"
+    good.write_text(
+        "import logging\n"
+        "logger = logging.getLogger('x')\n"
+        "PLACEHOLDER = 'python -c \"print(42)\"'\n"
+        "def f(x, obj):\n"
+        "    logger.info('state: %s', x)\n"
+        "    obj.print(x)\n"
+        "def g(x):\n"
+        "    print(x)  # print-ok: test fixture\n"
+        "if __name__ == '__main__':\n"
+        "    print(f(1, None))\n"
+    )
+    assert _print_violations_in_file(str(good)) == []
+
+
 def test_lint_actually_detects_a_violation(tmp_path):
     """The linter itself must not rot: a textbook bare retry loop is
     flagged, a policy-driven one is not."""
