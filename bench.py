@@ -649,6 +649,135 @@ def serving_rung(on_tpu: bool):
         return None
 
 
+def serving_fleet_rung(on_tpu: bool):
+    """Fleet bench rung (PR 14): TWO prefix-cache-enabled serving
+    replicas behind the master's cache-aware router, driven with the
+    zipfian shared-prefix workload (the few-hot-system-prompts shape) —
+    publishing pool-aggregate tokens/sec, p99 TTFT, the fleet's prefix-
+    cache hit rate, and the cache-on vs cache-off TTFT delta over the
+    IDENTICAL request list (seeded loadgen)."""
+    try:
+        from determined_tpu.master.api_server import ApiServer
+        from determined_tpu.master.core import Master
+        from determined_tpu.models import gpt as gpt_mod
+        from determined_tpu.serving import GenerationEngine, ServingConfig
+        from determined_tpu.serving.loadgen import drive, zipf_prefix_prompts
+        from determined_tpu.serving.service import GenerationServer
+
+        if on_tpu:
+            model = gpt_mod.GPT(GPTConfig(remat=False))  # GPT-2 small
+            skw = dict(
+                model="small", page_size=128, num_pages=129,
+                max_pages_per_request=8, max_batch_size=8,
+                prefill_rows=4, prefill_seq=512, max_new_tokens=128,
+                max_queue_depth=64,
+            )
+            n_req, conc, m_new = 16, 8, 32
+            corpus, p_len, s_len = 4, 256, 16
+        else:
+            model = gpt_mod.GPT(GPTConfig(
+                vocab_size=1024, n_layers=2, n_heads=4, d_model=128,
+                d_ff=512, seq_len=256, remat=False,
+            ))
+            skw = dict(
+                page_size=16, num_pages=65, max_pages_per_request=4,
+                max_batch_size=8, prefill_rows=4, prefill_seq=64,
+                max_new_tokens=32, max_queue_depth=64,
+            )
+            n_req, conc, m_new = 8, 4, 8
+            corpus, p_len, s_len = 3, 32, 4
+        params = model.init(jax.random.PRNGKey(0))
+        prompts = zipf_prefix_prompts(
+            n_req, corpus_size=corpus, prefix_len=p_len, suffix_len=s_len,
+            seed=7, vocab=min(200, skw.get("vocab_size", 200)),
+        )
+
+        def run_fleet(cache: str):
+            """One 2-replica fleet pass; returns (report, hit_rate)."""
+            master = Master(router_config={
+                "block_tokens": skw["page_size"], "spill_queue_depth": 0.0,
+            })
+            api = ApiServer(master)
+            api.start()
+            engines, servers = [], []
+            try:
+                for i in (1, 2):
+                    eng = GenerationEngine(
+                        model, params,
+                        ServingConfig(**skw, prefix_cache=cache),
+                    )
+                    eng.start()
+                    srv = GenerationServer(eng)
+                    srv.start()
+                    engines.append(eng)
+                    servers.append(srv)
+                    tid, alloc = f"bench-serving-{i}", f"bench.{i}.0"
+                    master._commands[tid] = {
+                        "task_id": tid, "alloc_id": alloc,
+                        "task_type": "SERVING", "state": "RUNNING",
+                        "config": {},
+                    }
+                    master._alloc_pool[alloc] = "default"
+                    master.proxy.register(tid, "127.0.0.1", srv.port)
+                # warmup: compile prefill+decode on both replicas,
+                # outside the timed run (round-robin by whole-prompt
+                # hash covers both with distinct short prompts)
+                drive(api.url, 4, 4, prompt_len=8,
+                      max_new_tokens=2, timeout_s=600.0)
+                report = drive(
+                    api.url, n_req, conc, max_new_tokens=m_new,
+                    timeout_s=600.0, prompts=prompts,
+                )
+                looked = sum(
+                    e.prefix_cache.hits + e.prefix_cache.misses
+                    for e in engines if e.prefix_cache is not None
+                )
+                hits = sum(
+                    e.prefix_cache.hits
+                    for e in engines if e.prefix_cache is not None
+                )
+                return report, (hits / looked if looked else 0.0)
+            finally:
+                for s in servers:
+                    s.stop()
+                for e in engines:
+                    e.stop()
+                api.stop()
+                master.shutdown()
+
+        report_on, hit_rate = run_fleet("on")
+        report_off, _ = run_fleet("off")
+        out = {
+            "serving_fleet_replicas": 2,
+            "serving_fleet_requests": len(report_on.traces),
+            "serving_fleet_completed": report_on.completed,
+            "serving_fleet_tokens_per_sec": round(
+                report_on.tokens_per_sec, 2
+            ),
+            "serving_fleet_p50_ttft_ms": round(
+                report_on.ttft_percentile_ms(50), 3
+            ),
+            "serving_fleet_p99_ttft_ms": round(
+                report_on.ttft_percentile_ms(99), 3
+            ),
+            "serving_prefix_cache_hit_rate": round(hit_rate, 4),
+            # negative delta = the cache cut TTFT (prefill skipped on hits)
+            "serving_prefix_cache_ttft_delta_p50_ms": round(
+                report_on.ttft_percentile_ms(50)
+                - report_off.ttft_percentile_ms(50), 3
+            ),
+            "serving_fleet_p50_ttft_ms_cache_off": round(
+                report_off.ttft_percentile_ms(50), 3
+            ),
+        }
+        return out
+    except Exception:  # noqa: BLE001 — skip the rung, keep the headline
+        import traceback
+
+        traceback.print_exc()
+        return None
+
+
 def timeseries_rung():
     """Time-series plane rung (PR 9): TSDB ingest throughput through the
     strict parser (the real scrape path), query p99 latency at FULL
@@ -1254,6 +1383,13 @@ def main() -> None:
         sr = serving_rung(on_tpu)
         if sr is not None:
             record.update(sr)
+        # Fleet rung (PR 14): 2 replicas behind the master's cache-aware
+        # router under the zipfian shared-prefix workload — aggregate
+        # tokens/sec, p99 TTFT, prefix-cache hit rate, and the
+        # cache-on/off TTFT delta over the identical request list.
+        fr = serving_fleet_rung(on_tpu)
+        if fr is not None:
+            record.update(fr)
     if not os.environ.get("DTPU_BENCH_SKIP_TSDB"):
         # Time-series plane (PR 9): ingest throughput, query p99 at full
         # retention, and scrape+alert overhead per master tick (<1%).

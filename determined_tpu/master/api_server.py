@@ -339,6 +339,21 @@ class _EventStream(Exception):
         self.gen = gen
 
 
+class _RawStream(Exception):
+    """Control-flow: handler responds with a verbatim streamed body from
+    a backend (the router's generate pass-through — SSE or JSON alike).
+    Unlike _EventStream the dispatcher does not frame events; `chunks`
+    are raw bytes relayed unbuffered, status/headers are the backend's."""
+
+    def __init__(
+        self, status: int, headers: Dict[str, str], chunks: Any
+    ) -> None:
+        super().__init__("raw stream")
+        self.status = status
+        self.headers = headers
+        self.chunks = chunks
+
+
 class ApiRequest:
     def __init__(
         self,
@@ -1374,6 +1389,67 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         m.kill_command(r.groups[0])
         return {}
 
+    # -- serving-fleet router ----------------------------------------------------
+    def fleet_generate(r: ApiRequest):
+        """POST /api/v1/generate — cache-aware fan-out over the RUNNING
+        SERVING replicas (master/router.py): consistent-hash on the
+        prompt's leading page hash, load spill, shed-aware failover
+        (once, within the request deadline). The replica's response —
+        SSE token stream or buffered JSON — passes through verbatim."""
+        from determined_tpu.master.router import NoReplicas
+
+        body = r.body
+        # The route key needs the token stream the REPLICA will see:
+        # same extraction rules as serving/service.py tokenize().
+        if "prompt" in body:
+            prompt = body["prompt"]
+            if not isinstance(prompt, list) or not all(
+                isinstance(t, int) and not isinstance(t, bool)
+                for t in prompt
+            ):
+                raise ApiError(400, "prompt must be a list of token ids")
+        elif "text" in body:
+            if not isinstance(body["text"], str):
+                raise ApiError(400, "text must be a string")
+            prompt = list(body["text"].encode("utf-8"))
+        else:
+            raise ApiError(
+                400, "body must carry prompt (token ids) or text"
+            )
+        deadline_ms = body.get("deadline_ms")
+        if deadline_ms is not None and (
+            isinstance(deadline_ms, bool)
+            or not isinstance(deadline_ms, (int, float))
+        ):
+            raise ApiError(400, "deadline_ms must be a number")
+        pool = body.get("resource_pool")
+        if pool is not None and not isinstance(pool, str):
+            raise ApiError(400, "resource_pool must be a string")
+        fwd_headers = {"Content-Type": "application/json"}
+        tp = r.headers.get("traceparent")
+        if tp:
+            fwd_headers["traceparent"] = tp
+        try:
+            status, headers, chunks, _replica = m.router.dispatch(
+                prompt, r.raw, fwd_headers, pool=pool,
+                deadline_s=(
+                    float(deadline_ms) / 1e3
+                    if deadline_ms is not None else None
+                ),
+            )
+        except NoReplicas as e:
+            raise ApiError(503, str(e))
+        raise _RawStream(status, headers, chunks)
+
+    def cluster_stats(r: ApiRequest):
+        """GET /api/v1/stats — fleet snapshot: the router's recent
+        routing decisions/in-flight accounting plus the routable
+        replica set."""
+        return {
+            "router": m.router.stats(),
+            "replicas": m.router.replicas(r.q("pool")),
+        }
+
     # -- model registry ---------------------------------------------------------
     def create_model(r: ApiRequest):
         m.db.add_model(
@@ -2021,6 +2097,8 @@ def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
         R("POST", r"/api/v1/commands", create_command),
         R("GET", r"/api/v1/commands", list_commands),
         R("POST", r"/api/v1/commands/([\w.\-]+)/kill", kill_command),
+        R("POST", r"/api/v1/generate", fleet_generate),
+        R("GET", r"/api/v1/stats", cluster_stats),
         R("POST", r"/api/v1/models", create_model),
         R("GET", r"/api/v1/models", list_models),
         R("GET", r"/api/v1/models/([\w.\-]+)/versions", list_model_versions),
@@ -2435,6 +2513,44 @@ class ApiServer:
                                 pass  # viewer closed the tab
                             finally:
                                 es.gen.close()
+                        except _RawStream as rs:
+                            # Verbatim backend pass-through (router
+                            # generate): same unbuffered relay contract
+                            # as _proxy — chunks reach the client as the
+                            # replica produces them, observed at stream
+                            # start like every open-ended response.
+                            span.set_attribute("http.stream", True)
+                            finish(rs.status)
+                            expected = next(
+                                (int(v) for k, v in rs.headers.items()
+                                 if k.lower() == "content-length"
+                                 and v.isdigit()),
+                                None,
+                            )
+                            sent = 0
+                            try:
+                                self.send_response(rs.status)
+                                for k, v in rs.headers.items():
+                                    self.send_header(k, v)
+                                if expected is None:
+                                    self.send_header("Connection", "close")
+                                    self.close_connection = True
+                                self.end_headers()
+                                for chunk in rs.chunks:
+                                    self.wfile.write(chunk)
+                                    self.wfile.flush()
+                                    sent += len(chunk)
+                            except (BrokenPipeError, ConnectionResetError,
+                                    OSError):
+                                pass  # client went away mid-stream
+                            finally:
+                                if expected is not None and sent != expected:
+                                    # Advertised length undelivered:
+                                    # reuse would desync — tear down.
+                                    self.close_connection = True
+                                close = getattr(rs.chunks, "close", None)
+                                if close is not None:
+                                    close()
                         except (BrokenPipeError, ConnectionResetError):
                             # Long-poll client went away (e.g. task exited
                             # mid-response); nothing to answer.

@@ -407,6 +407,7 @@ class Master:
         traces_config: Optional[Dict[str, Any]] = None,
         profiling_config: Optional[Dict[str, Any]] = None,
         logs_config: Optional[Dict[str, Any]] = None,
+        router_config: Optional[Dict[str, Any]] = None,
     ) -> None:
         # Validated config tier (masterconf.py, the config.go:129 analog):
         # fail at boot with every problem named, not mid-scheduling on the
@@ -422,6 +423,7 @@ class Master:
             traces=traces_config,
             profiling=profiling_config,
             logs=logs_config,
+            router=router_config,
         )
         self.cluster_id = uuid.uuid4().hex[:8]
         self._external_url = external_url
@@ -584,6 +586,15 @@ class Master:
             interval_s=float(mcfg["scrape_interval_s"]),
             timeout_s=float(mcfg["scrape_timeout_s"]),
         )
+        # Serving-fleet router (master/router.py): POST /api/v1/generate
+        # consistent-hashes each request's leading page hash onto the
+        # RUNNING serving replicas so prefix families land where their
+        # cache lives; the TSDB above supplies the load tie-break.
+        from determined_tpu.master.router import Router
+
+        rcfg = dict(masterconf.ROUTER_DEFAULTS)
+        rcfg.update(router_config or {})
+        self.router = Router(self, rcfg)
         acfg = dict(masterconf.ALERTS_DEFAULTS)
         acfg.update(alerts_config or {})
         self.alert_engine = AlertEngine(

@@ -633,6 +633,16 @@ FIELDS: List[Tuple[str, str, str, str]] = [
      "lane granule); `gather` reproduces the contiguous-K/V behavior "
      "everywhere. DTPU_PAGED_ATTN=0 is the runtime kill switch. See "
      "docs/serving.md 'Paged attention'."),
+    ("serving.prefix_cache", "string", "on",
+     "Radix-tree prefix cache over retired KV pages: admissions that "
+     "share a leading page-aligned token prefix with an earlier request "
+     "map those pages out of the cache and skip their prefill (zero "
+     "recompute for the hit span). Cached pages are refcounted — evicted "
+     "leaf-first LRU only under pool pressure, before any admission "
+     "fails on pool exhaustion. `off` disables lookup and retention. "
+     "The master's fleet router keys on the same leading-page hash so "
+     "same-prefix requests land on the replica holding the prefix. See "
+     "docs/serving.md 'Prefix cache & fleet routing'."),
     ("environment.variables", "object", "{}",
      "Extra environment variables for the task process."),
     ("environment.jax_platform", "string", "",

@@ -87,6 +87,46 @@ LOGS_DEFAULTS = {
 
 _LOG_LEVELS = ("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL")
 
+#: Serving-fleet router knobs (`router:` section): the master-side half
+#: of the prefix-cache story (master/router.py; docs/serving.md "Prefix
+#: cache & fleet routing" documents each row).
+ROUTER_DEFAULTS = {
+    "virtual_nodes": 32,      # ring points per replica (consistent hash)
+    "block_tokens": 128,      # route-key block size — MUST match the
+                              # fleet's serving.page_size for the ring key
+                              # to equal the replicas' radix-tree key
+    "spill_queue_depth": 4.0,  # load gap (queue+occupancy+inflight) past
+                               # which the sticky pick spills to the
+                               # least-loaded replica
+}
+
+
+def validate_router(cfg: Optional[Dict[str, Any]]) -> List[str]:
+    errors: List[str] = []
+    if cfg is None:
+        return errors
+    if not isinstance(cfg, dict):
+        return ["router must be an object of serving-router knobs"]
+    for key, value in cfg.items():
+        if key not in ROUTER_DEFAULTS:
+            errors.append(
+                f"router: unknown key {key!r} "
+                f"(one of: {', '.join(sorted(ROUTER_DEFAULTS))})"
+            )
+            continue
+        if key in ("virtual_nodes", "block_tokens"):
+            if not isinstance(value, int) or isinstance(value, bool) \
+                    or value < 1:
+                errors.append(f"router.{key} must be an int >= 1")
+        elif key == "spill_queue_depth":
+            if not isinstance(value, (int, float)) or isinstance(value, bool) \
+                    or value < 0:
+                errors.append(
+                    "router.spill_queue_depth must be a number >= 0 "
+                    "(0 disables the load spill)"
+                )
+    return errors
+
 
 def validate_metrics(cfg: Optional[Dict[str, Any]]) -> List[str]:
     errors: List[str] = []
@@ -299,6 +339,7 @@ def validate(
     traces: Optional[Dict[str, Any]] = None,
     profiling: Optional[Dict[str, Any]] = None,
     logs: Optional[Dict[str, Any]] = None,
+    router: Optional[Dict[str, Any]] = None,
 ) -> None:
     """Validate the master's startup configuration; raises ValueError with
     EVERY problem named (config.go-style: fail fast at boot, not at the
@@ -309,6 +350,7 @@ def validate(
     errors += validate_traces(traces)
     errors += validate_profiling(profiling)
     errors += validate_logs(logs)
+    errors += validate_router(router)
     if not isinstance(preempt_timeout_s, (int, float)) or (
         preempt_timeout_s <= 0
     ):

@@ -1,0 +1,341 @@
+"""Cache-aware serving-fleet router: the master's `POST /api/v1/generate`.
+
+One replica's prefix cache (serving/kv_cache.py) only pays off if the
+requests sharing a prefix actually LAND on that replica — round-robin
+over N replicas divides every prefix family's hit rate by N. The router
+closes the loop: it consistent-hashes the request's leading page-block
+chain hash (the SAME `prefix_block_hashes` the engine keys its radix
+tree on, over the same `block_tokens = page_size` geometry) onto the
+RUNNING SERVING replicas of a pool, so "same prefix → same replica"
+lines up exactly with "that replica holds the prefix".
+
+Sticky-first, load-second: the ring pick is only a preference. When the
+primary's load (scraped `dtpu_serving_queue_depth` +
+`dtpu_serving_batch_occupancy` from the master TSDB, plus the router's
+own in-flight count — fresher than any scrape) exceeds the least-loaded
+candidate by `router.spill_queue_depth`, the order re-sorts by load: a
+hot prefix family spills to warm a second replica instead of queueing
+behind itself forever.
+
+Shed-aware failover: a 503 (admission shed, Retry-After) or 502
+(replica unreachable) answer fails over to the next-best candidate
+exactly ONCE, bounded by the request's deadline — two sheds mean the
+fleet is saturated and the CLIENT should back off, not the master
+retry-storm. Fault site `master.route` makes a failed replica pick a
+drillable input: the poisoned pick is skipped and counted
+(`dtpu_router_requests_total{outcome="fault"}`), never silent.
+"""
+from __future__ import annotations
+
+import bisect
+import hashlib
+import logging
+import struct
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from determined_tpu.common import faults
+from determined_tpu.common.metrics import REGISTRY as METRICS
+from determined_tpu.serving.kv_cache import prefix_block_hashes
+
+logger = logging.getLogger("determined_tpu.master")
+
+ROUTER_REQUESTS = METRICS.counter(
+    "dtpu_router_requests_total",
+    "Routed generate attempts by replica and outcome: ok (replica "
+    "answered), shed (503 — failover candidate), error (unreachable), "
+    "fault (injected master.route pick failure, skipped).",
+    labels=("replica", "outcome"),
+)
+ROUTER_INFLIGHT = METRICS.gauge(
+    "dtpu_router_inflight",
+    "Generate requests currently streaming through the router per "
+    "replica (master-side accounting; fresher than any scrape).",
+    labels=("replica",),
+)
+ROUTER_FAILOVERS = METRICS.counter(
+    "dtpu_router_failovers_total",
+    "Requests that left their first-choice replica (shed/error/fault) "
+    "and were retried on the next-best candidate.",
+)
+
+#: The backend load gauges consulted for the spill tie-break, summed.
+LOAD_GAUGES = ("dtpu_serving_queue_depth", "dtpu_serving_batch_occupancy")
+
+
+class NoReplicas(Exception):
+    """No RUNNING SERVING replica (of the requested pool) is routable."""
+
+
+class _TrackedStream:
+    """Chunk iterator that releases the replica's in-flight slot exactly
+    once — at exhaustion, close(), or GC — whichever comes first."""
+
+    def __init__(self, router: "Router", replica: str, chunks) -> None:
+        self._router = router
+        self._replica = replica
+        self._chunks = chunks
+        self._open = True
+
+    def __iter__(self):
+        try:
+            for chunk in self._chunks:
+                yield chunk
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        if not self._open:
+            return
+        self._open = False
+        inner = getattr(self._chunks, "close", None)
+        if inner is not None:
+            inner()
+        self._router._release(self._replica)
+
+    def __del__(self):  # noqa: D105 — belt-and-braces for dropped streams
+        self.close()
+
+
+class Router:
+    """One per master; all methods are thread-safe (HTTP handler threads
+    call dispatch concurrently)."""
+
+    def __init__(self, master, config: Dict[str, Any]) -> None:
+        self.m = master
+        self.virtual_nodes = int(config["virtual_nodes"])
+        self.block_tokens = int(config["block_tokens"])
+        self.spill_queue_depth = float(config["spill_queue_depth"])
+        self._lock = threading.Lock()
+        self._inflight: Dict[str, int] = {}
+        #: ring memoized on the replica set — rebuilt only on join/leave.
+        self._ring_for: Tuple[Tuple[str, ...], List[Tuple[str, str]]] = (
+            (), []
+        )
+        self._requests = 0
+        self._failovers = 0
+        self._decisions: deque = deque(maxlen=16)
+
+    # -- replica discovery -----------------------------------------------------
+    def replicas(self, pool: Optional[str] = None) -> List[str]:
+        """RUNNING SERVING task ids with a registered proxy endpoint,
+        optionally filtered to one resource pool."""
+        out = []
+        for cmd in self.m.list_commands():
+            if cmd.get("task_type") != "SERVING":
+                continue
+            if cmd.get("state") != "RUNNING":
+                continue
+            if pool and self.m._alloc_pool.get(cmd["alloc_id"]) != pool:
+                continue
+            if self.m.proxy.target(cmd["task_id"]) is None:
+                continue
+            out.append(cmd["task_id"])
+        return sorted(out)
+
+    # -- the consistent-hash ring ----------------------------------------------
+    def route_key(self, prompt: Iterable[int]) -> str:
+        """The request's FIRST leading-page chain hash — every request of
+        a prefix family shares page 0, so one hash is the family id. A
+        prompt shorter than one block routes on its whole-token hash
+        (no family to be sticky to; spread these)."""
+        prompt = list(prompt)
+        heads = prefix_block_hashes(prompt, self.block_tokens, max_blocks=1)
+        if heads:
+            return heads[0]
+        return hashlib.sha256(
+            struct.pack(f"<{len(prompt)}q", *prompt) if prompt else b""
+        ).hexdigest()
+
+    def _ring(self, replicas: List[str]) -> List[Tuple[str, str]]:
+        key = tuple(replicas)
+        with self._lock:
+            if self._ring_for[0] == key:
+                return self._ring_for[1]
+        ring = sorted(
+            (hashlib.sha256(f"{r}#{v}".encode()).hexdigest(), r)
+            for r in replicas
+            for v in range(self.virtual_nodes)
+        )
+        with self._lock:
+            self._ring_for = (key, ring)
+        return ring
+
+    def load(self, task_id: str) -> float:
+        """Queue depth + batch occupancy from the last scrape, plus the
+        router's own in-flight count (covers the window between a burst
+        landing and the next scrape seeing it)."""
+        total = 0.0
+        tsdb = getattr(self.m, "tsdb", None)
+        if tsdb is not None:
+            for name in LOAD_GAUGES:
+                for sample in tsdb.instant(name, {"instance": task_id}):
+                    total += float(sample["value"])
+        with self._lock:
+            total += self._inflight.get(task_id, 0)
+        return total
+
+    def rank(
+        self, key: str, replicas: List[str]
+    ) -> Tuple[List[str], Dict[str, float]]:
+        """Candidates in ring order from `key`, re-sorted by load only
+        when the sticky pick is `spill_queue_depth` hotter than the best
+        alternative (hysteresis: mild imbalance keeps cache affinity)."""
+        replicas = sorted(replicas)
+        loads = {r: self.load(r) for r in replicas}
+        if len(replicas) <= 1:
+            return replicas, loads
+        ring = self._ring(replicas)
+        hashes = [h for h, _ in ring]
+        start = bisect.bisect_right(hashes, key) % len(ring)
+        order: List[str] = []
+        seen = set()
+        for j in range(len(ring)):
+            r = ring[(start + j) % len(ring)][1]
+            if r not in seen:
+                seen.add(r)
+                order.append(r)
+                if len(order) == len(replicas):
+                    break
+        if (
+            self.spill_queue_depth > 0
+            and loads[order[0]] - min(loads.values()) >= self.spill_queue_depth
+        ):
+            pos = {r: i for i, r in enumerate(order)}
+            order.sort(key=lambda r: (loads[r], pos[r]))
+        return order, loads
+
+    # -- in-flight accounting --------------------------------------------------
+    def _acquire(self, replica: str) -> None:
+        with self._lock:
+            self._inflight[replica] = self._inflight.get(replica, 0) + 1
+            n = self._inflight[replica]
+        ROUTER_INFLIGHT.labels(replica).set(n)
+
+    def _release(self, replica: str) -> None:
+        with self._lock:
+            n = max(0, self._inflight.get(replica, 0) - 1)
+            if n:
+                self._inflight[replica] = n
+            else:
+                self._inflight.pop(replica, None)
+        ROUTER_INFLIGHT.labels(replica).set(n)
+
+    # -- dispatch --------------------------------------------------------------
+    def dispatch(
+        self,
+        prompt: List[int],
+        raw_body: bytes,
+        headers: Dict[str, str],
+        pool: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+    ) -> Tuple[int, Dict[str, str], Any, str]:
+        """Route one generate request; returns (status, headers, chunk
+        iterator, replica). Raises NoReplicas when nothing is routable.
+
+        At most TWO forwards: the sticky pick and one failover on
+        shed/error — within the request deadline. An injected
+        `master.route` fault skips (and counts) a pick without spending
+        a forward."""
+        replicas = self.replicas(pool)
+        if not replicas:
+            raise NoReplicas(
+                "no running serving replicas"
+                + (f" in pool {pool!r}" if pool else "")
+            )
+        key = self.route_key(prompt)
+        order, loads = self.rank(key, replicas)
+        deadline = (
+            time.time() + float(deadline_s) if deadline_s else None
+        )
+        with self._lock:
+            self._requests += 1
+        attempts: List[Tuple[str, str]] = []
+        forwards = 0
+        for replica in order:
+            if forwards >= 2:
+                break
+            if attempts and deadline is not None and time.time() >= deadline:
+                break
+            try:
+                faults.inject("master.route")
+            except faults.InjectedFault as e:
+                # The pick failed, not the replica: skip it, counted.
+                logger.warning(
+                    "router: injected pick failure for %s: %s", replica, e
+                )
+                ROUTER_REQUESTS.labels(replica, "fault").inc()
+                attempts.append((replica, "fault"))
+                continue
+            if attempts:
+                ROUTER_FAILOVERS.inc()
+                with self._lock:
+                    self._failovers += 1
+            forwards += 1
+            status, out_headers, chunks = self.m.proxy.forward_stream(
+                replica, "POST", "/api/v1/generate", "", headers, raw_body,
+            )
+            if status in (502, 503):
+                outcome = "shed" if status == 503 else "error"
+                ROUTER_REQUESTS.labels(replica, outcome).inc()
+                attempts.append((replica, outcome))
+                close = getattr(chunks, "close", None)
+                if close is not None:
+                    close()
+                continue
+            ROUTER_REQUESTS.labels(replica, "ok").inc()
+            attempts.append((replica, "ok"))
+            self._note(key, order, loads, attempts, replica, status)
+            self._acquire(replica)
+            return (
+                status, out_headers,
+                _TrackedStream(self, replica, chunks), replica,
+            )
+        # Every candidate shed/failed within the budget: the fleet is
+        # saturated — hand the client the back-off it would have gotten
+        # from a single replica.
+        self._note(key, order, loads, attempts, None, 503)
+        return (
+            503,
+            {"Retry-After": "1", "Content-Type": "application/json"},
+            iter([b'{"error": "all serving replicas shed or unreachable"}']),
+            "",
+        )
+
+    def _note(
+        self,
+        key: str,
+        order: List[str],
+        loads: Dict[str, float],
+        attempts: List[Tuple[str, str]],
+        replica: Optional[str],
+        status: int,
+    ) -> None:
+        with self._lock:
+            self._decisions.append({
+                "key": key[:16],
+                "order": list(order),
+                "loads": {r: round(v, 3) for r, v in loads.items()},
+                "attempts": [
+                    {"replica": r, "outcome": o} for r, o in attempts
+                ],
+                "replica": replica,
+                "status": status,
+                "ts": time.time(),
+            })
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            decisions = list(self._decisions)
+            return {
+                "requests": self._requests,
+                "failovers": self._failovers,
+                "inflight": dict(self._inflight),
+                "virtual_nodes": self.virtual_nodes,
+                "block_tokens": self.block_tokens,
+                "spill_queue_depth": self.spill_queue_depth,
+                "last_decision": decisions[-1] if decisions else None,
+                "recent_decisions": decisions,
+            }

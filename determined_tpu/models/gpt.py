@@ -951,6 +951,78 @@ class GPT(Model):
         logits = self._head(params, x)
         return logits, jnp.stack(ks), jnp.stack(vs)
 
+    def prefill_kv_cached(
+        self,
+        params: Dict[str, Any],
+        tokens: jax.Array,
+        positions: jax.Array,
+        segment_ids: jax.Array,
+        prefix_k: jax.Array,
+        prefix_v: jax.Array,
+        prefix_seg: jax.Array,
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Tail prefill that attends THROUGH an already-cached prefix.
+
+        The prefix-cache hit path: a request whose leading pages matched
+        the radix cache computes K/V only for its tail tokens, but those
+        tail tokens must still attend to the cached prefix — so each
+        layer concatenates the (gathered) cached prefix K/V in front of
+        the tail's own and runs the flash kernel in the bottom-aligned
+        ``kv_offset`` geometry the decode path already uses.
+
+        tokens [B, S] int32 — ONE document tail per row (rows cannot be
+        packed: each has its own prefix buffer); positions [B, S] int32 —
+        ABSOLUTE positions (cached_tokens + offset — the pos_embed index
+        must match what a full prefill would have used); segment_ids
+        [B, S] — 1 on real tail tokens, 0 on padding; prefix_k/prefix_v
+        [L, B, Sp, H, Dh] — each row's cached pages gathered contiguous
+        (dead tail rows arbitrary); prefix_seg [B, Sp] — 1 on live prefix
+        positions, 0 past row's prefix length.
+
+        → (logits [B, S, V], k [L, B, S, H, Dh], v) — K/V of the TAIL
+        only (the prefix's K/V already live in the page pool). With
+        ``kv_offset = Sp`` query row r sees every (live) prefix key plus
+        tail keys ≤ r — exactly the causal mask of the full prompt, so
+        greedy streams are identical to the cache-off path.
+        """
+        c = self.config
+        if c.pipeline_stages > 1:
+            raise ValueError(
+                "prefill_kv_cached does not support pipeline stages"
+            )
+        b, s = tokens.shape
+        sp = prefix_k.shape[2]
+        x = (
+            params["tok_embed"].astype(c.dtype)[tokens]
+            + params["pos_embed"].astype(c.dtype)[positions]
+        )
+        bq = fit_block(s, c.flash_block_q)
+        bk = fit_block(sp + s, c.flash_block_k)
+        kv_seg = jnp.concatenate([prefix_seg, segment_ids], axis=1)
+        ks, vs = [], []
+        for i in range(c.n_layers):
+            blk = jax.tree_util.tree_map(lambda a, i=i: a[i], params["blocks"])
+            h = _layernorm(x, blk["ln1_scale"], blk["ln1_bias"])
+            qkv = (
+                jnp.einsum("bsd,dthk->bsthk", h, blk["wqkv"].astype(c.dtype))
+                + blk["bqkv"].astype(c.dtype)
+            )
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            ks.append(k)
+            vs.append(v)
+            o = flash_attention(
+                q,
+                jnp.concatenate([prefix_k[i].astype(k.dtype), k], axis=1),
+                jnp.concatenate([prefix_v[i].astype(v.dtype), v], axis=1),
+                causal=True, kv_offset=sp, block_q=bq, block_k=bk,
+                segment_ids=segment_ids, kv_segment_ids=kv_seg,
+            )
+            o = jnp.einsum("bshk,hkd->bsd", o, blk["wo"].astype(c.dtype))
+            x = x + o + blk["bo"].astype(c.dtype)
+            x, _aux = self._mlp_half(x, blk, manual=False)
+        logits = self._head(params, x)
+        return logits, jnp.stack(ks), jnp.stack(vs)
+
     def decode_kv(
         self,
         params: Dict[str, Any],

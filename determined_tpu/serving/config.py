@@ -36,11 +36,14 @@ KNOWN_SERVING_KEYS = {
     "max_prefills_per_iter",
     "eos_id",
     "decode_kernel",
+    "prefix_cache",
 }
 
 KNOWN_MODELS = ("tiny", "small", "medium")
 
 KNOWN_DECODE_KERNELS = ("auto", "paged", "gather")
+
+KNOWN_PREFIX_CACHE = ("on", "off")
 
 #: The paged decode kernel DMAs K/V pages as ``(page_size, head_dim)``
 #: MXU tiles with the page dimension lane-tiled — the same 128 granule
@@ -98,6 +101,12 @@ class ServingConfig:
     #: env var overrides at engine build (0 = kill switch to gather,
     #: 1 = force paged, interpret mode off-TPU).
     decode_kernel: str = "auto"
+    #: radix-tree prefix cache over page identity: `on` keeps finished
+    #: requests' full-token pages in an LRU-evictable cached state and
+    #: maps matched leading pages into new requests (zero prefill compute
+    #: for the hit span); `off` reproduces the return-to-free-list
+    #: behavior exactly. Greedy token streams are identical either way.
+    prefix_cache: str = "off"
 
     @property
     def max_context(self) -> int:
@@ -152,6 +161,12 @@ def validate_serving(d: Any) -> List[str]:
         errors.append(
             f"serving.decode_kernel {kernel!r} unknown "
             f"(one of {sorted(KNOWN_DECODE_KERNELS)})"
+        )
+    pc = d.get("prefix_cache", "off")
+    if pc not in KNOWN_PREFIX_CACHE:
+        errors.append(
+            f"serving.prefix_cache {pc!r} unknown "
+            f"(one of {sorted(KNOWN_PREFIX_CACHE)})"
         )
     page_size = d.get("page_size", 128)
     if (

@@ -19,10 +19,21 @@ join/leave the batch BETWEEN iterations without draining it —
   W3C trace spans (queue → prefill → decode) parented to the submitting
   client's traceparent.
 
+With ``serving.prefix_cache: on`` the pool grows a third page state:
+finished requests' full-token pages stay CACHED in a radix tree
+(kv_cache.PrefixCache) instead of returning to the free list, admission
+maps matched leading pages straight into new requests' page tables, and
+only the tail is prefilled — through ``prefill_kv_cached``, which
+attends the tail to the cached prefix K/V in the same bottom-aligned
+``kv_offset`` geometry decode uses, so greedy streams are identical
+cache-on vs cache-off.
+
 Fault sites (common/faults.py): ``serving.admission`` (deterministic
 shed), ``serving.decode`` (mid-stream failure — SSE error event, pages
-freed), ``serving.page_alloc`` (pool exhaustion) — the chaos drills in
-tests/test_serving.py exercise all three.
+freed), ``serving.page_alloc`` (pool exhaustion), ``serving.prefix_cache``
+(poisoned lookup → counted fallback to a normal full prefill) — the
+chaos drills in tests/test_serving.py and tests/test_prefix_cache.py
+exercise all four.
 """
 from __future__ import annotations
 
@@ -44,7 +55,11 @@ from determined_tpu.common import faults
 from determined_tpu.common import trace as trace_mod
 from determined_tpu.common.metrics import REGISTRY as METRICS
 from determined_tpu.serving.config import ServingConfig
-from determined_tpu.serving.kv_cache import PagePool, PoolExhausted
+from determined_tpu.serving.kv_cache import (
+    PagePool,
+    PoolExhausted,
+    PrefixCache,
+)
 
 logger = logging.getLogger("determined_tpu.serving")
 
@@ -182,6 +197,10 @@ class Request:
     )
     tokens: List[int] = dataclasses.field(default_factory=list)
     pages: List[int] = dataclasses.field(default_factory=list)
+    #: prefix-cache hit state: the matched radix nodes (pinned for this
+    #: request's lifetime) whose pages head `pages`.
+    cached_nodes: List[Any] = dataclasses.field(default_factory=list)
+    cached_pages: int = 0
     slot: int = -1
     length: int = 0                     # tokens in cache
     last_token: int = 0
@@ -267,6 +286,15 @@ class GenerationEngine:
         self._q_pad = 8 if jax.default_backend() == "tpu" else 1
         self._prefill_fn = jax.jit(model.prefill_kv)
         self._scatter_fn = jax.jit(_scatter_kv, donate_argnums=(0, 1))
+        # -- prefix cache (serving.prefix_cache: on) ---------------------
+        # off reproduces the return-to-free-list lifecycle exactly; on
+        # layers the radix cache over the SAME pool (eviction hooks into
+        # alloc) and compiles the prefix-aware tail prefill once.
+        self.prefix_cache: Optional[PrefixCache] = None
+        self._prefill_cached_fn = None
+        if config.prefix_cache == "on":
+            self.prefix_cache = PrefixCache(self.pool, config.page_size)
+            self._prefill_cached_fn = jax.jit(self._prefill_cached_step)
         #: static page-granular prefill budget: every admitted doc spans
         #: ceil(len/page_size) ≤ tokens/page_size + 1 pages, so one packed
         #: batch touches at most rows·seq/page_size + docs pages (docs ≤
@@ -377,6 +405,27 @@ class GenerationEngine:
         nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
         return nxt, ck, cv
 
+    # -- jitted cached-tail prefill -----------------------------------------
+    def _prefill_cached_step(self, params, tokens, positions, segs, ck, cv,
+                             prefix_pt, prefix_len):
+        """Gather each row's cached prefix pages contiguous and run the
+        prefix-aware tail prefill in ONE jitted call (the gathered buffer
+        never round-trips to host). ck/cv are READ-ONLY here — the pages
+        keep serving other requests; donation stays with the scatter."""
+        import jax.numpy as jnp
+
+        n_layers, _, _, h, hd = ck.shape
+        b = tokens.shape[0]
+        pk = ck[:, prefix_pt].reshape(n_layers, b, -1, h, hd)
+        pv = cv[:, prefix_pt].reshape(n_layers, b, -1, h, hd)
+        sp = pk.shape[2]
+        prefix_seg = (
+            jnp.arange(sp)[None, :] < prefix_len[:, None]
+        ).astype(jnp.int32)
+        return self.model.prefill_kv_cached(
+            params, tokens, positions, segs, pk, pv, prefix_seg
+        )
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -398,7 +447,7 @@ class GenerationEngine:
         for i, req in enumerate(self._slots):
             if req is not None:
                 self._slots[i] = None
-                self.pool.free(req.pages)
+                self._retire_pages(req, cacheable=False)
                 req.events.put(("error", "engine shutting down"))
         BATCH_OCCUPANCY.set(0)
 
@@ -521,9 +570,7 @@ class GenerationEngine:
             if req is None:
                 continue
             self._slots[i] = None
-            if req.pages:
-                self.pool.free(req.pages)
-                req.pages = []
+            self._retire_pages(req, cacheable=False)
             req.finish_reason = "error"
             REQUESTS.labels("error").inc()
             req.events.put(
@@ -531,6 +578,11 @@ class GenerationEngine:
                  "pages freed")
             )
         BATCH_OCCUPANCY.set(0)
+        if self.prefix_cache is not None:
+            # The crash may have been mid-write (and the donated-buffer
+            # rebuild below zeroes the pool outright): every cached
+            # page's contents are suspect, so the whole tree goes.
+            self.prefix_cache.flush()
         if self.cache_k.is_deleted() or self.cache_v.is_deleted():
             # A jit that raises AFTER consuming its donated inputs leaves
             # the pool buffers invalidated; rebuild them — evicting
@@ -553,9 +605,21 @@ class GenerationEngine:
         ) is not None
 
     def _admit(self) -> List[Request]:
-        """Move queue heads into free slots for ONE packed prefill batch.
-        Stops at slot/pack/page capacity; expired deadlines shed here."""
+        """Move queue heads into free slots for ONE prefill round.
+        Stops at slot/pack/page capacity; expired deadlines shed here.
+
+        With the prefix cache on, each head is first walked through the
+        radix tree: a hit pins the matched pages (refs++ BEFORE the
+        alloc, so the alloc's own eviction can never pull them out from
+        under us), allocates only the tail's pages, and takes one row of
+        the cached-tail prefill batch; misses pack into the classic
+        full-prompt prefill exactly as before. An injected
+        ``serving.prefix_cache`` fault (or a hash-collision verify
+        failure inside match) downgrades the head to a counted
+        full-prefill fallback — never a corrupted stream."""
         admitted: List[Request] = []
+        miss_lens: List[int] = []
+        hit_rows = 0
         occupied_before = sum(1 for r in self._slots if r is not None)
         while True:
             with self._lock:
@@ -572,16 +636,33 @@ class GenerationEngine:
                 self._count_shed("deadline")
                 req.events.put(("error", "deadline expired in queue"))
                 continue
-            if not self._pack_fits(
-                [len(a.prompt) for a in admitted], len(req.prompt)
-            ):
+            nodes: List[Any] = []
+            if self.prefix_cache is not None:
+                try:
+                    faults.inject("serving.prefix_cache")
+                    nodes = self.prefix_cache.match(req.prompt)
+                except faults.InjectedFault:
+                    self.prefix_cache.note_fallback()
+                    nodes = []
+            if nodes:
+                if hit_rows >= self.cfg.prefill_rows:
+                    break  # cached-tail batch full; next iteration
+            elif not self._pack_fits(miss_lens, len(req.prompt)):
                 break
             need = self.pool.pages_for(
                 len(req.prompt) + req.max_new_tokens, self.cfg.page_size
             )
+            if nodes:
+                self.prefix_cache.acquire(nodes)
             try:
-                pages = self.pool.alloc(need)
+                # The hit span needs no pages of its own (max_new >= 1
+                # and match stops short of the full prompt, so at least
+                # one fresh page is always needed — decode never writes
+                # into a shared cached page).
+                fresh = self.pool.alloc(need - len(nodes))
             except PoolExhausted:
+                if nodes:
+                    self.prefix_cache.release(nodes)
                 if not admitted and occupied_before == 0:
                     # Nothing in flight will ever free pages: shed rather
                     # than wedge the queue head forever (the fault-driven
@@ -598,7 +679,18 @@ class GenerationEngine:
             with self._lock:
                 self._queue.popleft()
                 QUEUE_DEPTH.set(len(self._queue))
-            req.pages = pages
+            req.cached_nodes = nodes
+            req.cached_pages = len(nodes)
+            req.pages = [n.page for n in nodes] + fresh
+            if self.prefix_cache is not None:
+                if nodes:
+                    self.prefix_cache.note_hit(len(nodes))
+                    hit_rows += 1
+                else:
+                    self.prefix_cache.note_miss()
+                    miss_lens.append(len(req.prompt))
+            else:
+                miss_lens.append(len(req.prompt))
             req.t_admit = time.time()
             slot = free[len(admitted)]
             req.slot = slot
@@ -610,6 +702,18 @@ class GenerationEngine:
 
     # -- prefill ------------------------------------------------------------
     def _prefill(self, reqs: List[Request]) -> None:
+        """One admission round's prefills: cache misses go through the
+        classic packed full-prompt prefill, cache hits through the
+        prefix-aware tail prefill (one row per request — every row has
+        its own cached prefix, so rows cannot pack)."""
+        misses = [r for r in reqs if not r.cached_pages]
+        hits = [r for r in reqs if r.cached_pages]
+        if misses:
+            self._prefill_packed(misses)
+        if hits:
+            self._prefill_cached(hits)
+
+    def _prefill_packed(self, reqs: List[Request]) -> None:
         import jax.numpy as jnp
 
         cfg = self.cfg
@@ -665,34 +769,101 @@ class GenerationEngine:
         for (row, start), req in zip(layout, reqs):
             ln = len(req.prompt)
             req.length = ln
-            first = self._sample_host(logits[row, start + ln - 1], req)
-            req.last_token = first
-            req.tokens.append(first)
-            req.t_first_token = now
-            # Exemplar: the p99 TTFT answer links to this request's
-            # trace — but only when the head-sample will actually ship
-            # the request's spans (the decision is a pure function of
-            # the trace id, so it's knowable here). A sampled-out trace
-            # as an exemplar would 404 in `dtpu traces show`.
-            TTFT.observe(
-                now - req.t_submit,
-                trace_id=(
-                    req.trace[0]
-                    if trace_mod._keep_span(req.trace[0], False, 0.0)
-                    else None
-                ),
-            )
-            TOKENS.inc()
-            with self._stats_lock:
-                self._tokens_emitted += 1
-            req.events.put(("token", first))
-            # a 1-token request is complete at prefill
-            if len(req.tokens) >= req.max_new_tokens or (
-                self.cfg.eos_id >= 0 and first == self.cfg.eos_id
-            ):
-                self._finish(req, "length" if len(req.tokens)
-                             >= req.max_new_tokens else "eos")
+            self._emit_first(req, logits[row, start + ln - 1], now)
         BATCH_OCCUPANCY.set(sum(1 for r in self._slots if r is not None))
+
+    def _prefill_cached(self, reqs: List[Request]) -> None:
+        """Prefix-cache hit path: prefill ONLY each request's tail (the
+        tokens past its matched pages), attending through the cached
+        prefix K/V gathered from the pool. Zero prefill compute and zero
+        K/V writes for the hit span — the tail's K/V scatters into the
+        request's fresh pages exactly like the packed path, and both
+        decode kernels then read the mixed cached/fresh page table
+        unchanged."""
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        ps = cfg.page_size
+        rows, seq = cfg.prefill_rows, cfg.prefill_seq
+        tokens = np.zeros((rows, seq), np.int32)
+        positions = np.zeros((rows, seq), np.int32)
+        segs = np.zeros((rows, seq), np.int32)
+        prefix_pt = np.zeros((rows, cfg.max_pages_per_request), np.int32)
+        prefix_len = np.zeros((rows,), np.int32)
+        src_idx = np.zeros((self._prefill_pages_max, ps), np.int32)
+        dst_pages = np.zeros((self._prefill_pages_max,), np.int32)
+        slot_i = 0
+        for row, req in enumerate(reqs):
+            m = req.cached_pages
+            cached = m * ps
+            tail = req.prompt[cached:]
+            ln = len(tail)
+            assert ln >= 1, "match always leaves a tail token to prefill"
+            tokens[row, :ln] = tail
+            # Absolute positions: the pos_embed index must match what a
+            # full prefill would have used for these tokens.
+            positions[row, :ln] = cached + np.arange(ln)
+            segs[row, :ln] = 1
+            prefix_pt[row, :m] = req.pages[:m]
+            prefix_len[row] = cached
+            # The tail starts ON a page boundary, so its pages align
+            # with the scatter granule like any packed doc's.
+            for pi in range(-(-ln // ps)):
+                idx = pi * ps + np.arange(ps)
+                src_idx[slot_i] = row * seq + np.minimum(idx, ln - 1)
+                dst_pages[slot_i] = req.pages[m + pi]
+                slot_i += 1
+        assert slot_i <= self._prefill_pages_max, "prefill page budget"
+        logits, k_l, v_l = self._prefill_cached_fn(
+            self.params, jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(segs), self.cache_k, self.cache_v,
+            jnp.asarray(prefix_pt), jnp.asarray(prefix_len),
+        )
+        # Block BEFORE the scatter dispatch: the scatter donates the pool
+        # buffers this computation is still reading.
+        logits = np.asarray(logits, np.float32)
+        self.cache_k, self.cache_v = self._scatter_fn(
+            self.cache_k, self.cache_v, k_l, v_l,
+            jnp.asarray(src_idx), jnp.asarray(dst_pages),
+        )
+        now = time.time()
+        for row, req in enumerate(reqs):
+            ln = len(req.prompt) - req.cached_pages * ps
+            req.length = len(req.prompt)
+            self._emit_first(req, logits[row, ln - 1], now)
+        BATCH_OCCUPANCY.set(sum(1 for r in self._slots if r is not None))
+
+    def _emit_first(self, req: Request, logits_row: np.ndarray,
+                    now: float) -> None:
+        """Sample and stream a request's first token from its prefill
+        logits (shared by the packed and cached-tail paths)."""
+        first = self._sample_host(logits_row, req)
+        req.last_token = first
+        req.tokens.append(first)
+        req.t_first_token = now
+        # Exemplar: the p99 TTFT answer links to this request's
+        # trace — but only when the head-sample will actually ship
+        # the request's spans (the decision is a pure function of
+        # the trace id, so it's knowable here). A sampled-out trace
+        # as an exemplar would 404 in `dtpu traces show`.
+        TTFT.observe(
+            now - req.t_submit,
+            trace_id=(
+                req.trace[0]
+                if trace_mod._keep_span(req.trace[0], False, 0.0)
+                else None
+            ),
+        )
+        TOKENS.inc()
+        with self._stats_lock:
+            self._tokens_emitted += 1
+        req.events.put(("token", first))
+        # a 1-token request is complete at prefill
+        if len(req.tokens) >= req.max_new_tokens or (
+            self.cfg.eos_id >= 0 and first == self.cfg.eos_id
+        ):
+            self._finish(req, "length" if len(req.tokens)
+                         >= req.max_new_tokens else "eos")
 
     def _sample_host(self, logits: np.ndarray, req: Request) -> int:
         if req.temperature <= 0:
@@ -718,7 +889,7 @@ class GenerationEngine:
                 if req is None:
                     continue
                 self._slots[i] = None
-                self.pool.free(req.pages)
+                self._retire_pages(req, cacheable=False)
                 req.finish_reason = "error"
                 REQUESTS.labels("error").inc()
                 req.events.put(
@@ -787,14 +958,35 @@ class GenerationEngine:
                 self._finish(req, "deadline")
         BATCH_OCCUPANCY.set(sum(1 for r in self._slots if r is not None))
 
+    def _retire_pages(self, req: Request, cacheable: bool) -> None:
+        """Return a request's pages. Cache off: straight to the free
+        list. Cache on: release the request's pins and (on clean
+        completion) adopt its full K/V-written pages into the radix tree
+        — the LRU-evictable cached state — freeing only the partial tail
+        and unused reservation. Error paths free everything the request
+        owned (the contents are suspect and must not be served)."""
+        if req.pages:
+            if self.prefix_cache is None:
+                self.pool.free(req.pages)
+            else:
+                written = (req.prompt + req.tokens)[:req.length]
+                self.prefix_cache.finish(
+                    written, req.pages, req.cached_nodes, cacheable
+                )
+        req.pages = []
+        req.cached_nodes = []
+        req.cached_pages = 0
+
     def _finish(self, req: Request, reason: str) -> None:
         """Request leaves the batch between iterations: pages return to
-        the pool immediately (an early finisher frees capacity while its
-        batch-mates keep decoding), spans and counters are emitted, and
-        the terminal event closes the client stream."""
+        the pool (or the prefix cache) immediately — an early finisher
+        frees capacity while its batch-mates keep decoding — spans and
+        counters are emitted, and the terminal event closes the client
+        stream."""
         self._slots[req.slot] = None
-        self.pool.free(req.pages)
-        req.pages = []
+        # Every _finish reason (length/eos/deadline) leaves valid K/V in
+        # the pages — a deadline cut is an SLO decision, not corruption.
+        self._retire_pages(req, cacheable=True)
         req.finish_reason = reason
         req.t_done = time.time()
         outcome = "ok" if reason in ("length", "eos") else reason
@@ -916,7 +1108,7 @@ class GenerationEngine:
             done = self._done_count
             shed = self._shed_count
             emitted = self._tokens_emitted
-        return {
+        out = {
             "queued": queued,
             "active": sum(1 for r in self._slots if r is not None),
             "done": done,
@@ -928,4 +1120,9 @@ class GenerationEngine:
             "decode_kernel": self._decode_kernel,
             "max_batch_size": self.cfg.max_batch_size,
             "max_context": self.max_total,
+            "cache_hit_rate": 0.0,
         }
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
+            out["cache_hit_rate"] = round(self.prefix_cache.hit_rate, 4)
+        return out

@@ -119,6 +119,38 @@ def _read_sse(resp, trace: RequestTrace) -> None:
             return
 
 
+def zipf_prefix_prompts(
+    n_requests: int,
+    *,
+    corpus_size: int = 8,
+    prefix_len: int = 16,
+    suffix_len: int = 4,
+    skew: float = 1.1,
+    seed: int = 0,
+    vocab: int = 200,
+) -> List[List[int]]:
+    """A zipfian shared-prefix workload: `corpus_size` distinct prefixes
+    with popularity ~ 1/rank^skew (the few-hot-system-prompts shape real
+    serving traffic has), each request appending a unique suffix. This is
+    what makes a prefix cache (and the cache-aware router keying on the
+    SAME leading block) earn its keep: the hot prefixes repeat, the
+    suffixes never do. Deterministic in `seed` — bench runs compare
+    cache-on vs cache-off over the IDENTICAL request list."""
+    import random
+
+    rng = random.Random(seed)
+    prefixes = [
+        [(rng.randrange(vocab)) + 1 for _ in range(prefix_len)]
+        for _ in range(corpus_size)
+    ]
+    weights = [1.0 / (rank + 1) ** skew for rank in range(corpus_size)]
+    picks = rng.choices(range(corpus_size), weights=weights, k=n_requests)
+    return [
+        prefixes[p] + [(rng.randrange(vocab)) + 1 for _ in range(suffix_len)]
+        for p in picks
+    ]
+
+
 def drive(
     url: str,
     n_requests: int,
@@ -129,18 +161,29 @@ def drive(
     deadline_ms: Optional[int] = None,
     stagger_s: float = 0.0,
     timeout_s: float = 300.0,
+    prompts: Optional[List[List[int]]] = None,
 ) -> LoadReport:
     """POST `n_requests` streaming generates at `concurrency` against
     `url` (service root or master `/proxy/<task>` root). `stagger_s`
     delays each worker's start — the drills use it to force late joins
-    into a non-empty batch."""
+    into a non-empty batch. `prompts` overrides the default
+    distinct-prompt stream with an explicit list (one per request — e.g.
+    `zipf_prefix_prompts` for the shared-prefix cache workload)."""
+    if prompts is not None and len(prompts) != n_requests:
+        raise ValueError(
+            f"prompts carries {len(prompts)} entries for "
+            f"{n_requests} requests"
+        )
     traces = [RequestTrace() for _ in range(n_requests)]
     sem = threading.Semaphore(concurrency)
 
     def one(i: int) -> None:
         trace = traces[i]
         body = {
-            "prompt": [(7 * i + j) % 200 + 1 for j in range(prompt_len)],
+            "prompt": (
+                list(prompts[i]) if prompts is not None
+                else [(7 * i + j) % 200 + 1 for j in range(prompt_len)]
+            ),
             "max_new_tokens": max_new_tokens,
             "stream": True,
         }

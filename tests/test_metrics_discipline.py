@@ -628,6 +628,86 @@ class TestLogPlaneRoutes:
             master.shutdown()
 
 
+class TestPrefixCacheAndRouterSeries:
+    """PR 14 satellite: the prefix-cache counters land on a serving
+    replica's LIVE /metrics surface (scraped over HTTP, not read
+    in-process) and the fleet-router routes/series ride the master's
+    instrumented dispatch path. The router series themselves are
+    exercised end-to-end in tests/test_router.py."""
+
+    def test_router_routes_registered_on_the_dispatch_path(self):
+        master = Master()
+        try:
+            patterns = {
+                (method, pattern.pattern)
+                for method, pattern, _h in build_routes(master)
+            }
+        finally:
+            master.shutdown()
+        assert ("POST", r"^/api/v1/generate$") in patterns
+        assert ("GET", r"^/api/v1/stats$") in patterns
+
+    def test_router_series_registered(self):
+        import determined_tpu.master.router  # noqa: F401 — registers
+
+        fam = REGISTRY.get("dtpu_router_requests_total")
+        assert tuple(fam.labelnames) == ("replica", "outcome")
+        assert REGISTRY.get("dtpu_router_failovers_total") is not None
+        assert tuple(
+            REGISTRY.get("dtpu_router_inflight").labelnames
+        ) == ("replica",)
+
+    def test_prefix_cache_series_on_live_metrics_surface(self):
+        from determined_tpu.serving.service import GenerationServer
+        from tests.test_serving import make_engine
+
+        engine = make_engine(prefix_cache="on")
+        engine.start()
+        server = GenerationServer(engine)
+        server.start()
+        try:
+            prefix = [(5 * i) % 200 + 1 for i in range(16)]
+            for tail in ([3], [9]):
+                resp = requests.post(
+                    f"{server.url}/api/v1/generate",
+                    json={"prompt": prefix + tail, "max_new_tokens": 2,
+                          "stream": False},
+                    timeout=180,
+                )
+                assert resp.status_code == 200
+            text = requests.get(f"{server.url}/metrics", timeout=30).text
+            stats = requests.get(
+                f"{server.url}/api/v1/stats", timeout=30
+            ).json()
+        finally:
+            server.stop()
+            engine.stop()
+        samples = parse_exposition(text)
+        # the second request hit the first's cached leading page
+        assert sample_value(
+            samples, "dtpu_serving_prefix_cache_hits_total"
+        ) >= 1
+        assert sample_value(
+            samples, "dtpu_serving_prefix_cache_misses_total"
+        ) >= 1
+        assert sample_value(
+            samples, "dtpu_serving_prefix_pages_reused_total"
+        ) >= 1
+        assert sample_value(
+            samples, "dtpu_serving_prefix_cache_pages"
+        ) >= 1
+        # counters exist (rendered at zero) even before their first event
+        assert sample_value(
+            samples, "dtpu_serving_prefix_cache_evictions_total"
+        ) is not None
+        assert sample_value(
+            samples, "dtpu_serving_prefix_cache_fallbacks_total"
+        ) is not None
+        # the stats surface mirrors the hit rate for dashboards/bench
+        assert stats["cache_hit_rate"] > 0
+        assert stats["prefix_cache"]["hits"] >= 1
+
+
 class TestNameDiscipline:
     def test_all_registered_names_are_dtpu_prefixed(self):
         # Importing the instrumented modules populates the registry.
@@ -638,6 +718,7 @@ class TestNameDiscipline:
         import determined_tpu.master.core  # noqa: F401
         import determined_tpu.master.logsink  # noqa: F401
         import determined_tpu.master.rm  # noqa: F401
+        import determined_tpu.master.router  # noqa: F401
         import determined_tpu.master.timeseries  # noqa: F401
         import determined_tpu.serving.engine  # noqa: F401
         import determined_tpu.serving.kv_cache  # noqa: F401

@@ -1,0 +1,302 @@
+"""Prefix cache (serving.prefix_cache): radix-tree semantics under
+refcount churn, greedy parity cache-on vs cache-off on both decode
+kernels, pool-pressure eviction-before-failure, and the
+serving.prefix_cache fault drill (counted fallback, never a corrupted
+stream)."""
+import numpy as np
+import pytest
+
+from determined_tpu.common import faults
+from determined_tpu.serving.config import ServingConfig, validate_serving
+from determined_tpu.serving.kv_cache import (
+    PagePool,
+    PoolExhausted,
+    PrefixCache,
+    prefix_block_hashes,
+)
+from tests.test_serving import assert_greedy, make_engine
+
+
+class TestBlockHashes:
+    def test_chain_commits_to_whole_prefix(self):
+        """Equal block content at depth i hashes DIFFERENTLY under
+        different earlier blocks — the property that makes a node key a
+        commitment to its entire prefix."""
+        a = prefix_block_hashes([1, 2, 3, 4, 9, 9], 2)
+        b = prefix_block_hashes([1, 2, 3, 4, 9, 9], 2)
+        c = prefix_block_hashes([5, 6, 3, 4, 9, 9], 2)
+        assert a == b and len(a) == 3
+        assert a[0] != c[0]
+        assert a[1] != c[1], "same block, different prefix, same hash"
+
+    def test_partial_last_block_excluded(self):
+        assert len(prefix_block_hashes([1, 2, 3], 2)) == 1
+        assert prefix_block_hashes([1], 2) == []
+
+    def test_max_blocks(self):
+        assert len(prefix_block_hashes(list(range(8)), 2, max_blocks=1)) == 1
+
+
+def _retire(cache, tokens, pages, matched=(), cacheable=True):
+    cache.finish(list(tokens), list(pages), list(matched), cacheable)
+
+
+class TestRadixTree:
+    """Pure host-side semantics on a tiny pool (page_size 4)."""
+
+    def _cache(self, num_pages=9):
+        pool = PagePool(num_pages)
+        return pool, PrefixCache(pool, 4)
+
+    def test_insert_then_match_leaves_a_tail(self, ):
+        pool, cache = self._cache()
+        pages = pool.alloc(3)
+        toks = [1, 2, 3, 4, 5, 6, 7, 8]
+        _retire(cache, toks, pages)             # 2 full pages cached
+        assert len(cache) == 2
+        assert pool.free_pages == 8 - 2         # spare page went back
+        # a longer prompt matches both pages ...
+        assert len(cache.match(toks + [9])) == 2
+        # ... but a prompt ENDING on the boundary keeps its last page
+        # as tail (the first generated token samples from tail logits)
+        assert len(cache.match(toks)) == 1
+        assert len(cache.match([1, 2, 3, 4])) == 0
+        # divergent content does not match past the shared prefix
+        assert len(cache.match([1, 2, 3, 4, 9, 9, 9, 9, 9])) == 1
+
+    def test_refcounted_page_never_evicted(self):
+        pool, cache = self._cache(num_pages=5)  # 4 allocatable
+        pages = pool.alloc(3)
+        _retire(cache, list(range(8)), pages)   # 2 cached, 1 free again
+        nodes = cache.match(list(range(8)) + [99])
+        assert len(nodes) == 2
+        cache.acquire(nodes)
+        # pool: 2 free + 2 cached-but-pinned. An alloc of 3 may evict
+        # NOTHING (both cached pages are pinned) and must fail whole.
+        with pytest.raises(PoolExhausted):
+            pool.alloc(3)
+        assert pool.free_pages == 2
+        assert len(cache) == 2
+        cache.release(nodes)
+        # unpinned, the same alloc succeeds by evicting cached pages
+        got = pool.alloc(3)
+        assert len(got) == 3
+        assert cache.evictions == 1 and len(cache) == 1
+
+    def test_eviction_is_leaf_first_lru(self):
+        pool, cache = self._cache(num_pages=9)
+        base = [1, 2, 3, 4]
+        p1 = pool.alloc(3)
+        _retire(cache, base + [5, 6, 7, 8], p1)        # chain A -> B
+        p2 = pool.alloc(3)
+        _retire(cache, base + [9, 10, 11, 12], p2)     # shares A, leaf C
+        assert len(cache) == 3
+        root_page = cache.match(base + [0])[0].page
+        # touch chain A->B so leaf C is the LRU leaf
+        nodes = cache.match(base + [5, 6, 7, 8, 0])
+        cache.acquire(nodes)
+        cache.release(nodes)
+        freed = cache.evict(1)
+        assert len(freed) == 1 and freed[0] != root_page
+        assert cache.match(base + [9, 10, 11, 12, 0])[-1].page == root_page
+        # the shared interior page survives until its last child goes
+        freed = cache.evict(2)
+        assert root_page == freed[-1]
+        assert len(cache) == 0
+
+    def test_duplicate_insert_dedupes(self):
+        pool, cache = self._cache()
+        toks = [1, 2, 3, 4, 5, 6, 7, 8]
+        _retire(cache, toks, pool.alloc(2))
+        free_before = pool.free_pages
+        _retire(cache, toks, pool.alloc(2))  # same content, new pages
+        assert len(cache) == 2
+        assert pool.free_pages == free_before  # duplicates went back
+
+    def test_flush_returns_everything(self):
+        pool, cache = self._cache()
+        _retire(cache, list(range(8)), pool.alloc(2))
+        cache.flush()
+        assert len(cache) == 0 and pool.free_pages == 8
+
+    def test_uncacheable_retire_frees_fresh_pages_only(self):
+        pool, cache = self._cache()
+        _retire(cache, list(range(8)), pool.alloc(2))
+        nodes = cache.match(list(range(8)) + [9])
+        cache.acquire(nodes)
+        fresh = pool.alloc(2)
+        # error path: matched pages stay cached, fresh pages freed
+        _retire(cache, list(range(9)), [n.page for n in nodes] + fresh,
+                matched=nodes, cacheable=False)
+        assert len(cache) == 2
+        assert pool.free_pages == 6
+
+    def test_knob_validation(self):
+        assert validate_serving({"prefix_cache": "on"}) == []
+        assert validate_serving({"prefix_cache": "off"}) == []
+        errs = validate_serving({"prefix_cache": "yes"})
+        assert errs and "prefix_cache" in errs[0]
+        assert ServingConfig().prefix_cache == "off"
+
+
+class TestEnginePrefixCache:
+    """Engine-level behavior on CPU (gather kernel; the paged-kernel
+    parity run is in TestPrefixParity below)."""
+
+    def _run(self, eng, prompt, mnt=5):
+        out = eng.submit(list(prompt), max_new_tokens=mnt).result(
+            timeout=180
+        )
+        assert "error" not in out, out
+        return out["tokens"]
+
+    def test_hit_reuses_pages_and_streams_match(self):
+        eng = make_engine(prefix_cache="on")
+        eng.start()
+        try:
+            prefix = [(3 * i) % 200 + 1 for i in range(16)]  # 1 full page
+            a = self._run(eng, prefix + [7, 8, 9])
+            b = self._run(eng, prefix + [7, 8, 9])
+            c = self._run(eng, prefix + [11])   # shared page, new tail
+            st = eng.stats()
+            assert st["prefix_cache"]["hits"] >= 2
+            assert st["prefix_cache"]["pages_reused"] >= 2
+            assert st["cache_hit_rate"] > 0
+            assert a == b
+            assert_greedy(eng.model, eng.params, prefix + [7, 8, 9], a)
+            assert_greedy(eng.model, eng.params, prefix + [11], c)
+        finally:
+            eng.stop()
+        # stop() retired everything: no leaked pages anywhere
+        assert eng.pool.pages_in_use == len(eng.prefix_cache)
+
+    def test_boundary_prompt_still_prefills_a_tail(self):
+        """A prompt that is an exact multiple of page_size must keep its
+        last page out of the match (first token comes from tail
+        logits)."""
+        eng = make_engine(prefix_cache="on")
+        eng.start()
+        try:
+            prompt = [(5 * i) % 150 + 1 for i in range(32)]  # 2 pages
+            a = self._run(eng, prompt)
+            b = self._run(eng, prompt)
+            assert a == b
+            assert_greedy(eng.model, eng.params, prompt, a)
+            # only page 0 may match; page 1 is the mandatory tail
+            assert eng.stats()["prefix_cache"]["pages_reused"] <= 1
+        finally:
+            eng.stop()
+
+    def test_pool_pressure_evicts_before_failing(self):
+        """Acceptance: with the cache full, admissions succeed by
+        evicting refcount-0 cached pages — never a page_alloc_failure."""
+        eng = make_engine(prefix_cache="on", num_pages=9,
+                          max_pages_per_request=4, max_new_tokens=8)
+        eng.start()
+        try:
+            # distinct prompts whose cached pages fill the little pool
+            for base in (1, 60, 120, 180):
+                self._run(eng, [base + i for i in range(30)], mnt=3)
+            assert len(eng.prefix_cache) > 0
+            before = eng.prefix_cache.evictions
+            # this admission needs more pages than the free list holds
+            toks = self._run(eng, [200 + i for i in range(30)], mnt=3)
+            assert len(toks) == 3
+            assert eng.prefix_cache.evictions > before
+            assert eng.stats()["shed"] == 0
+        finally:
+            eng.stop()
+
+    def test_fault_drill_falls_back_to_full_prefill(self):
+        """serving.prefix_cache drill: a poisoned lookup downgrades the
+        admission to a normal full prefill — same stream, counted."""
+        eng = make_engine(prefix_cache="on")
+        eng.start()
+        try:
+            prefix = [(3 * i) % 200 + 1 for i in range(16)]
+            warm = self._run(eng, prefix + [7, 8])
+            plan = faults.FaultPlan(
+                {"serving.prefix_cache": faults.FaultSpec(failures=1)}
+            )
+            with faults.plan_active(plan):
+                drilled = self._run(eng, prefix + [7, 8])
+            assert drilled == warm
+            st = eng.stats()["prefix_cache"]
+            assert st["fallbacks"] == 1
+            assert st["hits"] == 0  # warm was a miss; the drill never hit
+            # healed: the next lookup hits again
+            healed = self._run(eng, prefix + [7, 8])
+            assert healed == warm
+            assert eng.stats()["prefix_cache"]["hits"] == 1
+        finally:
+            eng.stop()
+
+    def test_decode_fault_does_not_cache_suspect_pages(self):
+        eng = make_engine(prefix_cache="on")
+        eng.start()
+        try:
+            plan = faults.FaultPlan(
+                {"serving.decode": faults.FaultSpec(failures=1)}
+            )
+            with faults.plan_active(plan):
+                out = eng.submit(
+                    [1, 2, 3, 4] * 5, max_new_tokens=6
+                ).result(timeout=180)
+            assert "error" in out
+            assert len(eng.prefix_cache) == 0
+        finally:
+            eng.stop()
+
+
+class TestPrefixParity:
+    """The tentpole parity acceptance: identical greedy token streams
+    with prefix_cache on vs off across late-join/early-free churn, on
+    BOTH decode paths (paged in interpret mode via DTPU_PAGED_ATTN=1,
+    gather via =0)."""
+
+    def _drive(self, eng):
+        prefix = [(3 * i) % 200 + 1 for i in range(16)]
+        warm = eng.submit(prefix + [5], max_new_tokens=10)
+        stream = warm.stream(timeout=180)
+        kind, _ = next(stream)
+        assert kind == "token"
+        # late joiners share the warm request's prefix page; the warm
+        # request is still decoding when they admit (late-join churn)
+        a = eng.submit(prefix + [7, 8, 9], max_new_tokens=3)
+        b = eng.submit(prefix + [11], max_new_tokens=2)
+        assert a.result(timeout=180)["reason"] == "length"
+        assert b.result(timeout=180)["reason"] == "length"
+        # early-free: a and b retired into the cache; reuse after churn
+        c = eng.submit(prefix + [7, 8, 9], max_new_tokens=4)
+        assert c.result(timeout=180)["reason"] == "length"
+        for _ in stream:
+            pass
+        assert eng.pool.pages_in_use >= 0
+        return {
+            "warm": list(warm.tokens), "a": list(a.tokens),
+            "b": list(b.tokens), "c": list(c.tokens),
+        }
+
+    @pytest.mark.parametrize("paged_env", ["1", "0"])
+    def test_greedy_streams_identical_on_and_off(
+        self, monkeypatch, paged_env
+    ):
+        monkeypatch.setenv("DTPU_PAGED_ATTN", paged_env)
+        streams = {}
+        for mode in ("on", "off"):
+            eng = make_engine(prefix_cache=mode)
+            expected = "paged" if paged_env == "1" else "gather"
+            assert eng.stats()["decode_kernel"] == expected
+            eng.start()
+            try:
+                streams[mode] = self._drive(eng)
+                model, params = eng.model, eng.params
+                if mode == "on":
+                    assert eng.stats()["prefix_cache"]["hits"] > 0
+            finally:
+                eng.stop()
+        assert streams["on"] == streams["off"]
+        prefix = [(3 * i) % 200 + 1 for i in range(16)]
+        assert_greedy(model, params, prefix + [5], streams["on"]["warm"])
+        assert_greedy(model, params, prefix + [7, 8, 9],
+                      streams["on"]["c"])

@@ -1,0 +1,419 @@
+"""Cache-aware serving-fleet router (master/router.py): consistent-hash
+ring stability under join/leave, the two-replica fleet drill through the
+master's `POST /api/v1/generate` (both replicas served, same prefix →
+same replica, hit rate > 0), shed-aware failover bounded to ONE retry,
+and the `master.route` fault drill — all with the routing metrics read
+off the master's live /metrics surface."""
+import hashlib
+import json
+from types import SimpleNamespace
+
+import pytest
+import requests
+
+from determined_tpu.common import faults
+from determined_tpu.common.metrics import (
+    REGISTRY,
+    parse_exposition,
+    sample_value,
+)
+from determined_tpu.master import masterconf
+from determined_tpu.master.api_server import ApiServer
+from determined_tpu.master.core import Master
+from determined_tpu.master.router import Router
+from determined_tpu.serving.loadgen import drive, zipf_prefix_prompts
+from determined_tpu.serving.service import GenerationServer
+from tests.test_serving import make_engine
+
+
+def _unit_router(**overrides):
+    cfg = dict(masterconf.ROUTER_DEFAULTS)
+    cfg.update({"block_tokens": 4, "spill_queue_depth": 0.0}, **overrides)
+    return Router(SimpleNamespace(), cfg)
+
+
+class TestRouteKey:
+    def test_prefix_family_shares_one_key(self):
+        r = _unit_router()
+        base = [1, 2, 3, 4]
+        assert r.route_key(base + [9]) == r.route_key(base + [7, 7])
+        assert r.route_key(base + [9]) != r.route_key([5, 2, 3, 4, 9])
+
+    def test_short_prompts_route_on_whole_prompt(self):
+        r = _unit_router()
+        assert r.route_key([1, 2]) != r.route_key([1, 3])
+        assert r.route_key([1, 2]) == r.route_key([1, 2])
+        assert r.route_key([])  # empty prompt still yields a key
+
+
+class TestRingStability:
+    def _keys(self, n=200):
+        return [hashlib.sha256(str(i).encode()).hexdigest() for i in range(n)]
+
+    def test_join_moves_only_keys_claimed_by_the_new_replica(self):
+        r = _unit_router()
+        keys = self._keys()
+        base = ["serving-1", "serving-2", "serving-3"]
+        before = {k: r.rank(k, base)[0][0] for k in keys}
+        after = {k: r.rank(k, base + ["serving-4"])[0][0] for k in keys}
+        moved = [k for k in keys if before[k] != after[k]]
+        # consistent hashing's whole point: a join steals ~1/N of the
+        # keyspace and every stolen key goes to the JOINER — nothing
+        # reshuffles between the survivors.
+        assert all(after[k] == "serving-4" for k in moved)
+        assert 0 < len(moved) < len(keys) / 2
+
+    def test_leave_moves_only_the_leavers_keys(self):
+        r = _unit_router()
+        keys = self._keys()
+        base = ["serving-1", "serving-2", "serving-3"]
+        before = {k: r.rank(k, base)[0][0] for k in keys}
+        after = {
+            k: r.rank(k, ["serving-1", "serving-2"])[0][0] for k in keys
+        }
+        for k in keys:
+            if before[k] != "serving-3":
+                assert after[k] == before[k]
+
+    def test_rank_is_deterministic_and_covers_all_replicas(self):
+        r = _unit_router()
+        order1, _ = r.rank("ab" * 32, ["b", "a", "c"])
+        order2, _ = r.rank("ab" * 32, ["c", "b", "a"])
+        assert order1 == order2
+        assert sorted(order1) == ["a", "b", "c"]
+
+    def test_spill_reorders_only_past_the_hysteresis(self):
+        r = _unit_router(spill_queue_depth=4.0)
+        replicas = ["serving-1", "serving-2"]
+        key = r.route_key([1, 2, 3, 4, 5])
+        sticky, _ = r.rank(key, replicas)
+        primary, other = sticky[0], sticky[1]
+        # below the gap: sticky order holds (cache affinity wins)
+        r._inflight = {primary: 3}
+        assert r.rank(key, replicas)[0][0] == primary
+        # past the gap: the least-loaded replica takes the request
+        r._inflight = {primary: 9}
+        assert r.rank(key, replicas)[0][0] == other
+
+
+@pytest.fixture()
+def fleet():
+    """Master + API + TWO prefix-cache-enabled serving replicas wired as
+    RUNNING SERVING commands with proxy targets — the in-process shape of
+    a 2-replica pool. Router block_tokens matches the engines' page_size
+    so the ring key IS the replicas' radix-tree key."""
+    master = Master(router_config={"block_tokens": 16,
+                                   "spill_queue_depth": 0.0})
+    api = ApiServer(master)
+    api.start()
+    engines, servers = [], []
+    for i in (1, 2):
+        eng = make_engine(
+            prefix_cache="on", max_batch_size=8, prefill_rows=4,
+            prefill_seq=64, num_pages=65, max_queue_depth=32,
+        )
+        eng.start()
+        srv = GenerationServer(eng)
+        srv.start()
+        engines.append(eng)
+        servers.append(srv)
+        tid, alloc = f"serving-{i}", f"serve.{i}.0"
+        master._commands[tid] = {
+            "task_id": tid, "alloc_id": alloc, "task_type": "SERVING",
+            "state": "RUNNING", "config": {},
+        }
+        master._alloc_pool[alloc] = "default"
+        master.proxy.register(tid, "127.0.0.1", srv.port)
+    yield master, api, engines, servers
+    for s in servers:
+        s.stop()
+    for e in engines:
+        e.stop()
+    api.stop()
+    master.shutdown()
+
+
+def _ok_count(replica):
+    return REGISTRY.get("dtpu_router_requests_total").labels(
+        replica, "ok"
+    ).value
+
+
+class TestFleetRouting:
+    def test_zipfian_fleet_drill(self, fleet):
+        """The acceptance drill: zipfian shared-prefix load against the
+        2-replica pool through the master's generate route — every
+        request completes, BOTH replicas serve traffic (asserted via
+        dtpu_router_requests_total on the master's live /metrics), and
+        the prefix caches see hits > 0."""
+        master, api, engines, servers = fleet
+        before = {t: _ok_count(t) for t in ("serving-1", "serving-2")}
+        prompts = zipf_prefix_prompts(
+            16, corpus_size=6, prefix_len=16, suffix_len=3, seed=3,
+        )
+        report = drive(
+            api.url, n_requests=16, concurrency=8,
+            max_new_tokens=4, timeout_s=300.0, prompts=prompts,
+        )
+        assert report.completed == 16, [t.error for t in report.traces]
+        assert report.total_tokens == 64
+        text = requests.get(f"{api.url}/metrics", timeout=30).text
+        samples = parse_exposition(text)
+        served = {
+            t: sample_value(
+                samples, "dtpu_router_requests_total",
+                replica=t, outcome="ok",
+            ) - before[t]
+            for t in ("serving-1", "serving-2")
+        }
+        assert all(n > 0 for n in served.values()), served
+        assert sum(served.values()) == 16
+        # the router kept prefix families together, so the caches hit
+        hit_rate = max(e.prefix_cache.hit_rate for e in engines)
+        assert hit_rate > 0
+        # routing decisions are inspectable on the fleet stats surface
+        stats = requests.get(f"{api.url}/api/v1/stats", timeout=30).json()
+        assert stats["replicas"] == ["serving-1", "serving-2"]
+        last = stats["router"]["last_decision"]
+        assert last["replica"] in ("serving-1", "serving-2")
+        assert last["attempts"][-1]["outcome"] == "ok"
+        assert stats["router"]["requests"] >= 16
+
+    def test_same_prefix_same_replica(self, fleet):
+        """Stickiness end-to-end: requests sharing a leading page land on
+        the SAME replica (the router key equals the radix-tree key), and
+        their streams match a single-replica run token for token."""
+        master, api, engines, servers = fleet
+        prefix = [(3 * i) % 200 + 1 for i in range(16)]
+        picked = set()
+        streams = []
+        for suffix in ([7], [7], [9, 9]):
+            resp = requests.post(
+                f"{api.url}/api/v1/generate",
+                json={"prompt": prefix + suffix, "max_new_tokens": 3,
+                      "stream": False},
+                timeout=300,
+            )
+            assert resp.status_code == 200
+            streams.append(resp.json()["tokens"])
+            stats = requests.get(
+                f"{api.url}/api/v1/stats", timeout=30
+            ).json()
+            picked.add(stats["router"]["last_decision"]["replica"])
+        assert len(picked) == 1, picked
+        assert streams[0] == streams[1]
+        # exactly one engine saw the family — and it hit on the repeats
+        hit_engines = [e for e in engines if len(e.prefix_cache) > 0]
+        assert len(hit_engines) == 1
+        assert hit_engines[0].prefix_cache.hits >= 2
+
+    def test_sse_streams_through_master_generate(self, fleet):
+        """The default streaming mode passes the replica's SSE bytes
+        through the router verbatim."""
+        master, api, engines, servers = fleet
+        resp = requests.post(
+            f"{api.url}/api/v1/generate",
+            json={"prompt": [3, 1, 4, 1, 5], "max_new_tokens": 3},
+            stream=True, timeout=300,
+        )
+        assert resp.status_code == 200
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        events = []
+        for block in resp.text.split("\n\n"):
+            for line in block.splitlines():
+                if line.startswith("event: "):
+                    events.append(line[len("event: "):])
+        resp.close()
+        assert events.count("token") == 3
+        assert events[-1] == "done"
+        # streams drained: no in-flight accounting leaked
+        stats = requests.get(f"{api.url}/api/v1/stats", timeout=30).json()
+        assert stats["router"]["inflight"] == {}
+
+    def test_shed_failover_once_then_503(self, fleet):
+        """Shed-aware failover: one shed fails over to the next-best
+        replica ONCE; when the whole fleet sheds, the client gets the
+        503 + Retry-After it would have gotten from a single replica —
+        never a retry storm."""
+        master, api, engines, servers = fleet
+        failovers_before = REGISTRY.get("dtpu_router_failovers_total").value
+        # one replica sheds: the request still completes via failover
+        plan = faults.FaultPlan(
+            {"serving.admission": faults.FaultSpec(failures=1)}
+        )
+        with faults.plan_active(plan):
+            resp = requests.post(
+                f"{api.url}/api/v1/generate",
+                json={"prompt": [1, 2, 3], "max_new_tokens": 2,
+                      "stream": False},
+                timeout=300,
+            )
+        assert resp.status_code == 200
+        assert len(resp.json()["tokens"]) == 2
+        assert REGISTRY.get(
+            "dtpu_router_failovers_total"
+        ).value == failovers_before + 1
+        # both replicas shed: 503 with Retry-After after exactly TWO
+        # forwards (the failover bound) — the fleet is saturated and
+        # the CLIENT backs off
+        plan = faults.FaultPlan(
+            {"serving.admission": faults.FaultSpec(failures=2)}
+        )
+        with faults.plan_active(plan):
+            resp = requests.post(
+                f"{api.url}/api/v1/generate",
+                json={"prompt": [1, 2, 3], "max_new_tokens": 2,
+                      "stream": False},
+                timeout=300,
+            )
+        assert resp.status_code == 503
+        assert float(resp.headers["Retry-After"]) > 0
+        stats = requests.get(f"{api.url}/api/v1/stats", timeout=30).json()
+        last = stats["router"]["last_decision"]
+        assert [a["outcome"] for a in last["attempts"]] == ["shed", "shed"]
+        assert last["replica"] is None
+
+    def test_expired_deadline_blocks_failover(self, fleet):
+        """The failover is bounded by the request deadline: a shed with
+        no time left answers 503 after ONE attempt instead of burning
+        the deadline on a doomed retry."""
+        master, api, engines, servers = fleet
+        plan = faults.FaultPlan(
+            {"serving.admission": faults.FaultSpec(failures=1)}
+        )
+        with faults.plan_active(plan):
+            resp = requests.post(
+                f"{api.url}/api/v1/generate",
+                json={"prompt": [1, 2, 3], "max_new_tokens": 1,
+                      "stream": False, "deadline_ms": 0.001},
+                timeout=300,
+            )
+        assert resp.status_code == 503
+        stats = requests.get(f"{api.url}/api/v1/stats", timeout=30).json()
+        assert len(stats["router"]["last_decision"]["attempts"]) == 1
+
+    def test_master_route_fault_drill(self, fleet):
+        """Fault site master.route: an injected pick failure skips the
+        primary — counted as outcome=fault on the live /metrics surface,
+        and the request completes on the next candidate."""
+        master, api, engines, servers = fleet
+        plan = faults.FaultPlan(
+            {"master.route": faults.FaultSpec(failures=1)}
+        )
+        with faults.plan_active(plan):
+            resp = requests.post(
+                f"{api.url}/api/v1/generate",
+                json={"prompt": [5, 5, 5], "max_new_tokens": 2,
+                      "stream": False},
+                timeout=300,
+            )
+        assert resp.status_code == 200
+        assert len(resp.json()["tokens"]) == 2
+        text = requests.get(f"{api.url}/metrics", timeout=30).text
+        samples = parse_exposition(text)
+        faulted = sum(
+            sample_value(
+                samples, "dtpu_router_requests_total",
+                replica=t, outcome="fault",
+            ) or 0.0
+            for t in ("serving-1", "serving-2")
+        )
+        assert faulted == 1
+        stats = requests.get(f"{api.url}/api/v1/stats", timeout=30).json()
+        outcomes = [
+            a["outcome"]
+            for a in stats["router"]["last_decision"]["attempts"]
+        ]
+        assert outcomes == ["fault", "ok"]
+
+    def test_unreachable_primary_fails_over(self, fleet):
+        """A replica whose service died (proxy target refuses) answers
+        502 from the forward — the router counts outcome=error and the
+        request completes on the survivor."""
+        master, api, engines, servers = fleet
+        # a third RUNNING replica whose port is dead
+        master._commands["serving-3"] = {
+            "task_id": "serving-3", "alloc_id": "serve.3.0",
+            "task_type": "SERVING", "state": "RUNNING", "config": {},
+        }
+        master._alloc_pool["serve.3.0"] = "default"
+        master.proxy.register("serving-3", "127.0.0.1", 1)  # dead port
+        # find a prompt whose sticky pick IS the dead replica
+        replicas = master.router.replicas()
+        assert "serving-3" in replicas
+        prompt = None
+        for i in range(200):
+            cand = [(i + j) % 200 + 1 for j in range(16)] + [i % 7]
+            order, _ = master.router.rank(
+                master.router.route_key(cand), replicas
+            )
+            if order[0] == "serving-3":
+                prompt = cand
+                break
+        assert prompt is not None
+        resp = requests.post(
+            f"{api.url}/api/v1/generate",
+            json={"prompt": prompt, "max_new_tokens": 2, "stream": False},
+            timeout=300,
+        )
+        assert resp.status_code == 200
+        assert len(resp.json()["tokens"]) == 2
+        assert REGISTRY.get("dtpu_router_requests_total").labels(
+            "serving-3", "error"
+        ).value >= 1
+
+    def test_pool_filter_and_no_replicas(self, fleet):
+        master, api, engines, servers = fleet
+        resp = requests.post(
+            f"{api.url}/api/v1/generate",
+            json={"prompt": [1], "max_new_tokens": 1, "stream": False,
+                  "resource_pool": "nope"},
+            timeout=30,
+        )
+        assert resp.status_code == 503
+        assert "no running serving replicas" in resp.json()["error"]
+        assert requests.get(
+            f"{api.url}/api/v1/stats?pool=nope", timeout=30
+        ).json()["replicas"] == []
+
+    def test_generate_client_errors_are_400(self, fleet):
+        master, api, engines, servers = fleet
+        for bad in (
+            {},
+            {"prompt": "nope"},
+            {"prompt": [True]},
+            {"text": 7},
+            {"prompt": [1], "deadline_ms": "soon"},
+            {"prompt": [1], "resource_pool": 3},
+        ):
+            resp = requests.post(
+                f"{api.url}/api/v1/generate", json=bad, timeout=30
+            )
+            assert resp.status_code == 400, (bad, resp.status_code)
+
+
+class TestRouterConfig:
+    def test_masterconf_validates_router_section(self):
+        assert masterconf.validate_router(None) == []
+        assert masterconf.validate_router({"virtual_nodes": 8}) == []
+        errs = masterconf.validate_router(
+            {"virtual_nodes": 0, "spill_queue_depth": -1, "bogus": 1}
+        )
+        joined = "; ".join(errs)
+        assert "virtual_nodes" in joined
+        assert "spill_queue_depth" in joined
+        assert "unknown key 'bogus'" in joined
+        with pytest.raises(ValueError, match="router"):
+            Master(router_config={"bogus": 1})
+
+    def test_master_applies_router_config(self):
+        master = Master(router_config={"virtual_nodes": 8,
+                                       "block_tokens": 16})
+        try:
+            assert master.router.virtual_nodes == 8
+            assert master.router.block_tokens == 16
+            assert master.router.spill_queue_depth == (
+                masterconf.ROUTER_DEFAULTS["spill_queue_depth"]
+            )
+        finally:
+            master.shutdown()
