@@ -1219,6 +1219,122 @@ def log_rung(step_time_s: float):
         return None
 
 
+def control_plane_rung():
+    """Control-plane load rung (PR 15): the master as its own k6.
+
+    Three phases against one embedded master through the REAL HTTP path
+    (common/loadharness.py, open-loop constant-arrival-rate):
+    (A) all four telemetry planes ingesting concurrently plus lifecycle
+    churn, queries, and control beats — SLO verdict must stay green and
+    the per-plane sustained QPS + submit p99 are the published numbers;
+    (B) an above-capacity drive into tightened admission bounds — the
+    master must answer 429 + Retry-After with counted shed while the
+    control-route p99 stays bounded (the two-lane claim, measured);
+    (C) a deliberate master.overload fault plan with a shed-watching SLO
+    rule — the harness verdict must FAIL and name the violated rule."""
+    try:
+        from determined_tpu.common import faults as faults_mod
+        from determined_tpu.common import loadharness
+        from determined_tpu.common.api_session import Session
+        from determined_tpu.master.api_server import ApiServer
+        from determined_tpu.master.core import Master
+
+        out = {}
+        master = Master(
+            metrics_config={"scrape_interval_s": 1.0, "min_step_s": 0.1},
+            alerts_config={"interval_s": 1.0, "rules": [{
+                # Bench-speed stand-in for ingest_shed_sustained (whose
+                # 5m/60s windows outlive a rung): ANY shed counted in
+                # the last 30s fires on the next evaluation.
+                "name": "bench_ingest_shed", "kind": "threshold",
+                "metric": "dtpu_ingest_shed_total",
+                "match": {"instance": "master"},
+                "func": "increase", "window_s": 30.0,
+                "op": ">", "value": 0.0, "for_s": 0.0,
+                "severity": "warning",
+                "help": "bench: any ingest shed in 30s",
+            }]},
+            overload_config={"max_inflight": 64, "retry_after_s": 0.1},
+        )
+        api = ApiServer(master)
+        api.start()
+        try:
+            sess = Session(api.url)
+            # Phase A — sustained four-plane mix, verdict must be green.
+            rep = loadharness.LoadHarness(
+                api.url,
+                mix={"metric_report": 40, "span_ingest": 15,
+                     "log_ingest": 15, "profile_ingest": 4,
+                     "submit_churn": 2, "query": 4, "control": 10},
+                duration_s=6.0, workers_per_scenario=4,
+            ).run()
+            master._run_maintenance(time.monotonic())  # scrape + evaluate
+            v = loadharness.verdict(
+                sess, rules=["bench_ingest_shed"],
+                fired_since=rep["started_at"],
+            )
+            scen = rep["scenarios"]
+            out["ctl_sustained_verdict_pass"] = v["pass"]
+            for plane, key in (("metric_report", "metrics"),
+                               ("span_ingest", "traces"),
+                               ("log_ingest", "logs"),
+                               ("profile_ingest", "profiles")):
+                out[f"ctl_{key}_ingest_qps"] = scen[plane]["achieved_qps"]
+            out["ctl_submit_p99_ms"] = scen["submit_churn"]["p99_ms"]
+            out["ctl_control_p99_ms"] = scen["control"]["p99_ms"]
+
+            # Phase B — above capacity: tighten the bulk bounds live and
+            # drive past them. Shed must be counted WITH Retry-After and
+            # the control lane's p99 must stay bounded mid-flood.
+            master.admission.per_plane = {
+                "metrics": 1, "traces": 0, "logs": 0, "profiles": 0,
+            }
+            rep2 = loadharness.LoadHarness(
+                api.url,
+                mix={"metric_report": 60, "span_ingest": 30,
+                     "log_ingest": 30, "profile_ingest": 10,
+                     "control": 10},
+                duration_s=4.0, workers_per_scenario=4,
+            ).run()
+            scen2 = rep2["scenarios"]
+            shed = sum(s.get("shed", 0) for s in scen2.values())
+            out["ctl_overload_shed_count"] = shed
+            out["ctl_overload_retry_after_seen"] = any(
+                s["retry_after_seen"] for s in scen2.values()
+            )
+            out["ctl_overload_control_p99_ms"] = scen2["control"]["p99_ms"]
+            master.admission.per_plane = {}
+
+            # Phase C — deliberate fault plan: every admission call
+            # sheds; the shed-watching rule must fire and the verdict
+            # must name it.
+            with faults_mod.plan_active(faults_mod.FaultPlan({
+                "master.overload": faults_mod.FaultSpec(error_rate=1.0),
+            })):
+                rep3 = loadharness.LoadHarness(
+                    api.url, mix={"span_ingest": 20},
+                    duration_s=2.0, workers_per_scenario=2,
+                ).run()
+                master._run_maintenance(time.monotonic())
+                v3 = loadharness.verdict(
+                    sess, rules=["bench_ingest_shed"],
+                    fired_since=rep3["started_at"],
+                )
+            out["ctl_fault_verdict_fails"] = not v3["pass"]
+            out["ctl_fault_violated_rule"] = ",".join(
+                v3["violated_rules"]
+            )
+        finally:
+            api.stop()
+            master.shutdown()
+        return out
+    except Exception:  # noqa: BLE001 — skip the rung, keep the headline
+        import traceback
+
+        traceback.print_exc()
+        return None
+
+
 def main() -> None:
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
@@ -1417,6 +1533,14 @@ def main() -> None:
         lr = log_rung(step_time_s)
         if lr is not None:
             record.update(lr)
+    if not os.environ.get("DTPU_BENCH_SKIP_CONTROL_PLANE"):
+        # Control-plane load harness (PR 15): sustained four-plane ingest
+        # QPS with a green SLO verdict, then above-capacity shed with the
+        # control lane's p99 held, then a fault-plan drive the verdict
+        # must fail by name.
+        cr = control_plane_rung()
+        if cr is not None:
+            record.update(cr)
     print(json.dumps(record))
 
 

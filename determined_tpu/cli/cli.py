@@ -1276,6 +1276,65 @@ def alerts_list(args: argparse.Namespace) -> None:
     print(f"rules loaded: {', '.join(out.get('rules', []))}")
 
 
+# -- load harness (common/loadharness.py: the master as its own k6) -----------
+def loadtest_run(args: argparse.Namespace) -> None:
+    from determined_tpu.common import loadharness
+
+    cfg: Dict[str, Any] = (
+        _load_config(args.config) if args.config else {}
+    )
+    if not isinstance(cfg, dict):
+        _die("loadtest config must be an object")
+    if args.duration is not None:
+        cfg["duration_s"] = args.duration
+    rules = cfg.pop("slo_rules", None)
+    session = _session(args)
+    try:
+        harness = loadharness.LoadHarness(
+            session.master_url, token=session.token, **cfg
+        )
+    except (TypeError, ValueError) as e:
+        _die(str(e))
+    report = harness.run()
+    verdict_doc = loadharness.verdict(
+        session, rules=rules, fired_since=report["started_at"]
+    )
+    if args.json:
+        print(json.dumps({"report": report, "verdict": verdict_doc},
+                         indent=2))
+    else:
+        print(loadharness.format_report(report, verdict_doc))
+    if not verdict_doc["pass"]:
+        sys.exit(1)
+
+
+def loadtest_report(args: argparse.Namespace) -> None:
+    """Verdict-only: judge the SLO surface as it stands (after a drive,
+    a deploy, or anything else) without offering new load."""
+    from determined_tpu.common import loadharness
+
+    verdict_doc = loadharness.verdict(
+        _session(args),
+        rules=args.rule or None,
+        fired_since=args.since,
+    )
+    if args.json:
+        print(json.dumps(verdict_doc, indent=2))
+    else:
+        print(
+            "verdict: PASS" if verdict_doc["pass"]
+            else "verdict: FAIL (violated: "
+            + ", ".join(verdict_doc["violated_rules"]) + ")"
+        )
+        seg = verdict_doc.get("slow_segment")
+        if seg:
+            print(f"slow segment: {seg['segment']} p99={seg['p99_s']}s")
+        for tid in verdict_doc.get("exemplar_trace_ids", []):
+            print(f"exemplar trace: {tid}")
+    if not verdict_doc["pass"]:
+        sys.exit(1)
+
+
 # -- job queue -----------------------------------------------------------------
 def queue_list(args: argparse.Namespace) -> None:
     queues = _session(args).get("/api/v1/queues")["queues"]
@@ -1756,6 +1815,28 @@ def build_parser() -> argparse.ArgumentParser:
     alerts.add_argument("--history", action="store_true",
                         help="also print recently resolved alerts")
     alerts.set_defaults(fn=alerts_list, verb="list")
+
+    loadtest = sub.add_parser("loadtest").add_subparsers(
+        dest="verb", required=True)
+    v = loadtest.add_parser("run")
+    v.add_argument("--config", default=None,
+                   help="JSON/YAML harness config: mix (scenario → qps), "
+                        "duration_s, workers_per_scenario, slo_rules "
+                        '(docs/operations.md "Load harness & overload '
+                        'control")')
+    v.add_argument("--duration", type=float, default=None,
+                   help="override the config's duration_s")
+    v.add_argument("--json", action="store_true",
+                   help="print the raw report + verdict JSON")
+    v.set_defaults(fn=loadtest_run)
+    v = loadtest.add_parser("report")
+    v.add_argument("--rule", action="append", default=[],
+                   help="SLO rule to watch (repeatable; default: all)")
+    v.add_argument("--since", type=float, default=0.0,
+                   help="unix seconds: resolved alerts that FIRED after "
+                        "this still fail the verdict")
+    v.add_argument("--json", action="store_true")
+    v.set_defaults(fn=loadtest_report)
 
     queue = sub.add_parser("queue", aliases=["q"]).add_subparsers(
         dest="verb", required=True)

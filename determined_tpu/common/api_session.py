@@ -33,6 +33,13 @@ from determined_tpu.common.resilience import (
 
 RETRY_STATUSES = (502, 503, 504)
 
+#: Admission shed (master overload layer, serving SLO admission): retried
+#: under the policy — which honors the response's Retry-After pacing — but
+#: recorded as breaker SUCCESS: a 429 is a HEALTHY endpoint protecting
+#: itself, and opening the circuit would turn deliberate load-shedding
+#: into a self-inflicted outage.
+SHED_STATUS = 429
+
 #: Methods that carry the idempotency header (GET is naturally idempotent).
 MUTATING_METHODS = ("POST", "PATCH", "DELETE")
 
@@ -163,7 +170,10 @@ class Session:
                     stream=stream,
                     **({} if self._verify is None else {"verify": self._verify}),
                 )
-                if resp.status_code in RETRY_STATUSES:
+                if (
+                    resp.status_code in RETRY_STATUSES
+                    or resp.status_code == SHED_STATUS
+                ):
                     raise requests.HTTPError(
                         f"retryable status {resp.status_code}", response=resp
                     )
@@ -197,6 +207,7 @@ class Session:
                 return (
                     e.response is None
                     or e.response.status_code in RETRY_STATUSES
+                    or e.response.status_code == SHED_STATUS
                 )
             return self._policy.should_retry(e)
 

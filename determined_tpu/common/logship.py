@@ -32,6 +32,7 @@ import atexit
 import logging
 import os
 import threading
+import time
 from collections import deque
 from typing import Any, Callable, Deque, Dict, List, Optional
 
@@ -63,6 +64,12 @@ LINES_DROPPED = METRICS.counter(
     "malformed records, store caps. Every loss is counted under a "
     "reason; a level-floor filter is policy, not loss.",
     labels=("reason",),
+)
+SHIP_BACKOFFS = METRICS.counter(
+    "dtpu_log_ship_backoffs_total",
+    "Flush pauses honoring the master's 429 + Retry-After ingest shed "
+    "(the batch is re-queued, not lost — loss still counts under "
+    "dtpu_log_lines_dropped_total).",
 )
 
 #: Level-name → numeric severity for floors (stdlib values; unknown
@@ -110,6 +117,9 @@ class LogShipper:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._wake = threading.Event()
+        # Monotonic deadline while honoring a 429 shed's Retry-After; the
+        # buffer keeps absorbing (drop-oldest) until it passes.
+        self._paused_until = 0.0
         self._thread = threading.Thread(
             target=self._run, name="dtpu-log-shipper", daemon=True
         )
@@ -131,7 +141,16 @@ class LogShipper:
     def flush(self) -> None:
         """Ship everything buffered, synchronously. One POST per batch;
         a failed batch is counted lost and NOT retried here (the Session
-        already retried transport blips) — flush must terminate."""
+        already retried transport blips) — flush must terminate. The one
+        exception is an admission shed (429 + Retry-After): the batch is
+        re-queued at the FRONT of the buffer and flushing pauses until
+        the advertised deadline — backoff, not loss."""
+        # Lazy import: resilience logs through handlers that may enqueue
+        # here.
+        from determined_tpu.common.resilience import shed_backoff
+
+        if time.monotonic() < self._paused_until:
+            return  # honoring a shed pause; buffer keeps absorbing
         while True:
             with self._lock:
                 if not self._buffer:
@@ -141,12 +160,28 @@ class LogShipper:
                     for _ in range(min(self._batch_size, len(self._buffer)))
                 ]
             try:
+                faults.inject("client.ingest_backoff")
                 faults.inject("client.log_ship")
                 self._session.post(
                     "/api/v1/logs/ingest", json_body={"lines": batch}
                 )
                 LINES_SHIPPED.inc(len(batch))
             except Exception as e:  # noqa: BLE001 — loss, never propagation
+                pause = shed_backoff(e)
+                if pause is not None:
+                    # Shed, not failure: put the batch back in order and
+                    # stand down. Re-queueing may overflow the bound —
+                    # that loss is the normal drop-oldest discipline.
+                    with self._lock:
+                        self._buffer.extendleft(reversed(batch))
+                        while len(self._buffer) > self._max_buffer:
+                            self._buffer.popleft()
+                            LINES_DROPPED.labels("buffer_overflow").inc()
+                    self._paused_until = time.monotonic() + pause
+                    SHIP_BACKOFFS.inc()
+                    logger.debug("log ship shed by %s; backing off %.2fs",
+                                 self.master_url, pause)
+                    return
                 LINES_DROPPED.labels("ship_failed").inc(len(batch))
                 logger.debug("log ship to %s failed: %s",
                              self.master_url, e)
@@ -164,7 +199,16 @@ class LogShipper:
         self._wake.set()
         self._thread.join(timeout=5)
         if flush:
+            # Final drain ignores any shed pause — one last attempt; if
+            # the master is still shedding, the leftovers are LOSS and
+            # must be counted (the process is going away with them).
+            self._paused_until = 0.0
             self.flush()
+            with self._lock:
+                leftover = len(self._buffer)
+                self._buffer.clear()
+            if leftover:
+                LINES_DROPPED.labels("ship_failed").inc(leftover)
 
 
 class StructuredLogHandler(logging.Handler):

@@ -76,6 +76,12 @@ WINDOWS_DROPPED = METRICS.counter(
     "ship failures, shipper-buffer overflow, sink errors, store caps.",
     labels=("reason",),
 )
+SHIP_BACKOFFS = METRICS.counter(
+    "dtpu_profile_ship_backoffs_total",
+    "Flush pauses honoring the master's 429 + Retry-After ingest shed "
+    "(the batch is re-queued, not lost — loss still counts under "
+    "dtpu_profile_windows_dropped_total).",
+)
 SAMPLES_TAKEN = METRICS.counter(
     "dtpu_profile_samples_total",
     "Thread-stack samples taken by this process's sampling profiler.",
@@ -165,6 +171,9 @@ class ProfileShipper:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._wake = threading.Event()
+        # Monotonic deadline while honoring a 429 shed's Retry-After; the
+        # buffer keeps absorbing (drop-oldest) until it passes.
+        self._paused_until = 0.0
         self._thread = threading.Thread(
             target=self._run, name="dtpu-profile-shipper", daemon=True
         )
@@ -185,7 +194,14 @@ class ProfileShipper:
     def flush(self) -> None:
         """Ship everything buffered, synchronously. One POST per batch;
         a failed batch is counted lost and NOT retried here (the Session
-        already retried transport blips) — flush must terminate."""
+        already retried transport blips) — flush must terminate. The one
+        exception is an admission shed (429 + Retry-After): the batch is
+        re-queued at the FRONT of the buffer and flushing pauses until
+        the advertised deadline — backoff, not loss."""
+        from determined_tpu.common.resilience import shed_backoff
+
+        if time.monotonic() < self._paused_until:
+            return  # honoring a shed pause; buffer keeps absorbing
         while True:
             with self._lock:
                 if not self._buffer:
@@ -195,12 +211,32 @@ class ProfileShipper:
                     for _ in range(min(self._batch_size, len(self._buffer)))
                 ]
             try:
+                faults.inject("client.ingest_backoff")
                 faults.inject("client.profile_ship")
                 self._session.post(
                     "/api/v1/profiles/ingest", json_body={"windows": batch}
                 )
                 WINDOWS_SHIPPED.inc(len(batch))
             except Exception as e:  # noqa: BLE001 — loss, never propagation
+                pause = shed_backoff(e)
+                if pause is not None:
+                    # Shed, not failure: put the batch back in order and
+                    # stand down. Re-queueing may overflow the bound —
+                    # that loss is the normal drop-oldest discipline.
+                    with self._lock:
+                        self._buffer.extendleft(reversed(batch))
+                        while len(self._buffer) > self._max_buffer:
+                            self._buffer.popleft()
+                            WINDOWS_DROPPED.labels(
+                                "buffer_overflow"
+                            ).inc()
+                    self._paused_until = time.monotonic() + pause
+                    SHIP_BACKOFFS.inc()
+                    logger.debug(
+                        "profile ship shed by %s; backing off %.2fs",
+                        self.master_url, pause,
+                    )
+                    return
                 WINDOWS_DROPPED.labels("ship_failed").inc(len(batch))
                 logger.debug("profile ship to %s failed: %s",
                              self.master_url, e)
@@ -218,7 +254,16 @@ class ProfileShipper:
         self._wake.set()
         self._thread.join(timeout=5)
         if flush:
+            # Final drain ignores any shed pause — one last attempt; if
+            # the master is still shedding, the leftovers are LOSS and
+            # must be counted (the process is going away with them).
+            self._paused_until = 0.0
             self.flush()
+            with self._lock:
+                leftover = len(self._buffer)
+                self._buffer.clear()
+            if leftover:
+                WINDOWS_DROPPED.labels("ship_failed").inc(leftover)
 
 
 def _thread_name(ident: int) -> str:

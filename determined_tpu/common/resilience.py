@@ -81,6 +81,59 @@ class RetryError(Exception):
     """All attempts exhausted; `__cause__` is the last underlying failure."""
 
 
+def retry_after_hint(exc: BaseException) -> Optional[float]:
+    """Server-provided pacing: the `Retry-After` seconds carried by a 429
+    (ingest admission shed) or 503 (restore-pending, serving saturation)
+    response, else None.
+
+    Duck-typed off ``exc.response`` (requests.HTTPError shape) so this
+    module keeps its no-requests-import rule. Junk values — the HTTP-date
+    form, non-numeric strings, negatives — yield None: the caller's own
+    backoff computes the pause instead. Callers cap the hint themselves
+    (RetryPolicy.call clamps to ``max_delay``) so a hostile/buggy server
+    cannot park a client for an hour."""
+    resp = getattr(exc, "response", None)
+    if resp is None or getattr(resp, "status_code", None) not in (429, 503):
+        return None
+    headers = getattr(resp, "headers", None)
+    if headers is None:
+        return None
+    try:
+        raw = headers.get("Retry-After")
+    except Exception:  # noqa: BLE001 — malformed mapping: no hint
+        return None
+    if raw is None:
+        return None
+    try:
+        value = float(raw)
+    except (TypeError, ValueError):
+        return None
+    return value if value >= 0 else None
+
+
+def shed_backoff(
+    exc: BaseException, *, default_s: float = 1.0, cap_s: float = 5.0
+) -> Optional[float]:
+    """Pause (seconds) a shipper should honor when `exc` is an ingest
+    SHED — an HTTP 429 from the master's admission layer, or the
+    `client.ingest_backoff` fault site — else None (every other failure
+    keeps the count-and-drop path: flush must terminate).
+
+    A 429 without a parseable Retry-After still backs off ``default_s``;
+    the hint is clamped to ``cap_s`` (same hostile-server rule as
+    retry_after_hint's callers)."""
+    if (
+        isinstance(exc, InjectedFault)
+        and getattr(exc, "site", "") == "client.ingest_backoff"
+    ):
+        return default_s
+    resp = getattr(exc, "response", None)
+    if getattr(resp, "status_code", None) != 429:
+        return None
+    hint = retry_after_hint(exc)
+    return min(hint, cap_s) if hint is not None else default_s
+
+
 class CircuitOpenError(ConnectionError):
     """Fail-fast: the endpoint's circuit is open (recent consecutive
     failures); retrying immediately would only burn connect timeouts.
@@ -169,6 +222,13 @@ class RetryPolicy:
                 if attempt + 1 >= self.max_attempts:
                     raise
                 pause = self.delay(attempt, key=key)
+                # Server-provided pacing wins over the computed backoff:
+                # a 429/503 carrying Retry-After names exactly when the
+                # endpoint wants the retry (the admission layer's shed
+                # contract), clamped to this policy's own ceiling.
+                hint = retry_after_hint(e)
+                if hint is not None:
+                    pause = min(hint, self.max_delay)
                 if (
                     self.deadline_s is not None
                     and clock() - start + pause > self.deadline_s

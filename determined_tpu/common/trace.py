@@ -87,6 +87,12 @@ SPANS_SAMPLED_OUT = METRICS.counter(
     "Spans intentionally not shipped by the tail-sampling policy "
     "(unremarkable and head-sampled out by trace-id hash).",
 )
+SHIP_BACKOFFS = METRICS.counter(
+    "dtpu_trace_ship_backoffs_total",
+    "Flush pauses honoring the master's 429 + Retry-After ingest shed "
+    "(the batch is re-queued, not lost — loss still counts under "
+    "dtpu_trace_spans_dropped_total).",
+)
 
 _TRACEPARENT_RE = re.compile(
     r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
@@ -193,6 +199,10 @@ class SpanShipper:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._wake = threading.Event()
+        #: monotonic deadline of a master-requested shed pause (429 +
+        #: Retry-After): flush no-ops until then, the bounded buffer keeps
+        #: absorbing with its usual drop-oldest discipline.
+        self._paused_until = 0.0
         self._thread = threading.Thread(
             target=self._run, name="dtpu-span-shipper", daemon=True
         )
@@ -214,7 +224,15 @@ class SpanShipper:
     def flush(self) -> None:
         """Ship everything buffered, synchronously. One POST per batch;
         a failed batch is counted lost and NOT retried here (the Session
-        already retried transport blips) — flush must terminate."""
+        already retried transport blips) — flush must terminate. The one
+        exception is a 429 SHED from the master's admission layer: the
+        batch re-queues at the buffer FRONT (order kept, loss still only
+        through the counted drop-oldest cap) and flush pauses for the
+        response's Retry-After."""
+        from determined_tpu.common.resilience import shed_backoff
+
+        if time.monotonic() < self._paused_until:
+            return  # honoring a shed pause; buffer keeps absorbing
         while True:
             with self._lock:
                 if not self._buffer:
@@ -224,12 +242,27 @@ class SpanShipper:
                     for _ in range(min(self._batch_size, len(self._buffer)))
                 ]
             try:
+                faults.inject("client.ingest_backoff")
                 faults.inject("client.trace_ship")
                 self._session.post(
                     "/api/v1/traces/ingest", json_body={"spans": batch}
                 )
                 SPANS_SHIPPED.inc(len(batch))
             except Exception as e:  # noqa: BLE001 — loss, never propagation
+                pause = shed_backoff(e)
+                if pause is not None:
+                    with self._lock:
+                        self._buffer.extendleft(reversed(batch))
+                        while len(self._buffer) > self._max_buffer:
+                            self._buffer.popleft()
+                            SPANS_DROPPED.labels("buffer_overflow").inc()
+                    self._paused_until = time.monotonic() + pause
+                    SHIP_BACKOFFS.inc()
+                    logger.debug(
+                        "span ship shed by %s; backing off %.2fs",
+                        self.master_url, pause,
+                    )
+                    return
                 SPANS_DROPPED.labels("ship_failed").inc(len(batch))
                 logger.debug("span ship to %s failed: %s",
                              self.master_url, e)
@@ -247,7 +280,17 @@ class SpanShipper:
         self._wake.set()
         self._thread.join(timeout=5)
         if flush:
+            # One final attempt regardless of a standing shed pause (the
+            # process is exiting; the master may have recovered). If the
+            # master sheds again, the leftover batch would vanish
+            # uncounted — count it as ship loss.
+            self._paused_until = 0.0
             self.flush()
+            with self._lock:
+                leftover = len(self._buffer)
+                self._buffer.clear()
+            if leftover:
+                SPANS_DROPPED.labels("ship_failed").inc(leftover)
 
 
 _shipper: Optional[SpanShipper] = None

@@ -171,6 +171,26 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
         "severity": "warning",
         "help": ">10 ERROR log lines ingested cluster-wide in the last 60s",
     },
+    {
+        # Two-lane overload control (master/overload.py): transient shed
+        # is the admission layer doing its job — the shippers pace and
+        # retry, nothing is lost. SUSTAINED shed means the cluster's
+        # telemetry volume outruns its admission bounds: raise the
+        # bounds or shrink the fleet's report cadence. The load
+        # harness's above-capacity drive trips this rule on purpose.
+        "name": "ingest_shed_sustained",
+        "kind": "ratio",
+        "num": {"metric": "dtpu_ingest_shed_total", "func": "increase",
+                "window_s": 300.0, "match": {"instance": "master"}},
+        "den": {"metric": "dtpu_api_requests_total", "func": "increase",
+                "window_s": 300.0, "match": {"instance": "master"}},
+        "op": ">",
+        "value": 0.25,
+        "for_s": 60.0,
+        "severity": "warning",
+        "help": ">25% of API requests answered with an ingest shed (429) "
+                "over 5m — telemetry volume is outrunning admission bounds",
+    },
 ]
 
 

@@ -295,6 +295,7 @@ class ApiError(Exception):
     def __init__(
         self, status: int, message: str,
         payload: Optional[Dict[str, Any]] = None,
+        headers: Optional[Dict[str, str]] = None,
     ) -> None:
         super().__init__(message)
         self.status = status
@@ -302,6 +303,9 @@ class ApiError(Exception):
         #: generation-fence 409 carries the resize directive so a fenced
         #: straggler can re-sync from the rejection itself).
         self.payload = payload or {}
+        #: extra response headers (e.g. the admission-shed 429 carries
+        #: Retry-After so shippers pace instead of hammering).
+        self.headers = headers or {}
 
 
 def _q_num(raw: Any, conv: Callable[[Any], Any], name: str) -> Any:
@@ -384,6 +388,22 @@ class ApiRequest:
     def qfloat(self, name: str, default: float) -> float:
         v = self.q(name)
         return float(v) if v is not None else default
+
+
+#: The BULK lane: high-volume loss-tolerant telemetry ingest routes, by
+#: (method, compiled-pattern) → plane label. Requests matching these pass
+#: through `master.admission` (master/overload.py) and answer 429 +
+#: Retry-After when the plane is saturated; every other route — all of
+#: control (rendezvous, progress beats, preemption polls, resize) — is
+#: never queued behind them. Keys must match build_routes() patterns
+#: verbatim (pinned by tests/test_metrics_discipline.py, so a route
+#: rename cannot silently take its plane out from under admission).
+BULK_INGEST_PLANES: Dict[Tuple[str, str], str] = {
+    ("POST", r"^/api/v1/trials/(\d+)/metrics$"): "metrics",
+    ("POST", r"^/api/v1/traces/ingest$"): "traces",
+    ("POST", r"^/api/v1/logs/ingest$"): "logs",
+    ("POST", r"^/api/v1/profiles/ingest$"): "profiles",
+}
 
 
 def build_routes(m: Master) -> List[Tuple[str, re.Pattern, Handler]]:
@@ -2445,10 +2465,39 @@ class ApiServer:
                             ).inc()
 
                         status_code = 200
+                        admitted_plane = None
                         try:
                             # activate(): master-internal spans started by
                             # the handler parent under the request span.
                             with master.tracer.activate(span):
+                                # Two-lane overload control: bulk telemetry
+                                # ingest passes per-plane admission; a
+                                # saturated plane answers 429 + Retry-After
+                                # HERE, before the handler runs, so control
+                                # routes (not in the map) never wait behind
+                                # a telemetry flood. Raising inside the
+                                # span keeps finish() observing the 429
+                                # into dtpu_api_requests_total.
+                                plane = BULK_INGEST_PLANES.get(
+                                    (method, pat.pattern)
+                                )
+                                if plane is not None:
+                                    if not master.admission.try_acquire(
+                                        plane
+                                    ):
+                                        ra = master.admission.retry_after_s
+                                        raise ApiError(
+                                            429,
+                                            f"{plane} ingest saturated",
+                                            payload={
+                                                "plane": plane,
+                                                "retry_after_s": ra,
+                                            },
+                                            headers={
+                                                "Retry-After": "%g" % ra
+                                            },
+                                        )
+                                    admitted_plane = plane
                                 result = handler(
                                     ApiRequest(
                                         match.groups(), body,
@@ -2560,7 +2609,8 @@ class ApiServer:
                             if e.status >= 500:
                                 span.status = "ERROR"
                             self._send(
-                                e.status, {"error": str(e), **e.payload}
+                                e.status, {"error": str(e), **e.payload},
+                                headers=e.headers or None,
                             )
                         except KeyError as e:
                             status_code = 404
@@ -2571,6 +2621,8 @@ class ApiServer:
                             logger.exception("handler error %s %s", method, parsed.path)
                             self._send(500, {"error": str(e)})
                         finally:
+                            if admitted_plane is not None:
+                                master.admission.release(admitted_plane)
                             finish(status_code)
                             # Append-only audit of every mutating API call
                             # (ref internal/audit.go): who, what, outcome.
@@ -2670,11 +2722,14 @@ class ApiServer:
                 self.close_connection = True
 
             def _send(self, status: int, payload: Dict[str, Any],
-                      close: bool = False) -> None:
+                      close: bool = False,
+                      headers: Optional[Dict[str, str]] = None) -> None:
                 data = json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 if close:
                     # Rejected without reading the declared body: the next
                     # keep-alive request would parse body bytes as a

@@ -76,6 +76,18 @@ SENTINEL_DIVERGENCE = METRICS.counter(
     "dtpu_sentinel_divergence_exits_total",
     "Trial exits attributed to a replica-divergence audit failure.",
 )
+#: Per-phase cost of the background tick: the ROADMAP's "tick cost
+#: independent of experiment count" item, made measurable — the load
+#: harness drives experiment count up and this histogram names which
+#: phase grows with it (scheduler / agent_sweep / stall_sweep / scrape /
+#: alerts / retention).
+TICK_DURATION = METRICS.histogram(
+    "dtpu_master_tick_duration_seconds",
+    "Background-tick phase duration: scheduler (every wake), and the "
+    "1 s-cadence maintenance phases (agent sweeps, stall sweep, scrape "
+    "trigger, alert evaluation, retention trims).",
+    labels=("phase",),
+)
 
 
 class AgentHub:
@@ -408,6 +420,7 @@ class Master:
         profiling_config: Optional[Dict[str, Any]] = None,
         logs_config: Optional[Dict[str, Any]] = None,
         router_config: Optional[Dict[str, Any]] = None,
+        overload_config: Optional[Dict[str, Any]] = None,
     ) -> None:
         # Validated config tier (masterconf.py, the config.go:129 analog):
         # fail at boot with every problem named, not mid-scheduling on the
@@ -424,6 +437,7 @@ class Master:
             profiling=profiling_config,
             logs=logs_config,
             router=router_config,
+            overload=overload_config,
         )
         self.cluster_id = uuid.uuid4().hex[:8]
         self._external_url = external_url
@@ -595,6 +609,15 @@ class Master:
         rcfg = dict(masterconf.ROUTER_DEFAULTS)
         rcfg.update(router_config or {})
         self.router = Router(self, rcfg)
+        # Two-lane overload control (master/overload.py): bulk telemetry
+        # ingest passes per-plane admission in the API dispatcher; when a
+        # plane saturates, the answer is 429 + Retry-After — never control
+        # traffic queued behind a telemetry flood.
+        from determined_tpu.master.overload import AdmissionController
+
+        ocfg = dict(masterconf.OVERLOAD_DEFAULTS)
+        ocfg.update(overload_config or {})
+        self.admission = AdmissionController(ocfg)
         acfg = dict(masterconf.ALERTS_DEFAULTS)
         acfg.update(alerts_config or {})
         self.alert_engine = AlertEngine(
@@ -939,6 +962,7 @@ class Master:
             try:
                 # Scheduling half: runs on every wake (kicks included) —
                 # cheap, and latency here is trial-start latency.
+                t_sched = _time.monotonic()
                 self.rm.tick_all()
                 for alloc_id in self.alloc_service.overdue_preemptions():
                     # Escalate, don't just kill: a rank that acked the
@@ -966,6 +990,9 @@ class Master:
                         ),
                         infra=True,
                     )
+                TICK_DURATION.labels("scheduler").observe(
+                    _time.monotonic() - t_sched
+                )
                 # Maintenance half stays on the 1 s cadence even under a
                 # kick storm (an ASHA burst of exits): pool.sync() can be
                 # a live k8s LIST, and the sweeps are O(cluster) — kicks
@@ -973,51 +1000,68 @@ class Master:
                 now = _time.monotonic()
                 if now - last_maintenance >= 1.0:
                     last_maintenance = now
-                    for pool in self.rm.pools.values():
-                        pool.sync()  # backend state poll (k8s; agent no-op)
-                    # Agent failure detection: an agent silent past the
-                    # timeout is gone — fail its allocations over (trial
-                    # restart budget applies; ref containers/manager.go:76).
-                    for agent_id in self.agent_hub.reap_stale(
-                        self.agent_timeout_s
-                    ):
-                        self.lose_agent(agent_id)
-                    self._reconcile_sweep()
-                    self._reap_unmanaged()
-                    self._reap_idle_commands()
-                    self._stall_sweep()
-                    self._elastic_grow_sweep()
-                    self._prune_heartbeats()
-                    self.auth.sweep()
-                    # Time-series plane: scrape sweep + alert evaluation
-                    # ride the maintenance cadence. Both are internally
-                    # interval-gated and per-target/per-rule fault-isolated
-                    # (a dead scrape target costs at most its HTTP timeout;
-                    # a broken rule logs and skips).
-                    self.scraper.maybe_scrape()
-                    self.alert_engine.maybe_evaluate()
-                    # Trace plane retention: a quiet store must not hold
-                    # stale traces at full retention forever (O(evictions)
-                    # per sweep; ingest trims too).
-                    self.tracestore.trim()
-                    # Profiling plane retention: same contract for the
-                    # profile store's windows.
-                    self.profilestore.trim()
-                    # Log plane retention: same contract for the line store.
-                    self.logstore.trim()
-                    # task_logs (SQLite system of record) retention: the
-                    # table otherwise only shrinks on per-trial delete, so
-                    # a chatty fleet grows it forever. Gated to ~30 s —
-                    # it's a table scan, not a dict sweep.
-                    if now - self._last_task_log_trim >= 30.0:
-                        self._last_task_log_trim = now
-                        lcfg = self._logs_cfg
-                        self.db.trim_task_logs(
-                            max_age_s=float(lcfg["task_log_retention_s"]),
-                            max_rows=int(lcfg["task_log_max_rows"]),
-                        )
+                    self._run_maintenance(now)
             except Exception:  # noqa: BLE001
                 logger.exception("tick loop error")
+
+    def _run_maintenance(self, now: float) -> None:
+        """One maintenance sweep (the 1 s half of the tick), with each
+        phase's cost observed into dtpu_master_tick_duration_seconds — a
+        method (not tick-loop inline) so tests and drills can run a sweep
+        on demand and read the phase costs directly."""
+        import time as _time
+
+        t0 = _time.monotonic()
+        for pool in self.rm.pools.values():
+            pool.sync()  # backend state poll (k8s; agent no-op)
+        # Agent failure detection: an agent silent past the
+        # timeout is gone — fail its allocations over (trial
+        # restart budget applies; ref containers/manager.go:76).
+        for agent_id in self.agent_hub.reap_stale(self.agent_timeout_s):
+            self.lose_agent(agent_id)
+        self._reconcile_sweep()
+        self._reap_unmanaged()
+        self._reap_idle_commands()
+        t1 = _time.monotonic()
+        TICK_DURATION.labels("agent_sweep").observe(t1 - t0)
+        self._stall_sweep()
+        self._elastic_grow_sweep()
+        self._prune_heartbeats()
+        self.auth.sweep()
+        t2 = _time.monotonic()
+        TICK_DURATION.labels("stall_sweep").observe(t2 - t1)
+        # Time-series plane: scrape sweep + alert evaluation
+        # ride the maintenance cadence. Both are internally
+        # interval-gated and per-target/per-rule fault-isolated
+        # (a dead scrape target costs at most its HTTP timeout;
+        # a broken rule logs and skips).
+        self.scraper.maybe_scrape()
+        t3 = _time.monotonic()
+        TICK_DURATION.labels("scrape").observe(t3 - t2)
+        self.alert_engine.maybe_evaluate()
+        t4 = _time.monotonic()
+        TICK_DURATION.labels("alerts").observe(t4 - t3)
+        # Trace plane retention: a quiet store must not hold
+        # stale traces at full retention forever (O(evictions)
+        # per sweep; ingest trims too).
+        self.tracestore.trim()
+        # Profiling plane retention: same contract for the
+        # profile store's windows.
+        self.profilestore.trim()
+        # Log plane retention: same contract for the line store.
+        self.logstore.trim()
+        # task_logs (SQLite system of record) retention: the
+        # table otherwise only shrinks on per-trial delete, so
+        # a chatty fleet grows it forever. Gated to ~30 s —
+        # it's a table scan, not a dict sweep.
+        if now - self._last_task_log_trim >= 30.0:
+            self._last_task_log_trim = now
+            lcfg = self._logs_cfg
+            self.db.trim_task_logs(
+                max_age_s=float(lcfg["task_log_retention_s"]),
+                max_rows=int(lcfg["task_log_max_rows"]),
+            )
+        TICK_DURATION.labels("retention").observe(_time.monotonic() - t4)
 
     def set_experiment_traceparent(
         self, exp_id: int, ctx: Optional[tuple]

@@ -65,6 +65,11 @@ def main() -> None:
              '{"max_lines": 100000, "ship_level": "INFO"} '
              "(docs/operations.md \"Log plane\")")
     parser.add_argument(
+        "--overload-config", default=None,
+        help='JSON overload-control knobs, e.g. '
+             '{"max_inflight": 8, "per_plane": {"traces": 4}} '
+             "(docs/operations.md \"Load harness & overload control\")")
+    parser.add_argument(
         "--config-defaults", default=None,
         help="JSON experiment-config defaults merged under every submitted "
              'config (master.yaml analog), e.g. {"max_restarts": 2}')
@@ -112,6 +117,9 @@ def main() -> None:
         ),
         logs_config=(
             json.loads(args.logs_config) if args.logs_config else None
+        ),
+        overload_config=(
+            json.loads(args.overload_config) if args.overload_config else None
         ),
     )
     if bool(args.tls_cert) != bool(args.tls_key):

@@ -101,6 +101,67 @@ ROUTER_DEFAULTS = {
 }
 
 
+#: Two-lane overload-control knobs (`overload:` section): the bulk-ingest
+#: admission layer (master/overload.py; docs/operations.md "Load harness
+#: & overload control" documents each row).
+OVERLOAD_DEFAULTS = {
+    "enabled": True,        # False: admission never sheds (bookkeeping stays)
+    "max_inflight": 8,      # default per-plane in-flight bound
+    "per_plane": {},        # per-plane overrides, e.g. {"traces": 4}; 0 sheds all
+    "retry_after_s": 0.25,  # pacing hint advertised on every 429
+}
+
+
+def validate_overload(cfg: Optional[Dict[str, Any]]) -> List[str]:
+    errors: List[str] = []
+    if cfg is None:
+        return errors
+    if not isinstance(cfg, dict):
+        return ["overload must be an object of admission knobs"]
+    for key, value in cfg.items():
+        if key not in OVERLOAD_DEFAULTS:
+            errors.append(
+                f"overload: unknown key {key!r} "
+                f"(one of: {', '.join(sorted(OVERLOAD_DEFAULTS))})"
+            )
+            continue
+        if key == "enabled":
+            if not isinstance(value, bool):
+                errors.append("overload.enabled must be a bool")
+        elif key == "max_inflight":
+            if not isinstance(value, int) or isinstance(value, bool) \
+                    or value < 0:
+                errors.append(
+                    "overload.max_inflight must be an int >= 0 "
+                    "(0 sheds every bulk request)"
+                )
+        elif key == "per_plane":
+            if not isinstance(value, dict):
+                errors.append(
+                    "overload.per_plane must be an object of "
+                    "{plane: in-flight bound}"
+                )
+                continue
+            for plane, bound in value.items():
+                if not isinstance(plane, str) or not plane:
+                    errors.append(
+                        "overload.per_plane keys must be plane names"
+                    )
+                elif not isinstance(bound, int) or isinstance(bound, bool) \
+                        or bound < 0:
+                    errors.append(
+                        f"overload.per_plane[{plane!r}] must be an "
+                        "int >= 0 (0 sheds every request on the plane)"
+                    )
+        elif key == "retry_after_s":
+            if not isinstance(value, (int, float)) or isinstance(value, bool) \
+                    or value <= 0:
+                errors.append(
+                    "overload.retry_after_s must be a positive number"
+                )
+    return errors
+
+
 def validate_router(cfg: Optional[Dict[str, Any]]) -> List[str]:
     errors: List[str] = []
     if cfg is None:
@@ -340,6 +401,7 @@ def validate(
     profiling: Optional[Dict[str, Any]] = None,
     logs: Optional[Dict[str, Any]] = None,
     router: Optional[Dict[str, Any]] = None,
+    overload: Optional[Dict[str, Any]] = None,
 ) -> None:
     """Validate the master's startup configuration; raises ValueError with
     EVERY problem named (config.go-style: fail fast at boot, not at the
@@ -351,6 +413,7 @@ def validate(
     errors += validate_profiling(profiling)
     errors += validate_logs(logs)
     errors += validate_router(router)
+    errors += validate_overload(overload)
     if not isinstance(preempt_timeout_s, (int, float)) or (
         preempt_timeout_s <= 0
     ):

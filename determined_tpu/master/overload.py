@@ -1,0 +1,110 @@
+"""Two-lane overload control: bounded admission for bulk telemetry ingest.
+
+The master's API surface carries two kinds of traffic with very
+different contracts. CONTROL traffic — rendezvous arrivals, progress
+beats, preemption polls, resize directives — is tiny, latency-critical,
+and a stall there wedges real training work. BULK traffic — metric
+reports, span/log/profile-window ingest — is high-volume, loss-tolerant
+by design (every shipper already drops-oldest and counts the loss), and
+under overload it is the lane that must yield.
+
+`AdmissionController` bounds the number of bulk-ingest requests allowed
+in flight PER PLANE (metrics / traces / logs / profiles). When a plane
+is saturated the dispatcher answers **429 + Retry-After** instead of
+queueing the request behind the others: the shippers honor the header
+(requeue + pause, common/trace.py et al.), so load sheds at the edge
+while control routes — which never pass through admission — keep their
+latency. Every refusal is counted (`dtpu_ingest_shed_total{plane}`);
+deliberate shedding must be as observable as the loss discipline it
+protects.
+
+This is admission control, not queueing: the server is thread-per-
+connection (ThreadingHTTPServer), so bounding the bulk lane's
+concurrency is exactly what keeps bulk floods from eating the thread
+and GIL time the control lane needs.
+
+Config: the `overload:` masterconf section (masterconf.OVERLOAD_DEFAULTS)
+— `enabled`, `max_inflight` (default per-plane cap), `per_plane`
+(per-plane overrides, 0 = shed everything), `retry_after_s` (the pacing
+hint advertised on refusals). Fault site `master.overload` forces a
+shed regardless of occupancy, for drills.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict
+
+from determined_tpu.common import faults
+from determined_tpu.common.faults import InjectedFault
+from determined_tpu.common.metrics import REGISTRY as METRICS
+
+INGEST_SHED = METRICS.counter(
+    "dtpu_ingest_shed_total",
+    "Bulk-ingest requests refused with 429 + Retry-After because the "
+    "plane's admission bound was reached (or the master.overload fault "
+    "forced a shed). Shed is PACING, not loss — the shippers requeue "
+    "and back off; loss still counts at the shipper.",
+    labels=("plane",),
+)
+INGEST_INFLIGHT = METRICS.gauge(
+    "dtpu_ingest_inflight",
+    "Bulk-ingest requests currently admitted and executing, per plane.",
+    labels=("plane",),
+)
+
+
+class AdmissionController:
+    """Per-plane in-flight bound for bulk telemetry ingest.
+
+    `try_acquire(plane)` either admits the request (caller MUST pair it
+    with `release(plane)`, success or failure) or refuses it — refusals
+    are counted and the dispatcher turns them into 429 + Retry-After.
+    Planes are open-vocabulary: an unknown plane gets the default
+    `max_inflight` bound, so adding a telemetry plane to the dispatch
+    map is enough to put it under admission.
+    """
+
+    def __init__(self, cfg: Dict[str, Any]) -> None:
+        self.enabled = bool(cfg.get("enabled", True))
+        self.max_inflight = int(cfg.get("max_inflight", 8))
+        self.per_plane = {
+            str(k): int(v) for k, v in (cfg.get("per_plane") or {}).items()
+        }
+        self.retry_after_s = float(cfg.get("retry_after_s", 0.25))
+        self._lock = threading.Lock()
+        self._inflight: Dict[str, int] = {}
+
+    def limit(self, plane: str) -> int:
+        return self.per_plane.get(plane, self.max_inflight)
+
+    def try_acquire(self, plane: str) -> bool:
+        """Admit or refuse one bulk request for `plane`.
+
+        Returns True and bumps the in-flight count (caller must
+        `release`) — or counts a shed and returns False. The
+        `master.overload` fault site sheds unconditionally so drills can
+        prove the 429 path without real saturation.
+        """
+        try:
+            faults.inject("master.overload")
+        except InjectedFault:
+            INGEST_SHED.labels(plane).inc()
+            return False
+        with self._lock:
+            n = self._inflight.get(plane, 0)
+            if self.enabled and n >= self.limit(plane):
+                INGEST_SHED.labels(plane).inc()
+                return False
+            self._inflight[plane] = n + 1
+        INGEST_INFLIGHT.labels(plane).set(n + 1)
+        return True
+
+    def release(self, plane: str) -> None:
+        with self._lock:
+            n = max(0, self._inflight.get(plane, 0) - 1)
+            self._inflight[plane] = n
+        INGEST_INFLIGHT.labels(plane).set(n)
+
+    def inflight(self, plane: str) -> int:
+        with self._lock:
+            return self._inflight.get(plane, 0)

@@ -215,6 +215,38 @@ class TestCLI:
         out = capsys.readouterr().out
         assert "pending" in out and "trial:1" in out and "steps=3" in out
 
+    def test_loadtest_verbs(self, live_master, tmp_path, capsys):
+        """`dtpu loadtest run/report` (PR 15): a short real drive with a
+        scenario-mix config prints the per-scenario table and a verdict,
+        and the verdict-only verb judges the live alert surface."""
+        master, api = live_master
+        cfg = tmp_path / "drive.json"
+        cfg.write_text(json.dumps({
+            "mix": {"metric_report": 8, "query": 2, "control": 4},
+            "workers_per_scenario": 2,
+        }))
+        self._run(api, "loadtest", "run", "--config", str(cfg),
+                  "--duration", "1.0")
+        out = capsys.readouterr().out
+        assert "metric_report" in out and "control" in out
+        assert "verdict: PASS" in out
+        self._run(api, "loadtest", "report")
+        assert "verdict: PASS" in capsys.readouterr().out
+        # --json emits the machine-readable report + verdict document
+        self._run(api, "loadtest", "run", "--config", str(cfg),
+                  "--duration", "0.5", "--json")
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["verdict"]["pass"] is True
+        assert doc["report"]["scenarios"]["query"]["error"] == 0
+
+    def test_loadtest_bad_config_dies(self, live_master, tmp_path):
+        master, api = live_master
+        cfg = tmp_path / "bad.json"
+        cfg.write_text(json.dumps({"mix": {"bogus_scenario": 1.0}}))
+        with pytest.raises(SystemExit):
+            self._run(api, "loadtest", "run", "--config", str(cfg),
+                      "--duration", "0.5")
+
 
 class TestDownloadCode:
     def test_download_code_roundtrip(self, live_master, tmp_path, capsys):

@@ -109,7 +109,13 @@ class TestGuides:
                           "master.log_ingest", "ship_level",
                           "max_lines_per_target", "log_error_burst",
                           "dtpu_log_lines_total",
-                          "dtpu_task_log_rows_trimmed_total"),
+                          "dtpu_task_log_rows_trimmed_total",
+                          # load harness + overload control (PR 15)
+                          "loadtest run", "Retry-After",
+                          "dtpu_ingest_shed_total", "master.overload",
+                          "client.ingest_backoff", "max_inflight",
+                          "retry_after_s", "coordinated omission",
+                          "dtpu_master_tick_duration_seconds"),
         "expconf-reference.md": ("slots_per_trial", "max_slots",
                                  "checkpoint_storage",
                                  "profiling.sample_hz"),

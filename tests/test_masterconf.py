@@ -101,3 +101,61 @@ class TestTimeSeriesKnobs:
             assert m.tsdb.stale_after_s == 21.0
         finally:
             m.shutdown()
+
+
+class TestOverloadKnobs:
+    """PR 15: the two-lane admission bounds are operator-visible config
+    with named validation errors, and the master boot applies them."""
+
+    def test_valid_section_passes(self):
+        masterconf.validate(overload={
+            "enabled": True, "max_inflight": 16,
+            "per_plane": {"traces": 4, "logs": 8},
+            "retry_after_s": 0.5,
+        })
+
+    def test_typod_key_named(self):
+        with pytest.raises(ValueError, match="unknown key 'junk'"):
+            masterconf.validate(overload={"junk": 1})
+
+    def test_bad_values_named(self):
+        with pytest.raises(ValueError, match="max_inflight must be an int"):
+            masterconf.validate(overload={"max_inflight": -1})
+        with pytest.raises(ValueError, match=r"per_plane\['traces'\]"):
+            masterconf.validate(overload={"per_plane": {"traces": -2}})
+        with pytest.raises(ValueError,
+                           match="retry_after_s must be a positive"):
+            masterconf.validate(overload={"retry_after_s": 0})
+        with pytest.raises(ValueError, match="enabled must be a bool"):
+            masterconf.validate(overload={"enabled": "yes"})
+
+    def test_all_errors_reported_at_once(self):
+        with pytest.raises(ValueError) as exc:
+            masterconf.validate(overload={
+                "max_inflight": "lots", "retry_after_s": -1,
+            })
+        msg = str(exc.value)
+        assert "max_inflight" in msg and "retry_after_s" in msg
+
+    def test_master_boot_applies_overload_config(self):
+        m = Master(overload_config={
+            "max_inflight": 3, "per_plane": {"traces": 1},
+            "retry_after_s": 0.75,
+        })
+        try:
+            assert m.admission.max_inflight == 3
+            assert m.admission.limit("traces") == 1
+            assert m.admission.limit("logs") == 3  # falls back to global
+            assert m.admission.retry_after_s == 0.75
+            # fill the plane: the bound holds and releases recover it
+            assert m.admission.try_acquire("traces") is True
+            assert m.admission.try_acquire("traces") is False
+            m.admission.release("traces")
+            assert m.admission.try_acquire("traces") is True
+            m.admission.release("traces")
+        finally:
+            m.shutdown()
+
+    def test_master_boot_rejects_bad_overload(self):
+        with pytest.raises(ValueError, match="overload"):
+            Master(overload_config={"max_inflight": None})

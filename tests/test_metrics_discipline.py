@@ -708,6 +708,121 @@ class TestPrefixCacheAndRouterSeries:
         assert stats["prefix_cache"]["hits"] >= 1
 
 
+class TestOverloadAndHarnessSeries:
+    """PR 15: the two-lane admission map stays anchored to REAL route
+    patterns, a shed is visible on the LIVE /metrics surface (counter,
+    429 status family, inflight gauge at zero after release), the
+    maintenance tick publishes its phase histogram, and the harness's
+    own series are registered."""
+
+    def test_bulk_ingest_planes_are_registered_routes(self):
+        from determined_tpu.master.api_server import BULK_INGEST_PLANES
+
+        master = Master()
+        try:
+            patterns = {
+                (method, pattern.pattern)
+                for method, pattern, _h in build_routes(master)
+            }
+        finally:
+            master.shutdown()
+        # every admission key must name a real (method, pattern) — a
+        # route rename silently un-protecting a plane fails HERE
+        for key in BULK_INGEST_PLANES:
+            assert key in patterns, key
+        # all four telemetry planes are covered, control routes are not
+        assert sorted(BULK_INGEST_PLANES.values()) == [
+            "logs", "metrics", "profiles", "traces",
+        ]
+        assert not any("experiments" in k[1] or "allocations" in k[1]
+                       for k in BULK_INGEST_PLANES)
+
+    def test_shed_lands_on_live_metrics_surface(self):
+        master = Master(
+            overload_config={"per_plane": {"logs": 0},
+                             "retry_after_s": 0.05},
+        )
+        api = ApiServer(master)
+        api.start()
+        try:
+            r = requests.post(
+                f"{api.url}/api/v1/logs/ingest", json={"lines": []},
+                timeout=30,
+            )
+            assert r.status_code == 429
+            # the status counter lands in the dispatcher's finally AFTER
+            # the 429 reaches the client — re-scrape past that window
+            import time as _time
+
+            deadline = _time.monotonic() + 5.0
+            while True:
+                samples = parse_exposition(
+                    requests.get(f"{api.url}/metrics", timeout=30).text
+                )
+                if (sample_value(
+                        samples, "dtpu_api_requests_total", method="POST",
+                        route=r"^/api/v1/logs/ingest$", status="429",
+                ) or 0) >= 1 or _time.monotonic() > deadline:
+                    break
+                _time.sleep(0.02)
+            assert sample_value(
+                samples, "dtpu_ingest_shed_total", plane="logs"
+            ) >= 1
+            # shed requests are still observed requests (alert numerator)
+            assert sample_value(
+                samples, "dtpu_api_requests_total", method="POST",
+                route=r"^/api/v1/logs/ingest$", status="429",
+            ) >= 1
+            # acquire never happened, so inflight stays balanced at 0
+            assert sample_value(
+                samples, "dtpu_ingest_inflight", plane="logs"
+            ) == 0
+        finally:
+            api.stop()
+            master.shutdown()
+
+    def test_maintenance_tick_phases_published(self):
+        import time as _time
+
+        master = Master()
+        try:
+            master._run_maintenance(_time.monotonic())
+        finally:
+            master.shutdown()
+        fam = REGISTRY.get("dtpu_master_tick_duration_seconds")
+        assert tuple(fam.labelnames) == ("phase",)
+        text = REGISTRY.render()
+        for phase in ("agent_sweep", "stall_sweep", "scrape",
+                      "alerts", "retention"):
+            assert f'phase="{phase}"' in text, phase
+
+    def test_harness_series_registered(self):
+        import determined_tpu.common.loadharness  # noqa: F401
+
+        assert tuple(
+            REGISTRY.get(
+                "dtpu_loadharness_request_duration_seconds"
+            ).labelnames
+        ) == ("scenario",)
+        assert tuple(
+            REGISTRY.get("dtpu_loadharness_requests_total").labelnames
+        ) == ("scenario", "outcome")
+
+    def test_shed_alert_rule_shipped_and_valid(self):
+        from determined_tpu.master.alerts import (
+            DEFAULT_RULES,
+            validate_rule,
+        )
+
+        rule = next(
+            r for r in DEFAULT_RULES
+            if r["name"] == "ingest_shed_sustained"
+        )
+        assert validate_rule(rule) == []
+        assert rule["num"]["metric"] == "dtpu_ingest_shed_total"
+        assert rule["den"]["metric"] == "dtpu_api_requests_total"
+
+
 class TestNameDiscipline:
     def test_all_registered_names_are_dtpu_prefixed(self):
         # Importing the instrumented modules populates the registry.

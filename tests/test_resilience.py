@@ -149,6 +149,85 @@ class TestRetryPolicy:
         assert b.next_delay() == 0.5
 
 
+class _ShedResponse:
+    """Duck-typed response carrier: what requests.HTTPError exposes,
+    without importing requests into the unit under test's fixtures."""
+
+    def __init__(self, status_code, headers=None):
+        self.status_code = status_code
+        self.headers = headers if headers is not None else {}
+
+
+def _shed_error(status_code, retry_after=None):
+    e = requests.HTTPError(f"retryable status {status_code}")
+    headers = {} if retry_after is None else {"Retry-After": retry_after}
+    e.response = _ShedResponse(status_code, headers)
+    return e
+
+
+class TestRetryAfter:
+    """Satellite: RetryPolicy honors a Retry-After header on 429/503 so
+    the Session and every shipper pace to the server's hint for free."""
+
+    def _drive(self, exc, **policy_kw):
+        kw = dict(max_attempts=3, base_delay=1.0, multiplier=2.0,
+                  max_delay=10.0, jitter=0.0)
+        kw.update(policy_kw)
+        p = RetryPolicy(**kw)
+        slept = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise exc
+            return "ok"
+
+        assert p.call(
+            flaky, retry_if=lambda e: True, sleep=slept.append,
+        ) == "ok"
+        return slept
+
+    def test_header_present_overrides_backoff(self):
+        # Server said 3.5s; the computed backoff (1.0) loses.
+        assert self._drive(_shed_error(429, "3.5")) == [3.5]
+        assert self._drive(_shed_error(503, "2")) == [2.0]
+
+    def test_header_capped_at_policy_max(self):
+        # A hostile/huge hint cannot park the client for an hour.
+        assert self._drive(_shed_error(429, "3600")) == [10.0]
+
+    def test_header_absent_normal_backoff(self):
+        assert self._drive(_shed_error(429)) == [1.0]
+
+    def test_junk_header_normal_backoff(self):
+        # HTTP-date form and garbage both fall back to computed backoff
+        # (we only speak delta-seconds); negative values are junk too.
+        for junk in ("Wed, 21 Oct 2026 07:28:00 GMT", "soon", "", "-5"):
+            assert self._drive(_shed_error(429, junk)) == [1.0]
+
+    def test_non_shed_status_ignores_header(self):
+        # Retry-After only means pacing on 429/503.
+        assert self._drive(_shed_error(500, "9")) == [1.0]
+
+    def test_shed_backoff_classifier(self):
+        from determined_tpu.common.resilience import shed_backoff
+
+        # 429 with a hint: honor it, capped.
+        assert shed_backoff(_shed_error(429, "0.5")) == 0.5
+        assert shed_backoff(_shed_error(429, "60"), cap_s=5.0) == 5.0
+        # 429 without a hint: the default pause.
+        assert shed_backoff(_shed_error(429), default_s=2.0) == 2.0
+        # Not a shed: no pause (the normal ship_failed path applies).
+        assert shed_backoff(_shed_error(503, "2")) is None
+        assert shed_backoff(ConnectionError("down")) is None
+        # The client.ingest_backoff drill site reads as a shed.
+        assert shed_backoff(
+            InjectedFault("client.ingest_backoff"), default_s=1.5
+        ) == 1.5
+        assert shed_backoff(InjectedFault("client.trace_ship")) is None
+
+
 class TestCircuitBreaker:
     def test_open_after_threshold_and_half_open_probe(self):
         clock = _FakeClock()
