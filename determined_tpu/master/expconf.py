@@ -585,8 +585,9 @@ FIELDS: List[Tuple[str, str, str, str]] = [
      "operator) observing the shrunk mesh keeps it stable."),
     ("serving.model", "string", "tiny",
      "Generation-service tasks (task_type SERVING): model the replica "
-     "serves — `tiny`, `small` (GPT-2 124M class), or `medium`. See "
-     "docs/serving.md."),
+     "serves — `tiny`, `small` (GPT-2 124M class), `medium`, or "
+     "`fixture` (the bench's pre-trained tiny model; pair with "
+     "DTPU_SERVING_CHECKPOINT for real weights). See docs/serving.md."),
     ("serving.page_size", "int >= 1", "128",
      "KV-cache page size in tokens. Lane-friendly multiples of 128 keep "
      "the paged decode gather and flash-kernel block fitting efficient "
@@ -643,6 +644,24 @@ FIELDS: List[Tuple[str, str, str, str]] = [
      "The master's fleet router keys on the same leading-page hash so "
      "same-prefix requests land on the replica holding the prefix. See "
      "docs/serving.md 'Prefix cache & fleet routing'."),
+    ("serving.speculation.mode", "string", "off",
+     "Draft-assisted speculative decoding: `ngram` turns on the "
+     "prompt-lookup proposer (drafts the continuation of the request's "
+     "own most recent matching n-gram — no draft model) with a verify "
+     "step that scores all draft positions in ONE static-shape decode "
+     "iteration; `off` decodes one token per iteration. Greedy streams "
+     "are bit-identical either way — speculation only changes how many "
+     "iterations they take. DTPU_SPEC_DECODE=0 is the runtime kill "
+     "switch (=1 forces `ngram`). See docs/serving.md 'Speculative "
+     "decoding'."),
+    ("serving.speculation.draft_len", "int in [1, 8]", "4",
+     "Draft tokens proposed per slot per iteration; verify scores "
+     "`draft_len + 1` positions in one jitted decode call, so this is "
+     "compiled into the decode geometry (changing it recompiles once at "
+     "engine build, never mid-serve)."),
+    ("serving.speculation.min_match", "int >= 1", "2",
+     "Trailing n-gram length the prompt-lookup proposer must match "
+     "before it drafts; longer matches draft less often but hit more."),
     ("environment.variables", "object", "{}",
      "Extra environment variables for the task process."),
     ("environment.jax_platform", "string", "",

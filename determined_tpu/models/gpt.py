@@ -1144,6 +1144,155 @@ class GPT(Model):
         logits = self._head(params, x)  # [B, 1, V]
         return logits[:, 0].astype(jnp.float32), cache_k, cache_v
 
+    def decode_kv_spec(
+        self,
+        params: Dict[str, Any],
+        tokens: jax.Array,
+        lengths: jax.Array,
+        q_lens: jax.Array,
+        active: jax.Array,
+        cache_k: jax.Array,
+        cache_v: jax.Array,
+        page_table: jax.Array,
+        *,
+        q_pad: int = 1,
+        kernel: str = "gather",
+        block_h: Optional[int] = None,
+        interpret: bool = False,
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Draft-verify decode: score Q positions per slot in ONE step.
+
+        The speculative-decoding verify geometry: tokens [B, Q] int32
+        carries each slot's last committed token (row 0, at position
+        lengths[b]) followed by its draft (rows 1..q_lens[b]−1, at
+        positions lengths[b]+r); rows past q_lens[b] are padding the
+        engine ignores. lengths/active/cache/page_table are exactly
+        decode_kv's. q_lens [B] int32 — real rows per slot (≥ 1); a
+        plain slot rides the same compiled step with q_lens = 1, so
+        speculating and non-speculating slots mix in one iteration with
+        every shape static.
+
+        → (logits [B, Q, V] fp32, cache_k, cache_v): logits[b, r]
+        predicts position lengths[b]+r+1, so greedy acceptance walks
+        drafts against argmax(logits[:, :-1]) and the accepted prefix's
+        emissions come straight off the same array. ALL Q rows' K/V are
+        written at their positions first (live rows through the page
+        table, dead/pad rows to the scratch page): an accepted prefix is
+        already committed in the pool, and a rejected tail sits at
+        positions past the rewound length — invisible to both kernels'
+        masks and overwritten before those positions ever go live.
+
+        Kernel dispatch mirrors decode_kv:
+
+        - ``"paged"`` — the in-kernel page-table path with per-row
+          bottom-aligned masking (paged_attention's ``q_lens``): row r's
+          page regimes/masks are the single-token kernel's at length+r.
+        - ``"gather"`` — the committed window [B, S_max] is gathered
+          with STRICT segment masking (pos < lengths: row 0's token is
+          NOT read from the pool) and the Q fresh rows' K/V concatenate
+          behind it at ``kv_offset = S_max`` — causal over the tail
+          gives row r exactly tail rows ≤ r, i.e. positions ≤
+          lengths[b]+r: the prefill_kv_cached concat geometry at decode
+          scale.
+
+        `q_pad` rounds Q up to a lane-friendly row count (the extra rows
+        are dropped before return).
+        """
+        c = self.config
+        if kernel not in ("paged", "gather"):
+            raise ValueError(
+                f"decode_kv_spec kernel must be 'paged' or 'gather', "
+                f"got {kernel!r}"
+            )
+        n_layers, _n_pages, page_size, h, hd = cache_k.shape
+        b, q_n = tokens.shape
+        n_page_slots = page_table.shape[1]
+        s_max = n_page_slots * page_size
+        qpad = max(1, int(q_pad))
+        qp = -(-q_n // qpad) * qpad        # Q rounded up to the lane pad
+        r = jnp.arange(q_n)
+        pos = lengths[:, None] + r[None, :]            # [B, Q]
+        live = active[:, None] & (r[None, :] < q_lens[:, None])
+        positions = jnp.clip(pos, 0, c.seq_len - 1)
+        x = (
+            params["tok_embed"].astype(c.dtype)[tokens]
+            + params["pos_embed"].astype(c.dtype)[positions]
+        )  # [B, Q, D]
+        # Write coordinates for every row's K/V; dead and padding rows
+        # route to the scratch page so the scatter stays unconditional.
+        widx = page_table[
+            jnp.arange(b)[:, None],
+            jnp.clip(pos // page_size, 0, n_page_slots - 1),
+        ]
+        widx = jnp.where(live, widx, 0)
+        woff = pos % page_size
+        if kernel == "gather":
+            kv_pos = jnp.arange(s_max)[None, :]
+            # STRICT boundary: the committed window ends at lengths−1 —
+            # row 0's token (and the draft) ride in the fresh tail, so
+            # the just-scattered pool rows are never double-counted.
+            kv_seg_win = (
+                (kv_pos < lengths[:, None]) & active[:, None]
+            ).astype(jnp.int32)  # [B, S_max]
+            tail_r = jnp.arange(qp)[None, :]
+            kv_seg_tail = (
+                (tail_r < q_lens[:, None]) & active[:, None]
+            ).astype(jnp.int32)  # [B, qp]
+            kv_seg = jnp.concatenate([kv_seg_win, kv_seg_tail], axis=1)
+            q_seg = jnp.where(
+                (tail_r < q_lens[:, None]) & active[:, None], 1, 2
+            ).astype(jnp.int32)  # [B, qp]
+            bq = fit_block(qp, 128)
+            bk = fit_block(s_max + qp, c.flash_block_k)
+        else:
+            from determined_tpu.ops.paged_attention import paged_attention
+        for i in range(n_layers):
+            blk = jax.tree_util.tree_map(lambda a, i=i: a[i], params["blocks"])
+            hn = _layernorm(x, blk["ln1_scale"], blk["ln1_bias"])
+            qkv = (
+                jnp.einsum("bsd,dthk->bsthk", hn, blk["wqkv"].astype(c.dtype))
+                + blk["bqkv"].astype(c.dtype)
+            )
+            q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            cache_k = cache_k.at[i, widx, woff].set(k_new)
+            cache_v = cache_v.at[i, widx, woff].set(v_new)
+            if qp > q_n:
+                q = jnp.concatenate(
+                    [q, jnp.zeros((b, qp - q_n, h, hd), q.dtype)], axis=1
+                )
+            if kernel == "paged":
+                o = paged_attention(
+                    q, cache_k[i], cache_v[i], page_table, lengths,
+                    active, q_lens=q_lens, block_h=block_h,
+                    interpret=interpret,
+                )[:, :q_n]
+            else:
+                k_full = cache_k[i][page_table].reshape(b, s_max, h, hd)
+                v_full = cache_v[i][page_table].reshape(b, s_max, h, hd)
+                k_tail, v_tail = k_new, v_new
+                if qp > q_n:
+                    k_tail = jnp.concatenate(
+                        [k_new, jnp.zeros((b, qp - q_n, h, hd), k_new.dtype)],
+                        axis=1,
+                    )
+                    v_tail = jnp.concatenate(
+                        [v_new, jnp.zeros((b, qp - q_n, h, hd), v_new.dtype)],
+                        axis=1,
+                    )
+                o = flash_attention(
+                    q,
+                    jnp.concatenate([k_full, k_tail], axis=1),
+                    jnp.concatenate([v_full, v_tail], axis=1),
+                    causal=True, kv_offset=s_max,
+                    segment_ids=q_seg, kv_segment_ids=kv_seg,
+                    block_q=bq, block_k=bk,
+                )[:, :q_n]
+            o = jnp.einsum("bshk,hkd->bsd", o, blk["wo"].astype(c.dtype))
+            x = x + o + blk["bo"].astype(c.dtype)
+            x, _aux = self._mlp_half(x, blk, manual=False)
+        logits = self._head(params, x)  # [B, Q, V]
+        return logits.astype(jnp.float32), cache_k, cache_v
+
     # -- 1F1B training path ------------------------------------------------
     def _loss_1f1b(
         self, params: Dict[str, Any], batch: Dict[str, jax.Array]

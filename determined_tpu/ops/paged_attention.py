@@ -97,24 +97,34 @@ def default_paged_block_h(n_heads: int, head_dim: int, page_size: int,
     return best
 
 
-def _page_index(b, hg, j, pt_ref, len_ref, act_ref, *, page_size):
+def _page_index(b, hg, j, pt_ref, len_ref, ql_ref, act_ref, *, page_size):
     """Pool page for grid step (slot b, head group hg, page slot j): the
     slot's j-th table entry while live, clamped to its LAST live page
     once dead — consecutive dead steps then map the same block and
-    Pallas elides the DMA entirely."""
+    Pallas elides the DMA entirely. With ``q_lens[b]`` query rows the
+    slot's last live position is ``length + q_lens − 1`` (row r sits at
+    position ``length + r``); at q_lens = 1 this reduces exactly to the
+    single-token ``length // page_size``."""
     del hg, act_ref
-    last_live = len_ref[b] // page_size  # live pages − 1 (length+1 tokens)
+    # live pages − 1 (length + q_lens live tokens)
+    last_live = (len_ref[b] + ql_ref[b] - 1) // page_size
     return pt_ref[b, jnp.minimum(j, last_live)]
 
 
-def _paged_kernel(pt_ref, len_ref, act_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, scale, page_size, block_h,
-                  num_page_slots, q_rows):
+def _paged_kernel(pt_ref, len_ref, ql_ref, act_ref, q_ref, k_ref, v_ref,
+                  o_ref, m_scr, l_scr, acc_scr, *, scale, page_size,
+                  block_h, num_page_slots, q_rows):
     """One (slot, head-group, page) step of the paged decode grid.
 
     Math per head mirrors ops/flash_attention._fwd_kernel exactly (dot →
     mask → running max → exp → correction → accumulate), with the page's
-    liveness regime standing in for the band dispatch.
+    liveness regime standing in for the band dispatch. Query row r sits
+    at position ``length + r`` (speculative verify: row 0 is the last
+    committed token, rows 1..q_lens−1 the draft), so its visibility
+    boundary is ``col ≤ length + r``; rows past ``q_lens − 1`` are lane
+    padding clamped onto the last real row's mask (their output is
+    dropped by the caller). At q_lens = 1 every predicate and mask below
+    is the plain single-token decode, bit for bit.
     """
     b = pl.program_id(0)
     j = pl.program_id(2)
@@ -125,8 +135,9 @@ def _paged_kernel(pt_ref, len_ref, act_ref, q_ref, k_ref, v_ref, o_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    length = len_ref[b]               # query position; length+1 live tokens
-    n_tokens = length + 1
+    length = len_ref[b]               # row 0's position; length+1 live there
+    q_live = ql_ref[b]                # real query rows (≥ 1)
+    n_tokens = length + 1             # row 0's visible-token count
     is_active = act_ref[b] != 0
     page_first = j * page_size
 
@@ -143,7 +154,12 @@ def _paged_kernel(pt_ref, len_ref, act_ref, q_ref, k_ref, v_ref, o_ref,
                 cols = page_first + jax.lax.broadcasted_iota(
                     jnp.int32, (q_rows, page_size), 1
                 )
-                mask = cols <= length
+                row_i = jax.lax.broadcasted_iota(
+                    jnp.int32, (q_rows, page_size), 0
+                )
+                # per-row boundary: row r sees cols ≤ length + r; padding
+                # rows clamp onto the last real row (output dropped).
+                mask = cols <= length + jnp.minimum(row_i, q_live - 1)
                 s = jnp.where(mask, s, NEG_INF)
             rows = slice(h * q_rows, (h + 1) * q_rows)
             m_prev = m_scr[rows, 0:1]
@@ -161,11 +177,18 @@ def _paged_kernel(pt_ref, len_ref, act_ref, q_ref, k_ref, v_ref, o_ref,
             )
             m_scr[rows, 0:1] = m_new
 
-    # Page regimes: interior (every position live), the length-boundary
-    # page (element mask), dead (skip — paired with the index_map clamp
-    # above, a dead page costs neither DMA nor compute).
+    # Page regimes: interior (every position live for EVERY real row —
+    # bounded by row 0, the tightest), the boundary band (per-row element
+    # mask; spans up to the last real row's visibility), dead (skip —
+    # paired with the index_map clamp above, a dead page costs neither
+    # DMA nor compute). At q_lens = 1 the band collapses to the classic
+    # single length-boundary page.
     interior = is_active & (page_first + page_size <= n_tokens)
-    edge = is_active & (page_first < n_tokens) & jnp.logical_not(interior)
+    edge = (
+        is_active
+        & (page_first < length + q_live)
+        & jnp.logical_not(interior)
+    )
 
     @pl.when(interior)
     def _():
@@ -192,6 +215,7 @@ def paged_attention(
     lengths: jax.Array,
     active: jax.Array,
     *,
+    q_lens: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     block_h: Optional[int] = None,
     interpret: bool = False,
@@ -200,14 +224,21 @@ def paged_attention(
 
     q: [B, q_rows, H, Dh] — row 0 is the real query (the token at
     position ``lengths[b]``, already written into the pool); extra rows
-    are TPU lane padding whose output the caller drops.
+    are TPU lane padding whose output the caller drops — unless
+    ``q_lens`` marks them live (below).
     k_pool/v_pool: [num_pages, page_size, H, Dh] — ONE layer's pool.
     page_table: [B, P] int32 — each slot's pages in order (dead tail
     arbitrary; it is never dereferenced live).
     lengths: [B] int32 — tokens cached BEFORE this iteration's token;
-    the slot therefore has ``lengths[b] + 1`` live positions.
+    the slot therefore has ``lengths[b] + q_lens[b]`` live positions.
     active: [B] bool/int32 — inactive slots read nothing and output 0,
     exactly like the gather path's unmatched segment ids.
+    q_lens: [B] int32 — real query rows per slot (speculative verify:
+    row r is the token at position ``lengths[b] + r``, already written
+    into the pool, and sees exactly positions 0..lengths[b]+r — the
+    bottom-aligned per-row boundary). Default (None) is all-ones: the
+    plain single-token decode, whose masks/regimes/DMA schedule this
+    reduces to bit for bit.
 
     → o [B, q_rows, H, Dh] (pool dtype). Forward-only — decode never
     differentiates. Every shape is static in (B, P, pool geometry).
@@ -215,6 +246,8 @@ def paged_attention(
     from jax.experimental.pallas import tpu as pltpu
 
     b, q_rows, n_heads, head_dim = q.shape
+    if q_lens is None:
+        q_lens = jnp.ones((b,), jnp.int32)
     num_pages, page_size, pool_h, pool_d = k_pool.shape
     n_slots, num_page_slots = page_table.shape
     if (pool_h, pool_d) != (n_heads, head_dim):
@@ -238,15 +271,17 @@ def paged_attention(
 
     kv_map = functools.partial(_page_index, page_size=page_size)
 
-    def head_map(b_, hg, j, pt_ref, len_ref, act_ref):
-        del j, pt_ref, len_ref, act_ref
+    def head_map(b_, hg, j, pt_ref, len_ref, ql_ref, act_ref):
+        del j, pt_ref, len_ref, ql_ref, act_ref
         return (b_, 0, hg, 0)
 
-    def kv_block_map(b_, hg, j, pt_ref, len_ref, act_ref):
-        return (kv_map(b_, hg, j, pt_ref, len_ref, act_ref), 0, hg, 0)
+    def kv_block_map(b_, hg, j, pt_ref, len_ref, ql_ref, act_ref):
+        return (
+            kv_map(b_, hg, j, pt_ref, len_ref, ql_ref, act_ref), 0, hg, 0
+        )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(b, n_heads // block_h, num_page_slots),
         in_specs=[
             pl.BlockSpec((1, q_rows, block_h, head_dim), head_map),
@@ -273,17 +308,25 @@ def paged_attention(
     )(
         page_table.astype(jnp.int32),
         lengths.astype(jnp.int32),
+        q_lens.astype(jnp.int32),
         active.astype(jnp.int32),
         q, k_pool, v_pool,
     )
 
 
-def paged_pages_read(lengths, active, page_size: int) -> int:
+def paged_pages_read(lengths, active, page_size: int, q_lens=None) -> int:
     """Pool pages a decode iteration actually reads (live pages summed
     over active slots) — the host-side mirror of the kernel's liveness
-    predicate, feeding ``dtpu_serving_kv_pages_read_total``."""
+    predicate, feeding ``dtpu_serving_kv_pages_read_total``. With
+    ``q_lens`` (speculative verify rows) a slot's live window extends to
+    ``lengths + q_lens − 1``; the default mirrors the plain decode."""
     import numpy as np
 
     lengths = np.asarray(lengths)
     active = np.asarray(active).astype(bool)
-    return int(np.sum(np.where(active, lengths // page_size + 1, 0)))
+    if q_lens is None:
+        q_lens = np.ones_like(lengths)
+    q_lens = np.asarray(q_lens)
+    return int(np.sum(
+        np.where(active, (lengths + q_lens - 1) // page_size + 1, 0)
+    ))

@@ -37,13 +37,27 @@ KNOWN_SERVING_KEYS = {
     "eos_id",
     "decode_kernel",
     "prefix_cache",
+    "speculation",
 }
 
-KNOWN_MODELS = ("tiny", "small", "medium")
+#: `fixture` is the bench's pre-trained tiny model
+#: (serving/fixture.py) — pair it with DTPU_SERVING_CHECKPOINT pointing
+#: at `ensure_fixture()`'s directory to serve real (non-random) weights.
+KNOWN_MODELS = ("tiny", "small", "medium", "fixture")
 
 KNOWN_DECODE_KERNELS = ("auto", "paged", "gather")
 
 KNOWN_PREFIX_CACHE = ("on", "off")
+
+KNOWN_SPECULATION_MODES = ("off", "ngram")
+
+#: Keys accepted inside `serving.speculation`.
+KNOWN_SPECULATION_KEYS = {"mode", "draft_len", "min_match"}
+
+#: Hard cap on draft_len: verify rides one static-shape decode iteration
+#: with Q = draft_len + 1 rows per slot, so an unbounded draft_len would
+#: quietly turn the decode step into a prefill-sized matmul.
+MAX_DRAFT_LEN = 8
 
 #: The paged decode kernel DMAs K/V pages as ``(page_size, head_dim)``
 #: MXU tiles with the page dimension lane-tiled — the same 128 granule
@@ -107,10 +121,36 @@ class ServingConfig:
     #: for the hit span); `off` reproduces the return-to-free-list
     #: behavior exactly. Greedy token streams are identical either way.
     prefix_cache: str = "off"
+    #: speculative decoding (prompt-lookup / n-gram drafting — no draft
+    #: model): `{"mode": "off"|"ngram", "draft_len": int, "min_match": int}`.
+    #: With mode `ngram`, greedy slots speculate up to `draft_len` tokens
+    #: per iteration drawn from the request's own token history (most
+    #: recent prior occurrence of the trailing `min_match`-gram), and one
+    #: verify step scores all draft_len+1 positions in a single jitted
+    #: decode iteration. Accepted prefix commits; the rejected tail rolls
+    #: back by rewinding `lengths` (pages are pre-budgeted, so rollback
+    #: never touches the free list). Greedy streams are bit-identical
+    #: spec-on vs spec-off. The DTPU_SPEC_DECODE env var overrides at
+    #: engine build (0 = kill switch to off, 1 = force ngram).
+    speculation: Any = dataclasses.field(
+        default_factory=lambda: {"mode": "off"}
+    )
 
     @property
     def max_context(self) -> int:
         return self.max_pages_per_request * self.page_size
+
+    @property
+    def spec_mode(self) -> str:
+        return dict(self.speculation or {}).get("mode", "off")
+
+    @property
+    def spec_draft_len(self) -> int:
+        return int(dict(self.speculation or {}).get("draft_len", 4))
+
+    @property
+    def spec_min_match(self) -> int:
+        return int(dict(self.speculation or {}).get("min_match", 2))
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ServingConfig":
@@ -168,6 +208,40 @@ def validate_serving(d: Any) -> List[str]:
             f"serving.prefix_cache {pc!r} unknown "
             f"(one of {sorted(KNOWN_PREFIX_CACHE)})"
         )
+    spec = d.get("speculation")
+    if spec is not None:
+        if not isinstance(spec, dict):
+            errors.append("serving.speculation must be an object")
+        else:
+            for key in spec:
+                if key not in KNOWN_SPECULATION_KEYS:
+                    errors.append(
+                        f"serving.speculation: unknown key {key!r} "
+                        f"(one of: {', '.join(sorted(KNOWN_SPECULATION_KEYS))})"
+                    )
+            mode = spec.get("mode", "off")
+            if mode not in KNOWN_SPECULATION_MODES:
+                errors.append(
+                    f"serving.speculation.mode {mode!r} unknown "
+                    f"(one of {sorted(KNOWN_SPECULATION_MODES)})"
+                )
+            dl = spec.get("draft_len")
+            if dl is not None and (
+                not isinstance(dl, int) or isinstance(dl, bool)
+                or not 1 <= dl <= MAX_DRAFT_LEN
+            ):
+                errors.append(
+                    f"serving.speculation.draft_len must be an int in "
+                    f"[1, {MAX_DRAFT_LEN}] (verify scores draft_len + 1 "
+                    "positions in one static-shape decode iteration)"
+                )
+            mm = spec.get("min_match")
+            if mm is not None and (
+                not isinstance(mm, int) or isinstance(mm, bool) or mm < 1
+            ):
+                errors.append(
+                    "serving.speculation.min_match must be an int >= 1"
+                )
     page_size = d.get("page_size", 128)
     if (
         kernel == "paged"

@@ -28,12 +28,24 @@ attends the tail to the cached prefix K/V in the same bottom-aligned
 ``kv_offset`` geometry decode uses, so greedy streams are identical
 cache-on vs cache-off.
 
+With ``serving.speculation.mode: ngram`` greedy slots additionally
+speculate: a prompt-lookup proposer drafts up to ``draft_len`` tokens
+from the request's own token history, ONE compiled verify step scores
+all ``draft_len + 1`` positions at the slot's bottom-aligned offsets
+(plain and sampled slots ride the same step with ``q_lens = 1``), the
+accepted prefix commits and the rejected tail rolls back by rewinding
+``lengths`` — pages are pre-budgeted per request, so rollback never
+touches the free list. Greedy streams are bit-identical spec-on vs
+spec-off on both decode kernels.
+
 Fault sites (common/faults.py): ``serving.admission`` (deterministic
 shed), ``serving.decode`` (mid-stream failure — SSE error event, pages
 freed), ``serving.page_alloc`` (pool exhaustion), ``serving.prefix_cache``
-(poisoned lookup → counted fallback to a normal full prefill) — the
-chaos drills in tests/test_serving.py and tests/test_prefix_cache.py
-exercise all four.
+(poisoned lookup → counted fallback to a normal full prefill),
+``serving.speculation`` (draft/verify failure → counted fallback to
+plain one-token decode) — the chaos drills in tests/test_serving.py,
+tests/test_prefix_cache.py and tests/test_speculation.py exercise all
+five.
 """
 from __future__ import annotations
 
@@ -60,6 +72,7 @@ from determined_tpu.serving.kv_cache import (
     PoolExhausted,
     PrefixCache,
 )
+from determined_tpu.serving.speculation import propose_ngram_draft
 
 logger = logging.getLogger("determined_tpu.serving")
 
@@ -105,6 +118,28 @@ KV_PAGES_READ = METRICS.counter(
     "DMA nor compute). Gather fallback: the full page window every "
     "iteration — the contiguous-buffer round-trip the paged kernel "
     "removes; the two rates differ by exactly the win.",
+)
+SPEC_PROPOSED = METRICS.counter(
+    "dtpu_serving_spec_proposed_tokens_total",
+    "Draft tokens proposed by the prompt-lookup speculator (verify "
+    "scores each; acceptance rate = accepted / proposed).",
+)
+SPEC_ACCEPTED = METRICS.counter(
+    "dtpu_serving_spec_accepted_tokens_total",
+    "Draft tokens the verify step accepted (each saved one decode "
+    "iteration; the bonus token verify always emits is not counted).",
+)
+SPEC_ROLLBACK = METRICS.counter(
+    "dtpu_serving_spec_rollback_tokens_total",
+    "Draft tokens rejected and rolled back by rewinding lengths — pure "
+    "host bookkeeping; pages are pre-budgeted so rollback never touches "
+    "the free list.",
+)
+SPEC_FALLBACKS = METRICS.counter(
+    "dtpu_serving_spec_fallbacks_total",
+    "Decode iterations that degraded to plain one-token decode because "
+    "the draft/verify path failed (injected or real); streams stay "
+    "bit-identical, only the multi-token win is lost.",
 )
 DECODE_ITER_LATENCY = METRICS.histogram(
     "dtpu_serving_decode_iteration_seconds",
@@ -366,6 +401,50 @@ class GenerationEngine:
             ),
             donate_argnums=(4, 5),
         )
+        # -- speculative decoding resolution (done ONCE, outside jit) ----
+        # serving.speculation.mode, with DTPU_SPEC_DECODE overriding at
+        # engine build: 0 = kill switch back to one-token decode,
+        # 1 = force the ngram proposer. When on, ONE spec decode step is
+        # compiled with static Q = draft_len + 1 query rows; plain and
+        # speculating slots share it (plain slots ride with q_lens = 1),
+        # so mixed batches never recompile.
+        env_spec = os.environ.get("DTPU_SPEC_DECODE", "")
+        if env_spec == "0":
+            self._spec_mode = "off"
+        elif env_spec == "1":
+            self._spec_mode = "ngram"
+        else:
+            self._spec_mode = config.spec_mode
+        self._spec_draft_len = config.spec_draft_len
+        self._spec_min_match = config.spec_min_match
+        self._spec_fn = None
+        if self._spec_mode == "ngram":
+            q_spec = self._spec_draft_len + 1
+            qp_spec = -(-q_spec // self._q_pad) * self._q_pad
+            spec_block_h = self._paged_block_h
+            if self._decode_kernel == "paged":
+                from determined_tpu.ops.flash_autotune import (
+                    tune_paged_block_h,
+                )
+
+                # The verify step runs the paged kernel at qp_spec query
+                # rows, a different tile than the one-token step — tuned
+                # separately under its own cache key.
+                spec_block_h = tune_paged_block_h(
+                    n_heads=c.n_heads, head_dim=c.head_dim,
+                    page_size=config.page_size, num_pages=config.num_pages,
+                    pages_per_slot=config.max_pages_per_request,
+                    batch=config.max_batch_size, q_rows=qp_spec,
+                    dtype=c.dtype,
+                )
+            self._spec_fn = jax.jit(
+                functools.partial(
+                    self._spec_decode_step, q_pad=self._q_pad,
+                    kernel=self._decode_kernel, block_h=spec_block_h,
+                    interpret=self._paged_interpret,
+                ),
+                donate_argnums=(5, 6),
+            )
         self._queue: deque = deque()
         self._slots: List[Optional[Request]] = [None] * config.max_batch_size
         self._lock = threading.Lock()
@@ -382,6 +461,10 @@ class GenerationEngine:
         self._done_count = 0
         self._shed_count = 0
         self._tokens_emitted = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_rollback = 0
+        self._spec_fallbacks = 0
         self._decode_backend = (
             "pallas" if on_tpu
             else ("interpret" if self._paged_interpret else "reference")
@@ -404,6 +487,32 @@ class GenerationEngine:
         )
         nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
         return nxt, ck, cv
+
+    # -- jitted speculative decode ------------------------------------------
+    def _spec_decode_step(self, params, toks, lengths, q_lens, active, ck,
+                          cv, pt, temps, key, *, q_pad, kernel="gather",
+                          block_h=None, interpret=False):
+        """One verify-in-one-step iteration over the static batch. toks
+        [B, Q] carries row 0 = the slot's last committed token and rows
+        1..q_lens-1 = its draft; the verify scores all Q positions at the
+        bottom-aligned offsets in ONE call (plain slots ride the same
+        compiled step with q_lens = 1). Returns the sampled/greedy row-0
+        token (the spec-off-identical next token) and the full greedy
+        grid the host acceptance loop walks."""
+        import jax
+        import jax.numpy as jnp
+
+        logits, ck, cv = self.model.decode_kv_spec(
+            params, toks, lengths, q_lens, active, ck, cv, pt,
+            q_pad=q_pad, kernel=kernel, block_h=block_h,
+            interpret=interpret,
+        )
+        greedy = jnp.argmax(logits, axis=-1)                  # [B, Q]
+        sampled = jax.random.categorical(
+            key, logits[:, 0] / jnp.maximum(temps, 1e-6)[:, None]
+        )
+        row0 = jnp.where(temps > 0, sampled, greedy[:, 0]).astype(jnp.int32)
+        return row0, greedy.astype(jnp.int32), ck, cv
 
     # -- jitted cached-tail prefill -----------------------------------------
     def _prefill_cached_step(self, params, tokens, positions, segs, ck, cv,
@@ -899,6 +1008,7 @@ class GenerationEngine:
             BATCH_OCCUPANCY.set(0)
             return
         b = cfg.max_batch_size
+        spec_on = self._spec_fn is not None
         last = np.zeros((b,), np.int32)
         lengths = np.zeros((b,), np.int32)
         active = np.zeros((b,), bool)
@@ -912,26 +1022,77 @@ class GenerationEngine:
             active[i] = True
             temps[i] = req.temperature
             pt[i, : len(req.pages)] = req.pages
+        # -- draft proposal (host, per greedy slot) ----------------------
+        # Every slot rides the same compiled step; plain / sampled /
+        # draft-less slots simply keep q_lens = 1. The draft cap keeps
+        # every written position inside the request's pre-budgeted pages
+        # (rollback is then pure lengths bookkeeping), and an injected
+        # `serving.speculation` fault degrades the WHOLE iteration to
+        # one-token decode — streams stay bit-identical, only the
+        # multi-token win is lost.
+        drafts: List[List[int]] = [[] for _ in range(b)]
+        q_lens = np.ones((b,), np.int32)
+        if spec_on:
+            try:
+                faults.inject("serving.speculation")
+                for i, req in enumerate(self._slots):
+                    if req is None or req.temperature > 0:
+                        continue
+                    m_cap = min(
+                        self._spec_draft_len,
+                        req.max_new_tokens - len(req.tokens) - 1,
+                        self.max_total - 2 - req.length,
+                    )
+                    if m_cap < 1:
+                        continue
+                    drafts[i] = propose_ngram_draft(
+                        req.prompt + req.tokens, m_cap,
+                        self._spec_min_match,
+                    )
+            except faults.InjectedFault:
+                SPEC_FALLBACKS.inc()
+                with self._stats_lock:
+                    self._spec_fallbacks += 1
+                drafts = [[] for _ in range(b)]
         self._iter_count += 1
         key = jax.random.PRNGKey(self._iter_count)
         t_iter = time.monotonic()
-        nxt, self.cache_k, self.cache_v = self._decode_fn(
-            self.params, jnp.asarray(last), jnp.asarray(lengths),
-            jnp.asarray(active), self.cache_k, self.cache_v,
-            jnp.asarray(pt), jnp.asarray(temps), key,
-        )
+        greedy = None
+        if spec_on:
+            toks = np.zeros((b, self._spec_draft_len + 1), np.int32)
+            toks[:, 0] = last
+            for i, d in enumerate(drafts):
+                if d:
+                    toks[i, 1:1 + len(d)] = d
+                    q_lens[i] = 1 + len(d)
+            nxt, greedy, self.cache_k, self.cache_v = self._spec_fn(
+                self.params, jnp.asarray(toks), jnp.asarray(lengths),
+                jnp.asarray(q_lens), jnp.asarray(active), self.cache_k,
+                self.cache_v, jnp.asarray(pt), jnp.asarray(temps), key,
+            )
+            greedy = np.asarray(greedy)
+        else:
+            nxt, self.cache_k, self.cache_v = self._decode_fn(
+                self.params, jnp.asarray(last), jnp.asarray(lengths),
+                jnp.asarray(active), self.cache_k, self.cache_v,
+                jnp.asarray(pt), jnp.asarray(temps), key,
+            )
         nxt = np.asarray(nxt)  # blocks until the device step is done
         DECODE_ITER_LATENCY.labels(self._decode_kernel).observe(
             time.monotonic() - t_iter
         )
         # Pages this iteration actually read. Paged: the host mirror of
         # the kernel's liveness predicate (dead page-table tails are
-        # free). Gather: the full window materializes every iteration —
-        # the counter rates differ by exactly the round-trip the paged
+        # free; draft rows extend liveness by q_lens - 1 positions).
+        # Gather: the full window materializes every iteration — the
+        # counter rates differ by exactly the round-trip the paged
         # kernel removes.
         if self._decode_kernel == "paged":
             KV_PAGES_READ.inc(
-                paged_pages_read(lengths, active, cfg.page_size)
+                paged_pages_read(
+                    lengths, active, cfg.page_size,
+                    q_lens=q_lens if spec_on else None,
+                )
             )
         else:
             KV_PAGES_READ.inc(len(lengths) * cfg.max_pages_per_request)
@@ -940,22 +1101,50 @@ class GenerationEngine:
         for i, req in enumerate(list(self._slots)):
             if req is None:
                 continue
-            tok = int(nxt[i])
-            req.length += 1          # the processed token entered the cache
-            req.last_token = tok
-            req.tokens.append(tok)
-            TOKENS.inc()
-            with self._stats_lock:
-                self._tokens_emitted += 1
-            req.events.put(("token", tok))
-            if cfg.eos_id >= 0 and tok == cfg.eos_id:
-                self._finish(req, "eos")
-            elif len(req.tokens) >= req.max_new_tokens:
-                self._finish(req, "length")
-            elif req.length + 1 >= self.max_total:
-                self._finish(req, "length")
-            elif now > req.deadline:
-                self._finish(req, "deadline")
+            m = len(drafts[i])
+            if m:
+                # Verify row r scored position lengths + r + 1; walk the
+                # accepted prefix (draft token r == greedy row r-1's
+                # prediction) and emit greedy rows 0..n — the EXACT
+                # tokens n+1 plain iterations would have produced. The
+                # rejected tail rolls back by simply not advancing
+                # req.length past the accepted span: its K/V sits beyond
+                # every kernel's length mask and is overwritten before
+                # it can ever become visible.
+                g = greedy[i]
+                n = 0
+                while n < m and drafts[i][n] == int(g[n]):
+                    n += 1
+                emitted = [int(g[r]) for r in range(n + 1)]
+                SPEC_PROPOSED.inc(m)
+                SPEC_ACCEPTED.inc(n)
+                SPEC_ROLLBACK.inc(m - n)
+                with self._stats_lock:
+                    self._spec_proposed += m
+                    self._spec_accepted += n
+                    self._spec_rollback += m - n
+            else:
+                emitted = [int(nxt[i])]
+            for tok in emitted:
+                req.length += 1      # the processed token entered the cache
+                req.last_token = tok
+                req.tokens.append(tok)
+                TOKENS.inc()
+                with self._stats_lock:
+                    self._tokens_emitted += 1
+                req.events.put(("token", tok))
+                if cfg.eos_id >= 0 and tok == cfg.eos_id:
+                    self._finish(req, "eos")
+                    break
+                elif len(req.tokens) >= req.max_new_tokens:
+                    self._finish(req, "length")
+                    break
+                elif req.length + 1 >= self.max_total:
+                    self._finish(req, "length")
+                    break
+                elif now > req.deadline:
+                    self._finish(req, "deadline")
+                    break
         BATCH_OCCUPANCY.set(sum(1 for r in self._slots if r is not None))
 
     def _retire_pages(self, req: Request, cacheable: bool) -> None:
@@ -1108,6 +1297,10 @@ class GenerationEngine:
             done = self._done_count
             shed = self._shed_count
             emitted = self._tokens_emitted
+            spec_proposed = self._spec_proposed
+            spec_accepted = self._spec_accepted
+            spec_rollback = self._spec_rollback
+            spec_fallbacks = self._spec_fallbacks
         out = {
             "queued": queued,
             "active": sum(1 for r in self._slots if r is not None),
@@ -1121,6 +1314,19 @@ class GenerationEngine:
             "max_batch_size": self.cfg.max_batch_size,
             "max_context": self.max_total,
             "cache_hit_rate": 0.0,
+            "speculation": {
+                "mode": self._spec_mode,
+                "draft_len": self._spec_draft_len,
+                "min_match": self._spec_min_match,
+                "proposed_tokens": spec_proposed,
+                "accepted_tokens": spec_accepted,
+                "rollback_tokens": spec_rollback,
+                "fallbacks": spec_fallbacks,
+                "acceptance_rate": (
+                    round(spec_accepted / spec_proposed, 4)
+                    if spec_proposed else 0.0
+                ),
+            },
         }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.stats()

@@ -1,0 +1,224 @@
+"""Deterministic pre-trained fixture checkpoint for the serving bench.
+
+The serving rungs used to decode from byte-level RANDOM init, which makes
+speculation-acceptance numbers meaningless (a random model's greedy
+continuation correlates with nothing, so prompt-lookup drafts never
+verify). This module closes that realism gap: a tiny GPT is pre-trained
+in-repo on a deterministic phrase corpus with heavy n-gram repetition,
+saved through the SAME checkpoint chain real experiments use
+(`trainer._checkpoint.save_pytree` + a `manifest.json` committed LAST,
+verified with `storage.base.verify_checkpoint_dir` on every load), and
+cached on disk keyed by a content fingerprint of everything that shaped
+it. `bench.serving_fleet_rung` loads this checkpoint instead of random
+init, and `loadgen.corpus_ngram_prompts` derives its prompts from the
+SAME corpus — so the prompt-lookup proposer has real n-grams to hit and
+the published acceptance rate is a property of the method, not noise.
+
+Train once, reuse forever:
+
+    python -m determined_tpu.serving.fixture          # prints the path
+
+(or let `ensure_fixture()` train lazily on first use).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("determined_tpu.serving")
+
+#: Bump to invalidate every cached fixture (training recipe changes).
+FIXTURE_VERSION = 2
+
+#: Corpus shape: phrases long enough that a min_match-gram anchors a
+#: unique continuation, short enough that prompts stay inside the CPU
+#: bench's prefill window.
+CORPUS_SEED = 7
+N_PHRASES = 12
+PHRASE_LEN = 10
+
+#: Training recipe (fingerprinted — change these, get a new cache dir).
+TRAIN_SEED = 0
+TRAIN_STEPS = 300
+TRAIN_BATCH = 8
+TRAIN_LR = 3e-3
+
+
+def fixture_phrases(
+    *, vocab: int = 1024, n_phrases: int = N_PHRASES,
+    phrase_len: int = PHRASE_LEN, seed: int = CORPUS_SEED,
+) -> List[List[int]]:
+    """The deterministic phrase corpus. Token ids stay in [1, vocab)
+    (0 is conventionally padding) and each phrase is distinct, so a
+    trailing n-gram of one phrase pins its continuation."""
+    rng = np.random.default_rng(seed)
+    phrases = []
+    seen = set()
+    while len(phrases) < n_phrases:
+        p = rng.integers(1, vocab, size=phrase_len).tolist()
+        key = tuple(p[:2])
+        if key in seen:  # distinct leading bigrams keep lookups unambiguous
+            continue
+        seen.add(key)
+        phrases.append([int(t) for t in p])
+    return phrases
+
+
+def fixture_model_config() -> Any:
+    """The bench-CPU serving geometry, fp32 so greedy argmax tie-breaks
+    identically everywhere (the parity contract's tiebreak discipline)."""
+    import jax.numpy as jnp
+
+    from determined_tpu.models import gpt as gpt_mod
+
+    return gpt_mod.GPTConfig(
+        vocab_size=1024, n_layers=2, n_heads=4, d_model=128, d_ff=512,
+        seq_len=256, remat=False, dtype=jnp.float32,
+    )
+
+
+def _fingerprint() -> str:
+    spec = {
+        "version": FIXTURE_VERSION,
+        "corpus": [CORPUS_SEED, N_PHRASES, PHRASE_LEN],
+        "train": [TRAIN_SEED, TRAIN_STEPS, TRAIN_BATCH, TRAIN_LR],
+        "model": [1024, 2, 4, 128, 512, 256, "float32"],
+    }
+    digest = hashlib.sha256(
+        json.dumps(spec, sort_keys=True).encode()
+    ).hexdigest()
+    return digest[:12]
+
+
+def default_cache_dir() -> str:
+    base = os.environ.get("DTPU_FIXTURE_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "determined_tpu", "fixtures"
+    )
+    return os.path.join(base, f"serving-spec-{_fingerprint()}")
+
+
+def _corpus_batch(rng: np.random.Generator, phrases, batch: int, seq: int):
+    """Training rows: ONE phrase tiled per row (random rotation). Every
+    transition — interiors AND the wrap from a phrase's last token back
+    to its first — is deterministic, so the trained model's greedy decode
+    cycles a phrase indefinitely. That loop is exactly what prompt-lookup
+    speculates perfectly (the trailing n-gram recurs one period earlier),
+    giving the bench a sustained, meaningful acceptance rate rather than
+    one that decays at the first phrase boundary."""
+    rows = np.zeros((batch, seq), np.int32)
+    for b in range(batch):
+        p = phrases[int(rng.integers(len(phrases)))]
+        rot = int(rng.integers(len(p)))
+        toks = (p[rot:] + p[:rot]) * (seq // len(p) + 2)
+        rows[b] = toks[:seq]
+    return rows
+
+
+def train_fixture(steps: int = TRAIN_STEPS) -> Tuple[Any, Any]:
+    """Pre-train the fixture model on the phrase corpus; returns
+    (model, params). ~seconds on CPU at the default recipe."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from determined_tpu.models import gpt as gpt_mod
+
+    model = gpt_mod.GPT(fixture_model_config())
+    params = model.init(jax.random.PRNGKey(TRAIN_SEED))
+    phrases = fixture_phrases()
+    opt = optax.adam(TRAIN_LR)
+    opt_state = opt.init(params)
+    loss_rng = jax.random.PRNGKey(TRAIN_SEED)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        def loss_fn(p):
+            loss, _metrics = model.loss(p, {"tokens": tokens}, loss_rng)
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.default_rng(TRAIN_SEED)
+    loss = None
+    for i in range(steps):
+        tokens = _corpus_batch(rng, phrases, TRAIN_BATCH, 64)
+        params, opt_state, loss = step(params, opt_state, jnp.asarray(tokens))
+    logger.info(
+        "serving fixture trained: %d steps, final loss %.3f",
+        steps, float(loss) if loss is not None else float("nan"),
+    )
+    return model, params
+
+
+def ensure_fixture(
+    cache_dir: Optional[str] = None, *, steps: int = TRAIN_STEPS,
+) -> Tuple[Any, Any, str]:
+    """Load the fixture checkpoint, training and saving it first when the
+    cache is cold. Returns (model, params, checkpoint_dir).
+
+    The on-disk layout is the PR 1 checkpoint chain: leaf files via
+    save_pytree, then manifest.json (sha256 + size per file) written
+    LAST — the commit point. Every load verifies the manifest; a corrupt
+    or torn cache entry is named, discarded, and retrained rather than
+    served.
+    """
+    import jax
+
+    from determined_tpu.models import gpt as gpt_mod
+    from determined_tpu.storage.base import (
+        MANIFEST_FILE,
+        MANIFEST_VERSION,
+        CorruptCheckpointError,
+        file_digest,
+        verify_checkpoint_dir,
+    )
+    from determined_tpu.trainer import _checkpoint as ckpt
+
+    path = cache_dir or default_cache_dir()
+    model = gpt_mod.GPT(fixture_model_config())
+    like = jax.eval_shape(model.init, jax.random.PRNGKey(TRAIN_SEED))
+    if os.path.exists(os.path.join(path, MANIFEST_FILE)):
+        try:
+            verify_checkpoint_dir(path)
+            params = ckpt.load_pytree(path, like)
+            return model, params, path
+        except CorruptCheckpointError as e:
+            logger.warning(
+                "serving fixture cache at %s failed verification (%s); "
+                "retraining", path, e,
+            )
+            import shutil
+
+            shutil.rmtree(path, ignore_errors=True)
+    model, params = train_fixture(steps=steps)
+    os.makedirs(path, exist_ok=True)
+    written = ckpt.save_pytree(params, path)  # relative leaf-file names
+    files = {
+        rel: file_digest(os.path.join(path, rel)) for rel in written
+    }
+    # Manifest LAST: its presence IS the commit point — a crash between
+    # save_pytree and here leaves a torn dir the next load retrains.
+    tmp = os.path.join(path, MANIFEST_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump({"version": MANIFEST_VERSION, "files": files}, f,
+                  indent=0, sort_keys=True)
+    os.replace(tmp, os.path.join(path, MANIFEST_FILE))
+    return model, params, path
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    _model, _params, path = ensure_fixture()
+    print(path)  # print-ok: CLI contract — the path IS the output
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -151,6 +151,37 @@ def zipf_prefix_prompts(
     ]
 
 
+def corpus_ngram_prompts(
+    n_requests: int,
+    phrases: List[List[int]],
+    *,
+    skew: float = 1.1,
+    seed: int = 0,
+    lead_len: int = 3,
+) -> List[List[int]]:
+    """Corpus-derived prompts with REPEATED n-grams: each request picks a
+    zipfian-hot context phrase (the shared-prefix shape the prefix cache
+    keys on) plus a distinct body phrase, then re-opens the body with its
+    first `lead_len` tokens — so the prompt's trailing n-gram already
+    occurred earlier in the prompt, and both consumers fire: the
+    prompt-lookup speculator finds the gram and drafts the body's
+    continuation, and a corpus-trained model's greedy decode actually
+    WALKS that continuation, so drafts verify. Deterministic in `seed` —
+    spec-on vs spec-off bench passes replay the IDENTICAL list."""
+    import random
+
+    rng = random.Random(seed)
+    weights = [1.0 / (rank + 1) ** skew for rank in range(len(phrases))]
+    prompts = []
+    for _ in range(n_requests):
+        ctx = rng.choices(range(len(phrases)), weights=weights, k=1)[0]
+        body = rng.randrange(len(phrases))
+        prompts.append(
+            phrases[ctx] + phrases[body] + phrases[body][:lead_len]
+        )
+    return prompts
+
+
 def drive(
     url: str,
     n_requests: int,

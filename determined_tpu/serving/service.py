@@ -368,25 +368,43 @@ class GenerationServer:
 
 
 def build_engine(serving_cfg: Dict[str, Any]) -> GenerationEngine:
-    """Model + engine from a config's `serving:` section (random params —
-    checkpoint loading rides the batch-inference restore path when a
-    checkpoint id is configured upstream)."""
+    """Model + engine from a config's `serving:` section. Params come
+    from DTPU_SERVING_CHECKPOINT (a manifest-verified checkpoint
+    directory in the trainer's save_pytree layout) when set, otherwise
+    random init — the dev/test default."""
     import dataclasses
+    import os
 
     import jax
 
     from determined_tpu.models import gpt as gpt_mod
     from determined_tpu.serving.config import ServingConfig
 
+    from determined_tpu.serving.fixture import fixture_model_config
+
     cfg = ServingConfig.from_dict(serving_cfg or {})
     config_builder = {"tiny": gpt_mod.tiny, "small": gpt_mod.small,
-                      "medium": gpt_mod.medium}[cfg.model]
+                      "medium": gpt_mod.medium,
+                      "fixture": fixture_model_config}[cfg.model]
     model = gpt_mod.GPT(config_builder())
     if cfg.prefill_seq > model.config.seq_len:
         # A small model with the default prefill geometry must come up
         # serving (shorter prompts), not refuse to start.
         cfg = dataclasses.replace(cfg, prefill_seq=model.config.seq_len)
-    params = model.init(jax.random.PRNGKey(0))
+    ckpt_dir = os.environ.get("DTPU_SERVING_CHECKPOINT", "")
+    if ckpt_dir:
+        # Manifest verification BEFORE the weights go live: a torn or
+        # bit-flipped checkpoint is a named refusal at startup, not a
+        # silently-wrong model serving traffic.
+        from determined_tpu.storage.base import verify_checkpoint_dir
+        from determined_tpu.trainer import _checkpoint as ckpt
+
+        verify_checkpoint_dir(ckpt_dir)
+        like = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+        params = ckpt.load_pytree(ckpt_dir, like)
+        logger.info("serving params restored from %s", ckpt_dir)
+    else:
+        params = model.init(jax.random.PRNGKey(0))
     return GenerationEngine(model, params, cfg)
 
 

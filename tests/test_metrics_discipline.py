@@ -708,6 +708,61 @@ class TestPrefixCacheAndRouterSeries:
         assert stats["prefix_cache"]["hits"] >= 1
 
 
+class TestSpeculationSeries:
+    """PR 17 satellite: the speculative-decoding counters land on a
+    serving replica's LIVE /metrics surface (scraped over HTTP, not read
+    in-process) and the acceptance rate rides /api/v1/stats. Parity,
+    rollback, and fault semantics are drilled in
+    tests/test_speculation.py."""
+
+    def test_spec_series_on_live_metrics_surface(self):
+        from determined_tpu.serving.service import GenerationServer
+        from tests.test_serving import make_engine
+
+        engine = make_engine(
+            speculation={"mode": "ngram", "draft_len": 4, "min_match": 2},
+        )
+        engine.start()
+        server = GenerationServer(engine)
+        server.start()
+        try:
+            # n-gram-rich prompt: the trailing bigram recurs, so the
+            # prompt-lookup proposer drafts from the first decode step
+            resp = requests.post(
+                f"{server.url}/api/v1/generate",
+                json={"prompt": [1, 2, 3, 4, 1, 2, 3, 4, 1, 2],
+                      "max_new_tokens": 16, "stream": False},
+                timeout=180,
+            )
+            assert resp.status_code == 200
+            text = requests.get(f"{server.url}/metrics", timeout=30).text
+            stats = requests.get(
+                f"{server.url}/api/v1/stats", timeout=30
+            ).json()
+        finally:
+            server.stop()
+            engine.stop()
+        samples = parse_exposition(text)
+        assert sample_value(
+            samples, "dtpu_serving_spec_proposed_tokens_total"
+        ) >= 1
+        assert sample_value(
+            samples, "dtpu_serving_spec_accepted_tokens_total"
+        ) >= 1
+        # present (rendered at zero) even before their first event
+        assert sample_value(
+            samples, "dtpu_serving_spec_rollback_tokens_total"
+        ) is not None
+        assert sample_value(
+            samples, "dtpu_serving_spec_fallbacks_total"
+        ) is not None
+        # the stats surface carries the acceptance rate for dashboards
+        spec = stats["speculation"]
+        assert spec["mode"] == "ngram"
+        assert spec["proposed_tokens"] >= 1
+        assert spec["acceptance_rate"] > 0
+
+
 class TestOverloadAndHarnessSeries:
     """PR 15: the two-lane admission map stays anchored to REAL route
     patterns, a shed is visible on the LIVE /metrics surface (counter,

@@ -347,6 +347,112 @@ class TestGeometryContract:
         assert paged_pages_read(lengths, active, 16) == 5
 
 
+class TestQLens:
+    """The speculative-verify extension: `q_lens[b]` live query rows per
+    slot, row r attending the committed window PLUS the first r draft
+    positions (cols ≤ lengths[b] + r)."""
+
+    def test_qlens_ones_bitwise_equals_none(self):
+        """q_lens of all-ones is EXACTLY the plain decode geometry — the
+        spec-capable call must be bitwise identical to the legacy one,
+        which is what lets one compiled function serve mixed batches."""
+        rng = np.random.default_rng(21)
+        ps, B, P, H, Dh = 8, 3, 3, 2, 16
+        kp, vp, pt, lengths, active = _pool_state(
+            rng, num_pages=B * P + 2, page_size=ps, n_heads=H, head_dim=Dh,
+            batch=B, pages_per_slot=P, lengths=[2, ps, 2 * ps - 1],
+            active=[1, 0, 1],
+        )
+        q = jnp.asarray(rng.normal(size=(B, 2, H, Dh)).astype(np.float32))
+        o_none = np.asarray(paged_attention(
+            q, kp, vp, pt, lengths, active, interpret=True
+        ))
+        o_ones = np.asarray(paged_attention(
+            q, kp, vp, pt, lengths, active,
+            q_lens=jnp.ones((B,), jnp.int32), interpret=True,
+        ))
+        assert np.array_equal(o_none, o_ones)
+
+    def test_multirow_verify_vs_dense_reference(self):
+        """Ragged q_lens across a batch (1, full draft, mid) against a
+        per-row dense reference: row r sees exactly lengths[b] + r + 1
+        keys. Draft rows cross page boundaries on purpose."""
+        rng = np.random.default_rng(22)
+        ps, B, P, H, Dh, Q = 8, 3, 4, 2, 16, 5
+        kp, vp, pt, lengths, active = _pool_state(
+            rng, num_pages=B * P + 2, page_size=ps, n_heads=H, head_dim=Dh,
+            batch=B, pages_per_slot=P,
+            # slot 1's draft spans a page edge (ps-2 .. ps+2)
+            lengths=[3, ps - 2, 2 * ps], active=[1, 1, 1],
+        )
+        q_lens = jnp.asarray(np.array([1, Q, 3], np.int32))
+        q = jnp.asarray(rng.normal(size=(B, Q, H, Dh)).astype(np.float32))
+        o = np.asarray(paged_attention(
+            q, kp, vp, pt, lengths, active, q_lens=q_lens, interpret=True
+        ))
+        kp_n, vp_n, pt_n = np.asarray(kp), np.asarray(vp), np.asarray(pt)
+        for b in range(B):
+            for r in range(int(np.asarray(q_lens)[b])):
+                n = int(np.asarray(lengths)[b]) + r + 1
+                pages = pt_n[b, : -(-n // ps)]
+                kf = kp_n[pages].reshape(-1, H, Dh)[:n]
+                vf = vp_n[pages].reshape(-1, H, Dh)[:n]
+                ref = np.asarray(reference_attention(
+                    jnp.asarray(q)[b:b + 1, r:r + 1], jnp.asarray(kf)[None],
+                    jnp.asarray(vf)[None], causal=False,
+                ), np.float32)[0, 0]
+                np.testing.assert_allclose(
+                    o[b, r], ref, rtol=0, atol=2e-5,
+                    err_msg=f"slot {b} draft row {r}",
+                )
+
+    def test_dead_pages_never_read_with_qlens(self):
+        """Poison every page past each slot's lengths + q_lens - 1
+        horizon: outputs on the live rows must not move — the draft
+        window widens the read set by exactly the draft, nothing more."""
+        rng = np.random.default_rng(23)
+        ps, B, P, H, Dh, Q = 8, 2, 4, 2, 16, 4
+        lengths = [ps - 2, 2 * ps - 1]
+        q_lens = np.array([Q, 2], np.int32)
+        kp, vp, pt, lengths, active = _pool_state(
+            rng, num_pages=B * P + 3, page_size=ps, n_heads=H, head_dim=Dh,
+            batch=B, pages_per_slot=P, lengths=lengths, active=[1, 1],
+        )
+        q = jnp.asarray(rng.normal(size=(B, Q, H, Dh)).astype(np.float32))
+        o = np.asarray(paged_attention(
+            q, kp, vp, pt, lengths, active, q_lens=jnp.asarray(q_lens),
+            interpret=True,
+        ))
+        live = set()
+        for b in range(B):
+            n = int(np.asarray(lengths)[b]) + int(q_lens[b])  # last live +1
+            live |= set(np.asarray(pt)[b, : -(-n // ps)].tolist())
+        kp_n, vp_n = np.asarray(kp).copy(), np.asarray(vp).copy()
+        for pg in range(kp_n.shape[0]):
+            if pg not in live:
+                kp_n[pg] = 1e6
+                vp_n[pg] = -1e6
+        o_poisoned = np.asarray(paged_attention(
+            q, jnp.asarray(kp_n), jnp.asarray(vp_n), pt, lengths, active,
+            q_lens=jnp.asarray(q_lens), interpret=True,
+        ))
+        for b in range(B):
+            m = int(q_lens[b])
+            assert np.array_equal(o[b, :m], o_poisoned[b, :m])
+
+    def test_pages_read_mirror_with_qlens(self):
+        lengths = np.array([0, 15, 16, 40], np.int32)
+        active = np.array([1, 1, 0, 1], bool)
+        q_lens = np.array([5, 2, 9, 1], np.int32)
+        # page_size 16, last live pos = length + q_len - 1:
+        # 4 → 1 page; 16 → 2; inactive → 0; 40 → 3
+        assert paged_pages_read(lengths, active, 16, q_lens=q_lens) == 6
+        # all-ones q_lens degenerates to the legacy accounting
+        ones = np.ones((4,), np.int32)
+        assert paged_pages_read(lengths, active, 16, q_lens=ones) == \
+            paged_pages_read(lengths, active, 16)
+
+
 class TestPagedAutotune:
     def test_off_tpu_returns_deterministic_fallback(self, tmp_path):
         from determined_tpu.ops.flash_autotune import tune_paged_block_h
